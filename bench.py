@@ -1,0 +1,82 @@
+#!/usr/bin/env python
+"""Headline benchmark: DCML-AS MAT training throughput (env-steps/sec).
+
+Measures the full training loop — on-device rollout (autoregressive MAT decode
++ vectorized DCML env) and the PPO update — exactly the workload the reference
+runs at ≈7.3 env-steps/s total throughput (BASELINE.md: wall-clock between
+TensorBoard rows of the shipped training curve, ``momat_ct.csv``).
+
+Prints ONE json line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+BASELINE_STEPS_PER_SEC = 7.3  # BASELINE.md, derived from momat_ct.csv timestamps
+
+
+def main() -> None:
+    # benchmark knobs (env-tunable, defaults sized for a single TPU chip)
+    E = int(os.environ.get("BENCH_N_ENVS", "32"))
+    T = int(os.environ.get("BENCH_EPISODE_LENGTH", "50"))
+    ITERS = int(os.environ.get("BENCH_ITERS", "3"))
+
+    from mat_dcml_tpu.utils.platform import apply_platform_override
+
+    apply_platform_override()
+    import jax
+
+    from mat_dcml_tpu.config import RunConfig
+    from mat_dcml_tpu.envs.dcml import DCMLEnv, DCMLEnvConfig
+    from mat_dcml_tpu.training.ppo import MATTrainer, PPOConfig
+    from mat_dcml_tpu.training.rollout import RolloutCollector
+    from mat_dcml_tpu.training.runner import build_mat_policy
+
+    data_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)), "data")
+    run = RunConfig(n_rollout_threads=E, episode_length=T)
+    ppo = PPOConfig()
+
+    env = DCMLEnv(DCMLEnvConfig(), data_dir=data_dir)
+    policy = build_mat_policy(run, env)
+    trainer = MATTrainer(policy, ppo)
+    collector = RolloutCollector(env, policy, T)
+
+    params = policy.init_params(jax.random.key(0))
+    train_state = trainer.init_state(params)
+    rollout_state = collector.init_state(jax.random.key(1), E)
+
+    collect = jax.jit(collector.collect)
+    train = jax.jit(trainer.train)
+
+    # warmup: compile both programs and run one full iteration
+    rollout_state, traj = collect(train_state.params, rollout_state)
+    train_state, metrics = train(train_state, traj, rollout_state, jax.random.key(2))
+    jax.block_until_ready(train_state)
+
+    start = time.perf_counter()
+    for i in range(ITERS):
+        rollout_state, traj = collect(train_state.params, rollout_state)
+        train_state, metrics = train(train_state, traj, rollout_state, jax.random.key(3 + i))
+    jax.block_until_ready(train_state)
+    elapsed = time.perf_counter() - start
+
+    steps = ITERS * E * T
+    steps_per_sec = steps / elapsed
+    print(
+        json.dumps(
+            {
+                "metric": "dcml_mat_train_env_steps_per_sec",
+                "value": round(steps_per_sec, 2),
+                "unit": "env_steps/s",
+                "vs_baseline": round(steps_per_sec / BASELINE_STEPS_PER_SEC, 2),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,16 @@
+"""mat_dcml_tpu: a TPU-native (JAX/XLA/Pallas) Multi-Agent Transformer framework.
+
+A from-scratch reimplementation of the capabilities of the reference
+MAT-DCML project (Multi-Agent Transformer applied to Distributed Coded
+Machine Learning worker selection), redesigned TPU-first:
+
+- Agents-as-sequence MAT models as pure Flax modules (``models/``).
+- Fused attention and scan-based autoregressive decoding (``ops/``, ``models/decode.py``).
+- Pure-JAX vectorized environments (``envs/``) replacing subprocess vec-envs.
+- Single-jit PPO training with mesh sharding (``training/``, ``parallel/``).
+
+Reference parity citations use the form ``<file>:<line>`` into the upstream
+tree (e.g. ``ma_transformer.py:233``); see SURVEY.md for the layer map.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,88 @@
+"""Experiment configuration: typed dataclasses + strict CLI.
+
+Replaces the reference's single global argparse namespace threaded through
+every layer (``mat/config.py:156-315``).  Unknown flags are an error — the
+reference's ``parse_known_args`` silently dropped them, which demonstrably ate
+a hyperparameter (``DCML_MAT_Train.py:193`` passes ``"value_loss_coef"``
+without ``--`` and it vanishes; SURVEY.md §7 known defects).
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+from typing import Optional
+
+from mat_dcml_tpu.training.ppo import PPOConfig
+
+
+@dataclasses.dataclass(frozen=True)
+class RunConfig:
+    """Run-level settings (env/episode/bookkeeping)."""
+
+    algorithm_name: str = "mat"       # mat | mat_dec | mat_encoder | mat_decoder | mat_gru | ...
+    env_name: str = "DCML"
+    scenario: str = "AS"
+    experiment_name: str = "check"
+    seed: int = 1
+    n_rollout_threads: int = 8        # env-batch size E (vmapped, not OS threads)
+    num_env_steps: int = 1_000_000
+    episode_length: int = 50
+    log_interval: int = 5
+    save_interval: int = 50
+    eval_interval: int = 25
+    use_eval: bool = False
+    eval_episodes: int = 32
+    run_dir: str = "results"
+    model_dir: Optional[str] = None
+    # model
+    n_block: int = 2
+    n_embd: int = 64
+    n_head: int = 2
+    encode_state: bool = False
+    dec_actor: bool = False
+    share_actor: bool = False
+    n_objective: int = 1
+
+    @property
+    def episodes(self) -> int:
+        return int(self.num_env_steps) // self.episode_length // self.n_rollout_threads
+
+
+def dcml_default_configs() -> tuple[RunConfig, PPOConfig]:
+    """The DCML-AS training recipe (``DCML_MAT_Train.py:193``), including the
+    ``value_loss_coef=1.0`` that the reference *actually* trained with (its
+    intended 1.5 was silently dropped by argparse)."""
+    return RunConfig(), PPOConfig()
+
+
+def _parse_bool(s: str) -> bool:
+    low = s.lower()
+    if low in ("1", "true", "yes", "on"):
+        return True
+    if low in ("0", "false", "no", "off"):
+        return False
+    raise argparse.ArgumentTypeError(f"expected a boolean, got {s!r}")
+
+
+def _add_dataclass_args(parser: argparse.ArgumentParser, dc) -> None:
+    for f in dataclasses.fields(dc):
+        name = "--" + f.name
+        default = getattr(dc, f.name)
+        if f.type == "bool" or isinstance(default, bool):
+            parser.add_argument(name, type=_parse_bool, default=default)
+        elif default is None:
+            parser.add_argument(name, default=None)
+        else:
+            parser.add_argument(name, type=type(default), default=default)
+
+
+def parse_cli(argv=None) -> tuple[RunConfig, PPOConfig]:
+    run, ppo = dcml_default_configs()
+    parser = argparse.ArgumentParser(description="mat_dcml_tpu trainer", allow_abbrev=False)
+    _add_dataclass_args(parser, run)
+    _add_dataclass_args(parser, ppo)
+    ns = parser.parse_args(argv)  # strict: unknown flags raise
+    run_kwargs = {f.name: getattr(ns, f.name) for f in dataclasses.fields(RunConfig)}
+    ppo_kwargs = {f.name: getattr(ns, f.name) for f in dataclasses.fields(PPOConfig)}
+    return RunConfig(**run_kwargs), PPOConfig(**ppo_kwargs)

@@ -1,0 +1,1 @@
+"""Environment suite: pure-JAX vectorized envs + host escape hatch."""

@@ -1,0 +1,4 @@
+"""The DCML worker-selection / workload-allocation environment, pure JAX."""
+
+from mat_dcml_tpu.envs.dcml.constants import DCMLConsts
+from mat_dcml_tpu.envs.dcml.env import DCMLEnv, DCMLEnvConfig, DCMLState, TimeStep

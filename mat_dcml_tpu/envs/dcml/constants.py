@@ -1,0 +1,49 @@
+"""DCML environment constants.
+
+Mirrors ``DCML_ENVs/DCML_utils/DCML_Config.py`` plus the module-level constants
+of ``DCML_Master.py:6-16`` and ``DCML_Worker_TIMESLOT_MultiProcess.py:5-12``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+
+@dataclasses.dataclass(frozen=True)
+class DCMLConsts:
+    # DCML_Config.py
+    worker_number_max: int = 100
+    extra_agent: int = 1
+    action_dim: int = 2
+    local_obs_dim: int = 7            # DYNAMIC_PRICE = False branch
+    sob_dim: int = 102
+    local_workload_period: int = 20
+    time_slot: int = 100
+    state_ratio: float = 1.0
+    pr_min: float = 0.0
+    pr_max: float = 0.95
+    continue_probability: float = 0.8
+    heterogeneous: bool = True
+    non_shannon_data_rate: float = 150.0 * (2**10) * (2**10)
+    unavailable_price: float = 10.0
+    master_price: float = 0.0
+
+    # DCML_Master.py:6-16
+    r_min: int = 2**10
+    r_max: int = 2**20
+    c_min: int = 2**5
+    c_max: int = 2**10
+
+    # DCML_Worker_TIMESLOT_MultiProcess.py:5-12
+    worker_frequency: float = 2e9
+    bit_to_byte: float = 4.0
+    second_to_centsec: float = 1.0
+    lambda_of_poisson: float = 3.0
+
+    # DCML_ENV_Functions.py:15-17
+    reward_alpha: float = 99.0
+    reward_beta: float = 1.0
+
+    @property
+    def n_agents(self) -> int:
+        return self.worker_number_max + self.extra_agent

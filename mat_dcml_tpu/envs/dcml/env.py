@@ -1,0 +1,442 @@
+"""Pure-JAX DCML worker-selection environment.
+
+A stateless, fully-vectorized rewrite of the reference env stack
+(``DCML_BID_FIRST_MA_ENV_SingleProcess.py`` + ``DCML_Master.py`` +
+``DCML_Worker_TIMESLOT_MultiProcess.py``).  Where the reference runs 100
+pure-Python worker simulations per step inside subprocess vec-envs
+(SURVEY.md §3.5), this env is a ``step(state, action) -> (state, timestep)``
+array program: ``vmap`` it over thousands of env instances and ``lax.scan`` it
+inside the rollout jit.
+
+Key closed-form rewrites (all proven equivalent in distribution — see
+tests/test_dcml_env.py):
+
+- Geometric retry loops (``DCML_Worker...py:54-59,100-105``): the loop
+  ``n=1; while U()<Pr: n+=1`` adds ``F ~ floor(log U / log Pr)`` failures;
+  sampled directly.
+- The queue-drain loop (``DCML_Worker...py:87-95``): the local workload trace
+  is 20-periodic, so the first ``m`` with cumulative free capacity >= cost is
+  computed from one period's cumulative sum (q full periods + partial index).
+- The reference's upload-retry block is indented *inside* the drain loop
+  (``DCML_Worker...py:99-106``) so retry counts inflate once per drained
+  timeslot; replicated faithfully via a negative-binomial draw (sum of m
+  geometric draws, sampled as Poisson(Gamma(m, Pr/(1-Pr)))).  Set
+  ``fixed_upload_retry=True`` for the evidently-intended single draw
+  (documented divergence, SURVEY.md §7 "known defects").
+- The K-th-smallest selected delay (``DCML_..._SingleProcess.py:128-130``):
+  unselected delays set to +inf, one sort, take ``[K-1]``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from pathlib import Path
+from typing import NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from mat_dcml_tpu.envs.dcml.constants import DCMLConsts
+
+_INF = jnp.inf
+
+
+class DCMLState(NamedTuple):
+    """Per-env state; the fields the *next* ``step`` consumes (set by the last
+    auto-reset, mirroring how the reference's ``reset`` primes ``step``)."""
+
+    rng: jax.Array               # PRNG key
+    r_rows: jax.Array            # master R (float32, integral value)
+    c_cols: jax.Array            # master C
+    master_pr: jax.Array         # master failure prob (homogeneous mode)
+    worker_prs: jax.Array        # (W,) per-worker failure probs
+    trace: jax.Array             # (W, P) local workload in [0, 1]
+    unavailable: jax.Array       # (W,) bool
+    arrive_time: jax.Array       # int32 in [0, P)
+    disable_rate: jax.Array      # int32
+    episode_idx: jax.Array       # int32, preset replay cursor
+
+
+class TimeStep(NamedTuple):
+    obs: jax.Array               # (A, local_obs_dim)
+    share_obs: jax.Array         # (A, sob_dim)
+    available_actions: jax.Array  # (A, action_dim)
+    reward: jax.Array            # (A, 1)
+    done: jax.Array              # (A,) bool
+    delay: jax.Array             # scalar info
+    payment: jax.Array           # scalar info
+
+
+@dataclasses.dataclass(frozen=True)
+class DCMLEnvConfig:
+    consts: DCMLConsts = DCMLConsts()
+    fixed: bool = False              # "select all available, K=0.7N" baseline (:58-62)
+    preset: bool = False             # deterministic eval replay (:25-32,174-194)
+    fixed_upload_retry: bool = False  # fix the reference's in-loop retry defect
+    max_drain_slots: float = 2**30   # numerical guard on the drain-loop bound
+
+
+class DCMLEnv:
+    """Functional env bundle.  All methods are jit/vmap-safe."""
+
+    def __init__(
+        self,
+        config: DCMLEnvConfig = DCMLEnvConfig(),
+        base_workloads: Optional[np.ndarray] = None,
+        preset_master: Optional[np.ndarray] = None,
+        preset_worker_prs: Optional[np.ndarray] = None,
+        preset_disable_rates: Optional[np.ndarray] = None,
+        data_dir: str | Path = "data",
+    ):
+        self.cfg = config
+        c = config.consts
+        if base_workloads is None:
+            base_workloads = load_base_workloads(Path(data_dir) / "workloads.txt", c)
+        self.base_workloads = jnp.asarray(base_workloads, jnp.float32)
+        assert self.base_workloads.shape == (c.worker_number_max, c.local_workload_period)
+        if config.preset:
+            if preset_master is None:
+                preset_master, preset_worker_prs, preset_disable_rates = load_preset(
+                    Path(data_dir) / "dcml_benchmark", sample=1
+                )
+            self.preset_master = jnp.asarray(preset_master, jnp.float32)
+            self.preset_worker_prs = jnp.asarray(preset_worker_prs, jnp.float32)
+            self.preset_disable_rates = jnp.asarray(preset_disable_rates, jnp.int32)
+        else:
+            self.preset_master = None
+            self.preset_worker_prs = None
+            self.preset_disable_rates = None
+
+        self.n_agents = c.n_agents
+        self.obs_dim = c.local_obs_dim
+        self.share_obs_dim = c.sob_dim
+        self.action_dim = c.action_dim
+
+    # ------------------------------------------------------------------ reset
+
+    def reset(self, key: jax.Array, episode_idx: jax.Array | int = 0) -> Tuple[DCMLState, TimeStep]:
+        """Fresh episode; mirrors ``Env.reset`` (``DCML_..._SingleProcess.py:157-274``)."""
+        c = self.cfg.consts
+        key, k_dr, k_at, k_master, k_prs, k_trace, k_ava = jax.random.split(key, 7)
+
+        episode_idx = jnp.asarray(episode_idx, jnp.int32)
+        # random.randint(1, 80) — inclusive (:158)
+        disable_rate = jax.random.randint(k_dr, (), 1, 81, jnp.int32)
+        arrive_time = jax.random.randint(k_at, (), 0, c.local_workload_period, jnp.int32)
+
+        # Master.reset (:46-56): R ~ randint(R_MIN, round(R_MAX*1.1)),
+        # C ~ randint(C_MIN, round(C_MAX*1.1)), Pr ~ U(0, 0.95), inclusive ends.
+        k_r, k_c, k_pr = jax.random.split(k_master, 3)
+        r_rows = jax.random.randint(k_r, (), c.r_min, round(c.r_max * 1.1) + 1).astype(jnp.float32)
+        c_cols = jax.random.randint(k_c, (), c.c_min, round(c.c_max * 1.1) + 1).astype(jnp.float32)
+        master_pr = jax.random.uniform(k_pr, (), minval=c.pr_min, maxval=c.pr_max)
+
+        worker_prs = jax.random.uniform(k_prs, (c.worker_number_max,), minval=c.pr_min, maxval=c.pr_max)
+
+        if self.cfg.preset:
+            # Wrap past the end of the fixture instead of JAX's silent
+            # clamp-at-last-row (the reference would IndexError there; its
+            # benchmark protocol never exceeds the 1001 episodes).
+            idx = jnp.mod(episode_idx, self.preset_master.shape[0])
+            row = self.preset_master[idx]
+            r_rows, c_cols, master_pr = row[0], row[1], row[2]
+            worker_prs = self.preset_worker_prs[idx]
+            disable_rate = self.preset_disable_rates[idx]
+
+        # all_workload = clip(base * U(0.8, 1.2), 0, 1)  (DCML_Worker...py:39,111)
+        noise = jax.random.uniform(k_trace, self.base_workloads.shape, minval=0.8, maxval=1.2)
+        trace = jnp.clip(self.base_workloads * noise, 0.0, 1.0)
+
+        # np.random.choice(W, disable_rate, replace=False) (:199): mark the
+        # first `disable_rate` slots of a random permutation unavailable.
+        perm_rank = jnp.argsort(jax.random.uniform(k_ava, (c.worker_number_max,)))
+        unavailable = perm_rank < disable_rate
+
+        state = DCMLState(
+            rng=key,
+            r_rows=r_rows,
+            c_cols=c_cols,
+            master_pr=master_pr,
+            worker_prs=worker_prs,
+            trace=trace,
+            unavailable=unavailable,
+            arrive_time=arrive_time,
+            disable_rate=disable_rate,
+            episode_idx=episode_idx + 1,
+        )
+        obs, share_obs, ava = self._observe(state)
+        ts = TimeStep(
+            obs=obs,
+            share_obs=share_obs,
+            available_actions=ava,
+            reward=jnp.zeros((c.n_agents, 1), jnp.float32),
+            done=jnp.zeros((c.n_agents,), bool),
+            delay=jnp.float32(0.0),
+            payment=jnp.float32(0.0),
+        )
+        return state, ts
+
+    # ------------------------------------------------------------------- step
+
+    def step(self, state: DCMLState, action: jax.Array) -> Tuple[DCMLState, TimeStep]:
+        """One task round; mirrors ``Env.step`` (``DCML_..._SingleProcess.py:57-144``).
+
+        ``action``: ``(n_agents,)`` or ``(n_agents, 1)`` — 100 select bits then
+        the coding ratio (the extra agent's continuous action).
+        """
+        c = self.cfg.consts
+        W = c.worker_number_max
+        action = action.reshape(-1)
+
+        key = state.rng
+        key, k_proc, k_done, k_reset = jax.random.split(key, 4)
+
+        if self.cfg.fixed:
+            select = (~state.unavailable).astype(jnp.float32)
+            n_raw = select.sum()
+            n_sel = n_raw
+            k_code = jnp.floor(n_sel * 0.7)
+        else:
+            select = action[:W]
+            ratio = action[-1]
+            n_raw = select.sum()
+            n_sel = n_raw
+            k_code = jnp.ceil(n_sel * ratio)
+
+        standalone = n_raw < 0.5
+        # clamp N in [1, W], K in [1, N]  (:96-103)
+        n_sel = jnp.clip(n_sel, 1.0, float(W))
+        k_code = jnp.clip(k_code, 1.0, n_sel)
+        # standalone path uses K = N = 1 (:81-83); the clamps above already
+        # produce K = 1, N = 1 when no worker is selected.
+
+        # Master.get_workload (:39-40): (ceil(R/K), C)
+        r_wl = jnp.ceil(state.r_rows / k_code)
+        c_wl = state.c_cols
+
+        delays, p0, c20, cap_period, m_slots = self._process_workers(
+            k_proc, r_wl, c_wl, state.worker_prs, state.trace, state.arrive_time
+        )
+
+        sel_mask = select > 0.5
+        masked_delays = jnp.where(sel_mask, delays, _INF)
+        sorted_delays = jnp.sort(masked_delays)
+        k_idx = k_code.astype(jnp.int32) - 1
+        final_delay = sorted_delays[k_idx]
+
+        end_timeslot = jnp.ceil(final_delay)
+        final_costs = self._cost_at(p0, c20, cap_period, m_slots, end_timeslot)
+        payment = jnp.sum(jnp.where(sel_mask, final_costs, 0.0))
+
+        reward_main = -(final_delay * c.reward_alpha) - payment * c.reward_beta
+
+        # standalone (:81-92): only worker 0 counts, reward scaled 1.5x, cost
+        # is the worker's full drained price (prices[-1]).
+        cost0_full = p0[0] + self._capacity(c20[0], cap_period[0], m_slots[0])
+        reward_alone = 1.5 * (-(delays[0] * c.reward_alpha) - cost0_full * c.reward_beta)
+
+        reward = jnp.where(standalone, reward_alone, reward_main)
+        delay_info = jnp.where(standalone, delays[0], final_delay)
+        payment_info = jnp.where(standalone, cost0_full, payment)
+
+        # done fires with CONTINUE_PROBABILITY (:141-142) — the reference uses
+        # it as a "next task unrelated" continuation flag; see ops/gae.py.
+        done = jax.random.uniform(k_done, ()) < c.continue_probability
+
+        new_state, reset_ts = self.reset(k_reset, state.episode_idx)
+        ts = TimeStep(
+            obs=reset_ts.obs,
+            share_obs=reset_ts.share_obs,
+            available_actions=reset_ts.available_actions,
+            reward=jnp.full((c.n_agents, 1), reward, jnp.float32),
+            done=jnp.full((c.n_agents,), done),
+            delay=delay_info,
+            payment=payment_info,
+        )
+        return new_state, ts
+
+    # ---------------------------------------------------------------- workers
+
+    def _process_workers(self, key, r_wl, c_wl, prs, trace, arrive_time):
+        """Vectorized ``Worker.process`` (``DCML_Worker...py:46-112``).
+
+        Returns per-worker ``(delay, p0, c20, cap_period, m_slots)`` where
+        ``p0`` is the transmit-time price floor, ``c20`` the cumulative free
+        capacity over one period starting at the arrival timepoint,
+        ``cap_period`` its total, and ``m_slots`` the drained timeslot count.
+        """
+        c = self.cfg.consts
+        W, P = trace.shape
+        k_dl, k_ul = jax.random.split(key)
+
+        compute_workload = (9.0 * r_wl - 3.0) * c_wl
+        cost0 = c.second_to_centsec * jnp.ceil(compute_workload) / c.worker_frequency
+
+        # download retry count: 1 + Geometric failures (:53-59)
+        fails0 = _geometric_failures(k_dl, prs)
+        n_retry = 1.0 + fails0
+        transmit_delay = (
+            c.second_to_centsec
+            * (jnp.ceil((r_wl + 1.0) * c_wl) * 1.0 * c.bit_to_byte / c.non_shannon_data_rate + 0.001)
+            * n_retry
+        )  # (:60)
+
+        p0 = jnp.floor(transmit_delay) * 0.1  # (:65)
+        arrive_ts = jnp.floor(transmit_delay + arrive_time)  # (:66)
+        ctp0 = jnp.mod(arrive_ts, P).astype(jnp.int32)  # (:67-69), timepoint = 0
+
+        wl0 = jnp.take_along_axis(trace, ctp0[:, None], axis=1)[:, 0]
+        frac = transmit_delay - jnp.floor(transmit_delay)
+        cost = cost0 + jnp.maximum(frac - wl0, 0.0)  # (:85-86)
+
+        # free capacity per slot, rolled to start at ctp0, one full period
+        idx = jnp.mod(ctp0[:, None] + jnp.arange(P)[None, :], P)
+        free = 1.0 - jnp.take_along_axis(trace, idx, axis=1)  # (W, P)
+        c20 = jnp.cumsum(free, axis=1)
+        cap_period = c20[:, -1]
+
+        # smallest m >= 1 with cumulative capacity >= cost (:87-95)
+        cap_safe = jnp.maximum(cap_period, 1e-6)
+        q_full = jnp.maximum(jnp.ceil(cost / cap_safe) - 1.0, 0.0)
+        rem = cost - q_full * cap_period
+        t_part = 1 + jnp.argmax(c20 >= rem[:, None] - 1e-9, axis=1)
+        m_slots = jnp.minimum(q_full * P + t_part, self.cfg.max_drain_slots)
+        drained = q_full * cap_period + jnp.take_along_axis(c20, (t_part - 1)[:, None], axis=1)[:, 0]
+
+        # upload retries: faithful mode adds one geometric draw per drained
+        # timeslot (the reference's in-loop indentation, :99-106); fixed mode
+        # draws once.
+        n_draws = jnp.ones_like(m_slots) if self.cfg.fixed_upload_retry else m_slots
+        extra_fails = _negative_binomial(k_ul, n_draws, prs)
+        n_retry_final = n_retry + extra_fails
+        upload_delay = (
+            c.second_to_centsec
+            * (jnp.ceil(r_wl) * 1.0 * c.bit_to_byte / c.non_shannon_data_rate + 0.001)
+            * n_retry_final
+            + 0.02
+        )  # (:106)
+
+        # (:108): finish_timeslot - arrive_time - overshoot + upload_delay
+        delay = (arrive_ts + m_slots) - arrive_time - (drained - cost) + upload_delay
+        return delay, p0, c20, cap_period, m_slots
+
+    def _capacity(self, c20_row, cap_period_row, j):
+        """Cumulative free capacity over the first ``j`` drained slots."""
+        P = c20_row.shape[0]
+        j = jnp.clip(j, 0, self.cfg.max_drain_slots)
+        q2 = jnp.floor(j / P)
+        r2 = (j - q2 * P).astype(jnp.int32)
+        partial = jnp.where(r2 > 0, c20_row[jnp.maximum(r2 - 1, 0)], 0.0)
+        return q2 * cap_period_row + partial
+
+    def _cost_at(self, p0, c20, cap_period, m_slots, end_timeslot):
+        """Per-worker accumulated price at ``end_timeslot``
+        (``DCML_..._SingleProcess.py:131-137``): ``prices[e-1]`` if the worker
+        was still draining, else its final price."""
+        j = jnp.minimum(jnp.maximum(end_timeslot, 1.0), m_slots)
+        cap = jax.vmap(self._capacity)(c20, cap_period, j)
+        return p0 + cap
+
+    # ------------------------------------------------------------------- obs
+
+    def _observe(self, state: DCMLState):
+        """Build (obs, share_obs, available_actions); mirrors
+        ``DCML_..._SingleProcess.py:162-274`` (OBSERVER_WORKLOAD branch,
+        HETEROGENEOUS, DYNAMIC_PRICE=False)."""
+        c = self.cfg.consts
+        W, P = c.worker_number_max, c.local_workload_period
+        avail = ~state.unavailable
+
+        r_norm = (state.r_rows - c.r_min) / (c.r_max - c.r_min)
+        c_norm = (state.c_cols - c.c_min) / (c.c_max - c.c_min)
+
+        at = state.arrive_time
+        slots = jnp.mod(at + jnp.arange(3), P)
+        wl3 = state.trace[:, slots]  # (W, 3)
+
+        n_avail = (W - state.disable_rate).astype(jnp.float32)
+        unavail_f = state.unavailable.astype(jnp.float32)
+        disabled_before = jnp.cumsum(unavail_f) - unavail_f
+        rank = (jnp.arange(W, dtype=jnp.float32) - disabled_before) / n_avail
+
+        # feature 7: own rank if available, else the previous block's feature 7
+        # (the obs[-7] back-reference at :210-213), forward-filled from 0.
+        def ff(carry, xs):
+            a, r = xs
+            out = jnp.where(a, r, carry)
+            return out, out
+
+        _, feat7 = jax.lax.scan(ff, jnp.float32(0.0), (avail, rank))
+
+        shared_head = jnp.stack([r_norm * c.state_ratio, c_norm * c.state_ratio])
+        worker_obs_avail = jnp.concatenate(
+            [jnp.broadcast_to(shared_head, (W, 2)), wl3, state.worker_prs[:, None], rank[:, None]],
+            axis=1,
+        )
+        worker_obs_unavail = jnp.concatenate(
+            [jnp.broadcast_to(shared_head, (W, 2)), jnp.ones((W, 4)), feat7[:, None]], axis=1
+        )
+        worker_obs = jnp.where(avail[:, None], worker_obs_avail, worker_obs_unavail)
+
+        # master ("extra") agent obs (:235-241): availability-masked means
+        af = avail.astype(jnp.float32)
+        denom = jnp.maximum(af.sum(), 1.0)
+        mean_wl3 = (wl3 * af[:, None]).sum(axis=0) / denom
+        mean_pr = (state.worker_prs * af).sum() / denom
+        master_obs = jnp.concatenate([shared_head, mean_wl3, jnp.array([mean_pr, 1.1])])
+
+        obs = jnp.concatenate([worker_obs, master_obs[None, :]], axis=0)
+
+        share_obs_row = jnp.concatenate([shared_head, state.worker_prs])  # (:181-182,252-253)
+        share_obs = jnp.broadcast_to(share_obs_row, (c.n_agents, c.sob_dim))
+
+        # availability mask (:266-268): [1,1] available / [1,0] disabled; master [1,1]
+        ava_workers = jnp.stack([jnp.ones(W), af], axis=1)
+        ava = jnp.concatenate([ava_workers, jnp.ones((1, 2))], axis=0)
+        return obs, share_obs, ava
+
+
+# ---------------------------------------------------------------- sampling
+
+
+def _geometric_failures(key: jax.Array, p_fail: jax.Array) -> jax.Array:
+    """Number of consecutive U() < p draws: F = floor(log U / log p), F=0 at p=0."""
+    u = jax.random.uniform(key, p_fail.shape, minval=jnp.finfo(jnp.float32).tiny, maxval=1.0)
+    safe_p = jnp.clip(p_fail, 1e-12, 1.0 - 1e-7)
+    f = jnp.floor(jnp.log(u) / jnp.log(safe_p))
+    return jnp.where(p_fail <= 0.0, 0.0, f)
+
+
+def _negative_binomial(key: jax.Array, n_draws: jax.Array, p_fail: jax.Array) -> jax.Array:
+    """Sum of ``n_draws`` iid geometric-failure counts, via the Gamma-Poisson
+    mixture: NB(n, p) = Poisson(Gamma(n, p/(1-p)))."""
+    k_g, k_p = jax.random.split(key)
+    safe_p = jnp.clip(p_fail, 0.0, 1.0 - 1e-6)
+    scale = safe_p / (1.0 - safe_p)
+    lam = jax.random.gamma(k_g, jnp.maximum(n_draws, 1e-6)) * scale
+    draws = jax.random.poisson(k_p, lam).astype(jnp.float32)
+    return jnp.where(p_fail <= 0.0, 0.0, draws)
+
+
+# ------------------------------------------------------------------ loaders
+
+
+def load_base_workloads(path: Path, consts: DCMLConsts) -> np.ndarray:
+    """Read the 100 stacked (20,) workload traces
+    (``DCML_..._SingleProcess.py:33-37`` reads them sequentially)."""
+    traces = []
+    with open(path, "rb") as reader:
+        for _ in range(consts.worker_number_max):
+            traces.append(np.load(reader, allow_pickle=True))
+    return np.stack(traces).astype(np.float32)
+
+
+def load_preset(bench_dir: Path, sample: int = 1):
+    """Load one of the 10 shipped eval fixtures (1001 episodes each)."""
+    with open(bench_dir / f"Sample_{sample}master_states.npy", "rb") as f:
+        master = np.load(f, allow_pickle=True)
+    with open(bench_dir / f"Sample_{sample}worker_states.npy", "rb") as f:
+        worker_prs = np.load(f, allow_pickle=False)
+        disable_rates = np.load(f, allow_pickle=False)
+    return master, worker_prs, disable_rates

@@ -1,0 +1,4 @@
+"""Model zoo: MAT encoder-decoder and its ablations, MLP/RNN actor-critics."""
+
+from mat_dcml_tpu.models.mat import MATConfig, MultiAgentTransformer
+from mat_dcml_tpu.models.policy import TransformerPolicy

@@ -1,0 +1,250 @@
+"""The Multi-Agent Transformer as a Flax module.
+
+Reference: ``mat_src/mat/algorithms/mat/algorithm/ma_transformer.py``.  The
+encoder doubles as the critic — its head emits per-agent values off the same
+trunk that produces ``obs_rep`` (``ma_transformer.py:141-154``); the decoder
+autoregressively maps previous agents' actions + ``obs_rep`` to the current
+agent's logits (``ma_transformer.py:157-230``).
+
+Action-type semantics (``ma_transformer.py:283-295``):
+  - ``discrete``: one categorical head per agent.
+  - ``semi_discrete``: the DCML mode — agents ``[0, n_agent+semi_index)`` are
+    categorical (worker-selection bits), the tail agents are Gaussian with
+    ``std = sigmoid(log_std) * 0.5`` (the coding-ratio agent)
+    (``transformer_act.py:30-129``).
+  - ``continuous``: Gaussian over all dims.
+  - ``available_continuous``: per-agent one-hot discrete part + Gaussian tail
+    concatenated (``transformer_act.py:234-322``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from mat_dcml_tpu.models.modules import (
+    DecodeBlock,
+    EncodeBlock,
+    GAIN_ACT,
+    dense,
+    init_decode_cache,
+)
+
+DISCRETE = "discrete"
+SEMI_DISCRETE = "semi_discrete"
+CONTINUOUS = "continuous"
+AVAILABLE_CONTINUOUS = "available_continuous"
+
+NORMAL_STD = 0.5  # transformer_act.py:6
+
+
+@dataclasses.dataclass(frozen=True)
+class MATConfig:
+    n_agent: int
+    obs_dim: int
+    state_dim: int
+    action_dim: int
+    n_block: int = 2
+    n_embd: int = 64
+    n_head: int = 2
+    action_type: str = DISCRETE
+    semi_index: int = -1          # number of trailing continuous agents, negated
+    discrete_dim: int = 2         # available_continuous: leading one-hot dims
+    encode_state: bool = False
+    dec_actor: bool = False       # "MAT-Dec" ablation (ma_transformer.py:175-189)
+    share_actor: bool = False
+    n_objective: int = 1          # >1 => MO-MAT vector-valued critic
+
+    @property
+    def action_input_dim(self) -> int:
+        # Discrete-style decoders consume one-hot + start-token slot.
+        if self.action_type in (DISCRETE, SEMI_DISCRETE, AVAILABLE_CONTINUOUS):
+            return self.action_dim + 1
+        return self.action_dim
+
+    @property
+    def n_discrete_agents(self) -> int:
+        """Agents with categorical heads in semi-discrete mode."""
+        return self.n_agent + self.semi_index
+
+
+class ObsEncoder(nn.Module):
+    """LayerNorm -> Linear -> GELU embed (``ma_transformer.py:131-134``)."""
+
+    n_embd: int
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        x = nn.LayerNorm()(x)
+        x = dense(self.n_embd, gain=GAIN_ACT)(x)
+        return nn.gelu(x)
+
+
+class Head(nn.Module):
+    """Linear-GELU-LN-Linear head (``ma_transformer.py:138-139,202-203``)."""
+
+    n_embd: int
+    out_dim: int
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        x = dense(self.n_embd, gain=GAIN_ACT)(x)
+        x = nn.gelu(x)
+        x = nn.LayerNorm()(x)
+        return dense(self.out_dim)(x)
+
+
+class Encoder(nn.Module):
+    """Value head + shared representation (``ma_transformer.py:119-154``)."""
+
+    cfg: MATConfig
+
+    def setup(self):
+        c = self.cfg
+        self.state_encoder = ObsEncoder(c.n_embd)
+        self.obs_encoder = ObsEncoder(c.n_embd)
+        self.ln = nn.LayerNorm()
+        self.blocks = [EncodeBlock(c.n_embd, c.n_head) for _ in range(c.n_block)]
+        self.head = Head(c.n_embd, c.n_objective)
+
+    def __call__(self, state: jax.Array, obs: jax.Array):
+        x = self.state_encoder(state) if self.cfg.encode_state else self.obs_encoder(obs)
+        rep = self.ln(x)
+        for blk in self.blocks:
+            rep = blk(rep)
+        v_loc = self.head(rep)
+        return v_loc, rep
+
+
+class DecActorMlp(nn.Module):
+    """Per-agent (or shared) MLP actor for the MAT-Dec ablation
+    (``ma_transformer.py:175-189``): LN-Linear-GELU-LN-Linear-GELU-LN-Linear."""
+
+    n_embd: int
+    action_dim: int
+
+    @nn.compact
+    def __call__(self, obs: jax.Array) -> jax.Array:
+        x = nn.LayerNorm()(obs)
+        x = nn.gelu(dense(self.n_embd, gain=GAIN_ACT)(x))
+        x = nn.LayerNorm()(x)
+        x = nn.gelu(dense(self.n_embd, gain=GAIN_ACT)(x))
+        x = nn.LayerNorm()(x)
+        return dense(self.action_dim)(x)
+
+
+class Decoder(nn.Module):
+    """Action-conditioned decoder (``ma_transformer.py:157-230``)."""
+
+    cfg: MATConfig
+
+    def setup(self):
+        c = self.cfg
+        if c.action_type != DISCRETE:
+            # std parameterized as sigmoid(log_std) * 0.5, init log_std = 1
+            # (ma_transformer.py:169-172, transformer_act.py:59).
+            self.log_std = self.param("log_std", lambda k: jnp.ones((c.action_dim,)))
+        if c.dec_actor:
+            if c.share_actor:
+                self.mlp = DecActorMlp(c.n_embd, c.action_dim)
+            else:
+                # One MLP per agent, vmapped over stacked parameters.
+                self.mlp = nn.vmap(
+                    DecActorMlp,
+                    in_axes=1,
+                    out_axes=1,
+                    axis_size=c.n_agent,
+                    variable_axes={"params": 0},
+                    split_rngs={"params": True},
+                )(c.n_embd, c.action_dim)
+        else:
+            if c.action_type in (DISCRETE, SEMI_DISCRETE):
+                self.action_encoder_nobias = dense(c.n_embd, gain=GAIN_ACT, use_bias=False)
+            else:
+                self.action_encoder_bias = dense(c.n_embd, gain=GAIN_ACT)
+            self.obs_encoder = ObsEncoder(c.n_embd)
+            self.ln = nn.LayerNorm()
+            self.blocks = [DecodeBlock(c.n_embd, c.n_head) for _ in range(c.n_block)]
+            self.head = Head(c.n_embd, c.action_dim)
+
+    def _embed_action(self, shifted_action: jax.Array) -> jax.Array:
+        if self.cfg.action_type in (DISCRETE, SEMI_DISCRETE):
+            return nn.gelu(self.action_encoder_nobias(shifted_action))
+        return nn.gelu(self.action_encoder_bias(shifted_action))
+
+    def __call__(self, shifted_action: jax.Array, obs_rep: jax.Array, obs: jax.Array) -> jax.Array:
+        """Full teacher-forced pass -> ``(B, n_agent, action_dim)`` logits."""
+        if self.cfg.dec_actor:
+            return self.mlp(obs)
+        x = self.ln(self._embed_action(shifted_action))
+        for blk in self.blocks:
+            x = blk(x, obs_rep)
+        return self.head(x)
+
+    def decode_step(self, shifted_action_i: jax.Array, rep_i: jax.Array, obs_i: jax.Array, caches, i):
+        """One autoregressive position with KV caches.
+
+        Args:
+          shifted_action_i: ``(B, 1, action_input_dim)`` previous agent's
+            (one-hot) action, or the start token at i = 0.
+          rep_i: ``(B, 1, n_embd)`` encoder rep at position i.
+          obs_i: ``(B, 1, obs_dim)`` obs at position i (dec_actor mode only).
+          caches: list of per-block KV cache dicts.
+          i: scalar agent index.
+
+        Returns:
+          ``(B, 1, action_dim)`` logits and updated caches.
+        """
+        if self.cfg.dec_actor:
+            return self.mlp(obs_i) if self.cfg.share_actor else self._dec_actor_step(obs_i, i), caches
+        x = self.ln(self._embed_action(shifted_action_i))
+        new_caches = []
+        for blk, cache in zip(self.blocks, caches):
+            x, cache = blk.decode_step(x, rep_i, cache, i)
+            new_caches.append(cache)
+        return self.head(x), new_caches
+
+    def _dec_actor_step(self, obs_i: jax.Array, i):
+        # Per-agent MLP selected by index: run all agents' MLPs on the same
+        # obs and gather row i (tiny model; avoids dynamic param indexing).
+        logits = self.mlp(jnp.broadcast_to(obs_i, (obs_i.shape[0], self.cfg.n_agent, obs_i.shape[-1])))
+        return jax.lax.dynamic_slice_in_dim(logits, i, 1, axis=1)
+
+    def std(self) -> jax.Array:
+        return jax.nn.sigmoid(self.log_std) * NORMAL_STD
+
+
+class MultiAgentTransformer(nn.Module):
+    """Wrapper exposing encode / decode methods for functional use
+    (``ma_transformer.py:233-339``)."""
+
+    cfg: MATConfig
+
+    def setup(self):
+        self.encoder = Encoder(self.cfg)
+        self.decoder = Decoder(self.cfg)
+
+    def __call__(self, state: jax.Array, obs: jax.Array, shifted_action: jax.Array):
+        """Init-path: touches both encoder and decoder parameters."""
+        v_loc, rep = self.encoder(state, obs)
+        logits = self.decoder(shifted_action, rep, obs)
+        return v_loc, rep, logits
+
+    def encode(self, state: jax.Array, obs: jax.Array):
+        return self.encoder(state, obs)
+
+    def decode_full(self, shifted_action: jax.Array, obs_rep: jax.Array, obs: jax.Array):
+        return self.decoder(shifted_action, obs_rep, obs)
+
+    def decode_step(self, shifted_action_i, rep_i, obs_i, caches, i):
+        return self.decoder.decode_step(shifted_action_i, rep_i, obs_i, caches, i)
+
+    def action_std(self):
+        return self.decoder.std()
+
+    def fresh_cache(self, batch: int, dtype=jnp.float32):
+        return init_decode_cache(self.cfg.n_block, batch, self.cfg.n_agent, self.cfg.n_embd, dtype)

@@ -1,0 +1,432 @@
+"""MAT ablation models: encoder-only, decoder-only, GRU.
+
+References: ``mat_encoder.py`` (value + action heads off one unmasked trunk,
+simultaneous decisions), ``mat_decoder.py`` (decoder-only; cross-attends raw
+obs embeddings; value head inside the decoder), ``mat_gru.py`` (attention
+blocks replaced by 2-layer GRUs over the agent axis).
+
+Selected by ``--algorithm_name mat_encoder | mat_decoder | mat_gru``
+(``transformer_policy.py:66-79``).  Like upstream, these support the
+``discrete`` and ``continuous`` action families.
+
+TPU notes: the encoder ablation needs no decode loop at all (one fused pass);
+the decoder ablation reuses the KV-cache scan; the GRU ablation's
+autoregressive decode carries GRU hidden state — the recurrent analogue of a
+KV cache, one cell step per agent instead of the reference's full-sequence
+re-run per agent (``mat_gru.py:167-169``).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from mat_dcml_tpu.models.mat import (
+    CONTINUOUS,
+    DISCRETE,
+    MATConfig,
+    NORMAL_STD,
+    Head,
+    ObsEncoder,
+)
+from mat_dcml_tpu.models.modules import DecodeBlock, EncodeBlock, dense, GAIN_ACT, init_decode_cache
+from mat_dcml_tpu.ops import distributions as D
+
+
+class VariantOutput(NamedTuple):
+    value: jax.Array
+    action: jax.Array
+    log_prob: jax.Array
+
+
+# ---------------------------------------------------------------------------
+# MAT-Encoder: one trunk, simultaneous decisions (mat_encoder.py:87-137)
+# ---------------------------------------------------------------------------
+
+class MultiAgentEncoderModel(nn.Module):
+    cfg: MATConfig
+
+    def setup(self):
+        c = self.cfg
+        self.state_encoder = ObsEncoder(c.n_embd)
+        self.obs_encoder = ObsEncoder(c.n_embd)
+        self.ln = nn.LayerNorm()
+        self.blocks = [EncodeBlock(c.n_embd, c.n_head) for _ in range(c.n_block)]
+        self.head = Head(c.n_embd, c.n_objective)
+        self.act_head = Head(c.n_embd, c.action_dim)
+        if c.action_type != DISCRETE:
+            self.log_std = self.param("log_std", lambda k: jnp.ones((c.action_dim,)))
+
+    def __call__(self, state: jax.Array, obs: jax.Array):
+        x = self.state_encoder(state) if self.cfg.encode_state else self.obs_encoder(obs)
+        rep = self.ln(x)
+        for blk in self.blocks:
+            rep = blk(rep)
+        return self.head(rep), rep, self.act_head(rep)
+
+    def action_std(self):
+        return jax.nn.sigmoid(self.log_std) * NORMAL_STD
+
+
+class EncoderPolicy:
+    """Simultaneous per-agent decisions (``mat_encoder.py:200-227``)."""
+
+    def __init__(self, cfg: MATConfig):
+        assert cfg.action_type in (DISCRETE, CONTINUOUS)
+        self.cfg = cfg
+        self.model = MultiAgentEncoderModel(cfg)
+        self.act_out_dim = 1 if cfg.action_type == DISCRETE else cfg.action_dim
+        self.act_prob_dim = self.act_out_dim
+
+    def init_params(self, key):
+        c = self.cfg
+        return self.model.init(
+            key,
+            jnp.zeros((1, c.n_agent, c.state_dim)),
+            jnp.zeros((1, c.n_agent, c.obs_dim)),
+        )
+
+    def get_actions(self, params, key, state, obs, available_actions=None, deterministic=False):
+        v, _, logit = self.model.apply(params, state, obs)
+        if self.cfg.action_type == DISCRETE:
+            logit = D.mask_logits(logit, available_actions)
+            idx = D.categorical_mode(logit) if deterministic else D.categorical_sample(key, logit)
+            logp = D.categorical_log_prob(logit, idx)
+            return VariantOutput(v, idx[..., None].astype(jnp.float32), logp[..., None])
+        std = self.model.apply(params, method="action_std")
+        act = logit if deterministic else D.normal_sample(key, logit, std)
+        logp = D.normal_log_prob(logit, std, act)
+        return VariantOutput(v, act, logp)
+
+    def evaluate_actions(self, params, state, obs, action, available_actions=None):
+        v, _, logit = self.model.apply(params, state, obs)
+        if self.cfg.action_type == DISCRETE:
+            logit = D.mask_logits(logit, available_actions)
+            idx = action[..., 0].astype(jnp.int32)
+            logp = D.categorical_log_prob(logit, idx)[..., None]
+            ent = D.categorical_entropy(logit)[..., None]
+        else:
+            std = self.model.apply(params, method="action_std")
+            logp = D.normal_log_prob(logit, std, action)
+            ent = jnp.broadcast_to(D.normal_entropy(logit, std), logit.shape)
+        return v, logp, ent
+
+    def get_values(self, params, state, obs):
+        v, _, _ = self.model.apply(params, state, obs)
+        return v
+
+
+# ---------------------------------------------------------------------------
+# MAT-Decoder: decoder-only with internal value head (mat_decoder.py:170-218)
+# ---------------------------------------------------------------------------
+
+class MultiAgentDecoderModel(nn.Module):
+    cfg: MATConfig
+
+    def setup(self):
+        c = self.cfg
+        if c.action_type == DISCRETE:
+            self.action_encoder_nobias = dense(c.n_embd, gain=GAIN_ACT, use_bias=False)
+        else:
+            self.log_std = self.param("log_std", lambda k: jnp.ones((c.action_dim,)))
+            self.action_encoder_bias = dense(c.n_embd, gain=GAIN_ACT)
+        self.obs_encoder = ObsEncoder(c.n_embd)
+        self.ln = nn.LayerNorm()
+        self.blocks = [DecodeBlock(c.n_embd, c.n_head) for _ in range(c.n_block)]
+        self.head = Head(c.n_embd, c.action_dim)
+        self.val_head = Head(c.n_embd, c.n_objective)
+
+    def _embed_action(self, a):
+        enc = self.action_encoder_nobias if self.cfg.action_type == DISCRETE else self.action_encoder_bias
+        return nn.gelu(enc(a))
+
+    def __call__(self, shifted_action: jax.Array, obs: jax.Array):
+        """Full pass -> (logits, values); cross-attention keys on obs
+        embeddings directly (``mat_decoder.py:206-218``)."""
+        obs_emb = self.obs_encoder(obs)
+        x = self.ln(self._embed_action(shifted_action))
+        for blk in self.blocks:
+            x = blk(x, obs_emb)
+        return self.head(x), self.val_head(x)
+
+    def decode_step(self, shifted_i, obs_i, caches, i):
+        obs_emb_i = self.obs_encoder(obs_i)
+        x = self.ln(self._embed_action(shifted_i))
+        new_caches = []
+        for blk, cache in zip(self.blocks, caches):
+            x, cache = blk.decode_step(x, obs_emb_i, cache, i)
+            new_caches.append(cache)
+        return self.head(x), self.val_head(x), new_caches
+
+    def action_std(self):
+        return jax.nn.sigmoid(self.log_std) * NORMAL_STD
+
+    def fresh_cache(self, batch, dtype=jnp.float32):
+        return init_decode_cache(self.cfg.n_block, batch, self.cfg.n_agent, self.cfg.n_embd, dtype)
+
+
+class DecoderPolicy:
+    """AR decode carrying per-position value (``mat_decoder.py:16-37``).
+
+    The reference's ``get_values`` runs a (stochastic) decode and returns its
+    values (``mat_decoder.py:291-294``); hence ``get_values`` takes a key.
+    """
+
+    def __init__(self, cfg: MATConfig):
+        assert cfg.action_type in (DISCRETE, CONTINUOUS)
+        self.cfg = cfg
+        self.model = MultiAgentDecoderModel(cfg)
+        self.act_out_dim = 1 if cfg.action_type == DISCRETE else cfg.action_dim
+        self.act_prob_dim = self.act_out_dim
+
+    def init_params(self, key):
+        c = self.cfg
+        return self.model.init(
+            key,
+            jnp.zeros((1, c.n_agent, c.action_input_dim)),
+            jnp.zeros((1, c.n_agent, c.obs_dim)),
+        )
+
+    def get_actions(self, params, key, state, obs, available_actions=None, deterministic=False):
+        del state  # decoder-only: conditions on obs alone
+        c = self.cfg
+        B, A, adim = obs.shape[0], c.n_agent, c.action_dim
+        in_dim = c.action_input_dim
+        if available_actions is None:
+            available_actions = jnp.ones((B, A, adim), jnp.float32)
+        std = self.model.apply(params, method="action_std") if c.action_type != DISCRETE else None
+
+        start = jnp.zeros((B, 1, in_dim), jnp.float32)
+        if c.action_type == DISCRETE:
+            start = start.at[:, 0, 0].set(1.0)
+        caches = self.model.apply(params, B, method="fresh_cache")
+
+        def body(carry, i):
+            caches, shifted_in, key = carry
+            key, k = jax.random.split(key)
+            obs_i = jax.lax.dynamic_slice_in_dim(obs, i, 1, axis=1)
+            logits, val, caches = self.model.apply(
+                params, shifted_in, obs_i, caches, i, method="decode_step"
+            )
+            logits = logits[:, 0]
+            if c.action_type == DISCRETE:
+                ava_i = jax.lax.dynamic_slice_in_dim(available_actions, i, 1, axis=1)[:, 0]
+                masked = D.mask_logits(logits, ava_i)
+                idx = D.categorical_mode(masked) if deterministic else D.categorical_sample(k, masked)
+                logp = D.categorical_log_prob(masked, idx)
+                act = idx[:, None].astype(jnp.float32)
+                logp = logp[:, None]
+                nxt = jnp.zeros((B, 1, in_dim)).at[:, 0, 1:].set(jax.nn.one_hot(idx, adim))
+            else:
+                act = logits if deterministic else D.normal_sample(k, logits, std)
+                logp = D.normal_log_prob(logits, std, act)
+                nxt = act[:, None, :]
+            return (caches, nxt, key), (act, logp, val[:, 0])
+
+        _, (acts, logps, vals) = jax.lax.scan(body, (caches, start, key), jnp.arange(A))
+        return VariantOutput(
+            jnp.swapaxes(vals, 0, 1), jnp.swapaxes(acts, 0, 1), jnp.swapaxes(logps, 0, 1)
+        )
+
+    def evaluate_actions(self, params, state, obs, action, available_actions=None):
+        del state
+        c = self.cfg
+        B, A, adim = obs.shape[0], c.n_agent, c.action_dim
+        if c.action_type == DISCRETE:
+            idx = action[..., 0].astype(jnp.int32)
+            onehot = jax.nn.one_hot(idx, adim, dtype=jnp.float32)
+            shifted = jnp.zeros((B, A, adim + 1)).at[:, 0, 0].set(1.0).at[:, 1:, 1:].set(onehot[:, :-1])
+            logits, vals = self.model.apply(params, shifted, obs)
+            logits = D.mask_logits(logits, available_actions)
+            logp = D.categorical_log_prob(logits, idx)[..., None]
+            ent = D.categorical_entropy(logits)[..., None]
+        else:
+            shifted = jnp.zeros((B, A, adim)).at[:, 1:].set(action[:, :-1])
+            mean, vals = self.model.apply(params, shifted, obs)
+            std = self.model.apply(params, method="action_std")
+            logp = D.normal_log_prob(mean, std, action)
+            ent = jnp.broadcast_to(D.normal_entropy(mean, std), mean.shape)
+        return vals, logp, ent
+
+    def get_values(self, params, state, obs, key=None, available_actions=None):
+        key = key if key is not None else jax.random.key(0)
+        return self.get_actions(params, key, state, obs, available_actions).value
+
+
+# ---------------------------------------------------------------------------
+# MAT-GRU: recurrence over the agent axis (mat_gru.py)
+# ---------------------------------------------------------------------------
+
+class StackedGRU(nn.Module):
+    """2-layer GRU over the agent axis (torch ``nn.GRU(num_layers=2)``)."""
+
+    n_embd: int
+    n_layers: int = 2
+
+    def setup(self):
+        self.cells = [nn.GRUCell(features=self.n_embd) for _ in range(self.n_layers)]
+
+    def __call__(self, x: jax.Array):
+        """Full sequence: ``(B, L, D) -> (B, L, D)``.  The agent axis is short
+        and static, so a Python loop (unrolled by XLA) is simplest here; the
+        autoregressive path uses :meth:`step` with an explicit carry."""
+        carry = self.initial_carry(x.shape[0])
+        ys = []
+        for t in range(x.shape[1]):
+            carry, y = self.step(carry, x[:, t])
+            ys.append(y)
+        return jnp.stack(ys, axis=1)
+
+    def step(self, carry, x_t):
+        new_carry = []
+        h = x_t
+        for cell, c in zip(self.cells, carry):
+            c2, h = cell(c, h)
+            new_carry.append(c2)
+        return new_carry, h
+
+    def initial_carry(self, batch: int):
+        return [jnp.zeros((batch, self.n_embd)) for _ in range(self.n_layers)]
+
+
+class MultiAgentGRUModel(nn.Module):
+    """Encoder/decoder with GRUs in place of attention (``mat_gru.py:20-98``)."""
+
+    cfg: MATConfig
+
+    def setup(self):
+        c = self.cfg
+        self.obs_encoder = ObsEncoder(c.n_embd)
+        self.enc_ln = nn.LayerNorm()
+        self.enc_gru = StackedGRU(c.n_embd)
+        self.head = Head(c.n_embd, c.n_objective)
+
+        if c.action_type == DISCRETE:
+            self.action_encoder_nobias = dense(c.n_embd, gain=GAIN_ACT, use_bias=False)
+        else:
+            self.log_std = self.param("log_std", lambda k: jnp.ones((c.action_dim,)))
+            self.action_encoder_bias = dense(c.n_embd, gain=GAIN_ACT)
+        self.dec_ln = nn.LayerNorm()
+        self.dec_gru = StackedGRU(c.n_embd)
+        self.act_head = Head(c.n_embd, c.action_dim)
+
+    def encode(self, state, obs):
+        del state  # mat_gru.py:45-48: obs only
+        rep = self.enc_gru(self.enc_ln(self.obs_encoder(obs)))
+        return self.head(rep), rep
+
+    def _embed_action(self, a):
+        enc = self.action_encoder_nobias if self.cfg.action_type == DISCRETE else self.action_encoder_bias
+        return nn.gelu(enc(a))
+
+    def decode_full(self, shifted_action, obs_rep, obs):
+        del obs
+        x = self._embed_action(shifted_action) + obs_rep  # mat_gru.py:92-94
+        x = self.dec_gru(self.dec_ln(x))
+        return self.act_head(x)
+
+    def decode_step(self, shifted_i, rep_i, carry, i):
+        del i
+        x = self._embed_action(shifted_i) + rep_i          # (B, 1, D)
+        x = self.dec_ln(x)[:, 0]
+        carry, h = self.dec_gru.step(carry, x)
+        return self.act_head(h)[:, None, :], carry
+
+    def initial_decode_carry(self, batch: int):
+        return self.dec_gru.initial_carry(batch)
+
+    def action_std(self):
+        return jax.nn.sigmoid(self.log_std) * NORMAL_STD
+
+
+class GRUPolicy:
+    """Same act API as MAT; hidden-state carry instead of KV caches."""
+
+    def __init__(self, cfg: MATConfig):
+        assert cfg.action_type in (DISCRETE, CONTINUOUS)
+        self.cfg = cfg
+        self.model = MultiAgentGRUModel(cfg)
+        self.act_out_dim = 1 if cfg.action_type == DISCRETE else cfg.action_dim
+        self.act_prob_dim = self.act_out_dim
+
+    def init_params(self, key):
+        c = self.cfg
+
+        def init_fn(mdl, state, obs, shifted):
+            v, rep = mdl.encode(state, obs)
+            logit = mdl.decode_full(shifted, rep, obs)
+            return v, logit
+
+        return self.model.init(
+            key,
+            jnp.zeros((1, c.n_agent, c.state_dim)),
+            jnp.zeros((1, c.n_agent, c.obs_dim)),
+            jnp.zeros((1, c.n_agent, c.action_input_dim)),
+            method=init_fn,
+        )
+
+    def get_actions(self, params, key, state, obs, available_actions=None, deterministic=False):
+        c = self.cfg
+        B, A, adim = obs.shape[0], c.n_agent, c.action_dim
+        in_dim = c.action_input_dim
+        v, rep = self.model.apply(params, state, obs, method="encode")
+        if available_actions is None:
+            available_actions = jnp.ones((B, A, adim), jnp.float32)
+        std = self.model.apply(params, method="action_std") if c.action_type != DISCRETE else None
+
+        start = jnp.zeros((B, 1, in_dim), jnp.float32)
+        if c.action_type == DISCRETE:
+            start = start.at[:, 0, 0].set(1.0)
+        carry0 = [jnp.zeros((B, c.n_embd)) for _ in range(2)]
+
+        def body(carry, i):
+            gru_carry, shifted_in, key = carry
+            key, k = jax.random.split(key)
+            rep_i = jax.lax.dynamic_slice_in_dim(rep, i, 1, axis=1)
+            logits, gru_carry = self.model.apply(
+                params, shifted_in, rep_i, gru_carry, i, method="decode_step"
+            )
+            logits = logits[:, 0]
+            if c.action_type == DISCRETE:
+                ava_i = jax.lax.dynamic_slice_in_dim(available_actions, i, 1, axis=1)[:, 0]
+                masked = D.mask_logits(logits, ava_i)
+                idx = D.categorical_mode(masked) if deterministic else D.categorical_sample(k, masked)
+                logp = D.categorical_log_prob(masked, idx)
+                act = idx[:, None].astype(jnp.float32)
+                logp = logp[:, None]
+                nxt = jnp.zeros((B, 1, in_dim)).at[:, 0, 1:].set(jax.nn.one_hot(idx, adim))
+            else:
+                act = logits if deterministic else D.normal_sample(k, logits, std)
+                logp = D.normal_log_prob(logits, std, act)
+                nxt = act[:, None, :]
+            return (gru_carry, nxt, key), (act, logp)
+
+        _, (acts, logps) = jax.lax.scan(body, (carry0, start, key), jnp.arange(A))
+        return VariantOutput(v, jnp.swapaxes(acts, 0, 1), jnp.swapaxes(logps, 0, 1))
+
+    def evaluate_actions(self, params, state, obs, action, available_actions=None):
+        c = self.cfg
+        B, A, adim = obs.shape[0], c.n_agent, c.action_dim
+        v, rep = self.model.apply(params, state, obs, method="encode")
+        if c.action_type == DISCRETE:
+            idx = action[..., 0].astype(jnp.int32)
+            onehot = jax.nn.one_hot(idx, adim, dtype=jnp.float32)
+            shifted = jnp.zeros((B, A, adim + 1)).at[:, 0, 0].set(1.0).at[:, 1:, 1:].set(onehot[:, :-1])
+            logits = self.model.apply(params, shifted, rep, obs, method="decode_full")
+            logits = D.mask_logits(logits, available_actions)
+            logp = D.categorical_log_prob(logits, idx)[..., None]
+            ent = D.categorical_entropy(logits)[..., None]
+        else:
+            shifted = jnp.zeros((B, A, adim)).at[:, 1:].set(action[:, :-1])
+            mean = self.model.apply(params, shifted, rep, obs, method="decode_full")
+            std = self.model.apply(params, method="action_std")
+            logp = D.normal_log_prob(mean, std, action)
+            ent = jnp.broadcast_to(D.normal_entropy(mean, std), mean.shape)
+        return v, logp, ent
+
+    def get_values(self, params, state, obs):
+        v, _ = self.model.apply(params, state, obs, method="encode")
+        return v

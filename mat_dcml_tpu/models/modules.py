@@ -1,0 +1,163 @@
+"""Shared Flax building blocks for the MAT family.
+
+Initialization mirrors the reference (``ma_transformer.py:18-21``): orthogonal
+kernels with gain 0.01 (or the ReLU gain ~sqrt(2) for "activated" layers) and
+zero biases.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from mat_dcml_tpu.ops.attention import merge_heads, multi_head_attention, split_heads
+
+GAIN_ACT = math.sqrt(2.0)  # torch nn.init.calculate_gain('relu')
+GAIN_OUT = 0.01
+
+
+def dense(features: int, gain: float = GAIN_OUT, use_bias: bool = True) -> nn.Dense:
+    return nn.Dense(
+        features,
+        use_bias=use_bias,
+        kernel_init=nn.initializers.orthogonal(gain),
+        bias_init=nn.initializers.zeros,
+    )
+
+
+class SelfAttention(nn.Module):
+    """QKV attention over the agent axis (``ma_transformer.py:24-69``).
+
+    Exposes split projection helpers so the KV-cached decode path can reuse
+    exactly the same parameters as the full forward.
+    """
+
+    n_embd: int
+    n_head: int
+    masked: bool = False
+
+    def setup(self):
+        assert self.n_embd % self.n_head == 0
+        self.key_p = dense(self.n_embd)
+        self.query_p = dense(self.n_embd)
+        self.value_p = dense(self.n_embd)
+        self.proj = dense(self.n_embd)
+
+    def __call__(self, key: jax.Array, value: jax.Array, query: jax.Array) -> jax.Array:
+        k = split_heads(self.key_p(key), self.n_head)
+        q = split_heads(self.query_p(query), self.n_head)
+        v = split_heads(self.value_p(value), self.n_head)
+        y = multi_head_attention(q, k, v, causal=self.masked)
+        return self.proj(merge_heads(y))
+
+    def project_kv(self, x: jax.Array):
+        """Raw (pre-head-split) key/value projections for cache writes."""
+        return self.key_p(x), self.value_p(x)
+
+    def attend_cached(self, query: jax.Array, k_cache: jax.Array, v_cache: jax.Array, kv_mask: jax.Array) -> jax.Array:
+        """Attention for a single query position over a static-length cache.
+
+        Args:
+          query: ``(B, 1, D)`` un-projected query input.
+          k_cache / v_cache: ``(B, L, D)`` raw projections; positions where
+            ``kv_mask`` is False are not yet populated.
+          kv_mask: ``(L,)`` validity mask.
+        """
+        q = split_heads(self.query_p(query), self.n_head)
+        k = split_heads(k_cache, self.n_head)
+        v = split_heads(v_cache, self.n_head)
+        y = multi_head_attention(q, k, v, kv_mask=kv_mask)
+        return self.proj(merge_heads(y))
+
+
+class MlpBlock(nn.Module):
+    """The transformer block MLP: Linear-GELU-Linear (``ma_transformer.py:83-87``)."""
+
+    n_embd: int
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        x = dense(self.n_embd, gain=GAIN_ACT)(x)
+        x = nn.gelu(x)
+        return dense(self.n_embd)(x)
+
+
+class EncodeBlock(nn.Module):
+    """Post-LN residual encoder block, unmasked attention (``ma_transformer.py:72-92``)."""
+
+    n_embd: int
+    n_head: int
+
+    def setup(self):
+        self.ln1 = nn.LayerNorm()
+        self.ln2 = nn.LayerNorm()
+        self.attn = SelfAttention(self.n_embd, self.n_head, masked=False)
+        self.mlp = MlpBlock(self.n_embd)
+
+    def __call__(self, x: jax.Array) -> jax.Array:
+        x = self.ln1(x + self.attn(x, x, x))
+        x = self.ln2(x + self.mlp(x))
+        return x
+
+
+class DecodeBlock(nn.Module):
+    """Decoder block: causal self-attn over shifted actions, then causal
+    cross-attn with the encoder representation as query
+    (``ma_transformer.py:95-116``)."""
+
+    n_embd: int
+    n_head: int
+
+    def setup(self):
+        self.ln1 = nn.LayerNorm()
+        self.ln2 = nn.LayerNorm()
+        self.ln3 = nn.LayerNorm()
+        self.attn1 = SelfAttention(self.n_embd, self.n_head, masked=True)
+        self.attn2 = SelfAttention(self.n_embd, self.n_head, masked=True)
+        self.mlp = MlpBlock(self.n_embd)
+
+    def __call__(self, x: jax.Array, rep_enc: jax.Array) -> jax.Array:
+        x = self.ln1(x + self.attn1(x, x, x))
+        x = self.ln2(rep_enc + self.attn2(key=x, value=x, query=rep_enc))
+        x = self.ln3(x + self.mlp(x))
+        return x
+
+    def decode_step(self, x: jax.Array, rep_i: jax.Array, cache: dict, i: jax.Array):
+        """Single-position decode with KV caches.
+
+        Args:
+          x: ``(B, 1, D)`` this position's input embedding.
+          rep_i: ``(B, 1, D)`` encoder representation at position i.
+          cache: dict with ``k1, v1, k2, v2`` each ``(B, L, D)``.
+          i: scalar position index.
+
+        Returns:
+          ``(B, 1, D)`` block output and the updated cache.
+        """
+        L = cache["k1"].shape[1]
+        valid = jnp.arange(L) <= i
+
+        k1, v1 = self.attn1.project_kv(x)
+        cache = dict(cache)
+        cache["k1"] = jax.lax.dynamic_update_slice(cache["k1"], k1, (0, i, 0))
+        cache["v1"] = jax.lax.dynamic_update_slice(cache["v1"], v1, (0, i, 0))
+        y = self.attn1.attend_cached(x, cache["k1"], cache["v1"], valid)
+        h = self.ln1(x + y)
+
+        k2, v2 = self.attn2.project_kv(h)
+        cache["k2"] = jax.lax.dynamic_update_slice(cache["k2"], k2, (0, i, 0))
+        cache["v2"] = jax.lax.dynamic_update_slice(cache["v2"], v2, (0, i, 0))
+        y2 = self.attn2.attend_cached(rep_i, cache["k2"], cache["v2"], valid)
+        h2 = self.ln2(rep_i + y2)
+
+        return self.ln3(h2 + self.mlp(h2)), cache
+
+
+def init_decode_cache(n_block: int, batch: int, length: int, n_embd: int, dtype=jnp.float32):
+    """Fresh per-block KV caches for autoregressive decoding."""
+    blk = lambda: {k: jnp.zeros((batch, length, n_embd), dtype) for k in ("k1", "v1", "k2", "v2")}
+    return [blk() for _ in range(n_block)]

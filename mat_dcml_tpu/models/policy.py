@@ -1,0 +1,124 @@
+"""Functional policy wrapper for the MAT family.
+
+TPU-native equivalent of ``transformer_policy.py``: the reference wraps the
+torch module with numpy<->torch glue, (batch*agent)<->(batch, agent) reshapes
+and an Adam optimizer; here the policy is a pure-function bundle over a params
+pytree — optimizer state lives with the trainer (optax), checkpointing with
+Orbax.  All methods keep the ``(batch, n_agent, dim)`` layout throughout; the
+reference's flatten/split round-trips (``transformer_policy.py:136-139``)
+disappear under jit.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from mat_dcml_tpu.models import decode as decode_lib
+from mat_dcml_tpu.models.mat import (
+    AVAILABLE_CONTINUOUS,
+    CONTINUOUS,
+    DISCRETE,
+    SEMI_DISCRETE,
+    MATConfig,
+    MultiAgentTransformer,
+)
+
+
+class PolicyOutput(NamedTuple):
+    value: jax.Array       # (B, n_agent, n_objective)
+    action: jax.Array      # (B, n_agent, act_out)
+    log_prob: jax.Array    # (B, n_agent, act_prob)
+
+
+class TransformerPolicy:
+    """Stateless method bundle; params are passed explicitly.
+
+    Mirrors ``transformer_policy.py:116-241`` (get_actions / get_values /
+    evaluate_actions / act) with explicit PRNG keys instead of global torch RNG.
+    """
+
+    def __init__(self, cfg: MATConfig):
+        self.cfg = cfg
+        self.model = MultiAgentTransformer(cfg)
+        # act bookkeeping (transformer_policy.py:43-57)
+        if cfg.action_type in (DISCRETE, SEMI_DISCRETE):
+            self.act_out_dim = 1
+            self.act_prob_dim = 1
+        elif cfg.action_type == AVAILABLE_CONTINUOUS:
+            self.act_out_dim = cfg.action_dim
+            self.act_prob_dim = cfg.action_dim - cfg.discrete_dim + 1
+        else:
+            self.act_out_dim = cfg.action_dim
+            self.act_prob_dim = cfg.action_dim
+
+    # -- init ---------------------------------------------------------------
+
+    def init_params(self, key: jax.Array):
+        cfg = self.cfg
+        state = jnp.zeros((1, cfg.n_agent, cfg.state_dim), jnp.float32)
+        obs = jnp.zeros((1, cfg.n_agent, cfg.obs_dim), jnp.float32)
+        shifted = jnp.zeros((1, cfg.n_agent, cfg.action_input_dim), jnp.float32)
+        return self.model.init(key, state, obs, shifted)
+
+    # -- rollout ------------------------------------------------------------
+
+    def get_actions(
+        self,
+        params,
+        key: jax.Array,
+        state: jax.Array,
+        obs: jax.Array,
+        available_actions: Optional[jax.Array] = None,
+        deterministic: bool = False,
+    ) -> PolicyOutput:
+        """Autoregressive decode (``ma_transformer.py:298-329``)."""
+        v_loc, obs_rep = self.model.apply(params, state, obs, method="encode")
+        res = decode_lib.ar_decode(
+            self.model, params, key, obs_rep, obs, available_actions, deterministic
+        )
+        return PolicyOutput(v_loc, res.action, res.log_prob)
+
+    def act_stride(
+        self,
+        params,
+        state: jax.Array,
+        obs: jax.Array,
+        available_actions: Optional[jax.Array] = None,
+        stride: int = 2,
+    ) -> PolicyOutput:
+        """Deterministic stride-batched decode for benchmark-protocol parity
+        (``transformer_policy.py:219-241`` with ``stride``)."""
+        v_loc, obs_rep = self.model.apply(params, state, obs, method="encode")
+        res = decode_lib.stride_decode(
+            self.model, params, obs_rep, obs, available_actions, stride=stride
+        )
+        return PolicyOutput(v_loc, res.action, res.log_prob)
+
+    # -- training -----------------------------------------------------------
+
+    def evaluate_actions(
+        self,
+        params,
+        state: jax.Array,
+        obs: jax.Array,
+        action: jax.Array,
+        available_actions: Optional[jax.Array] = None,
+    ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+        """Teacher-forced values, log-probs, entropies
+        (``ma_transformer.py:257-295``).  Returns ``(values, log_prob,
+        entropy)`` with entropy un-reduced ``(B, n_agent, act_prob)`` — the
+        trainer applies active-mask weighting (``transformer_policy.py:212-215``).
+        """
+        v_loc, obs_rep = self.model.apply(params, state, obs, method="encode")
+        logp, ent = decode_lib.parallel_act(
+            self.model, params, obs_rep, obs, action, available_actions
+        )
+        return v_loc, logp, ent
+
+    def get_values(self, params, state: jax.Array, obs: jax.Array) -> jax.Array:
+        """Encoder-as-critic value prediction (``ma_transformer.py:331-339``)."""
+        v_loc, _ = self.model.apply(params, state, obs, method="encode")
+        return v_loc

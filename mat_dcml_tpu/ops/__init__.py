@@ -1,0 +1,5 @@
+"""Low-level numerical ops: attention, distributions, GAE, normalizers."""
+
+from mat_dcml_tpu.ops.attention import multi_head_attention
+from mat_dcml_tpu.ops.gae import compute_gae
+from mat_dcml_tpu.ops.normalize import ValueNormState, value_norm_init, value_norm_update, value_norm_normalize, value_norm_denormalize

@@ -1,0 +1,69 @@
+"""Multi-head attention over the agent axis.
+
+The reference computes plain QKV attention with an optional causal
+(lower-triangular) mask over agents (``ma_transformer.py:24-69``).  Here the
+math is a single fused function over already-projected q/k/v so that the same
+code path serves the Flax module, the KV-cached decode step, and (later) a
+Pallas kernel drop-in.
+
+Shapes follow TPU conventions: ``(batch, heads, length, head_dim)``.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e9
+
+
+def multi_head_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = False,
+    kv_mask: jax.Array | None = None,
+) -> jax.Array:
+    """Scaled dot-product attention.
+
+    Args:
+      q: ``(B, H, Lq, Dh)`` queries.
+      k: ``(B, H, Lk, Dh)`` keys.
+      v: ``(B, H, Lk, Dh)`` values.
+      causal: if True, query position i attends only to key positions <= i
+        (requires Lq == Lk), matching the registered ``tril`` buffer of the
+        reference (``ma_transformer.py:40-41,60-61``).
+      kv_mask: optional ``(Lk,)`` or ``(B, Lk)`` boolean mask of valid key
+        positions (used by the KV-cached decode where the cache has static
+        length but only a prefix is populated).
+
+    Returns:
+      ``(B, H, Lq, Dh)`` attention output (before the output projection).
+    """
+    dh = q.shape[-1]
+    att = jnp.einsum("bhqd,bhkd->bhqk", q, k) * (1.0 / jnp.sqrt(jnp.asarray(dh, q.dtype)))
+    if causal:
+        lq, lk = q.shape[-2], k.shape[-2]
+        tri = jnp.tril(jnp.ones((lq, lk), dtype=bool))
+        att = jnp.where(tri[None, None], att, NEG_INF)
+    if kv_mask is not None:
+        if kv_mask.ndim == 1:
+            m = kv_mask[None, None, None, :]
+        else:
+            m = kv_mask[:, None, None, :]
+        att = jnp.where(m, att, NEG_INF)
+    att = jax.nn.softmax(att, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", att, v)
+
+
+def split_heads(x: jax.Array, n_head: int) -> jax.Array:
+    """``(B, L, D) -> (B, H, L, D//H)``."""
+    b, l, d = x.shape
+    return x.reshape(b, l, n_head, d // n_head).transpose(0, 2, 1, 3)
+
+
+def merge_heads(x: jax.Array) -> jax.Array:
+    """``(B, H, L, Dh) -> (B, L, H*Dh)``."""
+    b, h, l, dh = x.shape
+    return x.transpose(0, 2, 1, 3).reshape(b, l, h * dh)

@@ -1,0 +1,67 @@
+"""Action distributions as pure functions.
+
+Replaces ``torch.distributions`` usage in the reference
+(``transformer_act.py``, ``distributions.py``).  Availability masking uses the
+same convention as the reference: unavailable logits forced to -1e10
+(``transformer_act.py:163``).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+MASK_VALUE = -1e10
+LOG_2PI = jnp.log(2.0 * jnp.pi)
+
+
+def mask_logits(logits: jax.Array, available: jax.Array | None) -> jax.Array:
+    """Force logits of unavailable actions to -1e10 (``transformer_act.py:14,163``)."""
+    if available is None:
+        return logits
+    return jnp.where(available == 0, MASK_VALUE, logits)
+
+
+def categorical_sample(key: jax.Array, logits: jax.Array) -> jax.Array:
+    return jax.random.categorical(key, logits, axis=-1)
+
+
+def categorical_mode(logits: jax.Array) -> jax.Array:
+    # torch Categorical.probs.argmax == logits argmax (softmax is monotone).
+    return jnp.argmax(logits, axis=-1)
+
+
+def categorical_log_prob(logits: jax.Array, action: jax.Array) -> jax.Array:
+    """Log prob of integer ``action`` under ``Categorical(logits)``."""
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    return jnp.take_along_axis(logp, action[..., None].astype(jnp.int32), axis=-1)[..., 0]
+
+
+def categorical_entropy(logits: jax.Array) -> jax.Array:
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    p = jnp.exp(logp)
+    # Match torch.distributions.Categorical.entropy: -(p * logp).sum over support.
+    # With -1e10 masked logits p ~ 0 for masked entries; p*logp -> 0 * -1e10 is
+    # a large negative times ~0 which torch evaluates as p_min*logp; guard NaNs.
+    plogp = jnp.where(p > 0, p * logp, 0.0)
+    return -plogp.sum(axis=-1)
+
+
+def normal_sample(key: jax.Array, mean: jax.Array, std: jax.Array) -> jax.Array:
+    return mean + std * jax.random.normal(key, mean.shape, dtype=mean.dtype)
+
+
+def normal_log_prob(mean: jax.Array, std: jax.Array, action: jax.Array) -> jax.Array:
+    var = std * std
+    return -((action - mean) ** 2) / (2 * var) - jnp.log(std) - 0.5 * LOG_2PI
+
+
+def normal_entropy(mean: jax.Array, std: jax.Array) -> jax.Array:
+    del mean
+    return 0.5 + 0.5 * LOG_2PI + jnp.log(std)
+
+
+def huber_loss(e: jax.Array, delta: float) -> jax.Array:
+    """Matches ``mat/utils/util.py`` huber: 0.5 e^2 if |e|<=d else d(|e| - 0.5 d)."""
+    a = jnp.abs(e)
+    return jnp.where(a <= delta, 0.5 * e * e, delta * (a - 0.5 * delta))

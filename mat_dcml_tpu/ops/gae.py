@@ -1,0 +1,54 @@
+"""Generalized Advantage Estimation as a reverse scan.
+
+Reproduces the masked-GAE semantics of ``shared_buffer.py:207-238``:
+
+  delta_t = r_t + gamma * V'_{t+1} * mask_{t+1} - V'_t
+  gae_t   = delta_t + gamma * lambda * mask_{t+1} * gae_{t+1}
+  ret_t   = gae_t + V'_t
+
+where ``V'`` is the (optionally value-norm denormalized) value prediction and
+``mask_{t+1}`` is 0 when the episode ended at step t.  The DCML convention is
+that ``done`` fires with ``CONTINUE_PROBABILITY`` per step
+(``DCML_..._SingleProcess.py:141-142``) and ``dcml_runner.py:267-269`` turns it
+into ``mask = 1 - done``; we replicate that exactly.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def compute_gae(
+    rewards: jax.Array,
+    values: jax.Array,
+    masks: jax.Array,
+    gamma: float,
+    gae_lambda: float,
+) -> Tuple[jax.Array, jax.Array]:
+    """Masked GAE over the leading time axis.
+
+    Args:
+      rewards: ``(T, ...)`` per-step rewards.
+      values: ``(T+1, ...)`` (denormalized) value predictions, incl. bootstrap.
+      masks: ``(T+1, ...)`` continuation masks; ``masks[t+1] == 0`` means the
+        env terminated at step t. ``masks[0]`` is unused (kept for buffer-shape
+        parity with the reference).
+
+    Returns:
+      ``(advantages, returns)`` each ``(T, ...)``.
+    """
+
+    def step(gae, inp):
+        r, v, v_next, m_next = inp
+        delta = r + gamma * v_next * m_next - v
+        gae = delta + gamma * gae_lambda * m_next * gae
+        return gae, gae
+
+    inputs = (rewards, values[:-1], values[1:], masks[1:])
+    init = jnp.zeros_like(rewards[0])
+    _, adv = jax.lax.scan(step, init, inputs, reverse=True)
+    returns = adv + values[:-1]
+    return adv, returns

@@ -1,0 +1,77 @@
+"""Running-statistics value normalizers as pytree state.
+
+``ValueNorm`` reproduces ``mat/utils/valuenorm.py``: debiased EMA of mean and
+mean-square with ``beta=0.99999``, variance clamped to ``>= 1e-2``, debiasing
+term clamped to ``>= 1e-5``.  PopArt statistics (``mat/utils/popart.py``) share
+the same running-moment math; the output-layer-rescaling PopArt variant lives
+with the MLP critics.
+
+All functions are pure; on a device mesh the batch moments should be averaged
+with ``jax.lax.pmean`` before ``value_norm_update`` so every replica holds
+bit-identical statistics (see SURVEY.md §5 "Distributed communication
+backend").
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+class ValueNormState(NamedTuple):
+    running_mean: jax.Array      # (shape,)
+    running_mean_sq: jax.Array   # (shape,)
+    debiasing_term: jax.Array    # scalar
+
+
+def value_norm_init(shape: int = 1, dtype=jnp.float32) -> ValueNormState:
+    return ValueNormState(
+        running_mean=jnp.zeros((shape,), dtype),
+        running_mean_sq=jnp.zeros((shape,), dtype),
+        debiasing_term=jnp.zeros((), dtype),
+    )
+
+
+def _debiased_mean_var(state: ValueNormState, epsilon: float = 1e-5) -> Tuple[jax.Array, jax.Array]:
+    term = jnp.clip(state.debiasing_term, min=epsilon)
+    mean = state.running_mean / term
+    mean_sq = state.running_mean_sq / term
+    var = jnp.clip(mean_sq - mean**2, min=1e-2)
+    return mean, var
+
+
+def value_norm_update(
+    state: ValueNormState,
+    batch: jax.Array,
+    beta: float = 0.99999,
+    axis_mean=None,
+) -> ValueNormState:
+    """EMA update from a batch; ``batch`` has trailing dim == state shape.
+
+    ``axis_mean`` optionally supplies pre-reduced (mean, sq_mean) computed with
+    cross-device ``pmean`` — pass None to reduce locally (single host).
+    """
+    if axis_mean is None:
+        reduce_axes = tuple(range(batch.ndim - 1))
+        batch_mean = batch.mean(axis=reduce_axes)
+        batch_sq_mean = (batch**2).mean(axis=reduce_axes)
+    else:
+        batch_mean, batch_sq_mean = axis_mean
+    w = beta
+    return ValueNormState(
+        running_mean=state.running_mean * w + batch_mean * (1.0 - w),
+        running_mean_sq=state.running_mean_sq * w + batch_sq_mean * (1.0 - w),
+        debiasing_term=state.debiasing_term * w + (1.0 - w),
+    )
+
+
+def value_norm_normalize(state: ValueNormState, x: jax.Array) -> jax.Array:
+    mean, var = _debiased_mean_var(state)
+    return (x - mean) / jnp.sqrt(var)
+
+
+def value_norm_denormalize(state: ValueNormState, x: jax.Array) -> jax.Array:
+    mean, var = _debiased_mean_var(state)
+    return x * jnp.sqrt(var) + mean

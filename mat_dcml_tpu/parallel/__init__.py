@@ -1,0 +1,3 @@
+"""Mesh construction and sharding helpers (ICI/DCN-aware scaling)."""
+
+from mat_dcml_tpu.parallel.mesh import make_mesh, replicated, data_sharded

@@ -1,0 +1,56 @@
+"""Device mesh + sharding utilities.
+
+The reference has no distributed backend at all — its "distributed" layer is
+one OS process per env over pipes (SURVEY.md §2.8).  The TPU-native design:
+
+- envs are pure JAX, so rollout parallelism = sharding the env-batch axis of
+  the same jitted program over the mesh ``data`` axis;
+- gradient data-parallelism falls out of ``jit`` with sharded batch inputs —
+  XLA inserts the ``psum`` all-reduces for grads and for the batch statistics
+  (advantage mean/std, ValueNorm moments) that the reference computed in
+  single-device numpy;
+- multi-host: ``jax.distributed.initialize()`` then the same code — ICI for
+  collectives, DCN only for init/checkpoint/logging.
+
+``model`` and ``seq`` axes are declared for tensor/sequence parallelism
+headroom (the MAT agent axis could be context-sharded for 100x agent counts);
+DCML-scale models need only ``data``.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def make_mesh(
+    n_data: Optional[int] = None,
+    n_model: int = 1,
+    n_seq: int = 1,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Build a ``(data, model, seq)`` mesh over the available devices."""
+    devices = list(devices if devices is not None else jax.devices())
+    if n_data is None:
+        n_data = len(devices) // (n_model * n_seq)
+    n_total = n_data * n_model * n_seq
+    assert n_total <= len(devices), f"need {n_total} devices, have {len(devices)}"
+    arr = np.array(devices[:n_total]).reshape(n_data, n_model, n_seq)
+    return Mesh(arr, axis_names=("data", "model", "seq"))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def data_sharded(mesh: Mesh, axis: int = 0) -> NamedSharding:
+    """Shard a tree's leaves along ``axis`` over the ``data`` mesh axis."""
+    spec = [None] * axis + ["data"]
+    return NamedSharding(mesh, P(*spec))
+
+
+def shard_tree(tree, sharding: NamedSharding):
+    return jax.tree.map(lambda x: jax.device_put(x, sharding), tree)

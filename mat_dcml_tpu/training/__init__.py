@@ -1,0 +1,4 @@
+"""Training stack: on-device rollouts, PPO trainers, runners."""
+
+from mat_dcml_tpu.training.ppo import PPOConfig, TrainState, MATTrainer
+from mat_dcml_tpu.training.rollout import Trajectory, RolloutCollector

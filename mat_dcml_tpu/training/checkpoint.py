@@ -1,0 +1,41 @@
+"""Orbax checkpointing: full training state, not just weights.
+
+The reference only saves model weights (``transformer_policy.py:243-248``) —
+optimizer and ValueNorm state are lost, so "resume" is weight reload only
+(SURVEY.md §5).  Here the whole ``TrainState`` (params, optimizer moments,
+ValueNorm statistics, update counter) round-trips, giving true resume.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Optional
+
+import jax
+import orbax.checkpoint as ocp
+
+
+class CheckpointManager:
+    def __init__(self, directory: str | Path, max_to_keep: int = 5):
+        self.directory = Path(directory).absolute()
+        self.directory.mkdir(parents=True, exist_ok=True)
+        self.manager = ocp.CheckpointManager(
+            self.directory,
+            options=ocp.CheckpointManagerOptions(max_to_keep=max_to_keep, create=True),
+        )
+
+    def save(self, step: int, train_state) -> None:
+        self.manager.save(step, args=ocp.args.StandardSave(train_state))
+        self.manager.wait_until_finished()
+
+    def restore(self, step: Optional[int] = None, template=None):
+        step = step if step is not None else self.manager.latest_step()
+        if step is None:
+            return None
+        if template is not None:
+            return self.manager.restore(step, args=ocp.args.StandardRestore(template))
+        return self.manager.restore(step)
+
+    @property
+    def latest_step(self) -> Optional[int]:
+        return self.manager.latest_step()

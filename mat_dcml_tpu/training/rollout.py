@@ -1,0 +1,131 @@
+"""On-device trajectory collection.
+
+Replaces the reference's rollout machinery — ``dcml_runner.collect/insert``
+(``dcml_runner.py:145-288``) plus the subprocess vec-env round trip
+(``env_wrappers.py:343-403``) — with one ``lax.scan`` over the episode chunk:
+policy decode and env step fused in a single compiled program, envs vectorized
+by ``vmap`` instead of OS processes.
+
+The buffer (``shared_buffer.py``) collapses to the stacked scan outputs: a
+``Trajectory`` pytree of ``(T, E, A, d)`` arrays.  ``insert``'s mask semantics
+(``dcml_runner.py:261-272``) are reproduced: ``masks[t+1] = 1 - done_env[t]``;
+``active_masks`` handling keeps the same shape contract (all-ones in DCML since
+every agent shares the episode done flag).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from mat_dcml_tpu.models.policy import TransformerPolicy
+
+
+class Trajectory(NamedTuple):
+    """Stacked rollout chunk; time-major ``(T, E, A, d)``."""
+
+    share_obs: jax.Array         # (T, E, A, sob)
+    obs: jax.Array               # (T, E, A, obs)
+    available_actions: jax.Array  # (T, E, A, act_dim)
+    actions: jax.Array           # (T, E, A, act_out)
+    log_probs: jax.Array         # (T, E, A, act_prob)
+    values: jax.Array            # (T, E, A, n_obj)
+    rewards: jax.Array           # (T, E, A, 1)
+    masks: jax.Array             # (T+1, E, A, 1); masks[t+1] = 1 - done_env[t]
+    active_masks: jax.Array      # (T+1, E, A, 1)
+    delays: jax.Array            # (T, E) env info
+    payments: jax.Array          # (T, E)
+    dones: jax.Array             # (T, E) episode-end flags
+
+
+class RolloutState(NamedTuple):
+    """Carry between rollout chunks (the reference's ``after_update`` copy of
+    the last timestep, ``shared_buffer.py:188-198``)."""
+
+    env_states: NamedTuple       # vmapped env state pytree
+    obs: jax.Array               # (E, A, obs)
+    share_obs: jax.Array         # (E, A, sob)
+    available_actions: jax.Array  # (E, A, act_dim)
+    mask: jax.Array              # (E, A, 1) mask entering the next chunk
+    rng: jax.Array
+
+
+class RolloutCollector:
+    """Builds the jittable ``collect`` function for a (policy, env) pair."""
+
+    def __init__(self, env, policy: TransformerPolicy, episode_length: int):
+        self.env = env
+        self.policy = policy
+        self.T = episode_length
+
+    def init_state(self, key: jax.Array, n_envs: int) -> RolloutState:
+        key, k_reset = jax.random.split(key)
+        keys = jax.random.split(k_reset, n_envs)
+        env_states, ts = jax.vmap(self.env.reset)(keys, jnp.zeros(n_envs, jnp.int32))
+        E, A = ts.obs.shape[0], ts.obs.shape[1]
+        return RolloutState(
+            env_states=env_states,
+            obs=ts.obs,
+            share_obs=ts.share_obs,
+            available_actions=ts.available_actions,
+            mask=jnp.ones((E, A, 1), jnp.float32),
+            rng=key,
+        )
+
+    def collect(self, params, rollout_state: RolloutState) -> Tuple[RolloutState, Trajectory]:
+        """Roll ``T`` steps; pure function of (params, rollout_state)."""
+
+        def body(carry, _):
+            st = carry
+            key, k_act = jax.random.split(st.rng)
+            out = self.policy.get_actions(
+                params, k_act, st.share_obs, st.obs, st.available_actions, deterministic=False
+            )
+            env_states, ts = jax.vmap(self.env.step)(st.env_states, out.action)
+            done_env = ts.done.all(axis=1)                      # (E,)
+            next_mask = jnp.where(done_env[:, None, None], 0.0, 1.0)
+            next_mask = jnp.broadcast_to(next_mask, st.mask.shape)
+            transition = dict(
+                share_obs=st.share_obs,
+                obs=st.obs,
+                available_actions=st.available_actions,
+                actions=out.action,
+                log_probs=out.log_prob,
+                values=out.value,
+                rewards=ts.reward,
+                next_mask=next_mask,
+                delay=ts.delay,
+                payment=ts.payment,
+                done=done_env,
+            )
+            new_st = RolloutState(
+                env_states=env_states,
+                obs=ts.obs,
+                share_obs=ts.share_obs,
+                available_actions=ts.available_actions,
+                mask=next_mask,
+                rng=key,
+            )
+            return new_st, transition
+
+        final_state, tr = jax.lax.scan(body, rollout_state, None, length=self.T)
+
+        masks = jnp.concatenate([rollout_state.mask[None], tr["next_mask"]], axis=0)
+        active = jnp.ones_like(masks)
+        traj = Trajectory(
+            share_obs=tr["share_obs"],
+            obs=tr["obs"],
+            available_actions=tr["available_actions"],
+            actions=tr["actions"],
+            log_probs=tr["log_probs"],
+            values=tr["values"],
+            rewards=tr["rewards"],
+            masks=masks,
+            active_masks=active,
+            delays=tr["delay"],
+            payments=tr["payment"],
+            dones=tr["done"],
+        )
+        return final_state, traj

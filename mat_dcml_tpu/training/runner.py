@@ -1,0 +1,220 @@
+"""Training orchestration for DCML (the L6 "runner" layer).
+
+Replaces ``dcml_runner.py`` + ``base_runner.py``: the collect / insert /
+compute / train phases collapse into two jitted calls per episode chunk —
+``collect`` (rollout scan) and ``train`` (PPO update) — with host-side code
+left for logging, episode accounting, and checkpointing only.
+
+With a mesh, the same two functions are jitted with the env batch sharded over
+the ``data`` axis; everything else is unchanged (SURVEY.md §7.6).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from pathlib import Path
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from mat_dcml_tpu.config import RunConfig
+from mat_dcml_tpu.envs.dcml import DCMLEnv, DCMLEnvConfig
+from mat_dcml_tpu.models.mat import MATConfig, SEMI_DISCRETE
+from mat_dcml_tpu.models.policy import TransformerPolicy
+from mat_dcml_tpu.training.checkpoint import CheckpointManager
+from mat_dcml_tpu.training.ppo import MATTrainer, PPOConfig, TrainState
+from mat_dcml_tpu.training.rollout import RolloutCollector, RolloutState
+
+
+SUPPORTED_DCML_ALGOS = ("mat", "mat_dec")
+
+
+def build_mat_policy(run: RunConfig, env: DCMLEnv) -> TransformerPolicy:
+    if run.algorithm_name not in SUPPORTED_DCML_ALGOS:
+        # The encoder/decoder/GRU ablations are discrete/continuous-only, as
+        # upstream (mat_encoder.py:183-196 has no Semi_Discrete branch);
+        # DCML's semi-discrete action layout needs the full MAT.  Erroring
+        # beats silently training vanilla MAT under an ablation's run label.
+        raise NotImplementedError(
+            f"algorithm_name={run.algorithm_name!r} is not wired for the DCML "
+            f"(semi-discrete) runner yet; supported: {SUPPORTED_DCML_ALGOS}. "
+            "mat_encoder/mat_decoder/mat_gru run on discrete/continuous envs "
+            "via mat_dcml_tpu.models.mat_variants."
+        )
+    cfg = MATConfig(
+        n_agent=env.n_agents,
+        obs_dim=env.obs_dim,
+        state_dim=env.share_obs_dim,
+        action_dim=env.action_dim,
+        n_block=run.n_block,
+        n_embd=run.n_embd,
+        n_head=run.n_head,
+        action_type=SEMI_DISCRETE,
+        semi_index=-env.cfg.consts.extra_agent if hasattr(env, "cfg") else -1,
+        encode_state=run.encode_state,
+        dec_actor=run.dec_actor or run.algorithm_name == "mat_dec",
+        share_actor=run.share_actor or run.algorithm_name == "mat_dec",
+        n_objective=run.n_objective,
+    )
+    return TransformerPolicy(cfg)
+
+
+class DCMLRunner:
+    """Rollout-train loop with episode metric accounting
+    (``dcml_runner.py:22-124``)."""
+
+    def __init__(
+        self,
+        run: RunConfig,
+        ppo: PPOConfig,
+        env: Optional[DCMLEnv] = None,
+        data_dir: str = "data",
+        log_fn=print,
+    ):
+        self.run_cfg = run
+        self.ppo_cfg = ppo
+        self.log = log_fn
+        self.env = env if env is not None else DCMLEnv(DCMLEnvConfig(), data_dir=data_dir)
+        self.policy = build_mat_policy(run, self.env)
+        self.trainer = MATTrainer(self.policy, ppo, total_updates=run.episodes)
+        self.collector = RolloutCollector(self.env, self.policy, run.episode_length)
+
+        self._collect = jax.jit(self.collector.collect)
+        self._train = jax.jit(self.trainer.train)
+
+        self.run_dir = Path(run.run_dir) / run.env_name / run.scenario / run.algorithm_name / run.experiment_name
+        self.ckpt = CheckpointManager(self.run_dir / "models")
+        self.metrics_path = self.run_dir / "metrics.jsonl"
+
+    def setup(self, seed: Optional[int] = None):
+        seed = self.run_cfg.seed if seed is None else seed
+        key = jax.random.key(seed)
+        k_model, k_roll = jax.random.split(key)
+        params = self.policy.init_params(k_model)
+        train_state = self.trainer.init_state(params)
+        rollout_state = self.collector.init_state(k_roll, self.run_cfg.n_rollout_threads)
+        return train_state, rollout_state
+
+    def train_loop(self, num_episodes: Optional[int] = None, train_state=None, rollout_state=None):
+        run = self.run_cfg
+        episodes = num_episodes if num_episodes is not None else run.episodes
+        if train_state is None:
+            train_state, rollout_state = self.setup()
+        key = jax.random.key(run.seed + 7919)
+
+        # episode accounting (dcml_runner.py:29-74)
+        E = run.n_rollout_threads
+        acc_rew = np.zeros(E)
+        acc_delay = np.zeros(E)
+        acc_pay = np.zeros(E)
+        done_rewards, done_delays, done_payments = [], [], []
+
+        start = time.time()
+        for episode in range(episodes):
+            rollout_state, traj = self._collect(train_state.params, rollout_state)
+            key, k_train = jax.random.split(key)
+            train_state, metrics = self._train(train_state, traj, rollout_state, k_train)
+
+            # host-side episode metric accumulation
+            rew = np.asarray(traj.rewards).mean(axis=(2, 3))   # (T, E)
+            delays = np.asarray(traj.delays)
+            pays = np.asarray(traj.payments)
+            dones = np.asarray(traj.dones)
+            for t in range(rew.shape[0]):
+                acc_rew += rew[t]
+                acc_delay += delays[t]
+                acc_pay += pays[t]
+                finished = dones[t]
+                if finished.any():
+                    done_rewards.extend(acc_rew[finished].tolist())
+                    done_delays.extend(acc_delay[finished].tolist())
+                    done_payments.extend(acc_pay[finished].tolist())
+                    acc_rew[finished] = 0
+                    acc_delay[finished] = 0
+                    acc_pay[finished] = 0
+
+            total_steps = (episode + 1) * run.episode_length * E
+            if episode % run.log_interval == 0:
+                elapsed = time.time() - start
+                fps = total_steps / max(elapsed, 1e-9)
+                record = {
+                    "episode": episode,
+                    "total_steps": total_steps,
+                    "fps": fps,
+                    "average_step_rewards": float(np.asarray(traj.rewards).mean()),
+                    "value_loss": float(metrics.value_loss),
+                    "policy_loss": float(metrics.policy_loss),
+                    "dist_entropy": float(metrics.dist_entropy),
+                    "grad_norm": float(metrics.grad_norm),
+                    "ratio": float(metrics.ratio),
+                }
+                if done_rewards:
+                    record["aver_episode_rewards"] = float(np.mean(done_rewards))
+                    record["aver_episode_delays"] = float(np.mean(done_delays))
+                    record["aver_episode_payments"] = float(np.mean(done_payments))
+                    done_rewards, done_delays, done_payments = [], [], []
+                self._log_record(record)
+
+            if episode % run.save_interval == 0 or episode == episodes - 1:
+                self.ckpt.save(episode, train_state)
+
+            if run.use_eval and episode % run.eval_interval == 0:
+                eval_info = self.evaluate(train_state, n_steps=run.episode_length)
+                eval_info.update(episode=episode, total_steps=total_steps)
+                self.metrics_path.parent.mkdir(parents=True, exist_ok=True)
+                with open(self.metrics_path, "a") as f:
+                    f.write(json.dumps(eval_info) + "\n")
+                self.log(f"eval ep {episode}: {eval_info}")
+
+        return train_state, rollout_state
+
+    def _log_record(self, record: dict):
+        self.metrics_path.parent.mkdir(parents=True, exist_ok=True)
+        with open(self.metrics_path, "a") as f:
+            f.write(json.dumps(record) + "\n")
+        self.log(
+            f"ep {record['episode']} steps {record['total_steps']} fps {record['fps']:.0f} "
+            f"avg_r {record['average_step_rewards']:.3f} vloss {record['value_loss']:.3f} "
+            f"ploss {record['policy_loss']:.3f} ent {record['dist_entropy']:.3f}"
+        )
+
+    # ----------------------------------------------------------------- eval
+
+    def evaluate(self, train_state: TrainState, n_steps: int = 100, seed: int = 0, stride: Optional[int] = None):
+        """Deterministic-policy eval on fresh envs (``dcml_runner.py:319-448``).
+        ``stride`` switches to the reference's block-commit decode."""
+        E = self.run_cfg.n_rollout_threads
+        rollout_state = self.collector.init_state(jax.random.key(seed + 13), E)
+
+        if stride is None:
+            def act(params, st):
+                out = self.policy.get_actions(
+                    params, jax.random.key(0), st.share_obs, st.obs, st.available_actions, deterministic=True
+                )
+                return out.action
+        else:
+            def act(params, st):
+                out = self.policy.act_stride(params, st.share_obs, st.obs, st.available_actions, stride=stride)
+                return out.action
+
+        @jax.jit
+        def eval_step(params, st: RolloutState):
+            action = act(params, st)
+            env_states, ts = jax.vmap(self.env.step)(st.env_states, action)
+            new_st = RolloutState(env_states, ts.obs, ts.share_obs, ts.available_actions, st.mask, st.rng)
+            return new_st, (ts.reward.mean(), ts.delay.mean(), ts.payment.mean())
+
+        rewards, delays, payments = [], [], []
+        for _ in range(n_steps):
+            rollout_state, (r, d, p) = eval_step(train_state.params, rollout_state)
+            rewards.append(float(r))
+            delays.append(float(d))
+            payments.append(float(p))
+        return {
+            "eval_average_step_rewards": float(np.mean(rewards)),
+            "eval_average_delays": float(np.mean(delays)),
+            "eval_average_payments": float(np.mean(payments)),
+        }

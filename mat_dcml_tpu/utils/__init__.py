@@ -1,0 +1,3 @@
+"""Cross-cutting utilities: platform selection, logging."""
+
+from mat_dcml_tpu.utils.platform import apply_platform_override

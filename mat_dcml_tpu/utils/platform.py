@@ -1,0 +1,31 @@
+"""JAX platform selection that survives site-level backend shims.
+
+Some managed environments register a tunneled TPU backend from
+``sitecustomize`` and call ``jax.config.update("jax_platforms", ...)`` at
+interpreter startup — which silently overrides the user's ``JAX_PLATFORMS``
+env var (config updates outrank env reading).  Entry points call
+:func:`apply_platform_override` so an explicit ``JAX_PLATFORMS=cpu`` (e.g.
+running the trainer on a machine whose accelerator tunnel is down) wins again.
+No-op when the env var is unset or already in effect.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def apply_platform_override() -> None:
+    want = os.environ.get("JAX_PLATFORMS")
+    if not want:
+        return
+    import jax
+
+    try:
+        current = jax.config.jax_platforms or ""
+        # "axon,cpu" with JAX_PLATFORMS=axon is the shim's own doing — leave
+        # its fallback list alone; only intervene when the *leading* platform
+        # disagrees with what the user asked for.
+        if current.split(",")[0] != want.split(",")[0]:
+            jax.config.update("jax_platforms", want)
+    except Exception:
+        pass

@@ -1,0 +1,21 @@
+"""Test harness config: force an 8-device virtual CPU mesh.
+
+Must run before jax initializes — pytest imports conftest first.  This is the
+JAX-native "fake cluster" (SURVEY.md §4): sharding/pjit tests run against 8
+virtual CPU devices, no TPU required.
+"""
+
+import os
+
+# Hard override: the session environment may pin JAX to a tunneled TPU
+# backend (and its registration shim calls jax.config.update("jax_platforms",
+# ...) at interpreter startup, which trumps env vars).  Unit tests must never
+# depend on — or block on — that tunnel, so counter-update the config too.
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

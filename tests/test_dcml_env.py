@@ -1,0 +1,245 @@
+"""Golden and property tests for the pure-JAX DCML env.
+
+Strategy (SURVEY.md §4): the env's stochastic loops were rewritten in closed
+form — every rewrite is checked against a direct numpy port of the reference
+loop math (``DCML_Worker_TIMESLOT_MultiProcess.py:46-112``) on deterministic
+inputs (Pr=0 disables retry randomness), and the samplers are checked
+statistically.
+"""
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mat_dcml_tpu.envs.dcml import DCMLConsts, DCMLEnv, DCMLEnvConfig
+
+C = DCMLConsts()
+
+
+@pytest.fixture(scope="module")
+def env():
+    return DCMLEnv(DCMLEnvConfig(), data_dir="data")
+
+
+@pytest.fixture(scope="module")
+def preset_env():
+    return DCMLEnv(DCMLEnvConfig(preset=True), data_dir="data")
+
+
+def reference_process_pr0(r, c, trace_row, arrive_time):
+    """Numpy port of Worker.process math with Pr = 0 (n_retry = 1, no retry
+    randomness, standard_price = 1, frequency = 2e9, timepoint = 0)."""
+    P = C.local_workload_period
+    compute_workload = (9 * r - 3) * c
+    cost = math.ceil(compute_workload) / C.worker_frequency
+    n_retry = 1
+    transmit_delay = (math.ceil((r + 1) * c) * 1 * C.bit_to_byte / C.non_shannon_data_rate + 0.001) * n_retry
+    price = math.floor(transmit_delay) * 0.1
+    arrive_timeslot = int(math.floor(transmit_delay + arrive_time))
+    ctp = arrive_timeslot % P
+    finish_timeslot = arrive_timeslot
+    availability = 0.0
+    if transmit_delay % 1 > trace_row[ctp]:
+        cost += transmit_delay % 1 - trace_row[ctp]
+    prices = []
+    while availability < cost:
+        free = 1 - trace_row[ctp]
+        price += free
+        prices.append(price)
+        availability += free
+        ctp = (ctp + 1) % P
+        finish_timeslot += 1
+    upload_delay = (math.ceil(r) * 1 * C.bit_to_byte / C.non_shannon_data_rate + 0.001) * n_retry + 0.02
+    delay = finish_timeslot - arrive_time - (availability - cost) + upload_delay
+    return delay, prices
+
+
+class TestWorkerProcess:
+    @pytest.mark.parametrize("seed", [0, 1, 2, 3])
+    def test_closed_form_drain_matches_loop(self, env, seed):
+        """With Pr=0 the whole process is deterministic: the scan-free drain
+        (period cumsum) must equal the reference while-loop exactly."""
+        rng = np.random.default_rng(seed)
+        W = C.worker_number_max
+        trace = np.clip(rng.random((W, C.local_workload_period)) * 0.95, 0, 0.99).astype(np.float32)
+        r = float(rng.integers(2**10, 2**20))
+        k = float(rng.integers(1, 50))
+        r_wl = math.ceil(r / k)
+        c_wl = float(rng.integers(2**5, 2**10))
+        at = int(rng.integers(0, 20))
+
+        prs = jnp.zeros(W)
+        delays, p0, c20, cap_period, m_slots = env._process_workers(
+            jax.random.key(seed), jnp.float32(r_wl), jnp.float32(c_wl), prs, jnp.array(trace), jnp.int32(at)
+        )
+
+        for w in range(0, W, 17):
+            ref_delay, ref_prices = reference_process_pr0(r_wl, c_wl, trace[w].astype(np.float64), at)
+            assert abs(float(delays[w]) - ref_delay) < 1e-2, f"worker {w}"
+            assert int(m_slots[w]) == len(ref_prices), f"worker {w} drain count"
+            # accumulated price at a mid timeslot and at the end
+            for e in (1, max(1, len(ref_prices) // 2), len(ref_prices), len(ref_prices) + 5):
+                got = float(env._cost_at(p0, c20, cap_period, m_slots, jnp.float32(e))[w])
+                want = ref_prices[min(e, len(ref_prices)) - 1]
+                assert abs(got - want) < 1e-2, f"worker {w} cost@{e}"
+
+    def test_geometric_failures_mean(self):
+        from mat_dcml_tpu.envs.dcml.env import _geometric_failures
+
+        p = jnp.full((200_000,), 0.6)
+        f = _geometric_failures(jax.random.key(0), p)
+        # E[F] = p/(1-p) = 1.5
+        assert abs(float(f.mean()) - 1.5) < 0.05
+        assert float(_geometric_failures(jax.random.key(1), jnp.zeros(100)).max()) == 0.0
+
+    def test_negative_binomial_mean(self):
+        from mat_dcml_tpu.envs.dcml.env import _negative_binomial
+
+        p = jnp.full((100_000,), 0.5)
+        n = jnp.full((100_000,), 7.0)
+        f = _negative_binomial(jax.random.key(0), n, p)
+        # E = n * p/(1-p) = 7
+        assert abs(float(f.mean()) - 7.0) < 0.15
+
+
+class TestResetObs:
+    def test_shapes_and_masks(self, env):
+        state, ts = env.reset(jax.random.key(0))
+        assert ts.obs.shape == (101, 7)
+        assert ts.share_obs.shape == (101, 102)
+        assert ts.available_actions.shape == (101, 2)
+        ava = np.asarray(ts.available_actions)
+        np.testing.assert_array_equal(ava[:, 0], 1)
+        np.testing.assert_array_equal(ava[-1], [1, 1])  # master always full
+        # unavailable workers have second bit 0
+        unavail = np.asarray(state.unavailable)
+        np.testing.assert_array_equal(ava[:100, 1], (~unavail).astype(np.float32))
+        assert unavail.sum() == int(state.disable_rate)
+        assert 1 <= int(state.disable_rate) <= 80
+
+    def test_obs_layout_available_worker(self, env):
+        state, ts = env.reset(jax.random.key(1))
+        obs = np.asarray(ts.obs)
+        rn = (float(state.r_rows) - C.r_min) / (C.r_max - C.r_min)
+        cn = (float(state.c_cols) - C.c_min) / (C.c_max - C.c_min)
+        np.testing.assert_allclose(obs[:, 0], rn, rtol=1e-5)
+        np.testing.assert_allclose(obs[:, 1], cn, rtol=1e-5)
+        avail = ~np.asarray(state.unavailable)
+        trace = np.asarray(state.trace)
+        at = int(state.arrive_time)
+        prs = np.asarray(state.worker_prs)
+        idxs = np.flatnonzero(avail)
+        w = idxs[0]
+        np.testing.assert_allclose(
+            obs[w, 2:5], trace[w, [(at) % 20, (at + 1) % 20, (at + 2) % 20]], rtol=1e-5
+        )
+        assert abs(obs[w, 5] - prs[w]) < 1e-6
+        # ranks of available workers are i_avail / n_avail
+        n_avail = avail.sum()
+        for j, w in enumerate(idxs[:5]):
+            assert abs(obs[w, 6] - j / n_avail) < 1e-5
+        # unavailable workers: four ones then previous feature-7
+        uidxs = np.flatnonzero(~avail)
+        u = uidxs[0]
+        np.testing.assert_array_equal(obs[u, 2:6], np.ones(4))
+        # master row
+        np.testing.assert_allclose(obs[100, 2:5], trace[avail][:, [(at)%20, (at+1)%20, (at+2)%20]].mean(0), rtol=1e-4)
+        assert abs(obs[100, 5] - prs[avail].mean()) < 1e-4
+        assert abs(obs[100, 6] - 1.1) < 1e-6
+
+    def test_share_obs_layout(self, env):
+        state, ts = env.reset(jax.random.key(2))
+        so = np.asarray(ts.share_obs)
+        assert np.all(so == so[0])  # replicated to all agents
+        np.testing.assert_allclose(so[0, 2:], np.asarray(state.worker_prs), rtol=1e-6)
+
+
+class TestStep:
+    def test_step_reward_formula(self, env):
+        state, ts = env.reset(jax.random.key(3))
+        action = np.zeros((101, 1), np.float32)
+        avail = ~np.asarray(state.unavailable)
+        action[:100, 0] = avail.astype(np.float32)  # select all available
+        action[100, 0] = 0.5
+        new_state, ts2 = env.step(state, jnp.array(action))
+        r = float(ts2.reward[0, 0])
+        assert abs(r - (-99.0 * float(ts2.delay) - float(ts2.payment))) < 1e-2
+        assert np.all(np.asarray(ts2.reward) == ts2.reward[0, 0])
+        assert np.all(np.asarray(ts2.done) == ts2.done[0])
+        assert float(ts2.delay) > 0
+        assert float(ts2.payment) > 0
+
+    def test_standalone_when_none_selected(self, env):
+        state, ts = env.reset(jax.random.key(4))
+        action = np.zeros((101, 1), np.float32)
+        action[100, 0] = 0.7
+        _, ts2 = env.step(state, jnp.array(action))
+        # reward = 1.5 * (-99*delay - cost) (:90)
+        assert abs(float(ts2.reward[0, 0]) - 1.5 * (-99.0 * float(ts2.delay) - float(ts2.payment))) < 1e-2
+
+    def test_done_rate_matches_continue_probability(self, env):
+        state, _ = env.reset(jax.random.key(5))
+        action = jnp.ones((101, 1))
+
+        def body(carry, key):
+            st = carry
+            st = st._replace(rng=key)
+            st2, ts = env.step(st, action)
+            return st2, ts.done[0]
+
+        _, dones = jax.lax.scan(body, state, jax.random.split(jax.random.key(6), 2000))
+        rate = float(jnp.mean(dones.astype(jnp.float32)))
+        assert abs(rate - C.continue_probability) < 0.03
+
+    def test_vmapped_step(self, env):
+        keys = jax.random.split(jax.random.key(7), 16)
+        states, tss = jax.vmap(env.reset)(keys, jnp.zeros(16, jnp.int32))
+        assert tss.obs.shape == (16, 101, 7)
+        actions = jnp.ones((16, 101, 1))
+        states2, ts2 = jax.vmap(env.step)(states, actions)
+        assert ts2.reward.shape == (16, 101, 1)
+        assert np.all(np.isfinite(np.asarray(ts2.reward)))
+
+    def test_ratio_clamping(self, env):
+        """K = ceil(N*ratio) clamped to [1, N] (:96-103): extreme ratios are safe."""
+        state, _ = env.reset(jax.random.key(8))
+        for ratio in (-5.0, 0.0, 0.5, 5.0):
+            action = np.ones((101, 1), np.float32)
+            action[100, 0] = ratio
+            _, ts = env.step(state, jnp.array(action))
+            assert np.isfinite(float(ts.reward[0, 0]))
+
+
+class TestPreset:
+    def test_preset_replay_uses_fixture(self, preset_env):
+        master = np.asarray(preset_env.preset_master)
+        prs = np.asarray(preset_env.preset_worker_prs)
+        dr = np.asarray(preset_env.preset_disable_rates)
+        assert master.shape == (1001, 3)
+        assert prs.shape == (1001, 100)
+        state, ts = preset_env.reset(jax.random.key(0), 0)
+        assert float(state.r_rows) == master[0, 0]
+        assert float(state.c_cols) == master[0, 1]
+        np.testing.assert_allclose(np.asarray(state.worker_prs), prs[0], rtol=1e-6)
+        assert int(state.disable_rate) == dr[0]
+        assert int(state.episode_idx) == 1
+        # step auto-advances to the next fixture episode
+        state2, _ = preset_env.step(state, jnp.ones((101, 1)))
+        assert float(state2.r_rows) == master[1, 0]
+
+    def test_modify_preset_sweep(self, preset_env):
+        """modify_preset pins one factor across episodes (:344-353)."""
+        import dataclasses
+
+        env2 = DCMLEnv(
+            DCMLEnvConfig(preset=True),
+            preset_master=np.asarray(preset_env.preset_master),
+            preset_worker_prs=np.asarray(preset_env.preset_worker_prs),
+            preset_disable_rates=np.full((1001,), 40, np.int64),
+            data_dir="data",
+        )
+        state, _ = env2.reset(jax.random.key(0), 5)
+        assert int(state.disable_rate) == 40

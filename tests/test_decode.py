@@ -1,0 +1,136 @@
+"""Equivalence tests for the decode machinery.
+
+The load-bearing invariant (SURVEY.md §7.1): autoregressive decode log-probs
+must equal teacher-forced parallel log-probs for the same actions, for every
+action type.  This pins the KV-cache scan against the full decoder forward.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mat_dcml_tpu.models.decode import ar_decode, parallel_act, stride_decode
+from mat_dcml_tpu.models.mat import (
+    AVAILABLE_CONTINUOUS,
+    CONTINUOUS,
+    DISCRETE,
+    SEMI_DISCRETE,
+    MATConfig,
+    MultiAgentTransformer,
+)
+from mat_dcml_tpu.models.policy import TransformerPolicy
+
+
+def make_policy(action_type, n_agent=7, action_dim=3, **kw):
+    cfg = MATConfig(
+        n_agent=n_agent,
+        obs_dim=5,
+        state_dim=11,
+        action_dim=action_dim,
+        n_block=2,
+        n_embd=16,
+        n_head=2,
+        action_type=action_type,
+        **kw,
+    )
+    pol = TransformerPolicy(cfg)
+    params = pol.init_params(jax.random.key(0))
+    return pol, params
+
+
+def rollout_inputs(cfg, batch=4, seed=1):
+    rng = np.random.default_rng(seed)
+    state = jnp.array(rng.normal(size=(batch, cfg.n_agent, cfg.state_dim)), jnp.float32)
+    obs = jnp.array(rng.normal(size=(batch, cfg.n_agent, cfg.obs_dim)), jnp.float32)
+    ava = np.ones((batch, cfg.n_agent, cfg.action_dim), np.float32)
+    # Random unavailability; keep action 0 available.  For available_continuous
+    # only the leading discrete_dim slots are availability bits — the reference
+    # masks the full logits tensor in the parallel path (transformer_act.py:296)
+    # but only the discrete slice in the AR path (:262), so continuous slots
+    # must stay 1 for the two paths to agree.
+    hi = cfg.discrete_dim if cfg.action_type == AVAILABLE_CONTINUOUS else cfg.action_dim
+    ava[:, :, 1:hi] = (rng.random(size=(batch, cfg.n_agent, hi - 1)) > 0.3).astype(np.float32)
+    return state, obs, jnp.array(ava)
+
+
+@pytest.mark.parametrize("action_type", [DISCRETE, SEMI_DISCRETE, CONTINUOUS, AVAILABLE_CONTINUOUS])
+def test_ar_equals_parallel_logprob(action_type):
+    kw = {}
+    if action_type == SEMI_DISCRETE:
+        kw["semi_index"] = -1
+    if action_type == AVAILABLE_CONTINUOUS:
+        kw["discrete_dim"] = 2
+    pol, params = make_policy(action_type, **kw)
+    cfg = pol.cfg
+    state, obs, ava = rollout_inputs(cfg)
+    if action_type == CONTINUOUS:
+        ava = None
+
+    out = pol.get_actions(params, jax.random.key(42), state, obs, ava, deterministic=False)
+    v2, logp2, ent = pol.evaluate_actions(params, state, obs, out.action, ava)
+
+    np.testing.assert_allclose(np.asarray(out.log_prob), np.asarray(logp2), rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(out.value), np.asarray(v2), rtol=1e-5, atol=1e-5)
+    assert np.all(np.isfinite(np.asarray(ent)))
+
+
+@pytest.mark.parametrize("action_type", [DISCRETE, SEMI_DISCRETE])
+def test_available_actions_respected(action_type):
+    kw = {"semi_index": -1} if action_type == SEMI_DISCRETE else {}
+    pol, params = make_policy(action_type, **kw)
+    cfg = pol.cfg
+    state, obs, _ = rollout_inputs(cfg)
+    B = state.shape[0]
+    # only action 2 available for discrete agents
+    ava = np.zeros((B, cfg.n_agent, cfg.action_dim), np.float32)
+    ava[:, :, 2] = 1.0
+    out = pol.get_actions(params, jax.random.key(7), state, obs, jnp.array(ava))
+    nd = cfg.n_discrete_agents if action_type == SEMI_DISCRETE else cfg.n_agent
+    acts = np.asarray(out.action)[:, :nd, 0]
+    np.testing.assert_array_equal(acts, np.full_like(acts, 2.0))
+
+
+def test_deterministic_decode_is_argmax_reproducible():
+    pol, params = make_policy(SEMI_DISCRETE, semi_index=-1)
+    state, obs, ava = rollout_inputs(pol.cfg)
+    a1 = pol.get_actions(params, jax.random.key(0), state, obs, ava, deterministic=True)
+    a2 = pol.get_actions(params, jax.random.key(99), state, obs, ava, deterministic=True)
+    np.testing.assert_array_equal(np.asarray(a1.action), np.asarray(a2.action))
+
+
+def test_stride_decode_stride1_matches_exact():
+    """stride=1 block-commit decode == exact deterministic AR decode."""
+    pol, params = make_policy(SEMI_DISCRETE, semi_index=-1)
+    state, obs, ava = rollout_inputs(pol.cfg)
+    exact = pol.get_actions(params, jax.random.key(0), state, obs, ava, deterministic=True)
+    strided = pol.act_stride(params, state, obs, ava, stride=1)
+    np.testing.assert_allclose(np.asarray(exact.action), np.asarray(strided.action), atol=1e-5)
+    np.testing.assert_allclose(np.asarray(exact.log_prob), np.asarray(strided.log_prob), rtol=1e-4, atol=1e-4)
+
+
+def test_stride_decode_runs_with_larger_stride():
+    pol, params = make_policy(SEMI_DISCRETE, n_agent=9, semi_index=-1)
+    state, obs, ava = rollout_inputs(pol.cfg)
+    out = pol.act_stride(params, state, obs, ava, stride=4)
+    assert out.action.shape == (4, 9, 1)
+    assert np.all(np.isfinite(np.asarray(out.log_prob)))
+
+
+def test_semi_discrete_tail_is_continuous():
+    pol, params = make_policy(SEMI_DISCRETE, semi_index=-1, action_dim=2)
+    state, obs, ava = rollout_inputs(pol.cfg)
+    out = pol.get_actions(params, jax.random.key(3), state, obs, ava)
+    tail = np.asarray(out.action)[:, -1, 0]
+    # continuous tail should not be exactly integral almost surely
+    assert not np.all(tail == np.round(tail))
+    head = np.asarray(out.action)[:, :-1, 0]
+    assert np.all((head == 0) | (head == 1))
+
+
+def test_dec_actor_mode_runs():
+    pol, params = make_policy(DISCRETE, dec_actor=True, share_actor=True)
+    state, obs, ava = rollout_inputs(pol.cfg)
+    out = pol.get_actions(params, jax.random.key(1), state, obs, ava)
+    v, logp, ent = pol.evaluate_actions(params, state, obs, out.action, ava)
+    np.testing.assert_allclose(np.asarray(out.log_prob), np.asarray(logp), rtol=1e-4, atol=1e-4)

@@ -1,0 +1,151 @@
+"""Unit tests for core ops against closed-form / loop references."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mat_dcml_tpu.ops import distributions as D
+from mat_dcml_tpu.ops.attention import merge_heads, multi_head_attention, split_heads
+from mat_dcml_tpu.ops.gae import compute_gae
+from mat_dcml_tpu.ops.normalize import (
+    value_norm_denormalize,
+    value_norm_init,
+    value_norm_normalize,
+    value_norm_update,
+)
+
+
+def _softmax(x, axis=-1):
+    e = np.exp(x - x.max(axis=axis, keepdims=True))
+    return e / e.sum(axis=axis, keepdims=True)
+
+
+class TestAttention:
+    def test_matches_numpy_unmasked(self):
+        rng = np.random.default_rng(0)
+        B, H, L, Dh = 2, 2, 5, 4
+        q, k, v = (rng.normal(size=(B, H, L, Dh)).astype(np.float32) for _ in range(3))
+        out = multi_head_attention(jnp.array(q), jnp.array(k), jnp.array(v))
+        att = q @ k.transpose(0, 1, 3, 2) / np.sqrt(Dh)
+        expect = _softmax(att) @ v
+        np.testing.assert_allclose(np.asarray(out), expect, rtol=1e-5, atol=1e-5)
+
+    def test_causal_mask(self):
+        rng = np.random.default_rng(1)
+        B, H, L, Dh = 1, 1, 6, 4
+        q, k, v = (rng.normal(size=(B, H, L, Dh)).astype(np.float32) for _ in range(3))
+        out = multi_head_attention(jnp.array(q), jnp.array(k), jnp.array(v), causal=True)
+        att = q @ k.transpose(0, 1, 3, 2) / np.sqrt(Dh)
+        mask = np.tril(np.ones((L, L), bool))
+        att = np.where(mask, att, -1e9)
+        expect = _softmax(att) @ v
+        np.testing.assert_allclose(np.asarray(out), expect, rtol=1e-5, atol=1e-5)
+
+    def test_kv_mask_prefix_equals_truncated(self):
+        """Cached attention over a prefix == attention over the sliced arrays."""
+        rng = np.random.default_rng(2)
+        B, H, L, Dh = 2, 2, 8, 4
+        q = rng.normal(size=(B, H, 1, Dh)).astype(np.float32)
+        k, v = (rng.normal(size=(B, H, L, Dh)).astype(np.float32) for _ in range(2))
+        n_valid = 5
+        kv_mask = jnp.arange(L) < n_valid
+        out = multi_head_attention(jnp.array(q), jnp.array(k), jnp.array(v), kv_mask=kv_mask)
+        ref = multi_head_attention(jnp.array(q), jnp.array(k[:, :, :n_valid]), jnp.array(v[:, :, :n_valid]))
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-5, atol=1e-5)
+
+    def test_head_split_roundtrip(self):
+        x = jnp.arange(2 * 3 * 8, dtype=jnp.float32).reshape(2, 3, 8)
+        y = merge_heads(split_heads(x, 4))
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+class TestGae:
+    def test_matches_reference_loop(self):
+        """Replicates shared_buffer.py:207-238 (non-normalized path) as a loop."""
+        rng = np.random.default_rng(3)
+        T, E, A = 7, 3, 4
+        gamma, lam = 0.99, 0.95
+        rewards = rng.normal(size=(T, E, A, 1)).astype(np.float32)
+        values = rng.normal(size=(T + 1, E, A, 1)).astype(np.float32)
+        masks = (rng.random(size=(T + 1, E, A, 1)) > 0.4).astype(np.float32)
+
+        adv_ref = np.zeros_like(rewards)
+        ret_ref = np.zeros_like(rewards)
+        gae = 0.0
+        for t in reversed(range(T)):
+            delta = rewards[t] + gamma * values[t + 1] * masks[t + 1] - values[t]
+            gae = delta + gamma * lam * masks[t + 1] * gae
+            adv_ref[t] = gae
+            ret_ref[t] = gae + values[t]
+
+        adv, ret = compute_gae(jnp.array(rewards), jnp.array(values), jnp.array(masks), gamma, lam)
+        np.testing.assert_allclose(np.asarray(adv), adv_ref, rtol=1e-5, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(ret), ret_ref, rtol=1e-5, atol=1e-5)
+
+
+class TestValueNorm:
+    def test_matches_reference_ema(self):
+        """Replicates valuenorm.py:38-67 update/normalize/denormalize."""
+        rng = np.random.default_rng(4)
+        beta = 0.99999
+        state = value_norm_init(1)
+        rm, rmsq, term = 0.0, 0.0, 0.0
+        for _ in range(5):
+            batch = rng.normal(loc=3.0, scale=2.0, size=(64, 1)).astype(np.float32)
+            state = value_norm_update(state, jnp.array(batch), beta=beta)
+            rm = rm * beta + batch.mean() * (1 - beta)
+            rmsq = rmsq * beta + (batch**2).mean() * (1 - beta)
+            term = term * beta + (1 - beta)
+        mean = rm / max(term, 1e-5)
+        var = max(rmsq / max(term, 1e-5) - mean**2, 1e-2)
+
+        x = rng.normal(size=(10, 1)).astype(np.float32)
+        norm = value_norm_normalize(state, jnp.array(x))
+        np.testing.assert_allclose(np.asarray(norm), (x - mean) / np.sqrt(var), rtol=1e-4, atol=1e-5)
+        denorm = value_norm_denormalize(state, norm)
+        np.testing.assert_allclose(np.asarray(denorm), x, rtol=1e-4, atol=1e-5)
+
+    def test_uninitialized_normalize_is_safe(self):
+        state = value_norm_init(1)
+        out = value_norm_normalize(state, jnp.ones((4, 1)))
+        assert np.all(np.isfinite(np.asarray(out)))
+
+
+class TestDistributions:
+    def test_categorical_log_prob_and_entropy(self):
+        logits = jnp.array([[1.0, 2.0, 0.5]])
+        p = _softmax(np.array(logits))
+        lp = D.categorical_log_prob(logits, jnp.array([1]))
+        np.testing.assert_allclose(np.asarray(lp), np.log(p[:, 1]), rtol=1e-6)
+        ent = D.categorical_entropy(logits)
+        np.testing.assert_allclose(np.asarray(ent), -(p * np.log(p)).sum(-1), rtol=1e-5)
+
+    def test_masked_logits_entropy_finite(self):
+        logits = jnp.array([[1.0, 2.0]])
+        masked = D.mask_logits(logits, jnp.array([[1.0, 0.0]]))
+        ent = D.categorical_entropy(masked)
+        assert np.isfinite(float(ent[0]))
+        assert abs(float(ent[0])) < 1e-3  # one option left -> ~zero entropy
+        lp = D.categorical_log_prob(masked, jnp.array([0]))
+        np.testing.assert_allclose(np.asarray(lp), [0.0], atol=1e-5)
+
+    def test_normal_log_prob_matches_formula(self):
+        mean = jnp.array([0.5, -1.0])
+        std = jnp.array([0.3, 1.2])
+        x = jnp.array([0.7, -0.2])
+        lp = D.normal_log_prob(mean, std, x)
+        expect = -((np.array(x) - np.array(mean)) ** 2) / (2 * np.array(std) ** 2) - np.log(
+            np.array(std)
+        ) - 0.5 * np.log(2 * np.pi)
+        np.testing.assert_allclose(np.asarray(lp), expect, rtol=1e-5)
+
+    def test_normal_entropy(self):
+        std = jnp.array([0.5])
+        ent = D.normal_entropy(jnp.zeros(1), std)
+        np.testing.assert_allclose(np.asarray(ent), 0.5 * np.log(2 * np.pi * np.e * 0.25), rtol=1e-5)
+
+    def test_huber(self):
+        e = jnp.array([-0.5, 0.5, 3.0, -20.0])
+        out = D.huber_loss(e, 10.0)
+        np.testing.assert_allclose(np.asarray(out), [0.125, 0.125, 4.5, 10 * (20 - 5)], rtol=1e-6)

@@ -1,0 +1,33 @@
+#!/usr/bin/env python
+"""Train MAT on the DCML worker-selection env (TPU-native).
+
+Equivalent of the reference entry point ``DCML_MAT_Train.py`` — same default
+recipe (8 env batch, 1M steps, episode_length 50, lr 5e-5, ppo_epoch 15,
+4 minibatches, valuenorm), minus the subprocess vec-envs and run-dir/wandb
+boilerplate.  Metrics stream to ``<run_dir>/metrics.jsonl``.
+
+Usage:
+  python train_dcml.py                      # full recipe
+  python train_dcml.py --num_env_steps 40000 --n_rollout_threads 4
+"""
+
+import sys
+
+from mat_dcml_tpu.utils.platform import apply_platform_override
+
+apply_platform_override()
+
+from mat_dcml_tpu.config import parse_cli
+from mat_dcml_tpu.training.runner import DCMLRunner
+
+
+def main(argv=None):
+    run, ppo = parse_cli(argv)
+    runner = DCMLRunner(run, ppo)
+    print(f"algorithm={run.algorithm_name} env={run.env_name}/{run.scenario} "
+          f"episodes={run.episodes} devices={len(__import__('jax').devices())}")
+    runner.train_loop()
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])
