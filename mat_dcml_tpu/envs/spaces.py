@@ -1,0 +1,115 @@
+"""Action/observation space descriptors.
+
+The reference dispatches on gym space *class names* plus a duck-typed custom
+``Action_Space`` (``DCML_ENVs/DCML_utils/DCML_ActionSpace.py``) throughout
+(``act.py:18-68``, ``mat/utils/util.py:41-62``, ``transformer_policy.py:28-39``).
+Here spaces are frozen dataclasses carrying the same semantic fields; dispatch
+is on type, not string matching.
+
+``DCMLActionSpace`` reproduces the reference's mixed layout
+(``DCML_ActionSpace.py``): ``n_sub = high - low`` categorical sub-actions with
+``n`` choices each (the 100 worker-selection bits, 2 choices), plus
+``-semi_index`` Gaussian tail dims (the coding-ratio agent).  ``extra`` marks
+the single-continuous-dim variant used for the DCML master agent in separated
+(per-agent) policies.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class Discrete:
+    """Categorical space with ``n`` choices (gym.spaces.Discrete)."""
+
+    n: int
+
+    @property
+    def sample_dim(self) -> int:
+        return 1
+
+
+@dataclasses.dataclass(frozen=True)
+class Box:
+    """Continuous space; ``dim`` flat dims with uniform bounds (gym.spaces.Box)."""
+
+    dim: int
+    low: float = -1.0
+    high: float = 1.0
+
+    @property
+    def shape(self) -> Tuple[int, ...]:
+        return (self.dim,)
+
+    @property
+    def sample_dim(self) -> int:
+        return self.dim
+
+
+@dataclasses.dataclass(frozen=True)
+class MultiDiscrete:
+    """Tuple of categorical sub-spaces (gym.spaces.MultiDiscrete; the
+    reference computes per-head sizes as ``high - low + 1``, ``act.py:56-58``)."""
+
+    nvec: Tuple[int, ...]
+
+    @property
+    def sample_dim(self) -> int:
+        return len(self.nvec)
+
+
+@dataclasses.dataclass(frozen=True)
+class MultiBinary:
+    """``n`` independent Bernoulli bits (gym.spaces.MultiBinary)."""
+
+    n: int
+
+    @property
+    def sample_dim(self) -> int:
+        return self.n
+
+
+@dataclasses.dataclass(frozen=True)
+class DCMLActionSpace:
+    """The reference's duck-typed ``Action_Space`` (``DCML_ActionSpace.py``).
+
+    Modes, matching ``act.py:21-48`` dispatch:
+      - ``mixed=True``: ``n_sub`` categorical heads of ``n`` choices sliced
+        from one wide feature vector + ``cont_dim`` Gaussian tail — the
+        centralized-PPO joint action over all DCML agents.
+      - ``extra=True``: 1-dim Gaussian (the master/ratio agent standalone).
+      - neither: plain categorical with ``n`` choices (a worker agent).
+    """
+
+    n: int = 2
+    n_sub: int = 100              # high - low in the reference
+    semi_index: int = -1          # negated count of Gaussian tail dims
+    mixed: bool = False
+    extra: bool = False
+    continuous: bool = False
+    multi_discrete: bool = False
+
+    @property
+    def cont_dim(self) -> int:
+        return -self.semi_index
+
+    @property
+    def mixed_feature_dim(self) -> int:
+        """Width of the actor feature vector the mixed ACT head slices
+        (``mlp.py:51-56``): all sub-action logits + tail means."""
+        return self.n_sub * self.n + self.cont_dim
+
+    @property
+    def sample_dim(self) -> int:
+        if self.mixed:
+            return self.n_sub + self.cont_dim
+        if self.extra:
+            return self.cont_dim
+        return 1
+
+
+def space_sample_dim(space) -> int:
+    """Width of a stored action sample for ``space``."""
+    return space.sample_dim

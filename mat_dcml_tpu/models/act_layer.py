@@ -1,0 +1,246 @@
+"""Action heads for the actor-critic family (``mat/algorithms/utils/act.py``).
+
+One Flax module dispatching on the space descriptor type (the reference
+dispatches on gym class *names*, ``act.py:18-68``):
+
+- ``Discrete`` / plain ``DCMLActionSpace`` -> one Categorical linear head
+  (gain 0.01), availability-masked logits (``distributions.py:56-70``).
+- ``Box`` / ``DCMLActionSpace(extra=True)`` -> DiagGaussian: linear mean head
+  + learned ``log_std`` with ``std = sigmoid(log_std / std_x) * std_y``
+  (``distributions.py:95-116``).
+- ``MultiDiscrete`` -> one Categorical head per sub-action (``act.py:55-61``).
+- ``MultiBinary`` -> Bernoulli head (``act.py:52-54``; the reference's
+  ``FixedBernoulli.log_probs`` is a broken ``super.log_prob`` access — fixed
+  here, SURVEY.md §7 known defects).
+- ``DCMLActionSpace(mixed=True)`` -> NO linear head: the base's wide output
+  vector is sliced into ``n_sub`` categorical logit groups + Gaussian tail
+  means (``act.py:83-105,157-195``; the base widening is ``mlp.py:51-56``).
+
+Log-prob layout matches the reference exactly: Discrete (B,1); Box (B,dim)
+un-summed per dim (``FixedNormal.log_probs``, ``distributions.py:33-36``);
+MultiDiscrete (B,heads); mixed (B,1) summed over every part (``act.py:103``).
+Entropy from ``evaluate`` is the reference's active-mask-weighted scalar,
+including the mixed mode's ``/0.98`` rescale of both parts (``act.py:195``).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from mat_dcml_tpu.envs.spaces import (
+    Box,
+    DCMLActionSpace,
+    Discrete,
+    MultiBinary,
+    MultiDiscrete,
+)
+from mat_dcml_tpu.ops import distributions as D
+
+GAIN_ACT_HEAD = 0.01  # act.py passes gain=0.01 by convention (config.py gain default)
+
+
+def _head(features: int, gain: float = GAIN_ACT_HEAD) -> nn.Dense:
+    return nn.Dense(
+        features,
+        kernel_init=nn.initializers.orthogonal(gain),
+        bias_init=nn.initializers.zeros_init(),
+    )
+
+
+def _masked_mean(x: jax.Array, active_masks: Optional[jax.Array]) -> jax.Array:
+    """Reference entropy weighting: ``(ent * active).sum() / active.sum()``
+    with broadcast over trailing dims (``act.py:171-176,215-222``)."""
+    if active_masks is None:
+        return x.mean()
+    while active_masks.ndim < x.ndim:
+        active_masks = active_masks[..., None]
+    while active_masks.ndim > x.ndim:
+        active_masks = active_masks.squeeze(-1)
+    return (x * active_masks).sum() / jnp.clip(active_masks.sum(), min=1e-8)
+
+
+class ACTLayer(nn.Module):
+    """Samples / evaluates actions from actor features."""
+
+    space: object
+    std_x_coef: float = 1.0
+    std_y_coef: float = 0.5
+
+    def setup(self):
+        sp = self.space
+        if isinstance(sp, Discrete):
+            self.action_head = _head(sp.n)
+        elif isinstance(sp, Box):
+            self.mean_head = _head(sp.dim)
+            self.log_std = self.param(
+                "log_std", lambda k: jnp.ones((sp.dim,)) * self.std_x_coef
+            )
+        elif isinstance(sp, MultiDiscrete):
+            self.action_heads = [_head(n) for n in sp.nvec]
+        elif isinstance(sp, MultiBinary):
+            self.action_head = _head(sp.n)
+        elif isinstance(sp, DCMLActionSpace):
+            if sp.mixed:
+                # No head: features sliced directly (act.py:83-105).
+                self.log_std = self.param(
+                    "log_std", lambda k: jnp.ones((sp.cont_dim,))
+                )
+            elif sp.extra:
+                self.mean_head = _head(sp.cont_dim)
+                self.log_std = self.param(
+                    "log_std", lambda k: jnp.ones((sp.cont_dim,)) * self.std_x_coef
+                )
+            else:
+                self.action_head = _head(sp.n)
+        else:
+            raise TypeError(f"unsupported action space: {sp!r}")
+
+    # -- distribution params -------------------------------------------------
+
+    def _gauss_std(self, log_std: jax.Array) -> jax.Array:
+        return jax.nn.sigmoid(log_std / self.std_x_coef) * self.std_y_coef
+
+    def _mixed_std(self) -> jax.Array:
+        # Mixed tail uses plain sigmoid(log_std) * 0.5 (act.py:97,183).
+        return jax.nn.sigmoid(self.log_std) * 0.5
+
+    # -- sample --------------------------------------------------------------
+
+    def sample(
+        self,
+        x: jax.Array,
+        key: jax.Array,
+        available_actions: Optional[jax.Array] = None,
+        deterministic: bool = False,
+    ) -> Tuple[jax.Array, jax.Array]:
+        """-> (action (B, sample_dim) float, log_prob) per reference layout."""
+        sp = self.space
+        if isinstance(sp, Discrete) or (
+            isinstance(sp, DCMLActionSpace) and not sp.mixed and not sp.extra
+        ):
+            logits = D.mask_logits(self.action_head(x), available_actions)
+            a = D.categorical_mode(logits) if deterministic else D.categorical_sample(key, logits)
+            logp = D.categorical_log_prob(logits, a)
+            return a[..., None].astype(jnp.float32), logp[..., None]
+
+        if isinstance(sp, Box) or (isinstance(sp, DCMLActionSpace) and sp.extra):
+            mean = self.mean_head(x)
+            std = self._gauss_std(self.log_std)
+            a = mean if deterministic else D.normal_sample(key, mean, jnp.broadcast_to(std, mean.shape))
+            logp = D.normal_log_prob(mean, std, a)
+            return a, logp
+
+        if isinstance(sp, MultiDiscrete):
+            actions, logps = [], []
+            keys = jax.random.split(key, len(sp.nvec))
+            for i, head in enumerate(self.action_heads):
+                avail = None if available_actions is None else available_actions[..., i, :]
+                logits = D.mask_logits(head(x), avail)
+                a = D.categorical_mode(logits) if deterministic else D.categorical_sample(keys[i], logits)
+                actions.append(a[..., None].astype(jnp.float32))
+                logps.append(D.categorical_log_prob(logits, a)[..., None])
+            return jnp.concatenate(actions, -1), jnp.concatenate(logps, -1)
+
+        if isinstance(sp, MultiBinary):
+            logits = self.action_head(x)
+            p = jax.nn.sigmoid(logits)
+            if deterministic:
+                a = (p > 0.5).astype(jnp.float32)
+            else:
+                a = jax.random.bernoulli(key, p).astype(jnp.float32)
+            logp = (a * jax.nn.log_sigmoid(logits) + (1 - a) * jax.nn.log_sigmoid(-logits)).sum(
+                -1, keepdims=True
+            )
+            return a, logp
+
+        # DCML mixed: slice n_sub categorical groups + Gaussian tail
+        # (act.py:83-105).
+        assert isinstance(sp, DCMLActionSpace) and sp.mixed
+        B = x.shape[0]
+        disc_logits = x[..., : sp.n_sub * sp.n].reshape(*x.shape[:-1], sp.n_sub, sp.n)
+        if available_actions is not None:
+            disc_logits = D.mask_logits(disc_logits, available_actions[..., : sp.n_sub, :])
+        k_disc, k_cont = jax.random.split(key)
+        if deterministic:
+            a_disc = D.categorical_mode(disc_logits)
+        else:
+            a_disc = D.categorical_sample(k_disc, disc_logits)
+        logp_disc = D.categorical_log_prob(disc_logits, a_disc)       # (B, n_sub)
+        mean = x[..., sp.n_sub * sp.n :]
+        std = self._mixed_std()
+        a_cont = mean if deterministic else D.normal_sample(k_cont, mean, jnp.broadcast_to(std, mean.shape))
+        logp_cont = D.normal_log_prob(mean, std, a_cont)              # (B, cont)
+        action = jnp.concatenate([a_disc.astype(jnp.float32), a_cont], -1)
+        logp = jnp.concatenate([logp_disc, logp_cont], -1).sum(-1, keepdims=True)
+        return action, logp
+
+    # -- evaluate ------------------------------------------------------------
+
+    def evaluate(
+        self,
+        x: jax.Array,
+        action: jax.Array,
+        available_actions: Optional[jax.Array] = None,
+        active_masks: Optional[jax.Array] = None,
+    ) -> Tuple[jax.Array, jax.Array]:
+        """-> (log_prob, scalar entropy) matching ``act.py:144-226``."""
+        sp = self.space
+        if isinstance(sp, Discrete) or (
+            isinstance(sp, DCMLActionSpace) and not sp.mixed and not sp.extra
+        ):
+            logits = D.mask_logits(self.action_head(x), available_actions)
+            logp = D.categorical_log_prob(logits, action[..., 0])[..., None]
+            ent = _masked_mean(D.categorical_entropy(logits), active_masks)
+            return logp, ent
+
+        if isinstance(sp, Box) or (isinstance(sp, DCMLActionSpace) and sp.extra):
+            mean = self.mean_head(x)
+            std = self._gauss_std(self.log_std)
+            logp = D.normal_log_prob(mean, std, action)
+            ent = _masked_mean(
+                jnp.broadcast_to(D.normal_entropy(mean, std), mean.shape), active_masks
+            )
+            return logp, ent
+
+        if isinstance(sp, MultiDiscrete):
+            logps, ents = [], []
+            for i, head in enumerate(self.action_heads):
+                avail = None if available_actions is None else available_actions[..., i, :]
+                logits = D.mask_logits(head(x), avail)
+                logps.append(D.categorical_log_prob(logits, action[..., i])[..., None])
+                ents.append(_masked_mean(D.categorical_entropy(logits), active_masks))
+            return jnp.concatenate(logps, -1), jnp.stack(ents).mean()
+
+        if isinstance(sp, MultiBinary):
+            logits = self.action_head(x)
+            logp = (
+                action * jax.nn.log_sigmoid(logits) + (1 - action) * jax.nn.log_sigmoid(-logits)
+            ).sum(-1, keepdims=True)
+            p = jax.nn.sigmoid(logits)
+            ent_bits = -(p * jax.nn.log_sigmoid(logits) + (1 - p) * jax.nn.log_sigmoid(-logits))
+            return logp, _masked_mean(ent_bits.sum(-1), active_masks)
+
+        assert isinstance(sp, DCMLActionSpace) and sp.mixed
+        a_disc = action[..., : sp.n_sub].astype(jnp.int32)
+        a_cont = action[..., sp.n_sub :]
+        disc_logits = x[..., : sp.n_sub * sp.n].reshape(*x.shape[:-1], sp.n_sub, sp.n)
+        if available_actions is not None:
+            disc_logits = D.mask_logits(disc_logits, available_actions[..., : sp.n_sub, :])
+        logp_disc = jnp.take_along_axis(
+            jax.nn.log_softmax(disc_logits, -1), a_disc[..., None], axis=-1
+        )[..., 0]                                                      # (B, n_sub)
+        ent_disc = _masked_mean(D.categorical_entropy(disc_logits).mean(-1), active_masks)
+        mean = x[..., sp.n_sub * sp.n :]
+        std = self._mixed_std()
+        logp_cont = D.normal_log_prob(mean, std, a_cont)
+        ent_cont = _masked_mean(
+            jnp.broadcast_to(D.normal_entropy(mean, std), mean.shape), active_masks
+        )
+        logp = jnp.concatenate([logp_disc, logp_cont], -1).sum(-1, keepdims=True)
+        # act.py:195 — both parts divided by 0.98 before summing.
+        entropy = ent_disc / 0.98 + ent_cont / 0.98
+        return logp, entropy

@@ -1,0 +1,234 @@
+"""MLP/GRU actor-critic models for the PPO/MAPPO/HAPPO/HATRPO/IPPO families.
+
+JAX equivalents of ``mat/algorithms/actor_critic.py`` (shared by HAPPO/PPO/
+IPPO) and ``r_mappo/algorithm/r_actor_critic.py`` (recurrent MAPPO):
+
+- ``Actor``: base (MLP or CNN) -> optional mask-gated GRU -> ACT head
+  (``actor_critic.py:11-116``).
+- ``Critic``: base over centralized obs -> optional GRU -> scalar value head;
+  with PopArt the head's outputs live in normalized-return space and the
+  trainer rescales its weights when statistics update
+  (``actor_critic.py:119-171``, ``algorithms/utils/popart.py``).
+
+All methods are row-major ``(N, d)`` like the reference's flattened
+(threads x agents) batches; recurrent hidden states are ``(N, recurrent_N,
+hidden)``.  Per-agent (non-shared) families stack parameter pytrees along a
+leading agent axis and ``vmap`` these same modules.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import NamedTuple, Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from mat_dcml_tpu.envs.spaces import Box, DCMLActionSpace, Discrete
+from mat_dcml_tpu.models.act_layer import ACTLayer
+from mat_dcml_tpu.models.bases import CNNBase, GRULayer, MLPBase
+from mat_dcml_tpu.ops import distributions as D
+
+
+@dataclasses.dataclass(frozen=True)
+class ACConfig:
+    """Network hyperparameters (``config.py`` network group defaults)."""
+
+    hidden_size: int = 64
+    layer_N: int = 1
+    use_relu: bool = True
+    use_feature_normalization: bool = True
+    use_recurrent_policy: bool = False
+    recurrent_N: int = 1
+    std_x_coef: float = 1.0
+    std_y_coef: float = 0.5
+    use_popart: bool = False
+    image_obs: bool = False
+
+
+def _mixed_out_dim(space) -> Optional[int]:
+    if isinstance(space, DCMLActionSpace) and space.mixed:
+        return space.mixed_feature_dim
+    return None
+
+
+class Actor(nn.Module):
+    cfg: ACConfig
+    space: object
+
+    def setup(self):
+        c = self.cfg
+        out_dim = _mixed_out_dim(self.space)
+        if c.image_obs:
+            self.base = CNNBase(c.hidden_size, c.use_relu)
+        else:
+            self.base = MLPBase(
+                c.hidden_size, c.layer_N, c.use_relu, c.use_feature_normalization, out_dim
+            )
+        if c.use_recurrent_policy:
+            if out_dim is not None:
+                raise ValueError("recurrent policy is incompatible with the mixed "
+                                 "action space's wide feature head")
+            self.rnn = GRULayer(c.hidden_size, c.recurrent_N)
+        self.act = ACTLayer(self.space, c.std_x_coef, c.std_y_coef)
+
+    def _features(self, obs, rnn_states, masks):
+        x = self.base(obs)
+        if self.cfg.use_recurrent_policy:
+            x, rnn_states = self.rnn(x, rnn_states, masks)
+        return x, rnn_states
+
+    def __call__(self, obs, rnn_states, masks, available_actions=None,
+                 deterministic: bool = False, key: Optional[jax.Array] = None):
+        """Rollout step (``actor_critic.py:42-73``) -> (action, logp, h')."""
+        x, rnn_states = self._features(obs, rnn_states, masks)
+        if key is None:
+            if not deterministic:
+                raise ValueError("stochastic sampling requires an explicit PRNG key")
+            key = jax.random.key(0)  # never consumed on the deterministic path
+        action, logp = self.act.sample(x, key, available_actions, deterministic)
+        return action, logp, rnn_states
+
+    def evaluate(self, obs, rnn_states, action, masks, available_actions=None,
+                 active_masks=None):
+        """Training-time scoring (``actor_critic.py:75-117``) -> (logp, ent)."""
+        x, _ = self._features(obs, rnn_states, masks)
+        return self.act.evaluate(x, action, available_actions, active_masks)
+
+    def evaluate_seq(self, obs, rnn_states, action, masks, available_actions=None,
+                     active_masks=None):
+        """Recurrent training over ``(T, B, ...)`` sequences: the reference's
+        chunked recurrent generator path (``separated_buffer.py:236-430``)."""
+        if not self.cfg.use_recurrent_policy:
+            raise ValueError("evaluate_seq requires use_recurrent_policy=True")
+        x = self.base(obs)
+        x, _ = self.rnn.run_sequence(x, rnn_states, masks)
+        return self.act.evaluate(x, action, available_actions, active_masks)
+
+    def dist_params(self, obs, rnn_states, masks, available_actions=None):
+        """HATRPO KL machinery: distribution parameters
+        (``act.py:evaluate_actions_trpo``).  Discrete -> masked logits;
+        Box/extra -> (mean, std)."""
+        x, _ = self._features(obs, rnn_states, masks)
+        sp = self.space
+        if isinstance(sp, Discrete) or (
+            isinstance(sp, DCMLActionSpace) and not sp.mixed and not sp.extra
+        ):
+            return D.mask_logits(self.act.action_head(x), available_actions)
+        if isinstance(sp, Box) or (isinstance(sp, DCMLActionSpace) and sp.extra):
+            mean = self.act.mean_head(x)
+            std = jnp.broadcast_to(self.act._gauss_std(self.act.log_std), mean.shape)
+            return mean, std
+        raise TypeError(f"dist_params unsupported for {sp!r}")
+
+
+class Critic(nn.Module):
+    cfg: ACConfig
+    n_objective: int = 1
+
+    def setup(self):
+        c = self.cfg
+        if c.image_obs:
+            self.base = CNNBase(c.hidden_size, c.use_relu)
+        else:
+            self.base = MLPBase(c.hidden_size, c.layer_N, c.use_relu, c.use_feature_normalization)
+        if c.use_recurrent_policy:
+            self.rnn = GRULayer(c.hidden_size, c.recurrent_N)
+        # PopArt and plain heads share this layout; PopArt weight rescaling is
+        # a functional transform applied by the trainer (ops/popart.py).
+        self.v_out = nn.Dense(
+            self.n_objective,
+            kernel_init=nn.initializers.orthogonal(1.0),
+            bias_init=nn.initializers.zeros_init(),
+        )
+
+    def __call__(self, cent_obs, rnn_states, masks):
+        x = self.base(cent_obs)
+        if self.cfg.use_recurrent_policy:
+            x, rnn_states = self.rnn(x, rnn_states, masks)
+        return self.v_out(x), rnn_states
+
+    def values_seq(self, cent_obs, rnn_states, masks):
+        if not self.cfg.use_recurrent_policy:
+            raise ValueError("values_seq requires use_recurrent_policy=True")
+        x = self.base(cent_obs)
+        x, _ = self.rnn.run_sequence(x, rnn_states, masks)
+        return self.v_out(x)
+
+
+class ACOutput(NamedTuple):
+    value: jax.Array
+    action: jax.Array
+    log_prob: jax.Array
+    actor_h: jax.Array
+    critic_h: jax.Array
+
+
+class ActorCriticPolicy:
+    """Functional bundle over {actor, critic} params — the JAX counterpart of
+    ``rMAPPOPolicy.py`` / ``happo_policy.py`` / ``ippo_policy.py``."""
+
+    def __init__(self, cfg: ACConfig, obs_dim: int, cent_obs_dim: int, space,
+                 n_objective: int = 1):
+        self.cfg = cfg
+        self.space = space
+        self.obs_dim = obs_dim
+        self.cent_obs_dim = cent_obs_dim
+        self.actor = Actor(cfg, space)
+        self.critic = Critic(cfg, n_objective)
+
+    def init_hidden(self, n: int) -> Tuple[jax.Array, jax.Array]:
+        h = jnp.zeros((n, self.cfg.recurrent_N, self.cfg.hidden_size), jnp.float32)
+        return h, h
+
+    def init_params(self, key: jax.Array):
+        k_a, k_c = jax.random.split(key)
+        obs = jnp.zeros((1, self.obs_dim), jnp.float32)
+        cent = jnp.zeros((1, self.cent_obs_dim), jnp.float32)
+        h, _ = self.init_hidden(1)
+        mask = jnp.ones((1, 1), jnp.float32)
+        return {
+            "actor": self.actor.init(k_a, obs, h, mask, None, False, jax.random.key(0)),
+            "critic": self.critic.init(k_c, cent, h, mask),
+        }
+
+    def get_actions(self, params, key, cent_obs, obs, actor_h, critic_h, masks,
+                    available_actions=None, deterministic: bool = False) -> ACOutput:
+        action, logp, actor_h = self.actor.apply(
+            params["actor"], obs, actor_h, masks, available_actions, deterministic, key
+        )
+        value, critic_h = self.critic.apply(params["critic"], cent_obs, critic_h, masks)
+        return ACOutput(value, action, logp, actor_h, critic_h)
+
+    def get_values(self, params, cent_obs, critic_h, masks):
+        value, _ = self.critic.apply(params["critic"], cent_obs, critic_h, masks)
+        return value
+
+    def evaluate_actions(self, params, cent_obs, obs, actor_h, critic_h, action,
+                         masks, available_actions=None, active_masks=None):
+        logp, ent = self.actor.apply(
+            params["actor"], obs, actor_h, action, masks, available_actions,
+            active_masks, method="evaluate",
+        )
+        value, _ = self.critic.apply(params["critic"], cent_obs, critic_h, masks)
+        return value, logp, ent
+
+    def evaluate_actions_seq(self, params, cent_obs, obs, actor_h0, critic_h0,
+                             action, masks, available_actions=None, active_masks=None):
+        """Sequence (T, B, ...) evaluation for recurrent training."""
+        logp, ent = self.actor.apply(
+            params["actor"], obs, actor_h0, action, masks, available_actions,
+            active_masks, method="evaluate_seq",
+        )
+        value = self.critic.apply(
+            params["critic"], cent_obs, critic_h0, masks, method="values_seq"
+        )
+        return value, logp, ent
+
+    def act(self, params, key, obs, actor_h, masks, available_actions=None,
+            deterministic: bool = False):
+        action, logp, actor_h = self.actor.apply(
+            params["actor"], obs, actor_h, masks, available_actions, deterministic, key
+        )
+        return action, logp, actor_h

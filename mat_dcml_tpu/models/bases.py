@@ -1,0 +1,159 @@
+"""Feature-extractor bases for the actor-critic (non-transformer) family.
+
+JAX equivalents of ``mat/algorithms/utils/{mlp,cnn,rnn}.py``:
+
+- ``MLPBase`` — optional input LayerNorm, then two ``MLPLayer`` stacks
+  (Linear-act-LayerNorm x (1 + layer_N) each, ``mlp.py:8-30,33-67``).  For the
+  DCML mixed action space the second stack widens to emit the full logit
+  vector the ACT head slices (``mlp.py:51-56``).
+- ``CNNBase`` — conv + 2 linear layers on image obs, inputs scaled by 1/255
+  (``cnn.py:11-44``).
+- ``GRULayer`` — ``recurrent_N`` stacked GRU cells with mask-gated hidden
+  state and output LayerNorm (``rnn.py:7-80``).  The reference's
+  segment-batching over zero-mask boundaries (``rnn.py:40-74``) is a CPU-side
+  optimization of exactly "multiply hidden by mask each step"; here the
+  sequence form is a ``lax.scan`` doing that multiply, which XLA pipelines.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+ORTHO_GAIN_RELU = jnp.sqrt(2.0).item()   # nn.init.calculate_gain('relu')
+ORTHO_GAIN_TANH = 5.0 / 3.0              # nn.init.calculate_gain('tanh')
+
+
+def _dense(features: int, gain: float, use_bias: bool = True) -> nn.Dense:
+    return nn.Dense(
+        features,
+        use_bias=use_bias,
+        kernel_init=nn.initializers.orthogonal(gain),
+        bias_init=nn.initializers.zeros_init(),
+    )
+
+
+class MLPLayer(nn.Module):
+    """Linear-act-LayerNorm, then ``layer_N`` hidden repeats (``mlp.py:8-30``)."""
+
+    hidden_size: int
+    layer_N: int = 1
+    use_relu: bool = True
+    out_dim: Optional[int] = None  # width of the final repeat (mixed-action head)
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        act = nn.relu if self.use_relu else nn.tanh
+        gain = ORTHO_GAIN_RELU if self.use_relu else ORTHO_GAIN_TANH
+        # When out_dim is set every layer is out_dim wide: the reference
+        # passes out_dim as MLPLayer's hidden_size, so fc1 already widens and
+        # the layer_N repeats stay wide (mlp.py:20-25,51-56).
+        widths = [self.hidden_size if self.out_dim is None else self.out_dim] * (1 + self.layer_N)
+        for w in widths:
+            x = _dense(w, gain)(x)
+            x = act(x)
+            x = nn.LayerNorm()(x)
+        return x
+
+
+class MLPBase(nn.Module):
+    """Two stacked ``MLPLayer``s with optional feature normalization
+    (``mlp.py:33-67``).  ``out_dim`` (set for mixed action spaces) widens the
+    output stack so the ACT head can slice logits directly."""
+
+    hidden_size: int
+    layer_N: int = 1
+    use_relu: bool = True
+    use_feature_normalization: bool = True
+    out_dim: Optional[int] = None
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        if self.use_feature_normalization:
+            x = nn.LayerNorm()(x)
+        x = MLPLayer(self.hidden_size, self.layer_N, self.use_relu)(x)
+        x = MLPLayer(self.hidden_size, self.layer_N, self.use_relu, out_dim=self.out_dim)(x)
+        return x
+
+
+class CNNBase(nn.Module):
+    """Conv-flatten-linear-linear on (C, H, W) image obs (``cnn.py:11-58``)."""
+
+    hidden_size: int
+    use_relu: bool = True
+    kernel_size: int = 3
+    stride: int = 1
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        act = nn.relu if self.use_relu else nn.tanh
+        gain = ORTHO_GAIN_RELU if self.use_relu else ORTHO_GAIN_TANH
+        x = x / 255.0
+        # NCHW -> NHWC for lax conv defaults.
+        x = jnp.moveaxis(x, -3, -1)
+        x = nn.Conv(
+            self.hidden_size // 2,
+            kernel_size=(self.kernel_size, self.kernel_size),
+            strides=(self.stride, self.stride),
+            padding="VALID",
+            kernel_init=nn.initializers.orthogonal(gain),
+            bias_init=nn.initializers.zeros_init(),
+        )(x)
+        x = act(x)
+        x = x.reshape(*x.shape[:-3], -1)
+        x = act(_dense(self.hidden_size, gain)(x))
+        x = act(_dense(self.hidden_size, gain)(x))
+        return x
+
+
+class GRULayer(nn.Module):
+    """Mask-gated stacked GRU with output LayerNorm (``rnn.py:7-80``).
+
+    Hidden state layout: ``(batch, recurrent_N, hidden)``.  A zero mask at
+    step t resets the hidden state before the cell runs — identical semantics
+    to the reference's ``hxs * masks`` pre-multiply (``rnn.py:27-28,66``).
+    """
+
+    hidden_size: int
+    recurrent_N: int = 1
+
+    def setup(self):
+        self.cells = [
+            nn.GRUCell(
+                self.hidden_size,
+                kernel_init=nn.initializers.orthogonal(),
+                recurrent_kernel_init=nn.initializers.orthogonal(),
+                bias_init=nn.initializers.zeros_init(),
+            )
+            for _ in range(self.recurrent_N)
+        ]
+        self.norm = nn.LayerNorm()
+
+    def __call__(self, x: jax.Array, hxs: jax.Array, masks: jax.Array):
+        """Single step: ``x`` (B, d), ``hxs`` (B, N, h), ``masks`` (B, 1)."""
+        new_h = []
+        for i, cell in enumerate(self.cells):
+            h = hxs[:, i] * masks
+            h, x = cell(h, x)
+            new_h.append(h)
+        return self.norm(x), jnp.stack(new_h, axis=1)
+
+    def run_sequence(self, xs: jax.Array, hxs: jax.Array, masks: jax.Array):
+        """Sequence form: ``xs`` (T, B, d), ``hxs`` (B, N, h), ``masks`` (T, B, 1).
+
+        Returns ``(T, B, h)`` outputs and the final hidden state.  Equivalent
+        to the reference's flattened (T*B) path (``rnn.py:31-74``).
+        """
+
+        def body(h, inp):
+            x_t, m_t = inp
+            out, h = self(x_t, h, m_t)
+            return h, out
+
+        # Plain lax.scan over the bound module: parameters are created by the
+        # single-step path at init time, so apply-time scanning is safe.
+        final_h, outs = jax.lax.scan(body, hxs, (xs, masks))
+        return outs, final_h

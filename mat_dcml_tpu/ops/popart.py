@@ -1,0 +1,64 @@
+"""PopArt: preserve-outputs-precisely value-head rescaling.
+
+The reference has two PopArts: a statistics-only one (``mat/utils/popart.py``,
+identical math to ValueNorm — covered by ``ops/normalize.py``) and the
+output-layer variant (``mat/algorithms/utils/popart.py``) whose ``update``
+both advances the running moments AND rescales the value head's weight/bias so
+denormalized predictions are unchanged (``popart.py:48-70``):
+
+    w' = w * old_std / new_std
+    b' = (old_std * b + old_mean - new_mean) / new_std
+
+Here the head weights live in the critic's params pytree; ``popart_update``
+returns the new statistics plus a function of the head params, applied by the
+trainer — the functional equivalent of the in-place ``nn.Parameter`` mutation.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from mat_dcml_tpu.ops.normalize import (
+    ValueNormState,
+    _debiased_mean_var,
+    value_norm_init,
+    value_norm_update,
+)
+
+PopArtState = ValueNormState  # same running-moment pytree
+
+popart_init = value_norm_init
+
+
+def popart_std_mean(state: PopArtState) -> Tuple[jax.Array, jax.Array]:
+    mean, var = _debiased_mean_var(state)
+    return jnp.sqrt(var), mean
+
+
+def popart_update(
+    state: PopArtState, batch: jax.Array, head_params: dict, beta: float = 0.99999
+) -> Tuple[PopArtState, dict]:
+    """Advance moments from ``batch`` and rescale the Dense head params.
+
+    ``head_params`` is the flax param dict of the critic's ``v_out`` Dense:
+    ``{"kernel": (in, out), "bias": (out,)}``.
+    """
+    old_std, old_mean = popart_std_mean(state)
+    new_state = value_norm_update(state, batch, beta=beta)
+    new_std, new_mean = popart_std_mean(new_state)
+    kernel = head_params["kernel"] * (old_std / new_std)[None, :]
+    bias = (old_std * head_params["bias"] + old_mean - new_mean) / new_std
+    return new_state, {"kernel": kernel, "bias": bias}
+
+
+def popart_normalize(state: PopArtState, x: jax.Array) -> jax.Array:
+    mean, var = _debiased_mean_var(state)
+    return (x - mean) / jnp.sqrt(var)
+
+
+def popart_denormalize(state: PopArtState, x: jax.Array) -> jax.Array:
+    mean, var = _debiased_mean_var(state)
+    return x * jnp.sqrt(var) + mean

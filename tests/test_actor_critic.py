@@ -1,0 +1,201 @@
+"""Tests for the actor-critic stack: bases, ACT layer, Actor/Critic, PopArt.
+
+Reference semantics under test:
+- ACT log-prob layouts per space type (``act.py``): Discrete (B,1), Box (B,d)
+  un-summed, MultiDiscrete (B,heads), mixed DCML (B,1) summed.
+- Mixed-mode slicing: logits come straight from the wide feature vector
+  (``act.py:83-105``) with availability masking per sub-action.
+- GRU mask-gating: zero mask at t resets hidden exactly like ``rnn.py:27-28``.
+- PopArt invariance: rescaled head keeps denormalized outputs unchanged
+  (``algorithms/utils/popart.py:48-70``).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mat_dcml_tpu.envs.spaces import (
+    Box,
+    DCMLActionSpace,
+    Discrete,
+    MultiBinary,
+    MultiDiscrete,
+)
+from mat_dcml_tpu.models.act_layer import ACTLayer
+from mat_dcml_tpu.models.actor_critic import ACConfig, ActorCriticPolicy
+from mat_dcml_tpu.models.bases import GRULayer
+from mat_dcml_tpu.ops.popart import (
+    popart_denormalize,
+    popart_init,
+    popart_update,
+)
+
+B = 6
+
+
+def _run_act(space, feat_dim, avail=None, deterministic=False):
+    layer = ACTLayer(space)
+    x = jax.random.normal(jax.random.key(0), (B, feat_dim))
+    params = layer.init(jax.random.key(1), x, jax.random.key(2), avail, method="sample")
+    action, logp = layer.apply(params, x, jax.random.key(3), avail, deterministic, method="sample")
+    logp_eval, ent = layer.apply(params, x, action, avail, None, method="evaluate")
+    return action, logp, logp_eval, ent
+
+
+class TestACTLayer:
+    def test_discrete_shapes_and_consistency(self):
+        action, logp, logp_eval, ent = _run_act(Discrete(5), 16)
+        assert action.shape == (B, 1) and logp.shape == (B, 1)
+        np.testing.assert_allclose(logp, logp_eval, rtol=1e-5)
+        assert ent.shape == ()
+
+    def test_discrete_availability_mask(self):
+        avail = jnp.zeros((B, 5)).at[:, 2].set(1.0)
+        action, _, _, _ = _run_act(Discrete(5), 16, avail=avail)
+        assert (action[:, 0] == 2).all()
+
+    def test_box_logp_unsummed_per_dim(self):
+        action, logp, logp_eval, _ = _run_act(Box(3), 16)
+        assert action.shape == (B, 3) and logp.shape == (B, 3)
+        np.testing.assert_allclose(logp, logp_eval, rtol=1e-5)
+
+    def test_box_deterministic_is_mean_and_std_bound(self):
+        layer = ACTLayer(Box(2))
+        x = jax.random.normal(jax.random.key(0), (B, 8))
+        params = layer.init(jax.random.key(1), x, jax.random.key(2), None, method="sample")
+        a1, _ = layer.apply(params, x, jax.random.key(3), None, True, method="sample")
+        a2, _ = layer.apply(params, x, jax.random.key(4), None, True, method="sample")
+        np.testing.assert_array_equal(a1, a2)
+        # std = sigmoid(log_std/x_coef)*y_coef with init log_std=1 -> ~0.365
+        std = jax.nn.sigmoid(params["params"]["log_std"]) * 0.5
+        np.testing.assert_allclose(std, 0.3655, atol=1e-3)
+
+    def test_multi_discrete(self):
+        action, logp, logp_eval, _ = _run_act(MultiDiscrete((3, 4, 2)), 16)
+        assert action.shape == (B, 3) and logp.shape == (B, 3)
+        np.testing.assert_allclose(logp, logp_eval, rtol=1e-5)
+
+    def test_multibinary(self):
+        action, logp, logp_eval, _ = _run_act(MultiBinary(4), 16)
+        assert action.shape == (B, 4) and logp.shape == (B, 1)
+        assert set(np.unique(np.asarray(action))) <= {0.0, 1.0}
+        np.testing.assert_allclose(logp, logp_eval, rtol=1e-5)
+
+    def test_dcml_mixed_layout(self):
+        sp = DCMLActionSpace(n=2, n_sub=10, semi_index=-1, mixed=True)
+        feat = sp.mixed_feature_dim
+        assert feat == 21
+        avail = jnp.ones((B, 10, 2))
+        action, logp, logp_eval, ent = _run_act(sp, feat, avail=avail)
+        assert action.shape == (B, 11)     # 10 select bits + ratio
+        assert logp.shape == (B, 1)        # summed (act.py:103)
+        np.testing.assert_allclose(logp, logp_eval, rtol=1e-4)
+        assert np.isfinite(float(ent))
+
+    def test_dcml_mixed_availability(self):
+        sp = DCMLActionSpace(n=2, n_sub=6, semi_index=-1, mixed=True)
+        avail = jnp.ones((B, 6, 2)).at[:, 3, 1].set(0.0)  # agent 3 can only pick 0
+        action, _, _, _ = _run_act(sp, sp.mixed_feature_dim, avail=avail)
+        assert (action[:, 3] == 0).all()
+
+    def test_dcml_extra_is_gaussian(self):
+        sp = DCMLActionSpace(extra=True, semi_index=-1)
+        action, logp, logp_eval, _ = _run_act(sp, 16)
+        assert action.shape == (B, 1) and logp.shape == (B, 1)
+        np.testing.assert_allclose(logp, logp_eval, rtol=1e-5)
+
+
+class TestGRULayer:
+    def test_mask_resets_hidden(self):
+        layer = GRULayer(hidden_size=8, recurrent_N=2)
+        x = jax.random.normal(jax.random.key(0), (B, 8))
+        h = jax.random.normal(jax.random.key(1), (B, 2, 8))
+        mask1 = jnp.ones((B, 1))
+        params = layer.init(jax.random.key(2), x, h, mask1)
+        out_zero_mask, _ = layer.apply(params, x, h, jnp.zeros((B, 1)))
+        out_zero_h, _ = layer.apply(params, x, jnp.zeros_like(h), mask1)
+        np.testing.assert_allclose(out_zero_mask, out_zero_h, rtol=1e-6)
+
+    def test_sequence_matches_stepwise(self):
+        T = 5
+        layer = GRULayer(hidden_size=8, recurrent_N=1)
+        xs = jax.random.normal(jax.random.key(0), (T, B, 8))
+        h0 = jnp.zeros((B, 1, 8))
+        masks = jnp.ones((T, B, 1)).at[2].set(0.0)  # episode break at t=2
+        params = layer.init(jax.random.key(1), xs[0], h0, masks[0])
+        seq_out, seq_h = layer.apply(params, xs, h0, masks, method="run_sequence")
+        h = h0
+        for t in range(T):
+            out_t, h = layer.apply(params, xs[t], h, masks[t])
+            np.testing.assert_allclose(seq_out[t], out_t, rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(seq_h, h, rtol=1e-5, atol=1e-6)
+
+
+class TestActorCriticPolicy:
+    @pytest.mark.parametrize("recurrent", [False, True])
+    def test_rollout_and_evaluate_roundtrip(self, recurrent):
+        cfg = ACConfig(hidden_size=16, use_recurrent_policy=recurrent)
+        pol = ActorCriticPolicy(cfg, obs_dim=7, cent_obs_dim=12, space=Discrete(4))
+        params = pol.init_params(jax.random.key(0))
+        obs = jax.random.normal(jax.random.key(1), (B, 7))
+        cent = jax.random.normal(jax.random.key(2), (B, 12))
+        ah, ch = pol.init_hidden(B)
+        masks = jnp.ones((B, 1))
+        out = pol.get_actions(params, jax.random.key(3), cent, obs, ah, ch, masks)
+        assert out.value.shape == (B, 1)
+        assert out.action.shape == (B, 1)
+        v, logp, ent = pol.evaluate_actions(
+            params, cent, obs, ah, ch, out.action, masks
+        )
+        np.testing.assert_allclose(logp, out.log_prob, rtol=1e-5)
+        np.testing.assert_allclose(v, out.value, rtol=1e-5)
+
+    def test_recurrent_seq_evaluation_matches_stepwise(self):
+        T = 4
+        cfg = ACConfig(hidden_size=16, use_recurrent_policy=True)
+        pol = ActorCriticPolicy(cfg, obs_dim=5, cent_obs_dim=8, space=Discrete(3))
+        params = pol.init_params(jax.random.key(0))
+        obs = jax.random.normal(jax.random.key(1), (T, B, 5))
+        cent = jax.random.normal(jax.random.key(2), (T, B, 8))
+        masks = jnp.ones((T, B, 1)).at[2].set(0.0)
+        ah, ch = pol.init_hidden(B)
+        # stepwise rollout actions
+        actions = []
+        a_h, c_h = ah, ch
+        for t in range(T):
+            out = pol.get_actions(
+                params, jax.random.key(10 + t), cent[t], obs[t], a_h, c_h, masks[t]
+            )
+            a_h, c_h = out.actor_h, out.critic_h
+            actions.append(out.action)
+        actions = jnp.stack(actions)
+        v_seq, logp_seq, _ = pol.evaluate_actions_seq(
+            params, cent, obs, ah, ch, actions, masks
+        )
+        # stepwise evaluation with threaded hidden must match the seq path
+        a_h, c_h = ah, ch
+        for t in range(T):
+            out = pol.get_actions(
+                params, jax.random.key(10 + t), cent[t], obs[t], a_h, c_h, masks[t]
+            )
+            np.testing.assert_allclose(logp_seq[t], out.log_prob, rtol=1e-4, atol=1e-5)
+            np.testing.assert_allclose(v_seq[t], out.value, rtol=1e-4, atol=1e-5)
+            a_h, c_h = out.actor_h, out.critic_h
+
+
+class TestPopArt:
+    def test_update_preserves_denormalized_outputs(self):
+        rng = np.random.default_rng(0)
+        kernel = jnp.asarray(rng.normal(size=(8, 1)), jnp.float32)
+        bias = jnp.asarray(rng.normal(size=(1,)), jnp.float32)
+        head = {"kernel": kernel, "bias": bias}
+        state = popart_init(1)
+        # seed statistics so old_std is nontrivial
+        state, head = popart_update(state, jnp.asarray(rng.normal(size=(32, 1)) * 3 + 2, jnp.float32), head)
+        x = jnp.asarray(rng.normal(size=(5, 8)), jnp.float32)
+        before = popart_denormalize(state, x @ head["kernel"] + head["bias"])
+        batch = jnp.asarray(rng.normal(size=(64, 1)) * 10 - 4, jnp.float32)
+        new_state, new_head = popart_update(state, batch, head)
+        after = popart_denormalize(new_state, x @ new_head["kernel"] + new_head["bias"])
+        np.testing.assert_allclose(before, after, rtol=2e-4, atol=2e-4)
