@@ -1,0 +1,62 @@
+"""Single-agent joint view of the DCML env for centralized PPO.
+
+The reference's ``ppo`` algorithm flattens all DCML agents into ONE decision:
+a 201-wide actor feature vector sliced into 100 select-bit categorical heads +
+a Gaussian coding-ratio tail (``ppo_policy.py`` + the mixed ``Action_Space``
+branch of ``act.py:83-105``), stored in the joint ``SingleReplayBuffer``.
+This adapter exposes that view over the vectorized JAX env: one "agent" whose
+obs is the centralized state and whose action is the joint
+``(100 bits + ratio)`` vector, translated to the per-agent layout the core
+``DCMLEnv.step`` consumes.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from mat_dcml_tpu.envs.dcml.env import DCMLEnv, TimeStep
+from mat_dcml_tpu.envs.spaces import DCMLActionSpace
+
+
+class JointDCMLEnv:
+    """Wraps ``DCMLEnv`` with (A,) -> (1,) agent collapsing."""
+
+    def __init__(self, env: DCMLEnv):
+        self.env = env
+        w = env.n_agents - 1  # worker count
+        self.n_agents = 1
+        self.obs_dim = env.share_obs_dim
+        self.share_obs_dim = env.share_obs_dim
+        self.action_space = DCMLActionSpace(
+            n=env.action_dim, n_sub=w, semi_index=-1, mixed=True,
+            multi_discrete=True, continuous=True,
+        )
+        self.action_dim = self.action_space.sample_dim  # w + 1
+
+    def _wrap_ts(self, ts: TimeStep) -> TimeStep:
+        w = self.env.n_agents - 1
+        share = ts.share_obs[:1]                       # (1, sob)
+        avail = ts.available_actions[None, :w, :]      # (1, w, 2)
+        return TimeStep(
+            obs=share,
+            share_obs=share,
+            available_actions=avail,
+            reward=ts.reward[:1],
+            done=ts.done[:1],
+            delay=ts.delay,
+            payment=ts.payment,
+        )
+
+    def reset(self, key: jax.Array, episode_idx=0):
+        state, ts = self.env.reset(key, episode_idx)
+        return state, self._wrap_ts(ts)
+
+    def step(self, state, action: jax.Array):
+        # action: (1, w + 1) joint -> per-agent (A, 1)
+        joint = action[0]
+        per_agent = joint[:, None]                     # (A, 1): bits then ratio
+        state, ts = self.env.step(state, per_agent)
+        return state, self._wrap_ts(ts)

@@ -1,0 +1,91 @@
+"""Tiny pure-JAX multi-agent env for tests and examples.
+
+``MatchingEnv``: each agent sees a one-hot target in its obs and gets reward 1
+for picking the matching discrete action, 0 otherwise.  Episodes end every
+``horizon`` steps.  Implements the same TimeStep protocol as the DCML env
+(``envs/dcml/env.py``) so every collector/trainer runs on it unchanged — the
+role the reference's MPE simple_spread plays as "smallest second env"
+(SURVEY.md §7.8), but closed-form learnable so trainer tests can assert
+reward improvement in seconds.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+class ToyState(NamedTuple):
+    rng: jax.Array
+    targets: jax.Array       # (A,) int32
+    t: jax.Array             # int32 step counter
+
+
+class ToyTimeStep(NamedTuple):
+    obs: jax.Array
+    share_obs: jax.Array
+    available_actions: jax.Array
+    reward: jax.Array
+    done: jax.Array
+    delay: jax.Array
+    payment: jax.Array
+
+
+@dataclasses.dataclass(frozen=True)
+class MatchingEnvConfig:
+    n_agents: int = 3
+    n_actions: int = 4
+    horizon: int = 10
+
+
+class MatchingEnv:
+    def __init__(self, cfg: MatchingEnvConfig = MatchingEnvConfig()):
+        self.cfg = cfg
+        self.n_agents = cfg.n_agents
+        self.obs_dim = cfg.n_actions
+        self.share_obs_dim = cfg.n_actions * cfg.n_agents
+        self.action_dim = cfg.n_actions
+
+    def _observe(self, state: ToyState) -> Tuple[jax.Array, jax.Array, jax.Array]:
+        c = self.cfg
+        obs = jax.nn.one_hot(state.targets, c.n_actions)
+        share = jnp.broadcast_to(obs.reshape(-1), (c.n_agents, self.share_obs_dim))
+        avail = jnp.ones((c.n_agents, c.n_actions))
+        return obs, share, avail
+
+    def reset(self, key: jax.Array, episode_idx=0) -> Tuple[ToyState, ToyTimeStep]:
+        del episode_idx
+        key, k = jax.random.split(key)
+        targets = jax.random.randint(k, (self.cfg.n_agents,), 0, self.cfg.n_actions)
+        state = ToyState(key, targets, jnp.zeros((), jnp.int32))
+        obs, share, avail = self._observe(state)
+        zero = jnp.zeros(())
+        ts = ToyTimeStep(
+            obs, share, avail,
+            jnp.zeros((self.cfg.n_agents, 1)),
+            jnp.zeros((self.cfg.n_agents,), bool),
+            zero, zero,
+        )
+        return state, ts
+
+    def step(self, state: ToyState, action: jax.Array) -> Tuple[ToyState, ToyTimeStep]:
+        c = self.cfg
+        act = action[..., 0].astype(jnp.int32)
+        hit = (act == state.targets).astype(jnp.float32)
+        reward = jnp.broadcast_to(hit.mean(), (c.n_agents, 1))
+        t = state.t + 1
+        done_now = t >= c.horizon
+        key, k_targets = jax.random.split(state.rng)
+        new_targets = jax.random.randint(k_targets, (c.n_agents,), 0, c.n_actions)
+        state = ToyState(
+            rng=key,
+            targets=new_targets,
+            t=jnp.where(done_now, 0, t),
+        )
+        obs, share, avail = self._observe(state)
+        done = jnp.broadcast_to(done_now, (c.n_agents,))
+        zero = jnp.zeros(())
+        return state, ToyTimeStep(obs, share, avail, reward, done, zero, zero)

@@ -94,6 +94,16 @@ class ACTLayer(nn.Module):
                 self.log_std = self.param(
                     "log_std", lambda k: jnp.ones((sp.cont_dim,)) * self.std_x_coef
                 )
+            elif sp.multi_discrete:
+                # The reference's Action_Space MULTI_DISCRETE branch
+                # (act.py:36-43) iterates a scalar ``high - low`` and cannot
+                # construct — a latent defect, not a working mode.  Refuse
+                # loudly instead of silently building a single head.
+                raise NotImplementedError(
+                    "DCMLActionSpace(multi_discrete=True) without mixed=True has "
+                    "no working reference semantics; use MultiDiscrete(nvec) or "
+                    "mixed=True"
+                )
             else:
                 self.action_head = _head(sp.n)
         else:
@@ -160,7 +170,6 @@ class ACTLayer(nn.Module):
         # DCML mixed: slice n_sub categorical groups + Gaussian tail
         # (act.py:83-105).
         assert isinstance(sp, DCMLActionSpace) and sp.mixed
-        B = x.shape[0]
         disc_logits = x[..., : sp.n_sub * sp.n].reshape(*x.shape[:-1], sp.n_sub, sp.n)
         if available_actions is not None:
             disc_logits = D.mask_logits(disc_logits, available_actions[..., : sp.n_sub, :])
@@ -230,9 +239,7 @@ class ACTLayer(nn.Module):
         disc_logits = x[..., : sp.n_sub * sp.n].reshape(*x.shape[:-1], sp.n_sub, sp.n)
         if available_actions is not None:
             disc_logits = D.mask_logits(disc_logits, available_actions[..., : sp.n_sub, :])
-        logp_disc = jnp.take_along_axis(
-            jax.nn.log_softmax(disc_logits, -1), a_disc[..., None], axis=-1
-        )[..., 0]                                                      # (B, n_sub)
+        logp_disc = D.categorical_log_prob(disc_logits, a_disc)        # (B, n_sub)
         ent_disc = _masked_mean(D.categorical_entropy(disc_logits).mean(-1), active_masks)
         mean = x[..., sp.n_sub * sp.n :]
         std = self._mixed_std()

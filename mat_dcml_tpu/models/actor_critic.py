@@ -43,7 +43,6 @@ class ACConfig:
     recurrent_N: int = 1
     std_x_coef: float = 1.0
     std_y_coef: float = 0.5
-    use_popart: bool = False
     image_obs: bool = False
 
 

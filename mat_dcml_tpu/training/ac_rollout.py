@@ -1,0 +1,155 @@
+"""On-device rollout collection for actor-critic (non-MAT) policies.
+
+Counterpart of ``training/rollout.py`` for the MAPPO/IPPO/PPO/HAPPO families:
+additionally threads and stores per-step actor/critic GRU hidden states the
+way the reference buffers do (``shared_buffer.py:60-66``,
+``separated_buffer.py:56-62``), so recurrent training can re-run sequences
+from stored chunk-start states (``separated_buffer.py:236-430``).
+
+Works with any env exposing the DCML TimeStep protocol:
+``reset(key, episode_idx) -> (state, ts)``, ``step(state, action) ->
+(state, ts)`` with ``ts = (obs, share_obs, available_actions, reward, done,
+...)``.  Policies see flattened ``(E * A, d)`` rows — the reference's
+(threads x agents) layout (``rMAPPOPolicy.py`` call sites in
+``base_runner.py``).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from mat_dcml_tpu.models.actor_critic import ActorCriticPolicy
+
+
+class ACTrajectory(NamedTuple):
+    """Time-major rollout chunk ``(T, E, A, d)`` (+ hidden states)."""
+
+    share_obs: jax.Array
+    obs: jax.Array
+    available_actions: jax.Array
+    actions: jax.Array
+    log_probs: jax.Array
+    values: jax.Array
+    rewards: jax.Array
+    masks: jax.Array             # (T+1, E, A, 1)
+    active_masks: jax.Array      # (T+1, E, A, 1)
+    actor_h: jax.Array           # (T, E, A, N, h) hidden entering each step
+    critic_h: jax.Array
+    dones: jax.Array             # (T, E)
+
+
+class ACRolloutState(NamedTuple):
+    env_states: NamedTuple
+    obs: jax.Array
+    share_obs: jax.Array
+    available_actions: jax.Array
+    mask: jax.Array              # (E, A, 1)
+    actor_h: jax.Array           # (E, A, N, h)
+    critic_h: jax.Array
+    rng: jax.Array
+
+
+def _rows(x: jax.Array) -> jax.Array:
+    """(E, A, ...) -> (E*A, ...)."""
+    return x.reshape(x.shape[0] * x.shape[1], *x.shape[2:])
+
+
+def _unrows(x: jax.Array, E: int, A: int) -> jax.Array:
+    return x.reshape(E, A, *x.shape[1:])
+
+
+class ACRolloutCollector:
+    def __init__(self, env, policy: ActorCriticPolicy, episode_length: int,
+                 use_local_value: bool = False):
+        """``use_local_value=True`` feeds the critic local obs instead of the
+        shared state — the IPPO decentralized-V configuration
+        (``ippo_policy.py:13-29``)."""
+        self.env = env
+        self.policy = policy
+        self.T = episode_length
+        self.use_local_value = use_local_value
+
+    def _cent(self, st: ACRolloutState) -> jax.Array:
+        return st.obs if self.use_local_value else st.share_obs
+
+    def init_state(self, key: jax.Array, n_envs: int) -> ACRolloutState:
+        key, k_reset = jax.random.split(key)
+        keys = jax.random.split(k_reset, n_envs)
+        env_states, ts = jax.vmap(self.env.reset)(keys, jnp.zeros(n_envs, jnp.int32))
+        E, A = ts.obs.shape[0], ts.obs.shape[1]
+        ah, ch = self.policy.init_hidden(E * A)
+        return ACRolloutState(
+            env_states=env_states,
+            obs=ts.obs,
+            share_obs=ts.share_obs,
+            available_actions=ts.available_actions,
+            mask=jnp.ones((E, A, 1), jnp.float32),
+            actor_h=_unrows(ah, E, A),
+            critic_h=_unrows(ch, E, A),
+            rng=key,
+        )
+
+    def collect(self, params, rollout_state: ACRolloutState) -> Tuple[ACRolloutState, ACTrajectory]:
+        E, A = rollout_state.obs.shape[:2]
+
+        def body(st: ACRolloutState, _):
+            key, k_act = jax.random.split(st.rng)
+            out = self.policy.get_actions(
+                params, k_act, _rows(self._cent(st)), _rows(st.obs),
+                _rows(st.actor_h), _rows(st.critic_h), _rows(st.mask),
+                _rows(st.available_actions),
+            )
+            action_env = _unrows(out.action, E, A)
+            env_states, ts = jax.vmap(self.env.step)(st.env_states, action_env)
+            done_env = ts.done.all(axis=1)
+            next_mask = jnp.broadcast_to(
+                jnp.where(done_env[:, None, None], 0.0, 1.0), st.mask.shape
+            )
+            transition = dict(
+                share_obs=self._cent(st),
+                obs=st.obs,
+                available_actions=st.available_actions,
+                actions=action_env,
+                log_probs=_unrows(out.log_prob, E, A),
+                values=_unrows(out.value, E, A),
+                rewards=ts.reward,
+                next_mask=next_mask,
+                actor_h=st.actor_h,
+                critic_h=st.critic_h,
+                done=done_env,
+            )
+            # Hidden states reset via the mask multiply inside the GRU on the
+            # *next* step (rnn.py:27-28); store post-step states as-is.
+            new_st = ACRolloutState(
+                env_states=env_states,
+                obs=ts.obs,
+                share_obs=ts.share_obs,
+                available_actions=ts.available_actions,
+                mask=next_mask,
+                actor_h=_unrows(out.actor_h, E, A),
+                critic_h=_unrows(out.critic_h, E, A),
+                rng=key,
+            )
+            return new_st, transition
+
+        final_state, tr = jax.lax.scan(body, rollout_state, None, length=self.T)
+        masks = jnp.concatenate([rollout_state.mask[None], tr["next_mask"]], axis=0)
+        active = jnp.ones_like(masks)
+        traj = ACTrajectory(
+            share_obs=tr["share_obs"],
+            obs=tr["obs"],
+            available_actions=tr["available_actions"],
+            actions=tr["actions"],
+            log_probs=tr["log_probs"],
+            values=tr["values"],
+            rewards=tr["rewards"],
+            masks=masks,
+            active_masks=active,
+            actor_h=tr["actor_h"],
+            critic_h=tr["critic_h"],
+            dones=tr["done"],
+        )
+        return final_state, traj

@@ -1,0 +1,141 @@
+"""Independent PPO: per-agent parameters, decentralized value functions.
+
+Reference: ``ippo/ippo_policy.py`` + ``ippo/ippo_trainer.py`` — one policy
+(actor + critic on *local* obs) per agent, each trained on its own slice of
+the shared rollout via separated buffers (``base_runner.py:120-140``).
+
+TPU-native shape: agent parameters are stacked along a leading axis and the
+whole MAPPO update is ``vmap``-ped over it — the reference's Python loop over
+``trainer[agent].train(buffer[agent])`` becomes one batched program.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from mat_dcml_tpu.models.actor_critic import ActorCriticPolicy
+from mat_dcml_tpu.training.ac_rollout import ACTrajectory
+from mat_dcml_tpu.training.mappo import (
+    Bootstrap,
+    MAPPOConfig,
+    MAPPOMetrics,
+    MAPPOTrainer,
+    MAPPOTrainState,
+)
+
+
+class IPPORolloutCollector:
+    """Rollout collection with *per-agent* stacked params: each agent's own
+    actor/critic act on its slice, the reference's per-agent policy list
+    (``base_runner.py:120-140``) collapsed into one vmapped apply.
+
+    IPPO is decentralized-V: the critic consumes local obs
+    (``ippo_policy.py:13-29``), so ``share_obs`` stored in the trajectory is
+    the local obs too.
+    """
+
+    def __init__(self, env, policy: ActorCriticPolicy, episode_length: int):
+        self.env = env
+        self.policy = policy
+        self.T = episode_length
+        self.use_local_value = True
+
+    def init_state(self, key: jax.Array, n_envs: int):
+        from mat_dcml_tpu.training.ac_rollout import ACRolloutCollector
+
+        return ACRolloutCollector(self.env, self.policy, self.T, True).init_state(key, n_envs)
+
+    def collect(self, stacked_params, rs):
+        from mat_dcml_tpu.training.ac_rollout import ACRolloutState, ACTrajectory
+
+        pol = self.policy
+
+        def body(st: ACRolloutState, _):
+            key, k_act = jax.random.split(st.rng)
+            A = st.obs.shape[1]
+            keys = jax.random.split(k_act, A)
+            out = jax.vmap(pol.get_actions, in_axes=(0, 0, 1, 1, 1, 1, 1, 1), out_axes=1)(
+                stacked_params, keys, st.obs, st.obs, st.actor_h, st.critic_h,
+                st.mask, st.available_actions,
+            )
+            env_states, ts = jax.vmap(self.env.step)(st.env_states, out.action)
+            done_env = ts.done.all(axis=1)
+            next_mask = jnp.broadcast_to(
+                jnp.where(done_env[:, None, None], 0.0, 1.0), st.mask.shape
+            )
+            tr = dict(
+                share_obs=st.obs, obs=st.obs,
+                available_actions=st.available_actions,
+                actions=out.action, log_probs=out.log_prob, values=out.value,
+                rewards=ts.reward, next_mask=next_mask,
+                actor_h=st.actor_h, critic_h=st.critic_h, done=done_env,
+            )
+            new_st = st._replace(
+                env_states=env_states, obs=ts.obs, share_obs=ts.share_obs,
+                available_actions=ts.available_actions, mask=next_mask,
+                actor_h=out.actor_h, critic_h=out.critic_h, rng=key,
+            )
+            return new_st, tr
+
+        final, tr = jax.lax.scan(body, rs, None, length=self.T)
+        masks = jnp.concatenate([rs.mask[None], tr["next_mask"]], axis=0)
+        traj = ACTrajectory(
+            share_obs=tr["share_obs"], obs=tr["obs"],
+            available_actions=tr["available_actions"], actions=tr["actions"],
+            log_probs=tr["log_probs"], values=tr["values"], rewards=tr["rewards"],
+            masks=masks, active_masks=jnp.ones_like(masks),
+            actor_h=tr["actor_h"], critic_h=tr["critic_h"], dones=tr["done"],
+        )
+        return final, traj
+
+
+class IPPOTrainer:
+    """vmapped per-agent MAPPO.  ``policy`` is the *single-agent* template;
+    params/opt-state pytrees carry a leading agent axis."""
+
+    def __init__(self, policy: ActorCriticPolicy, cfg: MAPPOConfig, n_agents: int):
+        # IPPO importance weights use the prod convention (ippo_trainer.py:128).
+        self.inner = MAPPOTrainer(policy, cfg)
+        self.n_agents = n_agents
+
+    def init_params(self, key: jax.Array):
+        keys = jax.random.split(key, self.n_agents)
+        return jax.vmap(self.inner.policy.init_params)(keys)
+
+    def init_state(self, stacked_params) -> MAPPOTrainState:
+        return jax.vmap(self.inner.init_state)(stacked_params)
+
+    def train(self, state: MAPPOTrainState, traj: ACTrajectory, boot: Bootstrap,
+              key: jax.Array) -> Tuple[MAPPOTrainState, MAPPOMetrics]:
+        A = traj.rewards.shape[2]
+        assert A == self.n_agents
+
+        def slice_traj(x):
+            # (T, E, A, ...) -> (A, T, E, 1, ...): agent axis first, singleton
+            # kept so the inner single-policy trainer sees its 4D layout.
+            return jnp.moveaxis(x, 2, 0)[:, :, :, None]
+
+        traj_a = ACTrajectory(
+            share_obs=slice_traj(traj.share_obs),
+            obs=slice_traj(traj.obs),
+            available_actions=slice_traj(traj.available_actions),
+            actions=slice_traj(traj.actions),
+            log_probs=slice_traj(traj.log_probs),
+            values=slice_traj(traj.values),
+            rewards=slice_traj(traj.rewards),
+            masks=slice_traj(traj.masks),
+            active_masks=slice_traj(traj.active_masks),
+            actor_h=slice_traj(traj.actor_h),
+            critic_h=slice_traj(traj.critic_h),
+            dones=jnp.broadcast_to(traj.dones, (A, *traj.dones.shape)),
+        )
+        boot_a = Bootstrap(
+            cent_obs=jnp.moveaxis(boot.cent_obs, 1, 0)[:, :, None],
+            critic_h=jnp.moveaxis(boot.critic_h, 1, 0)[:, :, None],
+            mask=jnp.moveaxis(boot.mask, 1, 0)[:, :, None],
+        )
+        keys = jax.random.split(key, A)
+        return jax.vmap(self.inner.train)(state, traj_a, boot_a, keys)

@@ -1,0 +1,354 @@
+"""PPO trainer for the actor-critic families (MAPPO / IPPO / centralized PPO).
+
+Reference: ``r_mappo/r_mappo.py`` (shared recurrent MAPPO), ``ppo/ppo_trainer.py``
+(centralized joint PPO), ``ippo/ippo_trainer.py`` (independent PPO).  All three
+share one update shape; the differences are flags here:
+
+- ``importance_prod``: r_mappo uses elementwise ``exp(logp - old)`` summed
+  after the clip (``r_mappo.py:124-134``); ppo/happo take the *product* over
+  action dims first (``ppo_trainer.py:128``).
+- ``use_popart``: value targets normalized by the output-layer PopArt, whose
+  ``update`` also rescales the critic head weights (``algorithms/utils/
+  popart.py:48-70``) — here applied functionally to the params pytree.
+- separate actor/critic optimizers with ``lr`` / ``critic_lr``
+  (``ppo_policy.py``, ``rMAPPOPolicy.py``).
+- recurrent training re-runs GRU sequences from stored chunk-start hidden
+  states (``separated_buffer.py:236-430`` recurrent generator, chunk length
+  ``data_chunk_length``).
+
+Unlike the MAT trainer (which reproduces the reference's per-epoch return
+recomputation), the AC families compute returns ONCE per update — matching
+``base_runner.train:329-435``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from mat_dcml_tpu.models.actor_critic import ActorCriticPolicy
+from mat_dcml_tpu.ops.distributions import huber_loss
+from mat_dcml_tpu.ops.gae import compute_gae
+from mat_dcml_tpu.ops.normalize import (
+    ValueNormState,
+    value_norm_denormalize,
+    value_norm_init,
+    value_norm_normalize,
+    value_norm_update,
+)
+from mat_dcml_tpu.ops.popart import (
+    popart_denormalize,
+    popart_normalize,
+    popart_update,
+)
+from mat_dcml_tpu.training.ac_rollout import ACTrajectory
+
+
+@dataclasses.dataclass(frozen=True)
+class MAPPOConfig:
+    """Defaults follow ``config.py`` (lr 5e-4 group, ppo group)."""
+
+    lr: float = 5e-4
+    critic_lr: float = 5e-4
+    opti_eps: float = 1e-5
+    weight_decay: float = 0.0
+    clip_param: float = 0.2
+    ppo_epoch: int = 15
+    num_mini_batch: int = 1
+    entropy_coef: float = 0.01
+    value_loss_coef: float = 1.0
+    max_grad_norm: float = 10.0
+    gamma: float = 0.99
+    gae_lambda: float = 0.95
+    huber_delta: float = 10.0
+    use_clipped_value_loss: bool = True
+    use_huber_loss: bool = True
+    use_popart: bool = False
+    use_valuenorm: bool = True
+    use_value_active_masks: bool = True
+    use_policy_active_masks: bool = True
+    use_max_grad_norm: bool = True
+    importance_prod: bool = False
+    use_recurrent_policy: bool = False
+    data_chunk_length: int = 10
+
+
+class Bootstrap(NamedTuple):
+    """Inputs for the next-value bootstrap (the tail of the rollout)."""
+
+    cent_obs: jax.Array      # (E, A, d)
+    critic_h: jax.Array      # (E, A, N, h)
+    mask: jax.Array          # (E, A, 1)
+
+
+class MAPPOTrainState(NamedTuple):
+    params: dict
+    actor_opt: optax.OptState
+    critic_opt: optax.OptState
+    value_norm: ValueNormState
+    update_step: jax.Array
+
+
+class MAPPOMetrics(NamedTuple):
+    value_loss: jax.Array
+    policy_loss: jax.Array
+    dist_entropy: jax.Array
+    actor_grad_norm: jax.Array
+    critic_grad_norm: jax.Array
+    ratio: jax.Array
+
+
+def _rows(x):
+    return x.reshape(-1, *x.shape[2:])
+
+
+class MAPPOTrainer:
+    def __init__(self, policy: ActorCriticPolicy, cfg: MAPPOConfig):
+        self.policy = policy
+        self.cfg = cfg
+
+        def make_tx(lr):
+            tx = optax.adam(lr, eps=cfg.opti_eps)
+            if cfg.weight_decay:
+                tx = optax.chain(optax.add_decayed_weights(cfg.weight_decay), tx)
+            if cfg.use_max_grad_norm:
+                tx = optax.chain(optax.clip_by_global_norm(cfg.max_grad_norm), tx)
+            return tx
+
+        self.actor_tx = make_tx(cfg.lr)
+        self.critic_tx = make_tx(cfg.critic_lr)
+
+    def init_state(self, params) -> MAPPOTrainState:
+        return MAPPOTrainState(
+            params=params,
+            actor_opt=self.actor_tx.init(params["actor"]),
+            critic_opt=self.critic_tx.init(params["critic"]),
+            value_norm=value_norm_init(1),
+            update_step=jnp.zeros((), jnp.int32),
+        )
+
+    # ----------------------------------------------------------------- helpers
+
+    def _denorm(self, vn: ValueNormState, x):
+        if self.cfg.use_popart:
+            return popart_denormalize(vn, x)
+        if self.cfg.use_valuenorm:
+            return value_norm_denormalize(vn, x)
+        return x
+
+    def _value_loss(self, values, old_values, ret_norm, active):
+        cfg = self.cfg
+        v_clipped = old_values + jnp.clip(values - old_values, -cfg.clip_param, cfg.clip_param)
+        err_clipped = ret_norm - v_clipped
+        err_orig = ret_norm - values
+        if cfg.use_huber_loss:
+            vl_c, vl_o = huber_loss(err_clipped, cfg.huber_delta), huber_loss(err_orig, cfg.huber_delta)
+        else:
+            vl_c, vl_o = 0.5 * err_clipped**2, 0.5 * err_orig**2
+        vl = jnp.maximum(vl_o, vl_c) if cfg.use_clipped_value_loss else vl_o
+        if cfg.use_value_active_masks:
+            return (vl * active).sum() / active.sum()
+        return vl.mean()
+
+    def _policy_loss(self, logp, old_logp, adv, active):
+        cfg = self.cfg
+        delta = logp - old_logp
+        if cfg.importance_prod:
+            ratio = jnp.exp(delta.sum(-1, keepdims=True))  # prod(exp) == exp(sum)
+        else:
+            ratio = jnp.exp(delta)
+        surr1 = ratio * adv
+        surr2 = jnp.clip(ratio, 1.0 - cfg.clip_param, 1.0 + cfg.clip_param) * adv
+        surr = jnp.minimum(surr1, surr2).sum(-1, keepdims=True)
+        if cfg.use_policy_active_masks:
+            return -(surr * active).sum() / active.sum(), ratio
+        return -surr.mean(), ratio
+
+    def _compute_targets(self, state: MAPPOTrainState, traj: ACTrajectory, boot: Bootstrap):
+        next_v = self.policy.get_values(
+            state.params, _rows(boot.cent_obs), _rows(boot.critic_h), _rows(boot.mask)
+        ).reshape(1, *traj.values.shape[1:])
+        values_all = self._denorm(state.value_norm, jnp.concatenate([traj.values, next_v], 0))
+        adv, returns = compute_gae(
+            traj.rewards, values_all, traj.masks, self.cfg.gamma, self.cfg.gae_lambda
+        )
+        active = traj.active_masks[:-1]
+        denom = active.sum()
+        mean = (adv * active).sum() / denom
+        var = (((adv - mean) ** 2) * active).sum() / denom
+        adv_norm = (adv - mean) / (jnp.sqrt(var) + 1e-5)
+        return adv_norm, returns
+
+    def _normalize_targets(self, value_norm, params, ret_b):
+        """ValueNorm/PopArt update-then-normalize; PopArt also rescales the
+        critic head in params (``r_mappo.py:52-89`` + ``popart.py:48-70``)."""
+        cfg = self.cfg
+        flat_ret = ret_b.reshape(-1, ret_b.shape[-1])
+        if cfg.use_popart:
+            head = params["critic"]["params"]["v_out"]
+            value_norm, new_head = popart_update(value_norm, flat_ret, head)
+            params = jax.tree_util.tree_map(lambda x: x, params)  # shallow copy via pytree
+            critic = dict(params["critic"])
+            inner = dict(critic["params"])
+            inner["v_out"] = new_head
+            critic["params"] = inner
+            params = {**params, "critic": critic}
+            return value_norm, params, popart_normalize(value_norm, ret_b)
+        if cfg.use_valuenorm:
+            value_norm = value_norm_update(value_norm, flat_ret)
+            return value_norm, params, value_norm_normalize(value_norm, ret_b)
+        return value_norm, params, ret_b
+
+    # ------------------------------------------------------------------- train
+
+    def train(self, state: MAPPOTrainState, traj: ACTrajectory, boot: Bootstrap,
+              key: jax.Array) -> Tuple[MAPPOTrainState, MAPPOMetrics]:
+        adv, returns = self._compute_targets(state, traj, boot)
+        if self.cfg.use_recurrent_policy:
+            return self._train_recurrent(state, traj, adv, returns, key)
+        return self._train_ff(state, traj, adv, returns, key)
+
+    def _apply_updates(self, params, grads, actor_opt, critic_opt):
+        a_up, actor_opt = self.actor_tx.update(grads["actor"], actor_opt, params["actor"])
+        c_up, critic_opt = self.critic_tx.update(grads["critic"], critic_opt, params["critic"])
+        params = {
+            "actor": optax.apply_updates(params["actor"], a_up),
+            "critic": optax.apply_updates(params["critic"], c_up),
+        }
+        return params, actor_opt, critic_opt, optax.global_norm(grads["actor"]), optax.global_norm(grads["critic"])
+
+    def _train_ff(self, state, traj, adv, returns, key):
+        cfg = self.cfg
+        T, E, A = traj.rewards.shape[:3]
+        n_rows = T * E * A
+        mb_size = n_rows // cfg.num_mini_batch
+        flat = {
+            "cent_obs": traj.share_obs.reshape(n_rows, -1),
+            "obs": traj.obs.reshape(n_rows, -1),
+            "avail": traj.available_actions.reshape(n_rows, *traj.available_actions.shape[3:]),
+            "actions": traj.actions.reshape(n_rows, -1),
+            "log_probs": traj.log_probs.reshape(n_rows, -1),
+            "values": traj.values.reshape(n_rows, -1),
+            "active": traj.active_masks[:-1].reshape(n_rows, -1),
+            "masks": traj.masks[:-1].reshape(n_rows, -1),
+            "actor_h": traj.actor_h.reshape(n_rows, *traj.actor_h.shape[3:]),
+            "critic_h": traj.critic_h.reshape(n_rows, *traj.critic_h.shape[3:]),
+            "adv": adv.reshape(n_rows, -1),
+            "returns": returns.reshape(n_rows, -1),
+        }
+
+        def ppo_update(carry, mb_idx):
+            params, actor_opt, critic_opt, value_norm = carry
+            b = jax.tree.map(lambda x: x[mb_idx], flat)
+            value_norm, params, ret_norm = self._normalize_targets(value_norm, params, b["returns"])
+
+            def loss_fn(p):
+                values, logp, ent = self.policy.evaluate_actions(
+                    p, b["cent_obs"], b["obs"], b["actor_h"], b["critic_h"],
+                    b["actions"], b["masks"], b["avail"], b["active"],
+                )
+                policy_loss, ratio = self._policy_loss(logp, b["log_probs"], b["adv"], b["active"])
+                value_loss = self._value_loss(values, b["values"], ret_norm, b["active"])
+                total = policy_loss - ent * cfg.entropy_coef + value_loss * cfg.value_loss_coef
+                return total, (value_loss, policy_loss, ent, ratio)
+
+            (_, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+            params, actor_opt, critic_opt, a_gn, c_gn = self._apply_updates(
+                params, grads, actor_opt, critic_opt
+            )
+            vl, pl, ent, ratio = aux
+            return (params, actor_opt, critic_opt, value_norm), MAPPOMetrics(
+                vl, pl, ent, a_gn, c_gn, ratio.mean()
+            )
+
+        def epoch(carry, key_e):
+            perm = jax.random.permutation(key_e, n_rows)
+            mb_idxs = perm[: mb_size * cfg.num_mini_batch].reshape(cfg.num_mini_batch, mb_size)
+            return jax.lax.scan(ppo_update, carry, mb_idxs)
+
+        keys = jax.random.split(key, cfg.ppo_epoch)
+        carry = (state.params, state.actor_opt, state.critic_opt, state.value_norm)
+        (params, actor_opt, critic_opt, value_norm), metrics = jax.lax.scan(epoch, carry, keys)
+        new_state = MAPPOTrainState(params, actor_opt, critic_opt, value_norm, state.update_step + 1)
+        return new_state, jax.tree.map(lambda m: m.mean(), metrics)
+
+    def _train_recurrent(self, state, traj, adv, returns, key):
+        """Chunked-sequence training (``separated_buffer.py:320-430``)."""
+        cfg = self.cfg
+        T, E, A = traj.rewards.shape[:3]
+        L = cfg.data_chunk_length
+        assert T % L == 0, f"episode_length {T} must be divisible by data_chunk_length {L}"
+        nC = T // L
+        n_items = nC * E * A
+        mb_size = n_items // cfg.num_mini_batch
+
+        def to_chunks(x):
+            # (T, E, A, ...) -> (n_items, L, ...)
+            y = x.reshape(nC, L, E, A, *x.shape[3:])
+            y = jnp.moveaxis(y, 1, 3)          # (nC, E, A, L, ...)
+            return y.reshape(n_items, L, *x.shape[3:])
+
+        def chunk_starts(x):
+            # hidden at chunk start: x[(c*L)] per env/agent -> (n_items, ...)
+            y = x[::L]                          # (nC, E, A, ...)
+            return y.reshape(n_items, *x.shape[3:])
+
+        data = {
+            "cent_obs": to_chunks(traj.share_obs),
+            "obs": to_chunks(traj.obs),
+            "avail": to_chunks(traj.available_actions),
+            "actions": to_chunks(traj.actions),
+            "log_probs": to_chunks(traj.log_probs),
+            "values": to_chunks(traj.values),
+            "active": to_chunks(traj.active_masks[:-1]),
+            "masks": to_chunks(traj.masks[:-1]),
+            "adv": to_chunks(adv),
+            "returns": to_chunks(returns),
+            "actor_h0": chunk_starts(traj.actor_h),
+            "critic_h0": chunk_starts(traj.critic_h),
+        }
+
+        def seq(x):
+            # (mb, L, ...) -> (L, mb, ...)
+            return jnp.swapaxes(x, 0, 1)
+
+        def ppo_update(carry, mb_idx):
+            params, actor_opt, critic_opt, value_norm = carry
+            b = jax.tree.map(lambda x: x[mb_idx], data)
+            value_norm, params, ret_norm = self._normalize_targets(value_norm, params, b["returns"])
+
+            def loss_fn(p):
+                values, logp, ent = self.policy.evaluate_actions_seq(
+                    p, seq(b["cent_obs"]), seq(b["obs"]), b["actor_h0"], b["critic_h0"],
+                    seq(b["actions"]), seq(b["masks"]), seq(b["avail"]), seq(b["active"]),
+                )
+                policy_loss, ratio = self._policy_loss(
+                    logp, seq(b["log_probs"]), seq(b["adv"]), seq(b["active"])
+                )
+                value_loss = self._value_loss(values, seq(b["values"]), seq(ret_norm), seq(b["active"]))
+                total = policy_loss - ent * cfg.entropy_coef + value_loss * cfg.value_loss_coef
+                return total, (value_loss, policy_loss, ent, ratio)
+
+            (_, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+            params, actor_opt, critic_opt, a_gn, c_gn = self._apply_updates(
+                params, grads, actor_opt, critic_opt
+            )
+            vl, pl, ent, ratio = aux
+            return (params, actor_opt, critic_opt, value_norm), MAPPOMetrics(
+                vl, pl, ent, a_gn, c_gn, ratio.mean()
+            )
+
+        def epoch(carry, key_e):
+            perm = jax.random.permutation(key_e, n_items)
+            mb_idxs = perm[: mb_size * cfg.num_mini_batch].reshape(cfg.num_mini_batch, mb_size)
+            return jax.lax.scan(ppo_update, carry, mb_idxs)
+
+        keys = jax.random.split(key, cfg.ppo_epoch)
+        carry = (state.params, state.actor_opt, state.critic_opt, state.value_norm)
+        (params, actor_opt, critic_opt, value_norm), metrics = jax.lax.scan(epoch, carry, keys)
+        new_state = MAPPOTrainState(params, actor_opt, critic_opt, value_norm, state.update_step + 1)
+        return new_state, jax.tree.map(lambda m: m.mean(), metrics)

@@ -1,0 +1,137 @@
+"""Functional tests for the actor-critic PPO family (MAPPO / IPPO).
+
+Uses the closed-form-learnable MatchingEnv: reward is 1 when an agent picks
+the action its one-hot obs encodes, so a correct PPO implementation must push
+mean reward well above the 1/n_actions random baseline within a few updates.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mat_dcml_tpu.envs.spaces import Discrete
+from mat_dcml_tpu.envs.toy import MatchingEnv, MatchingEnvConfig
+from mat_dcml_tpu.models.actor_critic import ACConfig, ActorCriticPolicy
+from mat_dcml_tpu.training.ac_rollout import ACRolloutCollector
+from mat_dcml_tpu.training.ippo import IPPOTrainer
+from mat_dcml_tpu.training.mappo import Bootstrap, MAPPOConfig, MAPPOTrainer
+
+E = 16
+T = 10
+
+
+def _setup(recurrent=False, popart=False, valuenorm=True, local_value=False):
+    env = MatchingEnv(MatchingEnvConfig(n_agents=3, n_actions=4, horizon=5))
+    ac = ACConfig(hidden_size=32, use_recurrent_policy=recurrent)
+    pol = ActorCriticPolicy(
+        ac,
+        obs_dim=env.obs_dim,
+        cent_obs_dim=env.obs_dim if local_value else env.share_obs_dim,
+        space=Discrete(env.action_dim),
+    )
+    cfg = MAPPOConfig(
+        lr=3e-3, critic_lr=3e-3, ppo_epoch=5, num_mini_batch=1,
+        use_popart=popart, use_valuenorm=valuenorm,
+        use_recurrent_policy=recurrent, data_chunk_length=5,
+    )
+    collector = ACRolloutCollector(env, pol, T, use_local_value=local_value)
+    return env, pol, cfg, collector
+
+
+def _boot(collector, rs):
+    cent = rs.obs if collector.use_local_value else rs.share_obs
+    return Bootstrap(cent_obs=cent, critic_h=rs.critic_h, mask=rs.mask)
+
+
+def _run_training(trainer, collector, pol, iters, params=None, stacked=False):
+    if params is None:
+        params = pol.init_params(jax.random.key(0))
+    state = trainer.init_state(params)
+    rs = collector.init_state(jax.random.key(1), E)
+    collect = jax.jit(collector.collect)
+    train = jax.jit(trainer.train)
+    first_r = None
+    for i in range(iters):
+        if stacked:
+            # per-agent params: vmap the shared-structure collector apply
+            rs, traj = collect(state.params, rs)
+        else:
+            rs, traj = collect(state.params, rs)
+        mean_r = float(traj.rewards.mean())
+        if first_r is None:
+            first_r = mean_r
+        state, metrics = train(state, traj, _boot(collector, rs), jax.random.key(100 + i))
+    return first_r, mean_r, state, metrics
+
+
+class TestMAPPO:
+    def test_learns_matching(self):
+        env, pol, cfg, collector = _setup()
+        trainer = MAPPOTrainer(pol, cfg)
+        first_r, last_r, _, metrics = _run_training(trainer, collector, pol, 25)
+        assert first_r < 0.45            # random ~0.25
+        assert last_r > 0.6, f"did not learn: first {first_r}, last {last_r}"
+        assert np.isfinite(float(metrics.value_loss))
+
+    def test_recurrent_path_runs(self):
+        env, pol, cfg, collector = _setup(recurrent=True)
+        trainer = MAPPOTrainer(pol, cfg)
+        _, last_r, state, metrics = _run_training(trainer, collector, pol, 3)
+        for m in metrics:
+            assert np.isfinite(float(m))
+        assert int(state.update_step) == 3
+
+    def test_popart_path_runs_and_rescales(self):
+        env, pol, cfg, collector = _setup(popart=True, valuenorm=False)
+        trainer = MAPPOTrainer(pol, cfg)
+        params = pol.init_params(jax.random.key(0))
+        kernel_before = params["critic"]["params"]["v_out"]["kernel"].copy()
+        _, _, state, metrics = _run_training(trainer, collector, pol, 3, params=params)
+        assert np.isfinite(float(metrics.value_loss))
+        # PopArt statistics must be live (debiasing term grew)
+        assert float(state.value_norm.debiasing_term) > 0
+        # and the head was touched by both grads and rescaling
+        assert not np.allclose(
+            kernel_before, state.params["critic"]["params"]["v_out"]["kernel"]
+        )
+
+    def test_importance_prod_matches_sum_for_scalar_logp(self):
+        # For (B,1) log-probs prod-over-dims == elementwise: same loss path.
+        env, pol, cfg, collector = _setup()
+        t1 = MAPPOTrainer(pol, cfg)
+        t2 = MAPPOTrainer(pol, MAPPOConfig(**{**cfg.__dict__, "importance_prod": True}))
+        params = pol.init_params(jax.random.key(0))
+        rs = collector.init_state(jax.random.key(1), E)
+        rs, traj = jax.jit(collector.collect)(params, rs)
+        boot = _boot(collector, rs)
+        s1, m1 = jax.jit(t1.train)(t1.init_state(params), traj, boot, jax.random.key(2))
+        s2, m2 = jax.jit(t2.train)(t2.init_state(params), traj, boot, jax.random.key(2))
+        np.testing.assert_allclose(
+            float(m1.policy_loss), float(m2.policy_loss), rtol=1e-5
+        )
+
+
+class TestIPPO:
+    def test_learns_matching_per_agent(self):
+        from mat_dcml_tpu.training.ippo import IPPORolloutCollector
+
+        env, pol, cfg, _ = _setup(local_value=True)
+        trainer = IPPOTrainer(pol, MAPPOConfig(**{**cfg.__dict__, "importance_prod": True}),
+                              n_agents=env.n_agents)
+        collector = IPPORolloutCollector(env, pol, T)
+        params = trainer.init_params(jax.random.key(0))
+        state = trainer.init_state(params)
+        rs = collector.init_state(jax.random.key(1), E)
+        collect_j = jax.jit(collector.collect)
+        train_j = jax.jit(trainer.train)
+        first_r = None
+        for i in range(25):
+            rs, traj = collect_j(state.params, rs)
+            r = float(traj.rewards.mean())
+            if first_r is None:
+                first_r = r
+            boot = Bootstrap(cent_obs=rs.obs, critic_h=rs.critic_h, mask=rs.mask)
+            state, metrics = train_j(state, traj, boot, jax.random.key(100 + i))
+        assert first_r < 0.45
+        assert r > 0.6, f"IPPO did not learn: first {first_r}, last {r}"
