@@ -1,7 +1,8 @@
 """Tiny pure-JAX multi-agent env for tests and examples.
 
-``MatchingEnv``: each agent sees a one-hot target in its obs and gets reward 1
-for picking the matching discrete action, 0 otherwise.  Episodes end every
+``MatchingEnv``: each agent sees a one-hot target in its obs; the team reward
+(broadcast to every agent, like the DCML env's shared reward) is the fraction
+of agents that picked their matching discrete action.  Episodes end every
 ``horizon`` steps.  Implements the same TimeStep protocol as the DCML env
 (``envs/dcml/env.py``) so every collector/trainer runs on it unchanged — the
 role the reference's MPE simple_spread plays as "smallest second env"

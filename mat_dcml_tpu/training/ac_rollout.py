@@ -75,6 +75,18 @@ class ACRolloutCollector:
     def _cent(self, st: ACRolloutState) -> jax.Array:
         return st.obs if self.use_local_value else st.share_obs
 
+    def _apply(self, params, key, st: ACRolloutState):
+        """One policy application at the (E, A, ...) level.  The base class
+        flattens to (E*A) rows for shared params; stacked-per-agent collectors
+        (IPPO/HAPPO) override this with a vmap over the agent axis."""
+        E, A = st.obs.shape[:2]
+        out = self.policy.get_actions(
+            params, key, _rows(self._cent(st)), _rows(st.obs),
+            _rows(st.actor_h), _rows(st.critic_h), _rows(st.mask),
+            _rows(st.available_actions),
+        )
+        return jax.tree.map(lambda x: _unrows(x, E, A), out)
+
     def init_state(self, key: jax.Array, n_envs: int) -> ACRolloutState:
         key, k_reset = jax.random.split(key)
         keys = jax.random.split(k_reset, n_envs)
@@ -97,13 +109,8 @@ class ACRolloutCollector:
 
         def body(st: ACRolloutState, _):
             key, k_act = jax.random.split(st.rng)
-            out = self.policy.get_actions(
-                params, k_act, _rows(self._cent(st)), _rows(st.obs),
-                _rows(st.actor_h), _rows(st.critic_h), _rows(st.mask),
-                _rows(st.available_actions),
-            )
-            action_env = _unrows(out.action, E, A)
-            env_states, ts = jax.vmap(self.env.step)(st.env_states, action_env)
+            out = self._apply(params, k_act, st)
+            env_states, ts = jax.vmap(self.env.step)(st.env_states, out.action)
             done_env = ts.done.all(axis=1)
             next_mask = jnp.broadcast_to(
                 jnp.where(done_env[:, None, None], 0.0, 1.0), st.mask.shape
@@ -112,9 +119,9 @@ class ACRolloutCollector:
                 share_obs=self._cent(st),
                 obs=st.obs,
                 available_actions=st.available_actions,
-                actions=action_env,
-                log_probs=_unrows(out.log_prob, E, A),
-                values=_unrows(out.value, E, A),
+                actions=out.action,
+                log_probs=out.log_prob,
+                values=out.value,
                 rewards=ts.reward,
                 next_mask=next_mask,
                 actor_h=st.actor_h,
@@ -129,8 +136,8 @@ class ACRolloutCollector:
                 share_obs=ts.share_obs,
                 available_actions=ts.available_actions,
                 mask=next_mask,
-                actor_h=_unrows(out.actor_h, E, A),
-                critic_h=_unrows(out.critic_h, E, A),
+                actor_h=out.actor_h,
+                critic_h=out.critic_h,
                 rng=key,
             )
             return new_st, transition

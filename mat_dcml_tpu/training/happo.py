@@ -1,0 +1,420 @@
+"""HAPPO / HATRPO: heterogeneous-agent trust-region families.
+
+Reference: ``mat/algorithm/happo_policy.py`` + ``mat/happo_trainer.py`` and
+``hatrpo/hatrpo_policy.py`` + ``hatrpo/hatrpo_trainer.py``, orchestrated by
+the sequential-update loop in ``runner/shared/base_runner.py:329-417``:
+
+    for agent in randperm(A):
+        old_logp  = eval agent's rollout actions (no grad)
+        train agent (PPO surrogate x `factor`, or a TRPO step)
+        new_logp  = eval again with the updated params
+        factor   *= prod(exp(new_logp - old_logp))        # :413
+
+so later agents see earlier agents' policy shift — the advantage-decomposition
+correction that MAT's decoder replaces architecturally.
+
+TPU-native shape: agent parameters are stacked along a leading axis; the
+inherently-serial agent loop is a ``lax.scan`` over a permuted index vector,
+updating one agent's slice of the stacked pytree per step.  Everything jits.
+
+HATRPO's actor step (``hatrpo_trainer.py:125-349``) is the classic natural
+gradient: CG-solve ``F x = g`` with Fisher-vector products (Hessian of the
+self-KL, damping 0.1), step size ``1/sqrt(sᵀFs / 2δ)``-style scaling to the
+``kl_threshold`` ball, then a backtracking line search accepting the first
+fraction with ``kl < δ``, positive surrogate improvement, and improvement /
+expected-improvement > ``accept_ratio``.  The torch loop with early ``break``
+becomes a vmapped candidate sweep + first-accept select.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.flatten_util import ravel_pytree
+
+from mat_dcml_tpu.envs.spaces import Box
+from mat_dcml_tpu.models.actor_critic import ActorCriticPolicy
+from mat_dcml_tpu.training.ac_rollout import ACTrajectory
+from mat_dcml_tpu.training.ippo import IPPORolloutCollector
+from mat_dcml_tpu.training.mappo import (
+    Bootstrap,
+    MAPPOConfig,
+    MAPPOTrainer,
+    MAPPOTrainState,
+)
+
+
+class HAPPORolloutCollector(IPPORolloutCollector):
+    """Per-agent stacked params + centralized critic (``happo_policy.py``)."""
+
+    def __init__(self, env, policy: ActorCriticPolicy, episode_length: int):
+        super().__init__(env, policy, episode_length, use_local_value=False)
+
+
+@dataclasses.dataclass(frozen=True)
+class HAPPOConfig(MAPPOConfig):
+    """Adds the TRPO knobs (``config.py`` trpo group defaults)."""
+
+    kl_threshold: float = 0.01
+    ls_step: int = 10
+    accept_ratio: float = 0.5
+    cg_iters: int = 10
+    cg_damping: float = 0.1
+
+
+class HAPPOMetrics(NamedTuple):
+    value_loss: jax.Array
+    policy_loss: jax.Array
+    dist_entropy: jax.Array
+    ratio: jax.Array
+    factor_mean: jax.Array
+    kl: jax.Array            # HATRPO only; 0 for HAPPO
+    accepted: jax.Array      # HATRPO line-search acceptance rate; 1 for HAPPO
+
+
+def _rows(x: jax.Array) -> jax.Array:
+    """(T, E, 1, ...) agent slice -> (T*E, ...)."""
+    return x.reshape(x.shape[0] * x.shape[1], *x.shape[3:])
+
+
+class HAPPOTrainer:
+    """Sequential-factor PPO over per-agent stacked params.
+
+    ``policy`` is the single-agent template; ``params``/optimizer/value-norm
+    pytrees carry a leading agent axis (like ``IPPOTrainer``), but training is
+    a *sequential* scan over a permuted agent order with the compounding
+    ``factor``, not a parallel vmap.
+    """
+
+    def __init__(self, policy: ActorCriticPolicy, cfg: HAPPOConfig, n_agents: int):
+        if cfg.use_recurrent_policy:
+            raise NotImplementedError(
+                "HAPPO/HATRPO are feedforward-only here: the sequential-factor "
+                "update evaluates stored per-step hidden states as constants, "
+                "which would silently train a GRU wrong. Use MAPPOTrainer for "
+                "the recurrent chunked path."
+            )
+        self.policy = policy
+        self.cfg = cfg
+        self.n_agents = n_agents
+        # HAPPO importance weights take the product over action dims
+        # (happo_trainer.py:125); reuse the MAPPO helpers with that convention.
+        self.inner = MAPPOTrainer(
+            policy, dataclasses.replace(cfg, importance_prod=True)
+        )
+
+    # ------------------------------------------------------------------ state
+
+    def init_params(self, key: jax.Array):
+        keys = jax.random.split(key, self.n_agents)
+        return jax.vmap(self.policy.init_params)(keys)
+
+    def init_state(self, stacked_params) -> MAPPOTrainState:
+        return jax.vmap(self.inner.init_state)(stacked_params)
+
+    # ------------------------------------------------------------------ train
+
+    def train(self, state: MAPPOTrainState, traj: ACTrajectory, boot: Bootstrap,
+              key: jax.Array) -> Tuple[MAPPOTrainState, HAPPOMetrics]:
+        A = traj.rewards.shape[2]
+        assert A == self.n_agents
+        T, E = traj.rewards.shape[:2]
+        k_perm, k_train = jax.random.split(key)
+
+        def slice_traj(x):
+            return jnp.moveaxis(x, 2, 0)[:, :, :, None]
+
+        traj_a = ACTrajectory(
+            share_obs=slice_traj(traj.share_obs),
+            obs=slice_traj(traj.obs),
+            available_actions=slice_traj(traj.available_actions),
+            actions=slice_traj(traj.actions),
+            log_probs=slice_traj(traj.log_probs),
+            values=slice_traj(traj.values),
+            rewards=slice_traj(traj.rewards),
+            masks=slice_traj(traj.masks),
+            active_masks=slice_traj(traj.active_masks),
+            actor_h=slice_traj(traj.actor_h),
+            critic_h=slice_traj(traj.critic_h),
+            dones=jnp.broadcast_to(traj.dones, (A, *traj.dones.shape)),
+        )
+        boot_a = Bootstrap(
+            cent_obs=jnp.moveaxis(boot.cent_obs, 1, 0)[:, :, None],
+            critic_h=jnp.moveaxis(boot.critic_h, 1, 0)[:, :, None],
+            mask=jnp.moveaxis(boot.mask, 1, 0)[:, :, None],
+        )
+        # Per-agent GAE + advantage normalization from each agent's own critic
+        # (separated buffers, ``base_runner.py:336-344``).
+        adv_a, ret_a = jax.vmap(self.inner._compute_targets)(state, traj_a, boot_a)
+
+        order = jax.random.permutation(k_perm, A)  # randperm (:334)
+        agent_keys = jax.random.split(k_train, A)
+
+        def one_agent(carry, inp):
+            params_s, aopt_s, copt_s, vn_s, factor = carry
+            idx, k_agent = inp
+            take = lambda t: jax.tree.map(lambda x: x[idx], t)
+            params_i, aopt_i, copt_i, vn_i = (
+                take(params_s), take(aopt_s), take(copt_s), take(vn_s)
+            )
+            data = {
+                "cent_obs": _rows(traj_a.share_obs[idx]),
+                "obs": _rows(traj_a.obs[idx]),
+                "avail": _rows(traj_a.available_actions[idx]),
+                "actions": _rows(traj_a.actions[idx]),
+                "log_probs": _rows(traj_a.log_probs[idx]),
+                "values": _rows(traj_a.values[idx]),
+                "masks": _rows(traj_a.masks[idx][:-1]),
+                "active": _rows(traj_a.active_masks[idx][:-1]),
+                "actor_h": _rows(traj_a.actor_h[idx]),
+                "critic_h": _rows(traj_a.critic_h[idx]),
+                "adv": _rows(adv_a[idx]),
+                "returns": _rows(ret_a[idx]),
+                "factor": factor.reshape(T * E, 1),
+            }
+            old_logp = self._eval_logp(params_i, data)
+            params_i, aopt_i, copt_i, vn_i, metrics = self._update_agent(
+                params_i, aopt_i, copt_i, vn_i, data, k_agent
+            )
+            new_logp = self._eval_logp(params_i, data)
+            # factor update (:413): prod over action dims of the logp shift.
+            shift = jnp.exp((new_logp - old_logp).sum(-1, keepdims=True))
+            factor = factor * shift.reshape(T, E, 1)
+
+            put = lambda t, v: jax.tree.map(lambda full, new: full.at[idx].set(new), t, v)
+            carry = (
+                put(params_s, params_i), put(aopt_s, aopt_i),
+                put(copt_s, copt_i), put(vn_s, vn_i), factor,
+            )
+            return carry, metrics._replace(factor_mean=factor.mean())
+
+        factor0 = jnp.ones((T, E, 1), jnp.float32)
+        carry0 = (state.params, state.actor_opt, state.critic_opt, state.value_norm, factor0)
+        (params_s, aopt_s, copt_s, vn_s, _), metrics = jax.lax.scan(
+            one_agent, carry0, (order, agent_keys)
+        )
+        new_state = MAPPOTrainState(params_s, aopt_s, copt_s, vn_s, state.update_step + 1)
+        return new_state, jax.tree.map(lambda m: m.mean(), metrics)
+
+    # ---------------------------------------------------------------- helpers
+
+    def _eval_logp(self, params_i, data):
+        logp, _ = self.policy.actor.apply(
+            params_i["actor"], data["obs"], data["actor_h"], data["actions"],
+            data["masks"], data["avail"], data["active"], method="evaluate",
+        )
+        return logp
+
+    def _update_agent(self, params, aopt, copt, vn, data, key):
+        """PPO epochs with the ``factor`` weighting (``happo_trainer.py:96-160``)."""
+        cfg, inner = self.cfg, self.inner
+        N = data["obs"].shape[0]
+        mb_size = N // cfg.num_mini_batch
+
+        def ppo_update(carry, mb_idx):
+            params, aopt, copt, vn = carry
+            b = jax.tree.map(lambda x: x[mb_idx], data)
+            vn, params, ret_norm = inner._normalize_targets(vn, params, b["returns"])
+
+            def loss_fn(p):
+                values, logp, ent = self.policy.evaluate_actions(
+                    p, b["cent_obs"], b["obs"], b["actor_h"], b["critic_h"],
+                    b["actions"], b["masks"], b["avail"], b["active"],
+                )
+                ratio = jnp.exp((logp - b["log_probs"]).sum(-1, keepdims=True))
+                surr1 = ratio * b["adv"]
+                surr2 = jnp.clip(ratio, 1 - cfg.clip_param, 1 + cfg.clip_param) * b["adv"]
+                # factor multiplies the clipped surrogate (happo_trainer.py:128-140)
+                surr = (b["factor"] * jnp.minimum(surr1, surr2)).sum(-1, keepdims=True)
+                if cfg.use_policy_active_masks:
+                    policy_loss = -(surr * b["active"]).sum() / b["active"].sum()
+                else:
+                    policy_loss = -surr.mean()
+                value_loss = inner._value_loss(values, b["values"], ret_norm, b["active"])
+                total = policy_loss - ent * cfg.entropy_coef + value_loss * cfg.value_loss_coef
+                return total, (value_loss, policy_loss, ent, ratio.mean())
+
+            (_, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+            params, aopt, copt, _, _ = inner._apply_updates(params, grads, aopt, copt)
+            vl, pl, ent, ratio = aux
+            zero = jnp.zeros(())
+            return (params, aopt, copt, vn), HAPPOMetrics(
+                vl, pl, ent, ratio, zero, zero, jnp.ones(())
+            )
+
+        def epoch(carry, key_e):
+            perm = jax.random.permutation(key_e, N)
+            mb_idxs = perm[: mb_size * cfg.num_mini_batch].reshape(cfg.num_mini_batch, mb_size)
+            return jax.lax.scan(ppo_update, carry, mb_idxs)
+
+        keys = jax.random.split(key, cfg.ppo_epoch)
+        (params, aopt, copt, vn), metrics = jax.lax.scan(epoch, (params, aopt, copt, vn), keys)
+        return params, aopt, copt, vn, jax.tree.map(lambda m: m.mean(), metrics)
+
+
+class HATRPOTrainer(HAPPOTrainer):
+    """Sequential-factor TRPO: the HAPPO outer loop with the actor's PPO step
+    replaced by a KL-constrained natural-gradient step
+    (``hatrpo_trainer.py:183-349``).  One pass over minibatches per agent (the
+    reference's ``train`` has no epoch loop — ``:351-412``)."""
+
+    # ------------------------------------------------------------ kl machinery
+
+    def _logp_fn(self, actor_params, b):
+        logp, ent = self.policy.actor.apply(
+            actor_params, b["obs"], b["actor_h"], b["actions"], b["masks"],
+            b["avail"], b["active"], method="evaluate",
+        )
+        return logp, ent
+
+    def _kl_vs(self, actor_params, old_ref, b):
+        """Mean KL(old || new).  Continuous: closed-form diag-gaussian
+        (``hatrpo_trainer.py:137-147``); otherwise the k3 estimator on taken
+        actions ``exp(Δ) - 1 - Δ`` (``kl_approx``, ``:125-128``)."""
+        if isinstance(self.policy.space, Box):
+            mu_old, std_old = old_ref
+            mu, std = self.policy.actor.apply(
+                actor_params, b["obs"], b["actor_h"], b["masks"], b["avail"],
+                method="dist_params",
+            )
+            kl = (
+                jnp.log(std) - jnp.log(std_old)
+                + (std_old**2 + (mu_old - mu) ** 2) / (2.0 * std**2)
+                - 0.5
+            ).sum(-1, keepdims=True)
+        else:
+            lp_old = old_ref
+            lp, _ = self._logp_fn(actor_params, b)
+            d = lp - lp_old
+            kl = (jnp.exp(d) - 1.0 - d).sum(-1, keepdims=True)
+        return kl.mean()
+
+    def _old_ref(self, actor_params, b):
+        if isinstance(self.policy.space, Box):
+            mu, std = self.policy.actor.apply(
+                actor_params, b["obs"], b["actor_h"], b["masks"], b["avail"],
+                method="dist_params",
+            )
+            return jax.lax.stop_gradient(mu), jax.lax.stop_gradient(std)
+        lp, _ = self._logp_fn(actor_params, b)
+        return jax.lax.stop_gradient(lp)
+
+    # ------------------------------------------------------------ actor step
+
+    def _update_agent(self, params, aopt, copt, vn, data, key):
+        cfg, inner = self.cfg, self.inner
+        N = data["obs"].shape[0]
+        mb_size = N // cfg.num_mini_batch
+
+        def trpo_update(carry, mb_idx):
+            params, aopt, copt, vn = carry
+            b = jax.tree.map(lambda x: x[mb_idx], data)
+            vn, params, ret_norm = inner._normalize_targets(vn, params, b["returns"])
+
+            # ---- critic: plain Adam on the clipped/huber value loss (:215-227)
+            def critic_loss_fn(cp):
+                values, _ = self.policy.critic.apply(
+                    cp, b["cent_obs"], b["critic_h"], b["masks"]
+                )
+                return inner._value_loss(values, b["values"], ret_norm, b["active"]) * cfg.value_loss_coef
+
+            vl, cgrads = jax.value_and_grad(critic_loss_fn)(params["critic"])
+            c_up, copt = inner.critic_tx.update(cgrads, copt, params["critic"])
+            params = {**params, "critic": optax.apply_updates(params["critic"], c_up)}
+
+            # ---- actor: natural-gradient ascent on the factor-weighted surrogate
+            flat0, unravel = ravel_pytree(params["actor"])
+
+            def surrogate(aparams):
+                logp, ent = self._logp_fn(aparams, b)
+                ratio = jnp.exp((logp - b["log_probs"]).sum(-1, keepdims=True))
+                surr = (ratio * b["factor"] * b["adv"]).sum(-1, keepdims=True)
+                if cfg.use_policy_active_masks:
+                    loss = (surr * b["active"]).sum() / b["active"].sum()
+                else:
+                    loss = surr.mean()
+                return loss, ent
+
+            (loss0, ent0), g_tree = jax.value_and_grad(surrogate, has_aux=True)(params["actor"])
+            g = ravel_pytree(g_tree)[0]
+
+            old_ref = self._old_ref(params["actor"], b)
+
+            def kl_flat(flat):
+                return self._kl_vs(unravel(flat), old_ref, b)
+
+            kl_grad_fn = jax.grad(kl_flat)
+
+            def fvp(v):
+                # Hessian-vector product of the self-KL + damping (:171-181)
+                hvp = jax.grad(lambda f: jnp.vdot(kl_grad_fn(f), v))(flat0)
+                return hvp + cfg.cg_damping * v
+
+            # CG solve F x = g (:151-169), fixed iteration count under jit
+            def cg_body(carry, _):
+                x, r, p, rdotr = carry
+                Ap = fvp(p)
+                alpha = rdotr / jnp.maximum(jnp.vdot(p, Ap), 1e-10)
+                x = x + alpha * p
+                r = r - alpha * Ap
+                new_rdotr = jnp.vdot(r, r)
+                beta = new_rdotr / jnp.maximum(rdotr, 1e-10)
+                p = r + beta * p
+                return (x, r, p, new_rdotr), None
+
+            x0 = jnp.zeros_like(g)
+            (step_dir, _, _, _), _ = jax.lax.scan(
+                cg_body, (x0, g, g, jnp.vdot(g, g)), None, length=cfg.cg_iters
+            )
+
+            shs = 0.5 * jnp.vdot(step_dir, fvp(step_dir))
+            step_size = 1.0 / jnp.sqrt(jnp.maximum(shs / cfg.kl_threshold, 1e-10))
+            full_step = step_size * step_dir
+            expected_improve = jnp.vdot(g, full_step)
+
+            # Backtracking line search (:287-345): all ls_step fractions
+            # evaluated batched, first acceptable one selected.
+            fracs = 0.5 ** jnp.arange(cfg.ls_step, dtype=jnp.float32)
+
+            def candidate(frac):
+                new_flat = flat0 + frac * full_step
+                new_loss, _ = surrogate(unravel(new_flat))
+                improve = new_loss - loss0
+                kl = kl_flat(new_flat)
+                expected = expected_improve * frac
+                ok = (
+                    (kl < cfg.kl_threshold)
+                    & (improve / jnp.where(jnp.abs(expected) < 1e-10, 1e-10, expected)
+                       > cfg.accept_ratio)
+                    & (improve > 0)
+                )
+                return ok, new_flat, kl
+
+            oks, flats, kls = jax.vmap(candidate)(fracs)
+            first = jnp.argmax(oks)
+            accepted = oks.any()
+            new_flat = jnp.where(accepted, flats[first], flat0)
+            kl_sel = jnp.where(accepted, kls[first], 0.0)
+            params = {**params, "actor": unravel(new_flat)}
+
+            metrics = HAPPOMetrics(
+                value_loss=vl,
+                policy_loss=-loss0,
+                dist_entropy=ent0,
+                ratio=jnp.ones(()),
+                factor_mean=jnp.zeros(()),
+                kl=kl_sel,
+                accepted=accepted.astype(jnp.float32),
+            )
+            return (params, aopt, copt, vn), metrics
+
+        perm = jax.random.permutation(key, N)
+        mb_idxs = perm[: mb_size * cfg.num_mini_batch].reshape(cfg.num_mini_batch, mb_size)
+        (params, aopt, copt, vn), metrics = jax.lax.scan(
+            trpo_update, (params, aopt, copt, vn), mb_idxs
+        )
+        return params, aopt, copt, vn, jax.tree.map(lambda m: m.mean(), metrics)

@@ -17,7 +17,7 @@ import jax
 import jax.numpy as jnp
 
 from mat_dcml_tpu.models.actor_critic import ActorCriticPolicy
-from mat_dcml_tpu.training.ac_rollout import ACTrajectory
+from mat_dcml_tpu.training.ac_rollout import ACRolloutCollector, ACTrajectory
 from mat_dcml_tpu.training.mappo import (
     Bootstrap,
     MAPPOConfig,
@@ -27,69 +27,29 @@ from mat_dcml_tpu.training.mappo import (
 )
 
 
-class IPPORolloutCollector:
+class IPPORolloutCollector(ACRolloutCollector):
     """Rollout collection with *per-agent* stacked params: each agent's own
     actor/critic act on its slice, the reference's per-agent policy list
     (``base_runner.py:120-140``) collapsed into one vmapped apply.
 
     IPPO is decentralized-V: the critic consumes local obs
     (``ippo_policy.py:13-29``), so ``share_obs`` stored in the trajectory is
-    the local obs too.
+    the local obs too.  ``use_local_value=False`` gives the HAPPO/HATRPO
+    configuration: per-agent params but a centralized critic over
+    ``share_obs`` (``happo_policy.py`` critic input).
     """
 
-    def __init__(self, env, policy: ActorCriticPolicy, episode_length: int):
-        self.env = env
-        self.policy = policy
-        self.T = episode_length
-        self.use_local_value = True
+    def __init__(self, env, policy: ActorCriticPolicy, episode_length: int,
+                 use_local_value: bool = True):
+        super().__init__(env, policy, episode_length, use_local_value)
 
-    def init_state(self, key: jax.Array, n_envs: int):
-        from mat_dcml_tpu.training.ac_rollout import ACRolloutCollector
-
-        return ACRolloutCollector(self.env, self.policy, self.T, True).init_state(key, n_envs)
-
-    def collect(self, stacked_params, rs):
-        from mat_dcml_tpu.training.ac_rollout import ACRolloutState, ACTrajectory
-
-        pol = self.policy
-
-        def body(st: ACRolloutState, _):
-            key, k_act = jax.random.split(st.rng)
-            A = st.obs.shape[1]
-            keys = jax.random.split(k_act, A)
-            out = jax.vmap(pol.get_actions, in_axes=(0, 0, 1, 1, 1, 1, 1, 1), out_axes=1)(
-                stacked_params, keys, st.obs, st.obs, st.actor_h, st.critic_h,
-                st.mask, st.available_actions,
-            )
-            env_states, ts = jax.vmap(self.env.step)(st.env_states, out.action)
-            done_env = ts.done.all(axis=1)
-            next_mask = jnp.broadcast_to(
-                jnp.where(done_env[:, None, None], 0.0, 1.0), st.mask.shape
-            )
-            tr = dict(
-                share_obs=st.obs, obs=st.obs,
-                available_actions=st.available_actions,
-                actions=out.action, log_probs=out.log_prob, values=out.value,
-                rewards=ts.reward, next_mask=next_mask,
-                actor_h=st.actor_h, critic_h=st.critic_h, done=done_env,
-            )
-            new_st = st._replace(
-                env_states=env_states, obs=ts.obs, share_obs=ts.share_obs,
-                available_actions=ts.available_actions, mask=next_mask,
-                actor_h=out.actor_h, critic_h=out.critic_h, rng=key,
-            )
-            return new_st, tr
-
-        final, tr = jax.lax.scan(body, rs, None, length=self.T)
-        masks = jnp.concatenate([rs.mask[None], tr["next_mask"]], axis=0)
-        traj = ACTrajectory(
-            share_obs=tr["share_obs"], obs=tr["obs"],
-            available_actions=tr["available_actions"], actions=tr["actions"],
-            log_probs=tr["log_probs"], values=tr["values"], rewards=tr["rewards"],
-            masks=masks, active_masks=jnp.ones_like(masks),
-            actor_h=tr["actor_h"], critic_h=tr["critic_h"], dones=tr["done"],
+    def _apply(self, stacked_params, key, st):
+        A = st.obs.shape[1]
+        keys = jax.random.split(key, A)
+        return jax.vmap(self.policy.get_actions, in_axes=(0, 0, 1, 1, 1, 1, 1, 1), out_axes=1)(
+            stacked_params, keys, self._cent(st), st.obs, st.actor_h,
+            st.critic_h, st.mask, st.available_actions,
         )
-        return final, traj
 
 
 class IPPOTrainer:
@@ -97,8 +57,11 @@ class IPPOTrainer:
     params/opt-state pytrees carry a leading agent axis."""
 
     def __init__(self, policy: ActorCriticPolicy, cfg: MAPPOConfig, n_agents: int):
-        # IPPO importance weights use the prod convention (ippo_trainer.py:128).
-        self.inner = MAPPOTrainer(policy, cfg)
+        # IPPO importance weights use the prod convention (ippo_trainer.py:128);
+        # enforced here rather than trusted to the caller.
+        import dataclasses
+
+        self.inner = MAPPOTrainer(policy, dataclasses.replace(cfg, importance_prod=True))
         self.n_agents = n_agents
 
     def init_params(self, key: jax.Array):

@@ -191,7 +191,6 @@ class MAPPOTrainer:
         if cfg.use_popart:
             head = params["critic"]["params"]["v_out"]
             value_norm, new_head = popart_update(value_norm, flat_ret, head)
-            params = jax.tree_util.tree_map(lambda x: x, params)  # shallow copy via pytree
             critic = dict(params["critic"])
             inner = dict(critic["params"])
             inner["v_out"] = new_head

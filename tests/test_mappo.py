@@ -8,7 +8,6 @@ mean reward well above the 1/n_actions random baseline within a few updates.
 import jax
 import jax.numpy as jnp
 import numpy as np
-import pytest
 
 from mat_dcml_tpu.envs.spaces import Discrete
 from mat_dcml_tpu.envs.toy import MatchingEnv, MatchingEnvConfig
@@ -44,7 +43,7 @@ def _boot(collector, rs):
     return Bootstrap(cent_obs=cent, critic_h=rs.critic_h, mask=rs.mask)
 
 
-def _run_training(trainer, collector, pol, iters, params=None, stacked=False):
+def _run_training(trainer, collector, pol, iters, params=None):
     if params is None:
         params = pol.init_params(jax.random.key(0))
     state = trainer.init_state(params)
@@ -53,11 +52,7 @@ def _run_training(trainer, collector, pol, iters, params=None, stacked=False):
     train = jax.jit(trainer.train)
     first_r = None
     for i in range(iters):
-        if stacked:
-            # per-agent params: vmap the shared-structure collector apply
-            rs, traj = collect(state.params, rs)
-        else:
-            rs, traj = collect(state.params, rs)
+        rs, traj = collect(state.params, rs)
         mean_r = float(traj.rewards.mean())
         if first_r is None:
             first_r = mean_r
