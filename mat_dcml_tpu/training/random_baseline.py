@@ -1,0 +1,85 @@
+"""Random baseline policy + no-op trainer.
+
+Reference: ``mat/algorithms/random/`` — ``random_policy.py:79-109`` samples,
+per agent, a uniform-random *available* discrete action for the first
+``n_agent + semi_index`` agents and ``uniform(0, 1)`` for the continuous tail
+(the DCML coding-ratio agent); values and log-probs are zeros and the trainer
+is a scaffold whose ``train`` does nothing.  Used as the sanity anchor the
+benchmark sweeps compare against (SURVEY.md §4.2).
+
+The reference's double Python loop over (thread, agent) is one masked-gumbel
+draw here: sampling uniformly among available actions == argmax of
+``U ~ Gumbel`` restricted to the available set.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+class RandomPolicyOutput(NamedTuple):
+    value: jax.Array
+    action: jax.Array
+    log_prob: jax.Array
+
+
+class RandomPolicy:
+    """Drop-in for ``TransformerPolicy.get_actions`` on the DCML layout.
+
+    ``n_cont_tail`` agents at the end of the agent axis emit U(0, 1) scalars
+    (the coding ratio); all others pick uniformly among available discrete
+    actions.  Stateless: ``params`` is an empty dict for API compatibility.
+    """
+
+    def __init__(self, n_agent: int, action_dim: int, n_cont_tail: int = 1):
+        self.n_agent = n_agent
+        self.action_dim = action_dim
+        self.n_cont_tail = n_cont_tail
+
+    def init_params(self, key: jax.Array):
+        del key
+        return {}
+
+    def get_actions(self, params, key: jax.Array, share_obs, obs, available_actions,
+                    deterministic: bool = False) -> RandomPolicyOutput:
+        """(B, A, ...) batched sampling.  ``deterministic`` is ignored — the
+        reference has no deterministic random mode."""
+        del params, share_obs, deterministic
+        B, A = obs.shape[:2]
+        k_disc, k_cont = jax.random.split(key)
+
+        ava = available_actions if available_actions is not None else jnp.ones(
+            (B, A, self.action_dim)
+        )
+        # uniform over the available set: masked Gumbel-max
+        g = jax.random.gumbel(k_disc, (B, A, self.action_dim))
+        disc = jnp.argmax(jnp.where(ava > 0, g, -jnp.inf), axis=-1).astype(jnp.float32)
+
+        cont = jax.random.uniform(k_cont, (B, A))
+        is_tail = jnp.arange(A) >= (A - self.n_cont_tail)
+        action = jnp.where(is_tail[None, :], cont, disc)[..., None]
+
+        zeros = jnp.zeros((B, A, 1), jnp.float32)
+        return RandomPolicyOutput(value=zeros, action=action, log_prob=zeros)
+
+
+class RandomTrainer:
+    """No-op trainer scaffold (``random_trainer.py``): keeps the runner's
+    collect→train loop shape without learning anything."""
+
+    def __init__(self, policy: RandomPolicy):
+        self.policy = policy
+
+    def init_state(self, params):
+        return {"params": params}
+
+    def train(self, state, traj=None, *args, **kwargs) -> Tuple[dict, dict]:
+        metrics = {
+            "value_loss": jnp.zeros(()),
+            "policy_loss": jnp.zeros(()),
+            "dist_entropy": jnp.zeros(()),
+        }
+        return state, metrics
