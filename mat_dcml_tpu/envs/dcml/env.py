@@ -66,6 +66,12 @@ class TimeStep(NamedTuple):
     done: jax.Array              # (A,) bool
     delay: jax.Array             # scalar info
     payment: jax.Array           # scalar info
+    # MO-MAT objective vector (A, 2): (-delay*alpha, -payment*beta) — the
+    # per-channel decomposition of the scalar reward
+    # (``DCML_ENV_Functions.py:15-17``); the shipped training curves
+    # ``momat_ct.csv`` / ``momat_payment.csv`` track exactly these two
+    # channels (SURVEY.md §6).  objectives.sum(-1) == reward.
+    objectives: jax.Array
 
 
 @dataclasses.dataclass(frozen=True)
@@ -174,6 +180,7 @@ class DCMLEnv:
             done=jnp.zeros((c.n_agents,), bool),
             delay=jnp.float32(0.0),
             payment=jnp.float32(0.0),
+            objectives=jnp.zeros((c.n_agents, 2), jnp.float32),
         )
         return state, ts
 
@@ -239,6 +246,11 @@ class DCMLEnv:
         reward = jnp.where(standalone, reward_alone, reward_main)
         delay_info = jnp.where(standalone, delays[0], final_delay)
         payment_info = jnp.where(standalone, cost0_full, payment)
+        # per-objective channels; the standalone path keeps its 1.5x scaling
+        obj_scale = jnp.where(standalone, 1.5, 1.0)
+        objectives = obj_scale * jnp.stack(
+            [-delay_info * c.reward_alpha, -payment_info * c.reward_beta]
+        )
 
         # done fires with CONTINUE_PROBABILITY (:141-142) — the reference uses
         # it as a "next task unrelated" continuation flag; see ops/gae.py.
@@ -253,6 +265,7 @@ class DCMLEnv:
             done=jnp.full((c.n_agents,), done),
             delay=delay_info,
             payment=payment_info,
+            objectives=jnp.broadcast_to(objectives, (c.n_agents, 2)).astype(jnp.float32),
         )
         return new_state, ts
 

@@ -48,6 +48,7 @@ class JointDCMLEnv:
             done=ts.done[:1],
             delay=ts.delay,
             payment=ts.payment,
+            objectives=ts.objectives[:1],
         )
 
     def reset(self, key: jax.Array, episode_idx=0):

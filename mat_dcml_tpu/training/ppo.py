@@ -69,6 +69,13 @@ class PPOConfig:
     use_max_grad_norm: bool = True
     use_linear_lr_decay: bool = False
     recompute_returns_per_epoch: bool = True  # mat_trainer.py:178-198
+    # MO-MAT scalarization weights, comma-separated floats ("99,1" etc.);
+    # empty = equal weights.  Per-objective advantages are normalized per
+    # channel, then combined ``adv = sum_i w_i * adv_norm_i`` (reconstruction
+    # of the missing ``momat_trainer`` around the surviving
+    # ``mo_shared_buffer.py`` per-objective GAE).  Ignored when the policy has
+    # a single objective or when DMO per-step coefficients are present.
+    objective_weights: str = ""
 
 
 class TrainState(NamedTuple):
@@ -92,6 +99,18 @@ class MATTrainer:
     def __init__(self, policy: TransformerPolicy, cfg: PPOConfig, total_updates: int = 1):
         self.policy = policy
         self.cfg = cfg
+        self.n_objective = getattr(policy.cfg, "n_objective", 1)
+        if cfg.objective_weights:
+            w = [float(s) for s in cfg.objective_weights.split(",")]
+            assert len(w) == self.n_objective, (
+                f"objective_weights has {len(w)} entries for {self.n_objective} objectives"
+            )
+            arr = jnp.asarray(w, jnp.float32)
+            # normalize to the simplex so "99,1" and "0.99,0.01" give the same
+            # gradient scale (per-channel advantages are already unit-std)
+            self.objective_weights = arr / arr.sum()
+        else:
+            self.objective_weights = jnp.ones((self.n_objective,), jnp.float32) / self.n_objective
         self.total_updates = max(total_updates, 1)
         if cfg.use_linear_lr_decay:
             # update_linear_schedule (mat/utils/util.py:17-21)
@@ -109,7 +128,7 @@ class MATTrainer:
         return TrainState(
             params=params,
             opt_state=self.tx.init(params),
-            value_norm=value_norm_init(1),
+            value_norm=value_norm_init(self.n_objective),
             update_step=jnp.zeros((), jnp.int32),
         )
 
@@ -147,13 +166,24 @@ class MATTrainer:
             if cfg.use_valuenorm or cfg.use_popart:
                 values_all = value_norm_denormalize(value_norm, values_all)
             adv, returns = compute_gae(traj.rewards, values_all, traj.masks, cfg.gamma, cfg.gae_lambda)
-            # advantage normalization over active entries (mat_trainer.py:193-197)
+            # advantage normalization over active entries (mat_trainer.py:193-197);
+            # per objective channel — identical to the reference's global
+            # statistics when n_objective == 1.
             active = traj.active_masks[:-1]
+            axes = tuple(range(adv.ndim - 1))
             denom = active.sum()
-            mean = (adv * active).sum() / denom
-            var = (((adv - mean) ** 2) * active).sum() / denom
+            mean = (adv * active).sum(axes) / denom
+            var = (((adv - mean) ** 2) * active).sum(axes) / denom
             adv_norm = (adv - mean) / (jnp.sqrt(var) + 1e-5)
-            return adv_norm.reshape(n_rows, *adv.shape[2:]), returns.reshape(n_rows, *returns.shape[2:])
+            if self.n_objective > 1:
+                # scalarize: per-step DMO coefficients (broadcast over agents)
+                # when collected, else the static objective weights.
+                if traj.objective_coefficients is not None:
+                    w = traj.objective_coefficients[:, :, None, :]  # (T, E, 1, n_obj)
+                else:
+                    w = self.objective_weights
+                adv_norm = (adv_norm * w).sum(-1, keepdims=True)
+            return adv_norm.reshape(n_rows, *adv_norm.shape[2:]), returns.reshape(n_rows, *returns.shape[2:])
 
         def ppo_update(carry, mb_idx):
             params, opt_state, value_norm, adv_flat, ret_flat = carry

@@ -14,7 +14,7 @@ draw here: sampling uniformly among available actions == argmax of
 
 from __future__ import annotations
 
-from typing import NamedTuple, Tuple
+from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp
@@ -24,6 +24,12 @@ class RandomPolicyOutput(NamedTuple):
     value: jax.Array
     action: jax.Array
     log_prob: jax.Array
+
+
+class RandomTrainState(NamedTuple):
+    """Matches the ``TrainState.params`` attribute the runner reads."""
+
+    params: dict
 
 
 class RandomPolicy:
@@ -68,18 +74,19 @@ class RandomPolicy:
 
 class RandomTrainer:
     """No-op trainer scaffold (``random_trainer.py``): keeps the runner's
-    collect→train loop shape without learning anything."""
+    collect→train loop shape without learning anything.  Metrics match the
+    ``TrainMetrics`` attribute contract the runner logs from."""
 
     def __init__(self, policy: RandomPolicy):
         self.policy = policy
 
     def init_state(self, params):
-        return {"params": params}
+        return RandomTrainState(params=params)
 
-    def train(self, state, traj=None, *args, **kwargs) -> Tuple[dict, dict]:
-        metrics = {
-            "value_loss": jnp.zeros(()),
-            "policy_loss": jnp.zeros(()),
-            "dist_entropy": jnp.zeros(()),
-        }
-        return state, metrics
+    def train(self, state, traj=None, *args, **kwargs):
+        from mat_dcml_tpu.training.ppo import TrainMetrics
+
+        z = jnp.zeros(())
+        return state, TrainMetrics(
+            value_loss=z, policy_loss=z, dist_entropy=z, grad_norm=z, ratio=jnp.ones(())
+        )

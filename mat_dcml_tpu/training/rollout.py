@@ -32,12 +32,16 @@ class Trajectory(NamedTuple):
     actions: jax.Array           # (T, E, A, act_out)
     log_probs: jax.Array         # (T, E, A, act_prob)
     values: jax.Array            # (T, E, A, n_obj)
-    rewards: jax.Array           # (T, E, A, 1)
+    rewards: jax.Array           # (T, E, A, n_obj); n_obj=1 unless MO-MAT
     masks: jax.Array             # (T+1, E, A, 1); masks[t+1] = 1 - done_env[t]
     active_masks: jax.Array      # (T+1, E, A, 1)
     delays: jax.Array            # (T, E) env info
     payments: jax.Array          # (T, E)
     dones: jax.Array             # (T, E) episode-end flags
+    # DMO-MAT per-step preference weights (T, E, n_obj), resampled at episode
+    # boundaries (``dmo_shared_buffer.py:69`` objective_coefficients); None for
+    # single-objective and static-weight MO-MAT.
+    objective_coefficients: Optional[jax.Array] = None
 
 
 class RolloutState(NamedTuple):
@@ -50,28 +54,64 @@ class RolloutState(NamedTuple):
     available_actions: jax.Array  # (E, A, act_dim)
     mask: jax.Array              # (E, A, 1) mask entering the next chunk
     rng: jax.Array
+    objective_coefficients: Optional[jax.Array] = None  # (E, n_obj), DMO only
 
 
 class RolloutCollector:
     """Builds the jittable ``collect`` function for a (policy, env) pair."""
 
-    def __init__(self, env, policy: TransformerPolicy, episode_length: int):
+    def __init__(
+        self,
+        env,
+        policy: TransformerPolicy,
+        episode_length: int,
+        dynamic_coefficients: bool = False,
+    ):
         self.env = env
         self.policy = policy
         self.T = episode_length
+        # derived from the policy so reward channels can never silently
+        # mismatch the critic's value channels (cfg-less policies, e.g. the
+        # random baseline, are single-objective)
+        self.n_objective = getattr(getattr(policy, "cfg", None), "n_objective", 1)
+        # DMO-MAT: per-env preference weights on the objective simplex,
+        # resampled whenever the env episode ends (reconstructing the missing
+        # ``dmomat`` runner around ``dmo_shared_buffer.py:69``).  The weights
+        # condition the policy — they are appended to share_obs — so the
+        # network can actually learn preference-dependent behavior; the policy
+        # must be built with state_dim = env.share_obs_dim + n_objective.
+        self.dynamic_coefficients = dynamic_coefficients and self.n_objective > 1
+
+    def _sample_coefficients(self, key: jax.Array, n_envs: int) -> jax.Array:
+        return jax.random.dirichlet(key, jnp.ones((self.n_objective,)), (n_envs,))
+
+    def augment_share_obs(self, x: jax.Array, coefs: Optional[jax.Array]) -> jax.Array:
+        """Append per-env preference weights to every agent's obs/share_obs row.
+
+        Both views are widened because the MAT encoder reads ``obs`` unless
+        ``encode_state`` is set (``ma_transformer.py:144-149``) — augmenting
+        share_obs alone would leave the network blind to the preference.
+        """
+        if not self.dynamic_coefficients:
+            return x
+        A = x.shape[-2]
+        tiled = jnp.broadcast_to(coefs[..., None, :], (*coefs.shape[:-1], A, coefs.shape[-1]))
+        return jnp.concatenate([x, tiled], axis=-1)
 
     def init_state(self, key: jax.Array, n_envs: int) -> RolloutState:
-        key, k_reset = jax.random.split(key)
+        key, k_reset, k_coef = jax.random.split(key, 3)
         keys = jax.random.split(k_reset, n_envs)
         env_states, ts = jax.vmap(self.env.reset)(keys, jnp.zeros(n_envs, jnp.int32))
         E, A = ts.obs.shape[0], ts.obs.shape[1]
+        coefs = self._sample_coefficients(k_coef, E) if self.dynamic_coefficients else None
         return RolloutState(
             env_states=env_states,
-            obs=ts.obs,
-            share_obs=ts.share_obs,
+            obs=self.augment_share_obs(ts.obs, coefs),
+            share_obs=self.augment_share_obs(ts.share_obs, coefs),
             available_actions=ts.available_actions,
             mask=jnp.ones((E, A, 1), jnp.float32),
             rng=key,
+            objective_coefficients=coefs,
         )
 
     def collect(self, params, rollout_state: RolloutState) -> Tuple[RolloutState, Trajectory]:
@@ -87,6 +127,7 @@ class RolloutCollector:
             done_env = ts.done.all(axis=1)                      # (E,)
             next_mask = jnp.where(done_env[:, None, None], 0.0, 1.0)
             next_mask = jnp.broadcast_to(next_mask, st.mask.shape)
+            reward = ts.objectives if self.n_objective > 1 else ts.reward
             transition = dict(
                 share_obs=st.share_obs,
                 obs=st.obs,
@@ -94,19 +135,29 @@ class RolloutCollector:
                 actions=out.action,
                 log_probs=out.log_prob,
                 values=out.value,
-                rewards=ts.reward,
+                rewards=reward,
                 next_mask=next_mask,
                 delay=ts.delay,
                 payment=ts.payment,
                 done=done_env,
             )
+            if self.dynamic_coefficients:
+                # the weights in effect for THIS step; resample where the
+                # episode just ended so the next episode gets a fresh preference
+                key, k_coef = jax.random.split(key)
+                transition["objective_coefficients"] = st.objective_coefficients
+                fresh = self._sample_coefficients(k_coef, done_env.shape[0])
+                next_coefs = jnp.where(done_env[:, None], fresh, st.objective_coefficients)
+            else:
+                next_coefs = st.objective_coefficients
             new_st = RolloutState(
                 env_states=env_states,
-                obs=ts.obs,
-                share_obs=ts.share_obs,
+                obs=self.augment_share_obs(ts.obs, next_coefs),
+                share_obs=self.augment_share_obs(ts.share_obs, next_coefs),
                 available_actions=ts.available_actions,
                 mask=next_mask,
                 rng=key,
+                objective_coefficients=next_coefs,
             )
             return new_st, transition
 
@@ -127,5 +178,6 @@ class RolloutCollector:
             delays=tr["delay"],
             payments=tr["payment"],
             dones=tr["done"],
+            objective_coefficients=tr.get("objective_coefficients"),
         )
         return final_state, traj

@@ -29,7 +29,7 @@ from mat_dcml_tpu.training.ppo import MATTrainer, PPOConfig, TrainState
 from mat_dcml_tpu.training.rollout import RolloutCollector, RolloutState
 
 
-SUPPORTED_DCML_ALGOS = ("mat", "mat_dec")
+SUPPORTED_DCML_ALGOS = ("mat", "mat_dec", "momat", "dmomat", "random")
 
 
 def build_mat_policy(run: RunConfig, env: DCMLEnv) -> TransformerPolicy:
@@ -44,10 +44,15 @@ def build_mat_policy(run: RunConfig, env: DCMLEnv) -> TransformerPolicy:
             "mat_encoder/mat_decoder/mat_gru run on discrete/continuous envs "
             "via mat_dcml_tpu.models.mat_variants."
         )
+    n_objective = 2 if run.algorithm_name in ("momat", "dmomat") else run.n_objective
+    # dmomat conditions the policy on the per-episode preference weights: the
+    # collector appends them to BOTH obs and share_obs (the encoder reads obs
+    # unless encode_state, ma_transformer.py:144-149)
+    widen = n_objective if run.algorithm_name == "dmomat" else 0
     cfg = MATConfig(
         n_agent=env.n_agents,
-        obs_dim=env.obs_dim,
-        state_dim=env.share_obs_dim,
+        obs_dim=env.obs_dim + widen,
+        state_dim=env.share_obs_dim + widen,
         action_dim=env.action_dim,
         n_block=run.n_block,
         n_embd=run.n_embd,
@@ -57,7 +62,9 @@ def build_mat_policy(run: RunConfig, env: DCMLEnv) -> TransformerPolicy:
         encode_state=run.encode_state,
         dec_actor=run.dec_actor or run.algorithm_name == "mat_dec",
         share_actor=run.share_actor or run.algorithm_name == "mat_dec",
-        n_objective=run.n_objective,
+        # momat/dmomat: vector-valued critic over (completion-time, payment)
+        # channels — the reconstructed MO-MAT (SURVEY.md §2.4 missing modules)
+        n_objective=n_objective,
     )
     return TransformerPolicy(cfg)
 
@@ -78,9 +85,21 @@ class DCMLRunner:
         self.ppo_cfg = ppo
         self.log = log_fn
         self.env = env if env is not None else DCMLEnv(DCMLEnvConfig(), data_dir=data_dir)
-        self.policy = build_mat_policy(run, self.env)
-        self.trainer = MATTrainer(self.policy, ppo, total_updates=run.episodes)
-        self.collector = RolloutCollector(self.env, self.policy, run.episode_length)
+        if run.algorithm_name == "random":
+            # uniform-random-valid-actions sanity anchor (random_policy.py:79-109)
+            from mat_dcml_tpu.training.random_baseline import RandomPolicy, RandomTrainer
+
+            self.policy = RandomPolicy(self.env.n_agents, self.env.action_dim)
+            self.trainer = RandomTrainer(self.policy)
+        else:
+            self.policy = build_mat_policy(run, self.env)
+            self.trainer = MATTrainer(self.policy, ppo, total_updates=run.episodes)
+        self.collector = RolloutCollector(
+            self.env,
+            self.policy,
+            run.episode_length,
+            dynamic_coefficients=run.algorithm_name == "dmomat",
+        )
 
         self._collect = jax.jit(self.collector.collect)
         self._train = jax.jit(self.trainer.train)
@@ -118,8 +137,10 @@ class DCMLRunner:
             key, k_train = jax.random.split(key)
             train_state, metrics = self._train(train_state, traj, rollout_state, k_train)
 
-            # host-side episode metric accumulation
-            rew = np.asarray(traj.rewards).mean(axis=(2, 3))   # (T, E)
+            # host-side episode metric accumulation (one device->host copy)
+            rew_arr = np.asarray(traj.rewards)                 # (T, E, A, n_obj)
+            # sum objective channels (== scalar reward), mean over agents
+            rew = rew_arr.sum(axis=3).mean(axis=2)             # (T, E)
             delays = np.asarray(traj.delays)
             pays = np.asarray(traj.payments)
             dones = np.asarray(traj.dones)
@@ -144,13 +165,17 @@ class DCMLRunner:
                     "episode": episode,
                     "total_steps": total_steps,
                     "fps": fps,
-                    "average_step_rewards": float(np.asarray(traj.rewards).mean()),
+                    "average_step_rewards": float(rew_arr.sum(-1).mean()),
                     "value_loss": float(metrics.value_loss),
                     "policy_loss": float(metrics.policy_loss),
                     "dist_entropy": float(metrics.dist_entropy),
                     "grad_norm": float(metrics.grad_norm),
                     "ratio": float(metrics.ratio),
                 }
+                if rew_arr.shape[-1] > 1:
+                    # per-objective channel means (dcml_runner.py:306-309)
+                    for i in range(rew_arr.shape[-1]):
+                        record[f"average_step_objective_{i}"] = float(rew_arr[..., i].mean())
                 if done_rewards:
                     record["aver_episode_rewards"] = float(np.mean(done_rewards))
                     record["aver_episode_delays"] = float(np.mean(done_delays))
@@ -158,7 +183,7 @@ class DCMLRunner:
                     done_rewards, done_delays, done_payments = [], [], []
                 self._log_record(record)
 
-            if episode % run.save_interval == 0 or episode == episodes - 1:
+            if (episode % run.save_interval == 0 or episode == episodes - 1) and run.algorithm_name != "random":
                 self.ckpt.save(episode, train_state)
 
             if run.use_eval and episode % run.eval_interval == 0:
@@ -204,7 +229,14 @@ class DCMLRunner:
         def eval_step(params, st: RolloutState):
             action = act(params, st)
             env_states, ts = jax.vmap(self.env.step)(st.env_states, action)
-            new_st = RolloutState(env_states, ts.obs, ts.share_obs, ts.available_actions, st.mask, st.rng)
+            coefs = st.objective_coefficients
+            new_st = RolloutState(
+                env_states,
+                self.collector.augment_share_obs(ts.obs, coefs),
+                self.collector.augment_share_obs(ts.share_obs, coefs),
+                ts.available_actions, st.mask, st.rng,
+                objective_coefficients=coefs,
+            )
             return new_st, (ts.reward.mean(), ts.delay.mean(), ts.payment.mean())
 
         rewards, delays, payments = [], [], []

@@ -59,4 +59,5 @@ class TestRandomPolicy:
         state = tr.init_state(pol.init_params(jax.random.key(0)))
         state2, metrics = tr.train(state)
         assert state2 is state
-        assert float(metrics["policy_loss"]) == 0.0
+        assert float(metrics.policy_loss) == 0.0
+        assert float(metrics.ratio) == 1.0
