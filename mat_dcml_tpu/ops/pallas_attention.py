@@ -1,0 +1,219 @@
+"""Pallas TPU kernel: fused masked multi-head attention over the agent axis.
+
+The reference's hot op is QKV attention over the (≤101-token) agent sequence
+(``ma_transformer.py:45-69``): on GPU it runs as 4+ separate CUDA kernels
+(matmul, mask-add, softmax, matmul) with HBM round-trips between them.  Here
+the whole ``softmax(mask(qk^T)) v`` chain is one VMEM-resident fused kernel.
+
+Because the sequence is tiny (no flash tiling needed) but the batch is huge
+(thousands of vmapped envs), the grid runs over GROUPS of flattened
+(batch*head) rows — ``_GROUP`` rows of the whole attention problem per grid
+cell as one batched ``dot_general`` — rather than one cell per (batch, head),
+which drowned in per-cell overhead (measured 2x slower than XLA at B=256;
+grouping amortizes it).
+
+A custom VJP keeps the op differentiable: the backward pass is a second fused
+kernel that recomputes the (cheap) probability matrix instead of storing it —
+the flash-attention trade, profitable here because L² at L≤128 is smaller
+than the HBM round-trip it avoids.
+
+Interpret mode (``interpret=True``) runs the same kernels on CPU for the unit
+tests (SURVEY.md §7.1: "drop-in vs jnp reference, unit-tested for equality").
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+import os as _os
+
+from mat_dcml_tpu.ops.attention import NEG_INF
+
+
+def _group_size() -> int:
+    try:
+        g = int(_os.environ.get("MAT_DCML_TPU_ATTN_GROUP", "16"))
+    except ValueError as e:
+        raise ValueError("MAT_DCML_TPU_ATTN_GROUP must be a positive integer") from e
+    if g < 1:
+        raise ValueError(f"MAT_DCML_TPU_ATTN_GROUP must be >= 1, got {g}")
+    return g
+
+
+def _apply_masks(s: jax.Array, m: jax.Array | None, causal: bool) -> jax.Array:
+    """Mask a (G, Lq, Lk) score block with a (G, Lk) kv mask + causal tril."""
+    g, lq, lk = s.shape
+    if m is not None:
+        s = jnp.where(m[:, None, :] > 0, s, NEG_INF)
+    if causal:
+        row = jax.lax.broadcasted_iota(jnp.int32, (lq, lk), 0)
+        col = jax.lax.broadcasted_iota(jnp.int32, (lq, lk), 1)
+        s = jnp.where((col <= row)[None], s, NEG_INF)
+    return s
+
+
+_BATCH_QKT = (((2,), (2,)), ((0,), (0,)))   # (G,Lq,D) x (G,Lk,D) -> (G,Lq,Lk)
+_BATCH_PV = (((2,), (1,)), ((0,), (0,)))    # (G,Lq,Lk) x (G,Lk,D) -> (G,Lq,D)
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, *rest, causal: bool, scale: float, has_mask: bool):
+    m_ref, o_ref = rest if has_mask else (None, *rest)
+    q = q_ref[...].astype(jnp.float32)           # (G, Lq, Dh)
+    k = k_ref[...].astype(jnp.float32)           # (G, Lk, Dh)
+    v = v_ref[...].astype(jnp.float32)
+    s = jax.lax.dot_general(q, k, _BATCH_QKT, preferred_element_type=jnp.float32) * scale
+    s = _apply_masks(s, m_ref[...] if has_mask else None, causal)
+    p = jax.nn.softmax(s, axis=-1)
+    o_ref[...] = jax.lax.dot_general(p, v, _BATCH_PV, preferred_element_type=jnp.float32).astype(o_ref.dtype)
+
+
+def _bwd_kernel(q_ref, k_ref, v_ref, *rest, causal: bool, scale: float, has_mask: bool):
+    m_ref, do_ref, dq_ref, dk_ref, dv_ref = rest if has_mask else (None, *rest)
+    q = q_ref[...].astype(jnp.float32)
+    k = k_ref[...].astype(jnp.float32)
+    v = v_ref[...].astype(jnp.float32)
+    do = do_ref[...].astype(jnp.float32)
+    # recompute probabilities (flash-style) instead of saving them
+    s = jax.lax.dot_general(q, k, _BATCH_QKT, preferred_element_type=jnp.float32) * scale
+    s = _apply_masks(s, m_ref[...] if has_mask else None, causal)
+    p = jax.nn.softmax(s, axis=-1)
+    # dv = p^T do ; dp = do v^T ; ds = p*(dp - rowsum(dp*p)) ; dq = ds k ; dk = ds^T q
+    dv = jax.lax.dot_general(p, do, (((1,), (1,)), ((0,), (0,))), preferred_element_type=jnp.float32)
+    dp = jax.lax.dot_general(do, v, (((2,), (2,)), ((0,), (0,))), preferred_element_type=jnp.float32)
+    ds = p * (dp - jnp.sum(dp * p, axis=-1, keepdims=True))
+    dq = jax.lax.dot_general(ds, k, (((2,), (1,)), ((0,), (0,))), preferred_element_type=jnp.float32) * scale
+    dk = jax.lax.dot_general(ds, q, (((1,), (1,)), ((0,), (0,))), preferred_element_type=jnp.float32) * scale
+    dq_ref[...] = dq.astype(dq_ref.dtype)
+    dk_ref[...] = dk.astype(dk_ref.dtype)
+    dv_ref[...] = dv.astype(dv_ref.dtype)
+
+
+def _row_spec(g: int, l: int, dh: int) -> pl.BlockSpec:
+    return pl.BlockSpec((g, l, dh), lambda i: (i, 0, 0), memory_space=pltpu.VMEM)
+
+
+def _mask_spec(g: int, lk: int) -> pl.BlockSpec:
+    return pl.BlockSpec((g, lk), lambda i: (i, 0), memory_space=pltpu.VMEM)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
+def _fused_attention(q, k, v, mask, causal: bool, interpret: bool):
+    return _fused_attention_fwd(q, k, v, mask, causal, interpret)[0]
+
+
+def _fused_attention_fwd(q, k, v, mask, causal: bool, interpret: bool):
+    N, Lq, Dh = q.shape
+    Lk = k.shape[1]
+    g = min(_group_size(), N)
+    has_mask = mask is not None
+    in_specs = [_row_spec(g, Lq, Dh), _row_spec(g, Lk, Dh), _row_spec(g, Lk, Dh)]
+    inputs = [q, k, v]
+    if has_mask:
+        in_specs.append(_mask_spec(g, Lk))
+        inputs.append(mask)
+    out = pl.pallas_call(
+        functools.partial(
+            _fwd_kernel, causal=causal, scale=1.0 / math.sqrt(Dh), has_mask=has_mask
+        ),
+        out_shape=jax.ShapeDtypeStruct((N, Lq, Dh), q.dtype),
+        grid=(N // g,),
+        in_specs=in_specs,
+        out_specs=_row_spec(g, Lq, Dh),
+        interpret=interpret,
+    )(*inputs)
+    return out, (q, k, v, mask)
+
+
+def _fused_attention_bwd(causal: bool, interpret: bool, res, do):
+    q, k, v, mask = res
+    N, Lq, Dh = q.shape
+    Lk = k.shape[1]
+    g = min(_group_size(), N)
+    has_mask = mask is not None
+    in_specs = [_row_spec(g, Lq, Dh), _row_spec(g, Lk, Dh), _row_spec(g, Lk, Dh)]
+    inputs = [q, k, v]
+    if has_mask:
+        in_specs.append(_mask_spec(g, Lk))
+        inputs.append(mask)
+    in_specs.append(_row_spec(g, Lq, Dh))
+    inputs.append(do)
+    dq, dk, dv = pl.pallas_call(
+        functools.partial(
+            _bwd_kernel, causal=causal, scale=1.0 / math.sqrt(Dh), has_mask=has_mask
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct(q.shape, q.dtype),
+            jax.ShapeDtypeStruct(k.shape, k.dtype),
+            jax.ShapeDtypeStruct(v.shape, v.dtype),
+        ),
+        grid=(N // g,),
+        in_specs=in_specs,
+        out_specs=(_row_spec(g, Lq, Dh), _row_spec(g, Lk, Dh), _row_spec(g, Lk, Dh)),
+        interpret=interpret,
+    )(*inputs)
+    dmask = jnp.zeros_like(mask) if has_mask else None
+    return dq, dk, dv, dmask
+
+
+_fused_attention.defvjp(_fused_attention_fwd, _fused_attention_bwd)
+
+
+def fused_masked_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = False,
+    kv_mask: jax.Array | None = None,
+    interpret: bool = False,
+) -> jax.Array:
+    """Pallas drop-in for ``ops.attention.multi_head_attention``.
+
+    Same contract: ``q (B,H,Lq,Dh)``, ``k/v (B,H,Lk,Dh)``, optional causal
+    mask (requires Lq == Lk) and ``(Lk,)`` / ``(B, Lk)`` kv validity mask.
+    """
+    B, H, Lq, Dh = q.shape
+    Lk = k.shape[2]
+    if causal:
+        assert Lq == Lk, "causal attention requires Lq == Lk"
+    # flatten (B, H) -> rows; mask is per-batch, repeated per head; the
+    # no-mask (encoder) hot path skips the mask input entirely (static flag)
+    if kv_mask is None:
+        mask_rows = None
+    elif kv_mask.ndim == 1:
+        mask_rows = jnp.broadcast_to(kv_mask.astype(jnp.float32)[None, :], (B * H, Lk))
+    else:
+        mask_rows = jnp.repeat(kv_mask.astype(jnp.float32), H, axis=0)
+
+    qf = q.reshape(B * H, Lq, Dh)
+    kf = k.reshape(B * H, Lk, Dh)
+    vf = v.reshape(B * H, Lk, Dh)
+
+    # Mosaic computes (rows < 8)-sublane matmul tiles at reduced precision
+    # (observed ~1e-3 drift at Lq=1 on hardware, exact at Lq >= 8); pad the
+    # query rows to a full sublane tile and slice back.  Zero do-rows
+    # contribute zero to dk/dv, so the custom VJP is unaffected.
+    lq_pad = max(Lq, 8)
+    if lq_pad != Lq:
+        qf = jnp.pad(qf, ((0, 0), (0, lq_pad - Lq), (0, 0)))
+
+    # pad the flattened row count to a multiple of the group size (padded mask
+    # rows are all-ones; their outputs are sliced away)
+    n = B * H
+    g = min(_group_size(), n)
+    n_pad = -n % g
+    if n_pad:
+        qf = jnp.pad(qf, ((0, n_pad), (0, 0), (0, 0)))
+        kf = jnp.pad(kf, ((0, n_pad), (0, 0), (0, 0)))
+        vf = jnp.pad(vf, ((0, n_pad), (0, 0), (0, 0)))
+        if mask_rows is not None:
+            mask_rows = jnp.pad(mask_rows, ((0, n_pad), (0, 0)), constant_values=1.0)
+
+    out = _fused_attention(qf, kf, vf, mask_rows, causal, interpret)
+    return out[:n, :Lq].reshape(B, H, Lq, Dh)
