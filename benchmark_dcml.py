@@ -1,0 +1,151 @@
+#!/usr/bin/env python
+"""DCML benchmark sweep: deterministic preset-replay evaluation.
+
+Reproduces ``DCML_MAT_ALT_Benchmark.py``: load a trained checkpoint, sweep one
+env factor over N settings (default: worker disable rate = i*8 over 11
+settings), run ``n_steps`` deterministic-policy steps per setting on the
+preset fixture with stride-batched decode (stride=10), and write the mean
+completion-time / payment arrays as ``.npy`` (same two-save layout as the
+reference's ``dcml_BMAT_*.npy``) plus a JSON-lines summary.
+
+Usage:
+    python benchmark_dcml.py --model_dir results/DCML/AS/mat/check/models \
+        --sweep disable_rate --n_steps 1000 --stride 10 --out results/bmat
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+from pathlib import Path
+
+from mat_dcml_tpu.utils.platform import apply_platform_override
+
+apply_platform_override()
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from mat_dcml_tpu.config import RunConfig
+from mat_dcml_tpu.envs.dcml import DCMLEnv, DCMLEnvConfig
+from mat_dcml_tpu.envs.dcml.preset import PresetData, load_sample, modify_preset
+from mat_dcml_tpu.training.checkpoint import CheckpointManager
+from mat_dcml_tpu.training.runner import build_mat_policy
+
+
+# Sweep definitions from the benchmark script's (partly commented) variants
+# (``DCML_MAT_ALT_Benchmark.py:115-123``): value for iteration i.
+SWEEPS = {
+    "disable_rate": lambda i: dict(disable_rate=i * 8),
+    "R": lambda i: dict(r=round((i + 1) * (2**20) / 10), c=2**9),
+    "C": lambda i: dict(r=2**19, c=(i + 1) * (2**10) / 10),
+    "Pr": lambda i: dict(r=2**19, c=2**9, pr=i * 0.1),
+}
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(description="DCML deterministic benchmark sweep", allow_abbrev=False)
+    p.add_argument("--model_dir", default=None, help="Orbax checkpoint dir (runs random-init if omitted)")
+    p.add_argument("--ckpt_step", type=int, default=None, help="checkpoint step (default: latest)")
+    p.add_argument("--sweep", choices=sorted(SWEEPS), default="disable_rate")
+    p.add_argument("--n_iter", type=int, default=11)
+    p.add_argument("--n_steps", type=int, default=1000)
+    p.add_argument("--stride", type=int, default=10)
+    p.add_argument("--sample", type=int, default=1, help="which Sample_<k> fixture to replay")
+    p.add_argument("--data_dir", default="data")
+    p.add_argument("--out", default="results/dcml_benchmark_sweep")
+    p.add_argument("--seed", type=int, default=1)
+    # model hyperparameters (must match the checkpoint)
+    p.add_argument("--n_block", type=int, default=2)
+    p.add_argument("--n_embd", type=int, default=64)
+    p.add_argument("--n_head", type=int, default=2)
+    p.add_argument("--algorithm_name", default="mat")
+    return p.parse_args(argv)
+
+
+def run_setting(env: DCMLEnv, policy, params, n_steps: int, stride: int, seed: int):
+    """One sweep setting: n_steps deterministic steps on the preset env.
+
+    The whole loop is a single jitted ``lax.scan`` (vs the reference's Python
+    loop of 1000 separate forward passes, ``DCML_MAT_ALT_Benchmark.py:125-138``).
+    """
+
+    def step_fn(carry, _):
+        state, ts = carry
+        out = policy.act_stride(
+            params, ts.share_obs[None], ts.obs[None], ts.available_actions[None], stride=stride
+        )
+        state, ts = env.step(state, out.action[0])
+        return (state, ts), (ts.reward[0, 0], ts.delay, ts.payment)
+
+    @jax.jit
+    def sweep_run(key):
+        state, ts = env.reset(key, 0)
+        _, (rewards, cts, payments) = jax.lax.scan(step_fn, (state, ts), None, length=n_steps)
+        return rewards, cts, payments
+
+    rewards, cts, payments = sweep_run(jax.random.key(seed))
+    return np.asarray(rewards), np.asarray(cts), np.asarray(payments)
+
+
+def main(argv=None):
+    args = parse_args(argv)
+    run_cfg = RunConfig(
+        algorithm_name=args.algorithm_name,
+        n_block=args.n_block, n_embd=args.n_embd, n_head=args.n_head,
+    )
+    base = load_sample(Path(args.data_dir) / "dcml_benchmark", sample=args.sample)
+
+    # any env instance works for building the policy (dims are constants)
+    proto_env = DCMLEnv(DCMLEnvConfig(preset=True), data_dir=args.data_dir)
+    policy = build_mat_policy(run_cfg, proto_env)
+    if args.model_dir:
+        restored = CheckpointManager(args.model_dir).restore(args.ckpt_step)
+        if restored is None:
+            raise FileNotFoundError(f"no checkpoint found under {args.model_dir}")
+        params = restored["params"]
+        print(f"restored checkpoint from {args.model_dir}")
+    else:
+        params = policy.init_params(jax.random.key(args.seed))
+        print("WARNING: no --model_dir, benchmarking a random-init policy")
+
+    out_prefix = Path(args.out)
+    out_prefix.parent.mkdir(parents=True, exist_ok=True)
+    w_cts, w_payments, records = [], [], []
+    t0 = time.time()
+    for i in range(args.n_iter):
+        setting = SWEEPS[args.sweep](i)
+        data = modify_preset(base, **setting)
+        env = DCMLEnv(
+            DCMLEnvConfig(preset=True),
+            preset_master=data.master,
+            preset_worker_prs=data.worker_prs,
+            preset_disable_rates=data.disable_rates,
+            data_dir=args.data_dir,
+        )
+        rewards, cts, payments = run_setting(env, policy, params, args.n_steps, args.stride, args.seed)
+        rec = {
+            "sweep": args.sweep, "iter": i, "setting": setting,
+            "reward": float(rewards.mean()), "ct": float(cts.mean()),
+            "payment": float(payments.mean()), "n_steps": args.n_steps,
+        }
+        records.append(rec)
+        w_cts.append([rec["ct"]])
+        w_payments.append([rec["payment"]])
+        print(f"[{i + 1}/{args.n_iter}] {setting} -> reward {rec['reward']:.3f} "
+              f"ct {rec['ct']:.4f} payment {rec['payment']:.3f}")
+
+    # reference output layout: two stacked saves, (N_ITER, 1) each
+    with open(f"{out_prefix}.npy", "wb") as recorder:
+        np.save(recorder, np.array(w_cts))
+        np.save(recorder, np.array(w_payments))
+    with open(f"{out_prefix}.jsonl", "w") as f:
+        for rec in records:
+            f.write(json.dumps(rec) + "\n")
+    print(f"saved {out_prefix}.npy / .jsonl in {time.time() - t0:.1f}s")
+
+
+if __name__ == "__main__":
+    main()

@@ -29,8 +29,13 @@ _IMPL_ENV = "MAT_DCML_TPU_ATTN_IMPL"
 _PALLAS_MIN_SEQ = 256
 
 
+_VALID_IMPLS = ("auto", "xla", "pallas", "pallas_interpret")
+
+
 def _resolve_impl(impl: str | None, lk: int) -> str:
     impl = impl or os.environ.get(_IMPL_ENV, "auto")
+    if impl not in _VALID_IMPLS:
+        raise ValueError(f"attention impl must be one of {_VALID_IMPLS}, got {impl!r}")
     if impl == "auto":
         if jax.default_backend() == "tpu" and lk >= _PALLAS_MIN_SEQ:
             return "pallas"

@@ -102,24 +102,37 @@ def _mask_spec(g: int, lk: int) -> pl.BlockSpec:
     return pl.BlockSpec((g, lk), lambda i: (i, 0), memory_space=pltpu.VMEM)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
-def _fused_attention(q, k, v, mask, causal: bool, interpret: bool):
-    return _fused_attention_fwd(q, k, v, mask, causal, interpret)[0]
+def _specs_and_inputs(g: int, q, k, v, mask):
+    """The (in_specs, inputs) pair shared by the fwd and bwd pallas_calls.
 
-
-def _fused_attention_fwd(q, k, v, mask, causal: bool, interpret: bool):
+    A shared mask is a single (1, Lk) row every grid cell reads (index map
+    pinned to block 0); a per-row mask is blocked like q/k/v.
+    """
     N, Lq, Dh = q.shape
     Lk = k.shape[1]
-    g = min(_group_size(), N)
-    has_mask = mask is not None
+    assert N % g == 0, f"row count {N} not divisible by group {g}"
     in_specs = [_row_spec(g, Lq, Dh), _row_spec(g, Lk, Dh), _row_spec(g, Lk, Dh)]
     inputs = [q, k, v]
-    if has_mask:
-        in_specs.append(_mask_spec(g, Lk))
+    if mask is not None:
+        if mask.shape[0] == 1:
+            in_specs.append(pl.BlockSpec((1, Lk), lambda i: (0, 0), memory_space=pltpu.VMEM))
+        else:
+            in_specs.append(_mask_spec(g, Lk))
         inputs.append(mask)
+    return in_specs, inputs
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
+def _fused_attention(q, k, v, mask, causal: bool, interpret: bool, g: int):
+    return _fused_attention_fwd(q, k, v, mask, causal, interpret, g)[0]
+
+
+def _fused_attention_fwd(q, k, v, mask, causal: bool, interpret: bool, g: int):
+    N, Lq, Dh = q.shape
+    in_specs, inputs = _specs_and_inputs(g, q, k, v, mask)
     out = pl.pallas_call(
         functools.partial(
-            _fwd_kernel, causal=causal, scale=1.0 / math.sqrt(Dh), has_mask=has_mask
+            _fwd_kernel, causal=causal, scale=1.0 / math.sqrt(Dh), has_mask=mask is not None
         ),
         out_shape=jax.ShapeDtypeStruct((N, Lq, Dh), q.dtype),
         grid=(N // g,),
@@ -130,22 +143,16 @@ def _fused_attention_fwd(q, k, v, mask, causal: bool, interpret: bool):
     return out, (q, k, v, mask)
 
 
-def _fused_attention_bwd(causal: bool, interpret: bool, res, do):
+def _fused_attention_bwd(causal: bool, interpret: bool, g: int, res, do):
     q, k, v, mask = res
     N, Lq, Dh = q.shape
     Lk = k.shape[1]
-    g = min(_group_size(), N)
-    has_mask = mask is not None
-    in_specs = [_row_spec(g, Lq, Dh), _row_spec(g, Lk, Dh), _row_spec(g, Lk, Dh)]
-    inputs = [q, k, v]
-    if has_mask:
-        in_specs.append(_mask_spec(g, Lk))
-        inputs.append(mask)
+    in_specs, inputs = _specs_and_inputs(g, q, k, v, mask)
     in_specs.append(_row_spec(g, Lq, Dh))
     inputs.append(do)
     dq, dk, dv = pl.pallas_call(
         functools.partial(
-            _bwd_kernel, causal=causal, scale=1.0 / math.sqrt(Dh), has_mask=has_mask
+            _bwd_kernel, causal=causal, scale=1.0 / math.sqrt(Dh), has_mask=mask is not None
         ),
         out_shape=(
             jax.ShapeDtypeStruct(q.shape, q.dtype),
@@ -157,7 +164,7 @@ def _fused_attention_bwd(causal: bool, interpret: bool, res, do):
         out_specs=(_row_spec(g, Lq, Dh), _row_spec(g, Lk, Dh), _row_spec(g, Lk, Dh)),
         interpret=interpret,
     )(*inputs)
-    dmask = jnp.zeros_like(mask) if has_mask else None
+    dmask = jnp.zeros_like(mask) if mask is not None else None
     return dq, dk, dv, dmask
 
 
@@ -182,12 +189,13 @@ def fused_masked_attention(
     Lk = k.shape[2]
     if causal:
         assert Lq == Lk, "causal attention requires Lq == Lk"
-    # flatten (B, H) -> rows; mask is per-batch, repeated per head; the
-    # no-mask (encoder) hot path skips the mask input entirely (static flag)
+    # flatten (B, H) -> rows; a per-batch mask is repeated per head, a shared
+    # 1D mask stays a single (1, Lk) row all grid cells read; the no-mask
+    # (encoder) hot path skips the mask input entirely (static flag)
     if kv_mask is None:
         mask_rows = None
     elif kv_mask.ndim == 1:
-        mask_rows = jnp.broadcast_to(kv_mask.astype(jnp.float32)[None, :], (B * H, Lk))
+        mask_rows = kv_mask.astype(jnp.float32)[None, :]
     else:
         mask_rows = jnp.repeat(kv_mask.astype(jnp.float32), H, axis=0)
 
@@ -212,8 +220,8 @@ def fused_masked_attention(
         qf = jnp.pad(qf, ((0, n_pad), (0, 0), (0, 0)))
         kf = jnp.pad(kf, ((0, n_pad), (0, 0), (0, 0)))
         vf = jnp.pad(vf, ((0, n_pad), (0, 0), (0, 0)))
-        if mask_rows is not None:
+        if mask_rows is not None and mask_rows.shape[0] != 1:
             mask_rows = jnp.pad(mask_rows, ((0, n_pad), (0, 0)), constant_values=1.0)
 
-    out = _fused_attention(qf, kf, vf, mask_rows, causal, interpret)
+    out = _fused_attention(qf, kf, vf, mask_rows, causal, interpret, g)
     return out[:n, :Lq].reshape(B, H, Lq, Dh)

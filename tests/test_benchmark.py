@@ -1,0 +1,136 @@
+"""Benchmark sweep CLI + preset fixture tooling.
+
+Pins the deterministic-eval protocol (SURVEY.md §3.2/§4.1): fixture
+generate/save/load roundtrip in the reference's two-file format, factor
+pinning via modify_preset, and the sweep CLI end-to-end on tiny settings.
+"""
+
+import json
+
+import jax
+import numpy as np
+import pytest
+
+import benchmark_dcml
+from mat_dcml_tpu.envs.dcml import DCMLEnv, DCMLEnvConfig
+from mat_dcml_tpu.envs.dcml.preset import (
+    generate_preset_data,
+    load_preset_data,
+    load_sample,
+    modify_preset,
+    save_preset,
+)
+
+
+class TestPresetData:
+    def test_generate_shapes_and_ranges(self):
+        rng = np.random.default_rng(0)
+        data = generate_preset_data(rng, 50)
+        assert data.master.shape == (50, 3)
+        assert data.worker_prs.shape == (50, 100)
+        assert data.disable_rates.shape == (50,)
+        assert (data.master[:, 0] >= 2**10).all()
+        assert (data.master[:, 2] <= 0.95).all()
+        assert (data.disable_rates >= 1).all() and (data.disable_rates <= 80).all()
+
+    def test_generate_with_pinned_factors(self):
+        rng = np.random.default_rng(1)
+        data = generate_preset_data(rng, 10, row=4096, probability=0.5, disable_rate=7)
+        assert (data.master[:, 0] == 4096).all()
+        assert (data.master[:, 2] == 0.5).all()
+        assert (data.disable_rates == 7).all()
+
+    def test_save_load_roundtrip_matches_shipped_format(self, tmp_path):
+        rng = np.random.default_rng(2)
+        data = generate_preset_data(rng, 8)
+        save_preset(data, tmp_path, prefix="Sample_3")
+        # loads through BOTH our loader and the env's fixture loader
+        back = load_preset_data(tmp_path, prefix="Sample_3")
+        np.testing.assert_allclose(back.master, data.master)
+        np.testing.assert_allclose(back.worker_prs, data.worker_prs)
+        np.testing.assert_array_equal(back.disable_rates, data.disable_rates)
+        back2 = load_sample(tmp_path, sample=3)
+        np.testing.assert_allclose(back2.master, data.master)
+
+    def test_shipped_fixture_loads(self):
+        data = load_sample("data/dcml_benchmark", sample=1)
+        assert data.master.shape == (1001, 3)
+        assert data.worker_prs.shape == (1001, 100)
+        assert data.disable_rates.shape == (1001,)
+
+    def test_modify_preset_pins_factors_without_mutating(self):
+        rng = np.random.default_rng(3)
+        data = generate_preset_data(rng, 5)
+        orig_dr = data.disable_rates.copy()
+        mod = modify_preset(data, r=2**19, disable_rate=16, pr=0.3)
+        assert (mod.master[:, 0] == 2**19).all()
+        assert (mod.disable_rates == 16).all()
+        assert (mod.worker_prs == 0.3).all()
+        np.testing.assert_array_equal(data.disable_rates, orig_dr)  # no mutation
+
+    def test_env_replays_modified_preset(self):
+        """disable_rate pinned at 5 -> exactly 5 unavailable workers/episode."""
+        rng = np.random.default_rng(4)
+        data = modify_preset(generate_preset_data(rng, 6), disable_rate=5, r=8192)
+        env = DCMLEnv(
+            DCMLEnvConfig(preset=True),
+            preset_master=data.master,
+            preset_worker_prs=data.worker_prs,
+            preset_disable_rates=data.disable_rates,
+            data_dir="data",
+        )
+        state, ts = env.reset(jax.random.key(0), 0)
+        assert int(state.disable_rate) == 5
+        assert int(np.asarray(state.unavailable).sum()) == 5
+        assert float(state.r_rows) == 8192.0
+        np.testing.assert_allclose(np.asarray(state.worker_prs), data.worker_prs[0], rtol=1e-6)
+
+
+class TestBenchmarkCLI:
+    def test_sweep_end_to_end_random_init(self, tmp_path):
+        out = tmp_path / "sweep"
+        benchmark_dcml.main([
+            "--n_iter", "2", "--n_steps", "4", "--stride", "10",
+            "--n_embd", "16", "--n_head", "2", "--n_block", "1",
+            "--out", str(out),
+        ])
+        with open(f"{out}.npy", "rb") as f:
+            w_cts = np.load(f)
+            w_payments = np.load(f)
+        assert w_cts.shape == (2, 1)
+        assert w_payments.shape == (2, 1)
+        assert np.isfinite(w_cts).all() and np.isfinite(w_payments).all()
+        assert (w_cts > 0).all()
+        lines = [json.loads(l) for l in open(f"{out}.jsonl")]
+        assert len(lines) == 2
+        assert lines[0]["setting"] == {"disable_rate": 0}
+        assert lines[1]["setting"] == {"disable_rate": 8}
+
+    def test_sweep_definitions_match_reference(self):
+        assert benchmark_dcml.SWEEPS["disable_rate"](3) == {"disable_rate": 24}
+        assert benchmark_dcml.SWEEPS["R"](9) == {"r": 2**20, "c": 2**9}
+        assert benchmark_dcml.SWEEPS["Pr"](10) == {"r": 2**19, "c": 2**9, "pr": 1.0}
+
+    def test_checkpoint_roundtrip_through_benchmark(self, tmp_path):
+        """Save a checkpoint via the trainer path, restore it in the CLI."""
+        from mat_dcml_tpu.config import RunConfig
+        from mat_dcml_tpu.training.checkpoint import CheckpointManager
+        from mat_dcml_tpu.training.ppo import MATTrainer, PPOConfig
+        from mat_dcml_tpu.training.runner import build_mat_policy
+
+        run = RunConfig(n_embd=16, n_head=2, n_block=1)
+        env = DCMLEnv(DCMLEnvConfig(), data_dir="data")
+        policy = build_mat_policy(run, env)
+        trainer = MATTrainer(policy, PPOConfig())
+        state = trainer.init_state(policy.init_params(jax.random.key(0)))
+        ckpt = CheckpointManager(tmp_path / "models")
+        ckpt.save(0, state)
+
+        out = tmp_path / "bm"
+        benchmark_dcml.main([
+            "--model_dir", str(tmp_path / "models"),
+            "--n_iter", "1", "--n_steps", "2", "--stride", "4",
+            "--n_embd", "16", "--n_head", "2", "--n_block", "1",
+            "--out", str(out),
+        ])
+        assert (tmp_path / "bm.jsonl").exists()

@@ -135,3 +135,27 @@ def test_group_env_var_validation(monkeypatch):
     monkeypatch.setenv("MAT_DCML_TPU_ATTN_GROUP", "abc")
     with pytest.raises(ValueError):
         fused_masked_attention(q, k, v, interpret=True)
+
+
+def test_unknown_impl_string_raises():
+    q, k, v = _qkv(jax.random.key(10), 1, 1, 8, 8, 4)
+    with pytest.raises(ValueError, match="attention impl"):
+        multi_head_attention(q, k, v, impl="PALLAS")
+
+
+def test_row_group_padding_path(monkeypatch):
+    """B*H not divisible by the group size exercises the pad/slice branch,
+    forward and backward, with and without masks."""
+    monkeypatch.setenv("MAT_DCML_TPU_ATTN_GROUP", "4")
+    q, k, v = _qkv(jax.random.key(11), 3, 2, 10, 10, 8)  # B*H = 6, pad to 8
+    ref = multi_head_attention(q, k, v, causal=True, impl="xla")
+    out = fused_masked_attention(q, k, v, causal=True, interpret=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+    bmask = jax.random.uniform(jax.random.key(12), (3, 10)) > 0.3
+    bmask = bmask.at[:, 0].set(True)
+    ref = multi_head_attention(q, k, v, kv_mask=bmask, impl="xla")
+    out = fused_masked_attention(q, k, v, kv_mask=bmask, interpret=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+    g_ref = jax.grad(lambda x: (multi_head_attention(x, k, v, causal=True, impl="xla") ** 2).sum())(q)
+    g_pl = jax.grad(lambda x: (fused_masked_attention(x, k, v, causal=True, interpret=True) ** 2).sum())(q)
+    np.testing.assert_allclose(np.asarray(g_pl), np.asarray(g_ref), atol=1e-4)
