@@ -6,7 +6,17 @@ Measures the full training loop — on-device rollout (autoregressive MAT decode
 runs at ≈7.3 env-steps/s total throughput (BASELINE.md: wall-clock between
 TensorBoard rows of the shipped training curve, ``momat_ct.csv``).
 
-Prints ONE json line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE json line on stdout: {"metric", "value", "unit", "vs_baseline"}.
+All progress/diagnostics go to stderr so machine consumers can parse stdout.
+
+Knobs (environment variables):
+  BENCH_N_ENVS          rollout batch E (default 2048 — TPU-sized)
+  BENCH_EPISODE_LENGTH  T (default 50, the reference recipe)
+  BENCH_ITERS           timed iterations (default 3)
+  BENCH_SWEEP           "1" → run an E-scaling sweep and report the best E
+  BENCH_SWEEP_ENVS      comma list for the sweep (default 128,512,2048,8192)
+  BENCH_PROFILE_DIR     if set, capture a jax.profiler trace of one timed iter
+  BENCH_BREAKDOWN       "1" → additionally time collect vs train separately
 """
 
 from __future__ import annotations
@@ -19,17 +29,43 @@ import time
 BASELINE_STEPS_PER_SEC = 7.3  # BASELINE.md, derived from momat_ct.csv timestamps
 
 
-def main() -> None:
-    # benchmark knobs (env-tunable, defaults sized for a single TPU chip)
-    E = int(os.environ.get("BENCH_N_ENVS", "32"))
-    T = int(os.environ.get("BENCH_EPISODE_LENGTH", "50"))
-    ITERS = int(os.environ.get("BENCH_ITERS", "3"))
+def log(msg: str) -> None:
+    print(f"[bench] {msg}", file=sys.stderr, flush=True)
 
+
+def _setup_jax():
+    """Import jax with a persistent compilation cache and platform fallback."""
     from mat_dcml_tpu.utils.platform import apply_platform_override
 
     apply_platform_override()
     import jax
 
+    cache_dir = os.environ.get(
+        "JAX_COMPILATION_CACHE_DIR",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache"),
+    )
+    try:
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    except Exception as e:  # cache is an optimization, never fatal
+        log(f"compilation cache unavailable: {e}")
+
+    # Graceful fallback: if the configured platform can't initialize (TPU
+    # tunnel down / chip contended), retry on CPU instead of dying.
+    fell_back = False
+    try:
+        devs = jax.devices()
+    except Exception as e:
+        log(f"default platform failed ({e!r}); falling back to CPU")
+        jax.config.update("jax_platforms", "cpu")
+        devs = jax.devices()
+        fell_back = True
+    log(f"platform={devs[0].platform} devices={len(devs)}")
+    return jax, fell_back
+
+
+def _build(jax, E: int, T: int):
     from mat_dcml_tpu.config import RunConfig
     from mat_dcml_tpu.envs.dcml import DCMLEnv, DCMLEnvConfig
     from mat_dcml_tpu.training.ppo import MATTrainer, PPOConfig
@@ -51,21 +87,86 @@ def main() -> None:
 
     collect = jax.jit(collector.collect)
     train = jax.jit(trainer.train)
+    return collect, train, train_state, rollout_state
 
-    # warmup: compile both programs and run one full iteration
+
+def _measure(jax, E: int, T: int, iters: int, profile_dir: str | None = None,
+             breakdown: bool = False) -> dict:
+    """Compile + time `iters` full collect+train iterations at batch E."""
+    t0 = time.perf_counter()
+    collect, train, train_state, rollout_state = _build(jax, E, T)
+    log(f"E={E}: built in {time.perf_counter() - t0:.1f}s, compiling...")
+
+    t0 = time.perf_counter()
     rollout_state, traj = collect(train_state.params, rollout_state)
-    train_state, metrics = train(train_state, traj, rollout_state, jax.random.key(2))
+    train_state, _ = train(train_state, traj, rollout_state, jax.random.key(2))
     jax.block_until_ready(train_state)
+    log(f"E={E}: warmup (compile + 1 iter) {time.perf_counter() - t0:.1f}s")
+
+    if profile_dir:
+        jax.profiler.start_trace(profile_dir)
 
     start = time.perf_counter()
-    for i in range(ITERS):
+    for i in range(iters):
         rollout_state, traj = collect(train_state.params, rollout_state)
-        train_state, metrics = train(train_state, traj, rollout_state, jax.random.key(3 + i))
+        train_state, _ = train(train_state, traj, rollout_state, jax.random.key(3 + i))
     jax.block_until_ready(train_state)
     elapsed = time.perf_counter() - start
 
-    steps = ITERS * E * T
-    steps_per_sec = steps / elapsed
+    if profile_dir:
+        jax.profiler.stop_trace()
+        log(f"profile trace written to {profile_dir}")
+
+    steps = iters * E * T
+    result = {"E": E, "steps_per_sec": steps / elapsed, "iter_sec": elapsed / iters}
+    log(f"E={E}: {result['steps_per_sec']:.0f} env-steps/s ({elapsed / iters:.2f}s/iter)")
+
+    if breakdown:
+        for name, fn in [("collect", lambda k: collect(train_state.params, rollout_state)),
+                         ("train", lambda k: train(train_state, traj, rollout_state, k))]:
+            t0 = time.perf_counter()
+            for i in range(iters):
+                out = fn(jax.random.key(100 + i))
+            jax.block_until_ready(out)
+            dt = (time.perf_counter() - t0) / iters
+            result[f"{name}_sec"] = dt
+            log(f"E={E}: {name} {dt:.3f}s/iter")
+    return result
+
+
+def main() -> None:
+    E = int(os.environ.get("BENCH_N_ENVS", "2048"))
+    T = int(os.environ.get("BENCH_EPISODE_LENGTH", "50"))
+    ITERS = int(os.environ.get("BENCH_ITERS", "3"))
+    sweep = os.environ.get("BENCH_SWEEP", "0") == "1"
+    profile_dir = os.environ.get("BENCH_PROFILE_DIR") or None
+    breakdown = os.environ.get("BENCH_BREAKDOWN", "0") == "1"
+
+    jax, fell_back = _setup_jax()
+    if fell_back:
+        # a CPU fallback run exists to prove liveness, not throughput — the
+        # TPU-sized default batch would grind for hours on the host
+        E, ITERS = min(E, 32), min(ITERS, 2)
+        log(f"CPU fallback: shrinking to E={E} ITERS={ITERS}")
+
+    if sweep:
+        env_list = [int(x) for x in os.environ.get(
+            "BENCH_SWEEP_ENVS", "128,512,2048,8192").split(",")]
+        if fell_back:
+            env_list = [e for e in env_list if e <= 128] or [32]
+        results = [
+            # profile the largest (last) sweep entry if a trace was requested
+            _measure(jax, e, T, ITERS, breakdown=breakdown,
+                     profile_dir=profile_dir if e == env_list[-1] else None)
+            for e in env_list
+        ]
+        best = max(results, key=lambda r: r["steps_per_sec"])
+        log("sweep results: " + json.dumps(results))
+        steps_per_sec = best["steps_per_sec"]
+    else:
+        res = _measure(jax, E, T, ITERS, profile_dir=profile_dir, breakdown=breakdown)
+        steps_per_sec = res["steps_per_sec"]
+
     print(
         json.dumps(
             {

@@ -65,29 +65,56 @@ def parse_args(argv=None):
     return p.parse_args(argv)
 
 
-def run_setting(env: DCMLEnv, policy, params, n_steps: int, stride: int, seed: int):
-    """One sweep setting: n_steps deterministic steps on the preset env.
+def make_sweep_run(env: DCMLEnv, policy, n_steps: int, stride: int, n_coef: int = 0):
+    """Build ONE jitted sweep runner reused across all settings.
 
-    The whole loop is a single jitted ``lax.scan`` (vs the reference's Python
-    loop of 1000 separate forward passes, ``DCML_MAT_ALT_Benchmark.py:125-138``).
+    The preset arrays are jit *arguments* (assigned onto the env before
+    tracing-time reads), so the compiled ``n_steps`` scan is shared by every
+    sweep setting instead of being recompiled 11 times per run.  The whole
+    loop is a single ``lax.scan`` (vs the reference's Python loop of 1000
+    separate forward passes, ``DCML_MAT_ALT_Benchmark.py:125-138``).
+
+    ``n_coef > 0`` (dmomat checkpoints) appends fixed uniform preference
+    weights to obs/share_obs to match the preference-widened policy input.
+
+    The env is shallow-copied: the traced preset assignments leave tracer
+    objects on the copy's attributes after tracing, and a private copy keeps
+    that from poisoning the caller's env for later eager use.
     """
+    import copy
 
-    def step_fn(carry, _):
+    env = copy.copy(env)
+
+    def widen(x):
+        if not n_coef:
+            return x
+        coefs = jnp.full((*x.shape[:-1], n_coef), 1.0 / n_coef, x.dtype)
+        return jnp.concatenate([x, coefs], axis=-1)
+
+    def step_fn(params, carry, _):
         state, ts = carry
         out = policy.act_stride(
-            params, ts.share_obs[None], ts.obs[None], ts.available_actions[None], stride=stride
+            params,
+            widen(ts.share_obs)[None],
+            widen(ts.obs)[None],
+            ts.available_actions[None],
+            stride=stride,
         )
         state, ts = env.step(state, out.action[0])
         return (state, ts), (ts.reward[0, 0], ts.delay, ts.payment)
 
     @jax.jit
-    def sweep_run(key):
+    def sweep_run(params, key, master, worker_prs, disable_rates):
+        env.preset_master = master
+        env.preset_worker_prs = worker_prs
+        env.preset_disable_rates = disable_rates
         state, ts = env.reset(key, 0)
-        _, (rewards, cts, payments) = jax.lax.scan(step_fn, (state, ts), None, length=n_steps)
+        _, (rewards, cts, payments) = jax.lax.scan(
+            lambda c, x: step_fn(params, c, x), (state, ts), None, length=n_steps
+        )
         return rewards, cts, payments
 
-    rewards, cts, payments = sweep_run(jax.random.key(seed))
-    return np.asarray(rewards), np.asarray(cts), np.asarray(payments)
+    return sweep_run
 
 
 def main(argv=None):
@@ -113,19 +140,21 @@ def main(argv=None):
 
     out_prefix = Path(args.out)
     out_prefix.parent.mkdir(parents=True, exist_ok=True)
+    n_coef = policy.cfg.n_objective if args.algorithm_name == "dmomat" else 0
+    sweep_run = make_sweep_run(proto_env, policy, args.n_steps, args.stride, n_coef=n_coef)
     w_cts, w_payments, records = [], [], []
     t0 = time.time()
     for i in range(args.n_iter):
         setting = SWEEPS[args.sweep](i)
         data = modify_preset(base, **setting)
-        env = DCMLEnv(
-            DCMLEnvConfig(preset=True),
-            preset_master=data.master,
-            preset_worker_prs=data.worker_prs,
-            preset_disable_rates=data.disable_rates,
-            data_dir=args.data_dir,
+        rewards, cts, payments = sweep_run(
+            params,
+            jax.random.key(args.seed),
+            jnp.asarray(data.master, jnp.float32),
+            jnp.asarray(data.worker_prs, jnp.float32),
+            jnp.asarray(data.disable_rates, jnp.int32),
         )
-        rewards, cts, payments = run_setting(env, policy, params, args.n_steps, args.stride, args.seed)
+        rewards, cts, payments = np.asarray(rewards), np.asarray(cts), np.asarray(payments)
         rec = {
             "sweep": args.sweep, "iter": i, "setting": setting,
             "reward": float(rewards.mean()), "ct": float(cts.mean()),

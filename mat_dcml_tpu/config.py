@@ -78,11 +78,32 @@ def _add_dataclass_args(parser: argparse.ArgumentParser, dc) -> None:
 
 
 def parse_cli(argv=None) -> tuple[RunConfig, PPOConfig]:
-    run, ppo = dcml_default_configs()
-    parser = argparse.ArgumentParser(description="mat_dcml_tpu trainer", allow_abbrev=False)
+    run, ppo, _ = parse_cli_with_extras(argv)
+    return run, ppo
+
+
+def parse_cli_with_extras(
+    argv=None,
+    extras: Optional[argparse.ArgumentParser] = None,
+    overrides: Optional[dict] = None,
+) -> tuple[RunConfig, PPOConfig, argparse.Namespace]:
+    """Strict CLI with optional entry-point-specific flags.
+
+    ``extras``: a parent parser contributing additional arguments (returned via
+    the namespace).  ``overrides``: per-entry-point defaults (e.g. MPE's
+    ``episode_length=25``), replacing the reference's per-script ``parse_args``
+    shims (``train_mpe.py:21-40``).
+    """
+    rc_fields = {f.name for f in dataclasses.fields(RunConfig)}
+    run = RunConfig(**{k: v for k, v in (overrides or {}).items() if k in rc_fields})
+    ppo = PPOConfig()
+    parents = [extras] if extras is not None else []
+    parser = argparse.ArgumentParser(
+        description="mat_dcml_tpu trainer", allow_abbrev=False, parents=parents
+    )
     _add_dataclass_args(parser, run)
     _add_dataclass_args(parser, ppo)
     ns = parser.parse_args(argv)  # strict: unknown flags raise
     run_kwargs = {f.name: getattr(ns, f.name) for f in dataclasses.fields(RunConfig)}
     ppo_kwargs = {f.name: getattr(ns, f.name) for f in dataclasses.fields(PPOConfig)}
-    return RunConfig(**run_kwargs), PPOConfig(**ppo_kwargs)
+    return RunConfig(**run_kwargs), PPOConfig(**ppo_kwargs), ns

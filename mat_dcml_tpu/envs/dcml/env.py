@@ -441,14 +441,14 @@ def load_base_workloads(path: Path, consts: DCMLConsts) -> np.ndarray:
     traces = []
     with open(path, "rb") as reader:
         for _ in range(consts.worker_number_max):
-            traces.append(np.load(reader, allow_pickle=True))
+            traces.append(np.load(reader, allow_pickle=False))
     return np.stack(traces).astype(np.float32)
 
 
 def load_preset(bench_dir: Path, sample: int = 1):
     """Load one of the 10 shipped eval fixtures (1001 episodes each)."""
     with open(bench_dir / f"Sample_{sample}master_states.npy", "rb") as f:
-        master = np.load(f, allow_pickle=True)
+        master = np.load(f, allow_pickle=False)
     with open(bench_dir / f"Sample_{sample}worker_states.npy", "rb") as f:
         worker_prs = np.load(f, allow_pickle=False)
         disable_rates = np.load(f, allow_pickle=False)

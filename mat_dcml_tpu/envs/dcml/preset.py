@@ -113,7 +113,7 @@ def save_preset(data: PresetData, dir_name: str | Path, prefix: str = "") -> Non
 def load_preset_data(dir_name: str | Path, prefix: str = "") -> PresetData:
     d = Path(dir_name)
     with open(d / f"{prefix}master_states.npy", "rb") as f:
-        master = np.load(f, allow_pickle=True)
+        master = np.load(f, allow_pickle=False)
     with open(d / f"{prefix}worker_states.npy", "rb") as f:
         worker_prs = np.load(f, allow_pickle=False)
         disable_rates = np.load(f, allow_pickle=False)

@@ -1,0 +1,18 @@
+from mat_dcml_tpu.envs.mpe.simple_spread import (
+    SimpleSpreadConfig,
+    SimpleSpreadEnv,
+    SpreadState,
+    SpreadTimeStep,
+)
+
+# scenario registry (reference: mat/envs/mpe/scenarios/__init__.py load());
+# simple_spread is the one used by the shipped MPE training recipe
+SCENARIOS = {"simple_spread": (SimpleSpreadEnv, SimpleSpreadConfig)}
+
+__all__ = [
+    "SimpleSpreadConfig",
+    "SimpleSpreadEnv",
+    "SpreadState",
+    "SpreadTimeStep",
+    "SCENARIOS",
+]

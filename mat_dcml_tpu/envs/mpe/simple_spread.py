@@ -1,0 +1,202 @@
+"""Pure-JAX MPE ``simple_spread`` (cooperative navigation).
+
+A vectorizable rewrite of the reference's vendored multi-agent particle env
+(``mat_src/mat/envs/mpe/core.py`` physics + ``environment.py`` step protocol +
+``scenarios/simple_spread.py`` scenario): N agents move in a 2-D plane to
+cover M landmarks while avoiding collisions.  The reference runs one Python
+object graph per env inside subprocess workers; here the whole world is a
+small pytree and ``step`` is an array program — ``vmap`` it over thousands of
+envs.
+
+Faithful semantics:
+
+- Discrete(5) actions decoded as force ``u = (a1-a2, a3-a4) * sensitivity(5)``
+  (``environment.py:249-264``, one-hot branch; agents accept integer indices
+  and one-hot internally like the MPE runner's conversion,
+  ``mpe_runner.py:165-177``).
+- Physics: damped velocity integration ``v = v(1-damping) + F/m·dt``;
+  softmax-penetration collision forces between agent pairs
+  (``core.py:265-279,310-322``): ``F = k_c·Δ/|Δ|·margin·log(1+e^(-(|Δ|-d_min)/margin))``.
+- Reward (``scenarios/simple_spread.py:71-82``): shared team reward
+  ``N·(-Σ_l min_a |a-l|) - Σ_a collisions(a)``; NOTE the reference counts each
+  agent's self-collision (``is_collision(a, a)`` is True), a constant ``-N``
+  offset, replicated for parity.
+- Obs per agent (``scenarios/simple_spread.py:84-116`` + id feats appended by
+  ``environment.py:140-142``): ``[vel(2), pos(2), landmark_rel(2M),
+  other_pos(2(N-1)), comm(2(N-1))≡0, one_hot_id(N)]``.
+- Episode ends after ``episode_length`` steps (``environment.py:205-210``);
+  auto-reset inside ``step`` returns the new episode's obs with the final
+  step's reward (``env_wrappers.py:305-313`` worker semantics).
+- Reset draws: agent pos ~ U(-1,1)², landmark pos ~ 0.8·U(-1,1)², zero
+  velocities (``scenarios/simple_spread.py:37-45``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+class SpreadState(NamedTuple):
+    rng: jax.Array
+    agent_pos: jax.Array      # (N, 2)
+    agent_vel: jax.Array      # (N, 2)
+    landmark_pos: jax.Array   # (M, 2)
+    t: jax.Array              # int32 step counter
+
+
+class SpreadTimeStep(NamedTuple):
+    obs: jax.Array
+    share_obs: jax.Array
+    available_actions: jax.Array
+    reward: jax.Array
+    done: jax.Array
+    delay: jax.Array          # protocol compat (unused; zeros)
+    payment: jax.Array
+
+
+@dataclasses.dataclass(frozen=True)
+class SimpleSpreadConfig:
+    n_agents: int = 3
+    n_landmarks: int = 3
+    episode_length: int = 25   # world.world_length default (core.py:136)
+    agent_size: float = 0.15   # scenarios/simple_spread.py:21
+    landmark_size: float = 0.05  # Entity default (core.py:53)
+    dt: float = 0.1
+    damping: float = 0.25
+    contact_force: float = 1e2
+    contact_margin: float = 1e-3
+    sensitivity: float = 5.0   # environment.py:261
+    dim_c: int = 2             # communication dim (silent agents -> zeros)
+
+
+class SimpleSpreadEnv:
+    """Functional env bundle; same TimeStep protocol as the DCML env."""
+
+    def __init__(self, cfg: SimpleSpreadConfig = SimpleSpreadConfig()):
+        self.cfg = cfg
+        N, M = cfg.n_agents, cfg.n_landmarks
+        self.n_agents = N
+        # vel2 + pos2 + 2M + 2(N-1) + comm 2(N-1) + id N
+        self.obs_dim = 4 + 2 * M + (2 + cfg.dim_c) * (N - 1) + N
+        self.share_obs_dim = self.obs_dim * N
+        self.action_dim = 5  # Discrete(world.dim_p * 2 + 1) (environment.py:64)
+
+    # ----------------------------------------------------------------- reset
+
+    def _spawn(self, key: jax.Array) -> SpreadState:
+        c = self.cfg
+        key, k_a, k_l = jax.random.split(key, 3)
+        agent_pos = jax.random.uniform(k_a, (c.n_agents, 2), minval=-1.0, maxval=1.0)
+        landmark_pos = 0.8 * jax.random.uniform(k_l, (c.n_landmarks, 2), minval=-1.0, maxval=1.0)
+        return SpreadState(
+            rng=key,
+            agent_pos=agent_pos,
+            agent_vel=jnp.zeros((c.n_agents, 2)),
+            landmark_pos=landmark_pos,
+            t=jnp.zeros((), jnp.int32),
+        )
+
+    def reset(self, key: jax.Array, episode_idx=0) -> Tuple[SpreadState, SpreadTimeStep]:
+        del episode_idx
+        state = self._spawn(key)
+        obs, share, avail = self._observe(state)
+        N = self.cfg.n_agents
+        zero = jnp.zeros(())
+        ts = SpreadTimeStep(
+            obs, share, avail,
+            jnp.zeros((N, 1)), jnp.zeros((N,), bool), zero, zero,
+        )
+        return state, ts
+
+    # ------------------------------------------------------------------ step
+
+    def step(self, state: SpreadState, action: jax.Array) -> Tuple[SpreadState, SpreadTimeStep]:
+        c = self.cfg
+        N = c.n_agents
+        act = action.reshape(N, -1)
+        # integer index -> one-hot (the MPE runner's conversion,
+        # mpe_runner.py:165-177); one-hot vectors pass through
+        if act.shape[-1] == 1:
+            onehot = jax.nn.one_hot(act[:, 0].astype(jnp.int32), 5)
+        else:
+            onehot = act.astype(jnp.float32)
+        u = jnp.stack(
+            [onehot[:, 1] - onehot[:, 2], onehot[:, 3] - onehot[:, 4]], axis=1
+        ) * c.sensitivity  # (environment.py:249-264)
+
+        # pairwise agent collision forces (core.py:310-322)
+        delta = state.agent_pos[:, None, :] - state.agent_pos[None, :, :]  # (N, N, 2)
+        dist = jnp.sqrt(jnp.sum(delta**2, axis=-1) + 1e-12)
+        dist_min = 2.0 * c.agent_size
+        k = c.contact_margin
+        penetration = jnp.logaddexp(0.0, -(dist - dist_min) / k) * k
+        force_mag = c.contact_force * penetration / dist  # (N, N)
+        off_diag = 1.0 - jnp.eye(N)
+        pair_force = delta * (force_mag * off_diag)[..., None]  # force on i from j
+        coll_force = pair_force.sum(axis=1)
+
+        # integrate (core.py:265-279); mass=1, accel=None, no max_speed
+        vel = state.agent_vel * (1.0 - c.damping) + (u + coll_force) * c.dt
+        pos = state.agent_pos + vel * c.dt
+
+        stepped = SpreadState(state.rng, pos, vel, state.landmark_pos, state.t + 1)
+        reward = self._reward(stepped)
+        done_now = stepped.t >= c.episode_length
+
+        # auto-reset on episode end (env_wrappers.py:305-313)
+        fresh = self._spawn(state.rng)
+        new_state = jax.tree.map(
+            lambda a, b: jnp.where(done_now, a, b), fresh, stepped
+        )
+        obs, share, avail = self._observe(new_state)
+        zero = jnp.zeros(())
+        ts = SpreadTimeStep(
+            obs, share, avail,
+            jnp.broadcast_to(reward, (N, 1)),
+            jnp.broadcast_to(done_now, (N,)),
+            zero, zero,
+        )
+        return new_state, ts
+
+    def _reward(self, state: SpreadState) -> jax.Array:
+        """Shared team reward (``scenarios/simple_spread.py:71-82`` summed over
+        agents by ``environment.py:154-157``)."""
+        c = self.cfg
+        N = c.n_agents
+        d = jnp.linalg.norm(
+            state.agent_pos[:, None, :] - state.landmark_pos[None, :, :], axis=-1
+        )  # (N, M)
+        min_dists = d.min(axis=0).sum()
+        # collisions: every pair within 2*size, self-pairs included (the
+        # reference's is_collision(a, a) == True quirk)
+        ad = jnp.linalg.norm(
+            state.agent_pos[:, None, :] - state.agent_pos[None, :, :], axis=-1
+        )
+        n_coll = (ad < 2.0 * c.agent_size).sum()
+        return -N * min_dists - n_coll.astype(jnp.float32)
+
+    # ------------------------------------------------------------------- obs
+
+    def _observe(self, state: SpreadState):
+        c = self.cfg
+        N, M = c.n_agents, c.n_landmarks
+        landmark_rel = (state.landmark_pos[None, :, :] - state.agent_pos[:, None, :]).reshape(N, 2 * M)
+        # other agents' relative positions, in agent order with self removed
+        rel = state.agent_pos[None, :, :] - state.agent_pos[:, None, :]  # (N, N, 2)
+        idx = jnp.arange(N)
+        # gather the N-1 "others" rows per agent: for agent i take j != i in order
+        others = jax.vmap(
+            lambda i: rel[i][jnp.where(idx != i, size=N - 1)[0]].reshape(-1)
+        )(idx)  # (N, 2(N-1))
+        comm = jnp.zeros((N, c.dim_c * (N - 1)))  # silent agents
+        agent_id = jnp.eye(N)
+        obs = jnp.concatenate(
+            [state.agent_vel, state.agent_pos, landmark_rel, others, comm, agent_id], axis=1
+        )
+        share = jnp.broadcast_to(obs.reshape(-1), (N, self.share_obs_dim))
+        avail = jnp.ones((N, self.action_dim))
+        return obs, share, avail

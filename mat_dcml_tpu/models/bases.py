@@ -17,13 +17,14 @@ JAX equivalents of ``mat/algorithms/utils/{mlp,cnn,rnn}.py``:
 
 from __future__ import annotations
 
+import math
 from typing import Optional, Tuple
 
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
-ORTHO_GAIN_RELU = jnp.sqrt(2.0).item()   # nn.init.calculate_gain('relu')
+ORTHO_GAIN_RELU = math.sqrt(2.0)         # nn.init.calculate_gain('relu')
 ORTHO_GAIN_TANH = 5.0 / 3.0              # nn.init.calculate_gain('tanh')
 
 

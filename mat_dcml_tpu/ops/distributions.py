@@ -8,11 +8,16 @@ same convention as the reference: unavailable logits forced to -1e10
 
 from __future__ import annotations
 
+import math
+
 import jax
 import jax.numpy as jnp
 
 MASK_VALUE = -1e10
-LOG_2PI = jnp.log(2.0 * jnp.pi)
+# math.log, NOT jnp.log: a module-level jnp op initializes the JAX backend at
+# import time, which crashes the whole import chain when the TPU is
+# unavailable/contended (round-1 bench failure).
+LOG_2PI = math.log(2.0 * math.pi)
 
 
 def mask_logits(logits: jax.Array, available: jax.Array | None) -> jax.Array:

@@ -102,9 +102,10 @@ class MATTrainer:
         self.n_objective = getattr(policy.cfg, "n_objective", 1)
         if cfg.objective_weights:
             w = [float(s) for s in cfg.objective_weights.split(",")]
-            assert len(w) == self.n_objective, (
-                f"objective_weights has {len(w)} entries for {self.n_objective} objectives"
-            )
+            if len(w) != self.n_objective:
+                raise ValueError(
+                    f"objective_weights has {len(w)} entries for {self.n_objective} objectives"
+                )
             arr = jnp.asarray(w, jnp.float32)
             # normalize to the simplex so "99,1" and "0.99,0.01" give the same
             # gradient scale (per-channel advantages are already unit-std)

@@ -21,6 +21,8 @@ from mat_dcml_tpu.envs.dcml.preset import (
     save_preset,
 )
 
+pytestmark = pytest.mark.slow  # heavy compiles (see pytest.ini fast tier)
+
 
 class TestPresetData:
     def test_generate_shapes_and_ranges(self):

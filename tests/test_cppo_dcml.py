@@ -8,6 +8,7 @@ availability masking is respected by sampled joint actions.
 
 import os
 
+import pytest
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -17,6 +18,8 @@ from mat_dcml_tpu.envs.dcml.joint import JointDCMLEnv
 from mat_dcml_tpu.models.actor_critic import ACConfig, ActorCriticPolicy
 from mat_dcml_tpu.training.ac_rollout import ACRolloutCollector
 from mat_dcml_tpu.training.mappo import Bootstrap, MAPPOConfig, MAPPOTrainer
+
+pytestmark = pytest.mark.slow  # heavy compiles (see pytest.ini fast tier)
 
 DATA = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "data")
 

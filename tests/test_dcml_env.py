@@ -58,6 +58,7 @@ def reference_process_pr0(r, c, trace_row, arrive_time):
 
 
 class TestWorkerProcess:
+    @pytest.mark.slow
     @pytest.mark.parametrize("seed", [0, 1, 2, 3])
     def test_closed_form_drain_matches_loop(self, env, seed):
         """With Pr=0 the whole process is deterministic: the scan-free drain
@@ -95,6 +96,7 @@ class TestWorkerProcess:
         assert abs(float(f.mean()) - 1.5) < 0.05
         assert float(_geometric_failures(jax.random.key(1), jnp.zeros(100)).max()) == 0.0
 
+    @pytest.mark.slow
     def test_negative_binomial_mean(self):
         from mat_dcml_tpu.envs.dcml.env import _negative_binomial
 
@@ -194,6 +196,7 @@ class TestStep:
         rate = float(jnp.mean(dones.astype(jnp.float32)))
         assert abs(rate - C.continue_probability) < 0.03
 
+    @pytest.mark.slow
     def test_vmapped_step(self, env):
         keys = jax.random.split(jax.random.key(7), 16)
         states, tss = jax.vmap(env.reset)(keys, jnp.zeros(16, jnp.int32))

@@ -6,6 +6,7 @@ step (KL bounded by the threshold, line-search acceptance, learning signal).
 """
 
 
+import pytest
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -20,6 +21,8 @@ from mat_dcml_tpu.training.happo import (
     HATRPOTrainer,
 )
 from mat_dcml_tpu.training.mappo import Bootstrap
+
+pytestmark = pytest.mark.slow  # heavy compiles (see pytest.ini fast tier)
 
 E = 16
 T = 10

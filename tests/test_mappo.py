@@ -5,6 +5,7 @@ the action its one-hot obs encodes, so a correct PPO implementation must push
 mean reward well above the 1/n_actions random baseline within a few updates.
 """
 
+import pytest
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -15,6 +16,8 @@ from mat_dcml_tpu.models.actor_critic import ACConfig, ActorCriticPolicy
 from mat_dcml_tpu.training.ac_rollout import ACRolloutCollector
 from mat_dcml_tpu.training.ippo import IPPOTrainer
 from mat_dcml_tpu.training.mappo import Bootstrap, MAPPOConfig, MAPPOTrainer
+
+pytestmark = pytest.mark.slow  # heavy compiles (see pytest.ini fast tier)
 
 E = 16
 T = 10

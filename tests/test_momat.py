@@ -34,6 +34,7 @@ def mo_setup():
     return run, env, policy, trainer, collector, params
 
 
+@pytest.mark.slow
 def test_env_objectives_decompose_reward():
     """objectives.sum(-1) == scalar reward, channel 0 = -99*delay, 1 = -payment."""
     env = DCMLEnv(DCMLEnvConfig(), data_dir="data")
@@ -62,6 +63,7 @@ def test_mo_gae_matches_per_channel_scalar_gae():
         np.testing.assert_allclose(np.asarray(ret[..., i:i+1]), np.asarray(ret_i), rtol=1e-5)
 
 
+@pytest.mark.slow
 def test_momat_rollout_and_train_step(mo_setup):
     run, env, policy, trainer, collector, params = mo_setup
     assert trainer.n_objective == 2
@@ -86,10 +88,11 @@ def test_objective_weights_parsing():
     trainer = MATTrainer(policy, PPOConfig(objective_weights="3,1"))
     # normalized to the simplex so scale conventions can't skew gradients
     np.testing.assert_allclose(np.asarray(trainer.objective_weights), [0.75, 0.25])
-    with pytest.raises(AssertionError):
+    with pytest.raises(ValueError):
         MATTrainer(policy, PPOConfig(objective_weights="1,2,3"))
 
 
+@pytest.mark.slow
 def test_dmomat_coefficients_resampled_on_done():
     # dmomat policy is preference-conditioned: state_dim = sob_dim + n_objective
     run = RunConfig(

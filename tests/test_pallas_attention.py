@@ -13,6 +13,8 @@ import pytest
 from mat_dcml_tpu.ops.attention import multi_head_attention
 from mat_dcml_tpu.ops.pallas_attention import fused_masked_attention
 
+pytestmark = pytest.mark.slow  # heavy compiles (see pytest.ini fast tier)
+
 
 def _qkv(key, B, H, Lq, Lk, Dh):
     kq, kk, kv = jax.random.split(key, 3)

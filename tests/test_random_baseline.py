@@ -1,6 +1,8 @@
 """Random baseline: availability respected, uniform over the valid set, and a
 full rollout through the DCML env runs under jit."""
 
+import pytest
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -27,6 +29,7 @@ class TestRandomPolicy:
         assert ((tail >= 0) & (tail <= 1)).all()
         assert np.abs(tail - np.round(tail)).max() > 1e-3
 
+    @pytest.mark.slow
     def test_dcml_rollout_runs(self):
         from mat_dcml_tpu.envs.dcml import DCMLEnv, DCMLEnvConfig
 

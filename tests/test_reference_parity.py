@@ -1,0 +1,305 @@
+"""Golden-parity tests against the ACTUAL reference environment.
+
+The reference DCML env stack is numpy-only (no torch import —
+``DCML_BID_FIRST_MA_ENV_SingleProcess.py:1-11``), so it can be imported and
+driven directly as a correctness oracle.  These tests construct matching
+initial conditions for both envs and compare outputs:
+
+- Deterministic element-wise parity (``TestDeterministicParity``): worker
+  failure probs pinned to 0 (no retry randomness), workload-trace noise pinned
+  to its U(0.8, 1.2) midpoint (so ``all_workload == base trace``),
+  disable_rate 0, explicit ``arrive_time`` — every remaining quantity in the
+  reference's ``step`` (``DCML_..._SingleProcess.py:57-144``) is then a pure
+  function of (fixture row, arrive_time, action), and must match the JAX env's
+  ``step`` on a hand-built :class:`DCMLState` element-wise.
+- Observation parity (``test_reset_obs_parity``): the reference ``reset``
+  (``:157-274``) vs ``DCMLEnv._observe`` on the same state, including the
+  unavailable-worker branch with its ``obs[-7]`` back-reference (``:210-213``).
+- Distributional parity (``TestStochasticParity``): with real failure probs
+  the retry/noise draws differ by construction (different PRNGs), so compare
+  delay samples with a two-sample KS test and payment moments.
+
+Skipped wholesale if ``/root/reference`` is not present.
+"""
+
+from __future__ import annotations
+
+import math
+import os
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+REFERENCE_ROOT = Path(os.environ.get("DCML_REFERENCE_ROOT", "/root/reference"))
+
+pytestmark = pytest.mark.skipif(
+    not (REFERENCE_ROOT / "DCML_BID_FIRST_MA_ENV_SingleProcess.py").exists(),
+    reason="reference tree not available",
+)
+
+pytest_plugins: list = []
+
+
+def _midpoint_uniform(low, high, size=None):
+    """np.random.uniform stand-in returning the distribution midpoint —
+    collapses the reference's per-episode U(0.8, 1.2) trace rescaling
+    (``DCML_Worker...py:39,111``) to the identity."""
+    mid = (np.asarray(low) + np.asarray(high)) / 2.0
+    if size is None:
+        return float(mid)
+    return np.broadcast_to(mid, size if isinstance(size, tuple) else (size,)).copy()
+
+
+@pytest.fixture(scope="module")
+def ref_env_cls(monkeypatch_module):
+    """Import the reference Env with cwd at the repo root (its data paths are
+    relative; the repo ships byte-identical ``data/`` fixtures)."""
+    sys.path.insert(0, str(REFERENCE_ROOT))
+    try:
+        import DCML_BID_FIRST_MA_ENV_SingleProcess as ref_mod
+    finally:
+        sys.path.remove(str(REFERENCE_ROOT))
+    return ref_mod
+
+
+@pytest.fixture(scope="module")
+def monkeypatch_module():
+    from _pytest.monkeypatch import MonkeyPatch
+
+    mp = MonkeyPatch()
+    yield mp
+    mp.undo()
+
+
+@pytest.fixture(scope="module")
+def pinned_ref_env(ref_env_cls, monkeypatch_module):
+    """Reference Env in preset mode with all stochastic inputs pinned:
+    midpoint trace noise, Pr=0 workers, disable_rate=0."""
+    monkeypatch_module.setattr(np.random, "uniform", _midpoint_uniform)
+    env = ref_env_cls.Env(preset=True)
+    env.worker_Prs = np.zeros_like(env.worker_Prs)
+    env.disable_rates = np.zeros_like(env.disable_rates)
+    return env
+
+
+@pytest.fixture(scope="module")
+def jax_env():
+    from mat_dcml_tpu.envs.dcml import DCMLEnv, DCMLEnvConfig
+
+    return DCMLEnv(DCMLEnvConfig(), data_dir="data")
+
+
+def _build_state(jax_env, master_row, worker_prs, arrive_time):
+    """Hand-build the DCMLState matching the pinned reference reset."""
+    from mat_dcml_tpu.envs.dcml.env import DCMLState
+
+    W = jax_env.cfg.consts.worker_number_max
+    return DCMLState(
+        rng=jax.random.key(0),
+        r_rows=jnp.float32(master_row[0]),
+        c_cols=jnp.float32(master_row[1]),
+        master_pr=jnp.float32(master_row[2]),
+        worker_prs=jnp.asarray(worker_prs, jnp.float32),
+        trace=jax_env.base_workloads,  # midpoint noise == base trace
+        unavailable=jnp.zeros((W,), bool),
+        arrive_time=jnp.int32(arrive_time),
+        disable_rate=jnp.int32(0),
+        episode_idx=jnp.int32(0),
+    )
+
+
+def _actions(W):
+    """A spread of select/ratio patterns covering N and K clamp branches."""
+    rng = np.random.RandomState(7)
+    acts = []
+    for n_sel, ratio in [(10, 0.5), (1, 0.01), (100, 1.0), (37, 0.33), (100, 0.0), (5, 0.99)]:
+        bits = np.zeros(W)
+        bits[rng.choice(W, n_sel, replace=False)] = 1.0
+        acts.append(np.concatenate([bits, [ratio]]))
+    return acts
+
+
+class TestDeterministicParity:
+    def test_step_delay_payment_reward(self, pinned_ref_env, jax_env):
+        """Element-wise delay/payment/reward parity over episodes × arrive
+        times × actions (``DCML_..._SingleProcess.py:57-144``)."""
+        W = jax_env.cfg.consts.worker_number_max
+        step = jax.jit(jax_env.step)
+        checked = 0
+        for ep in [0, 3, 11, 42, 100]:
+            for at in [0, 7, 19]:
+                for action in _actions(W)[:3]:
+                    pinned_ref_env.eval_episode_i = ep
+                    pinned_ref_env.reset(arrive_time=at)
+                    ob, s_ob, rew, dones, info, ava = pinned_ref_env.step(action.copy())
+                    ref_delay = info[0]["delay"]
+                    ref_payment = info[0]["payment"]
+
+                    state = _build_state(
+                        jax_env,
+                        pinned_ref_env.master_status[ep],
+                        np.zeros(W),
+                        at,
+                    )
+                    _, ts = step(state, jnp.asarray(action, jnp.float32))
+                    np.testing.assert_allclose(
+                        float(ts.delay), ref_delay, rtol=2e-4, atol=1e-4,
+                        err_msg=f"delay mismatch ep={ep} at={at}",
+                    )
+                    np.testing.assert_allclose(
+                        float(ts.payment), ref_payment, rtol=2e-4, atol=1e-3,
+                        err_msg=f"payment mismatch ep={ep} at={at}",
+                    )
+                    np.testing.assert_allclose(
+                        float(ts.reward[0, 0]), rew[0, 0], rtol=2e-4, atol=1e-2,
+                        err_msg=f"reward mismatch ep={ep} at={at}",
+                    )
+                    checked += 1
+        assert checked == 45
+
+    def test_standalone_n_zero(self, pinned_ref_env, jax_env):
+        """N=0 → standalone single-worker path with 1.5x reward (``:81-92``)."""
+        W = jax_env.cfg.consts.worker_number_max
+        action = np.zeros(W + 1)
+        action[-1] = 0.5
+        pinned_ref_env.eval_episode_i = 5
+        pinned_ref_env.reset(arrive_time=4)
+        _, _, rew, _, info, _ = pinned_ref_env.step(action.copy())
+        state = _build_state(jax_env, pinned_ref_env.master_status[5], np.zeros(W), 4)
+        _, ts = jax.jit(jax_env.step)(state, jnp.asarray(action, jnp.float32))
+        np.testing.assert_allclose(float(ts.delay), info[0]["delay"], rtol=2e-4, atol=1e-4)
+        np.testing.assert_allclose(float(ts.payment), info[0]["payment"], rtol=2e-4, atol=1e-3)
+        np.testing.assert_allclose(float(ts.reward[0, 0]), rew[0, 0], rtol=2e-4, atol=1e-2)
+
+    def test_reset_obs_parity(self, pinned_ref_env, jax_env):
+        """obs / share_obs / availability parity of the observation builder
+        (``DCML_..._SingleProcess.py:157-274``) on the all-available state."""
+        for ep, at in [(0, 0), (9, 13), (77, 19)]:
+            pinned_ref_env.eval_episode_i = ep
+            ob, s_ob, ava = pinned_ref_env.reset(arrive_time=at)
+            state = _build_state(jax_env, pinned_ref_env.master_status[ep], np.zeros(100), at)
+            obs_j, sob_j, ava_j = jax_env._observe(state)
+            np.testing.assert_allclose(np.asarray(obs_j), ob, rtol=1e-5, atol=1e-6)
+            np.testing.assert_allclose(np.asarray(sob_j), s_ob, rtol=1e-5, atol=1e-6)
+            np.testing.assert_array_equal(np.asarray(ava_j), ava)
+
+    def test_reset_obs_parity_with_disabled(self, ref_env_cls, monkeypatch_module, jax_env):
+        """Unavailable-worker obs branch incl. the ``obs[-7]`` back-reference
+        (``:210-213``): pin np.random.choice to a known disabled set."""
+        import numpy.random as npr
+
+        monkeypatch_module.setattr(np.random, "uniform", _midpoint_uniform)
+        disabled = np.array([0, 1, 5, 50, 99])  # incl. worker 0 → feat7 seeds from 0
+
+        def fixed_choice(n, size, replace=False):
+            return disabled[:size]
+
+        env = ref_env_cls.Env(preset=True)
+        env.worker_Prs = np.zeros_like(env.worker_Prs)
+        env.disable_rates = np.zeros_like(env.disable_rates) + len(disabled)
+        monkeypatch_module.setattr(npr, "choice", fixed_choice)
+        env.eval_episode_i = 2
+        ob, s_ob, ava = env.reset(arrive_time=6)
+
+        from mat_dcml_tpu.envs.dcml.env import DCMLState
+
+        W = jax_env.cfg.consts.worker_number_max
+        unavailable = np.zeros(W, bool)
+        unavailable[disabled] = True
+        state = DCMLState(
+            rng=jax.random.key(0),
+            r_rows=jnp.float32(env.master_status[2][0]),
+            c_cols=jnp.float32(env.master_status[2][1]),
+            master_pr=jnp.float32(env.master_status[2][2]),
+            worker_prs=jnp.zeros((W,), jnp.float32),
+            trace=jax_env.base_workloads,
+            unavailable=jnp.asarray(unavailable),
+            arrive_time=jnp.int32(6),
+            disable_rate=jnp.int32(len(disabled)),
+            episode_idx=jnp.int32(0),
+        )
+        obs_j, sob_j, ava_j = jax_env._observe(state)
+        np.testing.assert_allclose(np.asarray(obs_j), ob, rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(sob_j), s_ob, rtol=1e-5, atol=1e-6)
+        np.testing.assert_array_equal(np.asarray(ava_j), ava)
+
+
+@pytest.mark.slow
+class TestStochasticParity:
+    """With Pr > 0 the two envs use different PRNGs; compare distributions."""
+
+    N_SAMPLES = 300
+
+    def _ref_delays(self, ref_env_cls, pr):
+        import random as pyrandom
+
+        pyrandom.seed(123)
+        np.random.seed(123)
+        env = ref_env_cls.Env(preset=True)
+        env.worker_Prs = np.full_like(env.worker_Prs, pr)
+        env.disable_rates = np.zeros_like(env.disable_rates)
+        W = 100
+        action = np.zeros(W + 1)
+        action[:20] = 1.0
+        action[-1] = 0.5
+        delays, payments = [], []
+        for i in range(self.N_SAMPLES):
+            env.eval_episode_i = i % 1000
+            env.reset(arrive_time=3)
+            _, _, _, _, info, _ = env.step(action.copy())
+            delays.append(info[0]["delay"])
+            payments.append(info[0]["payment"])
+        return np.array(delays), np.array(payments)
+
+    def _jax_delays(self, jax_env, pr):
+        from mat_dcml_tpu.envs.dcml.env import DCMLState
+
+        W = jax_env.cfg.consts.worker_number_max
+        master = np.load("data/dcml_benchmark/Sample_1master_states.npy", allow_pickle=False)
+        action = np.zeros(W + 1)
+        action[:20] = 1.0
+        action[-1] = 0.5
+        act = jnp.asarray(action, jnp.float32)
+
+        def one(key, row):
+            k_trace, k_step = jax.random.split(key)
+            noise = jax.random.uniform(k_trace, jax_env.base_workloads.shape, minval=0.8, maxval=1.2)
+            state = DCMLState(
+                rng=k_step,
+                r_rows=row[0].astype(jnp.float32),
+                c_cols=row[1].astype(jnp.float32),
+                master_pr=row[2].astype(jnp.float32),
+                worker_prs=jnp.full((W,), pr, jnp.float32),
+                trace=jnp.clip(jax_env.base_workloads * noise, 0.0, 1.0),
+                unavailable=jnp.zeros((W,), bool),
+                arrive_time=jnp.int32(3),
+                disable_rate=jnp.int32(0),
+                episode_idx=jnp.int32(0),
+            )
+            _, ts = jax_env.step(state, act)
+            return ts.delay, ts.payment
+
+        keys = jax.random.split(jax.random.key(42), self.N_SAMPLES)
+        rows = jnp.asarray(master[: self.N_SAMPLES], jnp.float32)
+        delays, payments = jax.jit(jax.vmap(one))(keys, rows)
+        return np.asarray(delays), np.asarray(payments)
+
+    @pytest.mark.parametrize("pr", [0.3, 0.7])
+    def test_delay_distribution_ks(self, ref_env_cls, jax_env, pr):
+        from scipy import stats
+
+        ref_d, ref_p = self._ref_delays(ref_env_cls, pr)
+        jax_d, jax_p = self._jax_delays(jax_env, pr)
+        # same fixture rows drive both; randomness is retries + trace noise
+        ks = stats.ks_2samp(ref_d, jax_d)
+        assert ks.pvalue > 0.01, f"delay KS p={ks.pvalue:.4f} (pr={pr})"
+        # payment moments (heavier-tailed; compare mean within 5 std errors)
+        se = np.sqrt(ref_p.var() / len(ref_p) + jax_p.var() / len(jax_p))
+        assert abs(ref_p.mean() - jax_p.mean()) < 5 * se + 1e-6, (
+            f"payment mean {ref_p.mean():.3f} vs {jax_p.mean():.3f} (pr={pr})"
+        )

@@ -11,6 +11,8 @@ from mat_dcml_tpu.training.ppo import MATTrainer, PPOConfig
 from mat_dcml_tpu.training.rollout import RolloutCollector
 from mat_dcml_tpu.training.runner import build_mat_policy
 
+pytestmark = pytest.mark.slow  # heavy compiles (see pytest.ini fast tier)
+
 
 @pytest.fixture(scope="module")
 def setup():
