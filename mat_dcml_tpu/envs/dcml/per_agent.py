@@ -1,0 +1,48 @@
+"""Per-agent (separated-policy) view of the DCML env.
+
+The reference's heterogeneous-agent DCML modes (happo and the per-agent branch
+of ``DCML_..._SingleProcess.py:51-52``) give each worker agent
+``Action_Space(2)`` and the master a continuous ``Action_Space(1, extra=True)``.
+Here all agents expose one :class:`~mat_dcml_tpu.envs.spaces.MixedRole` space;
+the role flag rides as a third ``available_actions`` column so stacked /
+shared-parameter policies stay structurally homogeneous (see spaces.py).
+
+Actions come back as ``(A, 1)`` float — worker select bits then the master's
+ratio — which is exactly the layout ``DCMLEnv.step`` consumes.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from mat_dcml_tpu.envs.dcml.env import DCMLEnv, TimeStep
+from mat_dcml_tpu.envs.spaces import MixedRole
+
+
+class PerAgentDCMLEnv:
+    """Wraps ``DCMLEnv`` with role-augmented availability masks."""
+
+    def __init__(self, env: DCMLEnv):
+        self.env = env
+        self.n_agents = env.n_agents
+        self.obs_dim = env.obs_dim
+        self.share_obs_dim = env.share_obs_dim
+        self.action_space = MixedRole(n=env.action_dim, cont_dim=1)
+        self.action_dim = env.action_dim
+        w = env.n_agents - env.cfg.consts.extra_agent
+        self._role = jnp.concatenate(
+            [jnp.zeros((w, 1)), jnp.ones((env.n_agents - w, 1))]
+        ).astype(jnp.float32)
+
+    def _wrap_ts(self, ts: TimeStep) -> TimeStep:
+        avail = jnp.concatenate([ts.available_actions.astype(jnp.float32), self._role], axis=-1)
+        return ts._replace(available_actions=avail)
+
+    def reset(self, key: jax.Array, episode_idx=0):
+        state, ts = self.env.reset(key, episode_idx)
+        return state, self._wrap_ts(ts)
+
+    def step(self, state, action: jax.Array):
+        state, ts = self.env.step(state, action)
+        return state, self._wrap_ts(ts)

@@ -110,6 +110,33 @@ class DCMLActionSpace:
         return 1
 
 
+@dataclasses.dataclass(frozen=True)
+class MixedRole:
+    """Per-agent space for heterogeneous-agent algorithms on DCML.
+
+    The reference's separated-policy DCML modes give each worker agent
+    ``Action_Space(2, continuous=False)`` and the master agent
+    ``Action_Space(1, extra=True, continuous=True)``
+    (``DCML_..._SingleProcess.py:51-52``) — structurally different heads, which
+    would force heterogeneous parameter pytrees.  ``MixedRole`` instead builds
+    BOTH heads in one module and selects per row by a role flag, so stacked /
+    shared-parameter trainers (HAPPO/MAPPO/IPPO) stay pytree-homogeneous — the
+    TPU-native answer to the reference's per-agent ``nn.Module`` lists.
+
+    The role flag rides as an extra trailing column of ``available_actions``
+    (width ``n + 1``): ``[avail_0..avail_{n-1}, role]`` with role 1.0 for the
+    continuous (master) agent.  Sampled actions are always ``(B, 1)`` float:
+    the categorical index for workers, the Gaussian draw for the master.
+    """
+
+    n: int = 2                    # categorical choices for the discrete role
+    cont_dim: int = 1             # Gaussian dims for the continuous role
+
+    @property
+    def sample_dim(self) -> int:
+        return max(1, self.cont_dim)
+
+
 def space_sample_dim(space) -> int:
     """Width of a stored action sample for ``space``."""
     return space.sample_dim

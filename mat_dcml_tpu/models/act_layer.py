@@ -35,6 +35,7 @@ from mat_dcml_tpu.envs.spaces import (
     Box,
     DCMLActionSpace,
     Discrete,
+    MixedRole,
     MultiBinary,
     MultiDiscrete,
 )
@@ -83,6 +84,20 @@ class ACTLayer(nn.Module):
             self.action_heads = [_head(n) for n in sp.nvec]
         elif isinstance(sp, MultiBinary):
             self.action_head = _head(sp.n)
+        elif isinstance(sp, MixedRole):
+            # Both heads exist for every agent; the per-row role flag (last
+            # available_actions column) selects which one acts.  See
+            # envs/spaces.py:MixedRole for why this keeps HAPPO/MAPPO/IPPO
+            # parameter pytrees homogeneous across DCML's heterogeneous agents.
+            if sp.cont_dim != 1:
+                raise NotImplementedError(
+                    "MixedRole stores (B, 1) actions; cont_dim must be 1"
+                )
+            self.action_head = _head(sp.n)
+            self.mean_head = _head(sp.cont_dim)
+            self.log_std = self.param(
+                "log_std", lambda k: jnp.ones((sp.cont_dim,)) * self.std_x_coef
+            )
         elif isinstance(sp, DCMLActionSpace):
             if sp.mixed:
                 # No head: features sliced directly (act.py:83-105).
@@ -118,6 +133,13 @@ class ACTLayer(nn.Module):
         # Mixed tail uses plain sigmoid(log_std) * 0.5 (act.py:97,183).
         return jax.nn.sigmoid(self.log_std) * 0.5
 
+    def _role_split(self, available_actions, x):
+        """MixedRole: peel the role flag off the augmented availability mask
+        (None — e.g. shape-only init — means all-discrete, unmasked)."""
+        if available_actions is None:
+            return jnp.zeros((*x.shape[:-1], 1)), None
+        return available_actions[..., -1:], available_actions[..., : self.space.n]
+
     # -- sample --------------------------------------------------------------
 
     def sample(
@@ -143,6 +165,19 @@ class ACTLayer(nn.Module):
             a = mean if deterministic else D.normal_sample(key, mean, jnp.broadcast_to(std, mean.shape))
             logp = D.normal_log_prob(mean, std, a)
             return a, logp
+
+        if isinstance(sp, MixedRole):
+            role, avail = self._role_split(available_actions, x)
+            logits = D.mask_logits(self.action_head(x), avail)
+            k_disc, k_cont = jax.random.split(key)
+            a_disc = D.categorical_mode(logits) if deterministic else D.categorical_sample(k_disc, logits)
+            logp_disc = D.categorical_log_prob(logits, a_disc)[..., None]
+            mean = self.mean_head(x)
+            std = self._gauss_std(self.log_std)
+            a_cont = mean if deterministic else D.normal_sample(key=k_cont, mean=mean, std=jnp.broadcast_to(std, mean.shape))
+            logp_cont = D.normal_log_prob(mean, std, a_cont).sum(-1, keepdims=True)
+            action = jnp.where(role > 0.5, a_cont, a_disc[..., None].astype(jnp.float32))
+            return action, jnp.where(role > 0.5, logp_cont, logp_disc)
 
         if isinstance(sp, MultiDiscrete):
             actions, logps = [], []
@@ -214,6 +249,20 @@ class ACTLayer(nn.Module):
                 jnp.broadcast_to(D.normal_entropy(mean, std), mean.shape), active_masks
             )
             return logp, ent
+
+        if isinstance(sp, MixedRole):
+            role, avail = self._role_split(available_actions, x)
+            logits = D.mask_logits(self.action_head(x), avail)
+            # Worker rows read the action as a categorical index; the master
+            # row's float ratio truncates to a valid (discarded) index.
+            logp_disc = D.categorical_log_prob(logits, action[..., 0].astype(jnp.int32))[..., None]
+            mean = self.mean_head(x)
+            std = self._gauss_std(self.log_std)
+            logp_cont = D.normal_log_prob(mean, std, action).sum(-1, keepdims=True)
+            logp = jnp.where(role > 0.5, logp_cont, logp_disc)
+            ent_cont = jnp.broadcast_to(D.normal_entropy(mean, std), mean.shape).sum(-1)
+            ent_row = jnp.where(role[..., 0] > 0.5, ent_cont, D.categorical_entropy(logits))
+            return logp, _masked_mean(ent_row, active_masks)
 
         if isinstance(sp, MultiDiscrete):
             logps, ents = [], []

@@ -16,7 +16,7 @@ Works with any env exposing the DCML TimeStep protocol:
 
 from __future__ import annotations
 
-from typing import NamedTuple, Tuple
+from typing import NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -39,6 +39,8 @@ class ACTrajectory(NamedTuple):
     actor_h: jax.Array           # (T, E, A, N, h) hidden entering each step
     critic_h: jax.Array
     dones: jax.Array             # (T, E)
+    delays: Optional[jax.Array] = None    # (T, E) DCML per-step info, else None
+    payments: Optional[jax.Array] = None
 
 
 class ACRolloutState(NamedTuple):
@@ -75,7 +77,7 @@ class ACRolloutCollector:
     def _cent(self, st: ACRolloutState) -> jax.Array:
         return st.obs if self.use_local_value else st.share_obs
 
-    def _apply(self, params, key, st: ACRolloutState):
+    def _apply(self, params, key, st: ACRolloutState, deterministic: bool = False):
         """One policy application at the (E, A, ...) level.  The base class
         flattens to (E*A) rows for shared params; stacked-per-agent collectors
         (IPPO/HAPPO) override this with a vmap over the agent axis."""
@@ -83,7 +85,7 @@ class ACRolloutCollector:
         out = self.policy.get_actions(
             params, key, _rows(self._cent(st)), _rows(st.obs),
             _rows(st.actor_h), _rows(st.critic_h), _rows(st.mask),
-            _rows(st.available_actions),
+            _rows(st.available_actions), deterministic,
         )
         return jax.tree.map(lambda x: _unrows(x, E, A), out)
 
@@ -128,6 +130,9 @@ class ACRolloutCollector:
                 critic_h=st.critic_h,
                 done=done_env,
             )
+            if hasattr(ts, "delay"):     # DCML info channels (env.py TimeStep)
+                transition["delay"] = ts.delay
+                transition["payment"] = ts.payment
             # Hidden states reset via the mask multiply inside the GRU on the
             # *next* step (rnn.py:27-28); store post-step states as-is.
             new_st = ACRolloutState(
@@ -158,5 +163,7 @@ class ACRolloutCollector:
             actor_h=tr["actor_h"],
             critic_h=tr["critic_h"],
             dones=tr["done"],
+            delays=tr.get("delay"),
+            payments=tr.get("payment"),
         )
         return final_state, traj

@@ -1,0 +1,198 @@
+"""Shared rollout-train orchestration (the L6 "base runner" layer).
+
+The JAX counterpart of the reference's ``runner/shared/base_runner.py``: the
+collect / insert / compute / train phases collapse into two jitted calls per
+episode chunk — ``collect`` (rollout scan) and ``train`` — with host-side code
+left for logging, episode accounting, and checkpointing only.  Env-specific
+runners (``DCMLRunner``, ``GenericRunner``) build the policy/trainer/collector
+in ``__init__`` and call :meth:`finalize`; everything else lives here once.
+
+Restore-at-construction: ``RunConfig.model_dir`` reloads the latest checkpoint
+in ``setup`` and continues the episode counter — the reference's
+``--model_dir`` restore (``base_runner.py:264-265``) upgraded to full-state
+resume (optimizer + ValueNorm included, training/checkpoint.py).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from pathlib import Path
+from typing import Optional
+
+import jax
+import numpy as np
+
+from mat_dcml_tpu.config import RunConfig
+from mat_dcml_tpu.training.checkpoint import CheckpointManager
+from mat_dcml_tpu.training.mappo import Bootstrap
+from mat_dcml_tpu.training.ppo import PPOConfig
+
+
+def ac_config_kwargs(ppo: PPOConfig) -> dict:
+    """PPOConfig -> MAPPOConfig shared-field mapping (one place, so CLI flags
+    behave identically across entry points)."""
+    return dict(
+        lr=ppo.lr, critic_lr=ppo.lr, ppo_epoch=ppo.ppo_epoch,
+        num_mini_batch=ppo.num_mini_batch, clip_param=ppo.clip_param,
+        entropy_coef=ppo.entropy_coef, value_loss_coef=ppo.value_loss_coef,
+        max_grad_norm=ppo.max_grad_norm, gamma=ppo.gamma,
+        gae_lambda=ppo.gae_lambda,
+    )
+
+
+class BaseRunner:
+    """Collect/train loop with episode metric accounting.
+
+    Subclass contract: ``__init__`` sets ``self.policy``, ``self.trainer``,
+    ``self.collector`` and ``self.is_mat`` (True when the trainer consumes the
+    rollout state directly — MAT family and the random baseline — False for
+    the actor-critic family, which takes a :class:`Bootstrap`), then calls
+    ``finalize(run)``.
+    """
+
+    run_cfg: RunConfig
+    is_mat: bool
+
+    def finalize(self, run: RunConfig, log_fn=print) -> None:
+        self.run_cfg = run
+        self.log = log_fn
+        self._collect = jax.jit(self.collector.collect)
+        self._train = jax.jit(self.trainer.train)
+        self.run_dir = (
+            Path(run.run_dir) / run.env_name / run.scenario / run.algorithm_name / run.experiment_name
+        )
+        self.ckpt = CheckpointManager(self.run_dir / "models")
+        self.metrics_path = self.run_dir / "metrics.jsonl"
+        self.start_episode = 0
+
+    # ------------------------------------------------------------------ setup
+
+    def _bootstrap(self, rs):
+        if self.is_mat:
+            return rs
+        use_local = getattr(self.collector, "use_local_value", False)
+        cent = rs.obs if use_local else rs.share_obs
+        return Bootstrap(cent_obs=cent, critic_h=rs.critic_h, mask=rs.mask)
+
+    def setup(self, seed: Optional[int] = None):
+        seed = self.run_cfg.seed if seed is None else seed
+        key = jax.random.key(seed)
+        k_model, k_roll = jax.random.split(key)
+        if hasattr(self.trainer, "init_params"):      # stacked per-agent params
+            params = self.trainer.init_params(k_model)
+        else:
+            params = self.policy.init_params(k_model)
+        train_state = self.trainer.init_state(params)
+        if self.run_cfg.model_dir:
+            mgr = CheckpointManager(self.run_cfg.model_dir)
+            restored = mgr.restore(template=train_state)
+            if restored is None:
+                raise FileNotFoundError(f"no checkpoint under {self.run_cfg.model_dir}")
+            train_state = restored
+            self.start_episode = (mgr.latest_step or 0) + 1
+            self.log(f"restored checkpoint step {mgr.latest_step} from {self.run_cfg.model_dir}")
+        rollout_state = self.collector.init_state(k_roll, self.run_cfg.n_rollout_threads)
+        return train_state, rollout_state
+
+    # ------------------------------------------------------------------ train
+
+    def train_loop(self, num_episodes: Optional[int] = None, train_state=None, rollout_state=None):
+        run = self.run_cfg
+        episodes = num_episodes if num_episodes is not None else run.episodes
+        if train_state is None:
+            train_state, rollout_state = self.setup()
+        key = jax.random.key(run.seed + 7919)
+
+        # episode accounting (dcml_runner.py:29-74)
+        E = run.n_rollout_threads
+        acc_rew = np.zeros(E)
+        acc_delay = np.zeros(E)
+        acc_pay = np.zeros(E)
+        done_rewards, done_delays, done_payments = [], [], []
+
+        start = time.time()
+        for episode in range(self.start_episode, episodes):
+            rollout_state, traj = self._collect(train_state.params, rollout_state)
+            key, k_train = jax.random.split(key)
+            train_state, metrics = self._train(
+                train_state, traj, self._bootstrap(rollout_state), k_train
+            )
+
+            # host-side episode metric accumulation (one device->host copy)
+            rew_arr = np.asarray(traj.rewards)                 # (T, E, A, n_obj)
+            # sum objective channels (== scalar reward), mean over agents
+            rew = rew_arr.sum(axis=3).mean(axis=2)             # (T, E)
+            has_info = traj.delays is not None
+            delays = np.asarray(traj.delays) if has_info else np.zeros_like(rew)
+            pays = np.asarray(traj.payments) if has_info else np.zeros_like(rew)
+            dones = np.asarray(traj.dones)
+            for t in range(rew.shape[0]):
+                acc_rew += rew[t]
+                acc_delay += delays[t]
+                acc_pay += pays[t]
+                finished = dones[t]
+                if finished.any():
+                    done_rewards.extend(acc_rew[finished].tolist())
+                    done_delays.extend(acc_delay[finished].tolist())
+                    done_payments.extend(acc_pay[finished].tolist())
+                    acc_rew[finished] = 0
+                    acc_delay[finished] = 0
+                    acc_pay[finished] = 0
+
+            total_steps = (episode + 1) * run.episode_length * E
+            # the first episode after a resume always logs, so every run
+            # contributes at least one metrics record
+            if episode % run.log_interval == 0 or episode == self.start_episode:
+                elapsed = time.time() - start
+                # fps counts only steps run in THIS process (correct after a
+                # --model_dir resume, where total_steps includes prior runs)
+                steps_here = (episode + 1 - self.start_episode) * run.episode_length * E
+                fps = steps_here / max(elapsed, 1e-9)
+                record = {
+                    "episode": episode,
+                    "total_steps": total_steps,
+                    "fps": fps,
+                    "average_step_rewards": float(rew_arr.sum(-1).mean()),
+                    # stacked per-agent trainers (ippo) report per-agent
+                    # metric vectors; log the mean over agents
+                    "value_loss": float(np.mean(metrics.value_loss)),
+                    "policy_loss": float(np.mean(metrics.policy_loss)),
+                    "dist_entropy": float(np.mean(metrics.dist_entropy)),
+                    "grad_norm": float(np.mean(getattr(metrics, "grad_norm", 0.0))),
+                    "ratio": float(np.mean(getattr(metrics, "ratio", 1.0))),
+                }
+                if rew_arr.shape[-1] > 1:
+                    # per-objective channel means (dcml_runner.py:306-309)
+                    for i in range(rew_arr.shape[-1]):
+                        record[f"average_step_objective_{i}"] = float(rew_arr[..., i].mean())
+                if done_rewards:
+                    record["aver_episode_rewards"] = float(np.mean(done_rewards))
+                    if has_info:
+                        record["aver_episode_delays"] = float(np.mean(done_delays))
+                        record["aver_episode_payments"] = float(np.mean(done_payments))
+                    done_rewards, done_delays, done_payments = [], [], []
+                self._log_record(record)
+
+            if (episode % run.save_interval == 0 or episode == episodes - 1) and self.run_cfg.algorithm_name != "random":
+                self.ckpt.save(episode, train_state)
+
+            if run.use_eval and episode % run.eval_interval == 0 and hasattr(self, "evaluate"):
+                eval_info = self.evaluate(train_state, n_steps=run.episode_length)
+                eval_info.update(episode=episode, total_steps=total_steps)
+                self.metrics_path.parent.mkdir(parents=True, exist_ok=True)
+                with open(self.metrics_path, "a") as f:
+                    f.write(json.dumps(eval_info) + "\n")
+                self.log(f"eval ep {episode}: {eval_info}")
+
+        return train_state, rollout_state
+
+    def _log_record(self, record: dict):
+        self.metrics_path.parent.mkdir(parents=True, exist_ok=True)
+        with open(self.metrics_path, "a") as f:
+            f.write(json.dumps(record) + "\n")
+        self.log(
+            f"ep {record['episode']} steps {record['total_steps']} fps {record['fps']:.0f} "
+            f"avg_r {record['average_step_rewards']:.3f} vloss {record['value_loss']:.3f} "
+            f"ploss {record['policy_loss']:.3f} ent {record['dist_entropy']:.3f}"
+        )

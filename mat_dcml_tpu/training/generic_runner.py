@@ -2,27 +2,15 @@
 
 The JAX analogue of the reference's per-benchmark runners
 (``mpe_runner.py:20-130``, ``base_runner.py:17-265`` algorithm dispatch):
-one episode-chunk loop alternating a jitted collect with a jitted train,
-host-side code only for logging/checkpointing.  Algorithm dispatch covers the
-full MAT family — vanilla MAT, MAT-Dec (``dec_actor``), and the
+policy/trainer/collector construction for discrete-action envs; the
+collect/train loop, checkpoint restore/resume, and metric accounting live in
+:class:`~mat_dcml_tpu.training.base_runner.BaseRunner`.  Algorithm dispatch
+covers the full MAT family — vanilla MAT, MAT-Dec (``dec_actor``), and the
 encoder/decoder/GRU ablations (``mat_encoder.py``, ``mat_decoder.py``,
-``mat_gru.py``) — plus the MLP actor-critic family (MAPPO / IPPO).
-
-Restore-at-construction: ``RunConfig.model_dir`` reloads the latest (or a
-specific) checkpoint before training, continuing the episode counter — the
-reference's ``--model_dir`` restore (``base_runner.py:264-265``) upgraded to
-full-state resume (optimizer + ValueNorm included, training/checkpoint.py).
+``mat_gru.py``) — plus the MLP actor-critic family (MAPPO / rMAPPO / IPPO).
 """
 
 from __future__ import annotations
-
-import json
-import time
-from pathlib import Path
-from typing import Optional
-
-import jax
-import numpy as np
 
 from mat_dcml_tpu.config import RunConfig
 from mat_dcml_tpu.envs.spaces import Discrete
@@ -31,9 +19,9 @@ from mat_dcml_tpu.models.mat import DISCRETE, MATConfig
 from mat_dcml_tpu.models.mat_variants import DecoderPolicy, EncoderPolicy, GRUPolicy
 from mat_dcml_tpu.models.policy import TransformerPolicy
 from mat_dcml_tpu.training.ac_rollout import ACRolloutCollector
-from mat_dcml_tpu.training.checkpoint import CheckpointManager
-from mat_dcml_tpu.training.ippo import IPPOTrainer
-from mat_dcml_tpu.training.mappo import Bootstrap, MAPPOConfig, MAPPOTrainer
+from mat_dcml_tpu.training.base_runner import BaseRunner, ac_config_kwargs
+from mat_dcml_tpu.training.ippo import IPPORolloutCollector, IPPOTrainer
+from mat_dcml_tpu.training.mappo import MAPPOConfig, MAPPOTrainer
 from mat_dcml_tpu.training.ppo import MATTrainer, PPOConfig
 from mat_dcml_tpu.training.rollout import RolloutCollector
 
@@ -72,7 +60,7 @@ def build_discrete_policy(run: RunConfig, env):
     )
 
 
-class GenericRunner:
+class GenericRunner(BaseRunner):
     """Collect/train loop with episode-reward accounting for any TimeStep env."""
 
     def __init__(self, run: RunConfig, ppo: PPOConfig, env, log_fn=print):
@@ -80,9 +68,7 @@ class GenericRunner:
             raise NotImplementedError(
                 f"algorithm_name={run.algorithm_name!r}; supported: {SUPPORTED_ALGOS}"
             )
-        self.run_cfg = run
         self.env = env
-        self.log = log_fn
         self.is_mat = run.algorithm_name in MAT_FAMILY
 
         if self.is_mat:
@@ -101,91 +87,16 @@ class GenericRunner:
                 space=Discrete(env.action_dim),
             )
             mcfg = MAPPOConfig(
-                lr=ppo.lr, critic_lr=ppo.lr, ppo_epoch=ppo.ppo_epoch,
-                num_mini_batch=ppo.num_mini_batch, entropy_coef=ppo.entropy_coef,
                 use_recurrent_policy=run.algorithm_name == "rmappo",
+                **ac_config_kwargs(ppo),
             )
-            trainer_cls = IPPOTrainer if run.algorithm_name == "ippo" else MAPPOTrainer
-            self.trainer = trainer_cls(self.policy, mcfg)
-            self.collector = ACRolloutCollector(
-                env, self.policy, run.episode_length,
-                use_local_value=run.algorithm_name == "ippo",
-            )
-
-        self._collect = jax.jit(self.collector.collect)
-        self._train = jax.jit(self.trainer.train)
-
-        self.run_dir = (
-            Path(run.run_dir) / run.env_name / run.scenario / run.algorithm_name / run.experiment_name
-        )
-        self.ckpt = CheckpointManager(self.run_dir / "models")
-        self.metrics_path = self.run_dir / "metrics.jsonl"
-        self.start_episode = 0
-
-    def setup(self, seed: Optional[int] = None):
-        seed = self.run_cfg.seed if seed is None else seed
-        key = jax.random.key(seed)
-        k_model, k_roll = jax.random.split(key)
-        params = self.policy.init_params(k_model)
-        train_state = self.trainer.init_state(params)
-        if self.run_cfg.model_dir:
-            mgr = CheckpointManager(self.run_cfg.model_dir)
-            restored = mgr.restore(template=train_state)
-            if restored is None:
-                raise FileNotFoundError(f"no checkpoint under {self.run_cfg.model_dir}")
-            train_state = restored
-            self.start_episode = (mgr.latest_step or 0) + 1
-            self.log(f"restored checkpoint step {mgr.latest_step} from {self.run_cfg.model_dir}")
-        rollout_state = self.collector.init_state(k_roll, self.run_cfg.n_rollout_threads)
-        return train_state, rollout_state
-
-    def _bootstrap(self, rs):
-        if self.is_mat:
-            return rs
-        cent = rs.obs if self.collector.use_local_value else rs.share_obs
-        return Bootstrap(cent_obs=cent, critic_h=rs.critic_h, mask=rs.mask)
-
-    def train_loop(self, num_episodes: Optional[int] = None, train_state=None, rollout_state=None):
-        run = self.run_cfg
-        episodes = num_episodes if num_episodes is not None else run.episodes
-        if train_state is None:
-            train_state, rollout_state = self.setup()
-        key = jax.random.key(run.seed + 7919)
-
-        start = time.time()
-        for episode in range(self.start_episode, episodes):
-            rollout_state, traj = self._collect(train_state.params, rollout_state)
-            key, k_train = jax.random.split(key)
-            train_state, metrics = self._train(
-                train_state, traj, self._bootstrap(rollout_state), k_train
-            )
-
-            total_steps = (episode + 1) * run.episode_length * run.n_rollout_threads
-            if episode % run.log_interval == 0:
-                rew = np.asarray(traj.rewards)
-                elapsed = time.time() - start
-                # fps counts only steps run in THIS process (correct after a
-                # --model_dir resume, where total_steps includes prior runs)
-                steps_here = (episode + 1 - self.start_episode) * run.episode_length * run.n_rollout_threads
-                record = {
-                    "episode": episode,
-                    "total_steps": total_steps,
-                    "fps": steps_here / max(elapsed, 1e-9),
-                    "average_step_rewards": float(rew.mean()),
-                    "value_loss": float(metrics.value_loss),
-                    "policy_loss": float(metrics.policy_loss),
-                    "dist_entropy": float(metrics.dist_entropy),
-                }
-                self.metrics_path.parent.mkdir(parents=True, exist_ok=True)
-                with open(self.metrics_path, "a") as f:
-                    f.write(json.dumps(record) + "\n")
-                self.log(
-                    f"ep {episode} steps {total_steps} fps {record['fps']:.0f} "
-                    f"avg_r {record['average_step_rewards']:.3f} "
-                    f"vloss {record['value_loss']:.3f} ploss {record['policy_loss']:.3f}"
+            if run.algorithm_name == "ippo":
+                self.trainer = IPPOTrainer(self.policy, mcfg, n_agents=env.n_agents)
+                self.collector = IPPORolloutCollector(
+                    env, self.policy, run.episode_length, use_local_value=True
                 )
+            else:
+                self.trainer = MAPPOTrainer(self.policy, mcfg)
+                self.collector = ACRolloutCollector(env, self.policy, run.episode_length)
 
-            if episode % run.save_interval == 0 or episode == episodes - 1:
-                self.ckpt.save(episode, train_state)
-
-        return train_state, rollout_state
+        self.finalize(run, log_fn)

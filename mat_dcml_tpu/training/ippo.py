@@ -43,10 +43,14 @@ class IPPORolloutCollector(ACRolloutCollector):
                  use_local_value: bool = True):
         super().__init__(env, policy, episode_length, use_local_value)
 
-    def _apply(self, stacked_params, key, st):
+    def _apply(self, stacked_params, key, st, deterministic: bool = False):
         A = st.obs.shape[1]
         keys = jax.random.split(key, A)
-        return jax.vmap(self.policy.get_actions, in_axes=(0, 0, 1, 1, 1, 1, 1, 1), out_axes=1)(
+
+        def one(p, k, cent, obs, ah, ch, m, av):
+            return self.policy.get_actions(p, k, cent, obs, ah, ch, m, av, deterministic)
+
+        return jax.vmap(one, in_axes=(0, 0, 1, 1, 1, 1, 1, 1), out_axes=1)(
             stacked_params, keys, self._cent(st), st.obs, st.actor_h,
             st.critic_h, st.mask, st.available_actions,
         )

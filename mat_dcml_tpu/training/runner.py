@@ -1,19 +1,21 @@
-"""Training orchestration for DCML (the L6 "runner" layer).
+"""DCML training orchestration (the reference's ``dcml_runner.py``).
 
-Replaces ``dcml_runner.py`` + ``base_runner.py``: the collect / insert /
-compute / train phases collapse into two jitted calls per episode chunk —
-``collect`` (rollout scan) and ``train`` (PPO update) — with host-side code
-left for logging, episode accounting, and checkpointing only.
+Algorithm dispatch covers the reference's DCML branches
+(``dcml_runner.py:145-248``: mat / momat / ppo / happo / random) plus the
+families the library supports beyond them (dmomat, mappo/rmappo, ippo,
+hatrpo).  The collect/train loop, checkpoint restore/resume, and metric
+accounting live in :class:`~mat_dcml_tpu.training.base_runner.BaseRunner`;
+this module adds the DCML-specific policy/trainer construction and the
+deterministic eval protocol with episode delay/payment accounting and
+inference timing (``dcml_runner.py:319-448``).
 
-With a mesh, the same two functions are jitted with the env batch sharded over
-the ``data`` axis; everything else is unchanged (SURVEY.md §7.6).
+With a mesh, the same two jitted functions run with the env batch sharded
+over the ``data`` axis; everything else is unchanged (SURVEY.md §7.6).
 """
 
 from __future__ import annotations
 
-import json
 import time
-from pathlib import Path
 from typing import Optional
 
 import jax
@@ -22,25 +24,39 @@ import numpy as np
 
 from mat_dcml_tpu.config import RunConfig
 from mat_dcml_tpu.envs.dcml import DCMLEnv, DCMLEnvConfig
+from mat_dcml_tpu.envs.dcml.joint import JointDCMLEnv
+from mat_dcml_tpu.envs.dcml.per_agent import PerAgentDCMLEnv
+from mat_dcml_tpu.models.actor_critic import ACConfig, ActorCriticPolicy
 from mat_dcml_tpu.models.mat import MATConfig, SEMI_DISCRETE
 from mat_dcml_tpu.models.policy import TransformerPolicy
-from mat_dcml_tpu.training.checkpoint import CheckpointManager
-from mat_dcml_tpu.training.ppo import MATTrainer, PPOConfig, TrainState
+from mat_dcml_tpu.training.ac_rollout import ACRolloutCollector, ACRolloutState
+from mat_dcml_tpu.training.base_runner import BaseRunner, ac_config_kwargs
+from mat_dcml_tpu.training.happo import (
+    HAPPOConfig,
+    HAPPORolloutCollector,
+    HAPPOTrainer,
+    HATRPOTrainer,
+)
+from mat_dcml_tpu.training.ippo import IPPORolloutCollector, IPPOTrainer
+from mat_dcml_tpu.training.mappo import MAPPOConfig, MAPPOTrainer
+from mat_dcml_tpu.training.ppo import MATTrainer, PPOConfig
 from mat_dcml_tpu.training.rollout import RolloutCollector, RolloutState
 
 
-SUPPORTED_DCML_ALGOS = ("mat", "mat_dec", "momat", "dmomat", "random")
+MAT_DCML_ALGOS = ("mat", "mat_dec", "momat", "dmomat")
+AC_DCML_ALGOS = ("ppo", "mappo", "rmappo", "ippo", "happo", "hatrpo")
+SUPPORTED_DCML_ALGOS = MAT_DCML_ALGOS + AC_DCML_ALGOS + ("random",)
 
 
 def build_mat_policy(run: RunConfig, env: DCMLEnv) -> TransformerPolicy:
-    if run.algorithm_name not in SUPPORTED_DCML_ALGOS:
+    if run.algorithm_name not in MAT_DCML_ALGOS:
         # The encoder/decoder/GRU ablations are discrete/continuous-only, as
         # upstream (mat_encoder.py:183-196 has no Semi_Discrete branch);
         # DCML's semi-discrete action layout needs the full MAT.  Erroring
         # beats silently training vanilla MAT under an ablation's run label.
         raise NotImplementedError(
-            f"algorithm_name={run.algorithm_name!r} is not wired for the DCML "
-            f"(semi-discrete) runner yet; supported: {SUPPORTED_DCML_ALGOS}. "
+            f"algorithm_name={run.algorithm_name!r} is not a MAT-family DCML "
+            f"algorithm; MAT family: {MAT_DCML_ALGOS}. "
             "mat_encoder/mat_decoder/mat_gru run on discrete/continuous envs "
             "via mat_dcml_tpu.models.mat_variants."
         )
@@ -69,7 +85,7 @@ def build_mat_policy(run: RunConfig, env: DCMLEnv) -> TransformerPolicy:
     return TransformerPolicy(cfg)
 
 
-class DCMLRunner:
+class DCMLRunner(BaseRunner):
     """Rollout-train loop with episode metric accounting
     (``dcml_runner.py:22-124``)."""
 
@@ -81,172 +97,176 @@ class DCMLRunner:
         data_dir: str = "data",
         log_fn=print,
     ):
-        self.run_cfg = run
+        if run.algorithm_name not in SUPPORTED_DCML_ALGOS:
+            raise NotImplementedError(
+                f"algorithm_name={run.algorithm_name!r}; supported on DCML: "
+                f"{SUPPORTED_DCML_ALGOS}"
+            )
         self.ppo_cfg = ppo
-        self.log = log_fn
         self.env = env if env is not None else DCMLEnv(DCMLEnvConfig(), data_dir=data_dir)
-        if run.algorithm_name == "random":
+        algo = run.algorithm_name
+        # "mat-like" trainers consume the rollout state directly (no Bootstrap)
+        self.is_mat = algo in MAT_DCML_ALGOS or algo == "random"
+
+        if algo == "random":
             # uniform-random-valid-actions sanity anchor (random_policy.py:79-109)
             from mat_dcml_tpu.training.random_baseline import RandomPolicy, RandomTrainer
 
             self.policy = RandomPolicy(self.env.n_agents, self.env.action_dim)
             self.trainer = RandomTrainer(self.policy)
-        else:
+            self.collector = RolloutCollector(self.env, self.policy, run.episode_length)
+        elif algo in MAT_DCML_ALGOS:
             self.policy = build_mat_policy(run, self.env)
             self.trainer = MATTrainer(self.policy, ppo, total_updates=run.episodes)
-        self.collector = RolloutCollector(
-            self.env,
-            self.policy,
-            run.episode_length,
-            dynamic_coefficients=run.algorithm_name == "dmomat",
-        )
+            self.collector = RolloutCollector(
+                self.env,
+                self.policy,
+                run.episode_length,
+                dynamic_coefficients=algo == "dmomat",
+            )
+        else:
+            mcfg_kwargs = ac_config_kwargs(ppo)
+            ac = ACConfig(
+                hidden_size=run.n_embd,
+                use_recurrent_policy=algo == "rmappo",
+            )
+            if algo == "ppo":
+                # centralized PPO over the joint action (ppo_policy.py +
+                # SingleReplayBuffer): one agent, mixed action space, prod
+                # importance weights (ppo_trainer.py:128)
+                wrapped = JointDCMLEnv(self.env)
+                self.policy = ActorCriticPolicy(
+                    ac, obs_dim=wrapped.obs_dim, cent_obs_dim=wrapped.share_obs_dim,
+                    space=wrapped.action_space,
+                )
+                self.trainer = MAPPOTrainer(
+                    self.policy, MAPPOConfig(importance_prod=True, **mcfg_kwargs)
+                )
+                self.collector = ACRolloutCollector(wrapped, self.policy, run.episode_length)
+            else:
+                wrapped = PerAgentDCMLEnv(self.env)
+                self.policy = ActorCriticPolicy(
+                    ac,
+                    obs_dim=wrapped.obs_dim,
+                    cent_obs_dim=wrapped.obs_dim if algo == "ippo" else wrapped.share_obs_dim,
+                    space=wrapped.action_space,
+                )
+                if algo in ("mappo", "rmappo"):
+                    self.trainer = MAPPOTrainer(self.policy, MAPPOConfig(
+                        use_recurrent_policy=algo == "rmappo", **mcfg_kwargs))
+                    self.collector = ACRolloutCollector(wrapped, self.policy, run.episode_length)
+                elif algo == "ippo":
+                    self.trainer = IPPOTrainer(
+                        self.policy, MAPPOConfig(**mcfg_kwargs), n_agents=wrapped.n_agents
+                    )
+                    self.collector = IPPORolloutCollector(
+                        wrapped, self.policy, run.episode_length, use_local_value=True
+                    )
+                else:  # happo / hatrpo
+                    trainer_cls = HATRPOTrainer if algo == "hatrpo" else HAPPOTrainer
+                    self.trainer = trainer_cls(
+                        self.policy, HAPPOConfig(**mcfg_kwargs), n_agents=wrapped.n_agents
+                    )
+                    self.collector = HAPPORolloutCollector(wrapped, self.policy, run.episode_length)
 
-        self._collect = jax.jit(self.collector.collect)
-        self._train = jax.jit(self.trainer.train)
-
-        self.run_dir = Path(run.run_dir) / run.env_name / run.scenario / run.algorithm_name / run.experiment_name
-        self.ckpt = CheckpointManager(self.run_dir / "models")
-        self.metrics_path = self.run_dir / "metrics.jsonl"
-
-    def setup(self, seed: Optional[int] = None):
-        seed = self.run_cfg.seed if seed is None else seed
-        key = jax.random.key(seed)
-        k_model, k_roll = jax.random.split(key)
-        params = self.policy.init_params(k_model)
-        train_state = self.trainer.init_state(params)
-        rollout_state = self.collector.init_state(k_roll, self.run_cfg.n_rollout_threads)
-        return train_state, rollout_state
-
-    def train_loop(self, num_episodes: Optional[int] = None, train_state=None, rollout_state=None):
-        run = self.run_cfg
-        episodes = num_episodes if num_episodes is not None else run.episodes
-        if train_state is None:
-            train_state, rollout_state = self.setup()
-        key = jax.random.key(run.seed + 7919)
-
-        # episode accounting (dcml_runner.py:29-74)
-        E = run.n_rollout_threads
-        acc_rew = np.zeros(E)
-        acc_delay = np.zeros(E)
-        acc_pay = np.zeros(E)
-        done_rewards, done_delays, done_payments = [], [], []
-
-        start = time.time()
-        for episode in range(episodes):
-            rollout_state, traj = self._collect(train_state.params, rollout_state)
-            key, k_train = jax.random.split(key)
-            train_state, metrics = self._train(train_state, traj, rollout_state, k_train)
-
-            # host-side episode metric accumulation (one device->host copy)
-            rew_arr = np.asarray(traj.rewards)                 # (T, E, A, n_obj)
-            # sum objective channels (== scalar reward), mean over agents
-            rew = rew_arr.sum(axis=3).mean(axis=2)             # (T, E)
-            delays = np.asarray(traj.delays)
-            pays = np.asarray(traj.payments)
-            dones = np.asarray(traj.dones)
-            for t in range(rew.shape[0]):
-                acc_rew += rew[t]
-                acc_delay += delays[t]
-                acc_pay += pays[t]
-                finished = dones[t]
-                if finished.any():
-                    done_rewards.extend(acc_rew[finished].tolist())
-                    done_delays.extend(acc_delay[finished].tolist())
-                    done_payments.extend(acc_pay[finished].tolist())
-                    acc_rew[finished] = 0
-                    acc_delay[finished] = 0
-                    acc_pay[finished] = 0
-
-            total_steps = (episode + 1) * run.episode_length * E
-            if episode % run.log_interval == 0:
-                elapsed = time.time() - start
-                fps = total_steps / max(elapsed, 1e-9)
-                record = {
-                    "episode": episode,
-                    "total_steps": total_steps,
-                    "fps": fps,
-                    "average_step_rewards": float(rew_arr.sum(-1).mean()),
-                    "value_loss": float(metrics.value_loss),
-                    "policy_loss": float(metrics.policy_loss),
-                    "dist_entropy": float(metrics.dist_entropy),
-                    "grad_norm": float(metrics.grad_norm),
-                    "ratio": float(metrics.ratio),
-                }
-                if rew_arr.shape[-1] > 1:
-                    # per-objective channel means (dcml_runner.py:306-309)
-                    for i in range(rew_arr.shape[-1]):
-                        record[f"average_step_objective_{i}"] = float(rew_arr[..., i].mean())
-                if done_rewards:
-                    record["aver_episode_rewards"] = float(np.mean(done_rewards))
-                    record["aver_episode_delays"] = float(np.mean(done_delays))
-                    record["aver_episode_payments"] = float(np.mean(done_payments))
-                    done_rewards, done_delays, done_payments = [], [], []
-                self._log_record(record)
-
-            if (episode % run.save_interval == 0 or episode == episodes - 1) and run.algorithm_name != "random":
-                self.ckpt.save(episode, train_state)
-
-            if run.use_eval and episode % run.eval_interval == 0:
-                eval_info = self.evaluate(train_state, n_steps=run.episode_length)
-                eval_info.update(episode=episode, total_steps=total_steps)
-                self.metrics_path.parent.mkdir(parents=True, exist_ok=True)
-                with open(self.metrics_path, "a") as f:
-                    f.write(json.dumps(eval_info) + "\n")
-                self.log(f"eval ep {episode}: {eval_info}")
-
-        return train_state, rollout_state
-
-    def _log_record(self, record: dict):
-        self.metrics_path.parent.mkdir(parents=True, exist_ok=True)
-        with open(self.metrics_path, "a") as f:
-            f.write(json.dumps(record) + "\n")
-        self.log(
-            f"ep {record['episode']} steps {record['total_steps']} fps {record['fps']:.0f} "
-            f"avg_r {record['average_step_rewards']:.3f} vloss {record['value_loss']:.3f} "
-            f"ploss {record['policy_loss']:.3f} ent {record['dist_entropy']:.3f}"
-        )
+        self.finalize(run, log_fn)
 
     # ----------------------------------------------------------------- eval
 
-    def evaluate(self, train_state: TrainState, n_steps: int = 100, seed: int = 0, stride: Optional[int] = None):
-        """Deterministic-policy eval on fresh envs (``dcml_runner.py:319-448``).
-        ``stride`` switches to the reference's block-commit decode."""
+    def evaluate(self, train_state, n_steps: int = 100, seed: int = 0, stride: Optional[int] = None):
+        """Deterministic-policy eval on fresh envs with episode delay/payment
+        accounting and per-call inference timing (``dcml_runner.py:319-448``).
+        ``stride`` switches MAT to the reference's block-commit decode."""
         E = self.run_cfg.n_rollout_threads
         rollout_state = self.collector.init_state(jax.random.key(seed + 13), E)
+        env = self.collector.env
 
-        if stride is None:
-            def act(params, st):
-                out = self.policy.get_actions(
-                    params, jax.random.key(0), st.share_obs, st.obs, st.available_actions, deterministic=True
+        if self.is_mat:
+            if stride is None:
+                def act(params, st, key):
+                    out = self.policy.get_actions(
+                        params, key, st.share_obs, st.obs, st.available_actions,
+                        deterministic=True,
+                    )
+                    return out.action
+            else:
+                def act(params, st, key):
+                    out = self.policy.act_stride(
+                        params, st.share_obs, st.obs, st.available_actions, stride=stride
+                    )
+                    return out.action
+
+            def step(st: RolloutState, action):
+                env_states, ts = jax.vmap(env.step)(st.env_states, action)
+                coefs = st.objective_coefficients
+                new_st = RolloutState(
+                    env_states,
+                    self.collector.augment_share_obs(ts.obs, coefs),
+                    self.collector.augment_share_obs(ts.share_obs, coefs),
+                    ts.available_actions, st.mask, st.rng,
+                    objective_coefficients=coefs,
                 )
-                return out.action
+                rew_env = ts.reward.sum(-1).mean(-1)           # (E,) per-env
+                return new_st, (rew_env, ts.delay, ts.payment, ts.done)
         else:
-            def act(params, st):
-                out = self.policy.act_stride(params, st.share_obs, st.obs, st.available_actions, stride=stride)
-                return out.action
+            def act(params, st, key):
+                return self.collector._apply(params, key, st, deterministic=True)
 
-        @jax.jit
-        def eval_step(params, st: RolloutState):
-            action = act(params, st)
-            env_states, ts = jax.vmap(self.env.step)(st.env_states, action)
-            coefs = st.objective_coefficients
-            new_st = RolloutState(
-                env_states,
-                self.collector.augment_share_obs(ts.obs, coefs),
-                self.collector.augment_share_obs(ts.share_obs, coefs),
-                ts.available_actions, st.mask, st.rng,
-                objective_coefficients=coefs,
-            )
-            return new_st, (ts.reward.mean(), ts.delay.mean(), ts.payment.mean())
+            def step(st: ACRolloutState, out):
+                env_states, ts = jax.vmap(env.step)(st.env_states, out.action)
+                done_env = ts.done.all(axis=1)
+                mask = jnp.broadcast_to(
+                    jnp.where(done_env[:, None, None], 0.0, 1.0), st.mask.shape
+                )
+                new_st = ACRolloutState(
+                    env_states, ts.obs, ts.share_obs, ts.available_actions,
+                    mask, out.actor_h, out.critic_h, st.rng,
+                )
+                rew_env = ts.reward.sum(-1).mean(-1)           # (E,) per-env
+                return new_st, (rew_env, ts.delay, ts.payment, ts.done)
+
+        act_j = jax.jit(act)
+        step_j = jax.jit(step)
+
+        # warm up compiles so inference timing measures steady-state latency
+        # (the reference times each policy call, dcml_runner.py:337-400)
+        _ = jax.block_until_ready(act_j(train_state.params, rollout_state, jax.random.key(0)))
 
         rewards, delays, payments = [], [], []
-        for _ in range(n_steps):
-            rollout_state, (r, d, p) = eval_step(train_state.params, rollout_state)
-            rewards.append(float(r))
-            delays.append(float(d))
-            payments.append(float(p))
-        return {
+        acc_delay, acc_pay, acc_rew = np.zeros(E), np.zeros(E), np.zeros(E)
+        ep_delays, ep_payments, ep_rewards = [], [], []
+        infer_time = 0.0
+        for i in range(n_steps):
+            t0 = time.perf_counter()
+            action = jax.block_until_ready(
+                act_j(train_state.params, rollout_state, jax.random.key(i))
+            )
+            infer_time += time.perf_counter() - t0
+            rollout_state, (r, d, p, done) = step_j(rollout_state, action)
+            r, d, p, done = np.asarray(r), np.asarray(d), np.asarray(p), np.asarray(done)
+            done_env = done.all(axis=1) if done.ndim > 1 else done
+            rewards.append(float(r.mean()))
+            delays.append(float(d.mean()))
+            payments.append(float(p.mean()))
+            acc_rew += r
+            acc_delay += d
+            acc_pay += p
+            if done_env.any():
+                ep_rewards.extend(acc_rew[done_env].tolist())
+                ep_delays.extend(acc_delay[done_env].tolist())
+                ep_payments.extend(acc_pay[done_env].tolist())
+                acc_rew[done_env] = 0
+                acc_delay[done_env] = 0
+                acc_pay[done_env] = 0
+
+        info = {
             "eval_average_step_rewards": float(np.mean(rewards)),
             "eval_average_delays": float(np.mean(delays)),
             "eval_average_payments": float(np.mean(payments)),
+            "eval_inference_sec_per_call": infer_time / n_steps,
         }
+        if ep_delays:
+            info["eval_aver_episode_rewards"] = float(np.mean(ep_rewards))
+            info["eval_aver_episode_delays"] = float(np.mean(ep_delays))
+            info["eval_aver_episode_payments"] = float(np.mean(ep_payments))
+        return info

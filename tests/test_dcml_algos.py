@@ -1,0 +1,108 @@
+"""DCML runner algorithm breadth + restore/resume (VERDICT r1 item 7).
+
+The reference's ``dcml_runner.py:145-248`` runs happo / ppo / mat / momat /
+random on DCML; the runner here additionally dispatches mappo / ippo.  These
+tests run each family end-to-end through ``DCMLRunner`` on a small DCML
+instance (8 workers + master) — heterogeneous agents (binary worker selection
++ continuous master ratio) flow through the MixedRole head for the separated
+families (see envs/spaces.py:MixedRole).
+
+Also covers the restore-at-construction path: kill a run after a checkpoint,
+rebuild with ``model_dir``, and continue losslessly from the next episode
+(``base_runner.py:264-265`` upgraded to full-state resume).
+"""
+
+import numpy as np
+import pytest
+import jax
+
+from mat_dcml_tpu.config import RunConfig
+from mat_dcml_tpu.envs.dcml import DCMLEnv, DCMLEnvConfig
+from mat_dcml_tpu.envs.dcml.constants import DCMLConsts
+from mat_dcml_tpu.training.ppo import PPOConfig
+from mat_dcml_tpu.training.runner import DCMLRunner
+
+pytestmark = pytest.mark.slow  # heavy compiles (see pytest.ini fast tier)
+
+W = 8
+E = 4
+T = 8
+
+
+def small_env() -> DCMLEnv:
+    consts = DCMLConsts(worker_number_max=W, sob_dim=W + 2)
+    rng = np.random.default_rng(0)
+    workloads = rng.integers(0, 5, size=(W, consts.local_workload_period)).astype(np.float32)
+    return DCMLEnv(DCMLEnvConfig(consts=consts), base_workloads=workloads)
+
+
+def run_cfg(tmp_path, algo, **kw) -> RunConfig:
+    defaults = dict(
+        algorithm_name=algo,
+        n_rollout_threads=E,
+        episode_length=T,
+        num_env_steps=E * T * 3,
+        log_interval=1,
+        save_interval=1,
+        run_dir=str(tmp_path),
+    )
+    defaults.update(kw)
+    return RunConfig(**defaults)
+
+
+PPO = PPOConfig(ppo_epoch=2, num_mini_batch=1)
+
+
+@pytest.mark.parametrize("algo", ["happo", "mappo", "ippo", "ppo"])
+def test_ac_family_trains_on_dcml(tmp_path, algo):
+    runner = DCMLRunner(run_cfg(tmp_path, algo), PPO, env=small_env(), log_fn=lambda *a: None)
+    state, rs = runner.train_loop(num_episodes=2)
+    # stacked per-agent trainers (ippo/happo) carry a per-agent step counter
+    assert int(np.asarray(state.update_step).flat[0]) == 2
+    # metrics stream written with finite losses + episode delay/payment fields
+    lines = [l for l in runner.metrics_path.read_text().splitlines() if l]
+    assert lines, "no metrics logged"
+    import json
+
+    rec = json.loads(lines[-1])
+    for k in ("value_loss", "policy_loss", "dist_entropy", "average_step_rewards"):
+        assert np.isfinite(rec[k]), rec
+
+    # eval covers the AC deterministic path + inference timing + episode stats
+    info = runner.evaluate(state, n_steps=6)
+    assert np.isfinite(info["eval_average_delays"])
+    assert info["eval_inference_sec_per_call"] > 0
+
+
+def test_happo_respects_worker_availability(tmp_path):
+    runner = DCMLRunner(run_cfg(tmp_path, "happo"), PPO, env=small_env(), log_fn=lambda *a: None)
+    state, rs = runner.setup()
+    rs, traj = runner._collect(state.params, rs)
+    bits = np.asarray(traj.actions[..., :W, 0])              # (T, E, W)
+    avail1 = np.asarray(traj.available_actions[..., :W, 1])  # select allowed?
+    assert np.all(bits[avail1 == 0] == 0), "unavailable worker was selected"
+    # master ratio is continuous, not just 0/1 head output
+    ratios = np.asarray(traj.actions[..., W, 0])
+    assert np.isfinite(ratios).all()
+
+
+def test_resume_continues_episode_counter(tmp_path):
+    cfg = run_cfg(tmp_path, "mat", num_env_steps=E * T * 4)
+    runner = DCMLRunner(cfg, PPO, env=small_env(), log_fn=lambda *a: None)
+    state, rs = runner.train_loop(num_episodes=3)
+    assert runner.ckpt.latest_step == 2
+
+    cfg2 = run_cfg(
+        tmp_path, "mat", num_env_steps=E * T * 4,
+        model_dir=str(runner.run_dir / "models"), experiment_name="resumed",
+    )
+    runner2 = DCMLRunner(cfg2, PPO, env=small_env(), log_fn=lambda *a: None)
+    state2, rs2 = runner2.setup()
+    assert runner2.start_episode == 3
+    # restored state matches the saved one exactly (params + opt + counter)
+    assert int(state2.update_step) == int(state.update_step)
+    for a, b in zip(jax.tree.leaves(state.params), jax.tree.leaves(state2.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # and training proceeds from there
+    state3, _ = runner2.train_loop(num_episodes=4, train_state=state2, rollout_state=rs2)
+    assert int(state3.update_step) == int(state.update_step) + 1

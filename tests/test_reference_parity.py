@@ -56,7 +56,7 @@ def _midpoint_uniform(low, high, size=None):
 
 
 @pytest.fixture(scope="module")
-def ref_env_cls(monkeypatch_module):
+def ref_env_cls():
     """Import the reference Env with cwd at the repo root (its data paths are
     relative; the repo ships byte-identical ``data/`` fixtures)."""
     sys.path.insert(0, str(REFERENCE_ROOT))
@@ -67,8 +67,11 @@ def ref_env_cls(monkeypatch_module):
     return ref_mod
 
 
-@pytest.fixture(scope="module")
+@pytest.fixture(scope="class")
 def monkeypatch_module():
+    """Class-scoped so the np.random pins undo before the NEXT test class —
+    TestStochasticParity must see the genuine np.random.uniform or its
+    "reference with real noise" sample is silently noise-free."""
     from _pytest.monkeypatch import MonkeyPatch
 
     mp = MonkeyPatch()
@@ -76,7 +79,7 @@ def monkeypatch_module():
     mp.undo()
 
 
-@pytest.fixture(scope="module")
+@pytest.fixture(scope="class")
 def pinned_ref_env(ref_env_cls, monkeypatch_module):
     """Reference Env in preset mode with all stochastic inputs pinned:
     midpoint trace noise, Pr=0 workers, disable_rate=0."""
@@ -238,6 +241,9 @@ class TestStochasticParity:
     def _ref_delays(self, ref_env_cls, pr):
         import random as pyrandom
 
+        # the deterministic class's midpoint pin must have been undone, or
+        # this "reference with real noise" sample would be noise-free
+        assert getattr(np.random.uniform, "__module__", "numpy") != __name__
         pyrandom.seed(123)
         np.random.seed(123)
         env = ref_env_cls.Env(preset=True)
