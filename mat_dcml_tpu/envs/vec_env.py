@@ -1,0 +1,265 @@
+"""Host-process vectorized env layer — the escape hatch for non-JAX envs.
+
+Pure-JAX envs vectorize with ``vmap`` inside the rollout scan; external
+simulators (StarCraft II, Google Research Football, MuJoCo) cannot be traced
+and need the reference's architecture: worker processes stepping real envs,
+synchronized lock-step over pipes, auto-reset inside the worker
+(``env_wrappers.py:27-137`` ``ShareVecEnv`` ABC, ``:300-340`` shareworker,
+``:343-403`` ``ShareSubprocVecEnv``, ``:713`` ``ShareDummyVecEnv``).
+
+Differences from the reference, deliberate:
+
+- **k envs per worker process** (``envs_per_worker``) instead of one process
+  per env — a TPU host feeding thousands of env slots cannot afford thousands
+  of processes; the reference's 1:1 mapping is the degenerate case.
+- **spawn start method** + cloudpickled factories (the reference's
+  ``CloudpickleWrapper``, ``env_wrappers.py:10-24``): forking a process that
+  has initialized XLA deadlocks in the child, so children must start clean.
+- Stacked numpy outputs ready for one ``jax.device_put`` per step — the
+  host↔device boundary is one transfer per phase, not per env.
+
+Host env contract (the reference's gym-ish shared-obs API,
+``DCML_..._SingleProcess.py:57,157``):
+
+    reset() -> (obs (A, d_o), share_obs (A, d_s), available_actions (A, d_a))
+    step(a) -> (obs, share_obs, rewards (A, 1), dones (A,), infos,
+                available_actions)
+
+Envs whose ``step`` already returns the next episode's obs after a terminal
+step (the DCML/pure-JAX convention) set ``self_resetting = True`` and the
+worker skips its reset-on-done.
+"""
+
+from __future__ import annotations
+
+import multiprocessing as mp
+from typing import Callable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+def _step_one(env, action):
+    """Step + auto-reset-inside-worker (``env_wrappers.py:305-313``): on a
+    terminal step the NEW episode's obs/avail are returned with the OLD
+    step's reward, matching what the collectors store."""
+    obs, share, rew, done, info, avail = env.step(action)
+    if not getattr(env, "self_resetting", False) and np.all(done):
+        obs, share, avail = env.reset()
+    return obs, share, rew, done, info, avail
+
+
+class CloudpickleWrapper:
+    """Carry closures across a spawn boundary (``env_wrappers.py:10-24``).
+
+    Unpickling is LAZY (``.load()``): multiprocessing deserializes process
+    args during child bootstrap, before any user code runs — if the payload
+    contains device arrays, eager unpickling would initialize the child's JAX
+    backend before the platform override below, wedging the child on an
+    unavailable accelerator tunnel."""
+
+    def __init__(self, x):
+        self.x = x
+
+    def __getstate__(self):
+        import cloudpickle
+
+        return cloudpickle.dumps(self.x)
+
+    def __setstate__(self, blob):
+        self._blob = blob
+        self.x = None
+
+    def load(self):
+        if self.x is None:
+            import pickle
+
+            self.x = pickle.loads(self._blob)
+        return self.x
+
+
+def _worker_loop(remote, parent_remote, wrapped_fns: CloudpickleWrapper):
+    parent_remote.close()
+    # spawned children re-run sitecustomize; make an explicit JAX_PLATFORMS
+    # win again before any env factory touches jax (utils/platform.py)
+    from mat_dcml_tpu.utils.platform import apply_platform_override
+
+    apply_platform_override()
+    envs = [fn() for fn in wrapped_fns.load()]
+    try:
+        while True:
+            cmd, data = remote.recv()
+            if cmd == "step":
+                remote.send([_step_one(env, a) for env, a in zip(envs, data)])
+            elif cmd == "reset":
+                remote.send([env.reset() for env in envs])
+            elif cmd == "spaces":
+                e = envs[0]
+                remote.send((e.n_agents, e.obs_dim, e.share_obs_dim, e.action_dim))
+            elif cmd == "close":
+                for env in envs:
+                    if hasattr(env, "close"):
+                        env.close()
+                remote.close()
+                break
+            else:
+                raise NotImplementedError(cmd)
+    except (KeyboardInterrupt, EOFError):
+        pass
+
+
+def _stack_reset(results) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    obs, share, avail = zip(*results)
+    return np.stack(obs), np.stack(share), np.stack(avail)
+
+
+def _stack_step(results):
+    obs, share, rew, done, infos, avail = zip(*results)
+    return (
+        np.stack(obs), np.stack(share), np.stack(rew),
+        np.stack(done), list(infos), np.stack(avail),
+    )
+
+
+class ShareVecEnv:
+    """Common interface: ``reset() -> (E, A, ·) numpy``, ``step(actions)``."""
+
+    n_envs: int
+    n_agents: int
+    obs_dim: int
+    share_obs_dim: int
+    action_dim: int
+
+    def reset(self):
+        raise NotImplementedError
+
+    def step(self, actions: np.ndarray):
+        raise NotImplementedError
+
+    def close(self):
+        pass
+
+
+class ShareDummyVecEnv(ShareVecEnv):
+    """In-process variant (``env_wrappers.py:713-763``) — the debugging and
+    single-thread configuration, and the reference for bridge tests."""
+
+    def __init__(self, env_fns: Sequence[Callable]):
+        self.envs = [fn() for fn in env_fns]
+        self.n_envs = len(self.envs)
+        e = self.envs[0]
+        self.n_agents, self.obs_dim = e.n_agents, e.obs_dim
+        self.share_obs_dim, self.action_dim = e.share_obs_dim, e.action_dim
+
+    def reset(self):
+        return _stack_reset([env.reset() for env in self.envs])
+
+    def step(self, actions: np.ndarray):
+        return _stack_step([_step_one(env, a) for env, a in zip(self.envs, actions)])
+
+    def close(self):
+        for env in self.envs:
+            if hasattr(env, "close"):
+                env.close()
+
+
+class ShareSubprocVecEnv(ShareVecEnv):
+    """Worker-process variant (``env_wrappers.py:343-403``), k envs/worker."""
+
+    def __init__(self, env_fns: Sequence[Callable], envs_per_worker: int = 1):
+        self.n_envs = len(env_fns)
+        ctx = mp.get_context("spawn")
+        chunks = [
+            env_fns[i : i + envs_per_worker]
+            for i in range(0, len(env_fns), envs_per_worker)
+        ]
+        self._chunk_sizes = [len(c) for c in chunks]
+        self.remotes, self.processes = [], []
+        for chunk in chunks:
+            remote, child = ctx.Pipe()
+            p = ctx.Process(
+                target=_worker_loop,
+                args=(child, remote, CloudpickleWrapper(chunk)),
+                daemon=True,
+            )
+            p.start()
+            child.close()
+            self.remotes.append(remote)
+            self.processes.append(p)
+        self.remotes[0].send(("spaces", None))
+        self.n_agents, self.obs_dim, self.share_obs_dim, self.action_dim = self.remotes[0].recv()
+        self._closed = False
+
+    def reset(self):
+        for remote in self.remotes:
+            remote.send(("reset", None))
+        results: List = []
+        for remote in self.remotes:
+            results.extend(remote.recv())
+        return _stack_reset(results)
+
+    def step(self, actions: np.ndarray):
+        """Synchronous lock-step: scatter action slices, gather transitions
+        (the reference's step_async/step_wait pair collapsed)."""
+        start = 0
+        for remote, k in zip(self.remotes, self._chunk_sizes):
+            remote.send(("step", actions[start : start + k]))
+            start += k
+        results = []
+        for remote in self.remotes:
+            results.extend(remote.recv())
+        return _stack_step(results)
+
+    def close(self):
+        if self._closed:
+            return
+        for remote in self.remotes:
+            try:
+                remote.send(("close", None))
+            except (BrokenPipeError, OSError):
+                pass
+        for p in self.processes:
+            p.join(timeout=5)
+            if p.is_alive():
+                p.terminate()
+        self._closed = True
+
+    def __del__(self):
+        self.close()
+
+
+class JaxEnvHostAdapter:
+    """Run a TimeStep-protocol JAX env behind the host-env contract.
+
+    Exists so the bridge can be validated against the vmapped path with
+    bit-identical PRNG discipline (the env carries its rng in its state), and
+    so pure-JAX envs can be mixed into host-side fleets if ever useful.
+    ``self_resetting = True``: these envs return the next episode's obs from
+    ``step`` themselves (DCML resets every step, ``DCML_..._SingleProcess.py:139``).
+    """
+
+    self_resetting = True
+
+    def __init__(self, env, key, episode_idx: int = 0):
+        import jax
+
+        self._env = env
+        self._key = key
+        self._episode_idx = episode_idx
+        self._jit_reset = jax.jit(env.reset)
+        self._jit_step = jax.jit(env.step)
+        self._state = None
+        self.n_agents, self.obs_dim = env.n_agents, env.obs_dim
+        self.share_obs_dim, self.action_dim = env.share_obs_dim, env.action_dim
+
+    def reset(self):
+        self._state, ts = self._jit_reset(self._key, self._episode_idx)
+        return np.asarray(ts.obs), np.asarray(ts.share_obs), np.asarray(ts.available_actions)
+
+    def step(self, action):
+        self._state, ts = self._jit_step(self._state, action)
+        info = {"delay": float(getattr(ts, "delay", 0.0)),
+                "payment": float(getattr(ts, "payment", 0.0))}
+        return (
+            np.asarray(ts.obs), np.asarray(ts.share_obs), np.asarray(ts.reward),
+            np.asarray(ts.done), info, np.asarray(ts.available_actions),
+        )

@@ -1,0 +1,138 @@
+"""Trajectory collection over host-process envs (the non-JAX escape hatch).
+
+Twin of :class:`~mat_dcml_tpu.training.rollout.RolloutCollector` for envs
+behind a :class:`~mat_dcml_tpu.envs.vec_env.ShareVecEnv`: the policy runs as
+one jitted call per step on the full ``(E, A, ·)`` batch, actions cross to the
+host once, the worker processes step their envs in lock-step, and the stacked
+transition crosses back once — the reference's rollout round trip
+(``env_wrappers.py:367-379`` + ``dcml_runner.py:145-248``) with the
+per-process pickling replaced by two bulk host↔device transfers per step.
+
+Produces the same :class:`Trajectory` pytree as the scan-based collector, so
+``MATTrainer`` (and anything else consuming trajectories) is oblivious to
+where the envs live.  PRNG discipline matches the scan collector exactly
+(split the carried key once per step for the policy), so a JAX env driven
+through :class:`JaxEnvHostAdapter` yields bit-identical rollouts — the bridge
+correctness test.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from mat_dcml_tpu.envs.vec_env import ShareVecEnv
+from mat_dcml_tpu.training.rollout import RolloutState, Trajectory
+
+
+def _info_field(info, name: str) -> float:
+    """Pull a scalar info channel from a per-env info of any common shape:
+    the reference's list-of-per-agent-dicts (``DCML_Basic_Env.py:9-17``), a
+    plain dict, or nothing."""
+    if isinstance(info, dict):
+        return float(info.get(name, 0.0))
+    if isinstance(info, (list, tuple)) and info and isinstance(info[0], dict):
+        return float(info[0].get(name, 0.0))
+    return 0.0
+
+
+class HostRolloutCollector:
+    """Builds ``collect`` for a (policy, host vec-env) pair."""
+
+    def __init__(self, vec_env: ShareVecEnv, policy, episode_length: int):
+        self.vec_env = vec_env
+        self.policy = policy
+        self.T = episode_length
+        n_objective = getattr(getattr(policy, "cfg", None), "n_objective", 1)
+        if n_objective != 1:
+            raise NotImplementedError(
+                "multi-objective rollouts need per-channel rewards, which the "
+                "host env contract does not carry; MO/DMO-MAT run on pure-JAX "
+                "envs via RolloutCollector"
+            )
+
+        def _act(params, key, share_obs, obs, avail):
+            return self.policy.get_actions(
+                params, key, share_obs, obs, avail, deterministic=False
+            )
+
+        self._act = jax.jit(_act)
+
+    def init_state(self, key: jax.Array, n_envs: int = 0) -> RolloutState:
+        """``n_envs`` is fixed by the vec env; the arg mirrors the scan
+        collector's signature so runners can treat both alike."""
+        if n_envs and n_envs != self.vec_env.n_envs:
+            raise ValueError(
+                f"vec env has {self.vec_env.n_envs} envs, runner asked for {n_envs}"
+            )
+        obs, share, avail = self.vec_env.reset()
+        E, A = obs.shape[:2]
+        return RolloutState(
+            env_states=None,                       # env state lives in workers
+            obs=jnp.asarray(obs, jnp.float32),
+            share_obs=jnp.asarray(share, jnp.float32),
+            available_actions=jnp.asarray(avail, jnp.float32),
+            mask=jnp.ones((E, A, 1), jnp.float32),
+            rng=key,
+        )
+
+    def collect(self, params, st: RolloutState) -> Tuple[RolloutState, Trajectory]:
+        E = self.vec_env.n_envs
+        tr: dict = {k: [] for k in (
+            "share_obs", "obs", "available_actions", "actions", "log_probs",
+            "values", "rewards", "next_mask", "delay", "payment", "done",
+        )}
+        obs, share, avail, mask, key = st.obs, st.share_obs, st.available_actions, st.mask, st.rng
+
+        for _ in range(self.T):
+            key, k_act = jax.random.split(key)
+            out = self._act(params, k_act, share, obs, avail)
+            tr["share_obs"].append(share)
+            tr["obs"].append(obs)
+            tr["available_actions"].append(avail)
+            tr["actions"].append(out.action)
+            tr["log_probs"].append(out.log_prob)
+            tr["values"].append(out.value)
+
+            obs_np, share_np, rew, done, infos, avail_np = self.vec_env.step(
+                np.asarray(out.action)
+            )
+            done_env = np.asarray(done).all(axis=1)              # (E,)
+            next_mask = np.broadcast_to(
+                np.where(done_env[:, None, None], 0.0, 1.0), mask.shape
+            ).astype(np.float32)
+            tr["rewards"].append(np.asarray(rew, np.float32))
+            tr["next_mask"].append(next_mask)
+            tr["delay"].append([_info_field(i, "delay") for i in infos])
+            tr["payment"].append([_info_field(i, "payment") for i in infos])
+            tr["done"].append(done_env)
+
+            obs = jnp.asarray(obs_np, jnp.float32)
+            share = jnp.asarray(share_np, jnp.float32)
+            avail = jnp.asarray(avail_np, jnp.float32)
+            mask = jnp.asarray(next_mask)
+
+        new_st = RolloutState(
+            env_states=None, obs=obs, share_obs=share, available_actions=avail,
+            mask=mask, rng=key,
+        )
+        stack = lambda xs: jnp.stack([jnp.asarray(x) for x in xs])
+        masks = jnp.concatenate([st.mask[None], stack(tr["next_mask"])], axis=0)
+        traj = Trajectory(
+            share_obs=stack(tr["share_obs"]),
+            obs=stack(tr["obs"]),
+            available_actions=stack(tr["available_actions"]),
+            actions=stack(tr["actions"]),
+            log_probs=stack(tr["log_probs"]),
+            values=stack(tr["values"]),
+            rewards=stack(tr["rewards"]),
+            masks=masks,
+            active_masks=jnp.ones_like(masks),
+            delays=jnp.asarray(np.asarray(tr["delay"], np.float32)),
+            payments=jnp.asarray(np.asarray(tr["payment"], np.float32)),
+            dones=jnp.asarray(np.asarray(tr["done"])),
+        )
+        return new_st, traj
