@@ -1,0 +1,26 @@
+"""SMAC environment family: map registry, pure-JAX combat stand-in,
+multi-map feature translation, and the gated real-SC2 host adapter."""
+
+from mat_dcml_tpu.envs.smac.maps import MapParams, get_map_params, map_param_registry
+from mat_dcml_tpu.envs.smac.smaclite import SMACLiteConfig, SMACLiteEnv, SMACTimeStep
+from mat_dcml_tpu.envs.smac.translation import (
+    TARGET_ACTION_DIM,
+    TARGET_NUM_AGENT,
+    TASK_EMBEDDING_DIM,
+    TranslatedSMACEnv,
+    gen_task_embedding,
+)
+
+__all__ = [
+    "MapParams",
+    "get_map_params",
+    "map_param_registry",
+    "SMACLiteConfig",
+    "SMACLiteEnv",
+    "SMACTimeStep",
+    "TranslatedSMACEnv",
+    "gen_task_embedding",
+    "TARGET_ACTION_DIM",
+    "TARGET_NUM_AGENT",
+    "TASK_EMBEDDING_DIM",
+]

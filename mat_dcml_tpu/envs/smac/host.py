@@ -1,0 +1,69 @@
+"""Real StarCraft II SMAC behind the host-process bridge (gated).
+
+The reference vendors a full SMAC fork (``starcraft2/StarCraft2_Env.py``)
+talking to the SC2 binary over pysc2 RPC.  A game binary cannot be vmapped or
+traced, so here the real thing plugs in through the host vec-env layer
+(:mod:`~mat_dcml_tpu.envs.vec_env`): one :class:`SMACHostEnv` per worker
+process, stacked numpy to the device once per step.
+
+Gated: requires the external ``smac`` package (oxwhirl/smac) and an SC2
+install — neither ships in this image — and raises a clear error otherwise.
+The pure-JAX stand-in (:mod:`~mat_dcml_tpu.envs.smac.smaclite`) covers
+training/testing without the binary.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class SMACHostEnv:
+    """Adapter: oxwhirl/smac ``StarCraft2Env`` -> host shared-obs contract
+    (obs/state/avail layouts match ``StarCraft2_Env.py:1015-1335``)."""
+
+    self_resetting = False                 # bridge auto-resets on done
+
+    def __init__(self, map_name: str = "3m", seed: int = 0, **smac_kwargs):
+        try:
+            from smac.env import StarCraft2Env  # type: ignore
+        except ImportError as err:
+            raise ImportError(
+                "SMACHostEnv needs the external 'smac' package and a StarCraft "
+                "II install (https://github.com/oxwhirl/smac). Neither is "
+                "bundled; use SMACLiteEnv (pure JAX) for binary-free training."
+            ) from err
+        self._env = StarCraft2Env(map_name=map_name, seed=seed, **smac_kwargs)
+        info = self._env.get_env_info()
+        self.n_agents = info["n_agents"]
+        self.obs_dim = info["obs_shape"]
+        self.share_obs_dim = info["state_shape"]
+        self.action_dim = info["n_actions"]
+        self.episode_limit = info["episode_limit"]
+
+    def _bundle(self):
+        obs = np.stack(self._env.get_obs()).astype(np.float32)
+        state = np.asarray(self._env.get_state(), np.float32)
+        share = np.broadcast_to(state, (self.n_agents, state.shape[-1])).copy()
+        avail = np.stack(
+            [self._env.get_avail_agent_actions(i) for i in range(self.n_agents)]
+        ).astype(np.float32)
+        return obs, share, avail
+
+    def reset(self):
+        self._env.reset()
+        return self._bundle()
+
+    def step(self, actions):
+        acts = np.asarray(actions).reshape(-1).astype(np.int64)
+        reward, terminated, info = self._env.step(acts)
+        obs, share, avail = self._bundle()
+        rew = np.full((self.n_agents, 1), reward, np.float32)
+        done = np.full((self.n_agents,), bool(terminated))
+        info = dict(info or {})
+        # ride the generic scalar info channels like SMACLite does
+        info["delay"] = float(info.get("battle_won", False))
+        info["payment"] = 0.0
+        return obs, share, rew, done, info, avail
+
+    def close(self):
+        self._env.close()

@@ -1,0 +1,414 @@
+"""Pure-JAX SMAC combat stand-in ("SMACLite").
+
+The reference vendors the full SC2-backed SMAC suite
+(``starcraft2/StarCraft2_Env.py:1-2091``) — a process+RPC boundary around a
+game binary that cannot be traced or vmapped.  The TPU-native counterpart is
+this closed-form combat microsim with the SAME structural API (obs feature
+layout, centralized state, availability mask, shaped reward, win/lose
+bookkeeping), so every SMAC-facing component — runners, multi-map feature
+translation, MAT/MAPPO policies — exercises the real interface while staying
+jit/vmap-compatible.  The real game remains reachable through the gated host
+adapter (:mod:`~mat_dcml_tpu.envs.smac.host`) over the process bridge
+(:mod:`~mat_dcml_tpu.envs.vec_env`).
+
+Faithful structural choices (citations into the reference):
+
+- actions: 0 no-op (dead only), 1 stop, 2-5 move N/S/E/W, 6+e attack enemy e
+  (``StarCraft2_Env.py:269-271`` ``n_actions = 6 + n_enemies``; avail rules
+  ``:1846-1884``: move by pathability, attack iff alive + within shoot range).
+- per-agent obs: move bits, then per-enemy (attackable, dist, rel_x, rel_y,
+  health, [shield], [type]), per-ally (visible, dist, rel_x, rel_y, health,
+  [shield], [type]), own (health, [shield], [type]) — all distances
+  normalized by sight range, zeros when dead (``:1015-1110``).
+- centralized state: per-ally (health, cooldown, rel-to-center x, y,
+  [shield], [type]) + per-enemy (health, rel x, y, [shield], [type]) +
+  last-action one-hots (``get_state``/``get_state_size`` ``:1189-1335``).
+- shaped reward: positive-only damage + kill + win bonuses, normalized so the
+  max episode return is ``reward_scale_rate`` (SMAC's reward_scale semantics).
+- sight range 9, shoot range 6 (melee 2), one-hot unit types from the map
+  roster (``maps.py``).
+
+Deliberate simplifications (a microsim, not SC2): no terrain/pathing grid, no
+shield regeneration, no medivac healing, enemy "AI" = attack nearest in range
+else advance toward nearest ally — approximating the built-in attack-move bot
+the real maps script.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from mat_dcml_tpu.envs.smac.maps import MapParams, UNIT_STATS, get_map_params
+
+SIGHT_RANGE = 9.0
+SHOOT_RANGE = 6.0
+MELEE_RANGE = 2.0
+MOVE_AMOUNT = 2.0
+N_ACTIONS_NO_ATTACK = 6
+REWARD_DEATH_VALUE = 10.0
+REWARD_WIN = 200.0
+REWARD_SCALE_RATE = 20.0
+
+
+class SMACLiteState(NamedTuple):
+    rng: jax.Array
+    ally_pos: jax.Array        # (A, 2)
+    ally_hp: jax.Array         # (A,)  health + shield pooled? no: health only
+    ally_shield: jax.Array     # (A,)
+    ally_cd: jax.Array         # (A,) cooldown steps remaining
+    enemy_pos: jax.Array       # (Ne, 2)
+    enemy_hp: jax.Array        # (Ne,)
+    enemy_shield: jax.Array    # (Ne,)
+    enemy_cd: jax.Array        # (Ne,)
+    last_actions: jax.Array    # (A,) int32
+    t: jax.Array               # int32
+
+
+class SMACTimeStep(NamedTuple):
+    obs: jax.Array             # (A, obs_dim)
+    share_obs: jax.Array       # (A, state_dim)
+    available_actions: jax.Array  # (A, n_actions)
+    reward: jax.Array          # (A, 1)
+    done: jax.Array            # (A,) bool
+    # info channels riding the generic scalar slots (Trajectory.delays /
+    # payments): `delay` carries the battle-won flag on the terminal step —
+    # per-episode sums of it ARE the win indicator the SMAC runner reports
+    # (smac_runner.py:70-91) — and `payment` carries the ally dead ratio.
+    delay: jax.Array           # scalar: 1.0 on the step a battle is won
+    payment: jax.Array         # scalar: dead allies / A on this step
+
+
+@dataclasses.dataclass(frozen=True)
+class SMACLiteConfig:
+    map_name: str = "3m"
+    move_amount: float = MOVE_AMOUNT
+    attack_own_team: bool = False          # reserved
+    continuing_episode: bool = False
+
+
+def _roster_arrays(types: Tuple[str, ...], all_types: Tuple[str, ...]):
+    hp = np.array([UNIT_STATS[t][0] for t in types], np.float32)
+    sh = np.array([UNIT_STATS[t][1] for t in types], np.float32)
+    dmg = np.array([UNIT_STATS[t][2] for t in types], np.float32)
+    cd = np.array([UNIT_STATS[t][3] for t in types], np.float32)
+    melee = np.array([UNIT_STATS[t][4] for t in types], bool)
+    type_id = np.array([all_types.index(t) for t in types], np.int32)
+    return hp, sh, dmg, cd, melee, type_id
+
+
+class SMACLiteEnv:
+    """TimeStep-protocol combat env; all methods jit/vmap-safe."""
+
+    def __init__(self, cfg: SMACLiteConfig = SMACLiteConfig()):
+        self.cfg = cfg
+        mp: MapParams = get_map_params(cfg.map_name)
+        self.map_params = mp
+        self.n_agents = mp.n_agents
+        self.n_enemies = mp.n_enemies
+        self.n_actions = N_ACTIONS_NO_ATTACK + mp.n_enemies
+        self.action_dim = self.n_actions
+        self.episode_limit = mp.limit
+
+        all_types = mp.unit_types
+        self.unit_type_bits = mp.unit_type_bits
+        a = _roster_arrays(mp.agents, all_types)
+        e = _roster_arrays(mp.enemies, all_types)
+        (self.a_hp0, self.a_sh0, self.a_dmg, self.a_cd0, a_melee, self.a_type) = (
+            jnp.asarray(x) for x in a
+        )
+        (self.e_hp0, self.e_sh0, self.e_dmg, self.e_cd0, e_melee, self.e_type) = (
+            jnp.asarray(x) for x in e
+        )
+        self.a_range = jnp.where(jnp.asarray(a_melee), MELEE_RANGE, SHOOT_RANGE)
+        self.e_range = jnp.where(jnp.asarray(e_melee), MELEE_RANGE, SHOOT_RANGE)
+        self.shield_bits = int((a[1].max() > 0) or (e[1].max() > 0))
+        self.map_w, self.map_h = mp.map_size
+
+        # obs layout widths (get_obs_*_size, StarCraft2_Env.py:1662-1686):
+        # (attackable/visible, dist, relx, rely, health[, shield][, type])
+        self.enemy_feat_dim = 4 + 1 + self.shield_bits + self.unit_type_bits
+        self.ally_feat_dim = 4 + 1 + self.shield_bits + self.unit_type_bits
+        self.own_feat_dim = 1 + self.shield_bits + self.unit_type_bits
+        self.obs_dim = (
+            4
+            + self.n_enemies * self.enemy_feat_dim
+            + (self.n_agents - 1) * self.ally_feat_dim
+            + self.own_feat_dim
+        )
+        # state layout (get_state_size, :1688-1711): ally (health, cd, relx,
+        # rely[, shield][, type]) + enemy (health, relx, rely[, shield][, type])
+        # + last actions one-hot
+        self.state_ally_dim = 4 + self.shield_bits + self.unit_type_bits
+        self.state_enemy_dim = 3 + self.shield_bits + self.unit_type_bits
+        self.share_obs_dim = (
+            self.n_agents * self.state_ally_dim
+            + self.n_enemies * self.state_enemy_dim
+            + self.n_agents * self.n_actions
+        )
+
+        max_reward = float(e[0].sum() + e[1].sum()) + self.n_enemies * REWARD_DEATH_VALUE + REWARD_WIN
+        self._reward_norm = max_reward / REWARD_SCALE_RATE
+
+    # ------------------------------------------------------------- spawning
+
+    def _spawn(self, key: jax.Array) -> SMACLiteState:
+        k_a, k_e, key = jax.random.split(key, 3)
+        cx, cy = self.map_w / 2.0, self.map_h / 2.0
+        ally_y = cy + (jnp.arange(self.n_agents) - (self.n_agents - 1) / 2.0) * 1.5
+        enemy_y = cy + (jnp.arange(self.n_enemies) - (self.n_enemies - 1) / 2.0) * 1.5
+        jitter_a = jax.random.uniform(k_a, (self.n_agents, 2), minval=-0.5, maxval=0.5)
+        jitter_e = jax.random.uniform(k_e, (self.n_enemies, 2), minval=-0.5, maxval=0.5)
+        ally_pos = jnp.stack([jnp.full((self.n_agents,), cx - 6.0), ally_y], -1) + jitter_a
+        enemy_pos = jnp.stack([jnp.full((self.n_enemies,), cx + 6.0), enemy_y], -1) + jitter_e
+        return SMACLiteState(
+            rng=key,
+            ally_pos=ally_pos,
+            ally_hp=self.a_hp0,
+            ally_shield=self.a_sh0,
+            ally_cd=jnp.zeros((self.n_agents,)),
+            enemy_pos=enemy_pos,
+            enemy_hp=self.e_hp0,
+            enemy_shield=self.e_sh0,
+            enemy_cd=jnp.zeros((self.n_enemies,)),
+            last_actions=jnp.zeros((self.n_agents,), jnp.int32),
+            t=jnp.zeros((), jnp.int32),
+        )
+
+    # ------------------------------------------------------------ observing
+
+    def _avail(self, st: SMACLiteState) -> jax.Array:
+        """(A, n_actions) availability (``get_avail_agent_actions :1846-1884``)."""
+        alive = st.ally_hp > 0
+        pos = st.ally_pos
+        can_n = pos[:, 1] + self.cfg.move_amount <= self.map_h
+        can_s = pos[:, 1] - self.cfg.move_amount >= 0.0
+        can_e = pos[:, 0] + self.cfg.move_amount <= self.map_w
+        can_w = pos[:, 0] - self.cfg.move_amount >= 0.0
+        dist = jnp.linalg.norm(pos[:, None, :] - st.enemy_pos[None, :, :], axis=-1)
+        att = (dist <= self.a_range[:, None]) & (st.enemy_hp > 0)[None, :]
+        avail = jnp.concatenate(
+            [
+                (~alive)[:, None],                   # no-op iff dead
+                alive[:, None],                      # stop
+                jnp.stack([can_n, can_s, can_e, can_w], -1) & alive[:, None],
+                att & alive[:, None],
+            ],
+            axis=-1,
+        )
+        return avail.astype(jnp.float32)
+
+    def _unit_tail(self, hp_frac, sh_frac, type_id):
+        cols = [hp_frac[..., None]]
+        if self.shield_bits:
+            cols.append(sh_frac[..., None])
+        if self.unit_type_bits:
+            cols.append(jax.nn.one_hot(type_id, self.unit_type_bits))
+        return jnp.concatenate(cols, -1)
+
+    def _observe(self, st: SMACLiteState) -> Tuple[jax.Array, jax.Array, jax.Array]:
+        A, Ne = self.n_agents, self.n_enemies
+        avail = self._avail(st)
+        alive_a = st.ally_hp > 0
+        alive_e = st.enemy_hp > 0
+        rel_e = st.enemy_pos[None, :, :] - st.ally_pos[:, None, :]     # (A, Ne, 2)
+        dist_e = jnp.linalg.norm(rel_e, axis=-1)
+        vis_e = (dist_e < SIGHT_RANGE) & alive_e[None, :]
+        e_hp_frac = st.enemy_hp / self.e_hp0
+        e_sh_frac = st.enemy_shield / jnp.maximum(self.e_sh0, 1.0)
+        e_tail = jnp.broadcast_to(
+            self._unit_tail(e_hp_frac, e_sh_frac, self.e_type)[None],
+            (A, Ne, 1 + self.shield_bits + self.unit_type_bits),
+        )
+        enemy_feats = jnp.concatenate(
+            [
+                avail[:, N_ACTIONS_NO_ATTACK:, None],
+                (dist_e / SIGHT_RANGE)[..., None],
+                rel_e / SIGHT_RANGE,
+                e_tail,
+            ],
+            axis=-1,
+        ) * vis_e[..., None]
+
+        rel_a = st.ally_pos[None, :, :] - st.ally_pos[:, None, :]      # (A, A, 2)
+        dist_a = jnp.linalg.norm(rel_a, axis=-1)
+        vis_a = (dist_a < SIGHT_RANGE) & alive_a[None, :]
+        a_hp_frac = st.ally_hp / self.a_hp0
+        a_sh_frac = st.ally_shield / jnp.maximum(self.a_sh0, 1.0)
+        a_tail = jnp.broadcast_to(
+            self._unit_tail(a_hp_frac, a_sh_frac, self.a_type)[None],
+            (A, A, 1 + self.shield_bits + self.unit_type_bits),
+        )
+        ally_feats_full = jnp.concatenate(
+            [
+                vis_a[..., None].astype(jnp.float32),
+                (dist_a / SIGHT_RANGE)[..., None],
+                rel_a / SIGHT_RANGE,
+                a_tail,
+            ],
+            axis=-1,
+        ) * vis_a[..., None]
+        # drop self row i for each agent i (al_ids loop, :1101-1104);
+        # numpy mask stays concrete under jit (a traced bool index errors)
+        mask = ~np.eye(A, dtype=bool)
+        ally_feats = ally_feats_full[mask].reshape(A, A - 1, self.ally_feat_dim)
+
+        own = self._unit_tail(a_hp_frac, a_sh_frac, self.a_type)       # (A, own_feat)
+        move_feats = avail[:, 2:N_ACTIONS_NO_ATTACK]
+        obs = jnp.concatenate(
+            [
+                move_feats,
+                enemy_feats.reshape(A, -1),
+                ally_feats.reshape(A, -1),
+                own,
+            ],
+            axis=-1,
+        ) * alive_a[:, None]                                           # dead -> zeros
+
+        # centralized state (get_state :1189-1240)
+        cx, cy = self.map_w / 2.0, self.map_h / 2.0
+        a_state = jnp.concatenate(
+            [
+                a_hp_frac[:, None],
+                (st.ally_cd / jnp.maximum(self.a_cd0, 1.0))[:, None],
+                (st.ally_pos[:, 0:1] - cx) / self.map_w,
+                (st.ally_pos[:, 1:2] - cy) / self.map_h,
+            ]
+            + ([a_sh_frac[:, None]] if self.shield_bits else [])
+            + ([jax.nn.one_hot(self.a_type, self.unit_type_bits)] if self.unit_type_bits else []),
+            axis=-1,
+        ) * alive_a[:, None]
+        e_state = jnp.concatenate(
+            [
+                e_hp_frac[:, None],
+                (st.enemy_pos[:, 0:1] - cx) / self.map_w,
+                (st.enemy_pos[:, 1:2] - cy) / self.map_h,
+            ]
+            + ([e_sh_frac[:, None]] if self.shield_bits else [])
+            + ([jax.nn.one_hot(self.e_type, self.unit_type_bits)] if self.unit_type_bits else []),
+            axis=-1,
+        ) * alive_e[:, None]
+        last_act = jax.nn.one_hot(st.last_actions, self.n_actions)
+        state = jnp.concatenate(
+            [a_state.reshape(-1), e_state.reshape(-1), last_act.reshape(-1)]
+        )
+        share_obs = jnp.broadcast_to(state, (A, self.share_obs_dim))
+        return obs, share_obs, avail
+
+    # -------------------------------------------------------------- control
+
+    def reset(self, key: jax.Array, episode_idx=0) -> Tuple[SMACLiteState, SMACTimeStep]:
+        del episode_idx
+        st = self._spawn(key)
+        obs, share, avail = self._observe(st)
+        zero = jnp.zeros(())
+        return st, SMACTimeStep(
+            obs, share, avail,
+            jnp.zeros((self.n_agents, 1)),
+            jnp.zeros((self.n_agents,), bool),
+            zero, zero,
+        )
+
+    def step(self, st: SMACLiteState, action: jax.Array) -> Tuple[SMACLiteState, SMACTimeStep]:
+        A, Ne = self.n_agents, self.n_enemies
+        act = action.reshape(-1).astype(jnp.int32)
+        alive_a = st.ally_hp > 0
+        alive_e = st.enemy_hp > 0
+        avail = self._avail(st) > 0.5
+        # invalid submissions downgrade to stop (alive) / no-op (dead)
+        valid = jnp.take_along_axis(avail, act[:, None], axis=1)[:, 0]
+        act = jnp.where(valid, act, jnp.where(alive_a, 1, 0))
+
+        # ally movement
+        dirs = jnp.array([[0, 0], [0, 0], [0, 1], [0, -1], [1, 0], [-1, 0]], jnp.float32)
+        move_vec = dirs[jnp.clip(act, 0, 5)] * self.cfg.move_amount
+        moving = (act >= 2) & (act < N_ACTIONS_NO_ATTACK)
+        new_pos = st.ally_pos + move_vec * moving[:, None]
+        new_pos = jnp.clip(
+            new_pos,
+            jnp.zeros((2,)),
+            jnp.array([self.map_w, self.map_h]),
+        )
+
+        # ally attacks: damage lands this step if cooldown ready
+        attacking = act >= N_ACTIONS_NO_ATTACK
+        target = jnp.clip(act - N_ACTIONS_NO_ATTACK, 0, Ne - 1)
+        can_fire = attacking & (st.ally_cd <= 0) & alive_a
+        dmg_to_enemy = jnp.zeros((Ne,)).at[target].add(
+            jnp.where(can_fire, self.a_dmg, 0.0)
+        )
+        ally_cd = jnp.where(
+            can_fire, self.a_cd0, jnp.maximum(st.ally_cd - 1.0, 0.0)
+        )
+
+        # enemy AI: attack nearest ally in range, else advance toward nearest
+        dist_ea = jnp.linalg.norm(
+            st.enemy_pos[:, None, :] - st.ally_pos[None, :, :], axis=-1
+        )                                                           # (Ne, A)
+        dist_masked = jnp.where(alive_a[None, :], dist_ea, jnp.inf)
+        near = jnp.argmin(dist_masked, axis=1)                      # (Ne,)
+        near_dist = jnp.take_along_axis(dist_masked, near[:, None], 1)[:, 0]
+        any_ally = jnp.isfinite(near_dist)
+        e_fire = alive_e & any_ally & (near_dist <= self.e_range) & (st.enemy_cd <= 0)
+        dmg_to_ally = jnp.zeros((A,)).at[near].add(jnp.where(e_fire, self.e_dmg, 0.0))
+        enemy_cd = jnp.where(e_fire, self.e_cd0, jnp.maximum(st.enemy_cd - 1.0, 0.0))
+        # advance when not firing
+        to_ally = jnp.take_along_axis(
+            st.ally_pos[None].repeat(Ne, 0), near[:, None, None].repeat(2, 2), 1
+        )[:, 0, :] - st.enemy_pos
+        norm = jnp.maximum(jnp.linalg.norm(to_ally, axis=-1, keepdims=True), 1e-6)
+        e_move = alive_e & any_ally & ~e_fire
+        enemy_pos = st.enemy_pos + (to_ally / norm) * self.cfg.move_amount * e_move[:, None]
+
+        # apply damage: shields absorb first (protoss semantics)
+        e_sh_after = jnp.maximum(st.enemy_shield - dmg_to_enemy, 0.0)
+        e_overflow = jnp.maximum(dmg_to_enemy - st.enemy_shield, 0.0)
+        enemy_hp = jnp.clip(st.enemy_hp - e_overflow, 0.0, None)
+        a_sh_after = jnp.maximum(st.ally_shield - dmg_to_ally, 0.0)
+        a_overflow = jnp.maximum(dmg_to_ally - st.ally_shield, 0.0)
+        ally_hp = jnp.clip(st.ally_hp - a_overflow, 0.0, None)
+
+        # shaped reward (positive-only SMAC default): damage + kills + win
+        enemy_killed = alive_e & (enemy_hp <= 0)
+        damage_dealt = (st.enemy_hp - enemy_hp).sum() + (st.enemy_shield - e_sh_after).sum()
+        won = ~(enemy_hp > 0).any()
+        lost = ~(ally_hp > 0).any() & ~won
+        t = st.t + 1
+        timeout = t >= self.episode_limit
+        done_now = won | lost | timeout
+        raw = (
+            damage_dealt
+            + REWARD_DEATH_VALUE * enemy_killed.sum()
+            + REWARD_WIN * won
+        )
+        reward = raw / self._reward_norm
+        # emitted only on terminal steps so per-episode SUMS of the channel
+        # (what the runner accounting computes) equal the episode's value
+        dead_ratio = (1.0 - (ally_hp > 0).mean()) * done_now
+
+        mid = SMACLiteState(
+            rng=st.rng, ally_pos=new_pos, ally_hp=ally_hp, ally_shield=a_sh_after,
+            ally_cd=ally_cd, enemy_pos=enemy_pos, enemy_hp=enemy_hp,
+            enemy_shield=e_sh_after, enemy_cd=enemy_cd, last_actions=act, t=t,
+        )
+        # auto-reset inside step (pure-JAX convention): terminal steps return
+        # the NEW episode's obs with the old step's reward
+        key_next, k_spawn = jax.random.split(st.rng)
+        fresh = self._spawn(k_spawn)._replace(rng=key_next)
+        new_st = jax.tree.map(
+            lambda a, b: jnp.where(done_now, a, b), fresh, mid
+        )
+        obs, share, avail_next = self._observe(new_st)
+        return new_st, SMACTimeStep(
+            obs=obs,
+            share_obs=share,
+            available_actions=avail_next,
+            reward=jnp.full((A, 1), reward, jnp.float32),
+            done=jnp.full((A,), done_now),
+            delay=won.astype(jnp.float32),
+            payment=dead_ratio,
+        )

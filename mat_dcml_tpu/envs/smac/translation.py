@@ -1,0 +1,221 @@
+"""Multi-map SMAC feature translation (``starcraft2/feature_translation.py``).
+
+Different maps have different agent counts, rosters, and action spaces; to
+train ONE policy across maps (and evaluate few-shot on held-out maps —
+``smac_multi_runner.py``), per-map obs/state/avail tensors are padded into a
+universal layout:
+
+- agents padded to ``TARGET_NUM_AGENT`` (27), enemies to ``TARGET_NUM_ENEMY``
+  (30) — virtual units are dead: zero features, no-op-only availability
+  (reference targets ``feature_translation.py:9-11``: 27 agents / 38 actions
+  with SC2's wider rosters; ours derive from the stand-in registry).
+- per-unit feature rows widened to a universal schema with a shield slot and
+  a unified unit-type one-hot over every known type
+  (``unified_unit_type_map``), so "marine" means the same feature column on
+  every map.
+- a task embedding (map one-hot + normalized team sizes/limit) appended to
+  obs and state (``gen_task_embedding :283-293``).
+
+Everything is static-shape jit/vmap-safe array surgery on top of
+:class:`SMACLiteEnv`; :class:`TranslatedSMACEnv` exposes the padded env as a
+normal TimeStep env so collectors/policies are map-agnostic.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from mat_dcml_tpu.envs.smac.maps import UNIT_STATS, map_param_registry
+from mat_dcml_tpu.envs.smac.smaclite import (
+    N_ACTIONS_NO_ATTACK,
+    SMACLiteConfig,
+    SMACLiteEnv,
+    SMACTimeStep,
+)
+
+TARGET_NUM_AGENT = 27
+TARGET_NUM_ENEMY = 30
+TARGET_ACTION_DIM = N_ACTIONS_NO_ATTACK + TARGET_NUM_ENEMY
+
+UNIFIED_TYPES: Tuple[str, ...] = tuple(sorted(UNIT_STATS))
+N_TYPES = len(UNIFIED_TYPES)
+
+# universal per-row widths: (flag, dist, relx, rely, health, shield, type*)
+UNIT_ROW_DIM = 5 + 1 + N_TYPES
+OWN_ROW_DIM = 1 + 1 + N_TYPES
+STATE_ALLY_DIM = 4 + 1 + N_TYPES          # health, cd, relx, rely, shield, type*
+STATE_ENEMY_DIM = 3 + 1 + N_TYPES
+
+_MAP_NAMES = tuple(sorted(map_param_registry))
+TASK_EMBEDDING_DIM = len(_MAP_NAMES) + 3
+
+
+def gen_task_embedding(map_name: str) -> np.ndarray:
+    """Map one-hot + (n_agents, n_enemies, limit) normalized
+    (``feature_translation.py:283-293``)."""
+    mp = map_param_registry[map_name]
+    one_hot = np.zeros(len(_MAP_NAMES), np.float32)
+    one_hot[_MAP_NAMES.index(map_name)] = 1.0
+    extras = np.array(
+        [mp.n_agents / TARGET_NUM_AGENT, mp.n_enemies / TARGET_NUM_ENEMY, mp.limit / 200.0],
+        np.float32,
+    )
+    return np.concatenate([one_hot, extras])
+
+
+def _widen_rows(rows: jax.Array, env: SMACLiteEnv, flag_cols: int) -> jax.Array:
+    """(..., k, env_row_dim) -> (..., k, flag_cols+4+1+1+N_TYPES): copy the
+    first ``flag_cols + 4`` columns verbatim (flags/dist/rel/health — callers
+    choose flag_cols so the copied prefix is exactly their non-shield,
+    non-type columns), place shield into the universal shield slot, re-embed
+    the unit type into the unified one-hot."""
+    lead = rows[..., : flag_cols + 3]
+    health = rows[..., flag_cols + 3 : flag_cols + 4]
+    idx = flag_cols + 4
+    if env.shield_bits:
+        shield = rows[..., idx : idx + 1]
+        idx += 1
+    else:
+        shield = jnp.zeros_like(health)
+    # env-local type one-hot -> unified: scatter through the map's type list
+    uni = jnp.zeros((*rows.shape[:-1], N_TYPES), rows.dtype)
+    local_types = env.map_params.unit_types
+    if env.unit_type_bits:
+        local_oh = rows[..., idx : idx + env.unit_type_bits]
+        for j, tname in enumerate(local_types):
+            uni = uni.at[..., UNIFIED_TYPES.index(tname)].set(local_oh[..., j])
+    else:
+        # homogeneous map: the (single) roster type, gated on the row being
+        # live (flag/health nonzero so padded rows stay all-zero)
+        live = (jnp.abs(rows).sum(-1, keepdims=True) > 0).astype(rows.dtype)
+        tname = local_types[0]
+        uni = uni.at[..., UNIFIED_TYPES.index(tname)].set(live[..., 0])
+    return jnp.concatenate([lead, health, shield, uni], axis=-1)
+
+
+def _pad_axis(x: jax.Array, axis: int, target: int) -> jax.Array:
+    pad = [(0, 0)] * x.ndim
+    pad[axis] = (0, target - x.shape[axis])
+    return jnp.pad(x, pad)
+
+
+class TranslatedSMACEnv:
+    """A SMACLite map padded to the universal multi-map layout."""
+
+    def __init__(self, cfg: SMACLiteConfig = SMACLiteConfig()):
+        self.env = SMACLiteEnv(cfg)
+        e = self.env
+        self.map_name = cfg.map_name
+        self.n_agents = TARGET_NUM_AGENT
+        self.action_dim = TARGET_ACTION_DIM
+        self._task_emb = jnp.asarray(gen_task_embedding(cfg.map_name))
+        self.obs_dim = (
+            4
+            + TARGET_NUM_ENEMY * UNIT_ROW_DIM
+            + (TARGET_NUM_AGENT - 1) * UNIT_ROW_DIM
+            + OWN_ROW_DIM
+            + TASK_EMBEDDING_DIM
+        )
+        self.share_obs_dim = (
+            TARGET_NUM_AGENT * STATE_ALLY_DIM
+            + TARGET_NUM_ENEMY * STATE_ENEMY_DIM
+            + TARGET_NUM_AGENT * TARGET_ACTION_DIM
+            + TASK_EMBEDDING_DIM
+        )
+
+    # ------------------------------------------------------------ translate
+
+    def _translate_obs(self, obs: jax.Array) -> jax.Array:
+        e = self.env
+        A, Ne = e.n_agents, e.n_enemies
+        i = 4
+        move = obs[:, :i]
+        enemy = obs[:, i : i + Ne * e.enemy_feat_dim].reshape(A, Ne, e.enemy_feat_dim)
+        i += Ne * e.enemy_feat_dim
+        ally = obs[:, i : i + (A - 1) * e.ally_feat_dim].reshape(A, A - 1, e.ally_feat_dim)
+        i += (A - 1) * e.ally_feat_dim
+        own = obs[:, i:]
+
+        enemy_u = _pad_axis(_widen_rows(enemy, e, flag_cols=1), 1, TARGET_NUM_ENEMY)
+        ally_u = _pad_axis(_widen_rows(ally, e, flag_cols=1), 1, TARGET_NUM_AGENT - 1)
+        own_u = _widen_rows(own[:, None, :], e, flag_cols=-3)[:, 0, :]
+        flat = jnp.concatenate(
+            [
+                move,
+                enemy_u.reshape(A, -1),
+                ally_u.reshape(A, -1),
+                own_u,
+                jnp.broadcast_to(self._task_emb, (A, TASK_EMBEDDING_DIM)),
+            ],
+            axis=-1,
+        )
+        return _pad_axis(flat, 0, TARGET_NUM_AGENT)
+
+    def _translate_state(self, share_obs: jax.Array) -> jax.Array:
+        e = self.env
+        A, Ne = e.n_agents, e.n_enemies
+        row = share_obs[0]
+        i = A * e.state_ally_dim
+        a_state = row[:i].reshape(A, e.state_ally_dim)
+        e_state = row[i : i + Ne * e.state_enemy_dim].reshape(Ne, e.state_enemy_dim)
+        i += Ne * e.state_enemy_dim
+        last = row[i:].reshape(A, e.n_actions)
+
+        a_u = _pad_axis(_widen_rows(a_state[None], e, flag_cols=0)[0], 0, TARGET_NUM_AGENT)
+        e_u = _pad_axis(_widen_rows(e_state[None], e, flag_cols=-1)[0], 0, TARGET_NUM_ENEMY)
+        # split last-action one-hot: no-attack block + attack block padded apart
+        last_u = jnp.concatenate(
+            [
+                last[:, :N_ACTIONS_NO_ATTACK],
+                _pad_axis(last[:, N_ACTIONS_NO_ATTACK:], 1, TARGET_NUM_ENEMY),
+            ],
+            axis=-1,
+        )
+        last_u = _pad_axis(last_u, 0, TARGET_NUM_AGENT)
+        state = jnp.concatenate(
+            [a_u.reshape(-1), e_u.reshape(-1), last_u.reshape(-1), self._task_emb]
+        )
+        return jnp.broadcast_to(state, (TARGET_NUM_AGENT, self.share_obs_dim))
+
+    def _translate_avail(self, avail: jax.Array) -> jax.Array:
+        wide = jnp.concatenate(
+            [
+                avail[:, :N_ACTIONS_NO_ATTACK],
+                _pad_axis(avail[:, N_ACTIONS_NO_ATTACK:], 1, TARGET_NUM_ENEMY),
+            ],
+            axis=-1,
+        )
+        pad_rows = jnp.zeros((TARGET_NUM_AGENT - avail.shape[0], TARGET_ACTION_DIM))
+        pad_rows = pad_rows.at[:, 0].set(1.0)             # virtual agents: no-op only
+        return jnp.concatenate([wide, pad_rows], axis=0)
+
+    def _translate_ts(self, ts: SMACTimeStep) -> SMACTimeStep:
+        A = self.env.n_agents
+        reward = jnp.broadcast_to(ts.reward[:1], (TARGET_NUM_AGENT, 1))
+        done = jnp.broadcast_to(ts.done[:1], (TARGET_NUM_AGENT,))
+        return SMACTimeStep(
+            obs=self._translate_obs(ts.obs),
+            share_obs=self._translate_state(ts.share_obs),
+            available_actions=self._translate_avail(ts.available_actions),
+            reward=reward,
+            done=done,
+            delay=ts.delay,
+            payment=ts.payment,
+        )
+
+    # --------------------------------------------------------------- control
+
+    def reset(self, key: jax.Array, episode_idx=0):
+        st, ts = self.env.reset(key, episode_idx)
+        return st, self._translate_ts(ts)
+
+    def step(self, st, action: jax.Array):
+        # slice back to the real roster; padded agents' actions are ignored,
+        # attack ids beyond the real enemy count downgrade inside the env
+        real = action[: self.env.n_agents]
+        st, ts = self.env.step(st, real)
+        return st, self._translate_ts(ts)

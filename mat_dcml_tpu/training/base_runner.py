@@ -172,6 +172,7 @@ class BaseRunner:
                         record["aver_episode_delays"] = float(np.mean(done_delays))
                         record["aver_episode_payments"] = float(np.mean(done_payments))
                     done_rewards, done_delays, done_payments = [], [], []
+                self._extra_metrics(record)
                 self._log_record(record)
 
             if (episode % run.save_interval == 0 or episode == episodes - 1) and self.run_cfg.algorithm_name != "random":
@@ -186,6 +187,10 @@ class BaseRunner:
                 self.log(f"eval ep {episode}: {eval_info}")
 
         return train_state, rollout_state
+
+    def _extra_metrics(self, record: dict) -> None:
+        """Hook for env-specific metric shaping (e.g. SMAC win rate from the
+        generic episode-info channels) before a record is logged."""
 
     def _log_record(self, record: dict):
         self.metrics_path.parent.mkdir(parents=True, exist_ok=True)

@@ -1,0 +1,185 @@
+"""SMAC runners: battle win-rate tracking and multi-map training.
+
+``SMACRunner`` (``runner/shared/smac_runner.py``): the generic collect/train
+loop plus win-rate / dead-ratio metrics — SMAC envs emit the battle-won flag
+and terminal dead ratio on the generic episode-info channels (see
+``SMACTimeStep``), so per-episode sums ARE the metrics
+(``smac_runner.py:70-93`` incl. ``dead_ratio`` from active masks), and an
+eval-until-N-episodes deterministic loop (``:164-220``).
+
+``SMACMultiRunner`` (``smac_multi_runner.py``): ONE policy over the universal
+translated layout trained across several maps — collect on each map
+round-robin, train on each map's chunk, log per-map win rates, eval over the
+full map list (plus held-out maps for few-shot studies).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from mat_dcml_tpu.config import RunConfig
+from mat_dcml_tpu.envs.smac import SMACLiteConfig, TranslatedSMACEnv
+from mat_dcml_tpu.training.base_runner import BaseRunner
+from mat_dcml_tpu.training.generic_runner import GenericRunner, build_discrete_policy
+from mat_dcml_tpu.training.ppo import MATTrainer, PPOConfig
+from mat_dcml_tpu.training.rollout import RolloutCollector
+
+
+class SMACRunner(GenericRunner):
+    """GenericRunner + SMAC metric shaping + episode-based eval."""
+
+    def _extra_metrics(self, record: dict) -> None:
+        if "aver_episode_delays" in record:
+            record["win_rate"] = record.pop("aver_episode_delays")
+            record["dead_ratio"] = record.pop("aver_episode_payments")
+
+    def evaluate(self, train_state, n_episodes: int = 32, seed: int = 0,
+                 max_steps: Optional[int] = None):
+        """Deterministic eval until ``n_episodes`` battles finish
+        (``smac_runner.py:164-220``)."""
+        E = self.run_cfg.n_rollout_threads
+        env = self.collector.env
+        rs = self.collector.init_state(jax.random.key(seed + 17), E)
+        limit = max_steps or 4 * getattr(env, "episode_limit", 200) * (
+            max(n_episodes // E, 1) + 1
+        )
+
+        @jax.jit
+        def eval_step(params, st):
+            out = self.policy.get_actions(
+                params, jax.random.key(0), st.share_obs, st.obs,
+                st.available_actions, deterministic=True,
+            )
+            env_states, ts = jax.vmap(env.step)(st.env_states, out.action)
+            new_st = st._replace(
+                env_states=env_states, obs=ts.obs, share_obs=ts.share_obs,
+                available_actions=ts.available_actions,
+            )
+            done_env = ts.done.all(axis=1)
+            return new_st, (done_env, ts.delay, ts.payment, ts.reward.mean())
+
+        episodes = wins = 0
+        dead_ratios, rewards = [], []
+        for _ in range(limit):
+            rs, (done, won, dead, rew) = eval_step(train_state.params, rs)
+            done = np.asarray(done)
+            rewards.append(float(rew))
+            if done.any():
+                episodes += int(done.sum())
+                wins += int(np.asarray(won)[done].sum())
+                dead_ratios.extend(np.asarray(dead)[done].tolist())
+            if episodes >= n_episodes:
+                break
+        return {
+            "eval_win_rate": wins / max(episodes, 1),
+            "eval_episodes": episodes,
+            "eval_dead_ratio": float(np.mean(dead_ratios)) if dead_ratios else 0.0,
+            "eval_average_step_rewards": float(np.mean(rewards)),
+        }
+
+
+class SMACMultiRunner(BaseRunner):
+    """One policy, many maps, via the universal translated layout."""
+
+    def __init__(self, run: RunConfig, ppo: PPOConfig,
+                 train_maps: Sequence[str], log_fn=print):
+        if run.algorithm_name not in ("mat", "mat_dec"):
+            raise NotImplementedError(
+                "multi-map training drives the MAT family (smac_multi_runner.py)"
+            )
+        self.train_maps = tuple(train_maps)
+        self.envs = {m: TranslatedSMACEnv(SMACLiteConfig(map_name=m)) for m in self.train_maps}
+        probe = next(iter(self.envs.values()))
+        self.env = probe
+        self.is_mat = True
+        self.policy = build_discrete_policy(run, probe)
+        self.trainer = MATTrainer(self.policy, ppo, total_updates=run.episodes)
+        # one collector (and jitted collect) per map — same policy params flow
+        # through every one; XLA compiles one program per map shape
+        self.collectors = {
+            m: RolloutCollector(env, self.policy, run.episode_length)
+            for m, env in self.envs.items()
+        }
+        self.collector = self.collectors[self.train_maps[0]]
+        self.finalize(run, log_fn)
+        self._collects = {m: jax.jit(c.collect) for m, c in self.collectors.items()}
+
+    def setup(self, seed: Optional[int] = None):
+        seed = self.run_cfg.seed if seed is None else seed
+        key = jax.random.key(seed)
+        k_model, *k_rolls = jax.random.split(key, 1 + len(self.train_maps))
+        params = self.policy.init_params(k_model)
+        train_state = self.trainer.init_state(params)
+        rollout_states = {
+            m: self.collectors[m].init_state(k, self.run_cfg.n_rollout_threads)
+            for m, k in zip(self.train_maps, k_rolls)
+        }
+        return train_state, rollout_states
+
+    def train_loop(self, num_episodes: Optional[int] = None, train_state=None,
+                   rollout_states=None):
+        run = self.run_cfg
+        episodes = num_episodes if num_episodes is not None else run.episodes
+        if train_state is None:
+            train_state, rollout_states = self.setup()
+        key = jax.random.key(run.seed + 7919)
+
+        wins = {m: [] for m in self.train_maps}
+        for episode in range(episodes):
+            # round-robin across maps (smac_multi_runner trains each map's
+            # chunk in turn); one map per outer iteration
+            m = self.train_maps[episode % len(self.train_maps)]
+            rollout_states[m], traj = self._collects[m](train_state.params, rollout_states[m])
+            key, k_train = jax.random.split(key)
+            train_state, metrics = self._train(train_state, traj, rollout_states[m], k_train)
+
+            dones = np.asarray(traj.dones)
+            won = np.asarray(traj.delays)
+            # per-episode win bookkeeping: a win flag fires on terminal steps
+            if dones.any():
+                wins[m].extend(won[dones].tolist())
+
+            if episode % run.log_interval == 0:
+                record = {
+                    "episode": episode,
+                    "map": m,
+                    "average_step_rewards": float(np.asarray(traj.rewards).mean()),
+                    "value_loss": float(np.mean(metrics.value_loss)),
+                    "policy_loss": float(np.mean(metrics.policy_loss)),
+                    "dist_entropy": float(np.mean(metrics.dist_entropy)),
+                }
+                for name, w in wins.items():
+                    if w:
+                        record[f"win_rate_{name}"] = float(np.mean(w))
+                wins = {m_: [] for m_ in self.train_maps}
+                self.metrics_path.parent.mkdir(parents=True, exist_ok=True)
+                import json
+
+                with open(self.metrics_path, "a") as f:
+                    f.write(json.dumps(record) + "\n")
+                self.log(f"ep {episode} [{m}] {record}")
+
+            if episode % run.save_interval == 0 or episode == episodes - 1:
+                self.ckpt.save(episode, train_state)
+        return train_state, rollout_states
+
+    def evaluate(self, train_state, maps: Optional[Sequence[str]] = None,
+                 n_episodes: int = 16, seed: int = 0):
+        """Per-map deterministic win rates; ``maps`` may include held-out maps
+        (few-shot eval, ``smac_multi_runner.py:160-275``)."""
+        maps = tuple(maps) if maps is not None else self.train_maps
+        out = {}
+        for m in maps:
+            env = self.envs.get(m) or TranslatedSMACEnv(SMACLiteConfig(map_name=m))
+            collector = RolloutCollector(env, self.policy, self.run_cfg.episode_length)
+            sub = SMACRunner.__new__(SMACRunner)       # reuse the eval loop only
+            sub.run_cfg = self.run_cfg
+            sub.policy = self.policy
+            sub.collector = collector
+            info = SMACRunner.evaluate(sub, train_state, n_episodes=n_episodes, seed=seed)
+            out[f"eval_win_rate_{m}"] = info["eval_win_rate"]
+        return out

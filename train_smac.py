@@ -1,0 +1,56 @@
+#!/usr/bin/env python
+"""Train on SMAC maps (StarCraft II combat).
+
+Equivalent of the reference entry point
+``mat_src/mat/scripts/train/train_smac.py`` (+ ``train_smac.sh`` recipe).
+Default backend is the pure-JAX combat stand-in
+(``mat_dcml_tpu/envs/smac/smaclite.py``) — vmapped on device, no game binary.
+``--backend sc2`` drives the real game through the host-process vec-env
+bridge (requires the external smac package + an SC2 install).
+
+Usage:
+  python train_smac.py --map_name 3m --algorithm_name mat \
+      --num_env_steps 500000 --n_rollout_threads 32
+  python train_smac.py --map_name 2s3z --algorithm_name mappo
+"""
+
+import argparse
+import dataclasses
+import sys
+
+from mat_dcml_tpu.utils.platform import apply_platform_override
+
+apply_platform_override()
+
+from mat_dcml_tpu.config import parse_cli_with_extras
+from mat_dcml_tpu.envs.smac import SMACLiteConfig, SMACLiteEnv, map_param_registry
+from mat_dcml_tpu.training.smac_runner import SMACRunner
+
+
+def main(argv=None):
+    extras = argparse.ArgumentParser(add_help=False)
+    extras.add_argument("--map_name", type=str, default="3m",
+                        choices=sorted(map_param_registry))
+    extras.add_argument("--backend", type=str, default="smaclite",
+                        choices=("smaclite", "sc2"))
+    run, ppo, ns = parse_cli_with_extras(argv, extras=extras, overrides={
+        "env_name": "StarCraft2", "episode_length": 60,
+    })
+    run = dataclasses.replace(run, scenario=ns.map_name)
+    if ns.backend == "sc2":
+        raise SystemExit(
+            "--backend sc2 needs the external smac package + an SC2 install "
+            "(not bundled); wire SMACHostEnv through ShareSubprocVecEnv + "
+            "HostRolloutCollector (envs/smac/host.py docstring)."
+        )
+    env = SMACLiteEnv(SMACLiteConfig(map_name=ns.map_name))
+    runner = SMACRunner(run, ppo, env)
+    print(f"algorithm={run.algorithm_name} env=SMAC/{ns.map_name} "
+          f"agents={env.n_agents} episodes={run.episodes} "
+          f"devices={len(__import__('jax').devices())}")
+    state, _ = runner.train_loop()
+    print("final eval:", runner.evaluate(state, n_episodes=run.eval_episodes))
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])

@@ -1,0 +1,53 @@
+#!/usr/bin/env python
+"""Multi-map SMAC training: one MAT policy across several maps.
+
+Equivalent of the reference entry point ``train_smac_multi.py`` (+
+``train_smac_multi.sh`` / ``train_smac_few_shot.sh``): per-map features are
+padded to a universal layout with a task embedding
+(``mat_dcml_tpu/envs/smac/translation.py``), the policy trains round-robin
+across ``--train_maps``, and ``--eval_maps`` may include held-out maps for
+few-shot evaluation.
+
+Usage:
+  python train_smac_multi.py --train_maps 3m,8m --eval_maps 3m,8m,5m_vs_6m
+"""
+
+import argparse
+import sys
+
+from mat_dcml_tpu.utils.platform import apply_platform_override
+
+apply_platform_override()
+
+from mat_dcml_tpu.config import parse_cli_with_extras
+from mat_dcml_tpu.envs.smac import map_param_registry
+from mat_dcml_tpu.training.smac_runner import SMACMultiRunner
+
+
+def _maps(arg: str):
+    names = [m for m in arg.split(",") if m]
+    for m in names:
+        if m not in map_param_registry:
+            raise SystemExit(f"unknown map {m!r}; known: {sorted(map_param_registry)}")
+    return names
+
+
+def main(argv=None):
+    extras = argparse.ArgumentParser(add_help=False)
+    extras.add_argument("--train_maps", type=str, default="3m,8m")
+    extras.add_argument("--eval_maps", type=str, default="")
+    run, ppo, ns = parse_cli_with_extras(argv, extras=extras, overrides={
+        "env_name": "StarCraft2Multi", "scenario": "multi", "episode_length": 60,
+    })
+    train_maps = _maps(ns.train_maps)
+    eval_maps = _maps(ns.eval_maps) if ns.eval_maps else train_maps
+    runner = SMACMultiRunner(run, ppo, train_maps=train_maps)
+    print(f"algorithm={run.algorithm_name} maps={train_maps} "
+          f"episodes={run.episodes} devices={len(__import__('jax').devices())}")
+    state, _ = runner.train_loop()
+    print("final eval:", runner.evaluate(state, maps=eval_maps,
+                                         n_episodes=run.eval_episodes))
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])
