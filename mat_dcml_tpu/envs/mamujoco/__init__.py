@@ -1,0 +1,21 @@
+"""Multi-agent MuJoCo family: obsk joint-graph factorization, pure-JAX
+stand-in dynamics, fault injection, and the gated real-gym host adapter."""
+
+from mat_dcml_tpu.envs.mamujoco.fault import FaultyAgentWrapper
+from mat_dcml_tpu.envs.mamujoco.lite import MJLiteConfig, MJLiteEnv
+from mat_dcml_tpu.envs.mamujoco.obsk import (
+    RobotGraph,
+    build_obs_indices,
+    get_parts_and_edges,
+    joints_at_kdist,
+)
+
+__all__ = [
+    "FaultyAgentWrapper",
+    "MJLiteConfig",
+    "MJLiteEnv",
+    "RobotGraph",
+    "build_obs_indices",
+    "get_parts_and_edges",
+    "joints_at_kdist",
+]

@@ -1,0 +1,107 @@
+"""Real multi-agent MuJoCo behind the host-process bridge (gated).
+
+Factorizes a gym MuJoCo robot into agents exactly as the reference
+``MujocoMulti`` (``mujoco_multi.py:39-260``): actuated joints partitioned by
+``agent_conf``, per-agent obs from the k-hop joint neighborhood (obsk index
+tables), state = the wrapped env's full observation, availability all-ones,
+shared reward.  Exposes the host shared-obs contract for
+:mod:`~mat_dcml_tpu.envs.vec_env`.
+
+Gated: requires ``gymnasium`` (or legacy ``gym``) with MuJoCo — not bundled;
+:class:`~mat_dcml_tpu.envs.mamujoco.lite.MJLiteEnv` covers binary-free
+training and tests.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from mat_dcml_tpu.envs.mamujoco.obsk import build_obs_indices, get_parts_and_edges
+
+
+class MujocoMultiHostEnv:
+    self_resetting = False
+
+    def __init__(self, scenario: str = "HalfCheetah-v4", agent_conf: str = "2x3",
+                 agent_obsk: int = 1, episode_limit: int = 1000, seed: int = 0):
+        try:
+            import gymnasium as gym
+        except ImportError:
+            try:
+                import gym  # type: ignore
+            except ImportError as err:
+                raise ImportError(
+                    "MujocoMultiHostEnv needs gymnasium (or gym) with MuJoCo "
+                    "installed; neither is bundled. Use MJLiteEnv for "
+                    "binary-free multi-agent continuous control."
+                ) from err
+        self._gym_env = gym.make(scenario)
+        self._seed = seed
+        self.episode_limit = episode_limit
+        parts, graph = get_parts_and_edges(scenario, agent_conf)
+        self.partitions = parts
+        self.n_agents = len(parts)
+        self.joints_per_agent = max(len(p) for p in parts)
+        self.action_dim = self.joints_per_agent
+        self._act_ids = [
+            [graph.joints[j].act_id for j in p] for p in parts
+        ]
+        rows = [build_obs_indices(graph, p, agent_obsk) for p in parts]
+        width_p = max(len(q) for q, _ in rows)
+        width_v = max(len(v) for _, v in rows)
+        self._qpos_ids = np.array(
+            [list(q) + [-1] * (width_p - len(q)) for q, _ in rows], np.int64
+        )
+        self._qvel_ids = np.array(
+            [list(v) + [-1] * (width_v - len(v)) for _, v in rows], np.int64
+        )
+        self.obs_dim = width_p + width_v
+        self._t = 0
+        env = self._gym_env.unwrapped
+        self.share_obs_dim = int(np.asarray(env.data.qpos).size + np.asarray(env.data.qvel).size)
+
+    def _bundle(self):
+        env = self._gym_env.unwrapped
+        qpos = np.asarray(env.data.qpos).ravel()
+        qvel = np.asarray(env.data.qvel).ravel()
+
+        def gather(x, ids):
+            out = x[np.clip(ids, 0, x.size - 1)]
+            out[ids < 0] = 0.0
+            return out
+
+        obs = np.concatenate(
+            [gather(qpos, self._qpos_ids), gather(qvel, self._qvel_ids)], axis=1
+        ).astype(np.float32)
+        state = np.concatenate([qpos, qvel]).astype(np.float32)
+        share = np.broadcast_to(state, (self.n_agents, state.size)).copy()
+        avail = np.ones((self.n_agents, 1), np.float32)
+        return obs, share, avail
+
+    def reset(self):
+        self._gym_env.reset(seed=self._seed)
+        self._seed += 1
+        self._t = 0
+        return self._bundle()
+
+    def step(self, actions):
+        acts = np.asarray(actions, np.float64).reshape(self.n_agents, -1)
+        flat = np.zeros(sum(len(p) for p in self.partitions))
+        for a, ids in enumerate(self._act_ids):
+            for k, i in enumerate(ids):
+                flat[i] = acts[a, k]
+        out = self._gym_env.step(flat)
+        if len(out) == 5:                       # gymnasium API
+            _, reward, terminated, truncated, info = out
+            done_flag = bool(terminated or truncated)
+        else:                                   # legacy gym API
+            _, reward, done_flag, info = out
+        self._t += 1
+        done_flag = done_flag or self._t >= self.episode_limit
+        obs, share, avail = self._bundle()
+        rew = np.full((self.n_agents, 1), reward, np.float32)
+        done = np.full((self.n_agents,), done_flag)
+        return obs, share, rew, done, dict(info or {}), avail
+
+    def close(self):
+        self._gym_env.close()

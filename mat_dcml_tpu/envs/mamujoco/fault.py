@@ -1,0 +1,32 @@
+"""Agent fault injection for robustness studies.
+
+The reference zeroes a chosen agent's torques during train/eval
+(``mujoco_runner.py:13-20`` ``faulty_action``; swept over ``eval_faulty_node``
+in ``train_mujoco.py:68-69``).  Here that is an env wrapper so the masking
+happens INSIDE the jitted step — one compiled program per faulty node, no
+host-side action surgery.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+class FaultyAgentWrapper:
+    """Zeroes ``faulty_node``'s action before the wrapped step; -1 = no fault."""
+
+    def __init__(self, env, faulty_node: int = -1):
+        self.env = env
+        self.faulty_node = faulty_node
+        for attr in ("n_agents", "obs_dim", "share_obs_dim", "action_dim",
+                     "episode_limit", "action_space"):
+            if hasattr(env, attr):
+                setattr(self, attr, getattr(env, attr))
+
+    def reset(self, key, episode_idx=0):
+        return self.env.reset(key, episode_idx)
+
+    def step(self, state, action):
+        if self.faulty_node >= 0:
+            action = action.at[..., self.faulty_node, :].set(0.0)
+        return self.env.step(state, action)

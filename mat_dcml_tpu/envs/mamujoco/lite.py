@@ -1,0 +1,173 @@
+"""Pure-JAX multi-agent continuous-control stand-in ("MuJoCoLite").
+
+The reference's multi-agent MuJoCo needs the MuJoCo binary (not bundled); the
+real robots remain reachable through the gated gym adapter
+(:mod:`~mat_dcml_tpu.envs.mamujoco.env`) over the host bridge.  This stand-in
+exercises the identical factorization machinery — joint partitions, k-hop
+obsk index building, per-agent continuous torque actions — on a closed-form
+jointed-chain dynamics that is jit/vmap-compatible and quickly learnable:
+
+    ω' = ω + dt (g·τ − d·ω − s·θ)          (damped torque integration)
+    θ' = θ + dt ω'
+    reward = −mean((θ − θ*)²) − c·mean(τ²)  (drive joints to a per-episode
+                                             target posture, control cost)
+
+i.e. a multi-joint "reacher" whose reward every agent shares (team objective,
+like the reference's shared locomotion reward, ``mujoco_multi.py:129-136``).
+Obs per agent = k-hop (θ, ω) slices via obsk indices + that joint-set's
+targets; state = full (θ, ω, θ*).  Availability masks are all-ones
+(continuous control has no masking, as upstream).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from mat_dcml_tpu.envs.mamujoco.obsk import build_obs_indices, get_parts_and_edges
+
+
+class MJLiteState(NamedTuple):
+    rng: jax.Array
+    theta: jax.Array          # (J,)
+    omega: jax.Array          # (J,)
+    target: jax.Array         # (J,)
+    t: jax.Array
+
+
+class MJLiteTimeStep(NamedTuple):
+    obs: jax.Array
+    share_obs: jax.Array
+    available_actions: jax.Array
+    reward: jax.Array
+    done: jax.Array
+    delay: jax.Array          # protocol compat (zeros)
+    payment: jax.Array
+
+
+@dataclasses.dataclass(frozen=True)
+class MJLiteConfig:
+    scenario: str = "HalfCheetah-v2"
+    agent_conf: str = "2x3"
+    agent_obsk: int = 1
+    episode_length: int = 50
+    dt: float = 0.05
+    gain: float = 4.0
+    damping: float = 0.4
+    stiffness: float = 0.5
+    ctrl_cost: float = 0.05
+
+
+class MJLiteEnv:
+    """TimeStep-protocol env over the obsk factorization; jit/vmap-safe."""
+
+    def __init__(self, cfg: MJLiteConfig = MJLiteConfig()):
+        self.cfg = cfg
+        parts, graph = get_parts_and_edges(cfg.scenario, cfg.agent_conf)
+        self.partitions = parts
+        self.graph = graph
+        self.n_joints = len(graph.joints)
+        self.n_agents = len(parts)
+        # torques per agent (= the env's action_dim; reference uses the max
+        # partition size, mujoco_multi.py:50)
+        self.joints_per_agent = max(len(p) for p in parts)
+        self.action_dim = self.joints_per_agent
+
+        # per-agent obs gather indices over the JOINT axis, -1 padded; the
+        # lite state has one θ/ω per joint so qpos ids ARE joint ids here
+        idx_rows = []
+        for p in parts:
+            qpos_ids, _ = build_obs_indices(graph, p, cfg.agent_obsk)
+            # map qpos ids back to joint ids (identity for the lite chain)
+            jids = [next(j for j, jt in enumerate(graph.joints) if jt.qpos_id == q)
+                    for q in qpos_ids if q >= graph.joints[0].qpos_id]
+            idx_rows.append(jids)
+        width = max(len(r) for r in idx_rows)
+        self._obs_jids = jnp.asarray(
+            np.array([r + [-1] * (width - len(r)) for r in idx_rows]), jnp.int32
+        )
+        self._obs_mask = jnp.asarray(
+            np.array([[1.0] * len(r) + [0.0] * (width - len(r)) for r in idx_rows]),
+            jnp.float32,
+        )
+        self._own_jids = jnp.asarray(
+            np.array([list(p) + [-1] * (self.joints_per_agent - len(p)) for p in parts]),
+            jnp.int32,
+        )
+        self.obs_dim = 3 * width                     # θ, ω, target per visible joint
+        self.share_obs_dim = 3 * self.n_joints
+        self.episode_limit = cfg.episode_length
+        from mat_dcml_tpu.envs.spaces import Box
+
+        self.action_space = Box(self.joints_per_agent)   # continuous torques
+
+    # ----------------------------------------------------------------- obs
+
+    def _gather(self, x: jax.Array) -> jax.Array:
+        """(J,) -> (A, width) via the padded joint-index table."""
+        safe = jnp.clip(self._obs_jids, 0, self.n_joints - 1)
+        return x[safe] * self._obs_mask
+
+    def _observe(self, st: MJLiteState):
+        obs = jnp.concatenate(
+            [self._gather(st.theta), self._gather(st.omega), self._gather(st.target)],
+            axis=-1,
+        )
+        state = jnp.concatenate([st.theta, st.omega, st.target])
+        share = jnp.broadcast_to(state, (self.n_agents, self.share_obs_dim))
+        avail = jnp.ones((self.n_agents, 1), jnp.float32)
+        return obs, share, avail
+
+    # ------------------------------------------------------------- control
+
+    def reset(self, key: jax.Array, episode_idx=0) -> Tuple[MJLiteState, MJLiteTimeStep]:
+        del episode_idx
+        key, k_th, k_tg = jax.random.split(key, 3)
+        st = MJLiteState(
+            rng=key,
+            theta=jax.random.uniform(k_th, (self.n_joints,), minval=-0.1, maxval=0.1),
+            omega=jnp.zeros((self.n_joints,)),
+            target=jax.random.uniform(k_tg, (self.n_joints,), minval=-1.0, maxval=1.0),
+            t=jnp.zeros((), jnp.int32),
+        )
+        obs, share, avail = self._observe(st)
+        zero = jnp.zeros(())
+        return st, MJLiteTimeStep(
+            obs, share, avail,
+            jnp.zeros((self.n_agents, 1)),
+            jnp.zeros((self.n_agents,), bool),
+            zero, zero,
+        )
+
+    def step(self, st: MJLiteState, action: jax.Array) -> Tuple[MJLiteState, MJLiteTimeStep]:
+        c = self.cfg
+        act = jnp.clip(action.reshape(self.n_agents, -1), -1.0, 1.0)
+        # scatter per-agent torques back onto the joint axis
+        tau = jnp.zeros((self.n_joints,))
+        safe = jnp.clip(self._own_jids, 0, self.n_joints - 1)
+        valid = (self._own_jids >= 0).astype(jnp.float32)
+        tau = tau.at[safe.reshape(-1)].add((act * valid).reshape(-1))
+
+        omega = st.omega + c.dt * (c.gain * tau - c.damping * st.omega - c.stiffness * st.theta)
+        theta = st.theta + c.dt * omega
+        err = theta - st.target
+        reward = -(err**2).mean() - c.ctrl_cost * (tau**2).mean()
+        t = st.t + 1
+        done_now = t >= c.episode_length
+
+        key_next, k_spawn = jax.random.split(st.rng)
+        fresh_st, _ = self.reset(k_spawn)
+        mid = MJLiteState(rng=key_next, theta=theta, omega=omega, target=st.target, t=t)
+        new_st = jax.tree.map(lambda a, b: jnp.where(done_now, a, b), fresh_st._replace(rng=key_next), mid)
+        obs, share, avail = self._observe(new_st)
+        zero = jnp.zeros(())
+        return new_st, MJLiteTimeStep(
+            obs=obs, share_obs=share, available_actions=avail,
+            reward=jnp.full((self.n_agents, 1), reward, jnp.float32),
+            done=jnp.full((self.n_agents,), done_now),
+            delay=zero, payment=zero,
+        )

@@ -13,9 +13,9 @@ encoder/decoder/GRU ablations (``mat_encoder.py``, ``mat_decoder.py``,
 from __future__ import annotations
 
 from mat_dcml_tpu.config import RunConfig
-from mat_dcml_tpu.envs.spaces import Discrete
+from mat_dcml_tpu.envs.spaces import Box, Discrete
 from mat_dcml_tpu.models.actor_critic import ACConfig, ActorCriticPolicy
-from mat_dcml_tpu.models.mat import DISCRETE, MATConfig
+from mat_dcml_tpu.models.mat import CONTINUOUS, DISCRETE, MATConfig
 from mat_dcml_tpu.models.mat_variants import DecoderPolicy, EncoderPolicy, GRUPolicy
 from mat_dcml_tpu.models.policy import TransformerPolicy
 from mat_dcml_tpu.training.ac_rollout import ACRolloutCollector
@@ -30,9 +30,18 @@ AC_FAMILY = ("mappo", "rmappo", "ippo")
 SUPPORTED_ALGOS = MAT_FAMILY + AC_FAMILY
 
 
+def _env_space(env):
+    """Envs declare a continuous space via ``env.action_space = Box(dim)``
+    (multi-agent MuJoCo); everything else is Discrete(action_dim)."""
+    space = getattr(env, "action_space", None)
+    return space if isinstance(space, Box) else Discrete(env.action_dim)
+
+
 def build_discrete_policy(run: RunConfig, env):
-    """Algorithm -> policy for a discrete-action TimeStep env
-    (``transformer_policy.py:66-79`` model-class dispatch)."""
+    """Algorithm -> policy for a discrete- or continuous-action TimeStep env
+    (``transformer_policy.py:28-39`` action-type inference + ``:66-79``
+    model-class dispatch)."""
+    continuous = isinstance(_env_space(env), Box)
     cfg = MATConfig(
         n_agent=env.n_agents,
         obs_dim=env.obs_dim,
@@ -41,7 +50,7 @@ def build_discrete_policy(run: RunConfig, env):
         n_block=run.n_block,
         n_embd=run.n_embd,
         n_head=run.n_head,
-        action_type=DISCRETE,
+        action_type=CONTINUOUS if continuous else DISCRETE,
         encode_state=run.encode_state,
         dec_actor=run.dec_actor or run.algorithm_name == "mat_dec",
         share_actor=run.share_actor or run.algorithm_name == "mat_dec",
@@ -84,7 +93,7 @@ class GenericRunner(BaseRunner):
                 ac,
                 obs_dim=env.obs_dim,
                 cent_obs_dim=env.obs_dim if run.algorithm_name == "ippo" else env.share_obs_dim,
-                space=Discrete(env.action_dim),
+                space=_env_space(env),
             )
             mcfg = MAPPOConfig(
                 use_recurrent_policy=run.algorithm_name == "rmappo",

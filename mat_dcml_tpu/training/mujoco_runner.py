@@ -1,0 +1,69 @@
+"""Multi-agent MuJoCo runner with agent-fault robustness evaluation.
+
+``runner/shared/mujoco_runner.py``: the generic collect/train loop over a
+factorized robot, plus fault injection — a chosen agent's torques zeroed
+during training (``faulty_action :13-20``) and an eval sweep over faulty
+nodes (``train_mujoco.py:68-69``) for few-shot robustness studies.  Fault
+masking lives in :class:`FaultyAgentWrapper` so it compiles into the step.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+
+from mat_dcml_tpu.config import RunConfig
+from mat_dcml_tpu.envs.mamujoco import FaultyAgentWrapper
+from mat_dcml_tpu.training.generic_runner import GenericRunner
+from mat_dcml_tpu.training.ppo import PPOConfig
+
+
+class MujocoRunner(GenericRunner):
+    """GenericRunner + train-time fault injection + faulty-node eval sweep."""
+
+    def __init__(self, run: RunConfig, ppo: PPOConfig, env,
+                 faulty_node: int = -1, log_fn=print):
+        self.base_env = env
+        train_env = FaultyAgentWrapper(env, faulty_node) if faulty_node >= 0 else env
+        super().__init__(run, ppo, train_env, log_fn=log_fn)
+
+    def evaluate(self, train_state, n_steps: int = 200, seed: int = 0,
+                 faulty_node: int = -1):
+        """Deterministic mean step reward with ``faulty_node``'s actions
+        zeroed (-1 = healthy)."""
+        env = FaultyAgentWrapper(self.base_env, faulty_node) if faulty_node >= 0 else self.base_env
+        E = self.run_cfg.n_rollout_threads
+        rs = self.collector.init_state(jax.random.key(seed + 23), E)
+
+        @jax.jit
+        def eval_step(params, st):
+            out = self.policy.get_actions(
+                params, jax.random.key(0), st.share_obs, st.obs,
+                st.available_actions, deterministic=True,
+            )
+            env_states, ts = jax.vmap(env.step)(st.env_states, out.action)
+            new_st = st._replace(
+                env_states=env_states, obs=ts.obs, share_obs=ts.share_obs,
+                available_actions=ts.available_actions,
+            )
+            return new_st, ts.reward.mean()
+
+        rewards = []
+        for _ in range(n_steps):
+            rs, r = eval_step(train_state.params, rs)
+            rewards.append(float(r))
+        return {"eval_average_step_rewards": float(np.mean(rewards)),
+                "faulty_node": faulty_node}
+
+    def evaluate_faulty_sweep(self, train_state,
+                              nodes: Sequence[int], n_steps: int = 200,
+                              seed: int = 0) -> dict:
+        """Robustness sweep over faulty nodes (``train_mujoco.py:68-69``)."""
+        return {
+            f"eval_reward_faulty_{n}": self.evaluate(
+                train_state, n_steps=n_steps, seed=seed, faulty_node=n
+            )["eval_average_step_rewards"]
+            for n in nodes
+        }

@@ -1,0 +1,165 @@
+"""Multi-agent MuJoCo tests: obsk factorization, lite dynamics, fault
+injection, continuous MAT/MAPPO training through the runner."""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from mat_dcml_tpu.envs.mamujoco import (
+    FaultyAgentWrapper,
+    MJLiteConfig,
+    MJLiteEnv,
+    build_obs_indices,
+    get_parts_and_edges,
+    joints_at_kdist,
+)
+
+
+class TestObsk:
+    def test_partitions(self):
+        for scenario, conf, n_agents, per in [
+            ("HalfCheetah-v2", "2x3", 2, 3),
+            ("HalfCheetah-v2", "6x1", 6, 1),
+            ("Ant-v2", "2x4", 2, 4),
+            ("Ant-v2", "4x2", 4, 2),
+            ("Ant-v2", "8x1", 8, 1),
+            ("Hopper-v2", "3x1", 3, 1),
+            ("Walker2d-v2", "2x3", 2, 3),
+            ("Swimmer-v2", "2x1", 2, 1),
+        ]:
+            parts, graph = get_parts_and_edges(scenario, conf)
+            assert len(parts) == n_agents
+            assert all(len(p) == per for p in parts)
+            # partitions tile all joints exactly once
+            flat = sorted(j for p in parts for j in p)
+            assert flat == list(range(len(graph.joints)))
+
+    def test_ant_diagonal_partition(self):
+        parts, _ = get_parts_and_edges("Ant-v2", "2x4d")
+        assert parts == ((0, 1, 4, 5), (2, 3, 6, 7))
+
+    def test_bad_conf_raises(self):
+        with pytest.raises(ValueError):
+            get_parts_and_edges("HalfCheetah-v2", "4x2")  # 8 != 6 joints
+
+    def test_khop_shells_grow(self):
+        parts, graph = get_parts_and_edges("HalfCheetah-v2", "6x1")
+        shells = joints_at_kdist(graph, parts[0], k=2)      # joint 0 = bthigh
+        assert shells[0] == [0]
+        # bthigh connects to bshin (1) and fthigh (3) through the torso
+        assert set(shells[1]) == {1, 3}
+        assert set(shells[2]) == {2, 4}
+        # k-hop obs indices grow with k
+        q0, _ = build_obs_indices(graph, parts[0], 0)
+        q2, _ = build_obs_indices(graph, parts[0], 2)
+        assert len(q2) > len(q0)
+
+    def test_obs_indices_include_globals(self):
+        parts, graph = get_parts_and_edges("Hopper-v2", "3x1")
+        qpos, qvel = build_obs_indices(graph, parts[0], 1)
+        for g in graph.global_qpos:
+            assert g in qpos
+        for g in graph.global_qvel:
+            assert g in qvel
+
+
+class TestMJLite:
+    def test_shapes_and_protocol(self):
+        env = MJLiteEnv(MJLiteConfig(scenario="HalfCheetah-v2", agent_conf="2x3"))
+        assert env.n_agents == 2 and env.action_dim == 3
+        state, ts = env.reset(jax.random.key(0))
+        assert ts.obs.shape == (2, env.obs_dim)
+        assert ts.share_obs.shape == (2, env.share_obs_dim)
+        state, ts = env.step(state, jnp.zeros((2, 3)))
+        assert np.isfinite(np.asarray(ts.obs)).all()
+        assert float(ts.reward[0, 0]) <= 0  # negative quadratic cost
+
+    def test_khop_widens_obs(self):
+        e0 = MJLiteEnv(MJLiteConfig(agent_conf="6x1", agent_obsk=0))
+        e1 = MJLiteEnv(MJLiteConfig(agent_conf="6x1", agent_obsk=1))
+        assert e1.obs_dim > e0.obs_dim
+
+    def test_episode_ends_and_resets(self):
+        env = MJLiteEnv(MJLiteConfig(episode_length=5))
+        state, ts = env.reset(jax.random.key(1))
+        tgt0 = np.asarray(state.target).copy()
+        for _ in range(5):
+            state, ts = env.step(state, jnp.zeros((env.n_agents, env.action_dim)))
+        assert bool(ts.done.all())
+        assert int(state.t) == 0                           # auto-reset
+        assert not np.allclose(np.asarray(state.target), tgt0)  # fresh target
+
+    def test_torques_move_joints_toward_target(self):
+        env = MJLiteEnv(MJLiteConfig(episode_length=1000))
+        state, ts = env.reset(jax.random.key(2))
+
+        def controller(st):
+            # P-controller sliced per agent over its own joints
+            err = st.target - st.theta
+            acts = []
+            for p in env.partitions:
+                acts.append([float(err[j]) for j in p])
+            return jnp.asarray(acts)
+
+        r_first = None
+        for _ in range(40):
+            state, ts = env.step(state, controller(state))
+            if r_first is None:
+                r_first = float(ts.reward[0, 0])
+        assert float(ts.reward[0, 0]) > r_first, "P-control must improve reward"
+
+    def test_fault_wrapper_zeroes_agent(self):
+        env = MJLiteEnv(MJLiteConfig(agent_conf="2x3"))
+        faulty = FaultyAgentWrapper(env, faulty_node=1)
+        state, _ = env.reset(jax.random.key(3))
+        big = jnp.ones((2, 3))
+        s_healthy, _ = env.step(state, big)
+        s_faulty, _ = faulty.step(state, big)
+        # agent 1's joints (3..5) received no torque under the fault
+        assert not np.allclose(np.asarray(s_healthy.omega[3:]), np.asarray(s_faulty.omega[3:]))
+        np.testing.assert_allclose(
+            np.asarray(s_faulty.omega[:3]), np.asarray(s_healthy.omega[:3])
+        )
+
+
+@pytest.mark.slow
+class TestMujocoTraining:
+    def _run(self, tmp_path, algo, iters, min_gain):
+        from mat_dcml_tpu.config import RunConfig
+        from mat_dcml_tpu.training.mujoco_runner import MujocoRunner
+        from mat_dcml_tpu.training.ppo import PPOConfig
+
+        env = MJLiteEnv(MJLiteConfig(scenario="HalfCheetah-v2", agent_conf="2x3",
+                                     episode_length=25))
+        run = RunConfig(
+            algorithm_name=algo, env_name="mujoco", scenario="cheetah_2x3",
+            n_rollout_threads=32, episode_length=25, n_embd=32, n_block=1,
+            run_dir=str(tmp_path), log_interval=10, save_interval=1000,
+        )
+        ppo = PPOConfig(ppo_epoch=5, num_mini_batch=1, lr=1e-3, entropy_coef=0.001)
+        runner = MujocoRunner(run, ppo, env, log_fn=lambda *a: None)
+        state, rs = runner.setup()
+        key = jax.random.key(0)
+        rewards = []
+        for i in range(iters):
+            rs, traj = runner._collect(state.params, rs)
+            key, k = jax.random.split(key)
+            state, _ = runner._train(state, traj, runner._bootstrap(rs), k)
+            rewards.append(float(np.asarray(traj.rewards).mean()))
+        first, last = np.mean(rewards[:3]), np.mean(rewards[-3:])
+        assert last > first + min_gain, f"{algo}: {first:.3f} -> {last:.3f}"
+        return runner, state
+
+    def test_continuous_mat_learns(self, tmp_path):
+        runner, state = self._run(tmp_path, "mat", 25, 0.1)
+        # faulty sweep runs and degrades (or at least changes) reward
+        sweep = runner.evaluate_faulty_sweep(state, nodes=[0, 1], n_steps=25)
+        healthy = runner.evaluate(state, n_steps=25)["eval_average_step_rewards"]
+        assert set(sweep) == {"eval_reward_faulty_0", "eval_reward_faulty_1"}
+        for v in sweep.values():
+            assert np.isfinite(v)
+            assert v <= healthy + 0.05, (sweep, healthy)
+
+    def test_continuous_mappo_learns(self, tmp_path):
+        self._run(tmp_path, "mappo", 40, 0.05)

@@ -1,0 +1,66 @@
+#!/usr/bin/env python
+"""Train on multi-agent MuJoCo (factorized robots, continuous control).
+
+Equivalent of the reference entry point
+``mat_src/mat/scripts/train/train_mujoco.py`` (+ ``train_mujoco.sh`` incl.
+its fault-injection flags).  Default backend is the pure-JAX stand-in
+dynamics over the same obsk joint factorization
+(``mat_dcml_tpu/envs/mamujoco/lite.py``); ``--backend gym`` drives real
+MuJoCo through the host-process bridge (requires gymnasium+mujoco).
+
+Usage:
+  python train_mujoco.py --scenario HalfCheetah-v2 --agent_conf 2x3
+  python train_mujoco.py --scenario Ant-v2 --agent_conf 2x4d --faulty_node 1 \
+      --eval_faulty_node 0,1
+"""
+
+import argparse
+import dataclasses
+import sys
+
+from mat_dcml_tpu.utils.platform import apply_platform_override
+
+apply_platform_override()
+
+from mat_dcml_tpu.config import parse_cli_with_extras
+from mat_dcml_tpu.envs.mamujoco import MJLiteConfig, MJLiteEnv
+from mat_dcml_tpu.training.mujoco_runner import MujocoRunner
+
+
+def main(argv=None):
+    extras = argparse.ArgumentParser(add_help=False)
+    extras.add_argument("--agent_conf", type=str, default="2x3")
+    extras.add_argument("--agent_obsk", type=int, default=1)
+    extras.add_argument("--faulty_node", type=int, default=-1)
+    extras.add_argument("--eval_faulty_node", type=str, default="")
+    extras.add_argument("--backend", type=str, default="lite", choices=("lite", "gym"))
+    # the robot rides the shared --scenario flag (RunConfig.scenario)
+    run, ppo, ns = parse_cli_with_extras(argv, extras=extras, overrides={
+        "env_name": "mujoco", "scenario": "HalfCheetah-v2", "episode_length": 50,
+    })
+    ns.scenario = run.scenario
+    run = dataclasses.replace(run, scenario=f"{ns.scenario}_{ns.agent_conf}")
+    if ns.backend == "gym":
+        raise SystemExit(
+            "--backend gym needs gymnasium+mujoco (not bundled); wire "
+            "MujocoMultiHostEnv through ShareSubprocVecEnv + "
+            "HostRolloutCollector (envs/mamujoco/env.py docstring)."
+        )
+    env = MJLiteEnv(MJLiteConfig(
+        scenario=ns.scenario, agent_conf=ns.agent_conf,
+        agent_obsk=ns.agent_obsk, episode_length=run.episode_length,
+    ))
+    runner = MujocoRunner(run, ppo, env, faulty_node=ns.faulty_node)
+    print(f"algorithm={run.algorithm_name} env=mujoco/{ns.scenario}/{ns.agent_conf} "
+          f"agents={env.n_agents} episodes={run.episodes} "
+          f"devices={len(__import__('jax').devices())}")
+    state, _ = runner.train_loop()
+    print("eval (healthy):", runner.evaluate(state, n_steps=run.episode_length))
+    if ns.eval_faulty_node:
+        nodes = [int(x) for x in ns.eval_faulty_node.split(",") if x]
+        print("faulty sweep:", runner.evaluate_faulty_sweep(
+            state, nodes, n_steps=run.episode_length))
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])
