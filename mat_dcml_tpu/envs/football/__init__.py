@@ -1,0 +1,20 @@
+"""Google Research Football family: feature/reward encoders and the gated
+gfootball host env (drive through the vec-env bridge + FootballRunner)."""
+
+from mat_dcml_tpu.envs.football.encoders import (
+    N_ACTIONS,
+    FeatureEncoder,
+    Rewarder,
+    availability,
+    ball_zone_onehot,
+)
+from mat_dcml_tpu.envs.football.env import FootballHostEnv
+
+__all__ = [
+    "N_ACTIONS",
+    "FeatureEncoder",
+    "Rewarder",
+    "availability",
+    "ball_zone_onehot",
+    "FootballHostEnv",
+]

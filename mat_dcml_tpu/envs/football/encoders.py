@@ -1,0 +1,216 @@
+"""Google Research Football feature/reward encoders.
+
+Re-design of the reference's hand-rolled encoders over gfootball's "raw"
+representation (``football/encode/obs_encode.py:1-346``,
+``rew_encode.py:1-104``): per-player features, ball features, teammate and
+opponent relative features with closest-unit summaries, a 19-action
+availability mask, and the shaped reward (win + score + ball-position +
+yellow-card + ball-distance terms).  Pure numpy over the raw obs dict —
+fully testable without the game binary.
+
+Action ids follow gfootball's default 19-action set
+(``obs_encode.py:_get_avail_new``): 0 no-op, 1-8 directions, 9 long pass,
+10 high pass, 11 short pass, 12 shot, 13 sprint, 14 release-move,
+15 release-sprint, 16 slide, 17 dribble, 18 release-dribble.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+N_ACTIONS = 19
+(NO_OP, LEFT, TOP_LEFT, TOP, TOP_RIGHT, RIGHT, BOTTOM_RIGHT, BOTTOM,
+ BOTTOM_LEFT, LONG_PASS, HIGH_PASS, SHORT_PASS, SHOT, SPRINT, RELEASE_MOVE,
+ RELEASE_SPRINT, SLIDE, DRIBBLE, RELEASE_DRIBBLE) = range(N_ACTIONS)
+
+N_ROLES = 10
+STICKY_SPRINT = 8
+STICKY_DRIBBLE = 9
+
+# pitch landmarks (gfootball coordinates)
+MIDDLE_X, PENALTY_X, END_X = 0.2, 0.64, 1.0
+PENALTY_Y, END_Y = 0.27, 0.42
+BALL_CLOSE = 0.03
+
+
+def ball_zone_onehot(ball_x: float, ball_y: float) -> np.ndarray:
+    """Six-zone pitch partition (own penalty box / own half / midfield /
+    their half / their penalty box / out wide)."""
+    zone = np.zeros(6, np.float32)
+    in_y = -END_Y < ball_y < END_Y
+    if (-END_X <= ball_x < -PENALTY_X) and (-PENALTY_Y < ball_y < PENALTY_Y):
+        zone[0] = 1.0
+    elif in_y and -END_X <= ball_x < -MIDDLE_X:
+        zone[1] = 1.0
+    elif in_y and -MIDDLE_X <= ball_x <= MIDDLE_X:
+        zone[2] = 1.0
+    elif (PENALTY_X < ball_x <= END_X) and (-PENALTY_Y < ball_y < PENALTY_Y):
+        zone[3] = 1.0
+    elif in_y and MIDDLE_X < ball_x <= END_X:
+        zone[4] = 1.0
+    else:
+        zone[5] = 1.0
+    return zone
+
+
+def availability(obs: dict, ball_distance: float) -> np.ndarray:
+    """19-action availability mask (``_get_avail_new`` semantics)."""
+    avail = np.ones(N_ACTIONS, np.float32)
+    sticky = np.asarray(obs["sticky_actions"])
+    ball_x, ball_y, _ = obs["ball"]
+
+    ball_kickable = not (
+        obs["ball_owned_team"] == 1
+        or (obs["ball_owned_team"] == -1 and ball_distance > BALL_CLOSE
+            and obs["game_mode"] == 0)
+    )
+    if not ball_kickable:
+        avail[[LONG_PASS, HIGH_PASS, SHORT_PASS, SHOT, DRIBBLE]] = 0
+        if obs["ball_owned_team"] == 1 and ball_distance > BALL_CLOSE:
+            avail[SLIDE] = 0
+    else:
+        avail[SLIDE] = 0
+
+    if sticky[STICKY_SPRINT] == 0:
+        avail[RELEASE_SPRINT] = 0
+    if sticky[STICKY_DRIBBLE] == 1:
+        avail[SLIDE] = 0
+    else:
+        avail[RELEASE_DRIBBLE] = 0
+    if sticky[:8].sum() == 0:
+        avail[RELEASE_MOVE] = 0
+
+    # shots only near their goal; long/high passes pointless inside the box
+    if ball_x < PENALTY_X or not (-PENALTY_Y <= ball_y <= PENALTY_Y):
+        avail[SHOT] = 0
+    elif ball_x <= END_X:
+        avail[[HIGH_PASS, LONG_PASS]] = 0
+
+    # set pieces collapse the choice set (goal kick / corner / penalty)
+    if obs["game_mode"] == 2 and ball_x < -0.7:
+        avail = np.zeros(N_ACTIONS, np.float32)
+        avail[[NO_OP, LONG_PASS, HIGH_PASS, SHORT_PASS]] = 1
+    elif obs["game_mode"] == 4 and ball_x > 0.9:
+        avail = np.zeros(N_ACTIONS, np.float32)
+        avail[[NO_OP, LONG_PASS, HIGH_PASS, SHORT_PASS]] = 1
+    elif obs["game_mode"] == 6 and ball_x > 0.6:
+        avail = np.zeros(N_ACTIONS, np.float32)
+        avail[[NO_OP, SHOT]] = 1
+    return avail
+
+
+class FeatureEncoder:
+    """raw obs dict -> flat per-player feature vector + availability."""
+
+    def encode(self, obs: dict) -> tuple[np.ndarray, np.ndarray]:
+        me = obs["active"]
+        my_pos = np.asarray(obs["left_team"][me], np.float32)
+        my_dir = np.asarray(obs["left_team_direction"][me], np.float32)
+        my_speed = float(np.linalg.norm(my_dir))
+        role = np.zeros(N_ROLES, np.float32)
+        role[int(obs["left_team_roles"][me]) % N_ROLES] = 1.0
+        sticky = np.asarray(obs["sticky_actions"], np.float32)
+
+        ball = np.asarray(obs["ball"], np.float32)
+        ball_dir = np.asarray(obs["ball_direction"], np.float32)
+        ball_rel = ball[:2] - my_pos
+        ball_distance = float(np.linalg.norm(ball_rel))
+        ball_speed = float(np.linalg.norm(ball_dir[:2]))
+        owned = float(obs["ball_owned_team"] != -1)
+        owned_by_us = float(obs["ball_owned_team"] == 0)
+        ball_far = float(ball_distance > BALL_CLOSE)
+
+        avail = availability(obs, ball_distance)
+
+        player = np.concatenate([
+            my_pos, my_dir * 100.0, [my_speed * 100.0], role,
+            [ball_far, float(obs["left_team_tired_factor"][me]),
+             sticky[STICKY_DRIBBLE], sticky[STICKY_SPRINT]],
+        ]).astype(np.float32)
+
+        ball_feats = np.concatenate([
+            ball, ball_zone_onehot(float(ball[0]), float(ball[1])), ball_rel,
+            ball_dir * 20.0,
+            [ball_speed * 20.0, ball_distance, owned, owned_by_us],
+        ]).astype(np.float32)
+
+        def team_block(pos, direction, tired, drop_me: bool):
+            pos = np.asarray(pos, np.float32)
+            direction = np.asarray(direction, np.float32)
+            tired = np.asarray(tired, np.float32).reshape(-1, 1)
+            if drop_me:
+                keep = np.arange(len(pos)) != me
+                pos, direction, tired = pos[keep], direction[keep], tired[keep]
+            dist = np.linalg.norm(pos - my_pos, axis=1, keepdims=True)
+            speed = np.linalg.norm(direction, axis=1, keepdims=True)
+            block = np.concatenate(
+                [pos * 2.0, direction * 100.0, speed * 100.0, dist * 2.0, tired],
+                axis=1,
+            ).astype(np.float32)
+            closest = block[int(np.argmin(dist))]
+            return block, closest
+
+        left, left_closest = team_block(
+            obs["left_team"], obs["left_team_direction"],
+            obs["left_team_tired_factor"], drop_me=True,
+        )
+        right, right_closest = team_block(
+            obs["right_team"], obs["right_team_direction"],
+            obs["right_team_tired_factor"], drop_me=False,
+        )
+
+        feats = np.concatenate([
+            player, ball_feats,
+            left.ravel(), left_closest, right.ravel(), right_closest,
+        ])
+        return feats, avail
+
+
+class Rewarder:
+    """Shaped reward (``rew_encode.py`` term structure):
+    ``5*win + 5*score + 0.003*ball_position + yellow - 0.003*min_dist``."""
+
+    def calc_reward(self, rew: float, prev_obs: dict, obs: dict) -> float:
+        return float(
+            5.0 * self._win(obs)
+            + 5.0 * rew
+            + 0.003 * self._ball_position(obs)
+            + self._yellow(prev_obs, obs)
+            - 0.003 * self._min_dist(obs)
+        )
+
+    @staticmethod
+    def _win(obs) -> float:
+        if obs["steps_left"] == 0:
+            mine, theirs = obs["score"]
+            if mine > theirs:
+                return float(mine - theirs)
+        return 0.0
+
+    @staticmethod
+    def _ball_position(obs) -> float:
+        x, y, _ = obs["ball"]
+        in_y = -END_Y < y < END_Y
+        if (-END_X <= x < -PENALTY_X) and (-PENALTY_Y < y < PENALTY_Y):
+            return -2.0
+        if in_y and -END_X <= x < -MIDDLE_X:
+            return -1.0
+        if (PENALTY_X < x <= END_X) and (-PENALTY_Y < y < PENALTY_Y):
+            return 2.0
+        if in_y and MIDDLE_X < x <= END_X:
+            return 1.0
+        return 0.0
+
+    @staticmethod
+    def _yellow(prev_obs, obs) -> float:
+        left = np.sum(obs["left_team_yellow_card"]) - np.sum(prev_obs["left_team_yellow_card"])
+        right = np.sum(obs["right_team_yellow_card"]) - np.sum(prev_obs["right_team_yellow_card"])
+        return float(right - left)
+
+    @staticmethod
+    def _min_dist(obs) -> float:
+        if obs["ball_owned_team"] == 0:
+            return 0.0
+        ball = np.asarray(obs["ball"][:2])
+        outfield = np.asarray(obs["left_team"][1:])      # skip the keeper
+        return float(np.min(np.linalg.norm(outfield - ball, axis=1)))

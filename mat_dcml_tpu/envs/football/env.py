@@ -1,0 +1,89 @@
+"""Google Research Football behind the host-process bridge (gated).
+
+Wraps gfootball's raw representation with the feature/reward encoders
+(``football/football_env.py:13-97``): per-agent encoded obs, share_obs = a
+copy of obs (``:56``), shaped rewards, 19-action availability.  Exposes the
+host shared-obs contract for :mod:`~mat_dcml_tpu.envs.vec_env`.
+
+Gated on the external ``gfootball`` package (not bundled).  The backend is
+injectable for tests: anything yielding gfootball-style raw obs-dict lists
+from ``reset()``/``step()`` works.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+from mat_dcml_tpu.envs.football.encoders import N_ACTIONS, FeatureEncoder, Rewarder
+
+
+class FootballHostEnv:
+    self_resetting = False
+
+    def __init__(self, scenario: str = "academy_3_vs_1_with_keeper",
+                 n_agents: int = 3, rewards: str = "scoring",
+                 backend_env=None):
+        if backend_env is None:
+            try:
+                import gfootball.env as football_env  # type: ignore
+            except ImportError as err:
+                raise ImportError(
+                    "FootballHostEnv needs the external gfootball package "
+                    "(https://github.com/google-research/football); not "
+                    "bundled. Tests inject a fake backend via backend_env."
+                ) from err
+            backend_env = football_env.create_environment(
+                env_name=scenario,
+                number_of_left_players_agent_controls=n_agents,
+                representation="raw",
+                rewards=rewards,
+            )
+        self._env = backend_env
+        self.n_agents = n_agents
+        self.action_dim = N_ACTIONS
+        self._encoder = FeatureEncoder()
+        self._rewarder = Rewarder()
+        self._prev_raw: Optional[Sequence[dict]] = None
+
+        probe = self._encode(self._env.reset())
+        self.obs_dim = probe[0].shape[1]
+        self.share_obs_dim = self.obs_dim              # share_obs = obs copy
+
+    def _encode(self, raw_list):
+        rows = [self._encoder.encode(raw) for raw in raw_list]
+        obs = np.stack([r[0] for r in rows]).astype(np.float32)
+        avail = np.stack([r[1] for r in rows]).astype(np.float32)
+        return obs, avail
+
+    def reset(self):
+        raw = self._env.reset()
+        self._prev_raw = raw
+        obs, avail = self._encode(raw)
+        return obs, obs.copy(), avail
+
+    def step(self, actions):
+        acts = [int(a) for a in np.asarray(actions).reshape(-1)]
+        raw, rews, done, info = self._env.step(acts)
+        obs, avail = self._encode(raw)
+        shaped = np.array(
+            [
+                self._rewarder.calc_reward(float(r), prev, cur)
+                for r, prev, cur in zip(np.atleast_1d(rews), self._prev_raw, raw)
+            ],
+            np.float32,
+        )[:, None]
+        self._prev_raw = raw
+        dones = np.full((self.n_agents,), bool(np.all(done)))
+        info = dict(info or {})
+        # goal difference rides the generic episode-info channel: sums of
+        # per-step score deltas equal the final goal difference the football
+        # runner reports as "scores" (football_runner.py)
+        info["delay"] = float(np.atleast_1d(rews)[0])
+        info["payment"] = 0.0
+        return obs, obs.copy(), shaped, dones, info, avail
+
+    def close(self):
+        if hasattr(self._env, "close"):
+            self._env.close()

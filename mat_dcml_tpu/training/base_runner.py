@@ -57,7 +57,12 @@ class BaseRunner:
     def finalize(self, run: RunConfig, log_fn=print) -> None:
         self.run_cfg = run
         self.log = log_fn
-        self._collect = jax.jit(self.collector.collect)
+        # host-loop collectors (vec-env bridge) drive jitted policy calls
+        # internally and cannot themselves be traced
+        if getattr(self.collector, "jittable", True):
+            self._collect = jax.jit(self.collector.collect)
+        else:
+            self._collect = self.collector.collect
         self._train = jax.jit(self.trainer.train)
         self.run_dir = (
             Path(run.run_dir) / run.env_name / run.scenario / run.algorithm_name / run.experiment_name

@@ -1,0 +1,43 @@
+"""Football runner: MAT over the host-process bridge with score metrics.
+
+``runner/shared/football_runner.py``: the collect/train loop over host
+gfootball workers, logging goal-difference "scores".  The env emits per-step
+score deltas on the generic episode-info channel, so the shared runner
+accounting's per-episode sums ARE the goal difference — this runner just
+renames them.  Architecture: jitted MAT policy + HostRolloutCollector over
+ShareSubprocVecEnv/ShareDummyVecEnv (``envs/vec_env.py``), the pattern every
+non-JAX env family uses.
+"""
+
+from __future__ import annotations
+
+from mat_dcml_tpu.config import RunConfig
+from mat_dcml_tpu.envs.vec_env import ShareVecEnv
+from mat_dcml_tpu.training.base_runner import BaseRunner
+from mat_dcml_tpu.training.generic_runner import build_discrete_policy
+from mat_dcml_tpu.training.host_rollout import HostRolloutCollector
+from mat_dcml_tpu.training.ppo import MATTrainer, PPOConfig
+
+
+class FootballRunner(BaseRunner):
+    def __init__(self, run: RunConfig, ppo: PPOConfig, vec_env: ShareVecEnv,
+                 log_fn=print):
+        if run.algorithm_name not in ("mat", "mat_dec"):
+            raise NotImplementedError(
+                "the football runner drives the MAT family (football_runner.py)"
+            )
+        if run.n_rollout_threads != vec_env.n_envs:
+            raise ValueError(
+                f"n_rollout_threads={run.n_rollout_threads} != vec env size {vec_env.n_envs}"
+            )
+        self.env = vec_env
+        self.is_mat = True
+        self.policy = build_discrete_policy(run, vec_env)
+        self.trainer = MATTrainer(self.policy, ppo, total_updates=run.episodes)
+        self.collector = HostRolloutCollector(vec_env, self.policy, run.episode_length)
+        self.finalize(run, log_fn)
+
+    def _extra_metrics(self, record: dict) -> None:
+        if "aver_episode_delays" in record:
+            record["scores"] = record.pop("aver_episode_delays")   # goal diff
+            record.pop("aver_episode_payments", None)

@@ -42,6 +42,8 @@ def _info_field(info, name: str) -> float:
 class HostRolloutCollector:
     """Builds ``collect`` for a (policy, host vec-env) pair."""
 
+    jittable = False          # the collect loop crosses the host boundary
+
     def __init__(self, vec_env: ShareVecEnv, policy, episode_length: int):
         self.vec_env = vec_env
         self.policy = policy

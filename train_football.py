@@ -1,0 +1,60 @@
+#!/usr/bin/env python
+"""Train MAT on Google Research Football through the host-process bridge.
+
+Equivalent of the reference entry point
+``mat_src/mat/scripts/train/train_football.py`` (+ ``train_football.sh``):
+gfootball workers in subprocesses (``ShareSubprocVecEnv``), encoded features
+and shaped rewards (``mat_dcml_tpu/envs/football/encoders.py``), jitted MAT
+policy on device, goal-difference metrics.
+
+Requires the external gfootball package (not bundled) — the entry point
+exists so a user with gfootball installed runs it unmodified.
+
+Usage:
+  python train_football.py --scenario academy_3_vs_1_with_keeper \
+      --n_agent 3 --n_rollout_threads 8
+"""
+
+import argparse
+import sys
+
+from mat_dcml_tpu.utils.platform import apply_platform_override
+
+apply_platform_override()
+
+from mat_dcml_tpu.config import parse_cli_with_extras
+from mat_dcml_tpu.envs.football import FootballHostEnv
+from mat_dcml_tpu.envs.vec_env import ShareDummyVecEnv, ShareSubprocVecEnv
+from mat_dcml_tpu.training.football_runner import FootballRunner
+
+
+def main(argv=None):
+    extras = argparse.ArgumentParser(add_help=False)
+    extras.add_argument("--n_agent", type=int, default=3)
+    extras.add_argument("--rewards", type=str, default="scoring")
+    extras.add_argument("--envs_per_worker", type=int, default=1)
+    run, ppo, ns = parse_cli_with_extras(argv, extras=extras, overrides={
+        "env_name": "football", "scenario": "academy_3_vs_1_with_keeper",
+        "episode_length": 200,
+    })
+
+    def make_env(scenario=run.scenario, n=ns.n_agent, rew=ns.rewards):
+        return FootballHostEnv(scenario=scenario, n_agents=n, rewards=rew)
+
+    fns = [make_env for _ in range(run.n_rollout_threads)]
+    vec = (
+        ShareDummyVecEnv(fns)
+        if run.n_rollout_threads == 1
+        else ShareSubprocVecEnv(fns, envs_per_worker=ns.envs_per_worker)
+    )
+    runner = FootballRunner(run, ppo, vec)
+    print(f"algorithm={run.algorithm_name} env=football/{run.scenario} "
+          f"agents={ns.n_agent} episodes={run.episodes}")
+    try:
+        runner.train_loop()
+    finally:
+        vec.close()
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])
