@@ -1,3 +1,7 @@
+from mat_dcml_tpu.envs.mpe.simple_speaker_listener import (
+    SimpleSpeakerListenerEnv,
+    SpeakerListenerConfig,
+)
 from mat_dcml_tpu.envs.mpe.simple_spread import (
     SimpleSpreadConfig,
     SimpleSpreadEnv,
@@ -7,9 +11,14 @@ from mat_dcml_tpu.envs.mpe.simple_spread import (
 
 # scenario registry (reference: mat/envs/mpe/scenarios/__init__.py load());
 # simple_spread is the one used by the shipped MPE training recipe
-SCENARIOS = {"simple_spread": (SimpleSpreadEnv, SimpleSpreadConfig)}
+SCENARIOS = {
+    "simple_spread": (SimpleSpreadEnv, SimpleSpreadConfig),
+    "simple_speaker_listener": (SimpleSpeakerListenerEnv, SpeakerListenerConfig),
+}
 
 __all__ = [
+    "SimpleSpeakerListenerEnv",
+    "SpeakerListenerConfig",
     "SimpleSpreadConfig",
     "SimpleSpreadEnv",
     "SpreadState",

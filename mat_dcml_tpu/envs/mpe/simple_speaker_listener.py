@@ -1,0 +1,148 @@
+"""Pure-JAX MPE ``simple_speaker_listener`` (cooperative communication).
+
+Reference: ``mpe/scenarios/simple_speaker_listener.py`` + ``mpe/core.py``
+physics.  Two heterogeneous agents: a stationary SPEAKER that observes the
+goal landmark's color and can only emit a 3-symbol message, and a mobile
+LISTENER that observes its velocity, the three landmark offsets, and the
+speaker's message — but not the goal.  Shared reward is the negative squared
+listener↔goal distance, so score requires the speaker to name the goal and
+the listener to decode it.
+
+Heterogeneity under one homogeneous policy interface (the TimeStep protocol
+assumes equal per-agent dims) is handled exactly like multi-map SMAC padding:
+obs rows are zero-padded to the wider (listener) layout, and one
+``Discrete(5)`` action space serves both roles with availability masks —
+speaker actions 0-2 are the comm symbols (3-4 masked off), listener actions
+are the standard MPE no-op/±x/±y move set (``environment.py:64`` Discrete
+move space; speaker's space is Discrete(dim_c)).
+
+The message the listener observes at step t is the symbol the speaker chose
+at step t (MPE updates comm state before observations in the same
+``world.step``, ``core.py:186-196``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+class SpeakerListenerState(NamedTuple):
+    rng: jax.Array
+    listener_pos: jax.Array   # (2,)
+    listener_vel: jax.Array   # (2,)
+    landmark_pos: jax.Array   # (3, 2)
+    goal: jax.Array           # () int32 landmark index
+    comm: jax.Array           # (3,) speaker's last message one-hot
+    t: jax.Array
+
+
+class SLTimeStep(NamedTuple):
+    obs: jax.Array
+    share_obs: jax.Array
+    available_actions: jax.Array
+    reward: jax.Array
+    done: jax.Array
+    delay: jax.Array
+    payment: jax.Array
+
+
+@dataclasses.dataclass(frozen=True)
+class SpeakerListenerConfig:
+    n_landmarks: int = 3
+    dim_c: int = 3
+    episode_length: int = 25
+    dt: float = 0.1
+    damping: float = 0.25
+    sensitivity: float = 5.0
+    # kept for train_mpe.py's shared flags; the scenario is fixed-size
+    n_agents: int = 2
+
+    def __post_init__(self):
+        if self.n_agents != 2:
+            raise ValueError("simple_speaker_listener is a 2-agent scenario")
+
+
+class SimpleSpeakerListenerEnv:
+    """Functional env bundle; same TimeStep protocol as simple_spread."""
+
+    SPEAKER, LISTENER = 0, 1
+
+    def __init__(self, cfg: SpeakerListenerConfig = SpeakerListenerConfig()):
+        self.cfg = cfg
+        self.n_agents = 2
+        # listener obs: vel(2) + landmark rel (2M) + comm (dim_c); the
+        # speaker's goal-color obs (M one-hot) zero-pads into the same width
+        self.obs_dim = 2 + 2 * cfg.n_landmarks + cfg.dim_c
+        self.share_obs_dim = self.obs_dim * 2
+        self.action_dim = 5
+
+    def _spawn(self, key: jax.Array) -> SpeakerListenerState:
+        c = self.cfg
+        key, k_p, k_l, k_g = jax.random.split(key, 4)
+        return SpeakerListenerState(
+            rng=key,
+            listener_pos=jax.random.uniform(k_p, (2,), minval=-1.0, maxval=1.0),
+            listener_vel=jnp.zeros((2,)),
+            landmark_pos=jax.random.uniform(k_l, (c.n_landmarks, 2), minval=-1.0, maxval=1.0),
+            goal=jax.random.randint(k_g, (), 0, c.n_landmarks),
+            comm=jnp.zeros((c.dim_c,)),
+            t=jnp.zeros((), jnp.int32),
+        )
+
+    def _observe(self, st: SpeakerListenerState):
+        c = self.cfg
+        # speaker: goal "color" one-hot, zero-padded to the listener width
+        speaker = jnp.zeros((self.obs_dim,)).at[: c.n_landmarks].set(
+            jax.nn.one_hot(st.goal, c.n_landmarks)
+        )
+        listener = jnp.concatenate([
+            st.listener_vel,
+            (st.landmark_pos - st.listener_pos[None, :]).reshape(-1),
+            st.comm,
+        ])
+        obs = jnp.stack([speaker, listener])
+        share = jnp.broadcast_to(obs.reshape(-1), (2, self.share_obs_dim))
+        avail = jnp.asarray(
+            [[1, 1, 1, 0, 0], [1, 1, 1, 1, 1]], jnp.float32
+        )  # speaker: 3 comm symbols; listener: no-op/±x/±y
+        return obs, share, avail
+
+    def reset(self, key: jax.Array, episode_idx=0) -> Tuple[SpeakerListenerState, SLTimeStep]:
+        del episode_idx
+        st = self._spawn(key)
+        obs, share, avail = self._observe(st)
+        zero = jnp.zeros(())
+        return st, SLTimeStep(
+            obs, share, avail, jnp.zeros((2, 1)), jnp.zeros((2,), bool), zero, zero
+        )
+
+    def step(self, st: SpeakerListenerState, action: jax.Array) -> Tuple[SpeakerListenerState, SLTimeStep]:
+        c = self.cfg
+        act = action.reshape(2, -1)[:, 0].astype(jnp.int32)
+        comm = jax.nn.one_hot(jnp.clip(act[self.SPEAKER], 0, c.dim_c - 1), c.dim_c)
+        onehot = jax.nn.one_hot(act[self.LISTENER], 5)
+        u = jnp.stack([onehot[1] - onehot[2], onehot[3] - onehot[4]]) * c.sensitivity
+        vel = st.listener_vel * (1.0 - c.damping) + u * c.dt
+        pos = st.listener_pos + vel * c.dt
+
+        stepped = SpeakerListenerState(
+            st.rng, pos, vel, st.landmark_pos, st.goal, comm, st.t + 1
+        )
+        goal_pos = st.landmark_pos[st.goal]
+        reward = -jnp.sum((pos - goal_pos) ** 2)
+        done_now = stepped.t >= c.episode_length
+
+        fresh = self._spawn(st.rng)
+        new_st = jax.tree.map(lambda a, b: jnp.where(done_now, a, b), fresh, stepped)
+        obs, share, avail = self._observe(new_st)
+        zero = jnp.zeros(())
+        return new_st, SLTimeStep(
+            obs, share, avail,
+            jnp.broadcast_to(reward, (2, 1)),
+            jnp.broadcast_to(done_now, (2,)),
+            zero, zero,
+        )

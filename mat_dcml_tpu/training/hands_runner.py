@@ -1,0 +1,38 @@
+"""Bi-DexHands runner (gated — the reference's own env module is absent).
+
+The reference ships ``runner/shared/hands_runner.py`` + ``train_hands.py``
+but the env package they import (``mat.envs.dexteroushandenvs``) does not
+exist in its tree (SURVEY.md §2.4 missing modules), so the capability was
+already broken upstream.  Here the runner exists as a thin specialization of
+the host-bridge pattern: Isaac-Gym-style hands envs are host simulators, so
+they plug in exactly like football — a host env exposing the shared-obs
+contract, driven through ``ShareSubprocVecEnv`` + ``HostRolloutCollector``.
+
+The one hands-specific behavior worth preserving from ``hands_runner.py:178``
+(actions arrive agent-major and are transposed per-agent before the env) is
+host-side layout, which the vec-env contract already fixes as ``(E, A, d)``.
+"""
+
+from __future__ import annotations
+
+from mat_dcml_tpu.config import RunConfig
+from mat_dcml_tpu.envs.vec_env import ShareVecEnv
+from mat_dcml_tpu.training.football_runner import FootballRunner
+from mat_dcml_tpu.training.ppo import PPOConfig
+
+
+class HandsRunner(FootballRunner):
+    """Host-bridge MAT runner for dexterous-hands simulators.
+
+    Construct with a vec env of host hands envs (obs/share_obs/avail per
+    agent, shared reward).  Requires an external Isaac Gym / Bi-DexHands
+    install to supply the envs — not bundled."""
+
+    def __init__(self, run: RunConfig, ppo: PPOConfig, vec_env: ShareVecEnv,
+                 log_fn=print):
+        super().__init__(run, ppo, vec_env, log_fn=log_fn)
+
+    def _extra_metrics(self, record: dict) -> None:
+        # hands envs report no score channels; keep raw episode rewards
+        record.pop("aver_episode_delays", None)
+        record.pop("aver_episode_payments", None)
