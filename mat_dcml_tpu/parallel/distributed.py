@@ -1,0 +1,112 @@
+"""Multi-host initialization + global-array helpers.
+
+The reference has no distributed backend (its "cluster" is one process per
+env over pipes, SURVEY.md §2.8).  The TPU-native replacement is SPMD over a
+global mesh: every host runs the SAME jitted program; XLA inserts the
+collectives (grad ``psum``, batch-statistic reductions) over ICI, with DCN
+touched only at init/checkpoint/logging.  Because statistics like ValueNorm
+moments and advantage mean/std are computed on globally-sharded arrays
+INSIDE one jit, they are globally exact by construction — the multi-process
+parity test (tests/test_multihost.py) asserts the sharded step matches the
+single-device step bit-for-bit-close, which is the property the reference
+could never state.
+
+``init_distributed`` wraps ``jax.distributed.initialize``:
+
+- on TPU pods, call with no arguments (the TPU runtime supplies topology);
+- on CPU "fake clusters" (tests, CI) pass coordinator/num_processes/
+  process_id and gloo collectives are enabled automatically;
+- env vars ``MAT_DCML_COORDINATOR`` / ``MAT_DCML_NUM_PROCESSES`` /
+  ``MAT_DCML_PROCESS_ID`` drive the same path for launcher scripts.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+
+def init_distributed(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> None:
+    """Initialize the multi-process runtime (idempotent, single-process no-op).
+
+    With no arguments: reads the ``MAT_DCML_*`` env vars; if those are unset
+    and the platform is a TPU pod, defers to JAX's automatic cluster
+    detection; otherwise stays single-process.
+    """
+    import jax
+
+    if coordinator_address is None:
+        coordinator_address = os.environ.get("MAT_DCML_COORDINATOR")
+    if num_processes is None:
+        num_processes = int(os.environ.get("MAT_DCML_NUM_PROCESSES", "0")) or None
+    if process_id is None:
+        pid = os.environ.get("MAT_DCML_PROCESS_ID")
+        process_id = int(pid) if pid is not None else None
+
+    if coordinator_address is None and num_processes is None:
+        # TPU pods self-describe; nothing to do elsewhere.  Tunneled or
+        # partially-populated pod env vars (single-host slices) make the
+        # autodetect raise — that simply means single-process.
+        if _running_on_tpu_pod():
+            try:
+                jax.distributed.initialize()
+            except (ValueError, RuntimeError):
+                pass
+        return
+
+    platforms = (os.environ.get("JAX_PLATFORMS") or "").lower()
+    if "cpu" in platforms:
+        # CPU cross-process collectives need an explicit backend
+        try:
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        except Exception:
+            pass
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+
+
+def _running_on_tpu_pod() -> bool:
+    return bool(os.environ.get("TPU_WORKER_HOSTNAMES") or os.environ.get("MEGASCALE_COORDINATOR_ADDRESS"))
+
+
+def process_index() -> int:
+    import jax
+
+    return jax.process_index()
+
+
+def is_primary() -> bool:
+    """True on the process that should own logging/checkpoint writes."""
+    return process_index() == 0
+
+
+def global_init_state(collector, key, n_envs: int, mesh, data_axis: str = "data"):
+    """Build a rollout state as GLOBAL arrays sharded over ``data_axis``.
+
+    Every process calls this with the same key; the init runs inside jit with
+    ``out_shardings``, so each host materializes only its addressable shards
+    — the multi-host-safe way to construct sharded program state (no
+    host-side full-size array is assumed to exist anywhere).
+    """
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    shard = NamedSharding(mesh, P(data_axis))
+    repl = NamedSharding(mesh, P())
+
+    def out_sharding(x):
+        return shard if getattr(x, "ndim", 0) >= 1 else repl
+
+    def init(k):
+        return collector.init_state(k, n_envs)
+
+    probe = jax.eval_shape(init, key)
+    shardings = jax.tree.map(out_sharding, probe)
+    return jax.jit(init, out_shardings=shardings)(key)

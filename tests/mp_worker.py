@@ -1,0 +1,44 @@
+"""Worker process for the 2-process CPU-mesh test (run via subprocess).
+
+Usage: python tests/mp_worker.py <process_id> <num_processes> <coordinator>
+Prints one JSON line with the shared fixed-seed training outcome.
+"""
+
+import json
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=4").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from mat_dcml_tpu.parallel.distributed import init_distributed, is_primary  # noqa: E402
+
+
+def main() -> None:
+    pid, nprocs, coordinator = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+    init_distributed(coordinator, nprocs, pid)
+    assert len(jax.devices()) == 4 * nprocs, (
+        f"expected {4 * nprocs} global devices, got {len(jax.devices())}"
+    )
+    assert len(jax.local_devices()) == 4
+
+    from _mp_common import build_mesh_from, run_sharded_training
+
+    result = run_sharded_training(build_mesh_from(jax.devices()))
+    result["process_id"] = pid
+    result["is_primary"] = is_primary()
+    result["n_global_devices"] = len(jax.devices())
+    print(json.dumps(result), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -18,14 +18,20 @@ from mat_dcml_tpu.utils.platform import apply_platform_override
 apply_platform_override()
 
 from mat_dcml_tpu.config import parse_cli
+from mat_dcml_tpu.parallel.distributed import init_distributed, is_primary
 from mat_dcml_tpu.training.runner import DCMLRunner
 
 
 def main(argv=None):
+    # multi-host: MAT_DCML_COORDINATOR / _NUM_PROCESSES / _PROCESS_ID env vars
+    # (or TPU-pod auto-detection); single-process no-op
+    init_distributed()
     run, ppo = parse_cli(argv)
-    runner = DCMLRunner(run, ppo)
-    print(f"algorithm={run.algorithm_name} env={run.env_name}/{run.scenario} "
-          f"episodes={run.episodes} devices={len(__import__('jax').devices())}")
+    log = print if is_primary() else (lambda *a, **k: None)
+    runner = DCMLRunner(run, ppo, log_fn=log)
+    log(f"algorithm={run.algorithm_name} env={run.env_name}/{run.scenario} "
+        f"episodes={run.episodes} devices={len(__import__('jax').devices())} "
+        f"processes={__import__('jax').process_count()}")
     runner.train_loop()
 
 
