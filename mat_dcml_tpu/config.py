@@ -35,6 +35,10 @@ class RunConfig:
     eval_episodes: int = 32
     run_dir: str = "results"
     model_dir: Optional[str] = None
+    # scalar-stream mirrors behind the jsonl metrics (base_runner.py:54-66)
+    use_tensorboard: bool = False
+    use_wandb: bool = False
+    wandb_project: str = "mat_dcml_tpu"
     # model
     n_block: int = 2
     n_embd: int = 64

@@ -15,7 +15,6 @@ resume (optimizer + ValueNorm included, training/checkpoint.py).
 
 from __future__ import annotations
 
-import json
 import time
 from pathlib import Path
 from typing import Optional
@@ -27,6 +26,7 @@ from mat_dcml_tpu.config import RunConfig
 from mat_dcml_tpu.training.checkpoint import CheckpointManager
 from mat_dcml_tpu.training.mappo import Bootstrap
 from mat_dcml_tpu.training.ppo import PPOConfig
+from mat_dcml_tpu.utils.metrics import MetricsWriter
 
 
 def ac_config_kwargs(ppo: PPOConfig) -> dict:
@@ -69,6 +69,13 @@ class BaseRunner:
         )
         self.ckpt = CheckpointManager(self.run_dir / "models")
         self.metrics_path = self.run_dir / "metrics.jsonl"
+        self.writer = MetricsWriter(
+            self.run_dir,
+            use_tensorboard=run.use_tensorboard,
+            use_wandb=run.use_wandb,
+            wandb_project=run.wandb_project,
+            run_name=f"{run.env_name}/{run.scenario}/{run.algorithm_name}/{run.experiment_name}",
+        )
         self.start_episode = 0
 
     # ------------------------------------------------------------------ setup
@@ -186,9 +193,7 @@ class BaseRunner:
             if run.use_eval and episode % run.eval_interval == 0 and hasattr(self, "evaluate"):
                 eval_info = self.evaluate(train_state, n_steps=run.episode_length)
                 eval_info.update(episode=episode, total_steps=total_steps)
-                self.metrics_path.parent.mkdir(parents=True, exist_ok=True)
-                with open(self.metrics_path, "a") as f:
-                    f.write(json.dumps(eval_info) + "\n")
+                self.writer.write(eval_info, step=total_steps)
                 self.log(f"eval ep {episode}: {eval_info}")
 
         return train_state, rollout_state
@@ -198,9 +203,7 @@ class BaseRunner:
         generic episode-info channels) before a record is logged."""
 
     def _log_record(self, record: dict):
-        self.metrics_path.parent.mkdir(parents=True, exist_ok=True)
-        with open(self.metrics_path, "a") as f:
-            f.write(json.dumps(record) + "\n")
+        self.writer.write(record, step=record.get("total_steps"))
         self.log(
             f"ep {record['episode']} steps {record['total_steps']} fps {record['fps']:.0f} "
             f"avg_r {record['average_step_rewards']:.3f} vloss {record['value_loss']:.3f} "

@@ -156,11 +156,7 @@ class SMACMultiRunner(BaseRunner):
                     if w:
                         record[f"win_rate_{name}"] = float(np.mean(w))
                 wins = {m_: [] for m_ in self.train_maps}
-                self.metrics_path.parent.mkdir(parents=True, exist_ok=True)
-                import json
-
-                with open(self.metrics_path, "a") as f:
-                    f.write(json.dumps(record) + "\n")
+                self.writer.write(record, step=episode)
                 self.log(f"ep {episode} [{m}] {record}")
 
             if episode % run.save_interval == 0 or episode == episodes - 1:

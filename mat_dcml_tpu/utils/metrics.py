@@ -1,0 +1,75 @@
+"""Metrics fan-out: jsonl (always) + TensorBoard / wandb (optional).
+
+The reference logs through wandb or tensorboardX chosen by ``--use_wandb``
+(``base_runner.py:54-66,472-505``, ``DCML_MAT_Train.py:121-132``).  Here the
+machine-readable jsonl stream is primary (it is what the tests and benchmark
+tooling consume), with scalar mirrors to TensorBoard
+(``<run_dir>/logs``, via torch's bundled SummaryWriter) and/or wandb when
+requested — both degrade to a one-line warning if the backend is missing.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+from typing import Optional
+
+
+class MetricsWriter:
+    def __init__(
+        self,
+        run_dir: str | Path,
+        jsonl_name: str = "metrics.jsonl",
+        use_tensorboard: bool = False,
+        use_wandb: bool = False,
+        wandb_project: str = "mat_dcml_tpu",
+        run_name: Optional[str] = None,
+        enabled: bool = True,
+    ):
+        """``enabled=False`` turns every sink off (non-primary hosts)."""
+        self.run_dir = Path(run_dir)
+        self.jsonl_path = self.run_dir / jsonl_name
+        self.enabled = enabled
+        self._tb = None
+        self._wandb = None
+        if not enabled:
+            return
+        if use_tensorboard:
+            try:
+                from torch.utils.tensorboard import SummaryWriter
+
+                self._tb = SummaryWriter(log_dir=str(self.run_dir / "logs"))
+            except Exception as e:                     # missing backend ≠ fatal
+                print(f"[metrics] tensorboard unavailable ({e}); jsonl only")
+        if use_wandb:
+            try:
+                import wandb
+
+                self._wandb = wandb.init(
+                    project=wandb_project, name=run_name, dir=str(self.run_dir)
+                )
+            except Exception as e:
+                print(f"[metrics] wandb unavailable ({e}); jsonl only")
+
+    def write(self, record: dict, step: Optional[int] = None) -> None:
+        if not self.enabled:
+            return
+        self.jsonl_path.parent.mkdir(parents=True, exist_ok=True)
+        with open(self.jsonl_path, "a") as f:
+            f.write(json.dumps(record) + "\n")
+        step = step if step is not None else record.get("total_steps", record.get("episode"))
+        scalars = {
+            k: v for k, v in record.items()
+            if isinstance(v, (int, float)) and k not in ("episode", "total_steps")
+        }
+        if self._tb is not None:
+            for k, v in scalars.items():
+                self._tb.add_scalar(k, v, global_step=step)
+        if self._wandb is not None:
+            self._wandb.log(scalars, step=step)
+
+    def close(self) -> None:
+        if self._tb is not None:
+            self._tb.close()
+        if self._wandb is not None:
+            self._wandb.finish()

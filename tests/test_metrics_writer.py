@@ -1,0 +1,34 @@
+"""MetricsWriter fan-out: jsonl always, TensorBoard events when enabled."""
+
+import json
+
+import numpy as np
+
+from mat_dcml_tpu.utils.metrics import MetricsWriter
+
+
+def test_jsonl_always_written(tmp_path):
+    w = MetricsWriter(tmp_path)
+    w.write({"episode": 0, "total_steps": 100, "value_loss": 0.5})
+    w.write({"episode": 1, "total_steps": 200, "value_loss": 0.25, "note": "str dropped from scalars"})
+    w.close()
+    recs = [json.loads(l) for l in (tmp_path / "metrics.jsonl").read_text().splitlines()]
+    assert len(recs) == 2 and recs[1]["value_loss"] == 0.25
+
+
+def test_tensorboard_events_created(tmp_path):
+    w = MetricsWriter(tmp_path, use_tensorboard=True)
+    for i in range(3):
+        w.write({"episode": i, "total_steps": i * 10, "reward": float(i)})
+    w.close()
+    event_files = list((tmp_path / "logs").glob("events.out.tfevents.*"))
+    assert event_files, "no TensorBoard event files written"
+    assert event_files[0].stat().st_size > 0
+
+
+def test_disabled_writer_is_silent(tmp_path):
+    w = MetricsWriter(tmp_path, use_tensorboard=True, enabled=False)
+    w.write({"episode": 0, "x": 1.0})
+    w.close()
+    assert not (tmp_path / "metrics.jsonl").exists()
+    assert not (tmp_path / "logs").exists()
