@@ -34,6 +34,22 @@ class DCMLConsts:
     c_min: int = 2**5
     c_max: int = 2**10
 
+    # Shannon channel mode (Shannon.py + DCML_Master.py:10-13,29-31,41-45,
+    # DCML_Config.py:10-11): rates B*log2(1 + P*d^-4 / noise)
+    min_worker_power: float = 10.0        # Watt
+    max_worker_power: float = 20.0
+    tx_power_min: float = 50.0            # master transmit power ~ U(50, 60)
+    tx_power_max: float = 60.0
+    distance_min: float = 10.0            # meters
+    distance_max: float = 100.0
+    b_total: float = 100e9                # split evenly across workers (:29-31)
+    noise_mw: float = 10.0 ** (-50.0 / 10.0)   # -50 dBm -> mW (Shannon.py:9)
+    path_loss_exponent: float = -4.0
+
+    # DYNAMIC_PRICE branch (DCML_Config.py:13-17): per-worker unit price in
+    # obs; local_obs_dim must be 8 when enabled
+    dynamic_price: bool = False
+
     # DCML_Worker_TIMESLOT_MultiProcess.py:5-12
     worker_frequency: float = 2e9
     bit_to_byte: float = 4.0

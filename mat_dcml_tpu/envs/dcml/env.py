@@ -56,6 +56,15 @@ class DCMLState(NamedTuple):
     arrive_time: jax.Array       # int32 in [0, P)
     disable_rate: jax.Array      # int32
     episode_idx: jax.Array       # int32, preset replay cursor
+    # per-worker channel rates; NON_SHANNON_DATA_RATE unless shannon_enable
+    # (DCML_Basic_Env.py:18-33).  The worker sim divides by download for both
+    # directions — the reference's upload formula reads self.download
+    # (DCML_Worker...py:106), a quirk replicated faithfully.
+    upload_trans: Optional[jax.Array] = None     # (W,)
+    download_trans: Optional[jax.Array] = None   # (W,)
+    # per-worker unit price (Poisson-derived, DCML_Worker...py:114-118);
+    # observed only under dynamic_price
+    prices: Optional[jax.Array] = None           # (W,)
 
 
 class TimeStep(NamedTuple):
@@ -81,6 +90,12 @@ class DCMLEnvConfig:
     preset: bool = False             # deterministic eval replay (:25-32,174-194)
     fixed_upload_retry: bool = False  # fix the reference's in-loop retry defect
     max_drain_slots: float = 2**30   # numerical guard on the drain-loop bound
+    # Shannon-rate transmission mode (Shannon.py:14-21, DCML_Basic_Env.py:
+    # 18-33): per-worker channel rates from the path-loss formula replace the
+    # fixed NON_SHANNON_DATA_RATE; master Pr pinned to 0 (DCML_Master.py:
+    # 47-56); share_obs carries the scaled rate vectors instead of worker Prs
+    # (DCML_..._SingleProcess.py:248-253)
+    shannon_enable: bool = False
 
 
 class DCMLEnv:
@@ -114,9 +129,14 @@ class DCMLEnv:
             self.preset_worker_prs = None
             self.preset_disable_rates = None
 
+        if c.dynamic_price and c.local_obs_dim != 8:
+            raise ValueError(
+                "dynamic_price=True needs local_obs_dim=8 (DCML_Config.py:13-17)"
+            )
         self.n_agents = c.n_agents
         self.obs_dim = c.local_obs_dim
-        self.share_obs_dim = c.sob_dim
+        # Shannon share_obs: [R, C] + upload/1e7 + download/1e7 (:248-251)
+        self.share_obs_dim = 2 + 2 * c.worker_number_max if config.shannon_enable else c.sob_dim
         self.action_dim = c.action_dim
 
     # ------------------------------------------------------------------ reset
@@ -124,7 +144,7 @@ class DCMLEnv:
     def reset(self, key: jax.Array, episode_idx: jax.Array | int = 0) -> Tuple[DCMLState, TimeStep]:
         """Fresh episode; mirrors ``Env.reset`` (``DCML_..._SingleProcess.py:157-274``)."""
         c = self.cfg.consts
-        key, k_dr, k_at, k_master, k_prs, k_trace, k_ava = jax.random.split(key, 7)
+        key, k_dr, k_at, k_master, k_prs, k_trace, k_ava, k_chan, k_price = jax.random.split(key, 9)
 
         episode_idx = jnp.asarray(episode_idx, jnp.int32)
         # random.randint(1, 80) — inclusive (:158)
@@ -159,6 +179,32 @@ class DCMLEnv:
         perm_rank = jnp.argsort(jax.random.uniform(k_ava, (c.worker_number_max,)))
         unavailable = perm_rank < disable_rate
 
+        W = c.worker_number_max
+        if self.cfg.shannon_enable:
+            # update_workers_transmission(True) (DCML_Basic_Env.py:19-29) +
+            # Master.get_transmission_rate (:41-45): fresh channel draws
+            master_pr = jnp.float32(0.0)             # Master.reset (:50-53)
+            k_tx, k_d, k_wp = jax.random.split(k_chan, 3)
+            bandwidth = c.b_total / W
+            tx_power = jax.random.uniform(k_tx, (), minval=c.tx_power_min, maxval=c.tx_power_max)
+            dist = jax.random.uniform(k_d, (W,), minval=c.distance_min, maxval=c.distance_max)
+            worker_power = jax.random.uniform(
+                k_wp, (W,), minval=c.min_worker_power, maxval=c.max_worker_power
+            )
+            gain = dist ** c.path_loss_exponent / c.noise_mw
+            upload_trans = bandwidth * jnp.log2(1.0 + worker_power * gain)
+            download_trans = bandwidth * jnp.log2(1.0 + tx_power * gain)
+        else:
+            upload_trans = jnp.full((W,), c.non_shannon_data_rate)
+            download_trans = jnp.full((W,), c.non_shannon_data_rate)
+
+        # per-worker unit price: mean of a period of Poisson(λ) arrivals / λ
+        # (DCML_Worker...py:114-118); only observed under dynamic_price
+        prices = (
+            jax.random.poisson(k_price, c.lambda_of_poisson, (W, c.local_workload_period))
+            .astype(jnp.float32).mean(axis=1) / c.lambda_of_poisson
+        )
+
         state = DCMLState(
             rng=key,
             r_rows=r_rows,
@@ -170,6 +216,9 @@ class DCMLEnv:
             arrive_time=arrive_time,
             disable_rate=disable_rate,
             episode_idx=episode_idx + 1,
+            upload_trans=upload_trans,
+            download_trans=download_trans,
+            prices=prices,
         )
         obs, share_obs, ava = self._observe(state)
         ts = TimeStep(
@@ -222,8 +271,14 @@ class DCMLEnv:
         r_wl = jnp.ceil(state.r_rows / k_code)
         c_wl = state.c_cols
 
+        download = (
+            state.download_trans
+            if state.download_trans is not None
+            else jnp.full((W,), c.non_shannon_data_rate)
+        )
         delays, p0, c20, cap_period, m_slots = self._process_workers(
-            k_proc, r_wl, c_wl, state.worker_prs, state.trace, state.arrive_time
+            k_proc, r_wl, c_wl, state.worker_prs, state.trace, state.arrive_time,
+            download,
         )
 
         sel_mask = select > 0.5
@@ -271,8 +326,12 @@ class DCMLEnv:
 
     # ---------------------------------------------------------------- workers
 
-    def _process_workers(self, key, r_wl, c_wl, prs, trace, arrive_time):
+    def _process_workers(self, key, r_wl, c_wl, prs, trace, arrive_time, download):
         """Vectorized ``Worker.process`` (``DCML_Worker...py:46-112``).
+
+        ``download``: (W,) per-worker data rate — NON_SHANNON_DATA_RATE or the
+        Shannon draw; BOTH directions divide by it, replicating the
+        reference's upload formula reading ``self.download`` (:106).
 
         Returns per-worker ``(delay, p0, c20, cap_period, m_slots)`` where
         ``p0`` is the transmit-time price floor, ``c20`` the cumulative free
@@ -291,7 +350,7 @@ class DCMLEnv:
         n_retry = 1.0 + fails0
         transmit_delay = (
             c.second_to_centsec
-            * (jnp.ceil((r_wl + 1.0) * c_wl) * 1.0 * c.bit_to_byte / c.non_shannon_data_rate + 0.001)
+            * (jnp.ceil((r_wl + 1.0) * c_wl) * 1.0 * c.bit_to_byte / download + 0.001)
             * n_retry
         )  # (:60)
 
@@ -325,10 +384,10 @@ class DCMLEnv:
         n_retry_final = n_retry + extra_fails
         upload_delay = (
             c.second_to_centsec
-            * (jnp.ceil(r_wl) * 1.0 * c.bit_to_byte / c.non_shannon_data_rate + 0.001)
+            * (jnp.ceil(r_wl) * 1.0 * c.bit_to_byte / download + 0.001)
             * n_retry_final
             + 0.02
-        )  # (:106)
+        )  # (:106; divides by download — the reference quirk, see docstring)
 
         # (:108): finish_timeslot - arrive_time - overshoot + upload_delay
         delay = (arrive_ts + m_slots) - arrive_time - (drained - cost) + upload_delay
@@ -399,15 +458,63 @@ class DCMLEnv:
         mean_pr = (state.worker_prs * af).sum() / denom
         master_obs = jnp.concatenate([shared_head, mean_wl3, jnp.array([mean_pr, 1.1])])
 
+        if c.dynamic_price:
+            # 8th obs feature (:214-215,228-229,240-241): worker unit price,
+            # UNAVAILABLE_PRICE when disabled, MASTER_PRICE for the master
+            prices = (
+                state.prices if state.prices is not None else jnp.ones((W,))
+            )
+            price_col = jnp.where(avail, prices, c.unavailable_price)
+            worker_obs = jnp.concatenate([worker_obs, price_col[:, None]], axis=1)
+            master_obs = jnp.append(master_obs, c.master_price)
+
         obs = jnp.concatenate([worker_obs, master_obs[None, :]], axis=0)
 
-        share_obs_row = jnp.concatenate([shared_head, state.worker_prs])  # (:181-182,252-253)
-        share_obs = jnp.broadcast_to(share_obs_row, (c.n_agents, c.sob_dim))
+        if self.cfg.shannon_enable:
+            # share_obs = [R, C] ++ upload/1e7 ++ download/1e7 (:248-251)
+            share_obs_row = jnp.concatenate(
+                [shared_head, state.upload_trans / 1e7, state.download_trans / 1e7]
+            )
+        else:
+            share_obs_row = jnp.concatenate([shared_head, state.worker_prs])  # (:181-182,252-253)
+        share_obs = jnp.broadcast_to(share_obs_row, (c.n_agents, self.share_obs_dim))
 
         # availability mask (:266-268): [1,1] available / [1,0] disabled; master [1,1]
         ava_workers = jnp.stack([jnp.ones(W), af], axis=1)
         ava = jnp.concatenate([ava_workers, jnp.ones((1, 2))], axis=0)
         return obs, share_obs, ava
+
+    # ------------------------------------------------- single-agent encoding
+
+    def encode_single_agent_state(self, state: DCMLState, binary: bool = True) -> jax.Array:
+        """``fake_reset`` state encoding (``DCML_..._SingleProcess.py:275-315``):
+        the flat single-agent view consumed by non-MARL baselines (TD3 etc.).
+
+        ``binary=True``: 32-bit big-endian binary expansions of R and C
+        (:279-286); else their normalized values.  Then, Shannon mode appends
+        the scaled rate vectors (:291-295); otherwise Pr plus each worker's
+        workload at the arrival timeslot (:296-309, OBSERVER_WORKLOAD).
+        """
+        c = self.cfg.consts
+        W = c.worker_number_max
+        if binary:
+            shifts = jnp.arange(31, -1, -1)
+            r_bits = (state.r_rows.astype(jnp.int32) >> shifts) & 1
+            c_bits = (state.c_cols.astype(jnp.int32) >> shifts) & 1
+            head = jnp.concatenate([r_bits, c_bits]).astype(jnp.float32)
+        else:
+            head = jnp.stack([
+                (state.r_rows - c.r_min) / (c.r_max - c.r_min) * c.state_ratio,
+                (state.c_cols - c.c_min) / (c.c_max - c.c_min) * c.state_ratio,
+            ])
+        if self.cfg.shannon_enable:
+            return jnp.concatenate(
+                [head, state.upload_trans / 1e7, state.download_trans / 1e7]
+            )
+        wl_now = jnp.take_along_axis(
+            state.trace, jnp.full((W, 1), state.arrive_time, jnp.int32), axis=1
+        )[:, 0]
+        return jnp.concatenate([head, state.master_pr[None], wl_now])
 
 
 # ---------------------------------------------------------------- sampling

@@ -1,0 +1,127 @@
+"""Secondary DCML env modes: Shannon-rate transmission, DYNAMIC_PRICE obs,
+and the fake_reset binary single-agent encoding (VERDICT r1 missing item 7)."""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from mat_dcml_tpu.envs.dcml import DCMLEnv, DCMLEnvConfig
+from mat_dcml_tpu.envs.dcml.constants import DCMLConsts
+
+W = 8
+
+
+def small_env(**cfg_kw):
+    consts_kw = cfg_kw.pop("consts_kw", {})
+    consts = DCMLConsts(worker_number_max=W, sob_dim=W + 2, **consts_kw)
+    rng = np.random.default_rng(0)
+    workloads = rng.uniform(0, 0.7, size=(W, consts.local_workload_period)).astype(np.float32)
+    return DCMLEnv(DCMLEnvConfig(consts=consts, **cfg_kw), base_workloads=workloads)
+
+
+class TestShannon:
+    def test_reset_draws_rates_and_pins_pr(self):
+        env = small_env(shannon_enable=True)
+        assert env.share_obs_dim == 2 + 2 * W
+        state, ts = env.reset(jax.random.key(0))
+        assert float(state.master_pr) == 0.0
+        up = np.asarray(state.upload_trans)
+        dn = np.asarray(state.download_trans)
+        assert (up > 0).all() and (dn > 0).all()
+        # worker power (10-20 W) < master power (50-60 W), same path gain
+        # => upload rate < download rate elementwise (Shannon.py:14-21)
+        assert (up < dn).all()
+        # rates vary across workers (distances differ)
+        assert np.std(dn) > 0
+        # share_obs carries the scaled rate vectors (:248-251)
+        row = np.asarray(ts.share_obs[0])
+        np.testing.assert_allclose(row[2 : 2 + W], up / 1e7, rtol=1e-6)
+        np.testing.assert_allclose(row[2 + W :], dn / 1e7, rtol=1e-6)
+
+    def test_rate_formula_matches_numpy(self):
+        """Rates must satisfy B*log2(1 + P*d^-4/noise) for SOME d in bounds,
+        with the same d recovering both directions' powers consistently."""
+        c = DCMLConsts(worker_number_max=W, sob_dim=W + 2)
+        env = small_env(shannon_enable=True)
+        state, _ = env.reset(jax.random.key(3))
+        B = c.b_total / W
+        up = np.asarray(state.upload_trans)
+        dn = np.asarray(state.download_trans)
+        # invert download for gain = P_tx * d^-4 / noise, assuming mid power;
+        # the recovered distance must lie inside the configured bounds
+        snr_dn = 2.0 ** (dn / B) - 1.0
+        d4 = 55.0 / (snr_dn * c.noise_mw)           # P_tx in [50, 60]
+        d = d4 ** 0.25
+        assert (d > c.distance_min * 0.95).all() and (d < c.distance_max * 1.05).all()
+        # and upload/download SNR ratio equals the power ratio (same gain)
+        snr_up = 2.0 ** (up / B) - 1.0
+        ratio = snr_up / snr_dn
+        assert (ratio > c.min_worker_power / c.tx_power_max * 0.99).all()
+        assert (ratio < c.max_worker_power / c.tx_power_min * 1.01).all()
+
+    def test_faster_channel_shorter_delay(self):
+        env = small_env(shannon_enable=True)
+        state, _ = env.reset(jax.random.key(1))
+        state = state._replace(
+            master_pr=jnp.float32(0.0),
+            worker_prs=jnp.zeros((W,)),
+            unavailable=jnp.zeros((W,), bool),
+        )
+        action = jnp.concatenate([jnp.ones((W,)), jnp.array([0.5])])[:, None]
+        slow = state._replace(download_trans=jnp.full((W,), 1e6))
+        fast = state._replace(download_trans=jnp.full((W,), 1e9))
+        _, ts_slow = env.step(slow, action)
+        _, ts_fast = env.step(fast, action)
+        assert float(ts_fast.delay) < float(ts_slow.delay)
+
+    def test_shannon_training_smoke(self, tmp_path):
+        from mat_dcml_tpu.config import RunConfig
+        from mat_dcml_tpu.training.ppo import PPOConfig
+        from mat_dcml_tpu.training.runner import DCMLRunner
+
+        run = RunConfig(n_rollout_threads=2, episode_length=4, num_env_steps=16,
+                        n_embd=16, n_block=1, run_dir=str(tmp_path), log_interval=1)
+        runner = DCMLRunner(run, PPOConfig(ppo_epoch=1, num_mini_batch=1),
+                            env=small_env(shannon_enable=True), log_fn=lambda *a: None)
+        state, _ = runner.train_loop(num_episodes=1)
+        assert int(state.update_step) == 1
+
+
+class TestDynamicPrice:
+    def test_obs_gains_price_column(self):
+        env = small_env(consts_kw=dict(dynamic_price=True, local_obs_dim=8))
+        assert env.obs_dim == 8
+        state, ts = env.reset(jax.random.key(2))
+        obs = np.asarray(ts.obs)
+        assert obs.shape == (W + 1, 8)
+        unavail = np.asarray(state.unavailable)
+        # disabled workers advertise UNAVAILABLE_PRICE; master MASTER_PRICE
+        assert (obs[:W][unavail][:, 7] == env.cfg.consts.unavailable_price).all()
+        avail_prices = obs[:W][~unavail][:, 7]
+        assert (avail_prices >= 0).all() and (avail_prices < 5).all()
+        assert obs[W, 7] == env.cfg.consts.master_price
+
+    def test_dim_mismatch_raises(self):
+        with pytest.raises(ValueError, match="local_obs_dim=8"):
+            small_env(consts_kw=dict(dynamic_price=True))
+
+
+class TestBinaryEncoding:
+    def test_binary_roundtrip(self):
+        env = small_env()
+        state, _ = env.reset(jax.random.key(4))
+        enc = np.asarray(env.encode_single_agent_state(state, binary=True))
+        assert enc.shape == (32 + 32 + 1 + W,)
+        r_bits, c_bits = enc[:32], enc[32:64]
+        r = int("".join(str(int(b)) for b in r_bits), 2)
+        c = int("".join(str(int(b)) for b in c_bits), 2)
+        assert r == int(state.r_rows) and c == int(state.c_cols)
+        assert enc[64] == float(state.master_pr)
+
+    def test_shannon_encoding_carries_rates(self):
+        env = small_env(shannon_enable=True)
+        state, _ = env.reset(jax.random.key(5))
+        enc = np.asarray(env.encode_single_agent_state(state, binary=True))
+        assert enc.shape == (64 + 2 * W,)
+        np.testing.assert_allclose(enc[64 : 64 + W], np.asarray(state.upload_trans) / 1e7)
