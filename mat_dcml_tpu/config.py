@@ -39,6 +39,9 @@ class RunConfig:
     use_tensorboard: bool = False
     use_wandb: bool = False
     wandb_project: str = "mat_dcml_tpu"
+    # capture a jax.profiler trace of one post-warmup training iteration
+    # (collect + train) into this directory; TensorBoard-viewable
+    profile_dir: Optional[str] = None
     # model
     n_block: int = 2
     n_embd: int = 64

@@ -125,11 +125,37 @@ class BaseRunner:
 
         start = time.time()
         for episode in range(self.start_episode, episodes):
+            # profile ONE post-warmup iteration (episode start+1: compiles are
+            # done, steady-state schedule) — the jax.profiler hook the
+            # reference lacked entirely (SURVEY.md §5 tracing)
+            profiling = (
+                run.profile_dir is not None and episode == self.start_episode + 1
+            )
+            if profiling:
+                jax.profiler.start_trace(run.profile_dir)
+            t_collect = time.perf_counter()
             rollout_state, traj = self._collect(train_state.params, rollout_state)
+            if profiling:
+                jax.block_until_ready(traj)
+                t_collect = time.perf_counter() - t_collect
             key, k_train = jax.random.split(key)
+            t_train = time.perf_counter()
             train_state, metrics = self._train(
                 train_state, traj, self._bootstrap(rollout_state), k_train
             )
+            if profiling:
+                jax.block_until_ready(train_state)
+                t_train = time.perf_counter() - t_train
+                jax.profiler.stop_trace()
+                self.log(
+                    f"[profile] trace -> {run.profile_dir}; compiled-step wall: "
+                    f"collect {t_collect:.3f}s train {t_train:.3f}s"
+                )
+                self.writer.write(
+                    {"episode": episode, "profile_collect_sec": t_collect,
+                     "profile_train_sec": t_train},
+                    step=episode,
+                )
 
             # host-side episode metric accumulation (one device->host copy)
             rew_arr = np.asarray(traj.rewards)                 # (T, E, A, n_obj)

@@ -1,0 +1,28 @@
+#!/usr/bin/env python
+"""Train MAT on Bi-DexHands (gated on an external Isaac Gym install).
+
+Equivalent of the reference entry point
+``mat_src/mat/scripts/train/train_hands.py`` (+ ``train_hands.sh``) — whose
+own env package (``mat.envs.dexteroushandenvs``) is missing from the
+reference tree (SURVEY.md §2.4), so this capability was broken upstream.
+Here the runner (``mat_dcml_tpu/training/hands_runner.py``) is ready: supply
+host envs exposing the shared-obs contract from an Isaac Gym / Bi-DexHands
+install and they drive through the vec-env bridge exactly like football.
+"""
+
+import sys
+
+
+def main(argv=None):
+    raise SystemExit(
+        "Bi-DexHands needs an external Isaac Gym install (not bundled, and "
+        "absent even from the reference tree). With one installed: wrap each "
+        "task env behind the host shared-obs contract (envs/vec_env.py "
+        "docstring), build a ShareSubprocVecEnv, and construct "
+        "mat_dcml_tpu.training.hands_runner.HandsRunner(run, ppo, vec_env) "
+        "— see train_football.py for the working template."
+    )
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])
