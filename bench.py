@@ -73,7 +73,14 @@ def _build(jax, E: int, T: int):
     from mat_dcml_tpu.training.runner import build_mat_policy
 
     data_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)), "data")
-    run = RunConfig(n_rollout_threads=E, episode_length=T)
+    # bfloat16 trunk on TPU (BENCH_DTYPE=float32 reverts): heads/softmax/
+    # distributions stay float32 (models/mat.py), so the PPO math is intact
+    dtype = os.environ.get(
+        "BENCH_DTYPE",
+        "bfloat16" if jax.default_backend() == "tpu" else "float32",
+    )
+    log(f"model_dtype={dtype}")
+    run = RunConfig(n_rollout_threads=E, episode_length=T, model_dtype=dtype)
     ppo = PPOConfig()
 
     env = DCMLEnv(DCMLEnvConfig(), data_dir=data_dir)

@@ -46,6 +46,9 @@ class RunConfig:
     n_block: int = 2
     n_embd: int = 64
     n_head: int = 2
+    # transformer trunk compute dtype ("float32" | "bfloat16"); heads,
+    # softmax, distributions, and params always float32 (models/mat.py)
+    model_dtype: str = "float32"
     encode_state: bool = False
     dec_actor: bool = False
     share_actor: bool = False

@@ -58,6 +58,16 @@ class MATConfig:
     dec_actor: bool = False       # "MAT-Dec" ablation (ma_transformer.py:175-189)
     share_actor: bool = False
     n_objective: int = 1          # >1 => MO-MAT vector-valued critic
+    # computation dtype for the transformer trunk ("float32" | "bfloat16");
+    # params, action/value heads, softmax, and distributions stay float32 —
+    # bfloat16 keeps the trunk matmuls on the TPU MXU fast path
+    dtype: str = "float32"
+
+    @property
+    def np_dtype(self):
+        import jax.numpy as _jnp
+
+        return {"float32": _jnp.float32, "bfloat16": _jnp.bfloat16}[self.dtype]
 
     @property
     def action_input_dim(self) -> int:
@@ -76,22 +86,27 @@ class ObsEncoder(nn.Module):
     """LayerNorm -> Linear -> GELU embed (``ma_transformer.py:131-134``)."""
 
     n_embd: int
+    dtype: object = None
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
-        x = nn.LayerNorm()(x)
-        x = dense(self.n_embd, gain=GAIN_ACT)(x)
+        x = nn.LayerNorm(dtype=self.dtype)(x)
+        x = dense(self.n_embd, gain=GAIN_ACT, dtype=self.dtype)(x)
         return nn.gelu(x)
 
 
 class Head(nn.Module):
-    """Linear-GELU-LN-Linear head (``ma_transformer.py:138-139,202-203``)."""
+    """Linear-GELU-LN-Linear head (``ma_transformer.py:138-139,202-203``).
+
+    Always float32: logits and values feed distributions/losses, where
+    bfloat16 rounding would perturb PPO ratios."""
 
     n_embd: int
     out_dim: int
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
+        x = x.astype(jnp.float32)
         x = dense(self.n_embd, gain=GAIN_ACT)(x)
         x = nn.gelu(x)
         x = nn.LayerNorm()(x)
@@ -105,10 +120,11 @@ class Encoder(nn.Module):
 
     def setup(self):
         c = self.cfg
-        self.state_encoder = ObsEncoder(c.n_embd)
-        self.obs_encoder = ObsEncoder(c.n_embd)
-        self.ln = nn.LayerNorm()
-        self.blocks = [EncodeBlock(c.n_embd, c.n_head) for _ in range(c.n_block)]
+        dt = c.np_dtype if c.dtype != "float32" else None
+        self.state_encoder = ObsEncoder(c.n_embd, dtype=dt)
+        self.obs_encoder = ObsEncoder(c.n_embd, dtype=dt)
+        self.ln = nn.LayerNorm(dtype=dt)
+        self.blocks = [EncodeBlock(c.n_embd, c.n_head, dtype=dt) for _ in range(c.n_block)]
         self.head = Head(c.n_embd, c.n_objective)
 
     def __call__(self, state: jax.Array, obs: jax.Array):
@@ -162,13 +178,14 @@ class Decoder(nn.Module):
                     split_rngs={"params": True},
                 )(c.n_embd, c.action_dim)
         else:
+            dt = c.np_dtype if c.dtype != "float32" else None
             if c.action_type in (DISCRETE, SEMI_DISCRETE):
-                self.action_encoder_nobias = dense(c.n_embd, gain=GAIN_ACT, use_bias=False)
+                self.action_encoder_nobias = dense(c.n_embd, gain=GAIN_ACT, use_bias=False, dtype=dt)
             else:
-                self.action_encoder_bias = dense(c.n_embd, gain=GAIN_ACT)
-            self.obs_encoder = ObsEncoder(c.n_embd)
-            self.ln = nn.LayerNorm()
-            self.blocks = [DecodeBlock(c.n_embd, c.n_head) for _ in range(c.n_block)]
+                self.action_encoder_bias = dense(c.n_embd, gain=GAIN_ACT, dtype=dt)
+            self.obs_encoder = ObsEncoder(c.n_embd, dtype=dt)
+            self.ln = nn.LayerNorm(dtype=dt)
+            self.blocks = [DecodeBlock(c.n_embd, c.n_head, dtype=dt) for _ in range(c.n_block)]
             self.head = Head(c.n_embd, c.action_dim)
 
     def _embed_action(self, shifted_action: jax.Array) -> jax.Array:
@@ -246,5 +263,6 @@ class MultiAgentTransformer(nn.Module):
     def action_std(self):
         return self.decoder.std()
 
-    def fresh_cache(self, batch: int, dtype=jnp.float32):
+    def fresh_cache(self, batch: int, dtype=None):
+        dtype = dtype if dtype is not None else self.cfg.np_dtype
         return init_decode_cache(self.cfg.n_block, batch, self.cfg.n_agent, self.cfg.n_embd, dtype)

@@ -20,10 +20,14 @@ GAIN_ACT = math.sqrt(2.0)  # torch nn.init.calculate_gain('relu')
 GAIN_OUT = 0.01
 
 
-def dense(features: int, gain: float = GAIN_OUT, use_bias: bool = True) -> nn.Dense:
+def dense(features: int, gain: float = GAIN_OUT, use_bias: bool = True,
+          dtype=None) -> nn.Dense:
+    """``dtype``: computation dtype (params stay float32 — flax param_dtype
+    default); bfloat16 here keeps the matmuls on the MXU fast path."""
     return nn.Dense(
         features,
         use_bias=use_bias,
+        dtype=dtype,
         kernel_init=nn.initializers.orthogonal(gain),
         bias_init=nn.initializers.zeros,
     )
@@ -39,13 +43,14 @@ class SelfAttention(nn.Module):
     n_embd: int
     n_head: int
     masked: bool = False
+    dtype: Optional[jnp.dtype] = None
 
     def setup(self):
         assert self.n_embd % self.n_head == 0
-        self.key_p = dense(self.n_embd)
-        self.query_p = dense(self.n_embd)
-        self.value_p = dense(self.n_embd)
-        self.proj = dense(self.n_embd)
+        self.key_p = dense(self.n_embd, dtype=self.dtype)
+        self.query_p = dense(self.n_embd, dtype=self.dtype)
+        self.value_p = dense(self.n_embd, dtype=self.dtype)
+        self.proj = dense(self.n_embd, dtype=self.dtype)
 
     def __call__(self, key: jax.Array, value: jax.Array, query: jax.Array) -> jax.Array:
         k = split_heads(self.key_p(key), self.n_head)
@@ -78,12 +83,13 @@ class MlpBlock(nn.Module):
     """The transformer block MLP: Linear-GELU-Linear (``ma_transformer.py:83-87``)."""
 
     n_embd: int
+    dtype: Optional[jnp.dtype] = None
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
-        x = dense(self.n_embd, gain=GAIN_ACT)(x)
+        x = dense(self.n_embd, gain=GAIN_ACT, dtype=self.dtype)(x)
         x = nn.gelu(x)
-        return dense(self.n_embd)(x)
+        return dense(self.n_embd, dtype=self.dtype)(x)
 
 
 class EncodeBlock(nn.Module):
@@ -91,12 +97,13 @@ class EncodeBlock(nn.Module):
 
     n_embd: int
     n_head: int
+    dtype: Optional[jnp.dtype] = None
 
     def setup(self):
-        self.ln1 = nn.LayerNorm()
-        self.ln2 = nn.LayerNorm()
-        self.attn = SelfAttention(self.n_embd, self.n_head, masked=False)
-        self.mlp = MlpBlock(self.n_embd)
+        self.ln1 = nn.LayerNorm(dtype=self.dtype)
+        self.ln2 = nn.LayerNorm(dtype=self.dtype)
+        self.attn = SelfAttention(self.n_embd, self.n_head, masked=False, dtype=self.dtype)
+        self.mlp = MlpBlock(self.n_embd, dtype=self.dtype)
 
     def __call__(self, x: jax.Array) -> jax.Array:
         x = self.ln1(x + self.attn(x, x, x))
@@ -111,14 +118,15 @@ class DecodeBlock(nn.Module):
 
     n_embd: int
     n_head: int
+    dtype: Optional[jnp.dtype] = None
 
     def setup(self):
-        self.ln1 = nn.LayerNorm()
-        self.ln2 = nn.LayerNorm()
-        self.ln3 = nn.LayerNorm()
-        self.attn1 = SelfAttention(self.n_embd, self.n_head, masked=True)
-        self.attn2 = SelfAttention(self.n_embd, self.n_head, masked=True)
-        self.mlp = MlpBlock(self.n_embd)
+        self.ln1 = nn.LayerNorm(dtype=self.dtype)
+        self.ln2 = nn.LayerNorm(dtype=self.dtype)
+        self.ln3 = nn.LayerNorm(dtype=self.dtype)
+        self.attn1 = SelfAttention(self.n_embd, self.n_head, masked=True, dtype=self.dtype)
+        self.attn2 = SelfAttention(self.n_embd, self.n_head, masked=True, dtype=self.dtype)
+        self.mlp = MlpBlock(self.n_embd, dtype=self.dtype)
 
     def __call__(self, x: jax.Array, rep_enc: jax.Array) -> jax.Array:
         x = self.ln1(x + self.attn1(x, x, x))

@@ -77,7 +77,11 @@ def multi_head_attention(
             interpret=chosen == "pallas_interpret",
         )
     dh = q.shape[-1]
-    att = jnp.einsum("bhqd,bhkd->bhqk", q, k) * (1.0 / jnp.sqrt(jnp.asarray(dh, q.dtype)))
+    # scores + softmax in float32 even under a bfloat16 trunk: attention
+    # weights are the numerically delicate part; the matmuls stay low-precision
+    att = jnp.einsum(
+        "bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32
+    ) * (1.0 / jnp.sqrt(jnp.asarray(dh, jnp.float32)))
     if causal:
         lq, lk = q.shape[-2], k.shape[-2]
         tri = jnp.tril(jnp.ones((lq, lk), dtype=bool))
@@ -88,7 +92,7 @@ def multi_head_attention(
         else:
             m = kv_mask[:, None, None, :]
         att = jnp.where(m, att, NEG_INF)
-    att = jax.nn.softmax(att, axis=-1)
+    att = jax.nn.softmax(att, axis=-1).astype(v.dtype)
     return jnp.einsum("bhqk,bhkd->bhqd", att, v)
 
 

@@ -73,6 +73,7 @@ def build_mat_policy(run: RunConfig, env: DCMLEnv) -> TransformerPolicy:
         n_block=run.n_block,
         n_embd=run.n_embd,
         n_head=run.n_head,
+        dtype=run.model_dtype,
         action_type=SEMI_DISCRETE,
         semi_index=-env.cfg.consts.extra_agent if hasattr(env, "cfg") else -1,
         encode_state=run.encode_state,
