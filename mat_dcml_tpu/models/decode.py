@@ -16,6 +16,7 @@ All functions are pure: ``params`` in, arrays out.
 
 from __future__ import annotations
 
+import os
 from functools import partial
 from typing import NamedTuple, Optional, Tuple
 
@@ -37,6 +38,26 @@ from mat_dcml_tpu.ops import distributions as D
 class DecodeResult(NamedTuple):
     action: jax.Array       # (B, n_agent, act_out) float32
     log_prob: jax.Array     # (B, n_agent, act_prob) float32
+
+
+# "auto": fused Pallas decode-step kernel on TPU, XLA elsewhere.
+_DECODE_IMPL_ENV = "MAT_DCML_TPU_DECODE_IMPL"
+_VALID_DECODE_IMPLS = ("auto", "xla", "pallas", "pallas_interpret")
+
+
+def _resolve_decode_impl(cfg) -> str:
+    impl = os.environ.get(_DECODE_IMPL_ENV, "auto")
+    if impl not in _VALID_DECODE_IMPLS:
+        raise ValueError(
+            f"{_DECODE_IMPL_ENV} must be one of {_VALID_DECODE_IMPLS}, got {impl!r}"
+        )
+    if cfg.dec_actor:
+        return "xla"               # MAT-Dec has no decoder trunk to fuse
+    if impl == "auto":
+        # stays XLA until the fused kernel demonstrates a measured win on the
+        # production shape (see ops/pallas_decode.py); flip via env var
+        return "xla"
+    return impl
 
 
 def _action_std(model: MultiAgentTransformer, params) -> jax.Array:
@@ -80,13 +101,38 @@ def ar_decode(
 
     caches = model.fresh_cache(B)
 
-    def decode_step(caches, shifted_in, i):
-        rep_i = jax.lax.dynamic_slice_in_dim(obs_rep, i, 1, axis=1)
-        obs_i = jax.lax.dynamic_slice_in_dim(obs, i, 1, axis=1)
-        logits, caches = model.apply(
-            params, shifted_in, rep_i, obs_i, caches, i, method="decode_step"
+    impl = _resolve_decode_impl(cfg)
+    if impl.startswith("pallas"):
+        # whole decode position fused into ONE kernel (ops/pallas_decode.py)
+        from mat_dcml_tpu.ops.pallas_decode import (
+            fused_decode_step,
+            pack_decode_weights,
         )
-        return logits[:, 0], caches  # (B, adim)
+
+        fused_weights, _ = pack_decode_weights(params, cfg)
+        cache_keys = ("k1", "v1", "k2", "v2")
+
+        def decode_step(caches, shifted_in, i):
+            rep_i = jax.lax.dynamic_slice_in_dim(obs_rep, i, 1, axis=1)[:, 0]
+            flat = [c[k] for c in caches for k in cache_keys]
+            logits, new_flat = fused_decode_step(
+                fused_weights, shifted_in[:, 0], rep_i, flat, i,
+                n_head=cfg.n_head, adim=adim,
+                interpret=impl == "pallas_interpret",
+            )
+            new_caches = [
+                dict(zip(cache_keys, new_flat[4 * b : 4 * b + 4]))
+                for b in range(cfg.n_block)
+            ]
+            return logits, new_caches
+    else:
+        def decode_step(caches, shifted_in, i):
+            rep_i = jax.lax.dynamic_slice_in_dim(obs_rep, i, 1, axis=1)
+            obs_i = jax.lax.dynamic_slice_in_dim(obs, i, 1, axis=1)
+            logits, caches = model.apply(
+                params, shifted_in, rep_i, obs_i, caches, i, method="decode_step"
+            )
+            return logits[:, 0], caches  # (B, adim)
 
     def body(carry, i):
         caches, shifted_in, key = carry
