@@ -17,6 +17,12 @@ Knobs (environment variables):
   BENCH_SWEEP_ENVS      comma list for the sweep (default 128,512,2048,8192)
   BENCH_PROFILE_DIR     if set, capture a jax.profiler trace of one timed iter
   BENCH_BREAKDOWN       "1" → additionally time collect vs train separately
+  BENCH_DTYPE           model trunk dtype (default bfloat16 on TPU)
+  BENCH_COMBINED        "0" → separate collect/train dispatches per iter
+                        (default 1: ONE jitted collect+train step — alternating
+                        between two executables pays a per-switch cost on
+                        tunneled backends, and one program per iteration is the
+                        TPU-native shape anyway)
 """
 
 from __future__ import annotations
@@ -94,30 +100,51 @@ def _build(jax, E: int, T: int):
 
     collect = jax.jit(collector.collect)
     train = jax.jit(trainer.train)
-    return collect, train, train_state, rollout_state
+
+    def _step(train_state, rollout_state, key):
+        rollout_state, traj = collector.collect(train_state.params, rollout_state)
+        train_state, metrics = trainer.train(train_state, traj, rollout_state, key)
+        return train_state, rollout_state, metrics
+
+    step = jax.jit(_step)
+    return collect, train, step, train_state, rollout_state
 
 
 def _measure(jax, E: int, T: int, iters: int, profile_dir: str | None = None,
-             breakdown: bool = False) -> dict:
+             breakdown: bool = False, combined: bool = True) -> dict:
     """Compile + time `iters` full collect+train iterations at batch E."""
     t0 = time.perf_counter()
-    collect, train, train_state, rollout_state = _build(jax, E, T)
+    collect, train, step, train_state, rollout_state = _build(jax, E, T)
     log(f"E={E}: built in {time.perf_counter() - t0:.1f}s, compiling...")
 
+    # TWO warmup iterations: the first compiles; the second catches the
+    # recompile caused by weak-type promotion in the carried train state (a
+    # literal-initialized leaf becomes strongly typed after one real update) —
+    # timing from the first "warm" call would silently include that recompile.
     t0 = time.perf_counter()
-    rollout_state, traj = collect(train_state.params, rollout_state)
-    train_state, _ = train(train_state, traj, rollout_state, jax.random.key(2))
-    jax.block_until_ready(train_state)
-    log(f"E={E}: warmup (compile + 1 iter) {time.perf_counter() - t0:.1f}s")
+    for w in range(2):
+        if combined:
+            train_state, rollout_state, _ = step(train_state, rollout_state, jax.random.key(2))
+        else:
+            rollout_state, traj = collect(train_state.params, rollout_state)
+            train_state, _ = train(train_state, traj, rollout_state, jax.random.key(2))
+        jax.block_until_ready(train_state)
+        log(f"E={E}: warmup {w + 1} done at {time.perf_counter() - t0:.1f}s")
 
     if profile_dir:
         jax.profiler.start_trace(profile_dir)
 
+    iter_secs = []
     start = time.perf_counter()
     for i in range(iters):
-        rollout_state, traj = collect(train_state.params, rollout_state)
-        train_state, _ = train(train_state, traj, rollout_state, jax.random.key(3 + i))
-    jax.block_until_ready(train_state)
+        t_it = time.perf_counter()
+        if combined:
+            train_state, rollout_state, _ = step(train_state, rollout_state, jax.random.key(3 + i))
+        else:
+            rollout_state, traj = collect(train_state.params, rollout_state)
+            train_state, _ = train(train_state, traj, rollout_state, jax.random.key(3 + i))
+        jax.block_until_ready(train_state)
+        iter_secs.append(time.perf_counter() - t_it)
     elapsed = time.perf_counter() - start
 
     if profile_dir:
@@ -125,10 +152,18 @@ def _measure(jax, E: int, T: int, iters: int, profile_dir: str | None = None,
         log(f"profile trace written to {profile_dir}")
 
     steps = iters * E * T
-    result = {"E": E, "steps_per_sec": steps / elapsed, "iter_sec": elapsed / iters}
-    log(f"E={E}: {result['steps_per_sec']:.0f} env-steps/s ({elapsed / iters:.2f}s/iter)")
+    result = {
+        "E": E,
+        "steps_per_sec": steps / elapsed,
+        "iter_sec": elapsed / iters,
+        "iter_secs": [round(s, 3) for s in iter_secs],
+    }
+    log(f"E={E}: {result['steps_per_sec']:.0f} env-steps/s ({elapsed / iters:.2f}s/iter; "
+        f"per-iter {result['iter_secs']})")
 
     if breakdown:
+        rollout_state, traj = collect(train_state.params, rollout_state)
+        jax.block_until_ready(traj)
         for name, fn in [("collect", lambda k: collect(train_state.params, rollout_state)),
                          ("train", lambda k: train(train_state, traj, rollout_state, k))]:
             t0 = time.perf_counter()
@@ -148,6 +183,7 @@ def main() -> None:
     sweep = os.environ.get("BENCH_SWEEP", "0") == "1"
     profile_dir = os.environ.get("BENCH_PROFILE_DIR") or None
     breakdown = os.environ.get("BENCH_BREAKDOWN", "0") == "1"
+    combined = os.environ.get("BENCH_COMBINED", "1") == "1"
 
     jax, fell_back = _setup_jax()
     if fell_back:
@@ -163,7 +199,7 @@ def main() -> None:
             env_list = [e for e in env_list if e <= 128] or [32]
         results = [
             # profile the largest (last) sweep entry if a trace was requested
-            _measure(jax, e, T, ITERS, breakdown=breakdown,
+            _measure(jax, e, T, ITERS, breakdown=breakdown, combined=combined,
                      profile_dir=profile_dir if e == env_list[-1] else None)
             for e in env_list
         ]
@@ -171,7 +207,8 @@ def main() -> None:
         log("sweep results: " + json.dumps(results))
         steps_per_sec = best["steps_per_sec"]
     else:
-        res = _measure(jax, E, T, ITERS, profile_dir=profile_dir, breakdown=breakdown)
+        res = _measure(jax, E, T, ITERS, profile_dir=profile_dir,
+                       breakdown=breakdown, combined=combined)
         steps_per_sec = res["steps_per_sec"]
 
     print(
