@@ -1,0 +1,122 @@
+"""Ring attention: sequence/context parallelism over the agent axis.
+
+The reference's only length-scaling device is stride-batched decoding over a
+≤101-token agent axis (SURVEY.md §5 long-context) — nothing distributes the
+sequence.  Here the attention interface is context-shardable: shards of the
+(agent) sequence live on different devices along a ``seq`` mesh axis, K/V
+shards rotate around the ring with ``jax.lax.ppermute`` while each device's
+Q shard accumulates output with an online (flash-style) softmax — compute
+overlaps communication, memory per device is O(L/n), and the result is exact
+(tested against dense attention on a virtual CPU mesh).
+
+This is headroom, not parity: DCML's 101 agents fit one chip trivially, but
+the MAT design treats agents AS the sequence, so a 100x agent count rides
+the same op over ICI.  Usage is via ``shard_map`` with the length axis
+sharded on ``seq``:
+
+    out = shard_map(
+        partial(ring_attention, axis_name="seq", causal=True),
+        mesh=mesh,
+        in_specs=P(None, None, "seq", None),
+        out_specs=P(None, None, "seq", None),
+    )(q, k, v)
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    axis_name: str = "seq",
+    causal: bool = False,
+) -> jax.Array:
+    """Exact attention over a ring-sharded sequence (call inside shard_map).
+
+    Args:
+      q, k, v: ``(B, H, L_local, Dh)`` — this device's shard of the global
+        length axis, sharded over ``axis_name``.
+      causal: apply the global lower-triangular mask (query position attends
+        to key positions <= its own GLOBAL index).
+
+    Returns:
+      ``(B, H, L_local, Dh)`` — this device's shard of the attention output.
+    """
+    n_shards = jax.lax.psum(1, axis_name)
+    my_idx = jax.lax.axis_index(axis_name)
+    B, H, Ll, Dh = q.shape
+    scale = 1.0 / jnp.sqrt(jnp.asarray(Dh, jnp.float32))
+
+    q32 = q.astype(jnp.float32)
+    q_pos = my_idx * Ll + jnp.arange(Ll)                    # global q positions
+
+    def scores_for(k_blk, kv_idx):
+        s = jnp.einsum("bhqd,bhkd->bhqk", q32, k_blk.astype(jnp.float32)) * scale
+        if causal:
+            k_pos = kv_idx * Ll + jnp.arange(Ll)
+            mask = q_pos[:, None] >= k_pos[None, :]          # (Ll, Ll)
+            s = jnp.where(mask[None, None], s, NEG_INF)
+        return s
+
+    # online softmax accumulators, derived from q so they carry the same
+    # device-varying type under shard_map (fresh constants would be
+    # "replicated" and mismatch the loop carry)
+    o = jnp.zeros_like(q32)
+    m = jnp.full_like(q32[..., :1], NEG_INF)
+    l = jnp.zeros_like(q32[..., :1])
+
+    def body(step, carry):
+        o, m, l, k_blk, v_blk = carry
+        kv_idx = (my_idx - step) % n_shards                  # whose shard we hold
+        s = scores_for(k_blk, kv_idx)                        # (B, H, Ll, Ll)
+        m_new = jnp.maximum(m, s.max(axis=-1, keepdims=True))
+        # guard fully-masked rows (m_new == NEG_INF): exp(0)=1 but l stays 0
+        alpha = jnp.exp(jnp.minimum(m - m_new, 0.0))
+        p = jnp.exp(s - m_new)
+        l = l * alpha + p.sum(axis=-1, keepdims=True)
+        o = o * alpha + jnp.einsum(
+            "bhqk,bhkd->bhqd", p, v_blk.astype(jnp.float32)
+        )
+        # rotate K/V shards around the ring (next step sees neighbor's shard)
+        perm = [(j, (j + 1) % n_shards) for j in range(n_shards)]
+        k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
+        v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
+        return o, m_new, l, k_blk, v_blk
+
+    o, m, l, _, _ = jax.lax.fori_loop(0, n_shards, body, (o, m, l, k, v))
+    out = o / jnp.maximum(l, 1e-30)
+    return out.astype(q.dtype)
+
+
+def ring_attention_sharded(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mesh,
+    *,
+    axis_name: str = "seq",
+    causal: bool = False,
+):
+    """Convenience wrapper: shard_map ``ring_attention`` with the length axis
+    of global ``(B, H, L, Dh)`` inputs sharded over ``axis_name``."""
+    from jax.sharding import PartitionSpec as P
+    from jax import shard_map
+
+    spec = P(None, None, axis_name, None)
+    fn = shard_map(
+        functools.partial(ring_attention, axis_name=axis_name, causal=causal),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+    )
+    return fn(q, k, v)
