@@ -1,0 +1,102 @@
+#!/usr/bin/env python
+"""Compare a DCML training run against the reference's shipped curves.
+
+The reference publishes no numbers; its recoverable training evidence is two
+TensorBoard CSV exports of an MO-MAT run's per-step objective means
+(``data/dcml_benchmark/momat_ct.csv`` / ``momat_payment.csv``, 800 points to
+step ~799k; BASELINE.md) and a TD3 episode-reward anchor
+(``data/dcml_td3.txt``).  Our momat runner logs the SAME quantities
+(``average_step_objective_0`` = completion-time channel,
+``average_step_objective_1`` = payment channel) to metrics.jsonl, so curves
+align directly on env steps.
+
+Usage:
+  python train_dcml.py --algorithm_name momat --experiment_name conv ...
+  python convergence_report.py results/DCML/AS/momat/conv/metrics.jsonl
+"""
+
+from __future__ import annotations
+
+import csv
+import json
+import sys
+from pathlib import Path
+
+import numpy as np
+
+DATA = Path(__file__).parent / "data" / "dcml_benchmark"
+
+
+def load_tb_csv(path: Path):
+    with open(path) as f:
+        rows = list(csv.DictReader(f))
+    steps = np.array([float(r["Step"]) for r in rows])
+    vals = np.array([float(r["Value"]) for r in rows])
+    return steps, vals
+
+
+def load_run(path: Path):
+    steps, ct, pay, rew = [], [], [], []
+    for line in open(path):
+        r = json.loads(line)
+        if "average_step_objective_0" in r:
+            steps.append(r["total_steps"])
+            ct.append(r["average_step_objective_0"])
+            pay.append(r["average_step_objective_1"])
+            rew.append(r.get("aver_episode_rewards", np.nan))
+    return np.array(steps), np.array(ct), np.array(pay), np.array(rew)
+
+
+def summarize(name, steps, vals, k=10):
+    if len(vals) == 0:
+        return f"  {name}: (no data)"
+    return (
+        f"  {name}: first {vals[0]:.3f} @ {steps[0]:.0f} | best {vals.max():.3f} | "
+        f"final(mean last {k}) {vals[-k:].mean():.3f} @ {steps[-1]:.0f}"
+    )
+
+
+def main(argv):
+    if len(argv) != 1:
+        raise SystemExit(__doc__)
+    run_path = Path(argv[0])
+    steps, ct, pay, rew = load_run(run_path)
+    b_ct_steps, b_ct = load_tb_csv(DATA / "momat_ct.csv")
+    b_pay_steps, b_pay = load_tb_csv(DATA / "momat_payment.csv")
+
+    print("== Completion-time objective (higher is better; reference best -3.125)")
+    print(summarize("reference (momat_ct.csv)", b_ct_steps, b_ct))
+    print(summarize("this run", steps, ct))
+    print("== Payment objective")
+    print(summarize("reference (momat_payment.csv)", b_pay_steps, b_pay))
+    print(summarize("this run", steps, pay))
+
+    td3_path = Path(__file__).parent / "data" / "dcml_td3.txt"
+    if td3_path.exists():
+        td3 = np.load(td3_path, allow_pickle=False).reshape(-1)
+        print("== TD3 anchor (episode rewards, unnormalized -99*delay - payment)")
+        print(f"  TD3: first {td3[0]:.0f} | mean last 50 {td3[-50:].mean():.0f}")
+        finite = rew[np.isfinite(rew)]
+        if finite.size:
+            print(f"  this run episode rewards: first {finite[0]:.0f} | "
+                  f"mean last 10 {finite[-10:].mean():.0f}")
+
+    # machine-readable summary next to the metrics file
+    out = {
+        "steps": float(steps[-1]) if len(steps) else 0,
+        "ct_best": float(ct.max()) if len(ct) else None,
+        "ct_final": float(ct[-10:].mean()) if len(ct) else None,
+        "pay_best": float(pay.max()) if len(pay) else None,
+        "pay_final": float(pay[-10:].mean()) if len(pay) else None,
+        "ref_ct_best": float(b_ct.max()),
+        "ref_ct_final": float(b_ct[-10:].mean()),
+        "ref_pay_best": float(b_pay.max()),
+        "ref_pay_final": float(b_pay[-10:].mean()),
+    }
+    summary = run_path.parent / "convergence_summary.json"
+    summary.write_text(json.dumps(out, indent=2))
+    print(f"\nwrote {summary}")
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])
