@@ -74,6 +74,17 @@ def test_ac_family_trains_on_dcml(tmp_path, algo):
     assert info["eval_inference_sec_per_call"] > 0
 
 
+def test_hatrpo_trains_on_dcml(tmp_path):
+    """TRPO natural-gradient step over the MixedRole heads: the KL-constrained
+    update must run end-to-end and keep the trust region bounded."""
+    runner = DCMLRunner(run_cfg(tmp_path, "hatrpo"), PPO, env=small_env(), log_fn=lambda *a: None)
+    state, rs = runner.setup()
+    rs, traj = runner._collect(state.params, rs)
+    state, metrics = runner._train(state, traj, runner._bootstrap(rs), jax.random.key(0))
+    assert np.isfinite(float(np.mean(metrics.value_loss)))
+    assert float(np.mean(metrics.kl)) < 0.05, "KL blew past the trust region"
+
+
 def test_happo_respects_worker_availability(tmp_path):
     runner = DCMLRunner(run_cfg(tmp_path, "happo"), PPO, env=small_env(), log_fn=lambda *a: None)
     state, rs = runner.setup()

@@ -73,8 +73,10 @@ class TestWorkerProcess:
         at = int(rng.integers(0, 20))
 
         prs = jnp.zeros(W)
+        download = jnp.full((W,), C.non_shannon_data_rate)
         delays, p0, c20, cap_period, m_slots = env._process_workers(
-            jax.random.key(seed), jnp.float32(r_wl), jnp.float32(c_wl), prs, jnp.array(trace), jnp.int32(at)
+            jax.random.key(seed), jnp.float32(r_wl), jnp.float32(c_wl), prs, jnp.array(trace), jnp.int32(at),
+            download,
         )
 
         for w in range(0, W, 17):
