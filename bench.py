@@ -23,6 +23,9 @@ Knobs (environment variables):
                         between two executables pays a per-switch cost on
                         tunneled backends, and one program per iteration is the
                         TPU-native shape anyway)
+  BENCH_INNER           scan N train iterations inside ONE jit (default 1);
+                        amortizes every dispatch/transfer — the upper bound a
+                        runner with on-device metric accumulation reaches
 """
 
 from __future__ import annotations
@@ -101,20 +104,38 @@ def _build(jax, E: int, T: int):
     collect = jax.jit(collector.collect)
     train = jax.jit(trainer.train)
 
-    def _step(train_state, rollout_state, key):
+    inner = int(os.environ.get("BENCH_INNER", "1"))
+
+    def _one(train_state, rollout_state, key):
         rollout_state, traj = collector.collect(train_state.params, rollout_state)
         train_state, metrics = trainer.train(train_state, traj, rollout_state, key)
         return train_state, rollout_state, metrics
 
-    step = jax.jit(_step)
-    return collect, train, step, train_state, rollout_state
+    if inner == 1:
+        step = jax.jit(_one)
+    else:
+        def _scanned(train_state, rollout_state, key):
+            def body(carry, k):
+                ts, rs = carry
+                ts, rs, metrics = _one(ts, rs, k)
+                return (ts, rs), metrics
+            import jax as _jax
+
+            (train_state, rollout_state), metrics = _jax.lax.scan(
+                body, (train_state, rollout_state), _jax.random.split(key, inner)
+            )
+            return train_state, rollout_state, metrics
+
+        step = jax.jit(_scanned)
+        log(f"BENCH_INNER={inner}: each dispatch runs {inner} train iterations")
+    return collect, train, step, inner, train_state, rollout_state
 
 
 def _measure(jax, E: int, T: int, iters: int, profile_dir: str | None = None,
              breakdown: bool = False, combined: bool = True) -> dict:
     """Compile + time `iters` full collect+train iterations at batch E."""
     t0 = time.perf_counter()
-    collect, train, step, train_state, rollout_state = _build(jax, E, T)
+    collect, train, step, inner, train_state, rollout_state = _build(jax, E, T)
     log(f"E={E}: built in {time.perf_counter() - t0:.1f}s, compiling...")
 
     # TWO warmup iterations: the first compiles; the second catches the
@@ -151,7 +172,7 @@ def _measure(jax, E: int, T: int, iters: int, profile_dir: str | None = None,
         jax.profiler.stop_trace()
         log(f"profile trace written to {profile_dir}")
 
-    steps = iters * E * T
+    steps = iters * inner * E * T
     result = {
         "E": E,
         "steps_per_sec": steps / elapsed,
