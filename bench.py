@@ -104,7 +104,12 @@ def _build(jax, E: int, T: int):
     collect = jax.jit(collector.collect)
     train = jax.jit(trainer.train)
 
-    inner = int(os.environ.get("BENCH_INNER", "1"))
+    inner = max(1, int(os.environ.get("BENCH_INNER", "1")))
+    if inner > 1 and os.environ.get("BENCH_COMBINED", "1") != "1":
+        # the separate-dispatch path runs one iteration per loop pass; honoring
+        # BENCH_INNER there would inflate the reported step count
+        log("BENCH_INNER ignored with BENCH_COMBINED=0")
+        inner = 1
 
     def _one(train_state, rollout_state, key):
         rollout_state, traj = collector.collect(train_state.params, rollout_state)
@@ -119,10 +124,9 @@ def _build(jax, E: int, T: int):
                 ts, rs = carry
                 ts, rs, metrics = _one(ts, rs, k)
                 return (ts, rs), metrics
-            import jax as _jax
 
-            (train_state, rollout_state), metrics = _jax.lax.scan(
-                body, (train_state, rollout_state), _jax.random.split(key, inner)
+            (train_state, rollout_state), metrics = jax.lax.scan(
+                body, (train_state, rollout_state), jax.random.split(key, inner)
             )
             return train_state, rollout_state, metrics
 
