@@ -122,6 +122,8 @@ class BaseRunner:
         acc_delay = np.zeros(E)
         acc_pay = np.zeros(E)
         done_rewards, done_delays, done_payments = [], [], []
+        # on-device accounting aggregates (collectors emitting chunk_stats)
+        agg_done = agg_rew = agg_delay = agg_pay = 0.0
 
         start = time.time()
         for episode in range(self.start_episode, episodes):
@@ -157,26 +159,38 @@ class BaseRunner:
                     step=episode,
                 )
 
-            # host-side episode metric accumulation (one device->host copy)
-            rew_arr = np.asarray(traj.rewards)                 # (T, E, A, n_obj)
-            # sum objective channels (== scalar reward), mean over agents
-            rew = rew_arr.sum(axis=3).mean(axis=2)             # (T, E)
-            has_info = traj.delays is not None
-            delays = np.asarray(traj.delays) if has_info else np.zeros_like(rew)
-            pays = np.asarray(traj.payments) if has_info else np.zeros_like(rew)
-            dones = np.asarray(traj.dones)
-            for t in range(rew.shape[0]):
-                acc_rew += rew[t]
-                acc_delay += delays[t]
-                acc_pay += pays[t]
-                finished = dones[t]
-                if finished.any():
-                    done_rewards.extend(acc_rew[finished].tolist())
-                    done_delays.extend(acc_delay[finished].tolist())
-                    done_payments.extend(acc_pay[finished].tolist())
-                    acc_rew[finished] = 0
-                    acc_delay[finished] = 0
-                    acc_pay[finished] = 0
+            stats = getattr(traj, "chunk_stats", None)
+            if stats is not None:
+                # on-device accounting: only these scalars cross to the host —
+                # the (T, E, A) reward/done tensors stay on device, which
+                # matters on tunneled backends
+                stats = {k: float(v) for k, v in jax.device_get(stats).items()}
+                agg_done += stats["n_done"]
+                agg_rew += stats["done_reward_sum"]
+                agg_delay += stats["done_delay_sum"]
+                agg_pay += stats["done_payment_sum"]
+                has_info = True
+            else:
+                # host-side episode metric accumulation (one device->host copy)
+                rew_arr = np.asarray(traj.rewards)             # (T, E, A, n_obj)
+                # sum objective channels (== scalar reward), mean over agents
+                rew = rew_arr.sum(axis=3).mean(axis=2)         # (T, E)
+                has_info = traj.delays is not None
+                delays = np.asarray(traj.delays) if has_info else np.zeros_like(rew)
+                pays = np.asarray(traj.payments) if has_info else np.zeros_like(rew)
+                dones = np.asarray(traj.dones)
+                for t in range(rew.shape[0]):
+                    acc_rew += rew[t]
+                    acc_delay += delays[t]
+                    acc_pay += pays[t]
+                    finished = dones[t]
+                    if finished.any():
+                        done_rewards.extend(acc_rew[finished].tolist())
+                        done_delays.extend(acc_delay[finished].tolist())
+                        done_payments.extend(acc_pay[finished].tolist())
+                        acc_rew[finished] = 0
+                        acc_delay[finished] = 0
+                        acc_pay[finished] = 0
 
             total_steps = (episode + 1) * run.episode_length * E
             # the first episode after a resume always logs, so every run
@@ -191,7 +205,10 @@ class BaseRunner:
                     "episode": episode,
                     "total_steps": total_steps,
                     "fps": fps,
-                    "average_step_rewards": float(rew_arr.sum(-1).mean()),
+                    "average_step_rewards": (
+                        stats["step_reward_mean"] if stats is not None
+                        else float(rew_arr.sum(-1).mean())
+                    ),
                     # stacked per-agent trainers (ippo) report per-agent
                     # metric vectors; log the mean over agents
                     "value_loss": float(np.mean(metrics.value_loss)),
@@ -200,16 +217,27 @@ class BaseRunner:
                     "grad_norm": float(np.mean(getattr(metrics, "grad_norm", 0.0))),
                     "ratio": float(np.mean(getattr(metrics, "ratio", 1.0))),
                 }
-                if rew_arr.shape[-1] > 1:
+                if stats is not None:
                     # per-objective channel means (dcml_runner.py:306-309)
-                    for i in range(rew_arr.shape[-1]):
-                        record[f"average_step_objective_{i}"] = float(rew_arr[..., i].mean())
-                if done_rewards:
-                    record["aver_episode_rewards"] = float(np.mean(done_rewards))
-                    if has_info:
-                        record["aver_episode_delays"] = float(np.mean(done_delays))
-                        record["aver_episode_payments"] = float(np.mean(done_payments))
-                    done_rewards, done_delays, done_payments = [], [], []
+                    for k, v in stats.items():
+                        if k.startswith("step_objective_"):
+                            i = k.split("_")[2]
+                            record[f"average_step_objective_{i}"] = v
+                    if agg_done > 0:
+                        record["aver_episode_rewards"] = agg_rew / agg_done
+                        record["aver_episode_delays"] = agg_delay / agg_done
+                        record["aver_episode_payments"] = agg_pay / agg_done
+                        agg_done = agg_rew = agg_delay = agg_pay = 0.0
+                else:
+                    if rew_arr.shape[-1] > 1:
+                        for i in range(rew_arr.shape[-1]):
+                            record[f"average_step_objective_{i}"] = float(rew_arr[..., i].mean())
+                    if done_rewards:
+                        record["aver_episode_rewards"] = float(np.mean(done_rewards))
+                        if has_info:
+                            record["aver_episode_delays"] = float(np.mean(done_delays))
+                            record["aver_episode_payments"] = float(np.mean(done_payments))
+                        done_rewards, done_delays, done_payments = [], [], []
                 self._extra_metrics(record)
                 self._log_record(record)
 

@@ -42,6 +42,13 @@ class Trajectory(NamedTuple):
     # boundaries (``dmo_shared_buffer.py:69`` objective_coefficients); None for
     # single-objective and static-weight MO-MAT.
     objective_coefficients: Optional[jax.Array] = None
+    # On-device episode accounting over this chunk (device->host transfer is a
+    # handful of scalars instead of the (T, E, A) reward/done tensors — which
+    # matters on tunneled backends): dict with n_done, done_reward_sum,
+    # done_delay_sum, done_payment_sum, step_reward_mean, and per-objective
+    # step means.  None when the collector predates the carry (hand-built
+    # states).
+    chunk_stats: Optional[dict] = None
 
 
 class RolloutState(NamedTuple):
@@ -55,6 +62,10 @@ class RolloutState(NamedTuple):
     mask: jax.Array              # (E, A, 1) mask entering the next chunk
     rng: jax.Array
     objective_coefficients: Optional[jax.Array] = None  # (E, n_obj), DMO only
+    # per-env running episode sums (reward, delay, payment), carried across
+    # chunks so episodes spanning chunk boundaries account correctly
+    # (dcml_runner.py:29-74 host accounting moved on-device)
+    episode_acc: Optional[jax.Array] = None             # (E, 3)
 
 
 class RolloutCollector:
@@ -112,6 +123,7 @@ class RolloutCollector:
             mask=jnp.ones((E, A, 1), jnp.float32),
             rng=key,
             objective_coefficients=coefs,
+            episode_acc=jnp.zeros((E, 3), jnp.float32),
         )
 
     def collect(self, params, rollout_state: RolloutState) -> Tuple[RolloutState, Trajectory]:
@@ -128,6 +140,17 @@ class RolloutCollector:
             next_mask = jnp.where(done_env[:, None, None], 0.0, 1.0)
             next_mask = jnp.broadcast_to(next_mask, st.mask.shape)
             reward = ts.objectives if self.n_objective > 1 else ts.reward
+
+            # on-device episode accounting: accumulate per-env sums, flush the
+            # finished episodes' totals into the chunk aggregates
+            step_vals = jnp.stack(
+                [reward.sum(-1).mean(-1), ts.delay, ts.payment], axis=-1
+            )                                                    # (E, 3)
+            acc = st.episode_acc + step_vals
+            flushed = jnp.where(done_env[:, None], acc, 0.0).sum(axis=0)   # (3,)
+            n_done = done_env.sum().astype(jnp.float32)
+            acc = jnp.where(done_env[:, None], 0.0, acc)
+
             transition = dict(
                 share_obs=st.share_obs,
                 obs=st.obs,
@@ -140,6 +163,8 @@ class RolloutCollector:
                 delay=ts.delay,
                 payment=ts.payment,
                 done=done_env,
+                _flushed=flushed,
+                _n_done=n_done,
             )
             if self.dynamic_coefficients:
                 # the weights in effect for THIS step; resample where the
@@ -158,10 +183,28 @@ class RolloutCollector:
                 mask=next_mask,
                 rng=key,
                 objective_coefficients=next_coefs,
+                episode_acc=acc,
             )
             return new_st, transition
 
+        if rollout_state.episode_acc is None:      # hand-built legacy state
+            rollout_state = rollout_state._replace(
+                episode_acc=jnp.zeros((rollout_state.obs.shape[0], 3), jnp.float32)
+            )
         final_state, tr = jax.lax.scan(body, rollout_state, None, length=self.T)
+
+        flushed = tr.pop("_flushed").sum(axis=0)            # (3,)
+        n_done = tr.pop("_n_done").sum()
+        chunk_stats = {
+            "n_done": n_done,
+            "done_reward_sum": flushed[0],
+            "done_delay_sum": flushed[1],
+            "done_payment_sum": flushed[2],
+            "step_reward_mean": tr["rewards"].sum(-1).mean(),
+        }
+        if self.n_objective > 1:
+            for i in range(self.n_objective):
+                chunk_stats[f"step_objective_{i}_mean"] = tr["rewards"][..., i].mean()
 
         masks = jnp.concatenate([rollout_state.mask[None], tr["next_mask"]], axis=0)
         active = jnp.ones_like(masks)
@@ -179,5 +222,6 @@ class RolloutCollector:
             payments=tr["payment"],
             dones=tr["done"],
             objective_coefficients=tr.get("objective_coefficients"),
+            chunk_stats=chunk_stats,
         )
         return final_state, traj
