@@ -245,7 +245,9 @@ class BaseRunner:
                 self.ckpt.save(episode, train_state)
 
             if run.use_eval and episode % run.eval_interval == 0 and hasattr(self, "evaluate"):
-                eval_info = self.evaluate(train_state, n_steps=run.episode_length)
+                # each runner's evaluate has protocol-appropriate defaults
+                # (steps for DCML/mujoco, episodes for SMAC)
+                eval_info = self.evaluate(train_state)
                 eval_info.update(episode=episode, total_steps=total_steps)
                 self.writer.write(eval_info, step=total_steps)
                 self.log(f"eval ep {episode}: {eval_info}")

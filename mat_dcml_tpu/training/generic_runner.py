@@ -26,7 +26,7 @@ from mat_dcml_tpu.training.ppo import MATTrainer, PPOConfig
 from mat_dcml_tpu.training.rollout import RolloutCollector
 
 MAT_FAMILY = ("mat", "mat_dec", "mat_encoder", "mat_decoder", "mat_gru")
-AC_FAMILY = ("mappo", "rmappo", "ippo")
+AC_FAMILY = ("mappo", "rmappo", "ippo", "happo", "hatrpo")
 SUPPORTED_ALGOS = MAT_FAMILY + AC_FAMILY
 
 
@@ -105,8 +105,63 @@ class GenericRunner(BaseRunner):
                 self.collector = IPPORolloutCollector(
                     env, self.policy, run.episode_length, use_local_value=True
                 )
+            elif run.algorithm_name in ("happo", "hatrpo"):
+                from mat_dcml_tpu.training.happo import (
+                    HAPPOConfig,
+                    HAPPORolloutCollector,
+                    HAPPOTrainer,
+                    HATRPOTrainer,
+                )
+
+                hcfg = HAPPOConfig(**ac_config_kwargs(ppo))
+                cls = HATRPOTrainer if run.algorithm_name == "hatrpo" else HAPPOTrainer
+                self.trainer = cls(self.policy, hcfg, n_agents=env.n_agents)
+                self.collector = HAPPORolloutCollector(env, self.policy, run.episode_length)
             else:
                 self.trainer = MAPPOTrainer(self.policy, mcfg)
                 self.collector = ACRolloutCollector(env, self.policy, run.episode_length)
 
         self.finalize(run, log_fn)
+
+    # ----------------------------------------------------------------- eval
+
+    def evaluate(self, train_state, n_steps: int = 100, seed: int = 0):
+        """Deterministic-policy mean step reward on fresh envs — the generic
+        in-loop eval every reference runner carries (``base_runner``/
+        ``mpe_runner`` eval loops)."""
+        import jax
+        import numpy as np
+
+        E = self.run_cfg.n_rollout_threads
+        rs = self.collector.init_state(jax.random.key(seed + 29), E)
+
+        if self.is_mat:
+            @jax.jit
+            def eval_step(params, st):
+                out = self.policy.get_actions(
+                    params, jax.random.key(0), st.share_obs, st.obs,
+                    st.available_actions, deterministic=True,
+                )
+                env_states, ts = jax.vmap(self.env.step)(st.env_states, out.action)
+                new_st = st._replace(
+                    env_states=env_states, obs=ts.obs, share_obs=ts.share_obs,
+                    available_actions=ts.available_actions,
+                )
+                return new_st, ts.reward.mean()
+        else:
+            @jax.jit
+            def eval_step(params, st):
+                out = self.collector._apply(params, jax.random.key(0), st, deterministic=True)
+                env_states, ts = jax.vmap(self.env.step)(st.env_states, out.action)
+                new_st = st._replace(
+                    env_states=env_states, obs=ts.obs, share_obs=ts.share_obs,
+                    available_actions=ts.available_actions,
+                    actor_h=out.actor_h, critic_h=out.critic_h,
+                )
+                return new_st, ts.reward.mean()
+
+        rewards = []
+        for _ in range(n_steps):
+            rs, r = eval_step(train_state.params, rs)
+            rewards.append(float(r))
+        return {"eval_average_step_rewards": float(np.mean(rewards))}

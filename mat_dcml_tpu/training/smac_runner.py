@@ -50,14 +50,19 @@ class SMACRunner(GenericRunner):
 
         @jax.jit
         def eval_step(params, st):
-            out = self.policy.get_actions(
-                params, jax.random.key(0), st.share_obs, st.obs,
-                st.available_actions, deterministic=True,
-            )
+            if self.is_mat:
+                out = self.policy.get_actions(
+                    params, jax.random.key(0), st.share_obs, st.obs,
+                    st.available_actions, deterministic=True,
+                )
+                extra = {}
+            else:
+                out = self.collector._apply(params, jax.random.key(0), st, deterministic=True)
+                extra = dict(actor_h=out.actor_h, critic_h=out.critic_h)
             env_states, ts = jax.vmap(env.step)(st.env_states, out.action)
             new_st = st._replace(
                 env_states=env_states, obs=ts.obs, share_obs=ts.share_obs,
-                available_actions=ts.available_actions,
+                available_actions=ts.available_actions, **extra,
             )
             done_env = ts.done.all(axis=1)
             return new_st, (done_env, ts.delay, ts.payment, ts.reward.mean())
