@@ -27,6 +27,7 @@ import jax
 import jax.numpy as jnp
 
 from mat_dcml_tpu.models.modules import (
+    gelu,
     DecodeBlock,
     EncodeBlock,
     GAIN_ACT,
@@ -92,7 +93,7 @@ class ObsEncoder(nn.Module):
     def __call__(self, x: jax.Array) -> jax.Array:
         x = nn.LayerNorm(dtype=self.dtype)(x)
         x = dense(self.n_embd, gain=GAIN_ACT, dtype=self.dtype)(x)
-        return nn.gelu(x)
+        return gelu(x)
 
 
 class Head(nn.Module):
@@ -108,7 +109,7 @@ class Head(nn.Module):
     def __call__(self, x: jax.Array) -> jax.Array:
         x = x.astype(jnp.float32)
         x = dense(self.n_embd, gain=GAIN_ACT)(x)
-        x = nn.gelu(x)
+        x = gelu(x)
         x = nn.LayerNorm()(x)
         return dense(self.out_dim)(x)
 
@@ -146,9 +147,9 @@ class DecActorMlp(nn.Module):
     @nn.compact
     def __call__(self, obs: jax.Array) -> jax.Array:
         x = nn.LayerNorm()(obs)
-        x = nn.gelu(dense(self.n_embd, gain=GAIN_ACT)(x))
+        x = gelu(dense(self.n_embd, gain=GAIN_ACT)(x))
         x = nn.LayerNorm()(x)
-        x = nn.gelu(dense(self.n_embd, gain=GAIN_ACT)(x))
+        x = gelu(dense(self.n_embd, gain=GAIN_ACT)(x))
         x = nn.LayerNorm()(x)
         return dense(self.action_dim)(x)
 
@@ -190,8 +191,8 @@ class Decoder(nn.Module):
 
     def _embed_action(self, shifted_action: jax.Array) -> jax.Array:
         if self.cfg.action_type in (DISCRETE, SEMI_DISCRETE):
-            return nn.gelu(self.action_encoder_nobias(shifted_action))
-        return nn.gelu(self.action_encoder_bias(shifted_action))
+            return gelu(self.action_encoder_nobias(shifted_action))
+        return gelu(self.action_encoder_bias(shifted_action))
 
     def __call__(self, shifted_action: jax.Array, obs_rep: jax.Array, obs: jax.Array) -> jax.Array:
         """Full teacher-forced pass -> ``(B, n_agent, action_dim)`` logits."""

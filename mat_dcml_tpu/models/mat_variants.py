@@ -32,7 +32,7 @@ from mat_dcml_tpu.models.mat import (
     Head,
     ObsEncoder,
 )
-from mat_dcml_tpu.models.modules import DecodeBlock, EncodeBlock, dense, GAIN_ACT, init_decode_cache
+from mat_dcml_tpu.models.modules import gelu, DecodeBlock, EncodeBlock, dense, GAIN_ACT, init_decode_cache
 from mat_dcml_tpu.ops import distributions as D
 
 
@@ -141,7 +141,7 @@ class MultiAgentDecoderModel(nn.Module):
 
     def _embed_action(self, a):
         enc = self.action_encoder_nobias if self.cfg.action_type == DISCRETE else self.action_encoder_bias
-        return nn.gelu(enc(a))
+        return gelu(enc(a))
 
     def __call__(self, shifted_action: jax.Array, obs: jax.Array):
         """Full pass -> (logits, values); cross-attention keys on obs
@@ -320,7 +320,7 @@ class MultiAgentGRUModel(nn.Module):
 
     def _embed_action(self, a):
         enc = self.action_encoder_nobias if self.cfg.action_type == DISCRETE else self.action_encoder_bias
-        return nn.gelu(enc(a))
+        return gelu(enc(a))
 
     def decode_full(self, shifted_action, obs_rep, obs):
         del obs

@@ -20,6 +20,12 @@ GAIN_ACT = math.sqrt(2.0)  # torch nn.init.calculate_gain('relu')
 GAIN_OUT = 0.01
 
 
+def gelu(x):
+    """Exact (erf) GELU — torch's nn.GELU default; flax's nn.gelu defaults to
+    the tanh approximation, which diverges from the reference by ~1e-3."""
+    return jax.nn.gelu(x, approximate=False)
+
+
 def dense(features: int, gain: float = GAIN_OUT, use_bias: bool = True,
           dtype=None) -> nn.Dense:
     """``dtype``: computation dtype (params stay float32 — flax param_dtype
@@ -88,7 +94,7 @@ class MlpBlock(nn.Module):
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
         x = dense(self.n_embd, gain=GAIN_ACT, dtype=self.dtype)(x)
-        x = nn.gelu(x)
+        x = gelu(x)
         return dense(self.n_embd, dtype=self.dtype)(x)
 
 

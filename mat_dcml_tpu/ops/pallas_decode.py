@@ -187,7 +187,7 @@ def _decode_step_kernel(
 
     # action embed + gelu + LN (Decoder._embed_action + ln)
     x = x_ref[:].astype(dtype) @ embed_w_ref[:].astype(dtype) + embed_b_ref[:].astype(dtype)
-    x = jax.nn.gelu(x)
+    x = jax.nn.gelu(x, approximate=False)
     x = _layer_norm(x, ln0_ref[0], ln0_ref[1])
     rep = rep_ref[:].astype(dtype)                        # (TB, D)
 
@@ -224,7 +224,7 @@ def _decode_step_kernel(
         h2 = _layer_norm(rep + y2, lns[2], lns[3])
 
         # ---- MLP + residual
-        m = jax.nn.gelu(h2 @ mlp_w1_ref[b].astype(dtype) + mlp_b1_ref[b].astype(dtype))
+        m = jax.nn.gelu(h2 @ mlp_w1_ref[b].astype(dtype) + mlp_b1_ref[b].astype(dtype), approximate=False)
         m = m @ mlp_w2_ref[b].astype(dtype) + mlp_b2_ref[b].astype(dtype)
         # block output becomes the next block's self-attn stream; `rep` stays
         # the ENCODER representation for every block (Decoder.decode_step)
@@ -232,7 +232,7 @@ def _decode_step_kernel(
 
     # ---- f32 head (models/mat.py Head)
     t = x.astype(jnp.float32) @ head_w1_ref[:].astype(jnp.float32) + head_b1_ref[:].astype(jnp.float32)
-    t = jax.nn.gelu(t)
+    t = jax.nn.gelu(t, approximate=False)
     t = _layer_norm(t, head_ln_ref[0], head_ln_ref[1])
     logits_ref[:] = t @ head_w2_ref[:] + head_b2_ref[:]
 
