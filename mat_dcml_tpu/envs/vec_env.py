@@ -91,7 +91,16 @@ def _worker_loop(remote, parent_remote, wrapped_fns: CloudpickleWrapper):
             if cmd == "step":
                 remote.send([_step_one(env, a) for env, a in zip(envs, data)])
             elif cmd == "reset":
-                remote.send([env.reset() for env in envs])
+                # data: per-env reset arguments, or None — the reference's
+                # "Choose" family (reset-with-argument for preset/turn-based
+                # envs, env_wrappers.py:437-667) folded into one command
+                if data is None:
+                    remote.send([env.reset() for env in envs])
+                else:
+                    remote.send([
+                        env.reset() if arg is None else env.reset(arg)
+                        for env, arg in zip(envs, data)
+                    ])
             elif cmd == "spaces":
                 e = envs[0]
                 remote.send((e.n_agents, e.obs_dim, e.share_obs_dim, e.action_dim))
@@ -121,7 +130,10 @@ def _stack_step(results):
 
 
 class ShareVecEnv:
-    """Common interface: ``reset() -> (E, A, ·) numpy``, ``step(actions)``."""
+    """Common interface: ``reset(reset_args=None) -> (E, A, ·) numpy``,
+    ``step(actions)``.  ``reset_args`` is an optional per-env argument list —
+    the reference's "Choose" variants (``env_wrappers.py:437-667``) as a
+    parameter instead of four more classes."""
 
     n_envs: int
     n_agents: int
@@ -129,7 +141,7 @@ class ShareVecEnv:
     share_obs_dim: int
     action_dim: int
 
-    def reset(self):
+    def reset(self, reset_args=None):
         raise NotImplementedError
 
     def step(self, actions: np.ndarray):
@@ -150,8 +162,13 @@ class ShareDummyVecEnv(ShareVecEnv):
         self.n_agents, self.obs_dim = e.n_agents, e.obs_dim
         self.share_obs_dim, self.action_dim = e.share_obs_dim, e.action_dim
 
-    def reset(self):
-        return _stack_reset([env.reset() for env in self.envs])
+    def reset(self, reset_args=None):
+        if reset_args is None:
+            return _stack_reset([env.reset() for env in self.envs])
+        return _stack_reset([
+            env.reset() if arg is None else env.reset(arg)
+            for env, arg in zip(self.envs, reset_args)
+        ])
 
     def step(self, actions: np.ndarray):
         return _stack_step([_step_one(env, a) for env, a in zip(self.envs, actions)])
@@ -189,9 +206,12 @@ class ShareSubprocVecEnv(ShareVecEnv):
         self.n_agents, self.obs_dim, self.share_obs_dim, self.action_dim = self.remotes[0].recv()
         self._closed = False
 
-    def reset(self):
-        for remote in self.remotes:
-            remote.send(("reset", None))
+    def reset(self, reset_args=None):
+        start = 0
+        for remote, k in zip(self.remotes, self._chunk_sizes):
+            chunk = None if reset_args is None else list(reset_args[start : start + k])
+            remote.send(("reset", chunk))
+            start += k
         results: List = []
         for remote in self.remotes:
             results.extend(remote.recv())

@@ -125,6 +125,21 @@ def test_subproc_matches_dummy():
         sub.close()
 
 
+def test_reset_with_arguments():
+    """The reference's Choose-family reset-with-argument
+    (``env_wrappers.py:437-667``) as a ``reset(reset_args=...)`` parameter."""
+
+    class ChooseEnv(CountdownEnv):
+        def reset(self, start=0):
+            self.t = int(start)
+            obs = np.full((self.n_agents, 1), self.t, np.float32)
+            return obs, obs.copy(), np.ones((self.n_agents, self.action_dim), np.float32)
+
+    vec = ShareDummyVecEnv([ChooseEnv for _ in range(3)])
+    obs, _, _ = vec.reset(reset_args=[5, None, 7])
+    assert obs[0, 0, 0] == 5 and obs[1, 0, 0] == 0 and obs[2, 0, 0] == 7
+
+
 def test_auto_reset_inside_worker():
     vec = ShareDummyVecEnv([lambda: CountdownEnv(horizon=3) for _ in range(2)])
     obs, _, _ = vec.reset()
