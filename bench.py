@@ -201,6 +201,38 @@ def _measure(jax, E: int, T: int, iters: int, profile_dir: str | None = None,
     return result
 
 
+def _is_oom(e: Exception) -> bool:
+    s = f"{type(e).__name__}: {e}"
+    return "RESOURCE_EXHAUSTED" in s or "Out of memory" in s or "out of memory" in s
+
+
+def _measure_safe(jax, E: int, T: int, iters: int, **kw) -> dict | None:
+    """_measure, returning None instead of dying on device OOM.
+
+    The bench must print a number on whatever chip the driver gives it —
+    a v4 fits E=2048 (T=50, 4 minibatches) but a v5-lite (16G HBM) does not,
+    and an OOM crash here would ship a round with no performance evidence.
+    """
+    import gc
+
+    try:
+        return _measure(jax, E, T, iters, **kw)
+    except Exception as e:  # noqa: BLE001 — classified below
+        if not _is_oom(e):
+            raise
+        log(f"E={E}: device OOM ({type(e).__name__}); backing off")
+        if kw.get("profile_dir"):
+            # the OOM may have fired between start_trace and stop_trace;
+            # a dangling trace would make the retry's start_trace raise
+            try:
+                jax.profiler.stop_trace()
+            except Exception:
+                pass
+        jax.clear_caches()
+        gc.collect()
+        return None
+
+
 def main() -> None:
     E = int(os.environ.get("BENCH_N_ENVS", "2048"))
     T = int(os.environ.get("BENCH_EPISODE_LENGTH", "50"))
@@ -224,16 +256,26 @@ def main() -> None:
             env_list = [e for e in env_list if e <= 128] or [32]
         results = [
             # profile the largest (last) sweep entry if a trace was requested
-            _measure(jax, e, T, ITERS, breakdown=breakdown, combined=combined,
-                     profile_dir=profile_dir if e == env_list[-1] else None)
+            _measure_safe(jax, e, T, ITERS, breakdown=breakdown, combined=combined,
+                          profile_dir=profile_dir if e == env_list[-1] else None)
             for e in env_list
         ]
+        results = [r for r in results if r is not None]
+        if not results:
+            raise SystemExit("every sweep batch size OOMed")
         best = max(results, key=lambda r: r["steps_per_sec"])
         log("sweep results: " + json.dumps(results))
         steps_per_sec = best["steps_per_sec"]
     else:
-        res = _measure(jax, E, T, ITERS, profile_dir=profile_dir,
-                       breakdown=breakdown, combined=combined)
+        res = None
+        while res is None:
+            res = _measure_safe(jax, E, T, ITERS, profile_dir=profile_dir,
+                                breakdown=breakdown, combined=combined)
+            if res is None:
+                if E <= 32:
+                    raise SystemExit("OOM even at E=32")
+                E //= 2
+                log(f"retrying at E={E}")
         steps_per_sec = res["steps_per_sec"]
 
     print(

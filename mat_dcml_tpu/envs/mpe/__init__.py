@@ -1,3 +1,8 @@
+from mat_dcml_tpu.envs.mpe.simple_adversary import (
+    SimpleAdversaryConfig,
+    SimpleAdversaryEnv,
+)
+from mat_dcml_tpu.envs.mpe.simple_push import SimplePushConfig, SimplePushEnv
 from mat_dcml_tpu.envs.mpe.simple_speaker_listener import (
     SimpleSpeakerListenerEnv,
     SpeakerListenerConfig,
@@ -8,19 +13,29 @@ from mat_dcml_tpu.envs.mpe.simple_spread import (
     SpreadState,
     SpreadTimeStep,
 )
+from mat_dcml_tpu.envs.mpe.simple_tag import SimpleTagConfig, SimpleTagEnv
 
 # scenario registry (reference: mat/envs/mpe/scenarios/__init__.py load());
 # simple_spread is the one used by the shipped MPE training recipe
 SCENARIOS = {
     "simple_spread": (SimpleSpreadEnv, SimpleSpreadConfig),
     "simple_speaker_listener": (SimpleSpeakerListenerEnv, SpeakerListenerConfig),
+    "simple_tag": (SimpleTagEnv, SimpleTagConfig),
+    "simple_adversary": (SimpleAdversaryEnv, SimpleAdversaryConfig),
+    "simple_push": (SimplePushEnv, SimplePushConfig),
 }
 
 __all__ = [
+    "SimpleAdversaryConfig",
+    "SimpleAdversaryEnv",
+    "SimplePushConfig",
+    "SimplePushEnv",
     "SimpleSpeakerListenerEnv",
     "SpeakerListenerConfig",
     "SimpleSpreadConfig",
     "SimpleSpreadEnv",
+    "SimpleTagConfig",
+    "SimpleTagEnv",
     "SpreadState",
     "SpreadTimeStep",
     "SCENARIOS",

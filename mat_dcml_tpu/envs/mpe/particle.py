@@ -1,0 +1,105 @@
+"""Shared pure-JAX MPE particle physics.
+
+Vectorized rewrite of the reference's per-object physics loop
+(``mat_src/mat/envs/mpe/core.py:224-279`` force gathering + integration and
+``environment.py:240-265`` action decode) used by every scenario env in this
+package.  Entities are rows of flat arrays (positions ``(E, 2)``, static
+per-entity parameters as ``(E,)`` constants baked into the jitted program),
+so the O(E²) collision response becomes one broadcasted pairwise expression
+instead of the reference's nested Python loop.
+
+Faithful quirks preserved:
+
+- ``accel`` is applied TWICE in the reference — once as the action
+  "sensitivity" (``environment.py:261-263``) and once as the force gain
+  ``mass * accel`` (``core.py:237``) — so an agent with ``accel=a`` feels
+  force ``a²·u`` while an accel-less agent feels ``5·u`` (mass 1).
+- Collision force uses softmax penetration
+  ``k·logaddexp(0, -(dist - dist_min)/k)`` (``core.py:315-317``) between
+  every pair where both entities collide and the receiver is movable.
+- Velocity is damped before the force is applied, then speed-clamped to
+  ``max_speed`` (``core.py:265-279``).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+DT = 0.1
+DAMPING = 0.25
+CONTACT_FORCE = 1e2
+CONTACT_MARGIN = 1e-3
+
+
+def decode_move(onehot5: jax.Array) -> jax.Array:
+    """Discrete(5) one-hot rows -> raw 2-D force direction (pre-gain).
+
+    Action layout no-op/+x/-x/+y/-y per ``environment.py:249-264``
+    (discrete_action_space branch): ``u = (a1-a2, a3-a4)``.
+    """
+    return jnp.stack(
+        [onehot5[..., 1] - onehot5[..., 2], onehot5[..., 3] - onehot5[..., 4]],
+        axis=-1,
+    )
+
+
+def force_gain(accel: float | None) -> float:
+    """Effective scalar multiplying the raw move direction (see module doc)."""
+    return accel * accel if accel is not None else 5.0
+
+
+def collision_forces(
+    pos: jax.Array,
+    sizes: jax.Array,
+    collide: jax.Array,
+    movable: jax.Array,
+    contact_force: float = CONTACT_FORCE,
+    contact_margin: float = CONTACT_MARGIN,
+) -> jax.Array:
+    """Pairwise contact forces on every entity (``core.py:241-263,310-322``).
+
+    pos: (E, 2); sizes/collide/movable: (E,) static entity parameters.
+    Returns (E, 2) summed force on each entity.  All reference scenarios use
+    unit masses, so the movable/movable mass ratio (``core.py:318-321``) is 1.
+    """
+    delta = pos[:, None, :] - pos[None, :, :]                 # (E, E, 2)
+    dist = jnp.sqrt(jnp.sum(delta**2, axis=-1) + 1e-12)
+    dist_min = sizes[:, None] + sizes[None, :]
+    k = contact_margin
+    penetration = jnp.logaddexp(0.0, -(dist - dist_min) / k) * k
+    mag = contact_force * penetration / dist                   # (E, E)
+    pair = collide[:, None] & collide[None, :] & ~jnp.eye(pos.shape[0], dtype=bool)
+    mag = jnp.where(pair, mag, 0.0)
+    # receiver must be movable; non-movable entities absorb without moving
+    return (delta * mag[..., None]).sum(axis=1) * movable[:, None]
+
+
+def integrate(
+    vel: jax.Array,
+    force: jax.Array,
+    max_speed: jax.Array,
+    dt: float = DT,
+    damping: float = DAMPING,
+) -> jax.Array:
+    """Damped Euler velocity update + per-entity speed clamp (``core.py:265-279``).
+
+    max_speed: (E,) with ``inf`` for unclamped entities.
+    """
+    vel = vel * (1.0 - damping) + force * dt
+    speed = jnp.sqrt(jnp.sum(vel**2, axis=-1) + 1e-12)
+    scale = jnp.minimum(1.0, max_speed / speed)
+    return vel * scale[:, None]
+
+
+def bound_penalty(pos: jax.Array) -> jax.Array:
+    """Per-agent screen-exit penalty (``scenarios/simple_tag.py:100-108``).
+
+    pos: (..., 2).  Sums the per-dimension piecewise bound() term:
+    0 below 0.9, linear ramp to 1.0, then exp(2x-2) capped at 10.
+    """
+    x = jnp.abs(pos)
+    ramp = (x - 0.9) * 10.0
+    expo = jnp.minimum(jnp.exp(2.0 * x - 2.0), 10.0)
+    per_dim = jnp.where(x < 0.9, 0.0, jnp.where(x < 1.0, ramp, expo))
+    return per_dim.sum(axis=-1)

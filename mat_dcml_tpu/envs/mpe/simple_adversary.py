@@ -1,0 +1,167 @@
+"""Pure-JAX MPE ``simple_adversary`` (physical deception).
+
+Reference: ``mat_src/mat/envs/mpe/scenarios/simple_adversary.py``.  One
+adversary (agent 0) and ``n_agents-1`` good agents move among
+``n_agents-1`` landmarks, one of which is the secret goal.  Good agents
+know the goal and try to cover it while the adversary — who cannot see
+which landmark is the goal — infers it from their behavior.
+
+Faithful semantics:
+
+- No collisions, no accel/max_speed; all agents size 0.15, landmarks 0.08
+  (``simple_adversary.py:17-31``); agents AND landmarks spawn at
+  ``U(-1,1)²`` (``:45-52`` — landmarks are NOT shrunk by 0.8 here, unlike
+  spread/tag); goal is a uniformly chosen landmark (``:41-44``).
+- Per-agent rewards (non-collaborative): good agents all receive
+  ``-min_a |a_good - goal| + Σ_adv |adv - goal|`` (shaped variant,
+  ``:86-107``); the adversary receives ``-|adv - goal|²`` (squared
+  distance, ``:109-117``).
+- Obs: good ``[goal_rel(2), landmark_rel(2L), other_pos(2(N-1))]``;
+  adversary ``[landmark_rel(2L), other_pos(2(N-1))]`` zero-padded to the
+  good width (``:119-137``); one-hot id appended (``environment.py:140-142``).
+  Note no velocity features in this scenario.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from mat_dcml_tpu.envs.mpe import particle
+
+
+class AdversaryState(NamedTuple):
+    rng: jax.Array
+    agent_pos: jax.Array      # (N, 2), adversary first
+    agent_vel: jax.Array      # (N, 2)
+    landmark_pos: jax.Array   # (L, 2)
+    goal: jax.Array           # () int32 landmark index
+    t: jax.Array
+
+
+class AdversaryTimeStep(NamedTuple):
+    obs: jax.Array
+    share_obs: jax.Array
+    available_actions: jax.Array
+    reward: jax.Array
+    done: jax.Array
+    delay: jax.Array
+    payment: jax.Array
+
+
+@dataclasses.dataclass(frozen=True)
+class SimpleAdversaryConfig:
+    n_agents: int = 3         # 1 adversary + 2 good (train_mpe num_agents)
+    episode_length: int = 25
+    agent_size: float = 0.15
+    landmark_size: float = 0.08
+
+    @property
+    def n_landmarks(self) -> int:
+        return self.n_agents - 1  # simple_adversary.py:16
+
+    def __post_init__(self):
+        if self.n_agents < 2:
+            raise ValueError("simple_adversary needs >= 2 agents")
+
+
+class SimpleAdversaryEnv:
+    """Functional env bundle; same TimeStep protocol as simple_spread."""
+
+    N_ADVERSARIES = 1
+
+    def __init__(self, cfg: SimpleAdversaryConfig = SimpleAdversaryConfig()):
+        self.cfg = cfg
+        N, L = cfg.n_agents, cfg.n_landmarks
+        self.n_agents = N
+        self._core_dim = 2 + 2 * L + 2 * (N - 1)  # good row is the widest
+        self.obs_dim = self._core_dim + N
+        self.share_obs_dim = self.obs_dim * N
+        self.action_dim = 5
+
+    def _spawn(self, key: jax.Array) -> AdversaryState:
+        c = self.cfg
+        key, k_a, k_l, k_g = jax.random.split(key, 4)
+        return AdversaryState(
+            rng=key,
+            agent_pos=jax.random.uniform(k_a, (c.n_agents, 2), minval=-1.0, maxval=1.0),
+            agent_vel=jnp.zeros((c.n_agents, 2)),
+            landmark_pos=jax.random.uniform(k_l, (c.n_landmarks, 2), minval=-1.0, maxval=1.0),
+            goal=jax.random.randint(k_g, (), 0, c.n_landmarks),
+            t=jnp.zeros((), jnp.int32),
+        )
+
+    def reset(self, key: jax.Array, episode_idx=0) -> Tuple[AdversaryState, AdversaryTimeStep]:
+        del episode_idx
+        st = self._spawn(key)
+        obs, share, avail = self._observe(st)
+        N = self.cfg.n_agents
+        zero = jnp.zeros(())
+        return st, AdversaryTimeStep(
+            obs, share, avail, jnp.zeros((N, 1)), jnp.zeros((N,), bool), zero, zero
+        )
+
+    def step(self, st: AdversaryState, action: jax.Array) -> Tuple[AdversaryState, AdversaryTimeStep]:
+        c = self.cfg
+        N = c.n_agents
+        act = action.reshape(N, -1)
+        onehot = (
+            jax.nn.one_hot(act[:, 0].astype(jnp.int32), 5)
+            if act.shape[-1] == 1 else act.astype(jnp.float32)
+        )
+        u = particle.decode_move(onehot) * particle.force_gain(None)
+        vel = particle.integrate(st.agent_vel, u, jnp.full((N,), jnp.inf))
+        pos = st.agent_pos + vel * particle.DT
+
+        stepped = AdversaryState(st.rng, pos, vel, st.landmark_pos, st.goal, st.t + 1)
+        reward = self._reward(stepped)
+        done_now = stepped.t >= c.episode_length
+
+        fresh = self._spawn(st.rng)
+        new_st = jax.tree.map(lambda a, b: jnp.where(done_now, a, b), fresh, stepped)
+        obs, share, avail = self._observe(new_st)
+        zero = jnp.zeros(())
+        return new_st, AdversaryTimeStep(
+            obs, share, avail, reward[:, None],
+            jnp.broadcast_to(done_now, (N,)), zero, zero,
+        )
+
+    def _reward(self, st: AdversaryState) -> jax.Array:
+        goal_pos = st.landmark_pos[st.goal]
+        adv_pos = st.agent_pos[: self.N_ADVERSARIES]
+        good_pos = st.agent_pos[self.N_ADVERSARIES:]
+        good_d = jnp.linalg.norm(good_pos - goal_pos, axis=-1)
+        adv_d = jnp.linalg.norm(adv_pos - goal_pos, axis=-1)
+        good_rew = -good_d.min() + adv_d.sum()
+        adv_rew = -jnp.sum((adv_pos - goal_pos) ** 2, axis=-1)  # squared
+        return jnp.concatenate(
+            [adv_rew, jnp.full((self.cfg.n_agents - 1,), good_rew)]
+        )
+
+    def _observe(self, st: AdversaryState):
+        c = self.cfg
+        N = c.n_agents
+        idx = jnp.arange(N)
+        landmark_rel = (
+            st.landmark_pos[None, :, :] - st.agent_pos[:, None, :]
+        ).reshape(N, -1)
+        rel = st.agent_pos[None, :, :] - st.agent_pos[:, None, :]
+        goal_rel = st.landmark_pos[st.goal][None, :] - st.agent_pos  # (N, 2)
+
+        def row(i):
+            others = jnp.where(idx != i, size=N - 1)[0]
+            other_pos = rel[i][others].reshape(-1)
+            good = jnp.concatenate([goal_rel[i], landmark_rel[i], other_pos])
+            adv = jnp.concatenate(
+                [landmark_rel[i], other_pos, jnp.zeros((2,))]
+            )
+            return jnp.where(i < self.N_ADVERSARIES, adv, good)
+
+        core = jax.vmap(row)(idx)
+        obs = jnp.concatenate([core, jnp.eye(N)], axis=1)
+        share = jnp.broadcast_to(obs.reshape(-1), (N, self.share_obs_dim))
+        avail = jnp.ones((N, self.action_dim))
+        return obs, share, avail

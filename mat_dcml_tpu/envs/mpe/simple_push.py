@@ -1,0 +1,181 @@
+"""Pure-JAX MPE ``simple_push`` (keep-away).
+
+Reference: ``mat_src/mat/envs/mpe/scenarios/simple_push.py``.  One good
+agent tries to reach the goal landmark; one adversary is rewarded for
+keeping it away (by shoving — agents collide).  Landmark colors encode
+the goal identity in the good agent's observation.
+
+Faithful semantics:
+
+- Agent 0 is the adversary (``simple_push.py:20-29``); agents collide
+  (default size 0.05, unit mass), landmarks don't (``:30-35``); agents at
+  ``U(-1,1)²``, landmarks at ``0.8·U(-1,1)²``, goal uniform (``:41-64``).
+- Per-agent rewards: good ``-|pos - goal|``; adversary
+  ``min_good |good - goal| - |adv - goal|`` (``:66-81``).
+- Obs: good ``[vel(2), goal_rel(2), agent_color(3), landmark_rel(2L),
+  landmark_colors(3L), other_pos(2(N-1))]``; adversary
+  ``[vel(2), landmark_rel(2L), other_pos(2(N-1))]`` zero-padded
+  (``:83-105``).  Landmark i's color is ``[0.1,0.1,0.1]`` with channel
+  ``i+1`` += 0.8 (``:42-46``); the good agent's color marks the goal index
+  with channel ``goal+1`` += 0.5 on ``[0.25]*3`` (``:48-56``) — both are
+  computed, not stored.  One-hot id appended (``environment.py:140-142``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from mat_dcml_tpu.envs.mpe import particle
+
+
+class PushState(NamedTuple):
+    rng: jax.Array
+    agent_pos: jax.Array      # (N, 2), adversary first
+    agent_vel: jax.Array
+    landmark_pos: jax.Array   # (L, 2)
+    goal: jax.Array           # () int32
+    t: jax.Array
+
+
+class PushTimeStep(NamedTuple):
+    obs: jax.Array
+    share_obs: jax.Array
+    available_actions: jax.Array
+    reward: jax.Array
+    done: jax.Array
+    delay: jax.Array
+    payment: jax.Array
+
+
+@dataclasses.dataclass(frozen=True)
+class SimplePushConfig:
+    n_agents: int = 2         # 1 adversary + 1 good (simple_push.py:16-17)
+    n_landmarks: int = 2
+    episode_length: int = 25
+    agent_size: float = 0.05  # Entity default (core.py:49-53)
+    landmark_size: float = 0.05
+
+    def __post_init__(self):
+        if self.n_agents < 2:
+            raise ValueError("simple_push needs >= 2 agents")
+
+
+class SimplePushEnv:
+    """Functional env bundle; same TimeStep protocol as simple_spread."""
+
+    N_ADVERSARIES = 1
+
+    def __init__(self, cfg: SimplePushConfig = SimplePushConfig()):
+        self.cfg = cfg
+        N, L = cfg.n_agents, cfg.n_landmarks
+        self.n_agents = N
+        # good row: vel2 + goal_rel2 + color3 + 2L + 3L + 2(N-1)
+        self._core_dim = 7 + 5 * L + 2 * (N - 1)
+        self.obs_dim = self._core_dim + N
+        self.share_obs_dim = self.obs_dim * N
+        self.action_dim = 5
+        self._sizes = jnp.asarray([cfg.agent_size] * N + [cfg.landmark_size] * L)
+        self._collide = jnp.asarray([True] * N + [False] * L)
+        self._movable = jnp.asarray([True] * N + [False] * L)
+
+    def _spawn(self, key: jax.Array) -> PushState:
+        c = self.cfg
+        key, k_a, k_l, k_g = jax.random.split(key, 4)
+        return PushState(
+            rng=key,
+            agent_pos=jax.random.uniform(k_a, (c.n_agents, 2), minval=-1.0, maxval=1.0),
+            agent_vel=jnp.zeros((c.n_agents, 2)),
+            landmark_pos=0.8 * jax.random.uniform(k_l, (c.n_landmarks, 2), minval=-1.0, maxval=1.0),
+            goal=jax.random.randint(k_g, (), 0, c.n_landmarks),
+            t=jnp.zeros((), jnp.int32),
+        )
+
+    def reset(self, key: jax.Array, episode_idx=0) -> Tuple[PushState, PushTimeStep]:
+        del episode_idx
+        st = self._spawn(key)
+        obs, share, avail = self._observe(st)
+        N = self.cfg.n_agents
+        zero = jnp.zeros(())
+        return st, PushTimeStep(
+            obs, share, avail, jnp.zeros((N, 1)), jnp.zeros((N,), bool), zero, zero
+        )
+
+    def step(self, st: PushState, action: jax.Array) -> Tuple[PushState, PushTimeStep]:
+        c = self.cfg
+        N = c.n_agents
+        act = action.reshape(N, -1)
+        onehot = (
+            jax.nn.one_hot(act[:, 0].astype(jnp.int32), 5)
+            if act.shape[-1] == 1 else act.astype(jnp.float32)
+        )
+        u = particle.decode_move(onehot) * particle.force_gain(None)
+        entity_pos = jnp.concatenate([st.agent_pos, st.landmark_pos])
+        coll = particle.collision_forces(
+            entity_pos, self._sizes, self._collide, self._movable
+        )[:N]
+        vel = particle.integrate(st.agent_vel, u + coll, jnp.full((N,), jnp.inf))
+        pos = st.agent_pos + vel * particle.DT
+
+        stepped = PushState(st.rng, pos, vel, st.landmark_pos, st.goal, st.t + 1)
+        reward = self._reward(stepped)
+        done_now = stepped.t >= c.episode_length
+
+        fresh = self._spawn(st.rng)
+        new_st = jax.tree.map(lambda a, b: jnp.where(done_now, a, b), fresh, stepped)
+        obs, share, avail = self._observe(new_st)
+        zero = jnp.zeros(())
+        return new_st, PushTimeStep(
+            obs, share, avail, reward[:, None],
+            jnp.broadcast_to(done_now, (N,)), zero, zero,
+        )
+
+    def _reward(self, st: PushState) -> jax.Array:
+        A = self.N_ADVERSARIES
+        goal_pos = st.landmark_pos[st.goal]
+        adv_pos = st.agent_pos[:A]
+        good_pos = st.agent_pos[A:]
+        good_d = jnp.linalg.norm(good_pos - goal_pos, axis=-1)
+        adv_d = jnp.linalg.norm(adv_pos - goal_pos, axis=-1)
+        return jnp.concatenate([good_d.min() - adv_d, -good_d])
+
+    def _observe(self, st: PushState):
+        c = self.cfg
+        N, L = c.n_agents, c.n_landmarks
+        idx = jnp.arange(N)
+        landmark_rel = (
+            st.landmark_pos[None, :, :] - st.agent_pos[:, None, :]
+        ).reshape(N, -1)
+        rel = st.agent_pos[None, :, :] - st.agent_pos[:, None, :]
+        goal_rel = st.landmark_pos[st.goal][None, :] - st.agent_pos
+        # landmark colors: [0.1,0.1,0.1] + 0.8 on channel i+1 (simple_push.py:42-46)
+        lm_colors = (
+            jnp.full((L, 3), 0.1)
+            .at[jnp.arange(L), jnp.minimum(jnp.arange(L) + 1, 2)]
+            .add(0.8)
+            .reshape(-1)
+        )
+        # good agent color marks the goal: [0.25]*3 + 0.5 on channel goal+1
+        agent_color = jnp.full((3,), 0.25).at[jnp.minimum(st.goal + 1, 2)].add(0.5)
+
+        def row(i):
+            others = jnp.where(idx != i, size=N - 1)[0]
+            other_pos = rel[i][others].reshape(-1)
+            good = jnp.concatenate(
+                [st.agent_vel[i], goal_rel[i], agent_color, landmark_rel[i],
+                 lm_colors, other_pos]
+            )
+            adv_pad = self._core_dim - (2 + 2 * L + 2 * (N - 1))
+            adv = jnp.concatenate(
+                [st.agent_vel[i], landmark_rel[i], other_pos, jnp.zeros((adv_pad,))]
+            )
+            return jnp.where(i < self.N_ADVERSARIES, adv, good)
+
+        core = jax.vmap(row)(idx)
+        obs = jnp.concatenate([core, jnp.eye(N)], axis=1)
+        share = jnp.broadcast_to(obs.reshape(-1), (N, self.share_obs_dim))
+        avail = jnp.ones((N, self.action_dim))
+        return obs, share, avail

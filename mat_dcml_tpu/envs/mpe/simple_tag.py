@@ -1,0 +1,196 @@
+"""Pure-JAX MPE ``simple_tag`` (predator–prey).
+
+Reference: ``mat_src/mat/envs/mpe/scenarios/simple_tag.py`` on the
+``core.py`` physics.  ``n_adversaries`` slow red predators chase ``n_good``
+faster green prey around ``n_landmarks`` large immovable obstacles.
+
+Faithful semantics (scenario file:line cites into the reference):
+
+- Agent order: adversaries first (``simple_tag.py:17-23``); adversary
+  size/accel/max_speed 0.075/3.0/1.0, prey 0.05/4.0/1.3; landmarks size 0.2,
+  collidable, spawned at ``0.8·U(-1,1)²`` (``:24-51``).
+- Per-agent (non-shared) rewards (``World.collaborative`` unset): prey take
+  −10 per predator contact and the piecewise screen-exit ``bound`` penalty
+  (``:88-112``); every predator receives +10 per (prey, predator) contact
+  pair — the sum over ALL predators, identical for each (``:114-127``).
+- Obs per agent: ``[vel, pos, landmark_rel, other_pos, other_vel]`` where
+  ``other_vel`` covers only *prey* among the others (``:129-145``), so
+  predator rows are 2·n_good wider minus...: prey rows are 2 entries
+  narrower (they exclude themselves) and zero-pad to the predator width;
+  a one-hot agent id is appended by the env driver
+  (``environment.py:140-142``).
+- Episode ends after ``episode_length`` steps with auto-reset inside
+  ``step`` (``environment.py:205-210``, ``env_wrappers.py:305-313``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from mat_dcml_tpu.envs.mpe import particle
+
+
+class TagState(NamedTuple):
+    rng: jax.Array
+    agent_pos: jax.Array      # (N, 2), adversaries first
+    agent_vel: jax.Array      # (N, 2)
+    landmark_pos: jax.Array   # (L, 2)
+    t: jax.Array
+
+
+class TagTimeStep(NamedTuple):
+    obs: jax.Array
+    share_obs: jax.Array
+    available_actions: jax.Array
+    reward: jax.Array
+    done: jax.Array
+    delay: jax.Array          # protocol compat (zeros)
+    payment: jax.Array
+
+
+@dataclasses.dataclass(frozen=True)
+class SimpleTagConfig:
+    n_good: int = 1           # simple_tag.py:10-13 comment defaults (1/3/2)
+    n_adversaries: int = 3
+    n_landmarks: int = 2
+    episode_length: int = 25
+    adv_size: float = 0.075
+    good_size: float = 0.05
+    adv_accel: float = 3.0
+    good_accel: float = 4.0
+    adv_max_speed: float = 1.0
+    good_max_speed: float = 1.3
+    landmark_size: float = 0.2
+
+    @property
+    def n_agents(self) -> int:
+        return self.n_adversaries + self.n_good
+
+
+class SimpleTagEnv:
+    """Functional env bundle; same TimeStep protocol as simple_spread."""
+
+    def __init__(self, cfg: SimpleTagConfig = SimpleTagConfig()):
+        self.cfg = cfg
+        N, L, G = cfg.n_agents, cfg.n_landmarks, cfg.n_good
+        self.n_agents = N
+        # widest role is the adversary: vel2 + pos2 + 2L + 2(N-1) + 2G
+        self._core_dim = 4 + 2 * L + 2 * (N - 1) + 2 * G
+        self.obs_dim = self._core_dim + N
+        self.share_obs_dim = self.obs_dim * N
+        self.action_dim = 5
+        A = cfg.n_adversaries
+        self._sizes = jnp.asarray(
+            [cfg.adv_size] * A + [cfg.good_size] * G + [cfg.landmark_size] * L
+        )
+        self._collide = jnp.ones((N + L,), bool)
+        self._movable = jnp.asarray([True] * N + [False] * L)
+        self._max_speed = jnp.asarray(
+            [cfg.adv_max_speed] * A + [cfg.good_max_speed] * G
+        )
+        self._gain = jnp.asarray(
+            [particle.force_gain(cfg.adv_accel)] * A
+            + [particle.force_gain(cfg.good_accel)] * G
+        )
+
+    # ----------------------------------------------------------------- reset
+
+    def _spawn(self, key: jax.Array) -> TagState:
+        c = self.cfg
+        key, k_a, k_l = jax.random.split(key, 3)
+        return TagState(
+            rng=key,
+            agent_pos=jax.random.uniform(k_a, (c.n_agents, 2), minval=-1.0, maxval=1.0),
+            agent_vel=jnp.zeros((c.n_agents, 2)),
+            landmark_pos=0.8 * jax.random.uniform(k_l, (c.n_landmarks, 2), minval=-1.0, maxval=1.0),
+            t=jnp.zeros((), jnp.int32),
+        )
+
+    def reset(self, key: jax.Array, episode_idx=0) -> Tuple[TagState, TagTimeStep]:
+        del episode_idx
+        st = self._spawn(key)
+        obs, share, avail = self._observe(st)
+        N = self.cfg.n_agents
+        zero = jnp.zeros(())
+        return st, TagTimeStep(
+            obs, share, avail, jnp.zeros((N, 1)), jnp.zeros((N,), bool), zero, zero
+        )
+
+    # ------------------------------------------------------------------ step
+
+    def step(self, st: TagState, action: jax.Array) -> Tuple[TagState, TagTimeStep]:
+        c = self.cfg
+        N = c.n_agents
+        act = action.reshape(N, -1)
+        onehot = (
+            jax.nn.one_hot(act[:, 0].astype(jnp.int32), 5)
+            if act.shape[-1] == 1 else act.astype(jnp.float32)
+        )
+        u = particle.decode_move(onehot) * self._gain[:, None]
+
+        entity_pos = jnp.concatenate([st.agent_pos, st.landmark_pos])
+        coll = particle.collision_forces(
+            entity_pos, self._sizes, self._collide, self._movable
+        )[:N]
+        vel = particle.integrate(st.agent_vel, u + coll, self._max_speed)
+        pos = st.agent_pos + vel * particle.DT
+
+        stepped = TagState(st.rng, pos, vel, st.landmark_pos, st.t + 1)
+        reward = self._reward(stepped)
+        done_now = stepped.t >= c.episode_length
+
+        fresh = self._spawn(st.rng)
+        new_st = jax.tree.map(lambda a, b: jnp.where(done_now, a, b), fresh, stepped)
+        obs, share, avail = self._observe(new_st)
+        zero = jnp.zeros(())
+        return new_st, TagTimeStep(
+            obs, share, avail, reward[:, None],
+            jnp.broadcast_to(done_now, (N,)), zero, zero,
+        )
+
+    def _reward(self, st: TagState) -> jax.Array:
+        c = self.cfg
+        A, G = c.n_adversaries, c.n_good
+        adv_pos = st.agent_pos[:A]
+        good_pos = st.agent_pos[A:]
+        d = jnp.linalg.norm(good_pos[:, None, :] - adv_pos[None, :, :], axis=-1)  # (G, A)
+        contact = d < (c.good_size + c.adv_size)
+        # prey: -10 per touching predator, minus the screen-exit penalty
+        good_rew = -10.0 * contact.sum(axis=1) - particle.bound_penalty(good_pos)
+        # predators: +10 per (prey, predator) contact pair, shared total
+        adv_rew = jnp.full((A,), 10.0 * contact.sum())
+        return jnp.concatenate([adv_rew, good_rew])
+
+    # ------------------------------------------------------------------- obs
+
+    def _observe(self, st: TagState):
+        c = self.cfg
+        N, A = c.n_agents, c.n_adversaries
+        idx = jnp.arange(N)
+        landmark_rel = (
+            st.landmark_pos[None, :, :] - st.agent_pos[:, None, :]
+        ).reshape(N, -1)
+        rel = st.agent_pos[None, :, :] - st.agent_pos[:, None, :]  # (N, N, 2)
+
+        def row(i):
+            others = jnp.where(idx != i, size=N - 1)[0]
+            other_pos = rel[i][others].reshape(-1)
+            # velocities of *prey* among the others, in agent order
+            good_others = jnp.where((idx != i) & (idx >= A), size=c.n_good, fill_value=N)[0]
+            pad_vel = jnp.concatenate([st.agent_vel, jnp.zeros((1, 2))])
+            other_vel = pad_vel[good_others].reshape(-1)
+            return jnp.concatenate(
+                [st.agent_vel[i], st.agent_pos[i], landmark_rel[i], other_pos, other_vel]
+            )
+
+        core = jax.vmap(row)(idx)  # (N, core_dim) — prey rows end in a 0 pad
+        # prey gathered n_good slots but only n_good-1 are real; the fill
+        # row (index N) contributed zeros, matching the zero-pad convention
+        obs = jnp.concatenate([core, jnp.eye(N)], axis=1)
+        share = jnp.broadcast_to(obs.reshape(-1), (N, self.share_obs_dim))
+        avail = jnp.ones((N, self.action_dim))
+        return obs, share, avail

@@ -1,0 +1,191 @@
+"""Golden-parity tests for the pure-JAX MPE simple_tag / simple_adversary /
+simple_push scenarios.
+
+Same scheme as ``test_mpe_parity.py``: the reference physics (``core.py``)
+and scenario modules are numpy-only and importable, so each test drives the
+actual reference ``World`` with the ``environment.py`` step protocol and
+checks positions/obs/per-agent rewards element-wise against the JAX env.
+Heterogeneous-role obs rows are zero-padded to the widest role in the JAX
+envs, so rows compare as ``[ref_obs, 0…, one_hot_id]``.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import sys
+import types
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from mat_dcml_tpu.envs.mpe import (
+    SimpleAdversaryConfig,
+    SimpleAdversaryEnv,
+    SimplePushConfig,
+    SimplePushEnv,
+    SimpleTagConfig,
+    SimpleTagEnv,
+)
+from mat_dcml_tpu.envs.mpe.simple_adversary import AdversaryState
+from mat_dcml_tpu.envs.mpe.simple_push import PushState
+from mat_dcml_tpu.envs.mpe.simple_tag import TagState
+
+REF = Path("/root/reference/mat_src/mat/envs/mpe")
+
+pytestmark = pytest.mark.skipif(not REF.exists(), reason="reference tree not available")
+
+
+def _load(name: str, path: Path):
+    spec = importlib.util.spec_from_file_location(name, path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[name] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture(scope="module")
+def ref_mpe():
+    for pkg in ["mat", "mat.envs", "mat.envs.mpe", "mat.envs.mpe.scenarios"]:
+        sys.modules.setdefault(pkg, types.ModuleType(pkg))
+    _load("mat.envs.mpe.core", REF / "core.py")
+    _load("mat.envs.mpe.scenario", REF / "scenario.py")
+    return {
+        name: _load(f"mat.envs.mpe.scenarios.{name}", REF / "scenarios" / f"{name}.py").Scenario()
+        for name in ["simple_tag", "simple_adversary", "simple_push"]
+    }
+
+
+class _Args:
+    episode_length = 25
+    num_agents = 3
+    num_landmarks = 2
+    num_good_agents = 1
+    num_adversaries = 3
+
+
+def _ref_step(world, scenario, actions_idx):
+    """One reference env step (``environment.py:125-166``), per-agent rewards."""
+    onehot = np.eye(5)[actions_idx]
+    for i, agent in enumerate(world.agents):
+        u = np.zeros(2)
+        u[0] += onehot[i][1] - onehot[i][2]
+        u[1] += onehot[i][3] - onehot[i][4]
+        sensitivity = 5.0 if agent.accel is None else agent.accel
+        agent.action.u = u * sensitivity
+        agent.action.c = np.zeros(world.dim_c)
+    world.step()
+    obs_n = [scenario.observation(a, world) for a in world.agents]
+    rew_n = [float(scenario.reward(a, world)) for a in world.agents]
+    return obs_n, np.asarray(rew_n)
+
+
+def _check(env, state, world, scenario, steps=10, seed=7):
+    """Drive both envs in lockstep and compare state/obs/rewards."""
+    N = env.n_agents
+    step = jax.jit(env.step)
+    rng = np.random.RandomState(seed)
+    for t in range(steps):
+        idx = rng.randint(0, 5, size=N)
+        ref_obs, ref_rew = _ref_step(world, scenario, idx)
+        state, ts = step(state, jnp.asarray(idx[:, None], jnp.float32))
+        np.testing.assert_allclose(
+            np.asarray(state.agent_pos),
+            np.stack([a.state.p_pos for a in world.agents]),
+            rtol=1e-4, atol=1e-5, err_msg=f"pos t={t}",
+        )
+        got = np.asarray(ts.obs)
+        for i in range(N):
+            d = len(ref_obs[i])
+            np.testing.assert_allclose(
+                got[i, :d], ref_obs[i], rtol=1e-4, atol=1e-5,
+                err_msg=f"obs agent {i} t={t}",
+            )
+            # zero pad then one-hot id
+            np.testing.assert_allclose(got[i, d:-N], 0.0, atol=1e-6)
+            np.testing.assert_allclose(got[i, -N:], np.eye(N)[i], atol=1e-6)
+        np.testing.assert_allclose(
+            np.asarray(ts.reward[:, 0]), ref_rew, rtol=1e-4, atol=1e-4,
+            err_msg=f"reward t={t}",
+        )
+
+
+def test_simple_tag_parity(ref_mpe):
+    scenario = ref_mpe["simple_tag"]
+    np.random.seed(0)
+    world = scenario.make_world(_Args())
+    scenario.reset_world(world)
+    env = SimpleTagEnv(SimpleTagConfig())
+    state = TagState(
+        rng=jax.random.key(0),
+        agent_pos=jnp.asarray(np.stack([a.state.p_pos for a in world.agents]), jnp.float32),
+        agent_vel=jnp.zeros((4, 2)),
+        landmark_pos=jnp.asarray(np.stack([l.state.p_pos for l in world.landmarks]), jnp.float32),
+        t=jnp.zeros((), jnp.int32),
+    )
+    _check(env, state, world, scenario)
+
+
+def test_simple_adversary_parity(ref_mpe):
+    scenario = ref_mpe["simple_adversary"]
+    np.random.seed(1)
+    world = scenario.make_world(_Args())
+    scenario.reset_world(world)
+    goal = next(i for i, l in enumerate(world.landmarks) if l is world.agents[0].goal_a)
+    env = SimpleAdversaryEnv(SimpleAdversaryConfig(n_agents=3))
+    state = AdversaryState(
+        rng=jax.random.key(0),
+        agent_pos=jnp.asarray(np.stack([a.state.p_pos for a in world.agents]), jnp.float32),
+        agent_vel=jnp.zeros((3, 2)),
+        landmark_pos=jnp.asarray(np.stack([l.state.p_pos for l in world.landmarks]), jnp.float32),
+        goal=jnp.asarray(goal, jnp.int32),
+        t=jnp.zeros((), jnp.int32),
+    )
+    _check(env, state, world, scenario)
+
+
+def test_simple_push_parity(ref_mpe):
+    scenario = ref_mpe["simple_push"]
+
+    class PushArgs(_Args):
+        num_agents = 2
+        num_landmarks = 2
+
+    np.random.seed(2)
+    world = scenario.make_world(PushArgs())
+    scenario.reset_world(world)
+    goal = world.agents[0].goal_a.index
+    env = SimplePushEnv(SimplePushConfig())
+    state = PushState(
+        rng=jax.random.key(0),
+        agent_pos=jnp.asarray(np.stack([a.state.p_pos for a in world.agents]), jnp.float32),
+        agent_vel=jnp.zeros((2, 2)),
+        landmark_pos=jnp.asarray(np.stack([l.state.p_pos for l in world.landmarks]), jnp.float32),
+        goal=jnp.asarray(goal, jnp.int32),
+        t=jnp.zeros((), jnp.int32),
+    )
+    _check(env, state, world, scenario)
+
+
+@pytest.mark.parametrize("env_cls,cfg_cls", [
+    (SimpleTagEnv, SimpleTagConfig),
+    (SimpleAdversaryEnv, SimpleAdversaryConfig),
+    (SimplePushEnv, SimplePushConfig),
+])
+def test_vmap_autoreset_shapes(env_cls, cfg_cls):
+    env = env_cls(cfg_cls(episode_length=4))
+    N = env.n_agents
+    keys = jax.random.split(jax.random.key(0), 6)
+    states, ts = jax.vmap(env.reset)(keys, jnp.zeros(6, jnp.int32))
+    assert ts.obs.shape == (6, N, env.obs_dim)
+    assert ts.share_obs.shape == (6, N, env.share_obs_dim)
+    step = jax.jit(jax.vmap(env.step))
+    acts = jnp.zeros((6, N, 1))
+    for _ in range(4):
+        states, ts = step(states, acts)
+    assert bool(np.asarray(ts.done).all())
+    assert np.all(np.asarray(states.t) == 0)  # auto-reset
+    assert np.all(np.isfinite(np.asarray(ts.obs)))

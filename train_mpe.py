@@ -13,6 +13,7 @@ Usage:
 """
 
 import argparse
+import dataclasses
 import sys
 
 from mat_dcml_tpu.utils.platform import apply_platform_override
@@ -20,27 +21,40 @@ from mat_dcml_tpu.utils.platform import apply_platform_override
 apply_platform_override()
 
 from mat_dcml_tpu.config import parse_cli_with_extras
-from mat_dcml_tpu.envs.mpe import SCENARIOS, SimpleSpreadConfig
+from mat_dcml_tpu.envs.mpe import SCENARIOS
 from mat_dcml_tpu.training.generic_runner import GenericRunner
 
 
 def main(argv=None):
     extras = argparse.ArgumentParser(add_help=False)
-    extras.add_argument("--num_agents", type=int, default=3)
-    extras.add_argument("--num_landmarks", type=int, default=3)
+    # None = keep each scenario config's own default (tag has 2 landmarks,
+    # spread 3, adversary derives its count); only explicit flags override
+    extras.add_argument("--num_agents", type=int, default=None)
+    extras.add_argument("--num_landmarks", type=int, default=None)
+    # predator-prey role counts (reference simple_tag.py:10-13 defaults)
+    extras.add_argument("--num_good_agents", type=int, default=None)
+    extras.add_argument("--num_adversaries", type=int, default=None)
     run, ppo, ns = parse_cli_with_extras(argv, extras=extras, overrides={
         "env_name": "MPE", "scenario": "simple_spread", "episode_length": 25,
     })
     if run.scenario not in SCENARIOS:
         raise SystemExit(f"unknown scenario {run.scenario!r}; available: {sorted(SCENARIOS)}")
     env_cls, cfg_cls = SCENARIOS[run.scenario]
-    env = env_cls(cfg_cls(
-        n_agents=ns.num_agents,
-        n_landmarks=ns.num_landmarks,
-        episode_length=run.episode_length,
-    ))
+    # scenarios differ in which size knobs exist (tag fixes roles, adversary
+    # derives landmarks); pass only the fields each config declares
+    candidates = {
+        "n_agents": ns.num_agents,
+        "n_landmarks": ns.num_landmarks,
+        "n_good": ns.num_good_agents,
+        "n_adversaries": ns.num_adversaries,
+        "episode_length": run.episode_length,
+    }
+    fields = {f.name for f in dataclasses.fields(cfg_cls)}
+    env = env_cls(cfg_cls(**{
+        k: v for k, v in candidates.items() if k in fields and v is not None
+    }))
     runner = GenericRunner(run, ppo, env)
-    print(f"algorithm={run.algorithm_name} env=MPE/{run.scenario} agents={ns.num_agents} "
+    print(f"algorithm={run.algorithm_name} env=MPE/{run.scenario} agents={env.n_agents} "
           f"episodes={run.episodes} devices={len(__import__('jax').devices())}")
     runner.train_loop()
 
