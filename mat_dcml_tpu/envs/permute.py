@@ -1,0 +1,91 @@
+"""Per-episode agent-order permutation wrapper.
+
+The reference ships two copy-variant envs whose only addition is shuffling
+the agent order each episode so policies cannot overfit to slot identity —
+``starcraft2/Random_StarCraft2_Env.py:387-390,404,451-453,484`` (the diff vs
+the base SMAC env is exactly ``permutate_idx``) and
+``ma_mujoco/multiagent_mujoco/random_mujoco_multi.py:128-131,138,167-172``.
+Instead of forking every env, the TPU build factors the idea into one
+generic wrapper over the TimeStep protocol: outward row ``i`` is inner agent
+``perm[i]`` for obs/share_obs/availability/reward/done, and incoming actions
+are gathered back with the inverse permutation before the inner ``step``
+(the reference's ``agent_recovery``).
+
+A fresh permutation is drawn whenever the inner env auto-resets (the
+reference redraws in ``reset``; with reset-inside-step semantics the
+returned obs already belong to the new episode, so they are permuted with
+the NEW order while that step's reward/done keep the old one).
+
+Reference defect not replicated: ``random_mujoco_multi.py:138`` applies
+``agent_recovery`` to the *flattened* joint action vector, which scrambles
+torques whenever agents have more than one action dim; this wrapper permutes
+whole per-agent action rows.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+class PermutedState(NamedTuple):
+    inner: Any
+    perm: jax.Array   # (N,) int32 — outward row i shows inner agent perm[i]
+    inv: jax.Array    # argsort(perm): inner agent j reads outward row inv[j]
+    rng: jax.Array
+
+
+class AgentPermutationWrapper:
+    """Wrap any TimeStep-protocol env with per-episode agent shuffling."""
+
+    def __init__(self, env):
+        self.env = env
+
+    def __getattr__(self, name):
+        # forward static descriptors (n_agents, obs_dim, action_dim, cfg, ...)
+        return getattr(self.env, name)
+
+    def _permute_ts(self, ts, perm):
+        return ts._replace(
+            obs=ts.obs[perm],
+            share_obs=ts.share_obs[perm],
+            available_actions=ts.available_actions[perm],
+            reward=ts.reward[perm],
+            done=ts.done[perm],
+        )
+
+    def _draw(self, key: jax.Array) -> Tuple[jax.Array, jax.Array]:
+        perm = jax.random.permutation(key, self.env.n_agents)
+        return perm, jnp.argsort(perm)
+
+    def reset(self, key: jax.Array, episode_idx=0):
+        k_in, k_perm, k_next = jax.random.split(key, 3)
+        inner, ts = self.env.reset(k_in, episode_idx)
+        perm, inv = self._draw(k_perm)
+        return PermutedState(inner, perm, inv, k_next), self._permute_ts(ts, perm)
+
+    def step(self, st: PermutedState, action: jax.Array):
+        N = self.env.n_agents
+        inner_action = (
+            action.reshape(N, -1)[st.inv].reshape(action.shape)
+        )
+        inner, ts = self.env.step(st.inner, inner_action)
+
+        # reward/done describe the episode just played -> old order
+        out = ts._replace(reward=ts.reward[st.perm], done=ts.done[st.perm])
+        # obs/avail may already belong to the auto-reset next episode -> draw
+        # the next episode's order on done (Random_StarCraft2_Env.py:404)
+        k_perm, rng = jax.random.split(st.rng)  # advance unconditionally —
+        # selecting between typed PRNG keys needs extended-dtype select
+        fresh_perm, fresh_inv = self._draw(k_perm)
+        done_now = ts.done.any()
+        perm = jnp.where(done_now, fresh_perm, st.perm)
+        inv = jnp.where(done_now, fresh_inv, st.inv)
+        out = out._replace(
+            obs=ts.obs[perm],
+            share_obs=ts.share_obs[perm],
+            available_actions=ts.available_actions[perm],
+        )
+        return PermutedState(inner, perm, inv, rng), out

@@ -24,16 +24,30 @@ class MujocoRunner(GenericRunner):
     """GenericRunner + train-time fault injection + faulty-node eval sweep."""
 
     def __init__(self, run: RunConfig, ppo: PPOConfig, env,
-                 faulty_node: int = -1, log_fn=print):
+                 faulty_node: int = -1, random_order: bool = False,
+                 log_fn=print):
         self.base_env = env
-        train_env = FaultyAgentWrapper(env, faulty_node) if faulty_node >= 0 else env
-        super().__init__(run, ppo, train_env, log_fn=log_fn)
+        self.random_order = random_order
+        super().__init__(run, ppo, self._compose(env, faulty_node), log_fn=log_fn)
+
+    def _compose(self, env, faulty_node: int):
+        """Fault masking binds to the PHYSICAL agent index, so the fault
+        wrapper sits inside and the per-episode permutation outside —
+        the permutation un-permutes actions back to physical order before
+        the fault zeroes its node (random_mujoco_multi keeps the same
+        orientation: permutation at the env boundary)."""
+        if faulty_node >= 0:
+            env = FaultyAgentWrapper(env, faulty_node)
+        if self.random_order:
+            from mat_dcml_tpu.envs.permute import AgentPermutationWrapper
+            env = AgentPermutationWrapper(env)
+        return env
 
     def evaluate(self, train_state, n_steps: int = 200, seed: int = 0,
                  faulty_node: int = -1):
         """Deterministic mean step reward with ``faulty_node``'s actions
         zeroed (-1 = healthy)."""
-        env = FaultyAgentWrapper(self.base_env, faulty_node) if faulty_node >= 0 else self.base_env
+        env = self._compose(self.base_env, faulty_node)
         E = self.run_cfg.n_rollout_threads
         rs = self.collector.init_state(jax.random.key(seed + 23), E)
 

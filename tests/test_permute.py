@@ -1,0 +1,119 @@
+"""Tests for the per-episode agent-order permutation wrapper
+(Random_StarCraft2_Env / random_mujoco_multi equivalent)."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from mat_dcml_tpu.envs.mamujoco import MJLiteConfig, MJLiteEnv
+from mat_dcml_tpu.envs.permute import AgentPermutationWrapper
+from mat_dcml_tpu.envs.smac import SMACLiteConfig, SMACLiteEnv
+
+
+@pytest.fixture(scope="module")
+def smac_env():
+    return SMACLiteEnv(SMACLiteConfig(map_name="2m"))
+
+
+def test_rows_are_inner_rows_permuted(smac_env):
+    wrapped = AgentPermutationWrapper(smac_env)
+    st, ts = wrapped.reset(jax.random.key(0))
+    perm = np.asarray(st.perm)
+    # outward rows are the inner state's observation rows reordered
+    inner_obs, inner_share, inner_avail = smac_env._observe(st.inner)
+    np.testing.assert_allclose(np.asarray(ts.obs), np.asarray(inner_obs)[perm])
+    np.testing.assert_allclose(
+        np.asarray(ts.available_actions), np.asarray(inner_avail)[perm]
+    )
+
+
+def test_actions_recovered_to_inner_order(smac_env):
+    wrapped = AgentPermutationWrapper(smac_env)
+    st, ts = wrapped.reset(jax.random.key(1))
+    inv = np.asarray(st.inv)
+
+    # choose distinct valid actions per outward row (stop=1 always legal)
+    act_out = jnp.ones((smac_env.n_agents, 1), jnp.int32)
+    # drive the inner env directly with the recovered order
+    inner_direct, ts_direct = smac_env.step(st.inner, act_out.reshape(-1)[inv])
+    st2, ts2 = wrapped.step(st, act_out)
+
+    # identical inner trajectories (PRNG-key leaves compared as raw words)
+    def leaves(tree):
+        return jax.tree.leaves(jax.tree.map(
+            lambda a: jax.random.key_data(a)
+            if jnp.issubdtype(a.dtype, jax.dtypes.prng_key) else a,
+            tree,
+        ))
+
+    for a, b in zip(leaves(inner_direct), leaves(st2.inner)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b))
+    # outward obs are the inner obs rows under the (possibly redrawn) perm
+    np.testing.assert_allclose(
+        np.asarray(ts2.obs), np.asarray(ts_direct.obs)[np.asarray(st2.perm)]
+    )
+    # reward/done keep the pre-step order
+    np.testing.assert_allclose(
+        np.asarray(ts2.reward), np.asarray(ts_direct.reward)[np.asarray(st.perm)]
+    )
+
+
+def test_permutation_redraws_each_episode():
+    env = MJLiteEnv(MJLiteConfig(agent_conf="6x1", episode_length=3))
+    wrapped = AgentPermutationWrapper(env)
+    st, _ = wrapped.reset(jax.random.key(2))
+    step = jax.jit(wrapped.step)
+    act = jnp.zeros((env.n_agents, env.action_dim))
+    perms = [np.asarray(st.perm)]
+    for t in range(9):
+        st, ts = step(st, act)
+        if bool(np.asarray(ts.done).any()):
+            perms.append(np.asarray(st.perm))
+    assert len(perms) >= 3
+    # with 6! orders, three consecutive identical draws are (1/720)^2 —
+    # a fixed seed keeps this deterministic
+    assert any(not np.array_equal(perms[0], p) for p in perms[1:])
+    # every draw is a valid permutation
+    for p in perms:
+        assert sorted(p.tolist()) == list(range(env.n_agents))
+
+
+def test_fault_binds_to_physical_agent():
+    """FaultyAgentWrapper inside + permutation outside: the zeroed torques
+    belong to the same PHYSICAL agent every episode (mujoco_runner
+    composition), not to whatever outward slot the shuffle exposes."""
+    from mat_dcml_tpu.envs.mamujoco import FaultyAgentWrapper
+
+    env = MJLiteEnv(MJLiteConfig(agent_conf="3x2", episode_length=10))
+    node = 1
+    composed = AgentPermutationWrapper(FaultyAgentWrapper(env, node))
+    st, _ = composed.reset(jax.random.key(5))
+    act_out = jnp.ones((env.n_agents, env.action_dim))
+
+    # expected: recover physical order, zero the physical node, step raw env
+    expected_act = act_out[np.asarray(st.inv)].at[node].set(0.0)
+    direct_state, _ = env.step(st.inner, expected_act)
+    st2, _ = composed.step(st, act_out)
+    np.testing.assert_allclose(
+        np.asarray(direct_state.omega), np.asarray(st2.inner.omega)
+    )
+    np.testing.assert_allclose(
+        np.asarray(direct_state.theta), np.asarray(st2.inner.theta)
+    )
+
+
+def test_vmap_jit_compatible(smac_env):
+    wrapped = AgentPermutationWrapper(smac_env)
+    keys = jax.random.split(jax.random.key(3), 4)
+    states, ts = jax.vmap(wrapped.reset)(keys, jnp.zeros(4, jnp.int32))
+    assert ts.obs.shape == (4, smac_env.n_agents, smac_env.obs_dim)
+    act = jnp.ones((4, smac_env.n_agents, 1), jnp.int32)
+    states, ts = jax.jit(jax.vmap(wrapped.step))(states, act)
+    assert np.all(np.isfinite(np.asarray(ts.obs)))
+    # forwarded attributes
+    assert wrapped.n_agents == smac_env.n_agents
+    assert wrapped.action_dim == smac_env.action_dim

@@ -34,6 +34,8 @@ def main(argv=None):
     extras.add_argument("--faulty_node", type=int, default=-1)
     extras.add_argument("--eval_faulty_node", type=str, default="")
     extras.add_argument("--backend", type=str, default="lite", choices=("lite", "gym"))
+    # per-episode agent-order shuffling (random_mujoco_multi equivalent)
+    extras.add_argument("--random_order", action="store_true")
     # the robot rides the shared --scenario flag (RunConfig.scenario)
     run, ppo, ns = parse_cli_with_extras(argv, extras=extras, overrides={
         "env_name": "mujoco", "scenario": "HalfCheetah-v2", "episode_length": 50,
@@ -50,7 +52,8 @@ def main(argv=None):
         scenario=ns.scenario, agent_conf=ns.agent_conf,
         agent_obsk=ns.agent_obsk, episode_length=run.episode_length,
     ))
-    runner = MujocoRunner(run, ppo, env, faulty_node=ns.faulty_node)
+    runner = MujocoRunner(run, ppo, env, faulty_node=ns.faulty_node,
+                          random_order=ns.random_order)
     print(f"algorithm={run.algorithm_name} env=mujoco/{ns.scenario}/{ns.agent_conf} "
           f"agents={env.n_agents} episodes={run.episodes} "
           f"devices={len(__import__('jax').devices())}")

@@ -33,6 +33,8 @@ def main(argv=None):
                         choices=sorted(map_param_registry))
     extras.add_argument("--backend", type=str, default="smaclite",
                         choices=("smaclite", "sc2"))
+    # per-episode agent-order shuffling (Random_StarCraft2_Env equivalent)
+    extras.add_argument("--random_order", action="store_true")
     run, ppo, ns = parse_cli_with_extras(argv, extras=extras, overrides={
         "env_name": "StarCraft2", "episode_length": 60,
     })
@@ -44,6 +46,9 @@ def main(argv=None):
             "HostRolloutCollector (envs/smac/host.py docstring)."
         )
     env = SMACLiteEnv(SMACLiteConfig(map_name=ns.map_name))
+    if ns.random_order:
+        from mat_dcml_tpu.envs.permute import AgentPermutationWrapper
+        env = AgentPermutationWrapper(env)
     runner = SMACRunner(run, ppo, env)
     print(f"algorithm={run.algorithm_name} env=SMAC/{ns.map_name} "
           f"agents={env.n_agents} episodes={run.episodes} "
