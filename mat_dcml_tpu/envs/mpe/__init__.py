@@ -3,6 +3,10 @@ from mat_dcml_tpu.envs.mpe.simple_adversary import (
     SimpleAdversaryEnv,
 )
 from mat_dcml_tpu.envs.mpe.simple_push import SimplePushConfig, SimplePushEnv
+from mat_dcml_tpu.envs.mpe.simple_reference import (
+    SimpleReferenceConfig,
+    SimpleReferenceEnv,
+)
 from mat_dcml_tpu.envs.mpe.simple_speaker_listener import (
     SimpleSpeakerListenerEnv,
     SpeakerListenerConfig,
@@ -23,6 +27,7 @@ SCENARIOS = {
     "simple_tag": (SimpleTagEnv, SimpleTagConfig),
     "simple_adversary": (SimpleAdversaryEnv, SimpleAdversaryConfig),
     "simple_push": (SimplePushEnv, SimplePushConfig),
+    "simple_reference": (SimpleReferenceEnv, SimpleReferenceConfig),
 }
 
 __all__ = [
@@ -30,6 +35,8 @@ __all__ = [
     "SimpleAdversaryEnv",
     "SimplePushConfig",
     "SimplePushEnv",
+    "SimpleReferenceConfig",
+    "SimpleReferenceEnv",
     "SimpleSpeakerListenerEnv",
     "SpeakerListenerConfig",
     "SimpleSpreadConfig",

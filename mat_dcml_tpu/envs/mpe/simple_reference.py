@@ -1,0 +1,161 @@
+"""Pure-JAX MPE ``simple_reference`` (cooperative communication, symmetric).
+
+Reference: ``mat_src/mat/envs/mpe/scenarios/simple_reference.py``.  Two
+agents, three fixed-color landmarks.  Each agent has a private goal landmark
+the OTHER agent must reach (``goal_a`` = the other agent, ``goal_b`` = the
+target landmark, ``:39-43``), and can see only its partner's goal color —
+so both must simultaneously move (decoding the partner's messages) and
+speak (describing the partner's target).
+
+Faithful semantics:
+
+- Actions: agents are movable and NOT silent with ``dim_c=10``, so the
+  reference exposes ``MultiDiscrete([move(5), comm(10)])``
+  (``environment.py:75-87``); the comm sub-action becomes the one-hot
+  message visible to the partner on the SAME step (``core.py`` world.step
+  updates comm before observations; ``environment.py:240-276`` decode).
+- Shared reward (``world.collaborative = True``, ``:12``): the sum over
+  agents of ``-|goal_a.pos - goal_b.pos|²`` (``:62-68``) — i.e.
+  ``-(|agent1 - goal_of_0|² + |agent0 - goal_of_1|²)`` given to both.
+- Obs: ``[vel(2), landmark_rel(6), partner_goal_color(3), partner_comm(10)]``
+  (``:69-97``; the goal-position and own-color terms are commented out in
+  the reference) + one-hot id (``environment.py:140-142``) -> 23 dims.
+  Landmark colors are the fixed R/G/B rows (``:47-49``).
+- Spawns: agents ``U(-1,1)²``, landmarks ``0.8·U(-1,1)²``, each agent's
+  goal landmark uniform (``:40-43,55-60``); no collisions.
+
+The MAT family is not available here — the reference's transformer act
+machinery has no MultiDiscrete family either (``transformer_act.py``);
+train with mappo / rmappo / ippo.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from mat_dcml_tpu.envs.mpe import particle
+from mat_dcml_tpu.envs.spaces import MultiDiscrete
+
+LANDMARK_COLORS = jnp.asarray(
+    [[0.75, 0.25, 0.25], [0.25, 0.75, 0.25], [0.25, 0.25, 0.75]]
+)  # simple_reference.py:47-49
+
+
+class ReferenceState(NamedTuple):
+    rng: jax.Array
+    agent_pos: jax.Array      # (2, 2)
+    agent_vel: jax.Array      # (2, 2)
+    landmark_pos: jax.Array   # (3, 2)
+    goal_b: jax.Array         # (2,) int32 — agent i's target for its PARTNER
+    comm: jax.Array           # (2, dim_c) last messages
+    t: jax.Array
+
+
+class ReferenceTimeStep(NamedTuple):
+    obs: jax.Array
+    share_obs: jax.Array
+    available_actions: jax.Array
+    reward: jax.Array
+    done: jax.Array
+    delay: jax.Array
+    payment: jax.Array
+
+
+@dataclasses.dataclass(frozen=True)
+class SimpleReferenceConfig:
+    n_landmarks: int = 3
+    dim_c: int = 10           # simple_reference.py:11
+    episode_length: int = 25
+    n_agents: int = 2
+
+    def __post_init__(self):
+        if self.n_agents != 2:
+            raise ValueError("simple_reference is a 2-agent scenario (:15-16)")
+        if self.n_landmarks != 3:
+            raise ValueError("simple_reference has 3 fixed-color landmarks")
+
+
+class SimpleReferenceEnv:
+    """Functional env bundle; same TimeStep protocol as simple_spread."""
+
+    def __init__(self, cfg: SimpleReferenceConfig = SimpleReferenceConfig()):
+        self.cfg = cfg
+        self.n_agents = 2
+        # vel2 + 2L + color3 + partner comm + id2
+        self.obs_dim = 2 + 2 * cfg.n_landmarks + 3 + cfg.dim_c + 2
+        self.share_obs_dim = self.obs_dim * 2
+        self.action_space = MultiDiscrete((5, cfg.dim_c))
+        self.action_dim = self.action_space.sample_dim  # stored width: 2 ints
+        self.avail_dim = 5 + cfg.dim_c                  # flat per-head segments
+
+    def _spawn(self, key: jax.Array) -> ReferenceState:
+        c = self.cfg
+        key, k_a, k_l, k_g = jax.random.split(key, 4)
+        return ReferenceState(
+            rng=key,
+            agent_pos=jax.random.uniform(k_a, (2, 2), minval=-1.0, maxval=1.0),
+            agent_vel=jnp.zeros((2, 2)),
+            landmark_pos=0.8 * jax.random.uniform(k_l, (c.n_landmarks, 2), minval=-1.0, maxval=1.0),
+            goal_b=jax.random.randint(k_g, (2,), 0, c.n_landmarks),
+            comm=jnp.zeros((2, c.dim_c)),
+            t=jnp.zeros((), jnp.int32),
+        )
+
+    def _observe(self, st: ReferenceState):
+        landmark_rel = (
+            st.landmark_pos[None, :, :] - st.agent_pos[:, None, :]
+        ).reshape(2, -1)
+        # agent i sees its PARTNER's goal color (goal_b of the partner is the
+        # landmark *i* must reach; i sees the color of the one it must
+        # describe — its own goal_b): observation() reads agent.goal_b
+        goal_color = LANDMARK_COLORS[st.goal_b]          # (2, 3)
+        partner_comm = st.comm[::-1]                     # other agent's message
+        obs = jnp.concatenate(
+            [st.agent_vel, landmark_rel, goal_color, partner_comm, jnp.eye(2)],
+            axis=1,
+        )
+        share = jnp.broadcast_to(obs.reshape(-1), (2, self.share_obs_dim))
+        avail = jnp.ones((2, self.avail_dim))
+        return obs, share, avail
+
+    def reset(self, key: jax.Array, episode_idx=0) -> Tuple[ReferenceState, ReferenceTimeStep]:
+        del episode_idx
+        st = self._spawn(key)
+        obs, share, avail = self._observe(st)
+        zero = jnp.zeros(())
+        return st, ReferenceTimeStep(
+            obs, share, avail, jnp.zeros((2, 1)), jnp.zeros((2,), bool), zero, zero
+        )
+
+    def step(self, st: ReferenceState, action: jax.Array) -> Tuple[ReferenceState, ReferenceTimeStep]:
+        c = self.cfg
+        act = action.reshape(2, -1).astype(jnp.int32)   # (2, [move, comm])
+        onehot = jax.nn.one_hot(act[:, 0], 5)
+        u = particle.decode_move(onehot) * particle.force_gain(None)
+        comm = jax.nn.one_hot(jnp.clip(act[:, 1], 0, c.dim_c - 1), c.dim_c)
+        vel = particle.integrate(st.agent_vel, u, jnp.full((2,), jnp.inf))
+        pos = st.agent_pos + vel * particle.DT
+
+        stepped = ReferenceState(
+            st.rng, pos, vel, st.landmark_pos, st.goal_b, comm, st.t + 1
+        )
+        # shared reward: agent i's term is -|partner_pos - goal_b_i|²
+        goal_pos = stepped.landmark_pos[stepped.goal_b]  # (2, 2)
+        partner_pos = pos[::-1]
+        reward = -jnp.sum((partner_pos - goal_pos) ** 2)
+        done_now = stepped.t >= c.episode_length
+
+        fresh = self._spawn(st.rng)
+        new_st = jax.tree.map(lambda a, b: jnp.where(done_now, a, b), fresh, stepped)
+        obs, share, avail = self._observe(new_st)
+        zero = jnp.zeros(())
+        return new_st, ReferenceTimeStep(
+            obs, share, avail,
+            jnp.broadcast_to(reward, (2, 1)),
+            jnp.broadcast_to(done_now, (2,)),
+            zero, zero,
+        )

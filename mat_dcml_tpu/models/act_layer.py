@@ -180,10 +180,16 @@ class ACTLayer(nn.Module):
             return action, jnp.where(role > 0.5, logp_cont, logp_disc)
 
         if isinstance(sp, MultiDiscrete):
+            # availability mask is the flat concat of per-head segments
+            # (widths nvec[i]), matching the 2-D (agents, features) TimeStep
+            # protocol; heads may have unequal widths (MPE move+comm)
             actions, logps = [], []
             keys = jax.random.split(key, len(sp.nvec))
+            off = 0
             for i, head in enumerate(self.action_heads):
-                avail = None if available_actions is None else available_actions[..., i, :]
+                n = sp.nvec[i]
+                avail = None if available_actions is None else available_actions[..., off:off + n]
+                off += n
                 logits = D.mask_logits(head(x), avail)
                 a = D.categorical_mode(logits) if deterministic else D.categorical_sample(keys[i], logits)
                 actions.append(a[..., None].astype(jnp.float32))
@@ -266,10 +272,13 @@ class ACTLayer(nn.Module):
 
         if isinstance(sp, MultiDiscrete):
             logps, ents = [], []
+            off = 0
             for i, head in enumerate(self.action_heads):
-                avail = None if available_actions is None else available_actions[..., i, :]
+                n = sp.nvec[i]
+                avail = None if available_actions is None else available_actions[..., off:off + n]
+                off += n
                 logits = D.mask_logits(head(x), avail)
-                logps.append(D.categorical_log_prob(logits, action[..., i])[..., None])
+                logps.append(D.categorical_log_prob(logits, action[..., i].astype(jnp.int32))[..., None])
                 ents.append(_masked_mean(D.categorical_entropy(logits), active_masks))
             return jnp.concatenate(logps, -1), jnp.stack(ents).mean()
 

@@ -13,7 +13,7 @@ encoder/decoder/GRU ablations (``mat_encoder.py``, ``mat_decoder.py``,
 from __future__ import annotations
 
 from mat_dcml_tpu.config import RunConfig
-from mat_dcml_tpu.envs.spaces import Box, Discrete
+from mat_dcml_tpu.envs.spaces import Box, Discrete, MultiDiscrete
 from mat_dcml_tpu.models.actor_critic import ACConfig, ActorCriticPolicy
 from mat_dcml_tpu.models.mat import CONTINUOUS, DISCRETE, MATConfig
 from mat_dcml_tpu.models.mat_variants import DecoderPolicy, EncoderPolicy, GRUPolicy
@@ -32,16 +32,25 @@ SUPPORTED_ALGOS = MAT_FAMILY + AC_FAMILY
 
 def _env_space(env):
     """Envs declare a continuous space via ``env.action_space = Box(dim)``
-    (multi-agent MuJoCo); everything else is Discrete(action_dim)."""
+    (multi-agent MuJoCo) or a factored one via ``MultiDiscrete(nvec)`` (MPE
+    move+comm scenarios); everything else is Discrete(action_dim)."""
     space = getattr(env, "action_space", None)
-    return space if isinstance(space, Box) else Discrete(env.action_dim)
+    return space if isinstance(space, (Box, MultiDiscrete)) else Discrete(env.action_dim)
 
 
 def build_discrete_policy(run: RunConfig, env):
     """Algorithm -> policy for a discrete- or continuous-action TimeStep env
     (``transformer_policy.py:28-39`` action-type inference + ``:66-79``
     model-class dispatch)."""
-    continuous = isinstance(_env_space(env), Box)
+    space = _env_space(env)
+    if isinstance(space, MultiDiscrete):
+        # faithful scope: the reference's transformer act machinery has no
+        # MultiDiscrete family either (transformer_act.py's four families);
+        # use the actor-critic algorithms for move+comm scenarios
+        raise NotImplementedError(
+            "MAT family has no MultiDiscrete act path (use mappo/rmappo/ippo)"
+        )
+    continuous = isinstance(space, Box)
     cfg = MATConfig(
         n_agent=env.n_agents,
         obs_dim=env.obs_dim,

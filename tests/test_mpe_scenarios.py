@@ -27,11 +27,14 @@ from mat_dcml_tpu.envs.mpe import (
     SimpleAdversaryEnv,
     SimplePushConfig,
     SimplePushEnv,
+    SimpleReferenceConfig,
+    SimpleReferenceEnv,
     SimpleTagConfig,
     SimpleTagEnv,
 )
 from mat_dcml_tpu.envs.mpe.simple_adversary import AdversaryState
 from mat_dcml_tpu.envs.mpe.simple_push import PushState
+from mat_dcml_tpu.envs.mpe.simple_reference import ReferenceState
 from mat_dcml_tpu.envs.mpe.simple_tag import TagState
 
 REF = Path("/root/reference/mat_src/mat/envs/mpe")
@@ -55,7 +58,8 @@ def ref_mpe():
     _load("mat.envs.mpe.scenario", REF / "scenario.py")
     return {
         name: _load(f"mat.envs.mpe.scenarios.{name}", REF / "scenarios" / f"{name}.py").Scenario()
-        for name in ["simple_tag", "simple_adversary", "simple_push"]
+        for name in ["simple_tag", "simple_adversary", "simple_push",
+                     "simple_reference"]
     }
 
 
@@ -168,6 +172,67 @@ def test_simple_push_parity(ref_mpe):
         t=jnp.zeros((), jnp.int32),
     )
     _check(env, state, world, scenario)
+
+
+def test_simple_reference_parity(ref_mpe):
+    """Moving + speaking agents: drives the reference World with MultiDiscrete
+    [move, comm] actions (``environment.py:240-276`` decode: move one-hot ->
+    force, comm index -> one-hot ``action.c`` -> ``state.c`` in world.step)."""
+    scenario = ref_mpe["simple_reference"]
+
+    class RefArgs(_Args):
+        num_agents = 2
+        num_landmarks = 3
+
+    np.random.seed(3)
+    world = scenario.make_world(RefArgs())
+    scenario.reset_world(world)
+    goals = [
+        next(i for i, l in enumerate(world.landmarks) if l is a.goal_b)
+        for a in world.agents
+    ]
+    env = SimpleReferenceEnv(SimpleReferenceConfig())
+    state = ReferenceState(
+        rng=jax.random.key(0),
+        agent_pos=jnp.asarray(np.stack([a.state.p_pos for a in world.agents]), jnp.float32),
+        agent_vel=jnp.zeros((2, 2)),
+        landmark_pos=jnp.asarray(np.stack([l.state.p_pos for l in world.landmarks]), jnp.float32),
+        goal_b=jnp.asarray(goals, jnp.int32),
+        comm=jnp.zeros((2, 10)),
+        t=jnp.zeros((), jnp.int32),
+    )
+    step = jax.jit(env.step)
+    rng = np.random.RandomState(11)
+    for t in range(10):
+        move = rng.randint(0, 5, size=2)
+        talk = rng.randint(0, 10, size=2)
+        # reference driver: move one-hot -> u * 5; comm one-hot -> action.c
+        for i, agent in enumerate(world.agents):
+            u = np.zeros(2)
+            oh = np.eye(5)[move[i]]
+            u[0] += oh[1] - oh[2]
+            u[1] += oh[3] - oh[4]
+            agent.action.u = u * 5.0
+            agent.action.c = np.eye(10)[talk[i]]
+        world.step()
+        ref_obs = [scenario.observation(a, world) for a in world.agents]
+        ref_rew = sum(float(scenario.reward(a, world)) for a in world.agents)
+
+        act = jnp.asarray(np.stack([move, talk], axis=1), jnp.float32)
+        state, ts = step(state, act)
+        got = np.asarray(ts.obs)
+        for i in range(2):
+            d = len(ref_obs[i])
+            np.testing.assert_allclose(
+                got[i, :d], ref_obs[i], rtol=1e-4, atol=1e-5,
+                err_msg=f"obs agent {i} t={t}",
+            )
+            np.testing.assert_allclose(got[i, -2:], np.eye(2)[i], atol=1e-6)
+        # collaborative: both rows carry the summed reward
+        np.testing.assert_allclose(
+            np.asarray(ts.reward[:, 0]), ref_rew, rtol=1e-4, atol=1e-4,
+            err_msg=f"reward t={t}",
+        )
 
 
 @pytest.mark.parametrize("env_cls,cfg_cls", [
