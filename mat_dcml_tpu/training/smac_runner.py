@@ -91,13 +91,15 @@ class SMACMultiRunner(BaseRunner):
     """One policy, many maps, via the universal translated layout."""
 
     def __init__(self, run: RunConfig, ppo: PPOConfig,
-                 train_maps: Sequence[str], log_fn=print):
+                 train_maps: Sequence[str], random_order: bool = False,
+                 log_fn=print):
         if run.algorithm_name not in ("mat", "mat_dec"):
             raise NotImplementedError(
                 "multi-map training drives the MAT family (smac_multi_runner.py)"
             )
         self.train_maps = tuple(train_maps)
-        self.envs = {m: TranslatedSMACEnv(SMACLiteConfig(map_name=m)) for m in self.train_maps}
+        self.random_order = random_order
+        self.envs = {m: self._make_env(m) for m in self.train_maps}
         probe = next(iter(self.envs.values()))
         self.env = probe
         self.is_mat = True
@@ -112,6 +114,18 @@ class SMACMultiRunner(BaseRunner):
         self.collector = self.collectors[self.train_maps[0]]
         self.finalize(run, log_fn)
         self._collects = {m: jax.jit(c.collect) for m, c in self.collectors.items()}
+
+    def _make_env(self, map_name: str):
+        env = TranslatedSMACEnv(SMACLiteConfig(map_name=map_name))
+        if self.random_order:
+            # translated multi-map + per-episode shuffling reproduces the
+            # Random_StarCraft2_Env_Multi combination by composition; eval
+            # maps (incl. held-out) go through the same wrapper so win rates
+            # are comparable across maps
+            from mat_dcml_tpu.envs.permute import AgentPermutationWrapper
+
+            env = AgentPermutationWrapper(env)
+        return env
 
     def setup(self, seed: Optional[int] = None):
         seed = self.run_cfg.seed if seed is None else seed
@@ -175,12 +189,13 @@ class SMACMultiRunner(BaseRunner):
         maps = tuple(maps) if maps is not None else self.train_maps
         out = {}
         for m in maps:
-            env = self.envs.get(m) or TranslatedSMACEnv(SMACLiteConfig(map_name=m))
+            env = self.envs.get(m) or self._make_env(m)
             collector = RolloutCollector(env, self.policy, self.run_cfg.episode_length)
             sub = SMACRunner.__new__(SMACRunner)       # reuse the eval loop only
             sub.run_cfg = self.run_cfg
             sub.policy = self.policy
             sub.collector = collector
+            sub.is_mat = True                          # multi-map is MAT-only
             info = SMACRunner.evaluate(sub, train_state, n_episodes=n_episodes, seed=seed)
             out[f"eval_win_rate_{m}"] = info["eval_win_rate"]
         return out

@@ -1,0 +1,52 @@
+"""Model-statistics hooks: parameter counts and analytic FLOP estimates.
+
+The reference carries both as ad-hoc instrumentation — a ``THOP_FLAG`` that
+reroutes ``MultiAgentTransformer.forward`` so the thop profiler can count
+MACs (``ma_transformer.py:257-280``) and a commented parameter-count block
+(``transformer_policy.py:89-102``).  The XLA-native equivalents need no
+third-party profiler: parameters are pytree leaves, and every jitted
+computation exposes the compiler's own analytic cost model through
+``lower(...).cost_analysis()``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+import numpy as np
+
+
+def param_count(params: Any) -> int:
+    """Total trainable scalars in a parameter pytree
+    (``transformer_policy.py:89-102``)."""
+    return int(sum(np.prod(l.shape) for l in jax.tree.leaves(params)))
+
+
+def param_bytes(params: Any) -> int:
+    """On-device parameter footprint in bytes."""
+    return int(sum(l.size * l.dtype.itemsize for l in jax.tree.leaves(params)))
+
+
+def flop_estimate(fn: Callable, *args, **kwargs) -> Optional[float]:
+    """XLA's analytic FLOP count for one call of ``fn(*args)``.
+
+    The ``THOP_FLAG`` equivalent (``ma_transformer.py:277-280``): returns
+    compiler-counted FLOPs for the optimized HLO, or None when the backend
+    does not expose a cost model.  Traces + compiles but does not execute.
+    """
+    try:
+        lowered = jax.jit(fn).lower(*args, **kwargs)
+        cost = lowered.compile().cost_analysis()
+        if not cost:  # some backends return {} / None
+            return None
+        flops = cost.get("flops")
+        return float(flops) if flops is not None else None
+    except Exception:
+        return None
+
+
+def model_stats_line(params: Any) -> str:
+    """One-line summary for runner startup logs."""
+    n = param_count(params)
+    return f"params {n:,} ({param_bytes(params) / 2**20:.2f} MiB)"

@@ -76,6 +76,23 @@ class TestACTLayer:
         assert action.shape == (B, 3) and logp.shape == (B, 3)
         np.testing.assert_allclose(logp, logp_eval, rtol=1e-5)
 
+    def test_multi_discrete_flat_availability_mask(self):
+        """Unequal-width heads (MPE move+comm) read flat per-head mask
+        segments [0:5] and [5:15]; masking all but one choice per head must
+        force that choice in both sample and evaluate."""
+        sp = MultiDiscrete((5, 10))
+        layer = ACTLayer(sp)
+        x = jax.random.normal(jax.random.key(0), (B, 16))
+        avail = jnp.zeros((B, 15)).at[:, 3].set(1.0).at[:, 5 + 7].set(1.0)
+        params = layer.init(jax.random.key(1), x, jax.random.key(2), avail, method="sample")
+        action, logp = layer.apply(params, x, jax.random.key(3), avail, False, method="sample")
+        np.testing.assert_array_equal(np.asarray(action[:, 0]), 3.0)
+        np.testing.assert_array_equal(np.asarray(action[:, 1]), 7.0)
+        # forced choices have probability 1 under the masked distributions
+        np.testing.assert_allclose(np.asarray(logp), 0.0, atol=1e-5)
+        logp_eval, _ = layer.apply(params, x, action, avail, None, method="evaluate")
+        np.testing.assert_allclose(np.asarray(logp_eval), 0.0, atol=1e-5)
+
     def test_multibinary(self):
         action, logp, logp_eval, _ = _run_act(MultiBinary(4), 16)
         assert action.shape == (B, 4) and logp.shape == (B, 1)

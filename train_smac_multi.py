@@ -36,12 +36,15 @@ def main(argv=None):
     extras = argparse.ArgumentParser(add_help=False)
     extras.add_argument("--train_maps", type=str, default="3m,8m")
     extras.add_argument("--eval_maps", type=str, default="")
+    # per-episode agent shuffling (Random_StarCraft2_Env_Multi equivalent)
+    extras.add_argument("--random_order", action="store_true")
     run, ppo, ns = parse_cli_with_extras(argv, extras=extras, overrides={
         "env_name": "StarCraft2Multi", "scenario": "multi", "episode_length": 60,
     })
     train_maps = _maps(ns.train_maps)
     eval_maps = _maps(ns.eval_maps) if ns.eval_maps else train_maps
-    runner = SMACMultiRunner(run, ppo, train_maps=train_maps)
+    runner = SMACMultiRunner(run, ppo, train_maps=train_maps,
+                             random_order=ns.random_order)
     print(f"algorithm={run.algorithm_name} maps={train_maps} "
           f"episodes={run.episodes} devices={len(__import__('jax').devices())}")
     state, _ = runner.train_loop()
