@@ -2,6 +2,7 @@ from mat_dcml_tpu.envs.mpe.simple_adversary import (
     SimpleAdversaryConfig,
     SimpleAdversaryEnv,
 )
+from mat_dcml_tpu.envs.mpe.simple_crypto import SimpleCryptoConfig, SimpleCryptoEnv
 from mat_dcml_tpu.envs.mpe.simple_push import SimplePushConfig, SimplePushEnv
 from mat_dcml_tpu.envs.mpe.simple_reference import (
     SimpleReferenceConfig,
@@ -28,11 +29,14 @@ SCENARIOS = {
     "simple_adversary": (SimpleAdversaryEnv, SimpleAdversaryConfig),
     "simple_push": (SimplePushEnv, SimplePushConfig),
     "simple_reference": (SimpleReferenceEnv, SimpleReferenceConfig),
+    "simple_crypto": (SimpleCryptoEnv, SimpleCryptoConfig),
 }
 
 __all__ = [
     "SimpleAdversaryConfig",
     "SimpleAdversaryEnv",
+    "SimpleCryptoConfig",
+    "SimpleCryptoEnv",
     "SimplePushConfig",
     "SimplePushEnv",
     "SimpleReferenceConfig",

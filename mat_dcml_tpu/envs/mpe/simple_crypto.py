@@ -1,0 +1,141 @@
+"""Pure-JAX MPE ``simple_crypto`` (covert communication).
+
+Reference: ``mat_src/mat/envs/mpe/scenarios/simple_crypto.py``.  Three
+immovable agents: Eve (agent 0, adversary), Bob (agent 1, good listener),
+Alice (agent 2, speaker).  Alice sees the goal landmark's color and a
+private key shared only with Bob; both Bob and Eve hear her message; each
+"speaks" a reconstruction through its own comm channel.  The good team is
+rewarded when Bob's utterance matches the goal color and Eve's does not;
+Eve is rewarded for matching it.
+
+Faithful semantics:
+
+- ``dim_c = 4``; every agent is ``movable=False`` and not silent
+  (``simple_crypto.py:27-35``), so each agent's action is ONE categorical
+  comm symbol (``environment.py`` exposes the comm-only Discrete(dim_c)
+  space for immovable speakers) — positions never change and never enter
+  any observation; the scenario is a pure signalling game.
+- Landmark i's "color" is the one-hot ``e_i`` in dim_c channels
+  (``:54-59``); the goal and the key are independent uniformly-chosen
+  landmarks (``:61-64``) — the key is the landmark COLOR, not an index.
+- Rewards after comm update (per-agent, non-collaborative):
+  Eve: ``-|c_Eve - goal_color|²``; Alice and Bob share
+  ``-|c_Bob - goal_color|² + |c_Eve - goal_color|²`` (``:98-122``;
+  the all-zero-comm skip only fires before any message exists, which the
+  one-hot comm alphabet makes unreachable after the first step).
+- Obs: Alice ``[goal_color(4), key(4)]``; Bob ``[key(4), alice_comm(4)]``;
+  Eve ``[alice_comm(4)]`` zero-padded (``:124-171`` — only the SPEAKER's
+  comm is audible, and positions are absent) + one-hot id.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+class CryptoState(NamedTuple):
+    rng: jax.Array
+    goal: jax.Array           # () int32 landmark index
+    key: jax.Array            # () int32 landmark index (Alice+Bob's secret)
+    comm: jax.Array           # (3, dim_c) last utterances [Eve, Bob, Alice]
+    t: jax.Array
+
+
+class CryptoTimeStep(NamedTuple):
+    obs: jax.Array
+    share_obs: jax.Array
+    available_actions: jax.Array
+    reward: jax.Array
+    done: jax.Array
+    delay: jax.Array
+    payment: jax.Array
+
+
+@dataclasses.dataclass(frozen=True)
+class SimpleCryptoConfig:
+    n_landmarks: int = 2      # simple_crypto.py:26 (args.num_landmarks # 2)
+    dim_c: int = 4
+    episode_length: int = 25
+    n_agents: int = 3
+
+    def __post_init__(self):
+        if self.n_agents != 3:
+            raise ValueError("simple_crypto is a 3-agent scenario (Eve/Bob/Alice)")
+        if self.n_landmarks > self.dim_c:
+            raise ValueError("landmark one-hot colors need n_landmarks <= dim_c")
+
+
+class SimpleCryptoEnv:
+    """Functional env bundle; same TimeStep protocol as simple_spread."""
+
+    EVE, BOB, ALICE = 0, 1, 2
+
+    def __init__(self, cfg: SimpleCryptoConfig = SimpleCryptoConfig()):
+        self.cfg = cfg
+        self.n_agents = 3
+        self._core_dim = 2 * cfg.dim_c    # widest rows: Alice/Bob
+        self.obs_dim = self._core_dim + 3
+        self.share_obs_dim = self.obs_dim * 3
+        self.action_dim = cfg.dim_c       # comm symbol (Discrete(dim_c))
+
+    def _spawn(self, key: jax.Array) -> CryptoState:
+        c = self.cfg
+        key, k_g, k_k = jax.random.split(key, 3)
+        return CryptoState(
+            rng=key,
+            goal=jax.random.randint(k_g, (), 0, c.n_landmarks),
+            key=jax.random.randint(k_k, (), 0, c.n_landmarks),
+            comm=jnp.zeros((3, c.dim_c)),
+            t=jnp.zeros((), jnp.int32),
+        )
+
+    def _observe(self, st: CryptoState):
+        c = self.cfg
+        goal_color = jax.nn.one_hot(st.goal, c.dim_c)
+        key_color = jax.nn.one_hot(st.key, c.dim_c)
+        alice_comm = st.comm[self.ALICE]
+        pad = jnp.zeros((c.dim_c,))
+        rows = jnp.stack([
+            jnp.concatenate([alice_comm, pad]),          # Eve
+            jnp.concatenate([key_color, alice_comm]),    # Bob
+            jnp.concatenate([goal_color, key_color]),    # Alice
+        ])
+        obs = jnp.concatenate([rows, jnp.eye(3)], axis=1)
+        share = jnp.broadcast_to(obs.reshape(-1), (3, self.share_obs_dim))
+        avail = jnp.ones((3, self.action_dim))
+        return obs, share, avail
+
+    def reset(self, key: jax.Array, episode_idx=0) -> Tuple[CryptoState, CryptoTimeStep]:
+        del episode_idx
+        st = self._spawn(key)
+        obs, share, avail = self._observe(st)
+        zero = jnp.zeros(())
+        return st, CryptoTimeStep(
+            obs, share, avail, jnp.zeros((3, 1)), jnp.zeros((3,), bool), zero, zero
+        )
+
+    def step(self, st: CryptoState, action: jax.Array) -> Tuple[CryptoState, CryptoTimeStep]:
+        c = self.cfg
+        act = action.reshape(3, -1)[:, 0].astype(jnp.int32)
+        comm = jax.nn.one_hot(jnp.clip(act, 0, c.dim_c - 1), c.dim_c)
+        stepped = CryptoState(st.rng, st.goal, st.key, comm, st.t + 1)
+
+        goal_color = jax.nn.one_hot(stepped.goal, c.dim_c)
+        eve_err = jnp.sum((comm[self.EVE] - goal_color) ** 2)
+        bob_err = jnp.sum((comm[self.BOB] - goal_color) ** 2)
+        good_rew = -bob_err + eve_err
+        reward = jnp.stack([-eve_err, good_rew, good_rew])
+        done_now = stepped.t >= c.episode_length
+
+        fresh = self._spawn(st.rng)
+        new_st = jax.tree.map(lambda a, b: jnp.where(done_now, a, b), fresh, stepped)
+        obs, share, avail = self._observe(new_st)
+        zero = jnp.zeros(())
+        return new_st, CryptoTimeStep(
+            obs, share, avail, reward[:, None],
+            jnp.broadcast_to(done_now, (3,)), zero, zero,
+        )

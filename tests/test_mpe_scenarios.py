@@ -25,6 +25,8 @@ import jax.numpy as jnp
 from mat_dcml_tpu.envs.mpe import (
     SimpleAdversaryConfig,
     SimpleAdversaryEnv,
+    SimpleCryptoConfig,
+    SimpleCryptoEnv,
     SimplePushConfig,
     SimplePushEnv,
     SimpleReferenceConfig,
@@ -33,6 +35,7 @@ from mat_dcml_tpu.envs.mpe import (
     SimpleTagEnv,
 )
 from mat_dcml_tpu.envs.mpe.simple_adversary import AdversaryState
+from mat_dcml_tpu.envs.mpe.simple_crypto import CryptoState
 from mat_dcml_tpu.envs.mpe.simple_push import PushState
 from mat_dcml_tpu.envs.mpe.simple_reference import ReferenceState
 from mat_dcml_tpu.envs.mpe.simple_tag import TagState
@@ -59,7 +62,7 @@ def ref_mpe():
     return {
         name: _load(f"mat.envs.mpe.scenarios.{name}", REF / "scenarios" / f"{name}.py").Scenario()
         for name in ["simple_tag", "simple_adversary", "simple_push",
-                     "simple_reference"]
+                     "simple_reference", "simple_crypto"]
     }
 
 
@@ -235,10 +238,62 @@ def test_simple_reference_parity(ref_mpe):
         )
 
 
+def test_simple_crypto_parity(ref_mpe):
+    """Pure signalling game: every agent emits one comm symbol per step;
+    positions are spawned but never observed or moved."""
+    scenario = ref_mpe["simple_crypto"]
+
+    class CryptoArgs(_Args):
+        num_agents = 3
+        num_landmarks = 2
+
+    np.random.seed(4)
+    world = scenario.make_world(CryptoArgs())
+    scenario.reset_world(world)
+    goal = next(i for i, l in enumerate(world.landmarks) if l is world.agents[0].goal_a)
+    key_idx = int(np.argmax(world.agents[2].key))
+    env = SimpleCryptoEnv(SimpleCryptoConfig())
+    state = CryptoState(
+        rng=jax.random.key(0),
+        goal=jnp.asarray(goal, jnp.int32),
+        key=jnp.asarray(key_idx, jnp.int32),
+        comm=jnp.zeros((3, 4)),
+        t=jnp.zeros((), jnp.int32),
+    )
+    step = jax.jit(env.step)
+    rng = np.random.RandomState(13)
+    for t in range(8):
+        sym = rng.randint(0, 4, size=3)
+        # reference driver: comm one-hot -> action.c -> world.step copies to
+        # state.c (agents immovable: physics is a no-op)
+        for i, agent in enumerate(world.agents):
+            agent.action.u = np.zeros(2)
+            agent.action.c = np.eye(4)[sym[i]]
+        world.step()
+        ref_obs = [scenario.observation(a, world) for a in world.agents]
+        ref_rew = [float(scenario.reward(a, world)) for a in world.agents]
+
+        state, ts = step(state, jnp.asarray(sym[:, None], jnp.float32))
+        got = np.asarray(ts.obs)
+        for i in range(3):
+            d = len(ref_obs[i])
+            np.testing.assert_allclose(
+                got[i, :d], ref_obs[i], rtol=1e-5, atol=1e-6,
+                err_msg=f"obs agent {i} t={t}",
+            )
+            np.testing.assert_allclose(got[i, d:-3], 0.0, atol=1e-6)
+            np.testing.assert_allclose(got[i, -3:], np.eye(3)[i], atol=1e-6)
+        np.testing.assert_allclose(
+            np.asarray(ts.reward[:, 0]), ref_rew, rtol=1e-5, atol=1e-5,
+            err_msg=f"reward t={t}",
+        )
+
+
 @pytest.mark.parametrize("env_cls,cfg_cls", [
     (SimpleTagEnv, SimpleTagConfig),
     (SimpleAdversaryEnv, SimpleAdversaryConfig),
     (SimplePushEnv, SimplePushConfig),
+    (SimpleCryptoEnv, SimpleCryptoConfig),
 ])
 def test_vmap_autoreset_shapes(env_cls, cfg_cls):
     env = env_cls(cfg_cls(episode_length=4))
