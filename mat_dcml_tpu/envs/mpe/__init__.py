@@ -2,6 +2,7 @@ from mat_dcml_tpu.envs.mpe.simple_adversary import (
     SimpleAdversaryConfig,
     SimpleAdversaryEnv,
 )
+from mat_dcml_tpu.envs.mpe.simple_attack import SimpleAttackConfig, SimpleAttackEnv
 from mat_dcml_tpu.envs.mpe.simple_crypto import SimpleCryptoConfig, SimpleCryptoEnv
 from mat_dcml_tpu.envs.mpe.simple_push import SimplePushConfig, SimplePushEnv
 from mat_dcml_tpu.envs.mpe.simple_reference import (
@@ -19,6 +20,10 @@ from mat_dcml_tpu.envs.mpe.simple_spread import (
     SpreadTimeStep,
 )
 from mat_dcml_tpu.envs.mpe.simple_tag import SimpleTagConfig, SimpleTagEnv
+from mat_dcml_tpu.envs.mpe.simple_world_comm import (
+    SimpleWorldCommConfig,
+    SimpleWorldCommEnv,
+)
 
 # scenario registry (reference: mat/envs/mpe/scenarios/__init__.py load());
 # simple_spread is the one used by the shipped MPE training recipe
@@ -30,11 +35,15 @@ SCENARIOS = {
     "simple_push": (SimplePushEnv, SimplePushConfig),
     "simple_reference": (SimpleReferenceEnv, SimpleReferenceConfig),
     "simple_crypto": (SimpleCryptoEnv, SimpleCryptoConfig),
+    "simple_attack": (SimpleAttackEnv, SimpleAttackConfig),
+    "simple_world_comm": (SimpleWorldCommEnv, SimpleWorldCommConfig),
 }
 
 __all__ = [
     "SimpleAdversaryConfig",
     "SimpleAdversaryEnv",
+    "SimpleAttackConfig",
+    "SimpleAttackEnv",
     "SimpleCryptoConfig",
     "SimpleCryptoEnv",
     "SimplePushConfig",
@@ -47,6 +56,8 @@ __all__ = [
     "SimpleSpreadEnv",
     "SimpleTagConfig",
     "SimpleTagEnv",
+    "SimpleWorldCommConfig",
+    "SimpleWorldCommEnv",
     "SpreadState",
     "SpreadTimeStep",
     "SCENARIOS",

@@ -1,0 +1,187 @@
+"""Pure-JAX MPE ``simple_attack`` (goal-seeking with interception).
+
+Reference: ``mat_src/mat/envs/mpe/scenarios/simple_attack.py`` (an
+author-added scenario, not in upstream MPE).  Every agent — adversaries
+first — has its own index-matched goal landmark (``reset_world``
+``:54``: ``world.agents[i].goal = landmark_i``, hence the
+``num_landmarks == num_agents`` assert ``:14``); all agents share one
+body type (size 0.075, accel 3.0, max_speed 1.0, ``:22-25``) and landmarks
+are large collidable obstacles (``:29-33``).
+
+Rewards (per-agent, ``:97-146``): both roles get ``-|pos - goal|`` plus a
++0.5 bonus inside the goal radius and the screen-exit ``bound`` penalty;
+good agents additionally lose 0.1 per adversary within 0.15 and 0.5 per
+touching adversary; adversaries lose 0.5 per (good, adversary) contact
+pair anywhere on the field.
+
+Obs (``:148-163``): ``[vel(2), pos(2), landmark_rel(2L), other_pos(2(N-1)),
+other_vel(2(N-1))]`` — ALL others' velocities, so rows are homogeneous
+(no padding needed) + one-hot id appended by the driver.
+
+Reference defects documented, not replicated:
+- ``bound`` is defined as a class-level function and called as a bare
+  name inside both reward methods (``:89-95,118,143``) — a ``NameError``
+  at first reward call; the scenario cannot actually run upstream.  The
+  evident intent (simple_tag's piecewise bound penalty) is implemented.
+- ``self.agent_failed`` is set unconditionally under ``if agent.collide``
+  (``:115``), making ``info['fail']`` always true after one step; not
+  carried.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from mat_dcml_tpu.envs.mpe import particle
+
+
+class AttackState(NamedTuple):
+    rng: jax.Array
+    agent_pos: jax.Array      # (N, 2), adversaries first
+    agent_vel: jax.Array
+    landmark_pos: jax.Array   # (N, 2) — one goal landmark per agent
+    t: jax.Array
+
+
+class AttackTimeStep(NamedTuple):
+    obs: jax.Array
+    share_obs: jax.Array
+    available_actions: jax.Array
+    reward: jax.Array
+    done: jax.Array
+    delay: jax.Array
+    payment: jax.Array
+
+
+@dataclasses.dataclass(frozen=True)
+class SimpleAttackConfig:
+    # the reference's annotated defaults (3 adversaries + 1 good,
+    # 3 landmarks, ``:10-13``) violate its own num_landmarks == num_agents
+    # assert (``:14``); resolved here by keeping 3 landmarks and dropping to
+    # 2 adversaries so the constraint holds
+    n_good: int = 1
+    n_adversaries: int = 2
+    episode_length: int = 25
+    agent_size: float = 0.075
+    accel: float = 3.0
+    max_speed: float = 1.0
+    landmark_size: float = 0.2
+
+    @property
+    def n_agents(self) -> int:
+        return self.n_adversaries + self.n_good
+
+    @property
+    def n_landmarks(self) -> int:
+        return self.n_agents  # simple_attack.py:14 assert
+
+
+class SimpleAttackEnv:
+    """Functional env bundle; same TimeStep protocol as simple_spread."""
+
+    def __init__(self, cfg: SimpleAttackConfig = SimpleAttackConfig()):
+        self.cfg = cfg
+        N = cfg.n_agents
+        self.n_agents = N
+        self.obs_dim = 4 + 2 * cfg.n_landmarks + 4 * (N - 1) + N
+        self.share_obs_dim = self.obs_dim * N
+        self.action_dim = 5
+        self._sizes = jnp.asarray(
+            [cfg.agent_size] * N + [cfg.landmark_size] * cfg.n_landmarks
+        )
+        self._collide = jnp.ones((N + cfg.n_landmarks,), bool)
+        self._movable = jnp.asarray([True] * N + [False] * cfg.n_landmarks)
+        self._max_speed = jnp.full((N,), cfg.max_speed)
+        self._gain = jnp.full((N,), particle.force_gain(cfg.accel))
+
+    def _spawn(self, key: jax.Array) -> AttackState:
+        c = self.cfg
+        key, k_a, k_l = jax.random.split(key, 3)
+        return AttackState(
+            rng=key,
+            agent_pos=jax.random.uniform(k_a, (c.n_agents, 2), minval=-1.0, maxval=1.0),
+            agent_vel=jnp.zeros((c.n_agents, 2)),
+            landmark_pos=0.8 * jax.random.uniform(k_l, (c.n_landmarks, 2), minval=-1.0, maxval=1.0),
+            t=jnp.zeros((), jnp.int32),
+        )
+
+    def reset(self, key: jax.Array, episode_idx=0) -> Tuple[AttackState, AttackTimeStep]:
+        del episode_idx
+        st = self._spawn(key)
+        obs, share, avail = self._observe(st)
+        N = self.cfg.n_agents
+        zero = jnp.zeros(())
+        return st, AttackTimeStep(
+            obs, share, avail, jnp.zeros((N, 1)), jnp.zeros((N,), bool), zero, zero
+        )
+
+    def step(self, st: AttackState, action: jax.Array) -> Tuple[AttackState, AttackTimeStep]:
+        c = self.cfg
+        N = c.n_agents
+        act = action.reshape(N, -1)
+        onehot = (
+            jax.nn.one_hot(act[:, 0].astype(jnp.int32), 5)
+            if act.shape[-1] == 1 else act.astype(jnp.float32)
+        )
+        u = particle.decode_move(onehot) * self._gain[:, None]
+        entity_pos = jnp.concatenate([st.agent_pos, st.landmark_pos])
+        coll = particle.collision_forces(
+            entity_pos, self._sizes, self._collide, self._movable
+        )[:N]
+        vel = particle.integrate(st.agent_vel, u + coll, self._max_speed)
+        pos = st.agent_pos + vel * particle.DT
+
+        stepped = AttackState(st.rng, pos, vel, st.landmark_pos, st.t + 1)
+        reward = self._reward(stepped)
+        done_now = stepped.t >= c.episode_length
+
+        fresh = self._spawn(st.rng)
+        new_st = jax.tree.map(lambda a, b: jnp.where(done_now, a, b), fresh, stepped)
+        obs, share, avail = self._observe(new_st)
+        zero = jnp.zeros(())
+        return new_st, AttackTimeStep(
+            obs, share, avail, reward[:, None],
+            jnp.broadcast_to(done_now, (N,)), zero, zero,
+        )
+
+    def _reward(self, st: AttackState) -> jax.Array:
+        c = self.cfg
+        A = c.n_adversaries
+        # shared terms: own-goal shaping + screen-exit penalty
+        goal_d = jnp.linalg.norm(st.agent_pos - st.landmark_pos, axis=-1)  # (N,)
+        base = -goal_d + 0.5 * (goal_d < c.landmark_size) - particle.bound_penalty(st.agent_pos)
+
+        adv_pos, good_pos = st.agent_pos[:A], st.agent_pos[A:]
+        d = jnp.linalg.norm(good_pos[:, None, :] - adv_pos[None, :, :], axis=-1)  # (G, A)
+        contact = d < 2.0 * c.agent_size
+        # good: -0.1 per nearby adversary, -0.5 per touching adversary
+        good_pen = 0.1 * (d < 0.15).sum(axis=1) + 0.5 * contact.sum(axis=1)
+        # adversaries: -0.5 per (good, adversary) contact pair, shared
+        adv_pen = jnp.full((A,), 0.5 * contact.sum())
+        return base - jnp.concatenate([adv_pen, good_pen])
+
+    def _observe(self, st: AttackState):
+        c = self.cfg
+        N = c.n_agents
+        idx = jnp.arange(N)
+        landmark_rel = (
+            st.landmark_pos[None, :, :] - st.agent_pos[:, None, :]
+        ).reshape(N, -1)
+        rel = st.agent_pos[None, :, :] - st.agent_pos[:, None, :]
+
+        def row(i):
+            others = jnp.where(idx != i, size=N - 1)[0]
+            return jnp.concatenate([
+                st.agent_vel[i], st.agent_pos[i], landmark_rel[i],
+                rel[i][others].reshape(-1), st.agent_vel[others].reshape(-1),
+            ])
+
+        core = jax.vmap(row)(idx)
+        obs = jnp.concatenate([core, jnp.eye(N)], axis=1)
+        share = jnp.broadcast_to(obs.reshape(-1), (N, self.share_obs_dim))
+        avail = jnp.ones((N, self.action_dim))
+        return obs, share, avail

@@ -1,0 +1,247 @@
+"""Pure-JAX MPE ``simple_world_comm`` (leader-directed predator-prey world).
+
+Reference: ``mat_src/mat/envs/mpe/scenarios/simple_world_comm.py``.  Four
+adversaries — one of whom is a speaking LEADER — chase two faster prey
+around one obstacle, two food sites, and two forests that hide whoever
+stands in them.  The leader sees through forests and broadcasts a 4-symbol
+message to coordinate the pack.
+
+Faithful semantics:
+
+- Defaults 4 adversaries (leader = agent 0) + 2 good (``:11-14``); sizes
+  0.075/0.045, accel 3.0/4.0, max_speed 1.0/1.3 (``:25-28``); obstacle
+  collide size 0.2, food 0.03, forests 0.3, all spawned ``0.8·U(-1,1)²``
+  (``:30-56,100-113``); ``dim_c = 4``.
+- Actions: the leader is the only non-silent agent, so the reference gives
+  it ``MultiDiscrete([move(5), comm(4)])`` and everyone else plain move.
+  Here every agent gets the MultiDiscrete space with the comm head masked
+  to symbol 0 for silent agents (flat per-head availability segments) —
+  their messages are discarded exactly as ``core.py`` zeroes silent
+  agents' comm state.
+- Rewards (``:154-200``): prey lose 5 per touching adversary, pay
+  ``2·bound`` per dimension on screen exit, gain +2 per touched food and
+  ``+0.05·min_dist_to_food`` (the reference's sign quirk — it rewards
+  DISTANCE from food — replicated); each adversary gets the shaped
+  ``-0.1·min_good_dist`` to itself plus a shared +5 per (prey, adversary)
+  contact pair.
+- Obs (``:225-287``): ``[vel, pos, entity_rel(2·5: obstacle+food+forests),
+  other_pos(2·5), (other_vel of prey), in_forest(±1,±1), leader_comm(4)]``
+  with forest concealment: another agent's pos/vel read zero unless the
+  viewer shares its forest, both are in the open, or the viewer is the
+  leader.  Prey rows omit the comm block and put ``in_forest`` before
+  ``other_vel`` (``:287``), zero-padding to the adversary width; the
+  computed-but-unused ``food_pos``/``prey_forest`` blocks (``:241-246,
+  265-277``) are dead code in the reference and not replicated.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from mat_dcml_tpu.envs.mpe import particle
+from mat_dcml_tpu.envs.spaces import MultiDiscrete
+
+
+class WorldCommState(NamedTuple):
+    rng: jax.Array
+    agent_pos: jax.Array      # (N, 2): [leader, adversaries..., good...]
+    agent_vel: jax.Array
+    landmark_pos: jax.Array   # (1, 2) obstacle
+    food_pos: jax.Array       # (2, 2)
+    forest_pos: jax.Array     # (2, 2)
+    leader_comm: jax.Array    # (dim_c,)
+    t: jax.Array
+
+
+class WorldCommTimeStep(NamedTuple):
+    obs: jax.Array
+    share_obs: jax.Array
+    available_actions: jax.Array
+    reward: jax.Array
+    done: jax.Array
+    delay: jax.Array
+    payment: jax.Array
+
+
+@dataclasses.dataclass(frozen=True)
+class SimpleWorldCommConfig:
+    n_good: int = 2
+    n_adversaries: int = 4    # leader included (agent 0)
+    n_landmarks: int = 1
+    n_food: int = 2
+    n_forests: int = 2
+    dim_c: int = 4
+    episode_length: int = 25
+    adv_size: float = 0.075
+    good_size: float = 0.045
+    adv_accel: float = 3.0
+    good_accel: float = 4.0
+    adv_max_speed: float = 1.0
+    good_max_speed: float = 1.3
+    landmark_size: float = 0.2
+    food_size: float = 0.03
+    forest_size: float = 0.3
+
+    @property
+    def n_agents(self) -> int:
+        return self.n_adversaries + self.n_good
+
+
+class SimpleWorldCommEnv:
+    """Functional env bundle; same TimeStep protocol as simple_spread."""
+
+    def __init__(self, cfg: SimpleWorldCommConfig = SimpleWorldCommConfig()):
+        self.cfg = cfg
+        N, A, G = cfg.n_agents, cfg.n_adversaries, cfg.n_good
+        self.n_agents = N
+        n_entities = cfg.n_landmarks + cfg.n_food + cfg.n_forests
+        # adversary row is the widest: vel2+pos2+2*entities+2(N-1)+2G+2+dim_c
+        self._core_dim = 4 + 2 * n_entities + 2 * (N - 1) + 2 * G + 2 + cfg.dim_c
+        self.obs_dim = self._core_dim + N
+        self.share_obs_dim = self.obs_dim * N
+        self.action_space = MultiDiscrete((5, cfg.dim_c))
+        self.action_dim = self.action_space.sample_dim
+        self.avail_dim = 5 + cfg.dim_c
+        L = cfg.n_landmarks
+        self._sizes = jnp.asarray(
+            [cfg.adv_size] * A + [cfg.good_size] * G + [cfg.landmark_size] * L
+        )
+        self._collide = jnp.ones((N + L,), bool)
+        self._movable = jnp.asarray([True] * N + [False] * L)
+        self._max_speed = jnp.asarray(
+            [cfg.adv_max_speed] * A + [cfg.good_max_speed] * G
+        )
+        self._gain = jnp.asarray(
+            [particle.force_gain(cfg.adv_accel)] * A
+            + [particle.force_gain(cfg.good_accel)] * G
+        )
+        self._agent_sizes = self._sizes[:N]
+
+    def _spawn(self, key: jax.Array) -> WorldCommState:
+        c = self.cfg
+        key, k_a, k_l, k_fo, k_fr = jax.random.split(key, 5)
+        u = lambda k, n: 0.8 * jax.random.uniform(k, (n, 2), minval=-1.0, maxval=1.0)
+        return WorldCommState(
+            rng=key,
+            agent_pos=jax.random.uniform(k_a, (c.n_agents, 2), minval=-1.0, maxval=1.0),
+            agent_vel=jnp.zeros((c.n_agents, 2)),
+            landmark_pos=u(k_l, c.n_landmarks),
+            food_pos=u(k_fo, c.n_food),
+            forest_pos=u(k_fr, c.n_forests),
+            leader_comm=jnp.zeros((c.dim_c,)),
+            t=jnp.zeros((), jnp.int32),
+        )
+
+    def reset(self, key: jax.Array, episode_idx=0) -> Tuple[WorldCommState, WorldCommTimeStep]:
+        del episode_idx
+        st = self._spawn(key)
+        obs, share, avail = self._observe(st)
+        N = self.cfg.n_agents
+        zero = jnp.zeros(())
+        return st, WorldCommTimeStep(
+            obs, share, avail, jnp.zeros((N, 1)), jnp.zeros((N,), bool), zero, zero
+        )
+
+    def step(self, st: WorldCommState, action: jax.Array) -> Tuple[WorldCommState, WorldCommTimeStep]:
+        c = self.cfg
+        N = c.n_agents
+        act = action.reshape(N, -1).astype(jnp.int32)
+        onehot = jax.nn.one_hot(act[:, 0], 5)
+        u = particle.decode_move(onehot) * self._gain[:, None]
+        comm = jax.nn.one_hot(jnp.clip(act[0, 1], 0, c.dim_c - 1), c.dim_c)
+
+        entity_pos = jnp.concatenate([st.agent_pos, st.landmark_pos])
+        coll = particle.collision_forces(
+            entity_pos, self._sizes, self._collide, self._movable
+        )[:N]
+        vel = particle.integrate(st.agent_vel, u + coll, self._max_speed)
+        pos = st.agent_pos + vel * particle.DT
+
+        stepped = WorldCommState(
+            st.rng, pos, vel, st.landmark_pos, st.food_pos, st.forest_pos,
+            comm, st.t + 1,
+        )
+        reward = self._reward(stepped)
+        done_now = stepped.t >= c.episode_length
+
+        fresh = self._spawn(st.rng)
+        new_st = jax.tree.map(lambda a, b: jnp.where(done_now, a, b), fresh, stepped)
+        obs, share, avail = self._observe(new_st)
+        zero = jnp.zeros(())
+        return new_st, WorldCommTimeStep(
+            obs, share, avail, reward[:, None],
+            jnp.broadcast_to(done_now, (N,)), zero, zero,
+        )
+
+    def _reward(self, st: WorldCommState) -> jax.Array:
+        c = self.cfg
+        A, G = c.n_adversaries, c.n_good
+        adv_pos, good_pos = st.agent_pos[:A], st.agent_pos[A:]
+        d = jnp.linalg.norm(good_pos[:, None, :] - adv_pos[None, :, :], axis=-1)  # (G, A)
+        contact = d < (c.good_size + c.adv_size)
+
+        food_d = jnp.linalg.norm(
+            good_pos[:, None, :] - st.food_pos[None, :, :], axis=-1
+        )  # (G, n_food)
+        food_touch = food_d < (c.good_size + c.food_size)
+        good_rew = (
+            -5.0 * contact.sum(axis=1)
+            - 2.0 * particle.bound_penalty(good_pos)
+            + 2.0 * food_touch.sum(axis=1)
+            + 0.05 * food_d.min(axis=1)   # reference sign quirk (see module doc)
+        )
+        adv_rew = -0.1 * d.min(axis=0) + 5.0 * contact.sum()
+        return jnp.concatenate([adv_rew, good_rew])
+
+    def _observe(self, st: WorldCommState):
+        c = self.cfg
+        N, A, G = c.n_agents, c.n_adversaries, c.n_good
+        idx = jnp.arange(N)
+        entities = jnp.concatenate([st.landmark_pos, st.food_pos, st.forest_pos])
+        entity_rel = (entities[None, :, :] - st.agent_pos[:, None, :]).reshape(N, -1)
+        rel = st.agent_pos[None, :, :] - st.agent_pos[:, None, :]
+
+        fd = jnp.linalg.norm(
+            st.agent_pos[:, None, :] - st.forest_pos[None, :, :], axis=-1
+        )  # (N, n_forests)
+        inf = fd < (self._agent_sizes[:, None] + c.forest_size)  # (N, 2)
+
+        def row(i):
+            others = jnp.where(idx != i, size=N - 1)[0]
+            # visibility: shared forest, both fully outside, or leader viewer
+            share_f = (inf[i][None, :] & inf[others]).any(axis=1)
+            both_out = ~inf[i].any() & ~inf[others].any(axis=1)
+            visible = share_f | both_out | (i == 0)
+            other_pos = jnp.where(visible[:, None], rel[i][others], 0.0).reshape(-1)
+            # visibility re-indexed by agent id (padded id N stays invisible)
+            vis_by_id = jnp.zeros((N + 1,), bool).at[others].set(visible)
+            good_others = jnp.where((idx != i) & (idx >= A), size=G, fill_value=N)[0]
+            pad_vel = jnp.concatenate([st.agent_vel, jnp.zeros((1, 2))])
+            other_vel = jnp.where(
+                vis_by_id[good_others][:, None], pad_vel[good_others], 0.0
+            ).reshape(-1)
+            in_forest = jnp.where(inf[i], 1.0, -1.0)
+            adv_row = jnp.concatenate([
+                st.agent_vel[i], st.agent_pos[i], entity_rel[i], other_pos,
+                other_vel, in_forest, st.leader_comm,
+            ])
+            pad = self._core_dim - (4 + entity_rel.shape[1] + other_pos.shape[0]
+                                    + 2 * (G - 1) + 2)
+            good_row = jnp.concatenate([
+                st.agent_vel[i], st.agent_pos[i], entity_rel[i], other_pos,
+                in_forest, other_vel[: 2 * (G - 1)], jnp.zeros((pad,)),
+            ])
+            return jnp.where(i < A, adv_row, good_row)
+
+        core = jax.vmap(row)(idx)
+        obs = jnp.concatenate([core, jnp.eye(N)], axis=1)
+        share = jnp.broadcast_to(obs.reshape(-1), (N, self.share_obs_dim))
+        # comm head masked to symbol 0 for every silent agent (leader free)
+        move_avail = jnp.ones((N, 5))
+        comm_avail = jnp.zeros((N, c.dim_c)).at[:, 0].set(1.0).at[0].set(1.0)
+        avail = jnp.concatenate([move_avail, comm_avail], axis=1)
+        return obs, share, avail

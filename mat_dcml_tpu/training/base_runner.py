@@ -105,12 +105,15 @@ class BaseRunner:
             self.start_episode = (mgr.latest_step or 0) + 1
             self.log(f"restored checkpoint step {mgr.latest_step} from {self.run_cfg.model_dir}")
         rollout_state = self.collector.init_state(k_roll, self.run_cfg.n_rollout_threads)
-        # the reference's parameter-count block + THOP hook, XLA-native
-        # (utils/profiling.py); one line at startup, like its commented probe
+        self._log_model_stats(train_state)
+        return train_state, rollout_state
+
+    def _log_model_stats(self, train_state) -> None:
+        """The reference's parameter-count block + THOP hook, XLA-native
+        (utils/profiling.py); one line at startup, like its commented probe."""
         from mat_dcml_tpu.utils.profiling import model_stats_line
 
         self.log(model_stats_line(train_state.params))
-        return train_state, rollout_state
 
     # ------------------------------------------------------------------ train
 

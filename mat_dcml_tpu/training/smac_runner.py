@@ -137,9 +137,7 @@ class SMACMultiRunner(BaseRunner):
             m: self.collectors[m].init_state(k, self.run_cfg.n_rollout_threads)
             for m, k in zip(self.train_maps, k_rolls)
         }
-        from mat_dcml_tpu.utils.profiling import model_stats_line
-
-        self.log(model_stats_line(train_state.params))
+        self._log_model_stats(train_state)
         return train_state, rollout_states
 
     def train_loop(self, num_episodes: Optional[int] = None, train_state=None,

@@ -25,6 +25,8 @@ import jax.numpy as jnp
 from mat_dcml_tpu.envs.mpe import (
     SimpleAdversaryConfig,
     SimpleAdversaryEnv,
+    SimpleAttackConfig,
+    SimpleAttackEnv,
     SimpleCryptoConfig,
     SimpleCryptoEnv,
     SimplePushConfig,
@@ -33,12 +35,16 @@ from mat_dcml_tpu.envs.mpe import (
     SimpleReferenceEnv,
     SimpleTagConfig,
     SimpleTagEnv,
+    SimpleWorldCommConfig,
+    SimpleWorldCommEnv,
 )
 from mat_dcml_tpu.envs.mpe.simple_adversary import AdversaryState
+from mat_dcml_tpu.envs.mpe.simple_attack import AttackState
 from mat_dcml_tpu.envs.mpe.simple_crypto import CryptoState
 from mat_dcml_tpu.envs.mpe.simple_push import PushState
 from mat_dcml_tpu.envs.mpe.simple_reference import ReferenceState
 from mat_dcml_tpu.envs.mpe.simple_tag import TagState
+from mat_dcml_tpu.envs.mpe.simple_world_comm import WorldCommState
 
 REF = Path("/root/reference/mat_src/mat/envs/mpe")
 
@@ -62,7 +68,8 @@ def ref_mpe():
     return {
         name: _load(f"mat.envs.mpe.scenarios.{name}", REF / "scenarios" / f"{name}.py").Scenario()
         for name in ["simple_tag", "simple_adversary", "simple_push",
-                     "simple_reference", "simple_crypto"]
+                     "simple_reference", "simple_crypto", "simple_attack",
+                     "simple_world_comm"]
     }
 
 
@@ -74,7 +81,7 @@ class _Args:
     num_adversaries = 3
 
 
-def _ref_step(world, scenario, actions_idx):
+def _ref_step(world, scenario, actions_idx, compute_rewards=True):
     """One reference env step (``environment.py:125-166``), per-agent rewards."""
     onehot = np.eye(5)[actions_idx]
     for i, agent in enumerate(world.agents):
@@ -86,6 +93,8 @@ def _ref_step(world, scenario, actions_idx):
         agent.action.c = np.zeros(world.dim_c)
     world.step()
     obs_n = [scenario.observation(a, world) for a in world.agents]
+    if not compute_rewards:
+        return obs_n, None
     rew_n = [float(scenario.reward(a, world)) for a in world.agents]
     return obs_n, np.asarray(rew_n)
 
@@ -289,11 +298,123 @@ def test_simple_crypto_parity(ref_mpe):
         )
 
 
+def test_simple_world_comm_parity(ref_mpe):
+    """Leader-directed predator-prey with forest concealment: obs (incl.
+    visibility zeroing and the leader's broadcast), per-agent rewards, and
+    physics all lockstep with the reference World."""
+    scenario = ref_mpe["simple_world_comm"]
+
+    class WCArgs(_Args):
+        num_good_agents = 2
+        num_adversaries = 4
+        num_landmarks = 1
+
+    np.random.seed(6)
+    world = scenario.make_world(WCArgs())
+    scenario.reset_world(world)
+    env = SimpleWorldCommEnv(SimpleWorldCommConfig())
+    state = WorldCommState(
+        rng=jax.random.key(0),
+        agent_pos=jnp.asarray(np.stack([a.state.p_pos for a in world.agents]), jnp.float32),
+        agent_vel=jnp.zeros((6, 2)),
+        landmark_pos=jnp.asarray(world.landmarks[0].state.p_pos, jnp.float32)[None, :],
+        food_pos=jnp.asarray(np.stack([f.state.p_pos for f in world.food]), jnp.float32),
+        forest_pos=jnp.asarray(np.stack([f.state.p_pos for f in world.forests]), jnp.float32),
+        leader_comm=jnp.zeros((4,)),
+        t=jnp.zeros((), jnp.int32),
+    )
+    step = jax.jit(env.step)
+    rng = np.random.RandomState(19)
+    for t in range(10):
+        move = rng.randint(0, 5, size=6)
+        talk = rng.randint(0, 4)
+        for i, agent in enumerate(world.agents):
+            u = np.zeros(2)
+            oh = np.eye(5)[move[i]]
+            u[0] += oh[1] - oh[2]
+            u[1] += oh[3] - oh[4]
+            agent.action.u = u * agent.accel   # accel doubles as sensitivity
+            agent.action.c = np.eye(4)[talk] if agent.leader else np.zeros(4)
+        world.step()
+        ref_obs = [scenario.observation(a, world) for a in world.agents]
+        ref_rew = [float(scenario.reward(a, world)) for a in world.agents]
+
+        acts = np.stack([move, np.full(6, talk)], axis=1)
+        state, ts = step(state, jnp.asarray(acts, jnp.float32))
+        np.testing.assert_allclose(
+            np.asarray(state.agent_pos),
+            np.stack([a.state.p_pos for a in world.agents]),
+            rtol=1e-4, atol=1e-5, err_msg=f"pos t={t}",
+        )
+        got = np.asarray(ts.obs)
+        for i in range(6):
+            d = len(ref_obs[i])
+            np.testing.assert_allclose(
+                got[i, :d], ref_obs[i], rtol=1e-4, atol=1e-5,
+                err_msg=f"obs agent {i} t={t}",
+            )
+            np.testing.assert_allclose(got[i, d:-6], 0.0, atol=1e-6)
+        np.testing.assert_allclose(
+            np.asarray(ts.reward[:, 0]), ref_rew, rtol=1e-4, atol=1e-4,
+            err_msg=f"reward t={t}",
+        )
+
+
+def test_simple_attack_physics_obs_parity_and_reference_reward_defect(ref_mpe):
+    """simple_attack: physics/obs lockstep with the reference World.  The
+    reference reward cannot be compared — its ``bound`` is a class-level def
+    called as a bare name (``simple_attack.py:89-95,118``), a NameError on
+    first call — so the test also PROVES that defect instead."""
+    scenario = ref_mpe["simple_attack"]
+
+    class AttackArgs(_Args):
+        num_good_agents = 1
+        num_adversaries = 2
+        num_landmarks = 3
+
+    np.random.seed(5)
+    world = scenario.make_world(AttackArgs())
+    scenario.reset_world(world)
+    env = SimpleAttackEnv(SimpleAttackConfig())
+    state = AttackState(
+        rng=jax.random.key(0),
+        agent_pos=jnp.asarray(np.stack([a.state.p_pos for a in world.agents]), jnp.float32),
+        agent_vel=jnp.zeros((3, 2)),
+        landmark_pos=jnp.asarray(np.stack([l.state.p_pos for l in world.landmarks]), jnp.float32),
+        t=jnp.zeros((), jnp.int32),
+    )
+    step = jax.jit(env.step)
+    rng = np.random.RandomState(17)
+    for t in range(10):
+        idx = rng.randint(0, 5, size=3)
+        ref_obs, _ = _ref_step(world, scenario, idx, compute_rewards=False)
+        state, ts = step(state, jnp.asarray(idx[:, None], jnp.float32))
+        np.testing.assert_allclose(
+            np.asarray(state.agent_pos),
+            np.stack([a.state.p_pos for a in world.agents]),
+            rtol=1e-4, atol=1e-5, err_msg=f"pos t={t}",
+        )
+        got = np.asarray(ts.obs)
+        for i in range(3):
+            d = len(ref_obs[i])
+            np.testing.assert_allclose(
+                got[i, :d], ref_obs[i], rtol=1e-4, atol=1e-5,
+                err_msg=f"obs agent {i} t={t}",
+            )
+        assert np.all(np.isfinite(np.asarray(ts.reward)))
+
+    # document the reference defect: its reward raises NameError('bound')
+    with pytest.raises(NameError, match="bound"):
+        scenario.reward(world.agents[0], world)
+
+
 @pytest.mark.parametrize("env_cls,cfg_cls", [
     (SimpleTagEnv, SimpleTagConfig),
     (SimpleAdversaryEnv, SimpleAdversaryConfig),
     (SimplePushEnv, SimplePushConfig),
     (SimpleCryptoEnv, SimpleCryptoConfig),
+    (SimpleAttackEnv, SimpleAttackConfig),
+    (SimpleWorldCommEnv, SimpleWorldCommConfig),
 ])
 def test_vmap_autoreset_shapes(env_cls, cfg_cls):
     env = env_cls(cfg_cls(episode_length=4))
@@ -303,7 +424,9 @@ def test_vmap_autoreset_shapes(env_cls, cfg_cls):
     assert ts.obs.shape == (6, N, env.obs_dim)
     assert ts.share_obs.shape == (6, N, env.share_obs_dim)
     step = jax.jit(jax.vmap(env.step))
-    acts = jnp.zeros((6, N, 1))
+    # MultiDiscrete envs store one column per head; Discrete envs one index
+    width = env.action_space.sample_dim if hasattr(env, "action_space") else 1
+    acts = jnp.zeros((6, N, width))
     for _ in range(4):
         states, ts = step(states, acts)
     assert bool(np.asarray(ts.done).all())
