@@ -75,6 +75,7 @@ class TestShannon:
         _, ts_fast = env.step(fast, action)
         assert float(ts_fast.delay) < float(ts_slow.delay)
 
+    @pytest.mark.slow
     def test_shannon_training_smoke(self, tmp_path):
         from mat_dcml_tpu.config import RunConfig
         from mat_dcml_tpu.training.ppo import PPOConfig

@@ -38,6 +38,7 @@ def rollout_random(env, key, n_steps=80):
 
 
 class TestSMACLite:
+    @pytest.mark.slow
     def test_shapes_and_registry(self):
         for name in ("3m", "2s3z", "5m_vs_6m", "MMM"):
             env = SMACLiteEnv(SMACLiteConfig(map_name=name))
@@ -130,6 +131,7 @@ class TestSMACLite:
 
 
 class TestTranslation:
+    @pytest.mark.slow
     def test_translated_shapes_uniform_across_maps(self):
         dims = set()
         for name in ("2m", "3m", "2s3z"):

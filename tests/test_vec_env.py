@@ -156,6 +156,7 @@ def test_auto_reset_inside_worker():
     assert infos[0]["delay"] == 0.5
 
 
+@pytest.mark.slow
 def test_mat_trains_over_bridge():
     policy, env = _policy_and_env()
     params = policy.init_params(jax.random.key(1))
