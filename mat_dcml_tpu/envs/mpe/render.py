@@ -1,0 +1,118 @@
+"""Headless MPE episode rendering to GIF.
+
+The reference renders MPE through a pyglet OpenGL viewer and the MPE runner
+saves eval episodes as GIFs (``mpe_runner.py:193-255``,
+``mpe/rendering.py``) — unusable on a display-less TPU VM.  This module is
+the software equivalent: a tiny numpy circle rasterizer over the same
+world box and entity color scheme, written with PIL (no GL, no pyglet).
+
+Works with any scenario env in this package whose state exposes
+``agent_pos`` plus optional ``landmark_pos`` / ``food_pos`` / ``forest_pos``
+rows; role split and radii are read off the env config
+(``adv_size``/``good_size``/``agent_size``...).  The pure-comm
+``simple_crypto`` has no positions and is not renderable (as in the
+reference, whose crypto agents are immovable dots).
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence, Tuple
+
+import numpy as np
+
+# reference entity colors (scenario reset_world conventions)
+GOOD = (115, 242, 115)
+ADVERSARY = (242, 115, 115)
+LEADER = (166, 166, 64)
+LANDMARK = (64, 64, 64)
+FOOD = (38, 38, 166)
+FOREST = (153, 230, 153)
+BG = (255, 255, 255)
+
+CAM_RANGE = 1.4  # world box drawn; MPE viewer uses a similar fixed zoom
+
+
+def _entities(env, state) -> List[Tuple[np.ndarray, float, Tuple[int, int, int]]]:
+    """(pos(2,), radius, color) per entity, back-to-front draw order."""
+    cfg = env.cfg
+    if not hasattr(state, "agent_pos"):
+        raise TypeError(
+            f"{type(state).__name__} has no positions to render "
+            "(pure-comm scenarios like simple_crypto are not renderable)"
+        )
+    out: List[Tuple[np.ndarray, float, Tuple[int, int, int]]] = []
+
+    def rows(name, radius, color):
+        arr = getattr(state, name, None)
+        if arr is None:
+            return
+        for p in np.asarray(arr).reshape(-1, 2):
+            out.append((p, radius, color))
+
+    rows("forest_pos", getattr(cfg, "forest_size", 0.3), FOREST)
+    rows("landmark_pos", getattr(cfg, "landmark_size", 0.08), LANDMARK)
+    rows("food_pos", getattr(cfg, "food_size", 0.03), FOOD)
+
+    agent_pos = np.asarray(state.agent_pos).reshape(-1, 2)
+    n_adv = getattr(cfg, "n_adversaries", 0)
+    adv_size = getattr(cfg, "adv_size", getattr(cfg, "agent_size", 0.05))
+    good_size = getattr(cfg, "good_size", getattr(cfg, "agent_size", 0.05))
+    for i, p in enumerate(agent_pos):
+        if i < n_adv:
+            color = LEADER if (i == 0 and hasattr(cfg, "n_forests")) else ADVERSARY
+            out.append((p, adv_size, color))
+        else:
+            out.append((p, good_size, GOOD))
+    return out
+
+
+def render_frame(env, state, size: int = 350) -> np.ndarray:
+    """One (size, size, 3) uint8 frame of the current world state."""
+    img = np.empty((size, size, 3), np.uint8)
+    img[:] = BG
+    # pixel-center world coordinates
+    axis = (np.arange(size) + 0.5) / size * (2 * CAM_RANGE) - CAM_RANGE
+    xs = axis[None, :]
+    ys = -axis[:, None]  # screen y grows downward
+    for pos, radius, color in _entities(env, state):
+        mask = (xs - pos[0]) ** 2 + (ys - pos[1]) ** 2 <= radius**2
+        img[mask] = color
+    return img
+
+
+def save_gif(frames: Sequence[np.ndarray], path: str, fps: int = 12) -> None:
+    """Write frames as an animated GIF (PIL; no display required)."""
+    from PIL import Image
+
+    ims = [Image.fromarray(f) for f in frames]
+    ims[0].save(
+        path, save_all=True, append_images=ims[1:],
+        duration=int(1000 / fps), loop=0,
+    )
+
+
+def render_episode(env, policy, params, key, n_steps: int = 0,
+                   size: int = 350) -> List[np.ndarray]:
+    """Roll one deterministic episode and rasterize every step.
+
+    ``policy`` must expose ``get_actions(params, key, share_obs, obs,
+    available_actions, deterministic=...)`` over (1, A, ·) batches — the
+    MAT/actor-critic policy surface used by the runners' eval loops.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    n_steps = n_steps or getattr(env.cfg, "episode_length", 25)
+    state, ts = env.reset(key)
+    frames = [render_frame(env, state, size)]
+    step = jax.jit(env.step)
+    for _ in range(n_steps):
+        out = policy.get_actions(
+            params, jax.random.key(0),
+            ts.share_obs[None], ts.obs[None],
+            ts.available_actions[None], deterministic=True,
+        )
+        act = jnp.asarray(out.action)[0]
+        state, ts = step(state, act)
+        frames.append(render_frame(env, state, size))
+    return frames

@@ -34,6 +34,9 @@ def main(argv=None):
     # predator-prey role counts (reference simple_tag.py:10-13 defaults)
     extras.add_argument("--num_good_agents", type=int, default=None)
     extras.add_argument("--num_adversaries", type=int, default=None)
+    # save one deterministic post-training episode as a GIF (the reference
+    # MPE runner's use_render/gif path, software-rasterized — no display)
+    extras.add_argument("--render_gif", type=str, default=None)
     run, ppo, ns = parse_cli_with_extras(argv, extras=extras, overrides={
         "env_name": "MPE", "scenario": "simple_spread", "episode_length": 25,
     })
@@ -53,10 +56,27 @@ def main(argv=None):
     env = env_cls(cfg_cls(**{
         k: v for k, v in candidates.items() if k in fields and v is not None
     }))
+    if ns.render_gif:
+        # validate BEFORE training so a bad combination fails in seconds
+        from mat_dcml_tpu.training.generic_runner import MAT_FAMILY
+
+        if run.algorithm_name not in MAT_FAMILY:
+            raise SystemExit("--render_gif drives the MAT-family policy surface")
+        if not hasattr(env, "_spawn") or run.scenario == "simple_crypto":
+            raise SystemExit(f"{run.scenario} has no positions to render")
     runner = GenericRunner(run, ppo, env)
     print(f"algorithm={run.algorithm_name} env=MPE/{run.scenario} agents={env.n_agents} "
           f"episodes={run.episodes} devices={len(__import__('jax').devices())}")
-    runner.train_loop()
+    state, _ = runner.train_loop()
+    if ns.render_gif:
+        from mat_dcml_tpu.envs.mpe.render import render_episode, save_gif
+
+        frames = render_episode(
+            env, runner.policy, state.params,
+            __import__("jax").random.key(run.seed + 99),
+        )
+        save_gif(frames, ns.render_gif)
+        print(f"saved {len(frames)}-frame episode gif to {ns.render_gif}")
 
 
 if __name__ == "__main__":
