@@ -32,6 +32,15 @@ BG = (255, 255, 255)
 CAM_RANGE = 1.4  # world box drawn; MPE viewer uses a similar fixed zoom
 
 
+def is_renderable(env) -> bool:
+    """True when the env's state carries positions (everything but the
+    pure-comm scenarios).  Costs one eager reset of a tiny env."""
+    import jax
+
+    state, _ = env.reset(jax.random.key(0))
+    return hasattr(state, "agent_pos")
+
+
 def _entities(env, state) -> List[Tuple[np.ndarray, float, Tuple[int, int, int]]]:
     """(pos(2,), radius, color) per entity, back-to-front draw order."""
     cfg = env.cfg
@@ -54,7 +63,9 @@ def _entities(env, state) -> List[Tuple[np.ndarray, float, Tuple[int, int, int]]
     rows("food_pos", getattr(cfg, "food_size", 0.03), FOOD)
 
     agent_pos = np.asarray(state.agent_pos).reshape(-1, 2)
-    n_adv = getattr(cfg, "n_adversaries", 0)
+    # role count lives on the config (tag/attack/world_comm) or as an env
+    # class constant (adversary/push: N_ADVERSARIES)
+    n_adv = getattr(cfg, "n_adversaries", getattr(env, "N_ADVERSARIES", 0))
     adv_size = getattr(cfg, "adv_size", getattr(cfg, "agent_size", 0.05))
     good_size = getattr(cfg, "good_size", getattr(cfg, "agent_size", 0.05))
     for i, p in enumerate(agent_pos):

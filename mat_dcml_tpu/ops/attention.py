@@ -18,8 +18,11 @@ import jax.numpy as jnp
 
 NEG_INF = -1e9
 
-# "auto", "xla", "pallas", "pallas_interpret" (CPU debugging)
+# "auto", "xla", "pallas", "pallas_interpret" (CPU debugging), "ring"
+# (context-parallel; only valid inside shard_map with the length axis
+# sharded — see parallel/seq_parallel.py)
 _IMPL_ENV = "MAT_DCML_TPU_ATTN_IMPL"
+_RING_AXIS_ENV = "MAT_DCML_TPU_ATTN_RING_AXIS"
 
 # Measured on one v4 chip (bench.py, E=256, T=50, full train loop): XLA 683
 # env-steps/s vs fused kernel 543 (grouped grid) / 318 (per-(b,h) grid).  At
@@ -29,7 +32,7 @@ _IMPL_ENV = "MAT_DCML_TPU_ATTN_IMPL"
 _PALLAS_MIN_SEQ = 256
 
 
-_VALID_IMPLS = ("auto", "xla", "pallas", "pallas_interpret")
+_VALID_IMPLS = ("auto", "xla", "pallas", "pallas_interpret", "ring")
 
 
 def _resolve_impl(impl: str | None, lk: int) -> str:
@@ -69,6 +72,17 @@ def multi_head_attention(
       ``(B, H, Lq, Dh)`` attention output (before the output projection).
     """
     chosen = _resolve_impl(impl, k.shape[-2])
+    if chosen == "ring":
+        # context parallelism: this call site is inside shard_map with the
+        # length axis sharded over the ring axis; K/V shards rotate with
+        # ppermute (ops/ring_attention.py).  The decode path's kv_mask never
+        # reaches here — decode is sequential and stays on one device.
+        if kv_mask is not None:
+            raise ValueError("ring attention does not support kv_mask")
+        from mat_dcml_tpu.ops.ring_attention import ring_attention
+
+        axis = os.environ.get(_RING_AXIS_ENV, "seq")
+        return ring_attention(q, k, v, axis_name=axis, causal=causal)
     if chosen.startswith("pallas"):
         from mat_dcml_tpu.ops.pallas_attention import fused_masked_attention
 

@@ -1,3 +1,4 @@
 """Mesh construction and sharding helpers (ICI/DCN-aware scaling)."""
 
 from mat_dcml_tpu.parallel.mesh import make_mesh, replicated, data_sharded
+from mat_dcml_tpu.parallel.seq_parallel import seq_sharded_forward

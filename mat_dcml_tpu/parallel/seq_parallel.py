@@ -1,0 +1,91 @@
+"""Sequence/context parallelism for the MAT training forward.
+
+MAT treats AGENTS as the sequence axis, so "long context" here means many
+agents.  The reference's only length device is stride-batched decoding
+(SURVEY.md §5); this module context-shards the teacher-forced training
+forward — the per-step hot path of PPO — over a ``seq`` mesh axis: every
+per-position op (embeds, LayerNorms, MLPs, value/logit heads) runs on its
+own shard untouched, and the two attention flavors (encoder full, decoder
+causal self/cross) rotate K/V shards around the ring with ``ppermute``
+(:mod:`~mat_dcml_tpu.ops.ring_attention`), compute overlapping
+communication.  Exact — pinned to the replicated forward by
+``tests/test_seq_parallel.py`` on a virtual CPU mesh.
+
+The autoregressive DECODE path is deliberately not context-sharded: it is
+sequential over positions with O(1) new work per step, so its shard would
+idle n-1 devices; collection scales over the ``data`` axis instead.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+
+import jax
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from mat_dcml_tpu.ops import attention as _attn
+
+
+@contextlib.contextmanager
+def _attn_impl(impl: str, axis: str):
+    """Pin the attention dispatch to ``impl`` while tracing."""
+    old_impl = os.environ.get(_attn._IMPL_ENV)
+    old_axis = os.environ.get(_attn._RING_AXIS_ENV)
+    os.environ[_attn._IMPL_ENV] = impl
+    os.environ[_attn._RING_AXIS_ENV] = axis
+    try:
+        yield
+    finally:
+        for k, v in ((_attn._IMPL_ENV, old_impl), (_attn._RING_AXIS_ENV, old_axis)):
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+def seq_sharded_forward(model, params, state, obs, shifted_action,
+                        mesh: Mesh, axis: str = "seq"):
+    """Teacher-forced MAT forward with the agent axis sharded over ``axis``.
+
+    Args:
+      model: a ``MultiAgentTransformer`` (``models/mat.py``).
+      state / obs / shifted_action: ``(B, L, ·)`` replicated inputs; the L
+        (agent) axis must divide the mesh's ``axis`` size.
+      mesh: mesh containing ``axis``.
+
+    Returns:
+      ``(v_loc, obs_rep, logits)`` exactly as ``model.__call__`` — computed
+      with O(L/n) per-device attention memory and ring communication.
+    """
+    if model.cfg.dec_actor:
+        raise NotImplementedError(
+            "MAT-Dec replaces the decoder with per-agent MLPs indexed by "
+            "global agent id; context-sharding applies to the transformer path"
+        )
+    n = mesh.shape[axis]
+    L = obs.shape[1]
+    if L % n != 0:
+        raise ValueError(
+            f"agent axis ({L}) must divide the '{axis}' mesh axis ({n}); "
+            "pad the agent dimension to a multiple"
+        )
+
+    row = P(None, axis, None)
+    replicated = jax.tree.map(lambda _: P(), params)
+
+    with _attn_impl("ring", axis):
+
+        @jax.jit
+        def run(params, state, obs, shifted_action):
+            def fwd(params, state_s, obs_s, act_s):
+                return model.apply(params, state_s, obs_s, act_s)
+
+            return shard_map(
+                fwd, mesh=mesh,
+                in_specs=(replicated, row, row, row),
+                out_specs=(row, row, row),
+            )(params, state, obs, shifted_action)
+
+        return run(params, state, obs, shifted_action)

@@ -58,11 +58,12 @@ def main(argv=None):
     }))
     if ns.render_gif:
         # validate BEFORE training so a bad combination fails in seconds
+        from mat_dcml_tpu.envs.mpe.render import is_renderable
         from mat_dcml_tpu.training.generic_runner import MAT_FAMILY
 
         if run.algorithm_name not in MAT_FAMILY:
             raise SystemExit("--render_gif drives the MAT-family policy surface")
-        if not hasattr(env, "_spawn") or run.scenario == "simple_crypto":
+        if not is_renderable(env):
             raise SystemExit(f"{run.scenario} has no positions to render")
     runner = GenericRunner(run, ppo, env)
     print(f"algorithm={run.algorithm_name} env=MPE/{run.scenario} agents={env.n_agents} "
