@@ -53,6 +53,10 @@ class RunConfig:
     dec_actor: bool = False
     share_actor: bool = False
     n_objective: int = 1
+    # context parallelism: ring-shard the agent axis of the teacher-forced
+    # training forward over this many devices (parallel/seq_parallel.py);
+    # 1 = replicated. n_agent must be divisible by it.
+    seq_shards: int = 1
 
     @property
     def episodes(self) -> int:

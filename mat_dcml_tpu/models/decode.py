@@ -205,6 +205,7 @@ def parallel_act(
     obs: jax.Array,
     action: jax.Array,
     available_actions: Optional[jax.Array],
+    decode_fn=None,
 ) -> Tuple[jax.Array, jax.Array]:
     """Teacher-forced log-probs and entropies in one decoder pass.
 
@@ -212,13 +213,17 @@ def parallel_act(
     ``semi_discrete_parallel_act`` (``:103-129``), ``continuous_parallel_act``
     (``:219-232``), ``available_continuous_parallel_act`` (``:285-322``).
 
+    ``decode_fn`` overrides the decoder application (same signature as
+    ``decode_full``: ``(shifted, obs_rep, obs) -> logits``) — the
+    sequence-parallel path routes it through ``seq_sharded_call``.
+
     Returns ``(log_prob, entropy)`` each ``(B, n_agent, act_prob_dim)``.
     """
     cfg = model.cfg
     B = obs_rep.shape[0]
     A, adim = cfg.n_agent, cfg.action_dim
 
-    decode = partial(model.apply, params, method="decode_full")
+    decode = decode_fn or partial(model.apply, params, method="decode_full")
 
     if cfg.action_type == DISCRETE:
         idx = action[..., 0].astype(jnp.int32)

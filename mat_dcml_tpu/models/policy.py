@@ -43,6 +43,10 @@ class TransformerPolicy:
     def __init__(self, cfg: MATConfig):
         self.cfg = cfg
         self.model = MultiAgentTransformer(cfg)
+        # optional context parallelism: when set (a Mesh with a "seq" axis),
+        # the teacher-forced training forward ring-shards the agent axis
+        # (parallel/seq_parallel.py); rollout decode stays replicated
+        self.seq_mesh = None
         # act bookkeeping (transformer_policy.py:43-57)
         if cfg.action_type in (DISCRETE, SEMI_DISCRETE):
             self.act_out_dim = 1
@@ -112,6 +116,21 @@ class TransformerPolicy:
         entropy)`` with entropy un-reduced ``(B, n_agent, act_prob)`` — the
         trainer applies active-mask weighting (``transformer_policy.py:212-215``).
         """
+        if self.seq_mesh is not None:
+            from mat_dcml_tpu.parallel.seq_parallel import seq_sharded_call
+
+            v_loc, obs_rep = seq_sharded_call(
+                self.model, params, self.seq_mesh, "encode", 2, state, obs
+            )
+            decode_fn = lambda shifted, rep, o: seq_sharded_call(  # noqa: E731
+                self.model, params, self.seq_mesh, "decode_full", 1,
+                shifted, rep, o,
+            )
+            logp, ent = decode_lib.parallel_act(
+                self.model, params, obs_rep, obs, action, available_actions,
+                decode_fn=decode_fn,
+            )
+            return v_loc, logp, ent
         v_loc, obs_rep = self.model.apply(params, state, obs, method="encode")
         logp, ent = decode_lib.parallel_act(
             self.model, params, obs_rep, obs, action, available_actions

@@ -11,6 +11,7 @@ Shapes follow TPU conventions: ``(batch, heads, length, head_dim)``.
 
 from __future__ import annotations
 
+import contextlib
 import os
 
 import jax
@@ -23,6 +24,9 @@ NEG_INF = -1e9
 # sharded — see parallel/seq_parallel.py)
 _IMPL_ENV = "MAT_DCML_TPU_ATTN_IMPL"
 _RING_AXIS_ENV = "MAT_DCML_TPU_ATTN_RING_AXIS"
+# global (pre-pad) sequence length when the caller padded L to divide the
+# ring; read at trace time by the "ring" dispatch below ("0" = no padding)
+_RING_VALID_ENV = "MAT_DCML_TPU_ATTN_RING_VALID"
 
 # Measured on one v4 chip (bench.py, E=256, T=50, full train loop): XLA 683
 # env-steps/s vs fused kernel 543 (grouped grid) / 318 (per-(b,h) grid).  At
@@ -34,8 +38,28 @@ _PALLAS_MIN_SEQ = 256
 
 _VALID_IMPLS = ("auto", "xla", "pallas", "pallas_interpret", "ring")
 
+# process-local trace-time override installed by parallel/seq_parallel.py's
+# context manager: (impl, ring_axis, valid_len).  Scoped to this module —
+# unlike an env var it is invisible to subprocesses (vec-env bridge workers,
+# multihost launchers) and does not shadow the user-facing _IMPL_ENV knob.
+_OVERRIDE: tuple | None = None
+
+
+@contextlib.contextmanager
+def impl_override(impl: str, axis: str = "seq", valid_len: int = 0):
+    """Pin attention dispatch while tracing a sharded forward."""
+    global _OVERRIDE
+    old = _OVERRIDE
+    _OVERRIDE = (impl, axis, valid_len)
+    try:
+        yield
+    finally:
+        _OVERRIDE = old
+
 
 def _resolve_impl(impl: str | None, lk: int) -> str:
+    if _OVERRIDE is not None:
+        return _OVERRIDE[0]
     impl = impl or os.environ.get(_IMPL_ENV, "auto")
     if impl not in _VALID_IMPLS:
         raise ValueError(f"attention impl must be one of {_VALID_IMPLS}, got {impl!r}")
@@ -81,8 +105,14 @@ def multi_head_attention(
             raise ValueError("ring attention does not support kv_mask")
         from mat_dcml_tpu.ops.ring_attention import ring_attention
 
-        axis = os.environ.get(_RING_AXIS_ENV, "seq")
-        return ring_attention(q, k, v, axis_name=axis, causal=causal)
+        if _OVERRIDE is not None:
+            axis, valid = _OVERRIDE[1], _OVERRIDE[2] or None
+        else:  # manual env-var selection
+            axis = os.environ.get(_RING_AXIS_ENV, "seq")
+            valid = int(os.environ.get(_RING_VALID_ENV, "0")) or None
+        return ring_attention(
+            q, k, v, axis_name=axis, causal=causal, valid_len=valid
+        )
     if chosen.startswith("pallas"):
         from mat_dcml_tpu.ops.pallas_attention import fused_masked_attention
 

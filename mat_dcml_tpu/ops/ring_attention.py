@@ -40,6 +40,7 @@ def ring_attention(
     *,
     axis_name: str = "seq",
     causal: bool = False,
+    valid_len: Optional[int] = None,
 ) -> jax.Array:
     """Exact attention over a ring-sharded sequence (call inside shard_map).
 
@@ -48,6 +49,10 @@ def ring_attention(
         length axis, sharded over ``axis_name``.
       causal: apply the global lower-triangular mask (query position attends
         to key positions <= its own GLOBAL index).
+      valid_len: when the global length was zero-padded to divide the ring
+        (e.g. DCML's 101 agents on 2 shards -> 102), the number of REAL
+        positions; keys at global index >= valid_len are masked out.  Query
+        rows >= valid_len produce garbage the caller slices away.
 
     Returns:
       ``(B, H, L_local, Dh)`` — this device's shard of the attention output.
@@ -62,10 +67,12 @@ def ring_attention(
 
     def scores_for(k_blk, kv_idx):
         s = jnp.einsum("bhqd,bhkd->bhqk", q32, k_blk.astype(jnp.float32)) * scale
+        k_pos = kv_idx * Ll + jnp.arange(Ll)
         if causal:
-            k_pos = kv_idx * Ll + jnp.arange(Ll)
             mask = q_pos[:, None] >= k_pos[None, :]          # (Ll, Ll)
             s = jnp.where(mask[None, None], s, NEG_INF)
+        if valid_len is not None:
+            s = jnp.where((k_pos < valid_len)[None, None, None, :], s, NEG_INF)
         return s
 
     # online softmax accumulators, derived from q so they carry the same

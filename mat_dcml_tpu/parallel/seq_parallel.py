@@ -18,9 +18,6 @@ idle n-1 devices; collection scales over the ``data`` axis instead.
 
 from __future__ import annotations
 
-import contextlib
-import os
-
 import jax
 from jax import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
@@ -28,21 +25,55 @@ from jax.sharding import Mesh, PartitionSpec as P
 from mat_dcml_tpu.ops import attention as _attn
 
 
-@contextlib.contextmanager
-def _attn_impl(impl: str, axis: str):
-    """Pin the attention dispatch to ``impl`` while tracing."""
-    old_impl = os.environ.get(_attn._IMPL_ENV)
-    old_axis = os.environ.get(_attn._RING_AXIS_ENV)
-    os.environ[_attn._IMPL_ENV] = impl
-    os.environ[_attn._RING_AXIS_ENV] = axis
-    try:
-        yield
-    finally:
-        for k, v in ((_attn._IMPL_ENV, old_impl), (_attn._RING_AXIS_ENV, old_axis)):
-            if v is None:
-                os.environ.pop(k, None)
-            else:
-                os.environ[k] = v
+_attn_impl = _attn.impl_override  # trace-time, module-scoped pin
+
+
+def _check(model) -> None:
+    if model.cfg.dec_actor:
+        raise NotImplementedError(
+            "MAT-Dec replaces the decoder with per-agent MLPs indexed by "
+            "global agent id; context-sharding applies to the transformer path"
+        )
+
+
+def seq_sharded_call(model, params, mesh: Mesh, method, n_out: int, *args,
+                     axis: str = "seq"):
+    """Run any per-position model method with the agent axis ring-sharded.
+
+    ``args`` are ``(B, L, ·)`` arrays; outputs are ``n_out`` ``(B, L, ·)``
+    arrays.  When L does not divide the ring (DCML's prime 101 agents), the
+    inputs are zero-padded to the next multiple, padded KEY positions are
+    masked inside the ring attention, and the padded output rows are sliced
+    away — numerics identical to the unpadded forward.  Composable: callable
+    eagerly or inside an enclosing jit (the trainer's single jitted update),
+    since the attention-impl pin applies at trace time.
+    """
+    _check(model)
+    n = mesh.shape[axis]
+    L = args[0].shape[1]
+    pad = (-L) % n
+    if pad:
+        args = tuple(
+            jax.numpy.pad(a, ((0, 0), (0, pad), (0, 0))) for a in args
+        )
+    row = P(None, axis, None)
+    replicated = jax.tree.map(lambda _: P(), params)
+    out_specs = row if n_out == 1 else tuple([row] * n_out)
+
+    with _attn_impl("ring", axis, valid_len=L if pad else 0):
+
+        def fn(p, *a):
+            return model.apply(p, *a, method=method)
+
+        out = shard_map(
+            fn, mesh=mesh,
+            in_specs=(replicated, *([row] * len(args))),
+            out_specs=out_specs,
+        )(params, *args)
+    if pad:
+        trim = lambda x: x[:, :L]  # noqa: E731
+        out = trim(out) if n_out == 1 else tuple(trim(o) for o in out)
+    return out
 
 
 def seq_sharded_forward(model, params, state, obs, shifted_action,
@@ -59,33 +90,6 @@ def seq_sharded_forward(model, params, state, obs, shifted_action,
       ``(v_loc, obs_rep, logits)`` exactly as ``model.__call__`` — computed
       with O(L/n) per-device attention memory and ring communication.
     """
-    if model.cfg.dec_actor:
-        raise NotImplementedError(
-            "MAT-Dec replaces the decoder with per-agent MLPs indexed by "
-            "global agent id; context-sharding applies to the transformer path"
-        )
-    n = mesh.shape[axis]
-    L = obs.shape[1]
-    if L % n != 0:
-        raise ValueError(
-            f"agent axis ({L}) must divide the '{axis}' mesh axis ({n}); "
-            "pad the agent dimension to a multiple"
-        )
-
-    row = P(None, axis, None)
-    replicated = jax.tree.map(lambda _: P(), params)
-
-    with _attn_impl("ring", axis):
-
-        @jax.jit
-        def run(params, state, obs, shifted_action):
-            def fwd(params, state_s, obs_s, act_s):
-                return model.apply(params, state_s, obs_s, act_s)
-
-            return shard_map(
-                fwd, mesh=mesh,
-                in_specs=(replicated, row, row, row),
-                out_specs=(row, row, row),
-            )(params, state, obs, shifted_action)
-
-        return run(params, state, obs, shifted_action)
+    return seq_sharded_call(
+        model, params, mesh, None, 3, state, obs, shifted_action, axis=axis
+    )

@@ -29,6 +29,38 @@ from mat_dcml_tpu.training.ppo import PPOConfig
 from mat_dcml_tpu.utils.metrics import MetricsWriter
 
 
+def apply_seq_shards(run: RunConfig, policy) -> None:
+    """--seq_shards N: context-shard the training forward's agent axis over
+    an N-device ``seq`` mesh (parallel/seq_parallel.py).  MAT-family only —
+    the transformer policies carry a ``seq_mesh`` slot.  Called after EVERY
+    policy construction so an unsupported combination fails at startup, not
+    silently (or mid-first-update)."""
+    if getattr(run, "seq_shards", 1) <= 1:
+        return
+    if not hasattr(policy, "seq_mesh"):
+        raise NotImplementedError(
+            f"--seq_shards applies to the MAT transformer policy, not "
+            f"{type(policy).__name__}"
+        )
+    if getattr(policy.cfg, "dec_actor", False):
+        raise NotImplementedError(
+            "--seq_shards: MAT-Dec's per-agent MLPs are indexed by global "
+            "agent id; context-sharding applies to the transformer path"
+        )
+    import numpy as _np
+    from jax.sharding import Mesh
+
+    # local_devices: on a multi-process backend each process shards its own
+    # addressable devices (a global-list mesh would be non-addressable)
+    devs = jax.local_devices()
+    if len(devs) < run.seq_shards:
+        raise ValueError(
+            f"--seq_shards {run.seq_shards} needs that many local devices; "
+            f"{len(devs)} visible"
+        )
+    policy.seq_mesh = Mesh(_np.array(devs[: run.seq_shards]), ("seq",))
+
+
 def ac_config_kwargs(ppo: PPOConfig) -> dict:
     """PPOConfig -> MAPPOConfig shared-field mapping (one place, so CLI flags
     behave identically across entry points)."""

@@ -30,7 +30,7 @@ from mat_dcml_tpu.models.actor_critic import ACConfig, ActorCriticPolicy
 from mat_dcml_tpu.models.mat import MATConfig, SEMI_DISCRETE
 from mat_dcml_tpu.models.policy import TransformerPolicy
 from mat_dcml_tpu.training.ac_rollout import ACRolloutCollector, ACRolloutState
-from mat_dcml_tpu.training.base_runner import BaseRunner, ac_config_kwargs
+from mat_dcml_tpu.training.base_runner import BaseRunner, ac_config_kwargs, apply_seq_shards
 from mat_dcml_tpu.training.happo import (
     HAPPOConfig,
     HAPPORolloutCollector,
@@ -170,6 +170,7 @@ class DCMLRunner(BaseRunner):
                     )
                     self.collector = HAPPORolloutCollector(wrapped, self.policy, run.episode_length)
 
+        apply_seq_shards(run, self.policy)
         self.finalize(run, log_fn)
 
     # ----------------------------------------------------------------- eval
