@@ -55,7 +55,8 @@ class RunConfig:
     n_objective: int = 1
     # context parallelism: ring-shard the agent axis of the teacher-forced
     # training forward over this many devices (parallel/seq_parallel.py);
-    # 1 = replicated. n_agent must be divisible by it.
+    # 1 = replicated. Indivisible agent counts (DCML's 101) zero-pad with
+    # masked keys — numerics identical.
     seq_shards: int = 1
 
     @property

@@ -47,7 +47,6 @@ def apply_seq_shards(run: RunConfig, policy) -> None:
             "--seq_shards: MAT-Dec's per-agent MLPs are indexed by global "
             "agent id; context-sharding applies to the transformer path"
         )
-    import numpy as _np
     from jax.sharding import Mesh
 
     # local_devices: on a multi-process backend each process shards its own
@@ -58,7 +57,7 @@ def apply_seq_shards(run: RunConfig, policy) -> None:
             f"--seq_shards {run.seq_shards} needs that many local devices; "
             f"{len(devs)} visible"
         )
-    policy.seq_mesh = Mesh(_np.array(devs[: run.seq_shards]), ("seq",))
+    policy.seq_mesh = Mesh(np.array(devs[: run.seq_shards]), ("seq",))
 
 
 def ac_config_kwargs(ppo: PPOConfig) -> dict:

@@ -1,0 +1,116 @@
+// C++ oracle for the DCML worker timeslot simulation.
+//
+// An INDEPENDENT scalar implementation of the worker math the reference
+// runs in Python (DCML_Worker_TIMESLOT_MultiProcess.py:46-112) and this
+// framework vectorizes in JAX (mat_dcml_tpu/envs/dcml/env.py
+// _process_workers/_capacity/_cost_at).  Written as the reference wrote
+// it — a literal loop draining timeslots one by one — NOT as the JAX
+// cumsum/argmax rewrite, so agreement between the three implementations
+// is evidence of correctness rather than shared structure
+// (tests/test_native_oracle.py runs the differential comparison).
+//
+// Randomness is externalized: the geometric retry-failure counts are
+// inputs (download_fails; upload_fails = the summed extra failures for
+// however many upload draws the mode prescribes), making the function a
+// pure scalar map that can be compared exactly.
+//
+// Build: g++ -O2 -shared -fPIC -o libdcml_worker.so dcml_worker.cpp
+// (loaded via ctypes; no pybind11 needed).
+
+#include <cmath>
+#include <cstdint>
+
+namespace {
+
+// cumulative free capacity over the first j drained slots, period = trace
+// starting at slot ctp0 (env.py _capacity; reference price bookkeeping
+// DCML_Worker...py:84-108)
+double capacity_first_j(const double* trace, int period, int ctp0, long j) {
+    long q2 = j / period;       // full periods
+    int r2 = (int)(j - q2 * period);
+    double cap_period = 0.0;
+    for (int s = 0; s < period; ++s) {
+        cap_period += 1.0 - trace[(ctp0 + s) % period];
+    }
+    double partial = 0.0;
+    for (int s = 0; s < r2; ++s) {
+        partial += 1.0 - trace[(ctp0 + s) % period];
+    }
+    return (double)q2 * cap_period + partial;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Outputs (out[6]): delay, p0, cost, m_slots, drained, cap_period
+void dcml_worker_process(
+    double r_wl, double c_wl,
+    const double* trace, int period,
+    double arrive_time, double download_rate,
+    double download_fails, double upload_fails,
+    int max_drain_slots,
+    double second_to_centsec, double bit_to_byte, double worker_frequency,
+    double* out) {
+    // compute cost in free-capacity units (:49-50)
+    double compute_workload = (9.0 * r_wl - 3.0) * c_wl;
+    double cost0 = second_to_centsec * std::ceil(compute_workload) / worker_frequency;
+
+    // download with retries (:53-60)
+    double n_retry = 1.0 + download_fails;
+    double transmit_delay =
+        second_to_centsec *
+        (std::ceil((r_wl + 1.0) * c_wl) * bit_to_byte / download_rate + 0.001) *
+        n_retry;
+
+    double p0 = std::floor(transmit_delay) * 0.1;            // (:65)
+    double arrive_ts = std::floor(transmit_delay + arrive_time);  // (:66)
+    int ctp0 = (int)std::fmod(arrive_ts, (double)period);    // (:67-69)
+
+    double wl0 = trace[ctp0];
+    double frac = transmit_delay - std::floor(transmit_delay);
+    double cost = cost0 + ((frac - wl0 > 0.0) ? (frac - wl0) : 0.0);  // (:85-86)
+
+    // drain timeslots one by one until the accumulated free capacity covers
+    // the cost (:87-95) — the literal reference loop, epsilon-matched to the
+    // vectorized rewrite's tie tolerance
+    double cum = 0.0;
+    long m = 0;
+    while (cum < cost - 1e-9 && m < (long)max_drain_slots) {
+        cum += 1.0 - trace[(ctp0 + (int)(m % period)) % period];
+        ++m;
+    }
+    if (m == 0) m = 1;  // smallest m >= 1 (env.py t_part starts at 1)
+    double drained = capacity_first_j(trace, period, ctp0, m);
+
+    // upload with retries (:99-106; divides by the DOWNLOAD rate — the
+    // reference quirk replicated by both implementations)
+    double n_retry_final = n_retry + upload_fails;
+    double upload_delay =
+        second_to_centsec * (std::ceil(r_wl) * bit_to_byte / download_rate + 0.001) *
+            n_retry_final +
+        0.02;
+
+    // (:108)
+    double delay = (arrive_ts + (double)m) - arrive_time - (drained - cost) + upload_delay;
+
+    double cap_period = capacity_first_j(trace, period, ctp0, period);
+    out[0] = delay;
+    out[1] = p0;
+    out[2] = cost;
+    out[3] = (double)m;
+    out[4] = drained;
+    out[5] = cap_period;
+}
+
+// accumulated price at end_timeslot (env.py _cost_at; reference
+// DCML_..._SingleProcess.py:131-137)
+double dcml_worker_cost_at(
+    const double* trace, int period, int ctp0,
+    double p0, double m_slots, double end_timeslot) {
+    double j = end_timeslot < 1.0 ? 1.0 : end_timeslot;
+    if (j > m_slots) j = m_slots;
+    return p0 + capacity_first_j(trace, period, ctp0, (long)j);
+}
+
+}  // extern "C"

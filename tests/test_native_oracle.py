@@ -1,0 +1,135 @@
+"""Differential test: C++ worker oracle ≡ vectorized JAX DCML worker math.
+
+``native/dcml_worker.cpp`` re-implements the reference's worker timeslot
+loop (``DCML_Worker_TIMESLOT_MultiProcess.py:46-112``) as literal scalar
+C++ — a third, structurally different implementation (the JAX env uses a
+cumsum/argmax rewrite).  With failure probabilities pinned to zero the
+computation is deterministic, so the two implementations must agree
+exactly across randomized workloads, traces, and arrival offsets.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import shutil
+import subprocess
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from mat_dcml_tpu.envs.dcml import DCMLEnv, DCMLEnvConfig
+
+REPO = Path(__file__).resolve().parent.parent
+
+pytestmark = pytest.mark.skipif(
+    shutil.which("g++") is None, reason="g++ not available"
+)
+
+
+@pytest.fixture(scope="module")
+def lib(tmp_path_factory):
+    so = tmp_path_factory.mktemp("native") / "libdcml_worker.so"
+    subprocess.run(
+        ["g++", "-O2", "-shared", "-fPIC", "-o", str(so),
+         str(REPO / "native" / "dcml_worker.cpp")],
+        check=True,
+    )
+    lib = ctypes.CDLL(str(so))
+    lib.dcml_worker_process.restype = None
+    lib.dcml_worker_cost_at.restype = ctypes.c_double
+    return lib
+
+
+def _cpp_process(lib, r_wl, c_wl, trace, arrive_time, download, env):
+    c = env.cfg.consts
+    out = (ctypes.c_double * 6)()
+    tr = np.ascontiguousarray(trace, np.float64)
+    lib.dcml_worker_process(
+        ctypes.c_double(r_wl), ctypes.c_double(c_wl),
+        tr.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        ctypes.c_int(trace.shape[0]),
+        ctypes.c_double(arrive_time), ctypes.c_double(download),
+        ctypes.c_double(0.0), ctypes.c_double(0.0),  # Pr=0: no retries
+        ctypes.c_int(env.cfg.max_drain_slots),
+        ctypes.c_double(c.second_to_centsec), ctypes.c_double(c.bit_to_byte),
+        ctypes.c_double(c.worker_frequency),
+        out,
+    )
+    return np.array(out)  # delay, p0, cost, m_slots, drained, cap_period
+
+
+def test_worker_math_matches_jax(lib):
+    env = DCMLEnv(DCMLEnvConfig(), data_dir=str(REPO / "data"))
+    c = env.cfg.consts
+    W, P = c.worker_number_max, c.local_workload_period
+    rng = np.random.RandomState(0)
+
+    for trial in range(5):
+        r_wl = float(rng.randint(2**10, 2**16))
+        c_wl = float(rng.randint(2**5, 2**9))
+        trace = rng.uniform(0.0, 1.0, size=(W, P)).round(2)
+        arrive_time = float(rng.randint(0, 50))
+        download = c.non_shannon_data_rate
+
+        delays, p0, c20, cap_period, m_slots = env._process_workers(
+            jax.random.key(trial),
+            jnp.float32(r_wl), jnp.float32(c_wl),
+            jnp.zeros((W,)),                       # Pr = 0 -> deterministic
+            jnp.asarray(trace, jnp.float32),
+            jnp.float32(arrive_time),
+            jnp.full((W,), download, jnp.float32),
+        )
+        for w in range(0, W, 17):                  # sample workers
+            got = _cpp_process(lib, r_wl, c_wl, trace[w], arrive_time, download, env)
+            np.testing.assert_allclose(
+                got[0], float(delays[w]), rtol=1e-5, atol=1e-3,
+                err_msg=f"delay trial={trial} w={w}",
+            )
+            np.testing.assert_allclose(got[1], float(p0[w]), rtol=1e-5, atol=1e-3)
+            assert int(got[3]) == int(m_slots[w]), f"m_slots trial={trial} w={w}"
+            np.testing.assert_allclose(
+                got[5], float(cap_period[w]), rtol=1e-5, atol=1e-3
+            )
+
+
+def test_cost_at_matches_jax(lib):
+    env = DCMLEnv(DCMLEnvConfig(), data_dir=str(REPO / "data"))
+    c = env.cfg.consts
+    W, P = c.worker_number_max, c.local_workload_period
+    rng = np.random.RandomState(1)
+    trace = rng.uniform(0.0, 1.0, size=(W, P)).round(2)
+    r_wl, c_wl = 2**14.0, 2**7.0
+    arrive_time = 3.0
+    download = c.non_shannon_data_rate
+
+    delays, p0, c20, cap_period, m_slots = env._process_workers(
+        jax.random.key(9), jnp.float32(r_wl), jnp.float32(c_wl),
+        jnp.zeros((W,)), jnp.asarray(trace, jnp.float32),
+        jnp.float32(arrive_time), jnp.full((W,), download, jnp.float32),
+    )
+    for w in range(0, W, 23):
+        cpp = _cpp_process(lib, r_wl, c_wl, trace[w], arrive_time, download, env)
+        # recompute ctp0 the way both implementations do
+        n_retry = 1.0
+        transmit = c.second_to_centsec * (
+            np.ceil((r_wl + 1.0) * c_wl) * c.bit_to_byte / download + 0.001
+        ) * n_retry
+        ctp0 = int(np.floor(transmit + arrive_time)) % P
+        for end in [1.0, 2.0, 7.0, 100.0, 1e5]:
+            ref = float(env._cost_at(
+                p0[w][None], c20[w][None], cap_period[w][None],
+                m_slots[w][None], jnp.float32(end),
+            )[0])
+            tr = np.ascontiguousarray(trace[w], np.float64)
+            got = lib.dcml_worker_cost_at(
+                tr.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+                ctypes.c_int(P), ctypes.c_int(ctp0),
+                ctypes.c_double(cpp[1]), ctypes.c_double(cpp[3]),
+                ctypes.c_double(end),
+            )
+            np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-3,
+                                       err_msg=f"w={w} end={end}")
