@@ -42,11 +42,54 @@ def log(msg: str) -> None:
     print(f"[bench] {msg}", file=sys.stderr, flush=True)
 
 
+def _probe_tpu(timeout_s: int) -> bool:
+    """Can the TPU backend initialize within ``timeout_s``?  Probed in a
+    SUBPROCESS because a wedged tunnel blocks ``jax.devices()`` inside a
+    C++ wait that no in-process timeout can interrupt (observed: ~25 min
+    queue waits ending in UNAVAILABLE when the chip is unhealthy).  A
+    timed-out probe means the bench proceeds on CPU — a liveness number
+    beats a crashed round."""
+    import signal
+    import subprocess
+
+    if timeout_s <= 0:
+        return True  # probing disabled
+    # own session + process-group kill: run()'s kill-and-communicate can
+    # itself block forever if the wedged child (or a helper it spawned)
+    # holds the stdout pipe open after SIGKILL of the direct child only
+    proc = subprocess.Popen(
+        [sys.executable, "-c", "import jax; jax.devices(); print('ok')"],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
+        start_new_session=True,
+    )
+    try:
+        out, _ = proc.communicate(timeout=timeout_s)
+        return proc.returncode == 0 and "ok" in (out or "")
+    except subprocess.TimeoutExpired:
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except Exception:
+            pass
+        try:
+            proc.communicate(timeout=10)
+        except Exception:
+            pass
+        return False
+
+
 def _setup_jax():
     """Import jax with a persistent compilation cache and platform fallback."""
     from mat_dcml_tpu.utils.platform import apply_platform_override
 
     apply_platform_override()
+    probe_forced_cpu = False
+    probe_timeout = int(os.environ.get("BENCH_TPU_PROBE_TIMEOUT", "900"))
+    if os.environ.get("JAX_PLATFORMS", "") != "cpu" and not _probe_tpu(probe_timeout):
+        log(f"TPU probe failed/timed out ({probe_timeout}s); forcing CPU")
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        probe_forced_cpu = True
+        apply_platform_override()  # defeat the sitecustomize config update
+
     import jax
 
     cache_dir = os.environ.get(
@@ -71,7 +114,7 @@ def _setup_jax():
         devs = jax.devices()
         fell_back = True
     log(f"platform={devs[0].platform} devices={len(devs)}")
-    return jax, fell_back
+    return jax, fell_back or probe_forced_cpu
 
 
 def _build(jax, E: int, T: int):
