@@ -190,6 +190,9 @@ class ShareSubprocVecEnv(ShareVecEnv):
             for i in range(0, len(env_fns), envs_per_worker)
         ]
         self._chunk_sizes = [len(c) for c in chunks]
+        # set before any worker start so __del__ -> close() is safe even if
+        # construction fails mid-way (e.g. the env factory raises in-worker)
+        self._closed = False
         self.remotes, self.processes = [], []
         for chunk in chunks:
             remote, child = ctx.Pipe()
@@ -202,9 +205,16 @@ class ShareSubprocVecEnv(ShareVecEnv):
             child.close()
             self.remotes.append(remote)
             self.processes.append(p)
-        self.remotes[0].send(("spaces", None))
-        self.n_agents, self.obs_dim, self.share_obs_dim, self.action_dim = self.remotes[0].recv()
-        self._closed = False
+        try:
+            self.remotes[0].send(("spaces", None))
+            self.n_agents, self.obs_dim, self.share_obs_dim, self.action_dim = self.remotes[0].recv()
+        except (EOFError, ConnectionResetError, BrokenPipeError, OSError) as e:
+            self.close()
+            raise RuntimeError(
+                "vec-env worker died during env construction (its stderr "
+                "shows the original error — commonly a missing simulator "
+                "package)"
+            ) from e
 
     def reset(self, reset_args=None):
         start = 0

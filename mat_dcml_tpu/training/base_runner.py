@@ -128,16 +128,28 @@ class BaseRunner:
             params = self.policy.init_params(k_model)
         train_state = self.trainer.init_state(params)
         if self.run_cfg.model_dir:
-            mgr = CheckpointManager(self.run_cfg.model_dir)
-            restored = mgr.restore(template=train_state)
-            if restored is None:
-                raise FileNotFoundError(f"no checkpoint under {self.run_cfg.model_dir}")
-            train_state = restored
-            self.start_episode = (mgr.latest_step or 0) + 1
-            self.log(f"restored checkpoint step {mgr.latest_step} from {self.run_cfg.model_dir}")
+            train_state = self._maybe_restore(train_state)
+            self.start_episode = self._restored_step + 1
         rollout_state = self.collector.init_state(k_roll, self.run_cfg.n_rollout_threads)
         self._log_model_stats(train_state)
         return train_state, rollout_state
+
+    def _maybe_restore(self, train_state, params_only: bool = False):
+        """Restore from ``model_dir``.  ``params_only=True`` = transfer
+        semantics: weights reload, fresh optimizer/normalizer/schedule (the
+        reference's restore loads only the state_dict, SURVEY §5 checkpoint
+        notes); False = full-state lossless resume."""
+        mgr = CheckpointManager(self.run_cfg.model_dir)
+        restored = mgr.restore(template=train_state)
+        if restored is None:
+            raise FileNotFoundError(f"no checkpoint under {self.run_cfg.model_dir}")
+        self._restored_step = mgr.latest_step or 0
+        kind = "params" if params_only else "full state"
+        self.log(f"restored checkpoint step {mgr.latest_step} ({kind}) "
+                 f"from {self.run_cfg.model_dir}")
+        if params_only:
+            return train_state._replace(params=restored.params)
+        return restored
 
     def _log_model_stats(self, train_state) -> None:
         """The reference's parameter-count block + THOP hook, XLA-native

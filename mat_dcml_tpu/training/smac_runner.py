@@ -133,6 +133,11 @@ class SMACMultiRunner(BaseRunner):
         k_model, *k_rolls = jax.random.split(key, 1 + len(self.train_maps))
         params = self.policy.init_params(k_model)
         train_state = self.trainer.init_state(params)
+        if self.run_cfg.model_dir:
+            # few-shot transfer: reload the multi-task policy WEIGHTS and
+            # fine-tune with a fresh optimizer/schedule — full-state restore
+            # would resume the old run's (possibly fully decayed) LR schedule
+            train_state = self._maybe_restore(train_state, params_only=True)
         rollout_states = {
             m: self.collectors[m].init_state(k, self.run_cfg.n_rollout_threads)
             for m, k in zip(self.train_maps, k_rolls)

@@ -1,0 +1,10 @@
+#!/bin/sh
+# The reference DCML recipe (DCML_MAT_Train.py:193 hardcoded argv):
+# 8 rollout threads, 1M env steps, episode_length 50, lr 5e-5, ppo_epoch 15,
+# 4 minibatches.  On TPU the env batch can be far larger (bench.py measured
+# best E=256 on v5-lite); this launcher keeps the faithful recipe.
+algo="${1:-mat}"   # mat | mat_dec | momat | dmomat | ppo | mappo | rmappo | ippo | happo | hatrpo | random
+seed="${2:-1}"
+exec python train_dcml.py --algorithm_name "$algo" --experiment_name single \
+  --seed "$seed" --n_rollout_threads 8 --num_env_steps 1000000 \
+  --episode_length 50 --lr 5e-5 --ppo_epoch 15 --num_mini_batch 4

@@ -1,0 +1,11 @@
+#!/bin/sh
+# Reference train_football.sh: academy_counterattack_easy, 4 agents, 20
+# threads, 1 minibatch, episode_length 200, lr 5e-4, ppo_epoch 10, clip 0.05.
+# Needs the external gfootball package (the entry point explains the gating).
+scenario="${1:-academy_counterattack_easy}"
+seed="${2:-1}"
+exec python train_football.py --scenario "$scenario" --n_agent 4 \
+  --algorithm_name mat --experiment_name single --seed "$seed" \
+  --n_rollout_threads 20 --num_mini_batch 1 --episode_length 200 \
+  --num_env_steps 10000000 --lr 5e-4 --entropy_coef 0.01 \
+  --max_grad_norm 0.5 --ppo_epoch 10 --clip_param 0.05 --eval_episodes 32

@@ -1,0 +1,9 @@
+#!/bin/sh
+# Reference train_mpe.sh: 128 rollout threads, 1 minibatch, n_block 1,
+# n_embd 64, episode_length 25, lr 7e-4, ppo_epoch 10, clip 0.05.
+scenario="${1:-simple_spread}"
+seed="${2:-1}"
+exec python train_mpe.py --scenario "$scenario" --algorithm_name mat \
+  --experiment_name single --seed "$seed" --n_block 1 --n_embd 64 \
+  --n_rollout_threads 128 --num_mini_batch 1 --episode_length 25 \
+  --num_env_steps 20000000 --ppo_epoch 10 --clip_param 0.05 --lr 7e-4

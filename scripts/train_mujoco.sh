@@ -1,0 +1,13 @@
+#!/bin/sh
+# Reference train_mujoco.sh: HalfCheetah 6x1, obsk 0, 40 threads, 40
+# minibatches, episode_length 100, lr 5e-5, entropy 0.001, grad clip 0.5,
+# ppo_epoch 10, clip 0.05; faulty-node eval list for robustness studies.
+scenario="${1:-HalfCheetah-v2}"
+conf="${2:-6x1}"
+seed="${3:-1}"
+exec python train_mujoco.py --scenario "$scenario" --agent_conf "$conf" \
+  --agent_obsk 0 --algorithm_name mat --experiment_name single --seed "$seed" \
+  --n_rollout_threads 40 --num_mini_batch 40 --episode_length 100 \
+  --num_env_steps 10000000 --lr 5e-5 --entropy_coef 0.001 \
+  --max_grad_norm 0.5 --ppo_epoch 10 --clip_param 0.05 \
+  --eval_faulty_node -1 --eval_episodes 5

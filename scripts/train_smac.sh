@@ -1,0 +1,11 @@
+#!/bin/sh
+# Reference train_smac.sh hyperparameters (mat_src/mat/scripts/train_smac.sh):
+# 32 rollout threads, 1 minibatch, episode_length 100, lr 5e-4, ppo_epoch 15,
+# clip 0.05; map from $1 (the reference pins 6h_vs_8z — not in the SMACLite
+# roster; 8m is the closest large map).
+map="${1:-8m}"
+seed="${2:-1}"
+exec python train_smac.py --map_name "$map" --algorithm_name mat \
+  --experiment_name single --seed "$seed" --n_rollout_threads 32 \
+  --num_mini_batch 1 --episode_length 100 --num_env_steps 10000000 \
+  --lr 5e-4 --ppo_epoch 15 --clip_param 0.05

@@ -1,0 +1,14 @@
+#!/bin/sh
+# Reference train_smac_few_shot.sh: fine-tune the multi-task policy per
+# held-out map (loop over maps, restore with --model_dir).
+model_dir="${1:?usage: train_smac_few_shot.sh <model_dir of multi-task run>}"
+seed="${2:-1}"
+# genuinely held-out maps (disjoint from train_smac_multi.sh's roster of
+# 3m,8m,2s3z,3s5z,MMM), like the reference's from-scratch/few-shot lists
+for map in 2m 5m_vs_6m 8m_vs_9m; do
+  python train_smac_multi.py --train_maps "$map" --eval_maps "$map" \
+    --algorithm_name mat --experiment_name "few_shot_$map" --seed "$seed" \
+    --model_dir "$model_dir" --n_rollout_threads 36 --num_mini_batch 1 \
+    --episode_length 100 --num_env_steps 100000 --lr 5e-4 --ppo_epoch 10 \
+    --clip_param 0.05 || exit 1
+done

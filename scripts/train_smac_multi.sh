@@ -1,0 +1,9 @@
+#!/bin/sh
+# Reference train_smac_multi.sh: one policy across the map list, 36 threads,
+# 1 minibatch, episode_length 100, lr 5e-4, ppo_epoch 10, clip 0.05.
+# Maps restricted to the SMACLite roster equivalents.
+seed="${1:-1}"
+exec python train_smac_multi.py --train_maps 3m,8m,2s3z,3s5z,MMM \
+  --algorithm_name mat --experiment_name multi_task --seed "$seed" \
+  --n_rollout_threads 36 --num_mini_batch 1 --episode_length 100 \
+  --num_env_steps 10000000 --lr 5e-4 --ppo_epoch 10 --clip_param 0.05

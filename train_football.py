@@ -38,6 +38,18 @@ def main(argv=None):
         "episode_length": 200,
     })
 
+    # gate BEFORE forking bridge workers: a missing gfootball would otherwise
+    # kill every worker during env construction and surface as a pipe error
+    try:
+        import gfootball  # noqa: F401
+    except ImportError:
+        raise SystemExit(
+            "train_football.py needs the external gfootball package (not "
+            "bundled in this image). The encoders and runner are tested "
+            "against fake backends (tests/test_football.py); install "
+            "gfootball to drive the real game through the host bridge."
+        )
+
     def make_env(scenario=run.scenario, n=ns.n_agent, rew=ns.rewards):
         return FootballHostEnv(scenario=scenario, n_agents=n, rewards=rew)
 
