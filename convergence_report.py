@@ -2,13 +2,18 @@
 """Compare a DCML training run against the reference's shipped curves.
 
 The reference publishes no numbers; its recoverable training evidence is two
-TensorBoard CSV exports of an MO-MAT run's per-step objective means
+TensorBoard CSV exports of an MO-MAT run's objective curves
 (``data/dcml_benchmark/momat_ct.csv`` / ``momat_payment.csv``, 800 points to
 step ~799k; BASELINE.md) and a TD3 episode-reward anchor
-(``data/dcml_td3.txt``).  Our momat runner logs the SAME quantities
-(``average_step_objective_0`` = completion-time channel,
-``average_step_objective_1`` = payment channel) to metrics.jsonl, so curves
-align directly on env steps.
+(``data/dcml_td3.txt``).
+
+Scale note (verified empirically): the exported channels are RAW
+``-delay`` / ``-payment`` — at random init the reference curves start at
+(-7.41, -92.68) and a fresh run of this framework measures delay 8.2 /
+payment 96.1 — NOT the alpha/beta-scaled reward channels (our
+``average_step_objective_*``, which carry the 99x delay weight).  The
+comparison therefore uses our runner's ``aver_episode_delays`` /
+``aver_episode_payments`` negated, which are unit-identical.
 
 Usage:
   python train_dcml.py --algorithm_name momat --experiment_name conv ...
@@ -39,10 +44,11 @@ def load_run(path: Path):
     steps, ct, pay, rew = [], [], [], []
     for line in open(path):
         r = json.loads(line)
-        if "average_step_objective_0" in r:
+        if "aver_episode_delays" in r:
             steps.append(r["total_steps"])
-            ct.append(r["average_step_objective_0"])
-            pay.append(r["average_step_objective_1"])
+            # negate into the reference export's scale (see module doc)
+            ct.append(-r["aver_episode_delays"])
+            pay.append(-r["aver_episode_payments"])
             rew.append(r.get("aver_episode_rewards", np.nan))
     return np.array(steps), np.array(ct), np.array(pay), np.array(rew)
 
