@@ -49,6 +49,10 @@ class RunConfig:
     # transformer trunk compute dtype ("float32" | "bfloat16"); heads,
     # softmax, distributions, and params always float32 (models/mat.py)
     model_dtype: str = "float32"
+    # rematerialize transformer blocks in the PPO backward pass
+    # (jax.checkpoint): big-batch updates fit in HBM at ~1/3 extra forward
+    # FLOPs; numerically exact (tests/test_ppo_accum.py)
+    remat: bool = False
     encode_state: bool = False
     dec_actor: bool = False
     share_actor: bool = False

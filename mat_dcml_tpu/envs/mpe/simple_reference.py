@@ -134,6 +134,13 @@ class SimpleReferenceEnv:
     def step(self, st: ReferenceState, action: jax.Array) -> Tuple[ReferenceState, ReferenceTimeStep]:
         c = self.cfg
         act = action.reshape(2, -1).astype(jnp.int32)   # (2, [move, comm])
+        if act.shape[-1] != 2:
+            # See simple_world_comm.step: a wrong-width array would silently
+            # alias move/comm indices under static index clamping (ADVICE r2).
+            raise ValueError(
+                f"simple_reference expects (2, 2) MultiDiscrete actions "
+                f"(move, comm); got width {act.shape[-1]}"
+            )
         onehot = jax.nn.one_hot(act[:, 0], 5)
         u = particle.decode_move(onehot) * particle.force_gain(None)
         comm = jax.nn.one_hot(jnp.clip(act[:, 1], 0, c.dim_c - 1), c.dim_c)

@@ -150,6 +150,14 @@ class SimpleWorldCommEnv:
         c = self.cfg
         N = c.n_agents
         act = action.reshape(N, -1).astype(jnp.int32)
+        if act.shape[-1] != 2:
+            # Fail loudly at trace time: with a wrong-width action array,
+            # JAX's static out-of-bounds clamping would silently reuse the
+            # move index as the leader's comm symbol (ADVICE r2).
+            raise ValueError(
+                f"simple_world_comm expects (N, 2) MultiDiscrete actions "
+                f"(move, comm); got width {act.shape[-1]}"
+            )
         onehot = jax.nn.one_hot(act[:, 0], 5)
         u = particle.decode_move(onehot) * self._gain[:, None]
         comm = jax.nn.one_hot(jnp.clip(act[0, 1], 0, c.dim_c - 1), c.dim_c)

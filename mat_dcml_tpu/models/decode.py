@@ -40,9 +40,15 @@ class DecodeResult(NamedTuple):
     log_prob: jax.Array     # (B, n_agent, act_prob) float32
 
 
-# "auto": fused Pallas decode-step kernel on TPU, XLA elsewhere.
+# "auto": XLA until the whole-decode fused kernel demonstrates a measured win
+# on the chip, then Pallas on TPU for the discrete families (the flip is
+# _AUTO_PALLAS_ON_TPU below, with the BENCHLOG.md row as evidence).
 _DECODE_IMPL_ENV = "MAT_DCML_TPU_DECODE_IMPL"
 _VALID_DECODE_IMPLS = ("auto", "xla", "pallas", "pallas_interpret")
+
+# Flipped to True once the whole-decode kernel's win is measured on the chip
+# (BENCHLOG.md); kill switch: MAT_DCML_TPU_DECODE_IMPL=xla.
+_AUTO_PALLAS_ON_TPU = False
 
 
 def _resolve_decode_impl(cfg) -> str:
@@ -54,8 +60,12 @@ def _resolve_decode_impl(cfg) -> str:
     if cfg.dec_actor:
         return "xla"               # MAT-Dec has no decoder trunk to fuse
     if impl == "auto":
-        # stays XLA until the fused kernel demonstrates a measured win on the
-        # production shape (see ops/pallas_decode.py); flip via env var
+        if (
+            _AUTO_PALLAS_ON_TPU
+            and jax.default_backend() == "tpu"
+            and cfg.action_type in (DISCRETE, SEMI_DISCRETE)
+        ):
+            return "pallas"
         return "xla"
     return impl
 
@@ -89,6 +99,13 @@ def ar_decode(
     A, adim = cfg.n_agent, cfg.action_dim
     in_dim = cfg.action_input_dim
 
+    impl = _resolve_decode_impl(cfg)
+    if impl.startswith("pallas") and cfg.action_type in (DISCRETE, SEMI_DISCRETE):
+        return _fused_ar_decode_path(
+            model, params, key, obs_rep, available_actions, deterministic,
+            interpret=impl == "pallas_interpret",
+        )
+
     if available_actions is None:
         available_actions = jnp.ones((B, A, adim), jnp.float32)
 
@@ -101,9 +118,9 @@ def ar_decode(
 
     caches = model.fresh_cache(B)
 
-    impl = _resolve_decode_impl(cfg)
     if impl.startswith("pallas"):
-        # whole decode position fused into ONE kernel (ops/pallas_decode.py)
+        # continuous-family fallback: one fused kernel per decode position
+        # (the discrete families take the whole-decode kernel path above)
         from mat_dcml_tpu.ops.pallas_decode import (
             fused_decode_step,
             pack_decode_weights,
@@ -176,6 +193,73 @@ def ar_decode(
     action = jnp.swapaxes(acts, 0, 1)
     log_prob = jnp.swapaxes(logps, 0, 1)
     return DecodeResult(action, log_prob)
+
+
+def _fused_ar_decode_path(
+    model: MultiAgentTransformer,
+    params,
+    key: jax.Array,
+    obs_rep: jax.Array,
+    available_actions: Optional[jax.Array],
+    deterministic: bool,
+    interpret: bool = False,
+) -> DecodeResult:
+    """Whole-decode fused kernel path (``ops/pallas_decode.fused_ar_decode``).
+
+    Reproduces the XLA scan's draws bit-exactly: the per-position key chain
+    (``key, k_d, k_c = split(key, 3)``) is replayed here, and
+    ``jax.random.categorical(k, logits)`` == ``argmax(logits + gumbel(k,
+    logits.shape))``, so precomputing the Gumbel tensor and arg-maxing inside
+    the kernel is the same sample.  The semi-discrete Gaussian tail
+    (``transformer_act.py:93-98``) likewise consumes precomputed normal noise.
+    """
+    from mat_dcml_tpu.ops.pallas_decode import (
+        fused_ar_decode,
+        pack_ar_decode_weights,
+    )
+
+    cfg = model.cfg
+    B = obs_rep.shape[0]
+    A, adim = cfg.n_agent, cfg.action_dim
+    nd = cfg.n_discrete_agents if cfg.action_type == SEMI_DISCRETE else A
+    n_rows = max(1, A - nd)
+
+    def split_step(k, _):
+        k, k_d, k_c = jax.random.split(k, 3)
+        return k, (k_d, k_c)
+
+    _, (kds, kcs) = jax.lax.scan(split_step, key, None, length=A)
+    if deterministic:
+        gumbel = jnp.zeros((B, A, adim), jnp.float32)
+        normal = jnp.zeros((B, n_rows, adim), jnp.float32)
+    else:
+        gumbel = jnp.transpose(
+            jax.vmap(lambda k: jax.random.gumbel(k, (B, adim), jnp.float32))(kds),
+            (1, 0, 2),
+        )
+        if A - nd > 0:
+            normal = jnp.transpose(
+                jax.vmap(lambda k: jax.random.normal(k, (B, adim), jnp.float32))(kcs[nd:]),
+                (1, 0, 2),
+            )
+        else:
+            normal = jnp.zeros((B, n_rows, adim), jnp.float32)
+
+    std = _action_std(model, params) if cfg.action_type != DISCRETE else None
+    weights, _ = pack_ar_decode_weights(params, cfg, std)
+    adim_pad = weights.embed_act.shape[0]
+    pad = lambda x: jnp.pad(x, ((0, 0), (0, 0), (0, adim_pad - x.shape[2])))
+    gumbel, normal = pad(gumbel), pad(normal)
+    avail = (
+        pad(available_actions.astype(jnp.float32))
+        if available_actions is not None
+        else None
+    )
+    act, logp = fused_ar_decode(
+        weights, obs_rep, gumbel, normal, avail,
+        n_head=cfg.n_head, adim=adim, nd=nd, interpret=interpret,
+    )
+    return DecodeResult(act[..., None], logp[..., None])
 
 
 def _discrete_branch(logits, ava_i, key, deterministic, adim, in_dim):

@@ -47,7 +47,15 @@ _OVERRIDE: tuple | None = None
 
 @contextlib.contextmanager
 def impl_override(impl: str, axis: str = "seq", valid_len: int = 0):
-    """Pin attention dispatch while tracing a sharded forward."""
+    """Pin attention dispatch while tracing a sharded forward.
+
+    Single-trace assumption: the override is process-global state consulted at
+    trace time, so exactly one sharded forward may be traced inside the
+    context (which is how ``parallel/seq_parallel.py`` uses it — one
+    ``seq_sharded_call`` trace per context).  An explicitly passed ``impl=``
+    at a call site still wins over the override (ADVICE r2): call sites that
+    pin an implementation know something the blanket override does not.
+    """
     global _OVERRIDE
     old = _OVERRIDE
     _OVERRIDE = (impl, axis, valid_len)
@@ -58,7 +66,7 @@ def impl_override(impl: str, axis: str = "seq", valid_len: int = 0):
 
 
 def _resolve_impl(impl: str | None, lk: int) -> str:
-    if _OVERRIDE is not None:
+    if _OVERRIDE is not None and impl is None:
         return _OVERRIDE[0]
     impl = impl or os.environ.get(_IMPL_ENV, "auto")
     if impl not in _VALID_IMPLS:

@@ -163,6 +163,48 @@ def _cached_attention(q, k_cache, v_cache, i, n_head):
     return jnp.concatenate(outs, axis=-1)                  # (TB, D) f32
 
 
+def _decoder_block_body(
+    x, rep, i, b, dtype, n_head, D,
+    qkvp1_w_ref, qkvp1_b_ref, qkvp2_w_ref, qkvp2_b_ref,
+    mlp_w1_ref, mlp_b1_ref, mlp_w2_ref, mlp_b2_ref, lns_ref,
+    k1_ref, v1_ref, k2_ref, v2_ref,
+):
+    """One DecodeBlock position: write K/V at ``i`` into the given cache refs,
+    attend over them, LN/MLP — shared by the per-position and whole-decode
+    kernels so their numerics cannot drift apart (models/modules.py
+    ``DecodeBlock.decode_step`` is the XLA twin both are pinned to)."""
+    lns = lns_ref[b]
+    # ---- causal self-attn over the action cache
+    w1 = qkvp1_w_ref[b].astype(dtype)
+    b1 = qkvp1_b_ref[b].astype(dtype)
+    q1 = x @ w1[:, :D] + b1[:D]
+    k1 = x @ w1[:, D : 2 * D] + b1[D : 2 * D]
+    v1 = x @ w1[:, 2 * D : 3 * D] + b1[2 * D : 3 * D]
+    k1_ref[:, pl.ds(i, 1), :] = k1[:, None, :]
+    v1_ref[:, pl.ds(i, 1), :] = v1[:, None, :]
+    att1 = _cached_attention(q1, k1_ref[:], v1_ref[:], i, n_head).astype(dtype)
+    y1 = att1 @ w1[:, 3 * D :] + b1[3 * D :]
+    h = _layer_norm(x + y1, lns[0], lns[1])
+
+    # ---- causal cross-attn: keys/values from the h-cache, query = rep
+    w2 = qkvp2_w_ref[b].astype(dtype)
+    b2 = qkvp2_b_ref[b].astype(dtype)
+    q2 = rep @ w2[:, :D] + b2[:D]
+    k2 = h @ w2[:, D : 2 * D] + b2[D : 2 * D]
+    v2 = h @ w2[:, 2 * D : 3 * D] + b2[2 * D : 3 * D]
+    k2_ref[:, pl.ds(i, 1), :] = k2[:, None, :]
+    v2_ref[:, pl.ds(i, 1), :] = v2[:, None, :]
+    att2 = _cached_attention(q2, k2_ref[:], v2_ref[:], i, n_head).astype(dtype)
+    y2 = att2 @ w2[:, 3 * D :] + b2[3 * D :]
+    h2 = _layer_norm(rep + y2, lns[2], lns[3])
+
+    # ---- MLP + residual; block output feeds the next block's self-attn
+    # stream while `rep` stays the ENCODER representation for every block
+    m = jax.nn.gelu(h2 @ mlp_w1_ref[b].astype(dtype) + mlp_b1_ref[b].astype(dtype), approximate=False)
+    m = m @ mlp_w2_ref[b].astype(dtype) + mlp_b2_ref[b].astype(dtype)
+    return _layer_norm(h2 + m, lns[4], lns[5])
+
+
 def _decode_step_kernel(
     # scalar prefetch
     i_ref,
@@ -192,49 +234,281 @@ def _decode_step_kernel(
     rep = rep_ref[:].astype(dtype)                        # (TB, D)
 
     for b in range(n_block):
-        lns = lns_ref[b]
-        # ---- causal self-attn over the action cache (DecodeBlock.decode_step)
-        w1 = qkvp1_w_ref[b].astype(dtype)
-        b1 = qkvp1_b_ref[b].astype(dtype)
-        q1 = x @ w1[:, :D] + b1[:D]
-        k1 = x @ w1[:, D : 2 * D] + b1[D : 2 * D]
-        v1 = x @ w1[:, 2 * D : 3 * D] + b1[2 * D : 3 * D]
-        k1_ref, v1_ref = cache_out[4 * b], cache_out[4 * b + 1]
-        k1_ref[:] = cache_in[4 * b][:]
-        v1_ref[:] = cache_in[4 * b + 1][:]
-        k1_ref[:, pl.ds(i, 1), :] = k1[:, None, :]
-        v1_ref[:, pl.ds(i, 1), :] = v1[:, None, :]
-        att1 = _cached_attention(q1, k1_ref[:], v1_ref[:], i, n_head).astype(dtype)
-        y1 = att1 @ w1[:, 3 * D :] + b1[3 * D :]
-        h = _layer_norm(x + y1, lns[0], lns[1])
-
-        # ---- causal cross-attn: keys/values from h-cache, query = rep
-        w2 = qkvp2_w_ref[b].astype(dtype)
-        b2 = qkvp2_b_ref[b].astype(dtype)
-        q2 = rep @ w2[:, :D] + b2[:D]
-        k2 = h @ w2[:, D : 2 * D] + b2[D : 2 * D]
-        v2 = h @ w2[:, 2 * D : 3 * D] + b2[2 * D : 3 * D]
-        k2_ref, v2_ref = cache_out[4 * b + 2], cache_out[4 * b + 3]
-        k2_ref[:] = cache_in[4 * b + 2][:]
-        v2_ref[:] = cache_in[4 * b + 3][:]
-        k2_ref[:, pl.ds(i, 1), :] = k2[:, None, :]
-        v2_ref[:, pl.ds(i, 1), :] = v2[:, None, :]
-        att2 = _cached_attention(q2, k2_ref[:], v2_ref[:], i, n_head).astype(dtype)
-        y2 = att2 @ w2[:, 3 * D :] + b2[3 * D :]
-        h2 = _layer_norm(rep + y2, lns[2], lns[3])
-
-        # ---- MLP + residual
-        m = jax.nn.gelu(h2 @ mlp_w1_ref[b].astype(dtype) + mlp_b1_ref[b].astype(dtype), approximate=False)
-        m = m @ mlp_w2_ref[b].astype(dtype) + mlp_b2_ref[b].astype(dtype)
-        # block output becomes the next block's self-attn stream; `rep` stays
-        # the ENCODER representation for every block (Decoder.decode_step)
-        x = _layer_norm(h2 + m, lns[4], lns[5])
+        # cache tiles round-trip HBM here (aliased in/out); copy forward
+        # before the in-place position-i update
+        for c in range(4):
+            cache_out[4 * b + c][:] = cache_in[4 * b + c][:]
+        x = _decoder_block_body(
+            x, rep, i, b, dtype, n_head, D,
+            qkvp1_w_ref, qkvp1_b_ref, qkvp2_w_ref, qkvp2_b_ref,
+            mlp_w1_ref, mlp_b1_ref, mlp_w2_ref, mlp_b2_ref, lns_ref,
+            cache_out[4 * b], cache_out[4 * b + 1],
+            cache_out[4 * b + 2], cache_out[4 * b + 3],
+        )
 
     # ---- f32 head (models/mat.py Head)
     t = x.astype(jnp.float32) @ head_w1_ref[:].astype(jnp.float32) + head_b1_ref[:].astype(jnp.float32)
     t = jax.nn.gelu(t, approximate=False)
     t = _layer_norm(t, head_ln_ref[0], head_ln_ref[1])
     logits_ref[:] = t @ head_w2_ref[:] + head_b2_ref[:]
+
+
+# ---------------------------------------------------------------------------
+# Whole-decode fused kernel (round 3)
+# ---------------------------------------------------------------------------
+#
+# The per-position kernel above still pays one HBM round-trip of every KV
+# cache per position (8 caches x TB x L x D, in AND out, L times) plus one
+# kernel dispatch per scan step.  This kernel runs the ENTIRE autoregressive
+# decode — all L positions, sampling included — in ONE ``pallas_call``:
+#
+# - grid over batch tiles only; a ``fori_loop`` over positions runs inside
+#   the kernel, so per-position state never leaves VMEM;
+# - KV caches live in VMEM *scratch* (never written to HBM at all — decode
+#   outputs are just actions and log-probs);
+# - sampling is fused: categorical draws use precomputed Gumbel noise
+#   (``jax.random.categorical`` IS argmax(logits + gumbel), so feeding the
+#   same per-position Gumbel tensor reproduces the XLA path's draws
+#   bit-exactly), the semi-discrete Gaussian tail uses precomputed normal
+#   noise (``transformer_act.py:77-98`` sampling semantics);
+# - the sampled action is one-hot re-embedded as the next position's input
+#   inside the loop (the loop-carried value), replicating
+#   ``transformer_act.py:90`` without leaving the kernel.
+
+MASK_VALUE = -1e10   # ops/distributions.mask_logits (transformer_act.py:14,163)
+PAD_KILL = -3e38     # below MASK_VALUE + any Gumbel draw: padding lanes never win
+
+
+class ARDecodeWeights(NamedTuple):
+    """Packed weights for the whole-decode kernel."""
+
+    embed_start: jax.Array   # (1, D) pre-activation embedding of the start token
+    embed_act: jax.Array     # (adim_pad, D) rows = one-hot action embeddings
+    ln0: jax.Array           # (2, D)
+    block_qkvp1_w: jax.Array
+    block_qkvp1_b: jax.Array
+    block_qkvp2_w: jax.Array
+    block_qkvp2_b: jax.Array
+    block_mlp_w1: jax.Array
+    block_mlp_b1: jax.Array
+    block_mlp_w2: jax.Array
+    block_mlp_b2: jax.Array
+    block_lns: jax.Array
+    head_w1: jax.Array
+    head_b1: jax.Array
+    head_ln: jax.Array
+    head_w2: jax.Array       # (D, adim_pad)
+    head_b2: jax.Array
+    std_row: jax.Array       # (1, adim_pad) f32 action std (ones when discrete)
+
+
+def pack_ar_decode_weights(params, cfg, std=None) -> Tuple[ARDecodeWeights, int]:
+    """Flax MAT params -> whole-decode kernel weights.
+
+    The discrete-family action embedding is a no-bias dense over
+    ``[start | one-hot]`` (``ma_transformer.py:163-166``); split it into the
+    start row and the action rows so the kernel never materializes the
+    shifted-action vector.
+    """
+    w, adim = pack_decode_weights(params, cfg)
+    D = w.embed_w.shape[1]
+    adim_pad = w.head_w2.shape[1]
+    embed_act = jnp.zeros((adim_pad, D), w.embed_w.dtype).at[:adim].set(
+        w.embed_w[1 : 1 + adim]
+    )
+    std_row = jnp.ones((1, adim_pad), jnp.float32)
+    if std is not None:
+        std_row = std_row.at[0, :adim].set(std.astype(jnp.float32))
+    return ARDecodeWeights(
+        embed_start=w.embed_w[0:1],
+        embed_act=embed_act,
+        ln0=w.ln0,
+        block_qkvp1_w=w.block_qkvp1_w,
+        block_qkvp1_b=w.block_qkvp1_b,
+        block_qkvp2_w=w.block_qkvp2_w,
+        block_qkvp2_b=w.block_qkvp2_b,
+        block_mlp_w1=w.block_mlp_w1,
+        block_mlp_b1=w.block_mlp_b1,
+        block_mlp_w2=w.block_mlp_w2,
+        block_mlp_b2=w.block_mlp_b2,
+        block_lns=w.block_lns,
+        head_w1=w.head_w1,
+        head_b1=w.head_b1,
+        head_ln=w.head_ln,
+        head_w2=w.head_w2,
+        head_b2=w.head_b2,
+        std_row=std_row,
+    ), adim
+
+
+def _ar_decode_kernel(
+    *refs,
+    n_block: int,
+    n_head: int,
+    adim: int,
+    nd: int,
+    has_avail: bool,
+):
+    k = 4 if has_avail else 3
+    rep_ref, gumbel_ref, normal_ref = refs[0], refs[1], refs[2]
+    avail_ref = refs[3] if has_avail else None
+    (embed_start_ref, embed_act_ref, ln0_ref,
+     qkvp1_w_ref, qkvp1_b_ref, qkvp2_w_ref, qkvp2_b_ref,
+     mlp_w1_ref, mlp_b1_ref, mlp_w2_ref, mlp_b2_ref, lns_ref,
+     head_w1_ref, head_b1_ref, head_ln_ref, head_w2_ref, head_b2_ref,
+     std_ref) = refs[k : k + 18]
+    act_ref, logp_ref = refs[k + 18], refs[k + 19]
+    cache_refs = refs[k + 20 :]
+
+    TB, A, D = rep_ref.shape
+    adim_pad = gumbel_ref.shape[2]
+    n_rows = normal_ref.shape[1]
+    dtype = cache_refs[0].dtype
+
+    # Zero the V caches: attention weights at not-yet-written positions are
+    # exactly 0 after softmax underflow, but 0 * uninitialized-VMEM can be
+    # 0 * NaN.  (K garbage is masked before softmax; zero it too for hygiene.)
+    for c in cache_refs:
+        c[:] = jnp.zeros_like(c)
+
+    lanes = jax.lax.broadcasted_iota(jnp.int32, (1, adim_pad), 1)
+    lane_valid = lanes < adim                       # (1, adim_pad)
+    last_col = (lanes == adim - 1).astype(jnp.float32)
+    std_f = std_ref[:]                              # (1, adim_pad) f32
+    c_std = jnp.sum(std_f * last_col)               # scalar: std of the tail dim
+
+    def pos_body(i, prev_onehot):
+        # ---- action embed (start token at i=0) + gelu + LN
+        x = prev_onehot.astype(dtype) @ embed_act_ref[:].astype(dtype)
+        start = jnp.where(i == 0, 1.0, 0.0).astype(dtype)
+        x = x + start * embed_start_ref[:].astype(dtype)
+        x = jax.nn.gelu(x, approximate=False)
+        x = _layer_norm(x, ln0_ref[0], ln0_ref[1])
+        rep = rep_ref[:, pl.ds(i, 1), :][:, 0, :].astype(dtype)
+
+        for b in range(n_block):
+            x = _decoder_block_body(
+                x, rep, i, b, dtype, n_head, D,
+                qkvp1_w_ref, qkvp1_b_ref, qkvp2_w_ref, qkvp2_b_ref,
+                mlp_w1_ref, mlp_b1_ref, mlp_w2_ref, mlp_b2_ref, lns_ref,
+                cache_refs[4 * b], cache_refs[4 * b + 1],
+                cache_refs[4 * b + 2], cache_refs[4 * b + 3],
+            )
+
+        # ---- f32 head -> logits (TB, adim_pad)
+        t = x.astype(jnp.float32) @ head_w1_ref[:].astype(jnp.float32) + head_b1_ref[:].astype(jnp.float32)
+        t = jax.nn.gelu(t, approximate=False)
+        t = _layer_norm(t, head_ln_ref[0], head_ln_ref[1])
+        logits = t @ head_w2_ref[:] + head_b2_ref[:]
+
+        # ---- fused sampling
+        if has_avail:
+            ava = avail_ref[:, pl.ds(i, 1), :][:, 0, :]
+            masked = jnp.where(ava == 0, MASK_VALUE, logits)
+        else:
+            masked = logits
+        masked = jnp.where(lane_valid, masked, PAD_KILL)
+
+        g = gumbel_ref[:, pl.ds(i, 1), :][:, 0, :]
+        idx = jnp.argmax(masked + g, axis=-1)                       # (TB,)
+        onehot = (lanes == idx[:, None]).astype(jnp.float32)        # (TB, adim_pad)
+        mm = masked - jnp.max(masked, axis=-1, keepdims=True)
+        log_z = jnp.log(jnp.sum(jnp.exp(mm), axis=-1, keepdims=True))
+        logp_d = jnp.sum((mm - log_z) * onehot, axis=-1)            # (TB,)
+
+        nrow = jnp.clip(i - nd, 0, n_rows - 1)
+        nz = normal_ref[:, pl.ds(nrow, 1), :][:, 0, :]
+        c_sample = logits + std_f * nz
+        c_act = jnp.sum(c_sample * last_col, axis=-1)               # (TB,)
+        c_mean = jnp.sum(logits * last_col, axis=-1)
+        logp_c = (
+            -jnp.square(c_act - c_mean) / (2.0 * c_std * c_std)
+            - jnp.log(c_std)
+            - 0.5 * math.log(2.0 * math.pi)
+        )
+
+        is_cont = i >= nd
+        act_i = jnp.where(is_cont, c_act, idx.astype(jnp.float32))
+        logp_i = jnp.where(is_cont, logp_c, logp_d)
+        act_ref[pl.ds(i, 1), :] = act_i[None, :]
+        logp_ref[pl.ds(i, 1), :] = logp_i[None, :]
+        return onehot
+
+    init = jnp.zeros((TB, adim_pad), jnp.float32)
+    jax.lax.fori_loop(0, A, pos_body, init)
+
+
+def fused_ar_decode(
+    weights: ARDecodeWeights,
+    obs_rep: jax.Array,           # (B, A, D) trunk dtype
+    gumbel: jax.Array,            # (B, A, adim_pad) f32; zeros when deterministic
+    normal_rows: jax.Array,       # (B, max(1, A-nd), adim_pad) f32 tail noise
+    avail: jax.Array | None,      # (B, A, adim_pad) f32 or None (= all available)
+    *,
+    n_head: int,
+    adim: int,
+    nd: int,
+    interpret: bool = False,
+    block_b: int | None = None,
+) -> Tuple[jax.Array, jax.Array]:
+    """Whole-decode fused kernel.  Returns (action (B, A), log_prob (B, A))."""
+    B, A, D = obs_rep.shape
+    n_block = weights.block_qkvp1_w.shape[0]
+    adim_pad = weights.embed_act.shape[0]
+    n_rows = normal_rows.shape[1]
+
+    if block_b is None:
+        # VMEM: caches 4*n_block*TB*A*D + f32 noise/avail tiles TB*A*adim_pad.
+        bytes_c = 2 if obs_rep.dtype == jnp.bfloat16 else 4
+        per_b = 4 * n_block * A * D * bytes_c + (3 if avail is not None else 2) * A * adim_pad * 4
+        budget = 11 * 2**20
+        tb = budget // max(1, per_b)
+        block_b = max(8, min(128, 1 << (tb.bit_length() - 1) if tb > 0 else 8))
+    TB = min(block_b, B)
+
+    pad_b = (-B) % TB
+    if pad_b:
+        pad3 = lambda x: jnp.pad(x, ((0, pad_b), (0, 0), (0, 0)))
+        obs_rep, gumbel, normal_rows = pad3(obs_rep), pad3(gumbel), pad3(normal_rows)
+        if avail is not None:
+            avail = pad3(avail)
+    Bp = B + pad_b
+
+    grid = (Bp // TB,)
+    t3 = lambda s1, s2: pl.BlockSpec((TB, s1, s2), lambda g: (g, 0, 0))
+    full = lambda a: pl.BlockSpec(a.shape, lambda g: (0,) * a.ndim)
+
+    ops = [obs_rep, gumbel, normal_rows]
+    in_specs = [t3(A, D), t3(A, adim_pad), t3(n_rows, adim_pad)]
+    if avail is not None:
+        ops.append(avail)
+        in_specs.append(t3(A, adim_pad))
+    w = weights
+    wlist = [
+        w.embed_start, w.embed_act, w.ln0,
+        w.block_qkvp1_w, w.block_qkvp1_b, w.block_qkvp2_w, w.block_qkvp2_b,
+        w.block_mlp_w1, w.block_mlp_b1, w.block_mlp_w2, w.block_mlp_b2,
+        w.block_lns, w.head_w1, w.head_b1, w.head_ln, w.head_w2, w.head_b2,
+        w.std_row,
+    ]
+    ops += wlist
+    in_specs += [full(x) for x in wlist]
+
+    kernel = functools.partial(
+        _ar_decode_kernel,
+        n_block=n_block, n_head=n_head, adim=adim, nd=nd,
+        has_avail=avail is not None,
+    )
+    act, logp = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=[pl.BlockSpec((A, TB), lambda g: (0, g))] * 2,
+        out_shape=[jax.ShapeDtypeStruct((A, Bp), jnp.float32)] * 2,
+        scratch_shapes=[pltpu.VMEM((TB, A, D), obs_rep.dtype)] * (4 * n_block),
+        interpret=interpret,
+    )(*ops)
+    return jnp.swapaxes(act, 0, 1)[:B], jnp.swapaxes(logp, 0, 1)[:B]
 
 
 def fused_decode_step(

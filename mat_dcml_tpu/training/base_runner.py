@@ -49,6 +49,16 @@ def apply_seq_shards(run: RunConfig, policy) -> None:
         )
     from jax.sharding import Mesh
 
+    # Composition with a >1-device data-sharded placement (multi-process SPMD,
+    # parallel/distributed.py) is not supported yet: the seq mesh claims local
+    # devices the data placement also owns, and the two jits would fight over
+    # input shardings (ADVICE r2).  Fail at startup, not mid-first-update.
+    if jax.process_count() > 1:
+        raise NotImplementedError(
+            "--seq_shards cannot be combined with multi-process data "
+            "parallelism yet; run seq-sharding single-process or drop it"
+        )
+
     # local_devices: on a multi-process backend each process shards its own
     # addressable devices (a global-list mesh would be non-addressable)
     devs = jax.local_devices()

@@ -60,6 +60,7 @@ def build_discrete_policy(run: RunConfig, env):
         n_embd=run.n_embd,
         n_head=run.n_head,
         dtype=run.model_dtype,
+        remat=run.remat,
         action_type=CONTINUOUS if continuous else DISCRETE,
         encode_state=run.encode_state,
         dec_actor=run.dec_actor or run.algorithm_name == "mat_dec",

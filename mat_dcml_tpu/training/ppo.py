@@ -69,6 +69,12 @@ class PPOConfig:
     use_max_grad_norm: bool = True
     use_linear_lr_decay: bool = False
     recompute_returns_per_epoch: bool = True  # mat_trainer.py:178-198
+    # split each PPO minibatch into this many sequential gradient-accumulation
+    # chunks: activation memory drops by the same factor while gradients stay
+    # EXACT (chunk losses are normalized by full-minibatch denominators, so
+    # the summed chunk gradients equal the unchunked gradient; pinned by
+    # tests/test_ppo_accum.py).  The big-batch enabler alongside MATConfig.remat.
+    grad_accum_steps: int = 1
     # MO-MAT scalarization weights, comma-separated floats ("99,1" etc.);
     # empty = equal weights.  Per-objective advantages are normalized per
     # channel, then combined ``adv = sum_i w_i * adv_norm_i`` (reconstruction
@@ -186,20 +192,32 @@ class MATTrainer:
                 adv_norm = (adv_norm * w).sum(-1, keepdims=True)
             return adv_norm.reshape(n_rows, *adv_norm.shape[2:]), returns.reshape(n_rows, *returns.shape[2:])
 
+        accum = max(1, cfg.grad_accum_steps)
+        assert mb_size % accum == 0, (
+            f"grad_accum_steps ({accum}) must divide the minibatch size "
+            f"({mb_size} = {n_rows} rows / {cfg.num_mini_batch} minibatches)"
+        )
+
         def ppo_update(carry, mb_idx):
             params, opt_state, value_norm, adv_flat, ret_flat = carry
-            batch = jax.tree.map(lambda x: x[mb_idx], flat)
-            adv_b = adv_flat[mb_idx]
             ret_b = ret_flat[mb_idx]
 
-            # ValueNorm update precedes normalize (mat_trainer.py:68-71)
+            # ValueNorm update precedes normalize (mat_trainer.py:68-71),
+            # always on the FULL minibatch regardless of accumulation
             if cfg.use_valuenorm or cfg.use_popart:
                 value_norm = value_norm_update(value_norm, ret_b.reshape(-1, ret_b.shape[-1]))
-                ret_target = value_norm_normalize(value_norm, ret_b)
-            else:
-                ret_target = ret_b
 
-            def loss_fn(params):
+            # full-minibatch denominators: per-chunk losses scaled by these
+            # sum to the unchunked loss, so accumulated gradients are exact
+            active_full_sum = flat["active_masks"][mb_idx].sum()
+
+            def loss_fn(params, cidx):
+                batch = jax.tree.map(lambda x: x[cidx], flat)
+                adv_b = adv_flat[cidx]
+                if cfg.use_valuenorm or cfg.use_popart:
+                    ret_target = value_norm_normalize(value_norm, ret_flat[cidx])
+                else:
+                    ret_target = ret_flat[cidx]
                 values, logp, ent = self.policy.evaluate_actions(
                     params, batch["share_obs"], batch["obs"], batch["actions"], batch["available_actions"]
                 )
@@ -209,14 +227,11 @@ class MATTrainer:
                 surr2 = jnp.clip(ratio, 1.0 - cfg.clip_param, 1.0 + cfg.clip_param) * adv_b
                 surr = jnp.minimum(surr1, surr2).sum(axis=-1, keepdims=True)
                 if cfg.use_policy_active_masks:
-                    policy_loss = -(surr * active).sum() / active.sum()
+                    policy_loss = -(surr * active).sum() / active_full_sum
+                    entropy = (ent * active).sum() / active_full_sum
                 else:
-                    policy_loss = -surr.mean()
-
-                if cfg.use_policy_active_masks:
-                    entropy = (ent * active).sum() / active.sum()
-                else:
-                    entropy = ent.mean()
+                    policy_loss = -surr.sum() / (surr.size * accum)
+                    entropy = ent.sum() / (ent.size * accum)
 
                 v_clipped = batch["values"] + jnp.clip(
                     values - batch["values"], -cfg.clip_param, cfg.clip_param
@@ -231,19 +246,36 @@ class MATTrainer:
                     vl_orig = 0.5 * err_orig**2
                 vl = jnp.maximum(vl_orig, vl_clipped) if cfg.use_clipped_value_loss else vl_orig
                 if cfg.use_value_active_masks:
-                    value_loss = (vl * active).sum() / active.sum()
+                    value_loss = (vl * active).sum() / active_full_sum
                 else:
-                    value_loss = vl.mean()
+                    value_loss = vl.sum() / (vl.size * accum)
 
                 loss = policy_loss - entropy * cfg.entropy_coef + value_loss * cfg.value_loss_coef
-                return loss, (value_loss, policy_loss, entropy, ratio)
+                aux = (value_loss, policy_loss, entropy, ratio.sum() / (ratio.size * accum))
+                return loss, aux
 
-            (_, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+            idx_chunks = mb_idx.reshape(accum, mb_size // accum)
+
+            def chunk_step(acc, cidx):
+                g_acc, aux_acc = acc
+                (_, aux), g = jax.value_and_grad(loss_fn, has_aux=True)(params, cidx)
+                acc = (
+                    jax.tree.map(jnp.add, g_acc, g),
+                    jax.tree.map(jnp.add, aux_acc, aux),
+                )
+                return acc, None
+
+            zero = (
+                jax.tree.map(jnp.zeros_like, params),
+                tuple(jnp.zeros(()) for _ in range(4)),
+            )
+            (grads, aux), _ = jax.lax.scan(chunk_step, zero, idx_chunks)
+
             gnorm = optax.global_norm(grads)
             updates, opt_state = self.tx.update(grads, opt_state, params)
             params = optax.apply_updates(params, updates)
-            value_loss, policy_loss, entropy, ratio = aux
-            metrics = TrainMetrics(value_loss, policy_loss, entropy, gnorm, ratio.mean())
+            value_loss, policy_loss, entropy, ratio_mean = aux
+            metrics = TrainMetrics(value_loss, policy_loss, entropy, gnorm, ratio_mean)
             return (params, opt_state, value_norm, adv_flat, ret_flat), metrics
 
         def run_epoch(carry, key_e, targets):

@@ -74,6 +74,7 @@ def build_mat_policy(run: RunConfig, env: DCMLEnv) -> TransformerPolicy:
         n_embd=run.n_embd,
         n_head=run.n_head,
         dtype=run.model_dtype,
+        remat=run.remat,
         action_type=SEMI_DISCRETE,
         semi_index=-env.cfg.consts.extra_agent if hasattr(env, "cfg") else -1,
         encode_state=run.encode_state,

@@ -1,11 +1,18 @@
-"""Fused decode-step kernel numerics vs the unfused XLA path.
+"""Fused decode kernel numerics vs the unfused XLA path.
 
-Runs the Pallas kernel in interpret mode on CPU (bit-accurate semantics, no
+Runs the Pallas kernels in interpret mode on CPU (bit-accurate semantics, no
 TPU needed) and asserts the full autoregressive decode — sampled actions AND
 log-probs — matches the unfused scan exactly, across action families, both
 trunk dtypes, and non-divisible batch tiles.
+
+Two kernels are covered:
+- the whole-decode kernel (``fused_ar_decode``): the TPU hot path for the
+  discrete action families, with sampling fused inside;
+- the per-position kernel (``fused_decode_step``): the continuous-family
+  fallback.
 """
 
+import functools
 import os
 
 import numpy as np
@@ -24,7 +31,7 @@ from mat_dcml_tpu.models.policy import TransformerPolicy
 B, A = 6, 5          # deliberately NOT a multiple of the batch tile
 
 
-def _run(action_type, dtype, impl, seed=0, block_b=None):
+def _run(action_type, dtype, impl, seed=0, block_b=None, ava="ones"):
     cfg = MATConfig(
         n_agent=A, obs_dim=4, state_dim=12,
         action_dim=3 if action_type != SEMI_DISCRETE else 2,
@@ -36,22 +43,27 @@ def _run(action_type, dtype, impl, seed=0, block_b=None):
     key = jax.random.key(7)
     obs = jax.random.normal(jax.random.key(1), (B, A, 4))
     share = jax.random.normal(jax.random.key(2), (B, A, 12))
-    ava = jnp.ones((B, A, cfg.action_dim))
+    if ava == "ones":
+        ava = jnp.ones((B, A, cfg.action_dim))
+    elif ava == "masked":
+        # keep at least one action available per (env, agent)
+        m = jax.random.bernoulli(jax.random.key(9), 0.6, (B, A, cfg.action_dim))
+        ava = jnp.maximum(m.astype(jnp.float32), jax.nn.one_hot(0, cfg.action_dim))
 
     os.environ["MAT_DCML_TPU_DECODE_IMPL"] = impl
     try:
         if block_b is not None:
             import mat_dcml_tpu.ops.pallas_decode as pd
 
-            orig = pd.fused_decode_step
-            import functools
-
-            pd_fds = functools.partial(orig, block_b=block_b)
-            pd.fused_decode_step = pd_fds
+            orig_step = pd.fused_decode_step
+            orig_full = pd.fused_ar_decode
+            pd.fused_decode_step = functools.partial(orig_step, block_b=block_b)
+            pd.fused_ar_decode = functools.partial(orig_full, block_b=block_b)
             try:
                 out = policy.get_actions(params, key, share, obs, ava)
             finally:
-                pd.fused_decode_step = orig
+                pd.fused_decode_step = orig_step
+                pd.fused_ar_decode = orig_full
         else:
             out = policy.get_actions(params, key, share, obs, ava)
     finally:
@@ -63,14 +75,51 @@ def _run(action_type, dtype, impl, seed=0, block_b=None):
 def test_fused_matches_unfused(action_type):
     ref = _run(action_type, "float32", "xla")
     fused = _run(action_type, "float32", "pallas_interpret", block_b=2)
-    if action_type == CONTINUOUS:
+    if action_type == DISCRETE:
+        # categorical draws must be IDENTICAL — same logits, same key chain
+        # (whole-decode kernel: argmax(logits + precomputed gumbel) ==
+        # jax.random.categorical on the XLA path)
+        np.testing.assert_array_equal(np.asarray(ref.action), np.asarray(fused.action))
+    elif action_type == SEMI_DISCRETE:
+        # discrete agents exact; the Gaussian tail carries ~1e-8 reassociation
+        nd = A - 1
+        np.testing.assert_array_equal(
+            np.asarray(ref.action)[:, :nd], np.asarray(fused.action)[:, :nd]
+        )
+        np.testing.assert_allclose(
+            np.asarray(ref.action)[:, nd:], np.asarray(fused.action)[:, nd:],
+            rtol=1e-5, atol=1e-6,
+        )
+    else:
         # continuous samples carry float reassociation noise (~1e-8)
         np.testing.assert_allclose(
             np.asarray(ref.action), np.asarray(fused.action), rtol=1e-5, atol=1e-6
         )
-    else:
-        # categorical draws must be IDENTICAL — same logits, same key
-        np.testing.assert_array_equal(np.asarray(ref.action), np.asarray(fused.action))
+    np.testing.assert_allclose(
+        np.asarray(ref.log_prob), np.asarray(fused.log_prob), rtol=2e-5, atol=2e-6
+    )
+
+
+@pytest.mark.parametrize("action_type", [DISCRETE, SEMI_DISCRETE])
+def test_fused_matches_unfused_masked_avail(action_type):
+    ref = _run(action_type, "float32", "xla", ava="masked")
+    fused = _run(action_type, "float32", "pallas_interpret", block_b=2, ava="masked")
+    nd = A if action_type == DISCRETE else A - 1
+    np.testing.assert_array_equal(
+        np.asarray(ref.action)[:, :nd], np.asarray(fused.action)[:, :nd]
+    )
+    np.testing.assert_allclose(
+        np.asarray(ref.action), np.asarray(fused.action), rtol=1e-5, atol=1e-6
+    )
+    np.testing.assert_allclose(
+        np.asarray(ref.log_prob), np.asarray(fused.log_prob), rtol=2e-5, atol=2e-6
+    )
+
+
+def test_fused_matches_unfused_no_avail():
+    ref = _run(DISCRETE, "float32", "xla", ava=None)
+    fused = _run(DISCRETE, "float32", "pallas_interpret", block_b=2, ava=None)
+    np.testing.assert_array_equal(np.asarray(ref.action), np.asarray(fused.action))
     np.testing.assert_allclose(
         np.asarray(ref.log_prob), np.asarray(fused.log_prob), rtol=2e-5, atol=2e-6
     )
@@ -103,3 +152,25 @@ def test_deterministic_decode_identical():
     finally:
         os.environ["MAT_DCML_TPU_DECODE_IMPL"] = "xla"
     np.testing.assert_array_equal(np.asarray(ref.action), np.asarray(fused.action))
+
+
+def test_semi_discrete_dcml_shape():
+    """DCML-shaped config (larger A, one continuous tail agent): exact draw
+    parity and a batch tile that divides unevenly into the agent count."""
+    global B, A
+    oldB, oldA = B, A
+    B, A = 5, 9
+    try:
+        ref = _run(SEMI_DISCRETE, "float32", "xla")
+        fused = _run(SEMI_DISCRETE, "float32", "pallas_interpret", block_b=4)
+        np.testing.assert_array_equal(
+            np.asarray(ref.action)[:, : A - 1], np.asarray(fused.action)[:, : A - 1]
+        )
+        np.testing.assert_allclose(
+            np.asarray(ref.action), np.asarray(fused.action), rtol=1e-5, atol=1e-6
+        )
+        np.testing.assert_allclose(
+            np.asarray(ref.log_prob), np.asarray(fused.log_prob), rtol=2e-5, atol=2e-6
+        )
+    finally:
+        B, A = oldB, oldA

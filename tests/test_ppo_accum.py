@@ -1,0 +1,86 @@
+"""Gradient accumulation + remat exactness for the PPO update.
+
+``PPOConfig.grad_accum_steps`` must be a pure memory/compute trade: chunk
+losses are normalized by full-minibatch denominators, so the accumulated
+gradients — and therefore the resulting parameters and metrics — must match
+the unchunked update to float tolerance.  Same for ``MATConfig.remat``
+(rematerialization recomputes identical values in the backward pass).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mat_dcml_tpu.config import RunConfig
+from mat_dcml_tpu.envs.dcml import DCMLEnv, DCMLEnvConfig
+from mat_dcml_tpu.training.ppo import MATTrainer, PPOConfig
+from mat_dcml_tpu.training.rollout import RolloutCollector
+from mat_dcml_tpu.training.runner import build_mat_policy
+
+pytestmark = pytest.mark.slow  # heavy compiles (see pytest.ini fast tier)
+
+
+@pytest.fixture(scope="module")
+def rollout():
+    run = RunConfig(n_rollout_threads=4, episode_length=4, n_embd=16, n_head=2, n_block=1)
+    env = DCMLEnv(DCMLEnvConfig(), data_dir="data")
+    policy = build_mat_policy(run, env)
+    params = policy.init_params(jax.random.key(0))
+    collector = RolloutCollector(env, policy, run.episode_length)
+    rs = collector.init_state(jax.random.key(1), run.n_rollout_threads)
+    rs2, traj = jax.jit(collector.collect)(params, rs)
+    return run, env, policy, params, rs2, traj
+
+
+def _train(rollout, **ppo_kwargs):
+    run, env, policy, params, rs2, traj = rollout
+    ppo = PPOConfig(ppo_epoch=2, num_mini_batch=2, **ppo_kwargs)
+    trainer = MATTrainer(policy, ppo)
+    state = trainer.init_state(params)
+    return jax.jit(trainer.train)(state, traj, rs2, jax.random.key(3))
+
+
+@pytest.mark.parametrize("accum", [2, 4])
+def test_grad_accum_matches_unchunked(rollout, accum):
+    ref_state, ref_metrics = _train(rollout)
+    acc_state, acc_metrics = _train(rollout, grad_accum_steps=accum)
+    for a, b in zip(jax.tree.leaves(ref_state.params), jax.tree.leaves(acc_state.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-4, atol=1e-6)
+    np.testing.assert_allclose(
+        float(ref_metrics.value_loss), float(acc_metrics.value_loss), rtol=1e-4
+    )
+    np.testing.assert_allclose(
+        float(ref_metrics.grad_norm), float(acc_metrics.grad_norm), rtol=1e-4
+    )
+
+
+def test_grad_accum_must_divide_minibatch(rollout):
+    run, env, policy, params, rs2, traj = rollout
+    ppo = PPOConfig(ppo_epoch=1, num_mini_batch=2, grad_accum_steps=3)
+    trainer = MATTrainer(policy, ppo)
+    state = trainer.init_state(params)
+    with pytest.raises(AssertionError, match="grad_accum_steps"):
+        trainer.train(state, traj, rs2, jax.random.key(3))
+
+
+def test_remat_matches_nonremat():
+    run = RunConfig(n_rollout_threads=2, episode_length=4, n_embd=16, n_head=2, n_block=1)
+    env = DCMLEnv(DCMLEnvConfig(), data_dir="data")
+
+    def one_update(remat):
+        r = RunConfig(**{**run.__dict__, "remat": remat})
+        policy = build_mat_policy(r, env)
+        params = policy.init_params(jax.random.key(0))
+        collector = RolloutCollector(env, policy, r.episode_length)
+        rs = collector.init_state(jax.random.key(1), r.n_rollout_threads)
+        rs2, traj = jax.jit(collector.collect)(params, rs)
+        trainer = MATTrainer(policy, PPOConfig(ppo_epoch=1, num_mini_batch=2))
+        state = trainer.init_state(params)
+        state2, _ = jax.jit(trainer.train)(state, traj, rs2, jax.random.key(3))
+        return state2
+
+    ref = one_update(False)
+    rem = one_update(True)
+    for a, b in zip(jax.tree.leaves(ref.params), jax.tree.leaves(rem.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-4, atol=1e-6)
