@@ -23,19 +23,24 @@ class MujocoMultiHostEnv:
     self_resetting = False
 
     def __init__(self, scenario: str = "HalfCheetah-v4", agent_conf: str = "2x3",
-                 agent_obsk: int = 1, episode_limit: int = 1000, seed: int = 0):
-        try:
-            import gymnasium as gym
-        except ImportError:
+                 agent_obsk: int = 1, episode_limit: int = 1000, seed: int = 0,
+                 backend_env=None):
+        """``backend_env``: inject a pre-built gym(nasium)-shaped env object
+        (fake-backend tests, tests/test_mamujoco_host.py); default gym.make."""
+        if backend_env is None:
             try:
-                import gym  # type: ignore
-            except ImportError as err:
-                raise ImportError(
-                    "MujocoMultiHostEnv needs gymnasium (or gym) with MuJoCo "
-                    "installed; neither is bundled. Use MJLiteEnv for "
-                    "binary-free multi-agent continuous control."
-                ) from err
-        self._gym_env = gym.make(scenario)
+                import gymnasium as gym
+            except ImportError:
+                try:
+                    import gym  # type: ignore
+                except ImportError as err:
+                    raise ImportError(
+                        "MujocoMultiHostEnv needs gymnasium (or gym) with MuJoCo "
+                        "installed; neither is bundled. Use MJLiteEnv for "
+                        "binary-free multi-agent continuous control."
+                    ) from err
+            backend_env = gym.make(scenario)
+        self._gym_env = backend_env
         self._seed = seed
         self.episode_limit = episode_limit
         parts, graph = get_parts_and_edges(scenario, agent_conf)

@@ -80,11 +80,12 @@ class MJLiteEnv:
         # per-agent obs gather indices over the JOINT axis, -1 padded; the
         # lite state has one θ/ω per joint so qpos ids ARE joint ids here
         idx_rows = []
+        qpos_to_jid = {jt.qpos_id: j for j, jt in enumerate(graph.joints)}
         for p in parts:
             qpos_ids, _ = build_obs_indices(graph, p, cfg.agent_obsk)
-            # map qpos ids back to joint ids (identity for the lite chain)
-            jids = [next(j for j, jt in enumerate(graph.joints) if jt.qpos_id == q)
-                    for q in qpos_ids if q >= graph.joints[0].qpos_id]
+            # map qpos ids back to joint ids, dropping root/global entries
+            # (the lite state has one θ/ω per actuated joint only)
+            jids = [qpos_to_jid[q] for q in qpos_ids if q in qpos_to_jid]
             idx_rows.append(jids)
         width = max(len(r) for r in idx_rows)
         self._obs_jids = jnp.asarray(

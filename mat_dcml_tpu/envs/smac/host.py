@@ -23,16 +23,22 @@ class SMACHostEnv:
 
     self_resetting = False                 # bridge auto-resets on done
 
-    def __init__(self, map_name: str = "3m", seed: int = 0, **smac_kwargs):
-        try:
-            from smac.env import StarCraft2Env  # type: ignore
-        except ImportError as err:
-            raise ImportError(
-                "SMACHostEnv needs the external 'smac' package and a StarCraft "
-                "II install (https://github.com/oxwhirl/smac). Neither is "
-                "bundled; use SMACLiteEnv (pure JAX) for binary-free training."
-            ) from err
-        self._env = StarCraft2Env(map_name=map_name, seed=seed, **smac_kwargs)
+    def __init__(self, map_name: str = "3m", seed: int = 0, backend_env=None,
+                 **smac_kwargs):
+        """``backend_env``: inject a pre-built StarCraft2Env-shaped object
+        (fake-backend tests, tests/test_smac_host.py — the football pattern);
+        default imports the real oxwhirl/smac."""
+        if backend_env is None:
+            try:
+                from smac.env import StarCraft2Env  # type: ignore
+            except ImportError as err:
+                raise ImportError(
+                    "SMACHostEnv needs the external 'smac' package and a StarCraft "
+                    "II install (https://github.com/oxwhirl/smac). Neither is "
+                    "bundled; use SMACLiteEnv (pure JAX) for binary-free training."
+                ) from err
+            backend_env = StarCraft2Env(map_name=map_name, seed=seed, **smac_kwargs)
+        self._env = backend_env
         info = self._env.get_env_info()
         self.n_agents = info["n_agents"]
         self.obs_dim = info["obs_shape"]

@@ -1,0 +1,123 @@
+#!/usr/bin/env python
+"""Measure the whole-decode fused kernel vs the XLA decode scan on the chip.
+
+One serialized TPU session (tunnel discipline: one client at a time), probed
+via bench.py's killable-subprocess pattern: times ``get_actions`` (encode +
+full autoregressive decode) under the XLA impl and the Pallas whole-decode
+kernel at several batch tiles, at the production shape (E x 101 agents, bf16
+trunk), and reports the on-chip draw-match fraction between the two impls
+(f32 bit-exactness is pinned separately by tests/test_pallas_decode.py; the
+full train-loop effect is measured by bench.py's E-sweep once dispatch
+flips).
+
+Writes one JSON line per E to stdout; diagnostics to stderr.
+Usage: python scripts/tpu_decode_bench.py [E ...]
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+
+def log(msg):
+    print(f"[decode-bench] {msg}", file=sys.stderr, flush=True)
+
+
+def main():
+    Es = [int(a) for a in sys.argv[1:]] or [256]
+
+    from bench import _setup_jax
+
+    jax, fell_back = _setup_jax()
+    if fell_back:
+        log("TPU unavailable; refusing to measure decode on CPU")
+        raise SystemExit(2)
+    import jax.numpy as jnp
+    import numpy as np
+
+    from mat_dcml_tpu.config import RunConfig
+    from mat_dcml_tpu.envs.dcml import DCMLEnv, DCMLEnvConfig
+    from mat_dcml_tpu.models import decode as decode_lib
+    from mat_dcml_tpu.training.runner import build_mat_policy
+    import mat_dcml_tpu.ops.pallas_decode as pd
+
+    data_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", "data")
+    run = RunConfig(model_dtype=os.environ.get("BENCH_DTYPE", "bfloat16"))
+    env = DCMLEnv(DCMLEnvConfig(), data_dir=data_dir)
+    policy = build_mat_policy(run, env)
+    params = policy.init_params(jax.random.key(0))
+    A = policy.cfg.n_agent
+
+    def make_inputs(E, seed=1):
+        ks = jax.random.split(jax.random.key(seed), 3)
+        obs = jax.random.normal(ks[0], (E, A, env.obs_dim))
+        share = jax.random.normal(ks[1], (E, A, env.share_obs_dim))
+        ava = jnp.ones((E, A, env.action_dim))
+        return share, obs, ava
+
+    def timed(fn, *args, iters=20):
+        out = fn(*args)
+        jax.block_until_ready(out)
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = fn(*args)
+        jax.block_until_ready(out)
+        return (time.perf_counter() - t0) / iters, out
+
+    for E in Es:
+        share, obs, ava = make_inputs(E)
+
+        def actions_with(impl, block_b=None):
+            os.environ["MAT_DCML_TPU_DECODE_IMPL"] = impl
+            orig = pd.fused_ar_decode
+            if block_b is not None:
+                pd.fused_ar_decode = functools.partial(orig, block_b=block_b)
+            try:
+                fn = jax.jit(
+                    lambda p, k, s, o, a: policy.get_actions(p, k, s, o, a)
+                )
+                dt, out = timed(fn, params, jax.random.key(7), share, obs, ava)
+            finally:
+                pd.fused_ar_decode = orig
+                os.environ["MAT_DCML_TPU_DECODE_IMPL"] = "auto"
+            return dt, out
+
+        t_xla, out_xla = actions_with("xla")
+        log(f"E={E}: xla get_actions {t_xla*1e3:.1f} ms ({t_xla/A*1e6:.0f} us/position)")
+        row = {"E": E, "xla_ms": round(t_xla * 1e3, 2)}
+
+        for bb in (32, 64, 128):
+            try:
+                t_p, out_p = actions_with("pallas", block_b=bb)
+            except Exception as e:
+                log(f"E={E} pallas block_b={bb} FAILED: {type(e).__name__}: {e}")
+                row[f"pallas_bb{bb}_ms"] = None
+                continue
+            # on-chip parity: under a bf16 trunk the two paths round logits
+            # differently in low bits, so near-tie draws may differ on a tiny
+            # fraction of (env, agent) pairs — report the match fraction
+            # (f32 interpret-mode equality is pinned by test_pallas_decode.py)
+            a_x, a_p = np.asarray(out_xla.action), np.asarray(out_p.action)
+            nd = A - 1
+            match = float((a_x[:, :nd] == a_p[:, :nd]).mean())
+            tail_err = float(np.max(np.abs(a_x[:, nd:] - a_p[:, nd:])))
+            log(
+                f"E={E}: pallas bb={bb} {t_p*1e3:.1f} ms ({t_p/A*1e6:.0f} us/pos) "
+                f"draw_match={match:.4f} tail_maxerr={tail_err:.2e} "
+                f"speedup={t_xla/t_p:.1f}x"
+            )
+            row[f"pallas_bb{bb}_ms"] = round(t_p * 1e3, 2)
+            row[f"pallas_bb{bb}_draw_match"] = round(match, 4)
+        print(json.dumps(row), flush=True)
+
+    log("done")
+
+
+if __name__ == "__main__":
+    main()

@@ -64,6 +64,72 @@ class TestObsk:
             assert g in qvel
 
 
+class TestScalableConfigs:
+    """manyagent_swimmer / manyagent_ant / coupled_half_cheetah
+    (reference obsk.py:512-663; agent-count-scaling configs)."""
+
+    @pytest.mark.parametrize("conf,n_agents,per", [("10x2", 10, 2), ("20x1", 20, 1)])
+    def test_manyagent_swimmer_partitions(self, conf, n_agents, per):
+        parts, graph = get_parts_and_edges("manyagent_swimmer", conf)
+        assert len(parts) == n_agents and all(len(p) == per for p in parts)
+        n = n_agents * per
+        assert len(graph.joints) == n
+        # one actuator per rotor, chained; qpos = [x, y, rot_0..rot_{n-1}]
+        assert [j.act_id for j in graph.joints] == list(range(n))
+        assert [j.qpos_id for j in graph.joints] == list(range(2, 2 + n))
+        assert graph.edges == tuple((i, i + 1) for i in range(n - 1))
+        assert graph.global_qpos == ()      # reference registry: empty globals
+
+    def test_manyagent_ant_partitions(self):
+        parts, graph = get_parts_and_edges("manyagent_ant", "3x2")
+        assert len(parts) == 3
+        assert all(len(p) == 8 for p in parts)          # 2 segments x 4 joints
+        assert len(graph.joints) == 24
+        # free root: 7 qpos / 6 qvel dofs precede the rotors
+        assert min(j.qpos_id for j in graph.joints) == 7
+        assert min(j.qvel_id for j in graph.joints) == 6
+        # actuators tile 0..23 (reference per-segment order hip2,ankle2,hip1,ankle1)
+        assert sorted(j.act_id for j in graph.joints) == list(range(24))
+        seg0 = {j.name: j.act_id for j in graph.joints[:4]}
+        assert seg0 == {"hip1_0": 2, "ankle1_0": 3, "hip2_0": 0, "ankle2_0": 1}
+
+    def test_manyagent_khop_crosses_segments(self):
+        parts, graph = get_parts_and_edges("manyagent_swimmer", "4x2")
+        # agent 1 owns rotors (2, 3); 1 hop reaches the neighbour segments
+        shells = joints_at_kdist(graph, parts[1], k=1)
+        assert set(shells[1]) == {1, 4}
+
+    def test_coupled_half_cheetah(self):
+        parts, graph = get_parts_and_edges("coupled_half_cheetah", "1p1")
+        assert parts == ((0, 1, 2, 3, 4, 5), (6, 7, 8, 9, 10, 11))
+        # corrected actuator ids: second cheetah drives 6..11 (the reference
+        # registry reuses 0..5 for both, see module docstring)
+        assert [j.act_id for j in graph.joints] == list(range(12))
+        # tendon edge couples the two bthighs: 1 hop from bthigh sees bthigh2
+        shells = joints_at_kdist(graph, (0,), k=1)
+        assert 6 in shells[1]
+        with pytest.raises(ValueError):
+            get_parts_and_edges("coupled_half_cheetah", "2x6")
+
+    @pytest.mark.parametrize("scenario,conf", [
+        ("manyagent_swimmer", "10x2"),
+        ("manyagent_ant", "2x2"),
+        ("coupled_half_cheetah", "1p1"),
+    ])
+    def test_lite_env_runs(self, scenario, conf):
+        env = MJLiteEnv(MJLiteConfig(scenario=scenario, agent_conf=conf,
+                                     episode_length=5))
+        st, ts = env.reset(jax.random.key(0))
+        assert ts.obs.shape == (env.n_agents, env.obs_dim)
+        assert ts.share_obs.shape == (env.n_agents, env.share_obs_dim)
+        step = jax.jit(env.step)
+        for _ in range(5):
+            act = jnp.ones((env.n_agents, env.action_dim)) * 0.1
+            st, ts = step(st, act)
+        assert bool(ts.done.all())
+        assert np.isfinite(float(ts.reward.sum()))
+
+
 class TestMJLite:
     def test_shapes_and_protocol(self):
         env = MJLiteEnv(MJLiteConfig(scenario="HalfCheetah-v2", agent_conf="2x3"))

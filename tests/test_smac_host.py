@@ -1,0 +1,114 @@
+"""Contract tests for the gated real-SMAC adapter (fake backend, no SC2).
+
+The fake mimics the oxwhirl/smac ``StarCraft2Env`` API surface the adapter
+consumes, with the RECORDED env-info shapes of the reference's vendored fork
+for 3m and 8m (``mat_src/mat/envs/starcraft2/StarCraft2_Env.py``: obs
+construction ``:1015-1110``, state ``:1189-1335``, avail rules
+``:1846-1884``): 3m -> obs 30 / state 48 / 9 actions, 8m -> obs 80 /
+state 168 / 14 actions.  If the adapter's stacking/broadcast layout drifts
+from what the runner expects, these fail without a cluster.
+"""
+
+import numpy as np
+import pytest
+
+from mat_dcml_tpu.envs.smac.host import SMACHostEnv
+
+# (n_agents, obs_shape, state_shape, n_actions) as the reference's fork
+# reports them via get_env_info() for the two headline maps
+RECORDED = {
+    "3m": (3, 30, 48, 9),
+    "8m": (8, 80, 168, 14),
+}
+
+
+class FakeStarCraft2Env:
+    """StarCraft2Env-shaped: list-of-arrays obs, flat state, per-agent avail."""
+
+    def __init__(self, map_name="3m", horizon=8):
+        self.n_agents, self.obs_dim, self.state_dim, self.n_actions = RECORDED[map_name]
+        self.horizon = horizon
+        self.t = 0
+        self.rng = np.random.default_rng(3)
+        self.last_actions = None
+
+    def get_env_info(self):
+        return {
+            "n_agents": self.n_agents,
+            "obs_shape": self.obs_dim,
+            "state_shape": self.state_dim,
+            "n_actions": self.n_actions,
+            "episode_limit": self.horizon,
+        }
+
+    def reset(self):
+        self.t = 0
+
+    def get_obs(self):
+        return [self.rng.normal(size=self.obs_dim) for _ in range(self.n_agents)]
+
+    def get_state(self):
+        return self.rng.normal(size=self.state_dim)
+
+    def get_avail_agent_actions(self, i):
+        # no-op unavailable while alive, stop always available (avail rules
+        # StarCraft2_Env.py:1846-1884); attacks toggle with time
+        avail = [0, 1] + [1] * 4 + [self.t % 2] * (self.n_actions - 6)
+        return avail
+
+    def step(self, actions):
+        self.last_actions = np.asarray(actions)
+        assert self.last_actions.shape == (self.n_agents,)
+        assert self.last_actions.dtype.kind == "i"
+        self.t += 1
+        terminated = self.t >= self.horizon
+        info = {"battle_won": terminated}
+        return 1.5, terminated, info
+
+    def close(self):
+        pass
+
+
+@pytest.mark.parametrize("map_name", ["3m", "8m"])
+def test_env_info_and_bundle_shapes(map_name):
+    n, od, sd, na = RECORDED[map_name]
+    env = SMACHostEnv(backend_env=FakeStarCraft2Env(map_name))
+    assert (env.n_agents, env.obs_dim, env.share_obs_dim, env.action_dim) == (n, od, sd, na)
+
+    obs, share, avail = env.reset()
+    assert obs.shape == (n, od) and obs.dtype == np.float32
+    assert share.shape == (n, sd) and share.dtype == np.float32
+    # share obs = the global state broadcast to every agent
+    assert np.array_equal(share[0], share[-1])
+    assert avail.shape == (n, na) and avail.dtype == np.float32
+    assert avail[0, 0] == 0 and avail[0, 1] == 1   # no-op off, stop on
+
+
+def test_step_contract_and_action_forwarding():
+    fake = FakeStarCraft2Env("3m")
+    env = SMACHostEnv(backend_env=fake)
+    env.reset()
+    obs, share, rew, done, info, avail = env.step(np.array([[2.0], [1.0], [8.0]]))
+    # actions arrive flattened to int64 per-agent ids
+    assert np.array_equal(fake.last_actions, np.array([2, 1, 8]))
+    assert rew.shape == (3, 1) and np.all(rew == 1.5)
+    assert done.shape == (3,) and not done.any()
+    assert info["delay"] == 0.0 and info["payment"] == 0.0
+    assert obs.shape == (3, 30) and share.shape == (3, 48) and avail.shape == (3, 9)
+
+
+def test_done_and_win_channel():
+    fake = FakeStarCraft2Env("3m", horizon=2)
+    env = SMACHostEnv(backend_env=fake)
+    env.reset()
+    env.step(np.zeros((3, 1)))
+    _, _, _, done, info, _ = env.step(np.zeros((3, 1)))
+    assert done.all()
+    assert info["delay"] == 1.0          # battle_won rides the delay channel
+    # bridge protocol: the adapter does NOT self-reset; vec_env workers do
+    assert SMACHostEnv.self_resetting is False
+
+
+def test_import_gate_without_backend():
+    with pytest.raises(ImportError, match="smac"):
+        SMACHostEnv()
