@@ -26,6 +26,14 @@ Knobs (environment variables):
   BENCH_INNER           scan N train iterations inside ONE jit (default 1);
                         amortizes every dispatch/transfer — the upper bound a
                         runner with on-device metric accumulation reaches
+  BENCH_REMAT           "1" → rematerialize transformer blocks in the PPO
+                        backward (MATConfig.remat; default 0)
+  BENCH_ACCUM           gradient-accumulation chunks per PPO minibatch
+                        (PPOConfig.grad_accum_steps; default 1)
+
+On device OOM the bench walks a backoff ladder before shrinking the batch:
+remat on -> accumulation x2 (up to 8) -> halve E — big batches get memory
+relief before losing statistical size.
 """
 
 from __future__ import annotations
@@ -117,7 +125,7 @@ def _setup_jax():
     return jax, fell_back or probe_forced_cpu
 
 
-def _build(jax, E: int, T: int):
+def _build(jax, E: int, T: int, remat: bool = False, accum: int = 1):
     from mat_dcml_tpu.config import RunConfig
     from mat_dcml_tpu.envs.dcml import DCMLEnv, DCMLEnvConfig
     from mat_dcml_tpu.training.ppo import MATTrainer, PPOConfig
@@ -132,8 +140,11 @@ def _build(jax, E: int, T: int):
         "bfloat16" if jax.default_backend() == "tpu" else "float32",
     )
     log(f"model_dtype={dtype}")
-    run = RunConfig(n_rollout_threads=E, episode_length=T, model_dtype=dtype)
-    ppo = PPOConfig()
+    if remat or accum > 1:
+        log(f"remat={remat} grad_accum_steps={accum}")
+    run = RunConfig(n_rollout_threads=E, episode_length=T, model_dtype=dtype,
+                    remat=remat)
+    ppo = PPOConfig(grad_accum_steps=accum)
 
     env = DCMLEnv(DCMLEnvConfig(), data_dir=data_dir)
     policy = build_mat_policy(run, env)
@@ -179,10 +190,12 @@ def _build(jax, E: int, T: int):
 
 
 def _measure(jax, E: int, T: int, iters: int, profile_dir: str | None = None,
-             breakdown: bool = False, combined: bool = True) -> dict:
+             breakdown: bool = False, combined: bool = True,
+             remat: bool = False, accum: int = 1) -> dict:
     """Compile + time `iters` full collect+train iterations at batch E."""
     t0 = time.perf_counter()
-    collect, train, step, inner, train_state, rollout_state = _build(jax, E, T)
+    collect, train, step, inner, train_state, rollout_state = _build(
+        jax, E, T, remat=remat, accum=accum)
     log(f"E={E}: built in {time.perf_counter() - t0:.1f}s, compiling...")
 
     # TWO warmup iterations: the first compiles; the second catches the
@@ -225,6 +238,8 @@ def _measure(jax, E: int, T: int, iters: int, profile_dir: str | None = None,
         "steps_per_sec": steps / elapsed,
         "iter_sec": elapsed / iters,
         "iter_secs": [round(s, 3) for s in iter_secs],
+        "remat": remat,
+        "accum": accum,
     }
     log(f"E={E}: {result['steps_per_sec']:.0f} env-steps/s ({elapsed / iters:.2f}s/iter; "
         f"per-iter {result['iter_secs']})")
@@ -241,7 +256,58 @@ def _measure(jax, E: int, T: int, iters: int, profile_dir: str | None = None,
             dt = (time.perf_counter() - t0) / iters
             result[f"{name}_sec"] = dt
             log(f"E={E}: {name} {dt:.3f}s/iter")
+        _breakdown_mfu(jax, result, E, T)
     return result
+
+
+# bf16 peak TFLOP/s per chip by device_kind substring (public spec sheets);
+# used to turn measured FLOP rates into %-of-peak in the breakdown
+_PEAK_TFLOPS = {"v5 lite": 197.0, "v5e": 197.0, "v4": 275.0, "v5p": 459.0, "v6": 918.0}
+
+
+def _model_flops_per_env_step(E: int, T: int, ppo_epoch: int):
+    """Analytic matmul FLOPs (2*m*n*k) for one train iteration, split into
+    collect vs update.  Tokens = (env, agent) pairs; cached decode attends
+    over the full padded agent axis, the teacher-forced update re-runs the
+    full forward + backward (~3x forward).  Small terms (env sim, GAE,
+    distributions, value-norm) are omitted — this under-counts by a few
+    percent, so %-of-peak is slightly conservative."""
+    # DCML production shape (envs/dcml: 101 agents, obs 7, 2 actions) with
+    # the model _build constructs (RunConfig defaults: n_embd 64, 2 blocks)
+    A, D = 101, 64
+    obs_dim, adim, n_block = 7, 2, 2
+    enc_tok = 2 * obs_dim * D + n_block * (12 * D * D + 4 * A * D) + 2 * D * D + 2 * D
+    dec_tok = (
+        2 * (adim + 1) * D
+        + n_block * (20 * D * D + 8 * A * D)
+        + 2 * D * D + 2 * D * adim
+    )
+    per_env_step = A * (enc_tok + dec_tok)
+    collect = E * T * per_env_step
+    update = ppo_epoch * E * T * A * (enc_tok + dec_tok) * 3
+    return collect, update
+
+
+def _breakdown_mfu(jax, result: dict, E: int, T: int) -> None:
+    """Annotate a breakdown result with per-phase TFLOP/s and %-of-peak."""
+    from mat_dcml_tpu.training.ppo import PPOConfig
+
+    collect_fl, update_fl = _model_flops_per_env_step(E, T, PPOConfig().ppo_epoch)
+    kind = jax.devices()[0].device_kind.lower()
+    peak = next((v for k, v in _PEAK_TFLOPS.items() if k in kind), None)
+    for phase, fl in (("collect", collect_fl), ("train", update_fl)):
+        sec = result.get(f"{phase}_sec")
+        if not sec:
+            continue
+        tflops = fl / sec / 1e12
+        result[f"{phase}_tflops"] = round(tflops, 3)
+        if peak:
+            result[f"{phase}_pct_peak"] = round(100.0 * tflops / peak, 2)
+        log(
+            f"E={E}: {phase} {tflops:.3f} TFLOP/s"
+            + (f" ({100.0 * tflops / peak:.2f}% of {peak:.0f} bf16 peak, {kind})"
+               if peak else f" (unknown peak for {kind!r})")
+        )
 
 
 def _is_oom(e: Exception) -> bool:
@@ -276,6 +342,25 @@ def _measure_safe(jax, E: int, T: int, iters: int, **kw) -> dict | None:
         return None
 
 
+def _oom_backoff(remat: bool, accum: int, E: int, T: int,
+                 num_mini_batch: int = 4):
+    """Advance the OOM ladder one rung: remat first, then the next
+    power-of-two accumulation (up to 8) that divides the minibatch size
+    (ppo.py asserts divisibility at trace time).  Returns the new
+    (remat, accum) or None when exhausted."""
+    if not remat:
+        log("OOM backoff: enabling remat")
+        return True, accum
+    mb_size = (E * T) // num_mini_batch
+    a = accum * 2
+    while a <= 8 and mb_size % a:
+        a *= 2
+    if a <= 8:
+        log(f"OOM backoff: grad accumulation x{a}")
+        return True, a
+    return None
+
+
 def main() -> None:
     # Default batch: measured best on the driver's chip (TPU v5-lite, 16G
     # HBM): E=256 gives 2561 env-steps/s vs 2472 at E=512 (E-sweep
@@ -291,6 +376,9 @@ def main() -> None:
     breakdown = os.environ.get("BENCH_BREAKDOWN", "0") == "1"
     combined = os.environ.get("BENCH_COMBINED", "1") == "1"
 
+    remat = os.environ.get("BENCH_REMAT", "0") == "1"
+    accum = max(1, int(os.environ.get("BENCH_ACCUM", "1")))
+
     jax, fell_back = _setup_jax()
     if fell_back:
         # a CPU fallback run exists to prove liveness, not throughput — the
@@ -303,13 +391,17 @@ def main() -> None:
             "BENCH_SWEEP_ENVS", "128,512,2048,8192").split(",")]
         if fell_back:
             env_list = [e for e in env_list if e <= 128] or [32]
-        results = [
-            # profile the largest (last) sweep entry if a trace was requested
-            _measure_safe(jax, e, T, ITERS, breakdown=breakdown, combined=combined,
-                          profile_dir=profile_dir if e == env_list[-1] else None)
-            for e in env_list
-        ]
-        results = [r for r in results if r is not None]
+        results = []
+        for e in env_list:
+            kw = dict(breakdown=breakdown, combined=combined,
+                      # profile the largest (last) entry if a trace was requested
+                      profile_dir=profile_dir if e == env_list[-1] else None)
+            r = _measure_safe(jax, e, T, ITERS, remat=remat, accum=accum, **kw)
+            rung = (remat, accum)
+            while r is None and (rung := _oom_backoff(*rung, e, T)) is not None:
+                r = _measure_safe(jax, e, T, ITERS, remat=rung[0], accum=rung[1], **kw)
+            if r is not None:
+                results.append(r)
         if not results:
             raise SystemExit("every sweep batch size OOMed")
         best = max(results, key=lambda r: r["steps_per_sec"])
@@ -317,13 +409,22 @@ def main() -> None:
         steps_per_sec = best["steps_per_sec"]
     else:
         res = None
+        rung = (remat, accum)
         while res is None:
             res = _measure_safe(jax, E, T, ITERS, profile_dir=profile_dir,
-                                breakdown=breakdown, combined=combined)
+                                breakdown=breakdown, combined=combined,
+                                remat=rung[0], accum=rung[1])
             if res is None:
+                nxt = _oom_backoff(*rung, E, T)
+                if nxt is not None:
+                    rung = nxt
+                    continue
                 if E <= 32:
                     raise SystemExit("OOM even at E=32")
                 E //= 2
+                # fresh ladder at the smaller batch (it may fit un-relieved);
+                # restart from the user's requested knobs, not hard defaults
+                rung = (remat, accum)
                 log(f"retrying at E={E}")
         steps_per_sec = res["steps_per_sec"]
 

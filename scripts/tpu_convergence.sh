@@ -1,0 +1,23 @@
+#!/bin/sh
+# Full-budget convergence evidence on the chip (VERDICT r3 item 3):
+# the reference DCML recipe (DCML_MAT_Train.py:193 — 8 rollout threads,
+# 1M env steps, T=50, lr 5e-5, ppo_epoch 15, 4 minibatches) for
+#   1) momat  — both objective channels vs the shipped TensorBoard exports
+#   2) mat    — scalar episode reward vs the TD3 anchor (data/dcml_td3.txt)
+# run SEQUENTIALLY (tunnel discipline: one TPU client at a time), then the
+# convergence report for each.
+#
+# Usage: scripts/tpu_convergence.sh [num_env_steps] [seed]
+set -e
+steps="${1:-1000000}"
+seed="${2:-1}"
+cd "$(dirname "$0")/.."
+
+for algo in momat mat; do
+  echo "=== $algo: $steps env steps (reference recipe) ==="
+  python train_dcml.py --algorithm_name "$algo" --experiment_name "conv_r3" \
+    --seed "$seed" --n_rollout_threads 8 --num_env_steps "$steps" \
+    --episode_length 50 --lr 5e-5 --ppo_epoch 15 --num_mini_batch 4 \
+    --log_interval 25
+  python convergence_report.py "results/DCML/AS/$algo/conv_r3/metrics.jsonl" || true
+done
