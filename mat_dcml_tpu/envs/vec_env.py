@@ -103,7 +103,10 @@ def _worker_loop(remote, parent_remote, wrapped_fns: CloudpickleWrapper):
                     ])
             elif cmd == "spaces":
                 e = envs[0]
-                remote.send((e.n_agents, e.obs_dim, e.share_obs_dim, e.action_dim))
+                # action_space rides along so continuous host envs (hands,
+                # real MuJoCo) build continuous policies through the bridge
+                remote.send((e.n_agents, e.obs_dim, e.share_obs_dim,
+                             e.action_dim, getattr(e, "action_space", None)))
             elif cmd == "close":
                 for env in envs:
                     if hasattr(env, "close"):
@@ -140,6 +143,7 @@ class ShareVecEnv:
     obs_dim: int
     share_obs_dim: int
     action_dim: int
+    action_space = None    # Box/MultiDiscrete when the host env declares one
 
     def reset(self, reset_args=None):
         raise NotImplementedError
@@ -161,6 +165,7 @@ class ShareDummyVecEnv(ShareVecEnv):
         e = self.envs[0]
         self.n_agents, self.obs_dim = e.n_agents, e.obs_dim
         self.share_obs_dim, self.action_dim = e.share_obs_dim, e.action_dim
+        self.action_space = getattr(e, "action_space", None)
 
     def reset(self, reset_args=None):
         if reset_args is None:
@@ -207,7 +212,8 @@ class ShareSubprocVecEnv(ShareVecEnv):
             self.processes.append(p)
         try:
             self.remotes[0].send(("spaces", None))
-            self.n_agents, self.obs_dim, self.share_obs_dim, self.action_dim = self.remotes[0].recv()
+            (self.n_agents, self.obs_dim, self.share_obs_dim,
+             self.action_dim, self.action_space) = self.remotes[0].recv()
         except (EOFError, ConnectionResetError, BrokenPipeError, OSError) as e:
             self.close()
             raise RuntimeError(

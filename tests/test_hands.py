@@ -1,0 +1,82 @@
+"""HandsRunner over the host bridge with a fake dexterous-hands env.
+
+The reference's hands env package is absent from its own tree (SURVEY.md
+§2.4), so there is no oracle to pin — but the runner path (host shared-obs
+contract -> vec bridge -> MAT collect/train, ``hands_runner.py:178`` layout
+semantics) is testable with an Isaac-Gym-shaped fake, the football pattern.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from mat_dcml_tpu.training.hands_runner import HandsRunner
+
+
+class FakeHandsEnv:
+    """Host shared-obs contract: continuous actions, shared reward."""
+
+    self_resetting = False
+
+    def __init__(self, n_agents=2, obs_dim=12, act_dim=4, horizon=10):
+        self.n_agents, self.obs_dim, self.action_dim = n_agents, obs_dim, act_dim
+        self.share_obs_dim = obs_dim * n_agents
+        self.episode_limit = horizon
+        self.rng = np.random.default_rng(5)
+        self.t = 0
+        from mat_dcml_tpu.envs.spaces import Box
+
+        self.action_space = Box(act_dim)
+
+    def _bundle(self):
+        obs = self.rng.normal(size=(self.n_agents, self.obs_dim)).astype(np.float32)
+        share = np.tile(obs.reshape(-1), (self.n_agents, 1)).astype(np.float32)
+        avail = np.ones((self.n_agents, 1), np.float32)
+        return obs, share, avail
+
+    def reset(self):
+        self.t = 0
+        return self._bundle()
+
+    def step(self, actions):
+        acts = np.asarray(actions).reshape(self.n_agents, -1)
+        assert acts.shape[-1] == self.action_dim     # (E, A, d) bridge layout
+        self.t += 1
+        done = self.t >= self.episode_limit
+        obs, share, avail = self._bundle()
+        rew = np.full((self.n_agents, 1), -float(np.square(acts).mean()), np.float32)
+        return obs, share, rew, np.full((self.n_agents,), done), {}, avail
+
+    def close(self):
+        pass
+
+
+@pytest.mark.slow
+def test_hands_runner_trains_over_bridge(tmp_path):
+    from mat_dcml_tpu.config import RunConfig
+    from mat_dcml_tpu.envs.vec_env import ShareDummyVecEnv
+    from mat_dcml_tpu.training.ppo import PPOConfig
+
+    E, T = 2, 10
+    vec = ShareDummyVecEnv([lambda: FakeHandsEnv(horizon=T) for _ in range(E)])
+    run = RunConfig(
+        algorithm_name="mat", env_name="hands", scenario="fake",
+        n_rollout_threads=E, episode_length=T, n_embd=32, n_block=1,
+        run_dir=str(tmp_path), log_interval=1, save_interval=1000,
+    )
+    runner = HandsRunner(run, PPOConfig(ppo_epoch=2, num_mini_batch=1), vec,
+                         log_fn=lambda *a: None)
+    state, _ = runner.train_loop(num_episodes=2)
+    assert int(state.update_step) == 2
+    rec = json.loads(runner.metrics_path.read_text().splitlines()[-1])
+    # hands drops the score channels football keeps (hands_runner.py override)
+    assert "aver_episode_delays" not in rec
+    assert np.isfinite(rec["value_loss"])
+
+
+def test_train_hands_entry_is_gated():
+    import train_hands
+
+    with pytest.raises(SystemExit, match="Isaac Gym"):
+        train_hands.main([])
