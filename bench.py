@@ -428,6 +428,7 @@ def main() -> None:
                 log(f"retrying at E={E}")
         steps_per_sec = res["steps_per_sec"]
 
+    dev = jax.devices()[0]
     print(
         json.dumps(
             {
@@ -435,6 +436,10 @@ def main() -> None:
                 "value": round(steps_per_sec, 2),
                 "unit": "env_steps/s",
                 "vs_baseline": round(steps_per_sec / BASELINE_STEPS_PER_SEC, 2),
+                # self-documenting evidence: a CPU fallback number must never
+                # be mistaken for a chip measurement (VERDICT r2 weak #3)
+                "platform": dev.platform,
+                "device": dev.device_kind,
             }
         )
     )

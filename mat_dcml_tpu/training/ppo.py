@@ -76,12 +76,23 @@ class PPOConfig:
     # tests/test_ppo_accum.py).  The big-batch enabler alongside MATConfig.remat.
     grad_accum_steps: int = 1
     # MO-MAT scalarization weights, comma-separated floats ("99,1" etc.);
-    # empty = equal weights.  Per-objective advantages are normalized per
-    # channel, then combined ``adv = sum_i w_i * adv_norm_i`` (reconstruction
-    # of the missing ``momat_trainer`` around the surviving
-    # ``mo_shared_buffer.py`` per-objective GAE).  Ignored when the policy has
-    # a single objective or when DMO per-step coefficients are present.
+    # empty = equal weights.  Reconstruction of the missing ``momat_trainer``
+    # around the surviving ``mo_shared_buffer.py`` per-objective GAE.
+    # Ignored when the policy has a single objective.
     objective_weights: str = ""
+    # How MO advantages are combined (the reference's momat trainer is absent
+    # from its tree, so this is the reconstruction's central choice):
+    #   True  — scalarize RAW per-channel advantages first, then normalize the
+    #           combined advantage once.  The objective channels already carry
+    #           the env's alpha/beta scaling (envs/dcml/env.py objectives), so
+    #           with equal weights this reproduces the scalar-reward gradient
+    #           exactly (GAE is linear) — and the reference's published curves
+    #           (payment at -5.2 by 64k steps, momat_payment.csv) match scalar
+    #           dynamics, not unit-std per-channel pressure.
+    #   False — round-2 behavior: normalize each channel to unit std, then
+    #           weight-sum.  Removes the built-in 99:1 scale (payment curve
+    #           diverged: -26.9 at 64k vs the reference's -5.2).
+    mo_combined_norm: bool = True
 
 
 class TrainState(NamedTuple):
@@ -113,8 +124,11 @@ class MATTrainer:
                     f"objective_weights has {len(w)} entries for {self.n_objective} objectives"
                 )
             arr = jnp.asarray(w, jnp.float32)
-            # normalize to the simplex so "99,1" and "0.99,0.01" give the same
-            # gradient scale (per-channel advantages are already unit-std)
+            # normalize to the simplex so "99,1" and "0.99,0.01" are the same
+            # config: combined mode is scale-invariant via the single
+            # post-scalarization normalization, per-channel mode because each
+            # channel is unit-std before weighting — in both, only weight
+            # RATIOS matter
             self.objective_weights = arr / arr.sum()
         else:
             self.objective_weights = jnp.ones((self.n_objective,), jnp.float32) / self.n_objective
@@ -173,22 +187,27 @@ class MATTrainer:
             if cfg.use_valuenorm or cfg.use_popart:
                 values_all = value_norm_denormalize(value_norm, values_all)
             adv, returns = compute_gae(traj.rewards, values_all, traj.masks, cfg.gamma, cfg.gae_lambda)
+            if self.n_objective > 1:
+                # scalarization weights: per-step DMO coefficients (broadcast
+                # over agents) when collected, else the static weights
+                if traj.objective_coefficients is not None:
+                    w = traj.objective_coefficients[:, :, None, :]  # (T, E, 1, n_obj)
+                else:
+                    w = self.objective_weights
+                if cfg.mo_combined_norm:
+                    # scalarize RAW advantages before normalizing (see
+                    # PPOConfig.mo_combined_norm rationale)
+                    adv = (adv * w).sum(-1, keepdims=True)
             # advantage normalization over active entries (mat_trainer.py:193-197);
-            # per objective channel — identical to the reference's global
-            # statistics when n_objective == 1.
+            # identical to the reference's global statistics when the
+            # (remaining) channel count is 1.
             active = traj.active_masks[:-1]
             axes = tuple(range(adv.ndim - 1))
             denom = active.sum()
             mean = (adv * active).sum(axes) / denom
             var = (((adv - mean) ** 2) * active).sum(axes) / denom
             adv_norm = (adv - mean) / (jnp.sqrt(var) + 1e-5)
-            if self.n_objective > 1:
-                # scalarize: per-step DMO coefficients (broadcast over agents)
-                # when collected, else the static objective weights.
-                if traj.objective_coefficients is not None:
-                    w = traj.objective_coefficients[:, :, None, :]  # (T, E, 1, n_obj)
-                else:
-                    w = self.objective_weights
+            if self.n_objective > 1 and not cfg.mo_combined_norm:
                 adv_norm = (adv_norm * w).sum(-1, keepdims=True)
             return adv_norm.reshape(n_rows, *adv_norm.shape[2:]), returns.reshape(n_rows, *returns.shape[2:])
 

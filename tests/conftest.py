@@ -27,5 +27,5 @@ _cache_dir = os.environ.get(
     "MAT_DCML_TPU_TEST_CACHE", os.path.join(os.path.dirname(__file__), ".jax_cache")
 )
 jax.config.update("jax_compilation_cache_dir", _cache_dir)
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.1)
 jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)

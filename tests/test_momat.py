@@ -131,3 +131,34 @@ def test_dmomat_coefficients_resampled_on_done():
     state = trainer.init_state(params)
     state2, metrics = jax.jit(trainer.train)(state, traj, rs2, jax.random.key(5))
     assert np.isfinite(float(metrics.policy_loss))
+
+
+@pytest.mark.slow
+def test_mo_combined_vs_per_channel_norm(mo_setup):
+    """PPOConfig.mo_combined_norm selects the scalarize-then-normalize
+    reconstruction (default; the env channels already carry alpha/beta so
+    equal weights reproduce scalar-reward dynamics — see
+    test_env_objectives_decompose_reward + test_mo_gae_matches_per_channel)
+    vs the per-channel-unit-std variant; the two must actually train
+    differently on the same trajectory."""
+    run, env, policy, trainer, collector, params = mo_setup
+    rs = collector.init_state(jax.random.key(11), run.n_rollout_threads)
+    rs2, traj = jax.jit(collector.collect)(params, rs)
+
+    def one_update(combined):
+        t = MATTrainer(policy, PPOConfig(ppo_epoch=1, num_mini_batch=1,
+                                         mo_combined_norm=combined))
+        state = t.init_state(params)
+        state2, m = jax.jit(t.train)(state, traj, rs2, jax.random.key(12))
+        return state2, m
+
+    s_comb, m_comb = one_update(True)
+    s_perch, m_perch = one_update(False)
+    assert np.isfinite(float(m_comb.policy_loss))
+    assert np.isfinite(float(m_perch.policy_loss))
+    diff = any(
+        not np.allclose(np.asarray(a), np.asarray(b))
+        for a, b in zip(jax.tree.leaves(s_comb.params), jax.tree.leaves(s_perch.params))
+    )
+    assert diff, "normalization mode had no effect on the update"
+    assert PPOConfig().mo_combined_norm is True   # default = reference-curve mode

@@ -29,7 +29,10 @@ def _model_and_inputs(n_agent=8, batch=4):
     return model, params, state, obs, shifted
 
 
-@pytest.mark.parametrize("n_shards", [2, pytest.param(4, marks=pytest.mark.slow)])
+# slow tier: ~2 min compiles each on this 1-core box (fast-tier ring/seq
+# coverage stays via tests/test_ring_attention.py + the driver dryrun leg)
+@pytest.mark.slow
+@pytest.mark.parametrize("n_shards", [2, 4])
 def test_seq_sharded_matches_replicated(n_shards):
     model, params, state, obs, shifted = _model_and_inputs()
     mesh = Mesh(np.array(jax.devices()[:n_shards]), ("seq",))
@@ -59,6 +62,7 @@ def test_indivisible_agent_axis_pads_and_matches():
     )
 
 
+@pytest.mark.slow
 def test_policy_evaluate_actions_with_seq_mesh():
     """The --seq_shards training configuration: TransformerPolicy routes
     evaluate_actions (encoder + teacher-forced decoder) through the ring;
