@@ -156,10 +156,13 @@ def _cached_attention(q, k_cache, v_cache, i, n_head):
         qh = q[:, h * dh : (h + 1) * dh].astype(jnp.float32)          # (TB, dh)
         kh = k_cache[:, :, h * dh : (h + 1) * dh].astype(jnp.float32)  # (TB, L, dh)
         vh = v_cache[:, :, h * dh : (h + 1) * dh]
-        scores = jnp.einsum("bd,bld->bl", qh, kh) * scale              # (TB, L)
+        # broadcast-multiply-reduce instead of batched dot_general: the
+        # contractions are tiny (dh<=64) and this form always lowers on
+        # Mosaic (lane reduce for scores, sublane reduce for the output)
+        scores = jnp.sum(qh[:, None, :] * kh, axis=-1) * scale         # (TB, L)
         scores = jnp.where(valid, scores, NEG_INF)
         w = jax.nn.softmax(scores, axis=-1)
-        outs.append(jnp.einsum("bl,bld->bd", w, vh.astype(jnp.float32)))
+        outs.append(jnp.sum(w[:, :, None] * vh.astype(jnp.float32), axis=1))
     return jnp.concatenate(outs, axis=-1)                  # (TB, D) f32
 
 

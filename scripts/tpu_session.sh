@@ -1,0 +1,56 @@
+#!/bin/bash
+# The round-3 chip session, run unattended on the first healthy tunnel grant
+# (tunnel discipline: ONE client at a time; each python process below is a
+# fresh claim, fine while the chip is healthy).
+#
+#   1. decode micro-bench: XLA scan vs whole-decode Pallas kernel
+#   2. combined-step A/B at E=256: pick the faster decode impl
+#   3. full E-sweep with per-phase MFU breakdown (headline evidence)
+#   4. full-budget convergence: momat (both objectives) then scalar mat
+#
+# All output accumulates under artifacts/r3/.
+set -x
+cd "$(dirname "$0")/.."
+mkdir -p artifacts/r3
+export BENCH_TPU_PROBE_TIMEOUT=0     # the caller already probed; don't re-queue
+
+echo "=== 1. decode micro-bench ==="
+timeout 3000 python scripts/tpu_decode_bench.py 256 512 \
+  > artifacts/r3/decode_bench.json 2> artifacts/r3/decode_bench.log
+cat artifacts/r3/decode_bench.json
+
+echo "=== 2. combined-step A/B at E=256 ==="
+for impl in xla pallas; do
+  MAT_DCML_TPU_DECODE_IMPL=$impl BENCH_N_ENVS=256 BENCH_ITERS=3 \
+    timeout 3000 python bench.py \
+    > "artifacts/r3/bench_e256_$impl.json" 2> "artifacts/r3/bench_e256_$impl.log"
+  cat "artifacts/r3/bench_e256_$impl.json"
+done
+
+# pick the winner for the rest of the session
+winner=$(python - <<'EOF'
+import json
+def v(p):
+    try:
+        return json.load(open(p))["value"]
+    except Exception:
+        return -1.0
+x, p = v("artifacts/r3/bench_e256_xla.json"), v("artifacts/r3/bench_e256_pallas.json")
+print("pallas" if p > x else "xla")
+EOF
+)
+echo "winner impl: $winner" | tee artifacts/r3/winner.txt
+export MAT_DCML_TPU_DECODE_IMPL=$winner
+
+echo "=== 3. full E-sweep with breakdown ==="
+BENCH_SWEEP=1 BENCH_SWEEP_ENVS=256,512,1024,2048 BENCH_BREAKDOWN=1 \
+  BENCH_ITERS=3 timeout 5400 python bench.py \
+  > artifacts/r3/bench_sweep.json 2> artifacts/r3/bench_sweep.log
+cat artifacts/r3/bench_sweep.json
+
+echo "=== 4. convergence runs (reference recipe, full budget) ==="
+timeout 14000 bash scripts/tpu_convergence.sh 1000000 1 \
+  > artifacts/r3/convergence.log 2>&1
+tail -40 artifacts/r3/convergence.log
+
+echo "=== session complete ==="
