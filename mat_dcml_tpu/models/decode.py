@@ -206,12 +206,15 @@ def _fused_ar_decode_path(
 ) -> DecodeResult:
     """Whole-decode fused kernel path (``ops/pallas_decode.fused_ar_decode``).
 
-    Reproduces the XLA scan's draws bit-exactly: the per-position key chain
+    Reproduces the XLA scan's draws: the per-position key chain
     (``key, k_d, k_c = split(key, 3)``) is replayed here, and
     ``jax.random.categorical(k, logits)`` == ``argmax(logits + gumbel(k,
     logits.shape))``, so precomputing the Gumbel tensor and arg-maxing inside
-    the kernel is the same sample.  The semi-discrete Gaussian tail
-    (``transformer_act.py:93-98``) likewise consumes precomputed normal noise.
+    the kernel is the same sample — up to the kernel's polynomial-erf gelu
+    (~1e-4 logit tolerance; Mosaic has no erf primitive), so a draw can flip
+    only when two gumbel-perturbed logits tie within that margin.  The
+    semi-discrete Gaussian tail (``transformer_act.py:93-98``) likewise
+    consumes precomputed normal noise.
     """
     from mat_dcml_tpu.ops.pallas_decode import (
         fused_ar_decode,

@@ -133,6 +133,43 @@ def pack_decode_weights(params, cfg) -> Tuple[DecodeStepWeights, int]:
     ), adim
 
 
+def _gelu(x):
+    """Exact-erf GELU with an in-kernel polynomial erf.
+
+    Mosaic has no ``erf``/``erfc`` primitive (``jax.nn.gelu(approximate=False)``
+    lowers via ``lax.erfc`` and fails to compile for TPU kernels), so compute
+    erf with the Abramowitz–Stegun 7.1.26 rational approximation in f32
+    (max abs error 1.5e-7 ≈ one f32 ulp of erf's range).  Decode is
+    forward-only — no gradients ever flow through this — and the parity
+    suite pins the resulting logits to the XLA path at 1e-4.
+    """
+    x32 = x.astype(jnp.float32)
+    y = x32 * 0.7071067811865476          # x / sqrt(2)
+    a = jnp.abs(y)
+    t = 1.0 / (1.0 + 0.3275911 * a)
+    poly = t * (
+        0.254829592
+        + t * (-0.284496736 + t * (1.421413741 + t * (-1.453152027 + t * 1.061405429)))
+    )
+    erf_y = jnp.sign(y) * (1.0 - poly * jnp.exp(-a * a))
+    return (0.5 * x32 * (1.0 + erf_y)).astype(x.dtype)
+
+
+def _mm(a, b):
+    """Matmul with an f32 accumulator, rounded back to the input dtype.
+
+    Mosaic requires 32-bit matmul accumulation (a bf16 ``@`` traces as a
+    bf16-acc dot and fails verification); f32-accumulate-then-round is also
+    exactly what XLA emits for bf16 operands on the MXU, so this keeps the
+    kernel's numerics aligned with the unfused path.
+    """
+    out = jax.lax.dot_general(
+        a, b, (((a.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    return out.astype(a.dtype)
+
+
 def _layer_norm(x, scale, bias, eps=1e-6):
     x32 = x.astype(jnp.float32)
     mu = jnp.mean(x32, axis=-1, keepdims=True)
@@ -180,31 +217,31 @@ def _decoder_block_body(
     # ---- causal self-attn over the action cache
     w1 = qkvp1_w_ref[b].astype(dtype)
     b1 = qkvp1_b_ref[b].astype(dtype)
-    q1 = x @ w1[:, :D] + b1[:D]
-    k1 = x @ w1[:, D : 2 * D] + b1[D : 2 * D]
-    v1 = x @ w1[:, 2 * D : 3 * D] + b1[2 * D : 3 * D]
+    q1 = _mm(x, w1[:, :D]) + b1[:D]
+    k1 = _mm(x, w1[:, D : 2 * D]) + b1[D : 2 * D]
+    v1 = _mm(x, w1[:, 2 * D : 3 * D]) + b1[2 * D : 3 * D]
     k1_ref[:, pl.ds(i, 1), :] = k1[:, None, :]
     v1_ref[:, pl.ds(i, 1), :] = v1[:, None, :]
     att1 = _cached_attention(q1, k1_ref[:], v1_ref[:], i, n_head).astype(dtype)
-    y1 = att1 @ w1[:, 3 * D :] + b1[3 * D :]
+    y1 = _mm(att1, w1[:, 3 * D :]) + b1[3 * D :]
     h = _layer_norm(x + y1, lns[0], lns[1])
 
     # ---- causal cross-attn: keys/values from the h-cache, query = rep
     w2 = qkvp2_w_ref[b].astype(dtype)
     b2 = qkvp2_b_ref[b].astype(dtype)
-    q2 = rep @ w2[:, :D] + b2[:D]
-    k2 = h @ w2[:, D : 2 * D] + b2[D : 2 * D]
-    v2 = h @ w2[:, 2 * D : 3 * D] + b2[2 * D : 3 * D]
+    q2 = _mm(rep, w2[:, :D]) + b2[:D]
+    k2 = _mm(h, w2[:, D : 2 * D]) + b2[D : 2 * D]
+    v2 = _mm(h, w2[:, 2 * D : 3 * D]) + b2[2 * D : 3 * D]
     k2_ref[:, pl.ds(i, 1), :] = k2[:, None, :]
     v2_ref[:, pl.ds(i, 1), :] = v2[:, None, :]
     att2 = _cached_attention(q2, k2_ref[:], v2_ref[:], i, n_head).astype(dtype)
-    y2 = att2 @ w2[:, 3 * D :] + b2[3 * D :]
+    y2 = _mm(att2, w2[:, 3 * D :]) + b2[3 * D :]
     h2 = _layer_norm(rep + y2, lns[2], lns[3])
 
     # ---- MLP + residual; block output feeds the next block's self-attn
     # stream while `rep` stays the ENCODER representation for every block
-    m = jax.nn.gelu(h2 @ mlp_w1_ref[b].astype(dtype) + mlp_b1_ref[b].astype(dtype), approximate=False)
-    m = m @ mlp_w2_ref[b].astype(dtype) + mlp_b2_ref[b].astype(dtype)
+    m = _gelu(_mm(h2, mlp_w1_ref[b].astype(dtype)) + mlp_b1_ref[b].astype(dtype))
+    m = _mm(m, mlp_w2_ref[b].astype(dtype)) + mlp_b2_ref[b].astype(dtype)
     return _layer_norm(h2 + m, lns[4], lns[5])
 
 
@@ -231,8 +268,8 @@ def _decode_step_kernel(
     D = embed_w_ref.shape[1]
 
     # action embed + gelu + LN (Decoder._embed_action + ln)
-    x = x_ref[:].astype(dtype) @ embed_w_ref[:].astype(dtype) + embed_b_ref[:].astype(dtype)
-    x = jax.nn.gelu(x, approximate=False)
+    x = _mm(x_ref[:].astype(dtype), embed_w_ref[:].astype(dtype)) + embed_b_ref[:].astype(dtype)
+    x = _gelu(x)
     x = _layer_norm(x, ln0_ref[0], ln0_ref[1])
     rep = rep_ref[:].astype(dtype)                        # (TB, D)
 
@@ -250,10 +287,10 @@ def _decode_step_kernel(
         )
 
     # ---- f32 head (models/mat.py Head)
-    t = x.astype(jnp.float32) @ head_w1_ref[:].astype(jnp.float32) + head_b1_ref[:].astype(jnp.float32)
-    t = jax.nn.gelu(t, approximate=False)
+    t = _mm(x.astype(jnp.float32), head_w1_ref[:].astype(jnp.float32)) + head_b1_ref[:].astype(jnp.float32)
+    t = _gelu(t)
     t = _layer_norm(t, head_ln_ref[0], head_ln_ref[1])
-    logits_ref[:] = t @ head_w2_ref[:] + head_b2_ref[:]
+    logits_ref[:] = _mm(t, head_w2_ref[:]) + head_b2_ref[:]
 
 
 # ---------------------------------------------------------------------------
@@ -265,15 +302,20 @@ def _decode_step_kernel(
 # kernel dispatch per scan step.  This kernel runs the ENTIRE autoregressive
 # decode — all L positions, sampling included — in ONE ``pallas_call``:
 #
-# - grid over batch tiles only; a ``fori_loop`` over positions runs inside
-#   the kernel, so per-position state never leaves VMEM;
-# - KV caches live in VMEM *scratch* (never written to HBM at all — decode
-#   outputs are just actions and log-probs);
+# - grid = (batch tiles, position chunks), position minor: noise/avail/rep
+#   stream through VMEM in 8-position chunks (whole-sequence f32 tiles don't
+#   fit VMEM at the production shape), while per-position state never leaves
+#   the core;
+# - KV caches and the previous-action carry live in VMEM *scratch*, which
+#   persists across the sequential position-chunk grid steps (never written
+#   to HBM at all — decode outputs are just actions and log-probs);
 # - sampling is fused: categorical draws use precomputed Gumbel noise
 #   (``jax.random.categorical`` IS argmax(logits + gumbel), so feeding the
-#   same per-position Gumbel tensor reproduces the XLA path's draws
-#   bit-exactly), the semi-discrete Gaussian tail uses precomputed normal
-#   noise (``transformer_act.py:77-98`` sampling semantics);
+#   same per-position Gumbel tensor reproduces the XLA path's draws — up to
+#   the in-kernel polynomial-erf gelu's ~1e-4 logit tolerance, i.e. a draw
+#   can flip only when two gumbel-perturbed logits tie within that margin),
+#   the semi-discrete Gaussian tail uses precomputed normal noise
+#   (``transformer_act.py:77-98`` sampling semantics);
 # - the sampled action is one-hot re-embedded as the next position's input
 #   inside the loop (the loop-carried value), replicating
 #   ``transformer_act.py:90`` without leaving the kernel.
@@ -351,7 +393,15 @@ def _ar_decode_kernel(
     adim: int,
     nd: int,
     has_avail: bool,
+    pos_chunk: int,
 ):
+    """Grid = (batch tiles, position chunks).  The position axis is walked in
+    ``pos_chunk``-sized grid steps (minor dimension, so steps for one batch
+    tile are consecutive): per-chunk noise/avail/rep tiles stream through
+    VMEM instead of whole-sequence tiles (which blow VMEM at the production
+    shape A=101, adim_pad=128), while the KV caches, the previous-action
+    carry, and the (TB, Ap) output blocks stay VMEM-resident across chunks —
+    caches/carry as scratch, outputs by revisiting the same block index."""
     k = 4 if has_avail else 3
     rep_ref, gumbel_ref, normal_ref = refs[0], refs[1], refs[2]
     avail_ref = refs[3] if has_avail else None
@@ -361,33 +411,44 @@ def _ar_decode_kernel(
      head_w1_ref, head_b1_ref, head_ln_ref, head_w2_ref, head_b2_ref,
      std_ref) = refs[k : k + 18]
     act_ref, logp_ref = refs[k + 18], refs[k + 19]
-    cache_refs = refs[k + 20 :]
+    carry_ref = refs[k + 20]
+    cache_refs = refs[k + 21 :]
 
-    TB, A, D = rep_ref.shape
+    TB, _, D = rep_ref.shape
     adim_pad = gumbel_ref.shape[2]
     n_rows = normal_ref.shape[1]
+    Ap = cache_refs[0].shape[1]
     dtype = cache_refs[0].dtype
+    j = pl.program_id(1)
 
-    # Zero the V caches: attention weights at not-yet-written positions are
-    # exactly 0 after softmax underflow, but 0 * uninitialized-VMEM can be
-    # 0 * NaN.  (K garbage is masked before softmax; zero it too for hygiene.)
-    for c in cache_refs:
-        c[:] = jnp.zeros_like(c)
+    @pl.when(j == 0)
+    def _init():
+        # Zero the caches: attention weights at not-yet-written positions are
+        # exactly 0 after softmax underflow, but 0 * uninitialized-VMEM can
+        # be 0 * NaN.  (K garbage is masked before softmax; zero it too.)
+        for c in cache_refs:
+            c[:] = jnp.zeros_like(c)
+        carry_ref[:] = jnp.zeros_like(carry_ref)
+        act_ref[:] = jnp.zeros_like(act_ref)
+        logp_ref[:] = jnp.zeros_like(logp_ref)
 
     lanes = jax.lax.broadcasted_iota(jnp.int32, (1, adim_pad), 1)
+    lanes_a = jax.lax.broadcasted_iota(jnp.int32, (1, Ap), 1)
     lane_valid = lanes < adim                       # (1, adim_pad)
     last_col = (lanes == adim - 1).astype(jnp.float32)
     std_f = std_ref[:]                              # (1, adim_pad) f32
     c_std = jnp.sum(std_f * last_col)               # scalar: std of the tail dim
 
-    def pos_body(i, prev_onehot):
+    prev_onehot = carry_ref[:]
+    for jj in range(pos_chunk):
+        i = j * pos_chunk + jj                       # global position (traced)
         # ---- action embed (start token at i=0) + gelu + LN
-        x = prev_onehot.astype(dtype) @ embed_act_ref[:].astype(dtype)
+        x = _mm(prev_onehot.astype(dtype), embed_act_ref[:].astype(dtype))
         start = jnp.where(i == 0, 1.0, 0.0).astype(dtype)
         x = x + start * embed_start_ref[:].astype(dtype)
-        x = jax.nn.gelu(x, approximate=False)
+        x = _gelu(x)
         x = _layer_norm(x, ln0_ref[0], ln0_ref[1])
-        rep = rep_ref[:, pl.ds(i, 1), :][:, 0, :].astype(dtype)
+        rep = rep_ref[:, jj, :].astype(dtype)
 
         for b in range(n_block):
             x = _decoder_block_body(
@@ -399,20 +460,20 @@ def _ar_decode_kernel(
             )
 
         # ---- f32 head -> logits (TB, adim_pad)
-        t = x.astype(jnp.float32) @ head_w1_ref[:].astype(jnp.float32) + head_b1_ref[:].astype(jnp.float32)
-        t = jax.nn.gelu(t, approximate=False)
+        t = _mm(x.astype(jnp.float32), head_w1_ref[:].astype(jnp.float32)) + head_b1_ref[:].astype(jnp.float32)
+        t = _gelu(t)
         t = _layer_norm(t, head_ln_ref[0], head_ln_ref[1])
-        logits = t @ head_w2_ref[:] + head_b2_ref[:]
+        logits = _mm(t, head_w2_ref[:]) + head_b2_ref[:]
 
         # ---- fused sampling
         if has_avail:
-            ava = avail_ref[:, pl.ds(i, 1), :][:, 0, :]
+            ava = avail_ref[:, jj, :]
             masked = jnp.where(ava == 0, MASK_VALUE, logits)
         else:
             masked = logits
         masked = jnp.where(lane_valid, masked, PAD_KILL)
 
-        g = gumbel_ref[:, pl.ds(i, 1), :][:, 0, :]
+        g = gumbel_ref[:, jj, :]
         idx = jnp.argmax(masked + g, axis=-1)                       # (TB,)
         onehot = (lanes == idx[:, None]).astype(jnp.float32)        # (TB, adim_pad)
         mm = masked - jnp.max(masked, axis=-1, keepdims=True)
@@ -433,12 +494,13 @@ def _ar_decode_kernel(
         is_cont = i >= nd
         act_i = jnp.where(is_cont, c_act, idx.astype(jnp.float32))
         logp_i = jnp.where(is_cont, logp_c, logp_d)
-        act_ref[pl.ds(i, 1), :] = act_i[None, :]
-        logp_ref[pl.ds(i, 1), :] = logp_i[None, :]
-        return onehot
-
-    init = jnp.zeros((TB, adim_pad), jnp.float32)
-    jax.lax.fori_loop(0, A, pos_body, init)
+        # masked read-modify-write of the resident (TB, Ap) output blocks:
+        # no dynamic lane indexing (unsupported on Mosaic), just a select
+        col = lanes_a == i                                          # (1, Ap)
+        act_ref[:] = jnp.where(col, act_i[:, None], act_ref[:])
+        logp_ref[:] = jnp.where(col, logp_i[:, None], logp_ref[:])
+        prev_onehot = onehot
+    carry_ref[:] = prev_onehot
 
 
 def fused_ar_decode(
@@ -460,32 +522,52 @@ def fused_ar_decode(
     adim_pad = weights.embed_act.shape[0]
     n_rows = normal_rows.shape[1]
 
+    # Position axis walked in chunks (grid minor dim); Mosaic wants the
+    # second-to-last block dim sublane-aligned, and 8 positions per chunk
+    # keeps the streamed noise tiles small.
+    P = 8
+    pad_a = (-A) % P
+    Ap = A + pad_a
+
     if block_b is None:
-        # VMEM: caches 4*n_block*TB*A*D + f32 noise/avail tiles TB*A*adim_pad.
+        # VMEM: the persistent per-tile KV caches dominate (streamed chunk
+        # tiles are ~0.5 MB at P=8); leave headroom for double-buffering.
         bytes_c = 2 if obs_rep.dtype == jnp.bfloat16 else 4
-        per_b = 4 * n_block * A * D * bytes_c + (3 if avail is not None else 2) * A * adim_pad * 4
-        budget = 11 * 2**20
+        per_b = 4 * n_block * Ap * D * bytes_c
+        budget = 9 * 2**20
         tb = budget // max(1, per_b)
-        block_b = max(8, min(128, 1 << (tb.bit_length() - 1) if tb > 0 else 8))
-    TB = min(block_b, B)
+        block_b = max(8, min(256, 1 << (tb.bit_length() - 1) if tb > 0 else 8))
+    if not interpret:
+        # sublane-aligned batch tiles: both the chosen tile AND the B-clamp
+        # must be rounded up to 8, else 8 < B < block_b with B % 8 != 0
+        # produces a Mosaic-illegal tile (review r3)
+        block_b = max(8, (block_b + 7) // 8 * 8)
+        TB = min(block_b, (max(B, 8) + 7) // 8 * 8)
+    else:
+        TB = min(block_b, B)
 
     pad_b = (-B) % TB
-    if pad_b:
-        pad3 = lambda x: jnp.pad(x, ((0, pad_b), (0, 0), (0, 0)))
-        obs_rep, gumbel, normal_rows = pad3(obs_rep), pad3(gumbel), pad3(normal_rows)
+    if pad_b or pad_a:
+        pad3 = lambda x: jnp.pad(x, ((0, pad_b), (0, pad_a), (0, 0)))
+        obs_rep, gumbel = pad3(obs_rep), pad3(gumbel)
+        normal_rows = jnp.pad(normal_rows, ((0, pad_b), (0, 0), (0, 0)))
         if avail is not None:
             avail = pad3(avail)
     Bp = B + pad_b
 
-    grid = (Bp // TB,)
-    t3 = lambda s1, s2: pl.BlockSpec((TB, s1, s2), lambda g: (g, 0, 0))
-    full = lambda a: pl.BlockSpec(a.shape, lambda g: (0,) * a.ndim)
+    grid = (Bp // TB, Ap // P)
+    chunk = lambda s2: pl.BlockSpec((TB, P, s2), lambda g, j: (g, j, 0))
+    full = lambda a: pl.BlockSpec(a.shape, lambda g, j: (0,) * a.ndim)
 
     ops = [obs_rep, gumbel, normal_rows]
-    in_specs = [t3(A, D), t3(A, adim_pad), t3(n_rows, adim_pad)]
+    in_specs = [
+        chunk(D),
+        chunk(adim_pad),
+        pl.BlockSpec((TB, n_rows, adim_pad), lambda g, j: (g, 0, 0)),
+    ]
     if avail is not None:
         ops.append(avail)
-        in_specs.append(t3(A, adim_pad))
+        in_specs.append(chunk(adim_pad))
     w = weights
     wlist = [
         w.embed_start, w.embed_act, w.ln0,
@@ -500,18 +582,21 @@ def fused_ar_decode(
     kernel = functools.partial(
         _ar_decode_kernel,
         n_block=n_block, n_head=n_head, adim=adim, nd=nd,
-        has_avail=avail is not None,
+        has_avail=avail is not None, pos_chunk=P,
     )
     act, logp = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=in_specs,
-        out_specs=[pl.BlockSpec((A, TB), lambda g: (0, g))] * 2,
-        out_shape=[jax.ShapeDtypeStruct((A, Bp), jnp.float32)] * 2,
-        scratch_shapes=[pltpu.VMEM((TB, A, D), obs_rep.dtype)] * (4 * n_block),
+        # same (g, 0) block revisited across all position chunks: the output
+        # stays VMEM-resident per batch tile and flushes once at tile change
+        out_specs=[pl.BlockSpec((TB, Ap), lambda g, j: (g, 0))] * 2,
+        out_shape=[jax.ShapeDtypeStruct((Bp, Ap), jnp.float32)] * 2,
+        scratch_shapes=[pltpu.VMEM((TB, adim_pad), jnp.float32)]
+        + [pltpu.VMEM((TB, Ap, D), obs_rep.dtype)] * (4 * n_block),
         interpret=interpret,
     )(*ops)
-    return jnp.swapaxes(act, 0, 1)[:B], jnp.swapaxes(logp, 0, 1)[:B]
+    return act[:B, :A], logp[:B, :A]
 
 
 def fused_decode_step(

@@ -76,9 +76,13 @@ def test_fused_matches_unfused(action_type):
     ref = _run(action_type, "float32", "xla")
     fused = _run(action_type, "float32", "pallas_interpret", block_b=2)
     if action_type == DISCRETE:
-        # categorical draws must be IDENTICAL — same logits, same key chain
-        # (whole-decode kernel: argmax(logits + precomputed gumbel) ==
-        # jax.random.categorical on the XLA path)
+        # categorical draws are identical at these fixed seeds — same key
+        # chain, argmax(logits + precomputed gumbel) == jax.random.categorical
+        # on the XLA path.  NOT a universal guarantee: the kernel's
+        # polynomial-erf gelu (Mosaic has no erf) perturbs logits ~1e-4, so a
+        # draw flips iff two gumbel-perturbed logits tie within that margin;
+        # if a future seed/shape change trips this, compare with a near-tie
+        # exclusion instead of loosening blindly.
         np.testing.assert_array_equal(np.asarray(ref.action), np.asarray(fused.action))
     elif action_type == SEMI_DISCRETE:
         # discrete agents exact; the Gaussian tail carries ~1e-8 reassociation
