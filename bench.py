@@ -249,6 +249,11 @@ def _measure(jax, E: int, T: int, iters: int, profile_dir: str | None = None,
         jax.block_until_ready(traj)
         for name, fn in [("collect", lambda k: collect(train_state.params, rollout_state)),
                          ("train", lambda k: train(train_state, traj, rollout_state, k))]:
+            # warm up each dispatch: under BENCH_COMBINED only the fused step
+            # was compiled, so the first separate-train call would otherwise
+            # time its own compilation (r3 chip session: 18.7s "train" vs the
+            # 4.0s implied by combined-minus-collect)
+            jax.block_until_ready(fn(jax.random.key(99)))
             t0 = time.perf_counter()
             for i in range(iters):
                 out = fn(jax.random.key(100 + i))

@@ -199,11 +199,17 @@ class DCMLEnv:
             download_trans = jnp.full((W,), c.non_shannon_data_rate)
 
         # per-worker unit price: mean of a period of Poisson(λ) arrivals / λ
-        # (DCML_Worker...py:114-118); only observed under dynamic_price
-        prices = (
-            jax.random.poisson(k_price, c.lambda_of_poisson, (W, c.local_workload_period))
-            .astype(jnp.float32).mean(axis=1) / c.lambda_of_poisson
-        )
+        # (DCML_Worker...py:114-118); only observed under dynamic_price, and
+        # reset runs EVERY step (auto-reset), so gate it — jax.random.poisson
+        # is a rejection sampler whose while_loop serializes inside the
+        # collect scan on TPU, and the scan carry keeps XLA from dead-code
+        # eliminating an unread (W, P) draw per env per step
+        prices = None
+        if c.dynamic_price:
+            prices = (
+                jax.random.poisson(k_price, c.lambda_of_poisson, (W, c.local_workload_period))
+                .astype(jnp.float32).mean(axis=1) / c.lambda_of_poisson
+            )
 
         state = DCMLState(
             rng=key,
@@ -379,8 +385,10 @@ class DCMLEnv:
         # upload retries: faithful mode adds one geometric draw per drained
         # timeslot (the reference's in-loop indentation, :99-106); fixed mode
         # draws once.
-        n_draws = jnp.ones_like(m_slots) if self.cfg.fixed_upload_retry else m_slots
-        extra_fails = _negative_binomial(k_ul, n_draws, prs)
+        if self.cfg.fixed_upload_retry:
+            extra_fails = _geometric_failures(k_ul, prs)   # one draw == NB(1, p)
+        else:
+            extra_fails = _negative_binomial(k_ul, m_slots, prs)
         n_retry_final = n_retry + extra_fails
         upload_delay = (
             c.second_to_centsec
@@ -434,12 +442,12 @@ class DCMLEnv:
 
         # feature 7: own rank if available, else the previous block's feature 7
         # (the obs[-7] back-reference at :210-213), forward-filled from 0.
-        def ff(carry, xs):
-            a, r = xs
-            out = jnp.where(a, r, carry)
-            return out, out
-
-        _, feat7 = jax.lax.scan(ff, jnp.float32(0.0), (avail, rank))
+        # Log-depth cummax + gather instead of a 100-step lax.scan: identical
+        # values (the fill picks rank[last available index <= i]), but no
+        # sequential inner loop inside the per-step env (TPU collect scan).
+        iw = jnp.arange(W)
+        last_avail = jax.lax.associative_scan(jnp.maximum, jnp.where(avail, iw, -1))
+        feat7 = jnp.where(last_avail >= 0, rank[jnp.maximum(last_avail, 0)], 0.0)
 
         shared_head = jnp.stack([r_norm * c.state_ratio, c_norm * c.state_ratio])
         worker_obs_avail = jnp.concatenate(
@@ -520,23 +528,54 @@ class DCMLEnv:
 # ---------------------------------------------------------------- sampling
 
 
-def _geometric_failures(key: jax.Array, p_fail: jax.Array) -> jax.Array:
-    """Number of consecutive U() < p draws: F = floor(log U / log p), F=0 at p=0."""
-    u = jax.random.uniform(key, p_fail.shape, minval=jnp.finfo(jnp.float32).tiny, maxval=1.0)
+def _geom_inverse_cdf(u: jax.Array, p_fail: jax.Array) -> jax.Array:
+    """Geometric failure count from a uniform: F = floor(log u / log p)."""
     safe_p = jnp.clip(p_fail, 1e-12, 1.0 - 1e-7)
-    f = jnp.floor(jnp.log(u) / jnp.log(safe_p))
+    return jnp.floor(jnp.log(u) / jnp.log(safe_p))
+
+
+def _uniform_open(key: jax.Array, shape) -> jax.Array:
+    return jax.random.uniform(
+        key, shape, minval=jnp.finfo(jnp.float32).tiny, maxval=1.0
+    )
+
+
+def _geometric_failures(key: jax.Array, p_fail: jax.Array) -> jax.Array:
+    """Number of consecutive U() < p draws; F=0 at p=0."""
+    f = _geom_inverse_cdf(_uniform_open(key, p_fail.shape), p_fail)
     return jnp.where(p_fail <= 0.0, 0.0, f)
 
 
+_NB_DRAW_CAP = 64
+
+
 def _negative_binomial(key: jax.Array, n_draws: jax.Array, p_fail: jax.Array) -> jax.Array:
-    """Sum of ``n_draws`` iid geometric-failure counts, via the Gamma-Poisson
-    mixture: NB(n, p) = Poisson(Gamma(n, p/(1-p)))."""
-    k_g, k_p = jax.random.split(key)
-    safe_p = jnp.clip(p_fail, 0.0, 1.0 - 1e-6)
-    scale = safe_p / (1.0 - safe_p)
-    lam = jax.random.gamma(k_g, jnp.maximum(n_draws, 1e-6)) * scale
-    draws = jax.random.poisson(k_p, lam).astype(jnp.float32)
-    return jnp.where(p_fail <= 0.0, 0.0, draws)
+    """Sum of ``n_draws`` iid geometric-failure counts.
+
+    Exact masked sum of up to ``_NB_DRAW_CAP`` closed-form geometric draws —
+    the reference itself draws one geometric per drained timeslot in a loop
+    (``DCML_Worker...py:99-106``), and the drained-slot counts this receives
+    are tiny in practice (p99 ≈ 5 over random-policy rollouts).  The previous
+    Gamma-Poisson mixture was distribution-equivalent but ``jax.random.gamma``
+    / ``poisson`` are rejection samplers whose data-dependent while_loops
+    serialize inside the TPU collect scan.  Lanes with ``n_draws`` beyond the
+    cap (never observed) get the remainder from a moment-matched normal, so
+    no lane is truncated and no control flow is data-dependent.
+    """
+    k_g, k_t = jax.random.split(key)
+    u = _uniform_open(k_g, (*n_draws.shape, _NB_DRAW_CAP))
+    f = _geom_inverse_cdf(u, p_fail[..., None])
+    live = jnp.arange(_NB_DRAW_CAP) < jnp.minimum(n_draws, _NB_DRAW_CAP)[..., None]
+    total = jnp.where(live, f, 0.0).sum(axis=-1)
+
+    safe_p = jnp.clip(p_fail, 1e-12, 1.0 - 1e-7)
+    rem = jnp.maximum(n_draws - _NB_DRAW_CAP, 0.0)
+    mean = safe_p / (1.0 - safe_p)
+    var = safe_p / jnp.square(1.0 - safe_p)
+    z = jax.random.normal(k_t, n_draws.shape)
+    tail = jnp.maximum(jnp.round(rem * mean + z * jnp.sqrt(rem * var)), 0.0)
+    total = total + jnp.where(rem > 0, tail, 0.0)
+    return jnp.where(p_fail <= 0.0, 0.0, total)
 
 
 # ------------------------------------------------------------------ loaders

@@ -1,0 +1,133 @@
+#!/usr/bin/env python
+"""Decompose the collect phase's on-chip cost (round-3 sweep follow-up).
+
+The r3 chip session measured collect at ~0.985 s/iter for T=50 at E=256 —
+~19.7 ms per env step — while ``get_actions`` (encode + full AR decode +
+value) measures only ~0.34 ms standalone.  This script times each collect
+ingredient under one serialized TPU session to locate the other ~19 ms:
+
+  1. get_actions alone (sanity anchor vs scripts/tpu_decode_bench.py)
+  2. vmapped env.step alone
+  3. vmapped env.step with the negative-binomial upload-retry sampler
+     stubbed, and with the download geometric stubbed too (rejection-loop
+     vs closed-form sampling cost)
+  4. the full collect scan (T=50), and the same with the NB stub
+
+Writes one JSON line to stdout; diagnostics to stderr.
+Usage: python scripts/tpu_collect_bench.py [E]
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+
+def log(msg):
+    print(f"[collect-bench] {msg}", file=sys.stderr, flush=True)
+
+
+def main():
+    E = int(sys.argv[1]) if len(sys.argv) > 1 else 256
+    T = 50
+
+    from bench import _setup_jax
+
+    jax, fell_back = _setup_jax()
+    if fell_back:
+        log("TPU unavailable; refusing to measure collect on CPU")
+        raise SystemExit(2)
+    import jax.numpy as jnp
+
+    import mat_dcml_tpu.envs.dcml.env as envmod
+    from mat_dcml_tpu.config import RunConfig
+    from mat_dcml_tpu.envs.dcml import DCMLEnv, DCMLEnvConfig
+    from mat_dcml_tpu.training.rollout import RolloutCollector
+    from mat_dcml_tpu.training.runner import build_mat_policy
+
+    data_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", "data")
+    run = RunConfig(
+        n_rollout_threads=E, episode_length=T,
+        model_dtype=os.environ.get("BENCH_DTYPE", "bfloat16"),
+    )
+    env = DCMLEnv(DCMLEnvConfig(), data_dir=data_dir)
+    policy = build_mat_policy(run, env)
+    params = policy.init_params(jax.random.key(0))
+
+    def timed(fn, *args, iters=20):
+        out = fn(*args)
+        jax.block_until_ready(out)
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = fn(*args)
+        jax.block_until_ready(out)
+        return (time.perf_counter() - t0) / iters
+
+    row = {"E": E, "T": T}
+
+    # --- anchors
+    keys = jax.random.split(jax.random.key(0), E)
+    states, ts0 = jax.jit(jax.vmap(env.reset))(keys, jnp.zeros(E, jnp.int32))
+    jax.block_until_ready(ts0)
+    act = jnp.concatenate([jnp.ones((E, 100)), jnp.full((E, 1), 0.7)], axis=1)
+
+    ga = jax.jit(
+        lambda p, k, s, o, a: policy.get_actions(p, k, s, o, a, deterministic=False)
+    )
+    dt = timed(ga, params, jax.random.key(7), ts0.share_obs, ts0.obs, ts0.available_actions)
+    row["get_actions_ms"] = round(dt * 1e3, 3)
+    log(f"get_actions: {dt*1e3:.3f} ms")
+
+    # --- env.step variants
+    def bench_step(tag):
+        fn = jax.jit(jax.vmap(env.step))
+        dt = timed(fn, states, act)
+        row[f"env_step_{tag}_ms"] = round(dt * 1e3, 3)
+        log(f"env.step [{tag}]: {dt*1e3:.3f} ms")
+        return dt
+
+    bench_step("full")
+
+    orig_nb = envmod._negative_binomial
+    envmod._negative_binomial = lambda key, n, p: jnp.zeros_like(n)
+    try:
+        bench_step("no_nb")
+    finally:
+        envmod._negative_binomial = orig_nb
+
+    orig_geo = envmod._geometric_failures
+    envmod._negative_binomial = lambda key, n, p: jnp.zeros_like(n)
+    envmod._geometric_failures = lambda key, p: jnp.zeros_like(p)
+    try:
+        bench_step("no_nb_no_geo")
+    finally:
+        envmod._negative_binomial = orig_nb
+        envmod._geometric_failures = orig_geo
+
+    # --- full collect scans
+    def bench_collect(tag):
+        collector = RolloutCollector(env, policy, T)
+        rstate = collector.init_state(jax.random.key(1), E)
+        fn = jax.jit(collector.collect)
+        dt = timed(fn, params, rstate, iters=5)
+        row[f"collect_{tag}_s"] = round(dt, 4)
+        row[f"collect_{tag}_ms_per_step"] = round(dt / T * 1e3, 3)
+        log(f"collect [{tag}]: {dt:.3f} s ({dt/T*1e3:.2f} ms/env-step)")
+
+    bench_collect("full")
+    envmod._negative_binomial = lambda key, n, p: jnp.zeros_like(n)
+    try:
+        bench_collect("no_nb")
+    finally:
+        envmod._negative_binomial = orig_nb
+
+    print(json.dumps(row), flush=True)
+    log("done")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,43 @@
+#!/bin/bash
+# Round-3 follow-up chip session: measure the mid-round fixes that landed
+# after scripts/tpu_session.sh started (one TPU client at a time):
+#
+#   1. collect-phase decomposition (scripts/tpu_collect_bench.py) — locates
+#      the env-sim cost the r3 sweep exposed, now with the loop-free NB
+#      sampler + gated prices draw + cummax forward fill
+#   2. decode micro-bench re-run — the whole-decode Pallas kernel now lowers
+#      on Mosaic (poly-erf gelu, f32 matmul acc, position-chunked grid)
+#   3. combined-step A/B at E=256 with the fixed kernel
+#   4. E-sweep re-run with the fast env + warmed breakdown (headline)
+#
+# Output accumulates under artifacts/r3/ with _s2 suffixes.
+set -x
+cd "$(dirname "$0")/.."
+mkdir -p artifacts/r3
+export BENCH_TPU_PROBE_TIMEOUT=0
+
+echo "=== 1. collect decomposition ==="
+timeout 3000 python scripts/tpu_collect_bench.py 256 \
+  > artifacts/r3/collect_bench.json 2> artifacts/r3/collect_bench.log
+cat artifacts/r3/collect_bench.json
+
+echo "=== 2. decode micro-bench (fixed kernel) ==="
+timeout 3000 python scripts/tpu_decode_bench.py 256 512 \
+  > artifacts/r3/decode_bench_s2.json 2> artifacts/r3/decode_bench_s2.log
+cat artifacts/r3/decode_bench_s2.json
+
+echo "=== 3. combined-step A/B at E=256 (fixed kernel) ==="
+for impl in xla pallas; do
+  MAT_DCML_TPU_DECODE_IMPL=$impl BENCH_N_ENVS=256 BENCH_ITERS=3 \
+    timeout 3000 python bench.py \
+    > "artifacts/r3/bench_e256_${impl}_s2.json" 2> "artifacts/r3/bench_e256_${impl}_s2.log"
+  cat "artifacts/r3/bench_e256_${impl}_s2.json"
+done
+
+echo "=== 4. E-sweep with fast env ==="
+BENCH_SWEEP=1 BENCH_SWEEP_ENVS=256,512,1024,2048 BENCH_BREAKDOWN=1 \
+  BENCH_ITERS=3 timeout 5400 python bench.py \
+  > artifacts/r3/bench_sweep_s2.json 2> artifacts/r3/bench_sweep_s2.log
+cat artifacts/r3/bench_sweep_s2.json
+
+echo "=== session 2 complete ==="
