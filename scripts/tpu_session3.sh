@@ -1,0 +1,19 @@
+#!/bin/bash
+# Round-3 combined chip session, run after the mid-sweep tunnel wedge killed
+# scripts/tpu_session.sh.  Priority order: the convergence evidence first
+# (VERDICT r3 item 3 — the one artifact that needs hours), then the
+# fast-env/fixed-kernel measurements (scripts/tpu_session2.sh).
+set -x
+cd "$(dirname "$0")/.."
+mkdir -p artifacts/r3
+export BENCH_TPU_PROBE_TIMEOUT=0
+export MAT_DCML_TPU_DECODE_IMPL=xla   # measured winner (artifacts/r3/winner.txt)
+
+echo "=== convergence runs (reference recipe, full budget) ==="
+timeout 16000 bash scripts/tpu_convergence.sh 1000000 1 \
+  > artifacts/r3/convergence.log 2>&1
+tail -40 artifacts/r3/convergence.log
+
+bash scripts/tpu_session2.sh
+
+echo "=== session 3 complete ==="
