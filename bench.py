@@ -65,8 +65,17 @@ def _probe_tpu(timeout_s: int) -> bool:
     # own session + process-group kill: run()'s kill-and-communicate can
     # itself block forever if the wedged child (or a helper it spawned)
     # holds the stdout pipe open after SIGKILL of the direct child only
+    # probe runs a real matmul, not just backend init: the r3 session-1 wedge
+    # hit AFTER devices() had succeeded (mid-sweep device call hung), so an
+    # init-only probe can green-light a chip that stalls on first dispatch
     proc = subprocess.Popen(
-        [sys.executable, "-c", "import jax; jax.devices(); print('ok')"],
+        [
+            sys.executable,
+            "-c",
+            "import jax, jax.numpy as jnp; jax.devices(); "
+            "assert float((jnp.ones((8, 8)) @ jnp.ones((8, 8))).sum()) == 512.0; "
+            "print('ok')",
+        ],
         stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
         start_new_session=True,
     )
@@ -91,7 +100,9 @@ def _setup_jax():
 
     apply_platform_override()
     probe_forced_cpu = False
-    probe_timeout = int(os.environ.get("BENCH_TPU_PROBE_TIMEOUT", "900"))
+    # default must exceed the tunnel's ~25-min claim queue (r2/r3 outages):
+    # a 900s probe abandoned grants that would have been served at ~1500s
+    probe_timeout = int(os.environ.get("BENCH_TPU_PROBE_TIMEOUT", "2100"))
     if os.environ.get("JAX_PLATFORMS", "") != "cpu" and not _probe_tpu(probe_timeout):
         log(f"TPU probe failed/timed out ({probe_timeout}s); forcing CPU")
         os.environ["JAX_PLATFORMS"] = "cpu"
@@ -247,20 +258,26 @@ def _measure(jax, E: int, T: int, iters: int, profile_dir: str | None = None,
     if breakdown:
         rollout_state, traj = collect(train_state.params, rollout_state)
         jax.block_until_ready(traj)
-        for name, fn in [("collect", lambda k: collect(train_state.params, rollout_state)),
-                         ("train", lambda k: train(train_state, traj, rollout_state, k))]:
-            # warm up each dispatch: under BENCH_COMBINED only the fused step
-            # was compiled, so the first separate-train call would otherwise
-            # time its own compilation (r3 chip session: 18.7s "train" vs the
-            # 4.0s implied by combined-minus-collect)
-            jax.block_until_ready(fn(jax.random.key(99)))
+        phases = {
+            "collect": (collect, (train_state.params, rollout_state)),
+            "train": (train, (train_state, traj, rollout_state, jax.random.key(0))),
+        }
+        for name, (fn, args) in phases.items():
+            # one explicit compile per phase, shared by the timing loop and
+            # cost_analysis below: under BENCH_COMBINED only the fused step
+            # was compiled, so timing a bare first call would include the
+            # compile (r3 chip session: 18.7s "train" vs the 4.0s implied by
+            # combined-minus-collect)
+            compiled = fn.lower(*args).compile()
+            jax.block_until_ready(compiled(*args))        # warm-up execution
             t0 = time.perf_counter()
-            for i in range(iters):
-                out = fn(jax.random.key(100 + i))
+            for _ in range(iters):
+                out = compiled(*args)
             jax.block_until_ready(out)
             dt = (time.perf_counter() - t0) / iters
             result[f"{name}_sec"] = dt
             log(f"E={E}: {name} {dt:.3f}s/iter")
+            _roofline(jax, result, E, name, compiled)
         _breakdown_mfu(jax, result, E, T)
     return result
 
@@ -268,6 +285,50 @@ def _measure(jax, E: int, T: int, iters: int, profile_dir: str | None = None,
 # bf16 peak TFLOP/s per chip by device_kind substring (public spec sheets);
 # used to turn measured FLOP rates into %-of-peak in the breakdown
 _PEAK_TFLOPS = {"v5 lite": 197.0, "v5e": 197.0, "v4": 275.0, "v5p": 459.0, "v6": 918.0}
+
+# HBM bandwidth GB/s per chip (public spec sheets); roofline's memory leg
+_HBM_GBPS = {"v5 lite": 819.0, "v5e": 819.0, "v4": 1228.0, "v5p": 2765.0, "v6": 1640.0}
+
+
+def _chip_specs(jax):
+    """(device_kind, bf16 peak TFLOP/s or None, HBM GB/s or None)."""
+    kind = jax.devices()[0].device_kind.lower()
+    peak = next((v for k, v in _PEAK_TFLOPS.items() if k in kind), None)
+    bw = next((v for k, v in _HBM_GBPS.items() if k in kind), None)
+    return kind, peak, bw
+
+
+def _roofline(jax, result: dict, E: int, name: str, compiled) -> None:
+    """Annotate one phase with XLA's static cost analysis and a roofline
+    estimate.  ``cost_analysis()`` reports the compiled executable's total
+    flops and bytes accessed; roofline time = max(flops/peak, bytes/bw) says
+    whether the phase is compute- or HBM-bound and how far the measured time
+    sits above the ceiling — the analytic `_model_flops_per_env_step` counts
+    only matmuls, so XLA's numbers also catch elementwise/copy overheads."""
+    _, peak, bw = _chip_specs(jax)
+    try:
+        ca = compiled.cost_analysis()
+        flops = float(ca.get("flops", 0.0))
+        byts = float(ca.get("bytes accessed", 0.0))
+    except Exception as e:  # cost analysis is best-effort diagnostics
+        log(f"E={E}: {name} cost_analysis unavailable: {e}")
+        return
+    result[f"{name}_xla_gflops"] = round(flops / 1e9, 1)
+    result[f"{name}_xla_gbytes"] = round(byts / 1e9, 3)
+    msg = f"E={E}: {name} XLA-counted {flops/1e9:.1f} GFLOP, {byts/1e9:.2f} GB accessed"
+    sec = result.get(f"{name}_sec")
+    if peak and bw and sec:
+        t_flop = flops / (peak * 1e12)
+        t_mem = byts / (bw * 1e9)
+        roof = max(t_flop, t_mem)
+        bound = "compute" if t_flop >= t_mem else "HBM"
+        result[f"{name}_roofline_sec"] = round(roof, 4)
+        result[f"{name}_roofline_bound"] = bound
+        msg += (
+            f"; roofline {roof*1e3:.1f} ms ({bound}-bound)"
+            f" vs measured {sec*1e3:.1f} ms = {sec/max(roof,1e-9):.1f}x above"
+        )
+    log(msg)
 
 
 def _model_flops_per_env_step(E: int, T: int, ppo_epoch: int):
@@ -298,8 +359,7 @@ def _breakdown_mfu(jax, result: dict, E: int, T: int) -> None:
     from mat_dcml_tpu.training.ppo import PPOConfig
 
     collect_fl, update_fl = _model_flops_per_env_step(E, T, PPOConfig().ppo_epoch)
-    kind = jax.devices()[0].device_kind.lower()
-    peak = next((v for k, v in _PEAK_TFLOPS.items() if k in kind), None)
+    kind, peak, _ = _chip_specs(jax)
     for phase, fl in (("collect", collect_fl), ("train", update_fl)):
         sec = result.get(f"{phase}_sec")
         if not sec:

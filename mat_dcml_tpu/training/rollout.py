@@ -94,7 +94,13 @@ class RolloutCollector:
         self.dynamic_coefficients = dynamic_coefficients and self.n_objective > 1
 
     def _sample_coefficients(self, key: jax.Array, n_envs: int) -> jax.Array:
-        return jax.random.dirichlet(key, jnp.ones((self.n_objective,)), (n_envs,))
+        # Dirichlet(1,...,1) == normalized iid exponentials.  Closed form
+        # instead of jax.random.dirichlet because dirichlet samples gamma,
+        # a rejection sampler whose while_loop serializes inside the collect
+        # scan on TPU (this resamples every step in DMO mode, applied only
+        # at episode boundaries).
+        e = jax.random.exponential(key, (n_envs, self.n_objective))
+        return e / e.sum(axis=-1, keepdims=True)
 
     def augment_share_obs(self, x: jax.Array, coefs: Optional[jax.Array]) -> jax.Array:
         """Append per-env preference weights to every agent's obs/share_obs row.
