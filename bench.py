@@ -256,19 +256,20 @@ def _measure(jax, E: int, T: int, iters: int, profile_dir: str | None = None,
         f"per-iter {result['iter_secs']})")
 
     if breakdown:
-        rollout_state, traj = collect(train_state.params, rollout_state)
+        # one explicit compile per phase, shared by traj production, the
+        # timing loop, and cost_analysis: under BENCH_COMBINED only the fused
+        # step was compiled, so timing a bare first call would include the
+        # compile (r3 chip session: 18.7s "train" vs the 4.0s implied by
+        # combined-minus-collect)
+        collect_c = collect.lower(train_state.params, rollout_state).compile()
+        rollout_state, traj = collect_c(train_state.params, rollout_state)
         jax.block_until_ready(traj)
+        train_args = (train_state, traj, rollout_state, jax.random.key(0))
         phases = {
-            "collect": (collect, (train_state.params, rollout_state)),
-            "train": (train, (train_state, traj, rollout_state, jax.random.key(0))),
+            "collect": (collect_c, (train_state.params, rollout_state)),
+            "train": (train.lower(*train_args).compile(), train_args),
         }
-        for name, (fn, args) in phases.items():
-            # one explicit compile per phase, shared by the timing loop and
-            # cost_analysis below: under BENCH_COMBINED only the fused step
-            # was compiled, so timing a bare first call would include the
-            # compile (r3 chip session: 18.7s "train" vs the 4.0s implied by
-            # combined-minus-collect)
-            compiled = fn.lower(*args).compile()
+        for name, (compiled, args) in phases.items():
             jax.block_until_ready(compiled(*args))        # warm-up execution
             t0 = time.perf_counter()
             for _ in range(iters):

@@ -107,6 +107,42 @@ class TestWorkerProcess:
         f = _negative_binomial(jax.random.key(0), n, p)
         # E = n * p/(1-p) = 7
         assert abs(float(f.mean()) - 7.0) < 0.15
+        # Var = n * p/(1-p)^2 = 14 — pins the closed-form geometric sum as a
+        # real NB, not just mean-matched
+        var = float(((f - f.mean()) ** 2).mean())
+        assert abs(var - 14.0) < 0.6
+
+    @pytest.mark.slow
+    def test_negative_binomial_tail_beyond_cap(self):
+        """n_draws above the exact-draw cap routes through the moment-matched
+        normal tail; mean and variance must still track NB(n, p)."""
+        from mat_dcml_tpu.envs.dcml.env import _NB_DRAW_CAP, _negative_binomial
+
+        n_val = float(_NB_DRAW_CAP * 3)
+        p = jnp.full((100_000,), 0.3)
+        n = jnp.full((100_000,), n_val)
+        f = _negative_binomial(jax.random.key(2), n, p)
+        mean_want = n_val * 0.3 / 0.7
+        var_want = n_val * 0.3 / 0.7**2
+        assert abs(float(f.mean()) - mean_want) / mean_want < 0.02
+        var = float(((f - f.mean()) ** 2).mean())
+        assert abs(var - var_want) / var_want < 0.05
+        assert float(f.min()) >= 0.0
+
+    def test_dirichlet_coefficients_uniform_simplex(self):
+        """RolloutCollector's closed-form Dirichlet(1,..,1): on the simplex,
+        uniform marginals (E = 1/k, Var = (k-1)/(k^2 (k+1)))."""
+        from mat_dcml_tpu.training.rollout import RolloutCollector
+
+        rc = RolloutCollector.__new__(RolloutCollector)
+        rc.n_objective = 3
+        w = rc._sample_coefficients(jax.random.key(5), 60_000)
+        assert w.shape == (60_000, 3)
+        np.testing.assert_allclose(np.asarray(w.sum(-1)), 1.0, rtol=1e-5)
+        assert float(w.min()) >= 0.0
+        np.testing.assert_allclose(np.asarray(w.mean(0)), 1 / 3, atol=0.01)
+        var_want = 2.0 / (9.0 * 4.0)  # (k-1)/(k^2 (k+1)), k=3
+        np.testing.assert_allclose(np.asarray(w.var(0)), var_want, atol=0.003)
 
 
 class TestResetObs:
