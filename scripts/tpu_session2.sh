@@ -34,6 +34,16 @@ for impl in xla pallas; do
   cat "artifacts/r3/bench_e256_${impl}_s2.json"
 done
 
+echo "=== 3b. attention A/B in the PPO update (E=256) ==="
+# the update's teacher-forced attention materializes (B, h, A, A) f32
+# scores (~260 MB per call at minibatch 3200); if the breakdown shows the
+# update HBM-bound, the fused kernel may win here even though it lost in
+# collect (BENCHLOG r1 note: 543 vs 683 at collect shapes)
+MAT_DCML_TPU_ATTN_IMPL=pallas BENCH_N_ENVS=256 BENCH_ITERS=3 BENCH_BREAKDOWN=1 \
+  timeout 3000 python bench.py \
+  > artifacts/r3/bench_e256_attnpallas_s2.json 2> artifacts/r3/bench_e256_attnpallas_s2.log
+cat artifacts/r3/bench_e256_attnpallas_s2.json
+
 echo "=== 4. E-sweep with fast env ==="
 BENCH_SWEEP=1 BENCH_SWEEP_ENVS=256,512,1024,2048 BENCH_BREAKDOWN=1 \
   BENCH_ITERS=3 timeout 5400 python bench.py \
