@@ -26,13 +26,19 @@ timeout 3000 python scripts/tpu_decode_bench.py 256 512 \
   > artifacts/r3/decode_bench_s2.json 2> artifacts/r3/decode_bench_s2.log
 cat artifacts/r3/decode_bench_s2.json
 
-echo "=== 3. combined-step A/B at E=256 (fixed kernel) ==="
+echo "=== 3. combined-step A/B at E=256 (fixed kernel) + op trace ==="
 for impl in xla pallas; do
+  prof=""
+  [ "$impl" = xla ] && prof="artifacts/r3/trace_e256"
   MAT_DCML_TPU_DECODE_IMPL=$impl BENCH_N_ENVS=256 BENCH_ITERS=3 \
-    timeout 3000 python bench.py \
+    BENCH_PROFILE_DIR=$prof timeout 3000 python bench.py \
     > "artifacts/r3/bench_e256_${impl}_s2.json" 2> "artifacts/r3/bench_e256_${impl}_s2.log"
   cat "artifacts/r3/bench_e256_${impl}_s2.json"
 done
+# offline op-level breakdown of the captured trace (no TPU needed)
+JAX_PLATFORMS=cpu python scripts/trace_report.py artifacts/r3/trace_e256 40 \
+  > artifacts/r3/trace_e256_report.txt 2>&1 || true
+tail -50 artifacts/r3/trace_e256_report.txt
 
 echo "=== 3b. attention A/B in the PPO update (E=256) ==="
 # the update's teacher-forced attention materializes (B, h, A, A) f32

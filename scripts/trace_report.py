@@ -1,0 +1,107 @@
+#!/usr/bin/env python
+"""Offline analysis of a jax.profiler trace (xplane.pb) — no TensorBoard UI.
+
+Parses the raw XSpace protobuf directly (the installed
+tensorboard_plugin_profile's converter is incompatible with the installed
+TF's pywrap API, so no high-level tooling) and prints, per device plane and
+line, the top ops by summed duration.  Run on the artifacts captured by
+``BENCH_PROFILE_DIR`` (bench.py) or ``--profile_dir`` (training CLIs):
+
+    PROTOCOL_BUFFERS_PYTHON_IMPLEMENTATION=python \
+      python scripts/trace_report.py artifacts/r3/trace_e256 [top_n]
+
+Writes <dir>/op_summary.json and prints top-N tables for the device lines.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import sys
+from collections import defaultdict
+
+os.environ.setdefault("PROTOCOL_BUFFERS_PYTHON_IMPLEMENTATION", "python")
+
+
+def find_xspace(root: str) -> str:
+    hits = sorted(glob.glob(os.path.join(root, "**", "*.xplane.pb"), recursive=True))
+    if not hits:
+        raise SystemExit(f"no *.xplane.pb under {root}")
+    return hits[-1]
+
+
+def main():
+    root = sys.argv[1] if len(sys.argv) > 1 else "."
+    top_n = int(sys.argv[2]) if len(sys.argv) > 2 else 25
+    xspace_path = find_xspace(root)
+    print(f"[trace] {xspace_path}", file=sys.stderr)
+
+    from tensorflow.tsl.profiler.protobuf import xplane_pb2
+
+    xspace = xplane_pb2.XSpace()
+    with open(xspace_path, "rb") as f:
+        xspace.ParseFromString(f.read())
+
+    # device planes ("/device:TPU:0") carry the HLO op lines; the python
+    # host-thread line is dispatch noise.  CPU traces put XLA client lines
+    # under "/host:CPU", so fall back to any plane with XLA-ish lines.
+    def is_device(plane):
+        return any(s in plane.name.lower() for s in ("tpu", "gpu", "/device"))
+
+    def has_xla_line(plane):
+        return any("xla" in (l.name or l.display_name).lower() for l in plane.lines)
+
+    planes = [p for p in xspace.planes if is_device(p)]
+    if not planes:
+        planes = [p for p in xspace.planes if has_xla_line(p)]
+
+    summary = {}
+    for plane in planes:
+        meta = {m_id: m.name for m_id, m in plane.event_metadata.items()}
+        disp = {m_id: (m.display_name or m.name) for m_id, m in plane.event_metadata.items()}
+        for line in plane.lines:
+            agg = defaultdict(lambda: [0.0, 0])   # name -> [total_ps, count]
+            t_min, t_max = None, None
+            for ev in line.events:
+                name = disp.get(ev.metadata_id, meta.get(ev.metadata_id, "?"))
+                a = agg[name]
+                a[0] += ev.duration_ps
+                a[1] += 1
+                t0 = ev.offset_ps
+                t1 = ev.offset_ps + ev.duration_ps
+                t_min = t0 if t_min is None else min(t_min, t0)
+                t_max = t1 if t_max is None else max(t_max, t1)
+            if not agg:
+                continue
+            span_ms = (t_max - t_min) / 1e9 if t_max else 0.0
+            rows = sorted(
+                ((n, v[0] / 1e9, v[1]) for n, v in agg.items()),
+                key=lambda r: r[1], reverse=True,
+            )
+            key = f"{plane.name} :: {line.name or line.display_name}"
+            summary[key] = {
+                "span_ms": round(span_ms, 3),
+                "busy_ms": round(sum(r[1] for r in rows), 3),
+                "top": [
+                    {"op": n, "total_ms": round(ms, 3), "count": c,
+                     "pct_of_span": round(100 * ms / span_ms, 2) if span_ms else None}
+                    for n, ms, c in rows[:top_n]
+                ],
+            }
+
+    out_path = os.path.join(root, "op_summary.json")
+    with open(out_path, "w") as f:
+        json.dump(summary, f, indent=1)
+    print(f"[trace] wrote {out_path}", file=sys.stderr)
+
+    for key, s in summary.items():
+        print(f"\n== {key}  (span {s['span_ms']:.1f} ms, busy {s['busy_ms']:.1f} ms)")
+        print(f"{'op':64s} {'total-ms':>10s} {'%span':>7s} {'count':>8s}")
+        for r in s["top"]:
+            pct = f"{r['pct_of_span']:.1f}" if r["pct_of_span"] is not None else ""
+            print(f"{r['op'][:64]:64s} {r['total_ms']:>10.2f} {pct:>7s} {r['count']:>8d}")
+
+
+if __name__ == "__main__":
+    main()
