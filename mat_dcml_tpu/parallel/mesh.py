@@ -42,6 +42,21 @@ def make_mesh(
     return Mesh(arr, axis_names=("data", "model", "seq"))
 
 
+def make_data_seq_mesh(n_seq: int, devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """(data, seq) mesh with seq MINOR: consecutive devices form each ring.
+
+    ``jax.devices()`` orders by process, so with ``n_seq`` dividing the
+    per-process device count every ring stays inside one process — ring
+    collectives ride ICI, never DCN.  This ordering invariant lives here
+    and nowhere else; all data x seq composition sites must build through
+    this helper.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    if n_seq <= 0 or len(devices) % n_seq:
+        raise ValueError(f"n_seq {n_seq} must divide the device count {len(devices)}")
+    return Mesh(np.array(devices).reshape(-1, n_seq), ("data", "seq"))
+
+
 def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 

@@ -56,7 +56,21 @@ def seq_sharded_call(model, params, mesh: Mesh, method, n_out: int, *args,
         args = tuple(
             jax.numpy.pad(a, ((0, 0), (0, pad), (0, 0))) for a in args
         )
-    row = P(None, axis, None)
+    # Composition with data parallelism: on a 2D (data, seq) mesh the batch
+    # axis shards over "data" while agents ring over "seq" — one mesh, one
+    # shard_map, so the enclosing jit's data-sharded inputs never fight a
+    # second device placement (the ADVICE r2 conflict this used to forbid).
+    batch_axis = None
+    if "data" in mesh.axis_names and mesh.shape["data"] > 1:
+        batch_axis = "data"
+        B = args[0].shape[0]
+        if B % mesh.shape["data"]:
+            raise ValueError(
+                f"batch {B} not divisible by the mesh data axis "
+                f"({mesh.shape['data']}); choose n_rollout_threads / "
+                f"num_mini_batch so minibatch rows divide the data shards"
+            )
+    row = P(batch_axis, axis, None)
     replicated = jax.tree.map(lambda _: P(), params)
     out_specs = row if n_out == 1 else tuple([row] * n_out)
 

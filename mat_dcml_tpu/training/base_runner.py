@@ -49,18 +49,24 @@ def apply_seq_shards(run: RunConfig, policy) -> None:
         )
     from jax.sharding import Mesh
 
-    # Composition with a >1-device data-sharded placement (multi-process SPMD,
-    # parallel/distributed.py) is not supported yet: the seq mesh claims local
-    # devices the data placement also owns, and the two jits would fight over
-    # input shardings (ADVICE r2).  Fail at startup, not mid-first-update.
     if jax.process_count() > 1:
+        # The data x seq composition exists at library level — one global
+        # (data, seq) mesh via parallel.mesh.make_data_seq_mesh, batch over
+        # processes and agents ringing intra-process, pinned by
+        # tests/test_multihost.py::test_two_process_data_seq_mesh — but THIS
+        # runner builds its program state host-locally (BaseRunner.setup),
+        # so a process-spanning shard_map here would die mid-first-update on
+        # non-addressable inputs.  Until the runner constructs state through
+        # parallel.distributed.global_init_state, fail at startup with the
+        # supported route spelled out.
         raise NotImplementedError(
-            "--seq_shards cannot be combined with multi-process data "
-            "parallelism yet; run seq-sharding single-process or drop it"
+            "--seq_shards under multi-process training needs global-array "
+            "program state; build the loop on parallel.mesh.make_data_seq_mesh "
+            "+ parallel.distributed.global_init_state (see "
+            "tests/_mp_common.run_sharded_training) — the CLI runner does "
+            "not wire this yet"
         )
 
-    # local_devices: on a multi-process backend each process shards its own
-    # addressable devices (a global-list mesh would be non-addressable)
     devs = jax.local_devices()
     if len(devs) < run.seq_shards:
         raise ValueError(

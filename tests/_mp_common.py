@@ -29,8 +29,20 @@ def build_mesh_from(devices) -> Mesh:
     return Mesh(np.array(devices).reshape(len(devices)), ("data",))
 
 
-def run_sharded_training(mesh: Mesh) -> dict:
-    """Fixed-seed collect+train loop on ``mesh``; returns comparable scalars."""
+def build_mesh_2d(devices, n_seq: int) -> Mesh:
+    """(data, seq) mesh via the canonical seq-minor constructor."""
+    from mat_dcml_tpu.parallel.mesh import make_data_seq_mesh
+
+    return make_data_seq_mesh(n_seq, devices)
+
+
+def run_sharded_training(mesh: Mesh, seq: bool = False) -> dict:
+    """Fixed-seed collect+train loop on ``mesh``; returns comparable scalars.
+
+    ``seq=True`` additionally ring-shards the PPO update's agent axis over
+    the mesh's ``seq`` axis (the data x seq composition) — numerics must be
+    unchanged, which is exactly what the callers assert.
+    """
     env = MatchingEnv(MatchingEnvConfig(n_agents=3, n_actions=4, horizon=5))
     cfg = MATConfig(
         n_agent=env.n_agents, obs_dim=env.obs_dim, state_dim=env.share_obs_dim,
@@ -38,6 +50,9 @@ def run_sharded_training(mesh: Mesh) -> dict:
         action_type=DISCRETE,
     )
     policy = TransformerPolicy(cfg)
+    if seq:
+        assert "seq" in mesh.axis_names, "seq=True needs a (data, seq) mesh"
+        policy.seq_mesh = mesh
     trainer = MATTrainer(policy, PPOConfig(ppo_epoch=2, num_mini_batch=2))
     collector = RolloutCollector(env, policy, T)
 

@@ -31,9 +31,15 @@ def main() -> None:
     )
     assert len(jax.local_devices()) == 4
 
-    from _mp_common import build_mesh_from, run_sharded_training
+    from _mp_common import build_mesh_2d, build_mesh_from, run_sharded_training
 
-    result = run_sharded_training(build_mesh_from(jax.devices()))
+    seq = len(sys.argv) > 4 and sys.argv[4] == "seq"
+    if seq:
+        # data x seq composition across processes: batch over `data` (spanning
+        # both processes), agents ringing over `seq` (2 local devices each)
+        result = run_sharded_training(build_mesh_2d(jax.devices(), 2), seq=True)
+    else:
+        result = run_sharded_training(build_mesh_from(jax.devices()))
     result["process_id"] = pid
     result["is_primary"] = is_primary()
     result["n_global_devices"] = len(jax.devices())

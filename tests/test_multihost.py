@@ -28,7 +28,7 @@ import numpy as np
 import pytest
 import jax
 
-from tests._mp_common import build_mesh_from, run_sharded_training
+from tests._mp_common import build_mesh_2d, build_mesh_from, run_sharded_training
 
 REPO = Path(__file__).resolve().parent.parent
 
@@ -57,8 +57,7 @@ def test_sharded_matches_single_device():
     )
 
 
-@pytest.mark.slow
-def test_two_process_cpu_mesh():
+def _run_two_process(extra_args=()):
     port = _free_port()
     env = dict(os.environ)
     env.pop("XLA_FLAGS", None)                  # worker sets its own 4-device flag
@@ -66,7 +65,7 @@ def test_two_process_cpu_mesh():
     worker = str(REPO / "tests" / "mp_worker.py")
     procs = [
         subprocess.Popen(
-            [sys.executable, worker, str(pid), "2", f"127.0.0.1:{port}"],
+            [sys.executable, worker, str(pid), "2", f"127.0.0.1:{port}", *extra_args],
             stdout=subprocess.PIPE, stderr=subprocess.PIPE, env=env, text=True,
         )
         for pid in range(2)
@@ -76,8 +75,45 @@ def test_two_process_cpu_mesh():
         out, err = p.communicate(timeout=600)
         assert p.returncode == 0, f"worker failed:\n{err[-3000:]}"
         outs.append(json.loads(out.strip().splitlines()[-1]))
+    return sorted(outs, key=lambda r: r["process_id"])
 
-    a, b = sorted(outs, key=lambda r: r["process_id"])
+
+@pytest.mark.slow
+def test_data_seq_composition_single_process():
+    """(data=2, seq=4) on one process: batch sharded over data while agents
+    (3, padded to 4) ring over seq — must match the 1-device run exactly."""
+    devices = jax.devices()
+    assert len(devices) >= 8
+    composed = run_sharded_training(build_mesh_2d(devices[:8], 4), seq=True)
+    single = run_sharded_training(build_mesh_from(devices[:1]))
+    np.testing.assert_allclose(composed["param_l1"], single["param_l1"], rtol=1e-4)
+    np.testing.assert_allclose(composed["value_loss"], single["value_loss"], rtol=1e-3)
+    np.testing.assert_allclose(
+        composed["value_norm_sums"], single["value_norm_sums"], rtol=1e-4
+    )
+
+
+@pytest.mark.slow
+def test_two_process_data_seq_mesh():
+    """The full multi-host composition: 2 processes x 4 local devices as a
+    (data=4, seq=2) global mesh — batch spanning processes over `data`,
+    agent rings intra-process over `seq`.  Both processes must agree, and
+    the math must match the plain single-process run."""
+    a, b = _run_two_process(("seq",))
+    assert a["n_global_devices"] == b["n_global_devices"] == 8
+    assert a["param_l1"] == b["param_l1"]
+    assert a["value_loss"] == b["value_loss"]
+    local = run_sharded_training(build_mesh_from(jax.devices()[:1]))
+    np.testing.assert_allclose(a["param_l1"], local["param_l1"], rtol=1e-4)
+    np.testing.assert_allclose(a["value_loss"], local["value_loss"], rtol=1e-3)
+    np.testing.assert_allclose(
+        a["value_norm_sums"], local["value_norm_sums"], rtol=1e-4
+    )
+
+
+@pytest.mark.slow
+def test_two_process_cpu_mesh():
+    a, b = _run_two_process()
     assert a["n_global_devices"] == b["n_global_devices"] == 8
     assert a["is_primary"] and not b["is_primary"]
     # both processes of one SPMD program must agree exactly
