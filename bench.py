@@ -197,7 +197,7 @@ def _build(jax, E: int, T: int, remat: bool = False, accum: int = 1):
 
         step = jax.jit(_scanned)
         log(f"BENCH_INNER={inner}: each dispatch runs {inner} train iterations")
-    return collect, train, step, inner, train_state, rollout_state
+    return collect, train, step, inner, train_state, rollout_state, ppo
 
 
 def _measure(jax, E: int, T: int, iters: int, profile_dir: str | None = None,
@@ -205,7 +205,7 @@ def _measure(jax, E: int, T: int, iters: int, profile_dir: str | None = None,
              remat: bool = False, accum: int = 1) -> dict:
     """Compile + time `iters` full collect+train iterations at batch E."""
     t0 = time.perf_counter()
-    collect, train, step, inner, train_state, rollout_state = _build(
+    collect, train, step, inner, train_state, rollout_state, ppo = _build(
         jax, E, T, remat=remat, accum=accum)
     log(f"E={E}: built in {time.perf_counter() - t0:.1f}s, compiling...")
 
@@ -265,11 +265,21 @@ def _measure(jax, E: int, T: int, iters: int, profile_dir: str | None = None,
         rollout_state, traj = collect_c(train_state.params, rollout_state)
         jax.block_until_ready(traj)
         train_args = (train_state, traj, rollout_state, jax.random.key(0))
+        # XLA's cost_analysis counts each lax.scan BODY once (verified: the
+        # body-once flop count x trip count reproduces the analytic matmul
+        # total), so scale by the known trip counts from the ppo config the
+        # trainer was actually built with: collect scans T env steps, train
+        # scans epochs x minibatches (x accum chunks).  Caveat: the
+        # per-EPOCH returns recompute (ppo.py compute_targets, runs
+        # epochs-many times, not epochs*minibatches) gets overscaled by
+        # ~num_mini_batch x, so train flops/bytes are an upper bound by
+        # roughly +25%% at defaults — read the roofline directionally.
+        _ppo_trips = ppo.ppo_epoch * ppo.num_mini_batch * max(1, ppo.grad_accum_steps)
         phases = {
-            "collect": (collect_c, (train_state.params, rollout_state)),
-            "train": (train.lower(*train_args).compile(), train_args),
+            "collect": (collect_c, (train_state.params, rollout_state), T),
+            "train": (train.lower(*train_args).compile(), train_args, _ppo_trips),
         }
-        for name, (compiled, args) in phases.items():
+        for name, (compiled, args, trips) in phases.items():
             jax.block_until_ready(compiled(*args))        # warm-up execution
             t0 = time.perf_counter()
             for _ in range(iters):
@@ -278,7 +288,7 @@ def _measure(jax, E: int, T: int, iters: int, profile_dir: str | None = None,
             dt = (time.perf_counter() - t0) / iters
             result[f"{name}_sec"] = dt
             log(f"E={E}: {name} {dt:.3f}s/iter")
-            _roofline(jax, result, E, name, compiled)
+            _roofline(jax, result, E, name, compiled, trips)
         _breakdown_mfu(jax, result, E, T)
     return result
 
@@ -299,18 +309,22 @@ def _chip_specs(jax):
     return kind, peak, bw
 
 
-def _roofline(jax, result: dict, E: int, name: str, compiled) -> None:
+def _roofline(jax, result: dict, E: int, name: str, compiled, trips: int = 1) -> None:
     """Annotate one phase with XLA's static cost analysis and a roofline
-    estimate.  ``cost_analysis()`` reports the compiled executable's total
-    flops and bytes accessed; roofline time = max(flops/peak, bytes/bw) says
-    whether the phase is compute- or HBM-bound and how far the measured time
-    sits above the ceiling — the analytic `_model_flops_per_env_step` counts
-    only matmuls, so XLA's numbers also catch elementwise/copy overheads."""
+    estimate.  ``cost_analysis()`` reports the compiled executable's flops
+    and bytes accessed counting each lax.scan body ONCE — ``trips`` scales
+    by the scan trip count the caller knows.  Roofline time =
+    max(flops/peak, bytes/bw) says whether the phase is compute- or
+    HBM-bound and how far the measured time sits above the ceiling — the
+    analytic `_model_flops_per_env_step` counts only matmuls, so XLA's
+    numbers also catch elementwise/copy overheads.  Bytes are pre-fusion
+    op-level sums, i.e. an upper bound on real HBM traffic; read the
+    measured/roofline ratio directionally, not as an exact MFU."""
     _, peak, bw = _chip_specs(jax)
     try:
         ca = compiled.cost_analysis()
-        flops = float(ca.get("flops", 0.0))
-        byts = float(ca.get("bytes accessed", 0.0))
+        flops = float(ca.get("flops", 0.0)) * trips
+        byts = float(ca.get("bytes accessed", 0.0)) * trips
     except Exception as e:  # cost analysis is best-effort diagnostics
         log(f"E={E}: {name} cost_analysis unavailable: {e}")
         return
