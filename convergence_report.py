@@ -77,6 +77,32 @@ def main(argv):
     print(summarize("reference (momat_payment.csv)", b_pay_steps, b_pay))
     print(summarize("this run", steps, pay))
 
+    # step-aligned table: both channels at shared checkpoints, reference
+    # values linearly interpolated onto the run's step axis (smoothed over a
+    # +/-1-checkpoint window on our side to match TensorBoard's row spacing)
+    if len(steps) >= 3:
+        print("== Step-aligned comparison (ours / reference)")
+        print(f"  {'steps':>8s} {'ct ours':>9s} {'ct ref':>9s} {'pay ours':>9s} {'pay ref':>9s}")
+        grid = [s for s in (10_000, 25_000, 50_000, 100_000, 200_000, 400_000,
+                            600_000, 800_000, 1_000_000) if s <= steps[-1]]
+        if steps[-1] not in grid:
+            grid.append(int(steps[-1]))
+        for s in grid:
+            i = int(np.argmin(np.abs(steps - s)))
+            if abs(steps[i] - s) > 0.5 * s:
+                # nearest logged checkpoint is too far to label as step s
+                # (sparse logging early in a run)
+                continue
+            lo, hi = max(0, i - 1), min(len(steps), i + 2)
+            o_ct, o_pay = ct[lo:hi].mean(), pay[lo:hi].mean()
+
+            def ref_at(xs, ys):
+                # never extrapolate past the reference export's last row
+                return f"{float(np.interp(s, xs, ys)):>9.3f}" if s <= xs[-1] else f"{'n/a':>9s}"
+
+            print(f"  {s:>8d} {o_ct:>9.3f} {ref_at(b_ct_steps, b_ct)} "
+                  f"{o_pay:>9.3f} {ref_at(b_pay_steps, b_pay)}")
+
     td3_path = Path(__file__).parent / "data" / "dcml_td3.txt"
     if td3_path.exists():
         td3 = np.load(td3_path, allow_pickle=False).reshape(-1)
