@@ -13,11 +13,20 @@ steps="${1:-1000000}"
 seed="${2:-1}"
 cd "$(dirname "$0")/.."
 
-for algo in momat mat; do
-  echo "=== $algo: $steps env steps (reference recipe) ==="
-  python train_dcml.py --algorithm_name "$algo" --experiment_name "conv_r3" \
+# Three legs: momat under BOTH scalarization weightings (the reference's
+# missing trainer makes its weighting unrecoverable — the equal-weights run
+# dominates the reference's completion-time channel, the payment-weighted
+# "1,9" run chases its payment channel; BENCHLOG "MO-norm fix validation"),
+# then scalar mat vs the TD3 anchor.
+run_leg() {
+  local algo="$1" exp="$2"; shift 2
+  echo "=== $algo/$exp: $steps env steps (reference recipe) ==="
+  python train_dcml.py --algorithm_name "$algo" --experiment_name "$exp" \
     --seed "$seed" --n_rollout_threads 8 --num_env_steps "$steps" \
     --episode_length 50 --lr 5e-5 --ppo_epoch 15 --num_mini_batch 4 \
-    --log_interval 25
-  python convergence_report.py "results/DCML/AS/$algo/conv_r3/metrics.jsonl" || true
-done
+    --log_interval 25 "$@"
+  python convergence_report.py "results/DCML/AS/$algo/$exp/metrics.jsonl" || true
+}
+run_leg momat conv_r3
+run_leg momat conv_r3_w19 --objective_weights 1,9
+run_leg mat conv_r3
