@@ -6,10 +6,25 @@ Measures the full training loop — on-device rollout (autoregressive MAT decode
 runs at ≈7.3 env-steps/s total throughput (BASELINE.md: wall-clock between
 TensorBoard rows of the shipped training curve, ``momat_ct.csv``).
 
-Prints ONE json line on stdout: {"metric", "value", "unit", "vs_baseline"}.
-All progress/diagnostics go to stderr so machine consumers can parse stdout.
+Prints json lines on stdout; the LAST line is the number of record
+{"metric", "value", "unit", "vs_baseline"}.  All progress/diagnostics go to
+stderr so machine consumers can parse stdout.
+
+Deadline-aware orchestration (the default; VERDICT r3 item 1): the round-3
+bench of record was rc=124/parsed-null because the TPU probe + cold CPU
+fallback together outlived the driver's timeout.  Now the top-level process
+first runs a tiny CPU liveness leg in a subprocess (E=8, T=8, 1 iter — warm
+.jax_cache makes this seconds) and prints its line immediately, THEN probes
+the TPU and runs the full bench under the remaining BENCH_DEADLINE budget,
+overwriting the provisional line only if a chip number lands in time.  A
+driver kill at any point still finds a parseable line on stdout.  Session
+scripts that manage their own chip discipline bypass orchestration with
+BENCH_DIRECT=1 (BENCH_TPU_PROBE_TIMEOUT=0 implies it for legacy scripts).
 
 Knobs (environment variables):
+  BENCH_DEADLINE        total wall budget in seconds for the orchestrated
+                        run (default 1500 — well under the driver timeout)
+  BENCH_DIRECT          "1" → skip orchestration, measure in-process
   BENCH_N_ENVS          rollout batch E (default 2048 — TPU-sized)
   BENCH_EPISODE_LENGTH  T (default 50, the reference recipe)
   BENCH_ITERS           timed iterations (default 3)
@@ -79,19 +94,41 @@ def _probe_tpu(timeout_s: int) -> bool:
         stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
         start_new_session=True,
     )
-    try:
-        out, _ = proc.communicate(timeout=timeout_s)
-        return proc.returncode == 0 and "ok" in (out or "")
-    except subprocess.TimeoutExpired:
+    out, timed_out = _communicate_with_group_kill(proc, timeout_s)
+    return not timed_out and proc.returncode == 0 and "ok" in (out or "")
+
+
+def _communicate_with_group_kill(proc, timeout_s: float) -> tuple:
+    """``proc.communicate`` with the wedge-drain pattern shared by the probe
+    and orchestration children: on timeout (or the caller being interrupted)
+    SIGKILL the child's whole process GROUP — run()'s single-child kill can
+    block forever when a wedged helper holds the stdout pipe — then drain
+    whatever the child printed before wedging.  Returns ``(out, timed_out)``."""
+    import signal
+    import subprocess
+
+    def _kill_group():
         try:
             os.killpg(proc.pid, signal.SIGKILL)
         except Exception:
             pass
+
+    try:
+        out, _ = proc.communicate(timeout=timeout_s)
+        return out, False
+    except subprocess.TimeoutExpired:
+        _kill_group()
         try:
-            proc.communicate(timeout=10)
+            out, _ = proc.communicate(timeout=10)
         except Exception:
-            pass
-        return False
+            out = ""
+        return out, True
+    except BaseException:
+        # Ctrl-C etc.: the child is in its own session and never sees the
+        # terminal SIGINT — without this it would keep holding the single-
+        # client TPU tunnel after the parent dies
+        _kill_group()
+        raise
 
 
 def _setup_jax():
@@ -269,11 +306,16 @@ def _measure(jax, E: int, T: int, iters: int, profile_dir: str | None = None,
         # body-once flop count x trip count reproduces the analytic matmul
         # total), so scale by the known trip counts from the ppo config the
         # trainer was actually built with: collect scans T env steps, train
-        # scans epochs x minibatches (x accum chunks).  Caveat: the
-        # per-EPOCH returns recompute (ppo.py compute_targets, runs
-        # epochs-many times, not epochs*minibatches) gets overscaled by
-        # ~num_mini_batch x, so train flops/bytes are an upper bound by
-        # roughly +25%% at defaults — read the roofline directionally.
+        # scans epochs x minibatches (x accum chunks).  Caveats, both
+        # directions: (a) the per-EPOCH returns recompute (ppo.py
+        # compute_targets, runs epochs-many times, not epochs*minibatches)
+        # gets overscaled by ~num_mini_batch x, so train flops/bytes are an
+        # upper bound by roughly +25% at defaults; (b) the single-level trip
+        # scaling misses collect's NESTED scan — on the XLA decode path each
+        # env step's body itself scans ~A=101 decode positions, so collect
+        # flops/bytes are an UNDER-count by up to ~A x there (the fused
+        # Pallas decode path has no inner scan, so it is unaffected).  Read
+        # both rooflines directionally, not as exact MFU.
         _ppo_trips = ppo.ppo_epoch * ppo.num_mini_batch * max(1, ppo.grad_accum_steps)
         phases = {
             "collect": (collect_c, (train_state.params, rollout_state), T),
@@ -441,7 +483,134 @@ def _oom_backoff(remat: bool, accum: int, E: int, T: int,
     return None
 
 
+_CHILD = None  # current orchestration subprocess, for SIGTERM cleanup
+
+
+def _run_child(overrides: dict, timeout_s: float) -> dict | None:
+    """Run bench.py in direct mode as a subprocess; return its last JSON
+    stdout line, or None on timeout/crash/no-output.  stderr passes through
+    so the driver tail keeps the diagnostics."""
+    import subprocess
+
+    global _CHILD
+    if timeout_s <= 0:
+        return None
+    env = dict(os.environ)
+    env.update(overrides)
+    env["BENCH_DIRECT"] = "1"
+    # unbuffered child stdout: the r3 outage mode is a hang in teardown AFTER
+    # the record line was printed — block-buffered, SIGKILL would discard it
+    env["PYTHONUNBUFFERED"] = "1"
+    log(f"child leg ({overrides}) budget {timeout_s:.0f}s")
+    proc = subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__)],
+        stdout=subprocess.PIPE, stderr=sys.stderr, text=True,
+        start_new_session=True, env=env,
+    )
+    _CHILD = proc
+    try:
+        out, timed_out = _communicate_with_group_kill(proc, timeout_s)
+    finally:
+        _CHILD = None
+    if timed_out:
+        log("child leg timed out")
+    if not timed_out and proc.returncode != 0:
+        log(f"child leg exited rc={proc.returncode}")
+        return None
+    for line in reversed((out or "").strip().splitlines()):
+        try:
+            return json.loads(line)
+        except ValueError:
+            continue
+    return None
+
+
+def _orchestrate() -> None:
+    """Liveness line first, then the best number the deadline allows."""
+    import signal
+
+    def _cleanup(signum, frame):
+        if _CHILD is not None:
+            try:
+                os.killpg(_CHILD.pid, signal.SIGKILL)
+            except Exception:
+                pass
+        # a provisional line may already be on stdout; exit quietly
+        raise SystemExit(0)
+
+    signal.signal(signal.SIGTERM, _cleanup)
+
+    t0 = time.monotonic()
+    deadline = float(os.environ.get("BENCH_DEADLINE", "1500"))
+
+    def remaining() -> float:
+        return deadline - (time.monotonic() - t0)
+
+    # Phase A — provisional CPU liveness line, printed IMMEDIATELY on success
+    live = _run_child(
+        {"JAX_PLATFORMS": "cpu", "BENCH_N_ENVS": "8",
+         "BENCH_EPISODE_LENGTH": "8", "BENCH_ITERS": "1",
+         "BENCH_BREAKDOWN": "0", "BENCH_PROFILE_DIR": "", "BENCH_SWEEP": "0"},
+        min(600.0, max(60.0, remaining() * 0.4)),
+    )
+    if live is not None:
+        live["provisional"] = True
+        print(json.dumps(live), flush=True)
+    else:
+        log("liveness leg produced no line; continuing to the main legs")
+
+    # Phase B — the real measurement on whatever platform the budget allows
+    if os.environ.get("JAX_PLATFORMS", "") != "cpu":
+        # probe budget derives from the deadline (raising BENCH_DEADLINE
+        # lengthens the wait — grants have been served at ~1500s into the
+        # claim queue); an explicit BENCH_TPU_PROBE_TIMEOUT can only lower it
+        probe_t = remaining() - 240.0
+        user_cap = os.environ.get("BENCH_TPU_PROBE_TIMEOUT", "")
+        if user_cap:
+            probe_t = min(probe_t, float(user_cap))
+        if probe_t > 30 and _probe_tpu(int(probe_t)):
+            res = _run_child({"BENCH_TPU_PROBE_TIMEOUT": "0"}, remaining() - 30.0)
+            if res is not None:
+                # a child that itself fell back to CPU already produced the
+                # shrunk floor measurement — print it rather than recompute
+                print(json.dumps(res), flush=True)
+                return
+            log("TPU leg failed; falling through to the CPU leg")
+        else:
+            log("TPU probe failed or no budget; falling through to the CPU leg")
+
+    # CPU floor (the r2 record, 8.15 env-steps/s at E=32): only worth running
+    # if the budget still covers a cold compile.  Knobs the caller set
+    # explicitly are honored (and can exceed the deadline — the leg is then
+    # killed at the budget and the liveness line stands); unset ones get
+    # bounded floor defaults.
+    if (remaining() > 240 and live is None) or remaining() > 400:
+        overrides = {"JAX_PLATFORMS": "cpu"}
+        for knob, floor_default in (("BENCH_N_ENVS", "32"),
+                                    ("BENCH_ITERS", "2"),
+                                    ("BENCH_SWEEP", "0")):
+            if knob not in os.environ:
+                overrides[knob] = floor_default
+            else:
+                log(f"CPU floor leg: honoring explicit {knob}={os.environ[knob]}")
+        res = _run_child(overrides, remaining() - 30.0)
+        if res is not None:
+            print(json.dumps(res), flush=True)
+
+
 def main() -> None:
+    # Orchestrated (deadline-aware) unless the caller manages the chip
+    # itself: BENCH_DIRECT=1, or the legacy session-script signal
+    # BENCH_TPU_PROBE_TIMEOUT=0, or an explicit BENCH_DEADLINE=0.
+    direct = (
+        os.environ.get("BENCH_DIRECT", "0") == "1"
+        or os.environ.get("BENCH_TPU_PROBE_TIMEOUT", "") == "0"
+        or os.environ.get("BENCH_DEADLINE", "") == "0"
+    )
+    if not direct:
+        _orchestrate()
+        return
+
     # Default batch: measured best on the driver's chip (TPU v5-lite, 16G
     # HBM): E=256 gives 2561 env-steps/s vs 2472 at E=512 (E-sweep
     # 2026-07-30; see BENCHLOG.md) — throughput plateaus because the
@@ -521,7 +690,8 @@ def main() -> None:
                 "platform": dev.platform,
                 "device": dev.device_kind,
             }
-        )
+        ),
+        flush=True,  # a teardown wedge after this point must not eat the line
     )
 
 

@@ -97,8 +97,11 @@ def main(argv):
             o_ct, o_pay = ct[lo:hi].mean(), pay[lo:hi].mean()
 
             def ref_at(xs, ys):
-                # never extrapolate past the reference export's last row
-                return f"{float(np.interp(s, xs, ys)):>9.3f}" if s <= xs[-1] else f"{'n/a':>9s}"
+                # never extrapolate outside the reference export's logged
+                # range: np.interp clamps at BOTH edges
+                if s < xs[0] or s > xs[-1]:
+                    return f"{'n/a':>9s}"
+                return f"{float(np.interp(s, xs, ys)):>9.3f}"
 
             print(f"  {s:>8d} {o_ct:>9.3f} {ref_at(b_ct_steps, b_ct)} "
                   f"{o_pay:>9.3f} {ref_at(b_pay_steps, b_pay)}")

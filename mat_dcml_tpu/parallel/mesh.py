@@ -54,6 +54,18 @@ def make_data_seq_mesh(n_seq: int, devices: Optional[Sequence[jax.Device]] = Non
     devices = list(devices if devices is not None else jax.devices())
     if n_seq <= 0 or len(devices) % n_seq:
         raise ValueError(f"n_seq {n_seq} must divide the device count {len(devices)}")
+    # enforce the placement invariant itself, not a proxy: every ring
+    # (consecutive n_seq block) must sit inside one process, or its
+    # collectives silently ride DCN instead of ICI
+    for ring_start in range(0, len(devices), n_seq):
+        ring = devices[ring_start:ring_start + n_seq]
+        procs = {d.process_index for d in ring}
+        if len(procs) > 1:
+            raise ValueError(
+                f"seq ring {ring_start // n_seq} spans processes {sorted(procs)} "
+                f"(ICI -> DCN); pick n_seq dividing the per-process device "
+                f"count or reorder the device list"
+            )
     return Mesh(np.array(devices).reshape(-1, n_seq), ("data", "seq"))
 
 
