@@ -17,6 +17,7 @@ from __future__ import annotations
 import numpy as np
 
 from mat_dcml_tpu.envs.mamujoco.obsk import build_obs_indices, get_parts_and_edges
+from mat_dcml_tpu.envs.spaces import Box
 
 
 class MujocoMultiHostEnv:
@@ -48,6 +49,7 @@ class MujocoMultiHostEnv:
         self.n_agents = len(parts)
         self.joints_per_agent = max(len(p) for p in parts)
         self.action_dim = self.joints_per_agent
+        self.action_space = Box(self.joints_per_agent)   # continuous torques
         self._act_ids = [
             [graph.joints[j].act_id for j in p] for p in parts
         ]

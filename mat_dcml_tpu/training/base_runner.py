@@ -308,7 +308,10 @@ class BaseRunner:
                 self._extra_metrics(record)
                 self._log_record(record)
 
-            if (episode % run.save_interval == 0 or episode == episodes - 1) and self.run_cfg.algorithm_name != "random":
+            should_save = run.save_interval > 0 and (
+                episode % run.save_interval == 0 or episode == episodes - 1
+            )
+            if should_save and self.run_cfg.algorithm_name != "random":
                 self.ckpt.save(episode, train_state)
 
             if run.use_eval and episode % run.eval_interval == 0 and hasattr(self, "evaluate"):

@@ -12,12 +12,15 @@ from __future__ import annotations
 from typing import Optional, Sequence
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from mat_dcml_tpu.config import RunConfig
 from mat_dcml_tpu.envs.mamujoco import FaultyAgentWrapper
-from mat_dcml_tpu.training.generic_runner import GenericRunner
-from mat_dcml_tpu.training.ppo import PPOConfig
+from mat_dcml_tpu.training.base_runner import BaseRunner
+from mat_dcml_tpu.training.generic_runner import GenericRunner, build_discrete_policy
+from mat_dcml_tpu.training.host_rollout import HostRolloutCollector
+from mat_dcml_tpu.training.ppo import MATTrainer, PPOConfig
 
 
 class MujocoRunner(GenericRunner):
@@ -81,3 +84,104 @@ class MujocoRunner(GenericRunner):
             )["eval_average_step_rewards"]
             for n in nodes
         }
+
+
+class _FaultyVecEnv:
+    """Zero one agent's actions at the host-bridge boundary.
+
+    The pure-JAX path compiles :class:`FaultyAgentWrapper` into the env step;
+    host workers cannot be re-wrapped after spawn, but the fault semantics
+    (``faulty_action:13-20``: the node's torques forced to zero) only touch
+    the action tensor — applying them where actions cross to the host is
+    equivalent."""
+
+    def __init__(self, inner, node: int):
+        self._inner = inner
+        self._node = node
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    def step(self, actions):
+        actions = np.array(actions, copy=True)
+        actions[:, self._node] = 0.0
+        return self._inner.step(actions)
+
+
+class MujocoHostRunner(BaseRunner):
+    """Real-MuJoCo (gymnasium) training over the host-process bridge.
+
+    The continuous-MAT twin of :class:`FootballRunner`: jitted policy on
+    device, :class:`MujocoMultiHostEnv` workers stepping real physics
+    (``mujoco_multi.py:39-260`` factorization), fault injection at the
+    bridge boundary.
+
+    ``eval_env_fn`` (a zero-arg factory for ONE host env) enables evaluation:
+    eval runs on its own short-lived :class:`ShareDummyVecEnv` fleet — the
+    reference keeps eval envs separate too (``config.py`` n_eval_rollout
+    _threads), and resetting the TRAINING fleet mid-run would desynchronize
+    the collector's held observations from worker state."""
+
+    def __init__(self, run: RunConfig, ppo: PPOConfig, vec_env,
+                 faulty_node: int = -1, eval_env_fn=None, log_fn=print):
+        if run.algorithm_name not in ("mat", "mat_dec"):
+            raise NotImplementedError(
+                "the MuJoCo host runner drives the MAT family; use "
+                "--backend lite for mappo/ippo/happo"
+            )
+        if run.n_rollout_threads != vec_env.n_envs:
+            raise ValueError(
+                f"n_rollout_threads={run.n_rollout_threads} != vec env size "
+                f"{vec_env.n_envs}"
+            )
+        self.env = _FaultyVecEnv(vec_env, faulty_node) if faulty_node >= 0 else vec_env
+        self.eval_env_fn = eval_env_fn
+        self.is_mat = True
+        self.policy = build_discrete_policy(run, vec_env)
+        self.trainer = MATTrainer(self.policy, ppo, total_updates=run.episodes)
+        self.collector = HostRolloutCollector(self.env, self.policy, run.episode_length)
+
+        @jax.jit
+        def _det_act(params, key, share, obs, avail):
+            return self.policy.get_actions(
+                params, key, share, obs, avail, deterministic=True
+            )
+
+        self._det_act = _det_act          # compiled once, reused across evals
+        if eval_env_fn is None and run.use_eval:
+            # BaseRunner's train loop auto-invokes evaluate() when use_eval
+            # is set; without a separate eval fleet that would have to reset
+            # the training workers — refuse up front instead of corrupting
+            raise ValueError(
+                "use_eval with the gym backend needs eval_env_fn (a "
+                "factory for a separate eval env fleet)"
+            )
+        self.finalize(run, log_fn)
+
+    def evaluate(self, train_state, n_steps: int = 200, seed: int = 0,
+                 faulty_node: int = -1, n_envs: int = 2):
+        """Deterministic mean step reward on a FRESH eval fleet."""
+        from mat_dcml_tpu.envs.vec_env import ShareDummyVecEnv
+
+        if self.eval_env_fn is None:
+            raise ValueError("evaluate() needs eval_env_fn (see class docstring)")
+        env = ShareDummyVecEnv([self.eval_env_fn] * n_envs)
+        if faulty_node >= 0:
+            env = _FaultyVecEnv(env, faulty_node)
+        try:
+            obs, share, avail = env.reset()
+            rewards = []
+            for _ in range(n_steps):
+                out = self._det_act(
+                    train_state.params, jax.random.key(seed),
+                    jnp.asarray(share, jnp.float32), jnp.asarray(obs, jnp.float32),
+                    jnp.asarray(avail, jnp.float32),
+                )
+                obs, share, rew, done, infos, avail = env.step(np.asarray(out.action))
+                rewards.append(float(np.mean(rew)))
+        finally:
+            env.close()
+        return {"eval_average_step_rewards": float(np.mean(rewards)),
+                "faulty_node": faulty_node}
+
+    evaluate_faulty_sweep = MujocoRunner.evaluate_faulty_sweep

@@ -170,7 +170,16 @@ class MATTrainer:
         )
         mb_size = n_rows // cfg.num_mini_batch
 
-        flat = jax.tree.map(lambda x: x.reshape(n_rows, *x.shape[2:]), {
+        # Flatten (T, E) -> rows E-MAJOR: under a data-sharded mesh E is the
+        # sharded axis, and merging it as the major axis lets the row sharding
+        # propagate as a relabel — T-major flatten interleaves shards and
+        # forces an [SPMD] involuntary full rematerialization per tensor
+        # (MULTICHIP_r03 tail).  Row ORDER is irrelevant to the math: every
+        # epoch permutes rows before forming minibatches.
+        def flatten_rows(x):
+            return x.swapaxes(0, 1).reshape(n_rows, *x.shape[2:])
+
+        flat = jax.tree.map(flatten_rows, {
             "share_obs": traj.share_obs,
             "obs": traj.obs,
             "available_actions": traj.available_actions,
@@ -209,7 +218,7 @@ class MATTrainer:
             adv_norm = (adv - mean) / (jnp.sqrt(var) + 1e-5)
             if self.n_objective > 1 and not cfg.mo_combined_norm:
                 adv_norm = (adv_norm * w).sum(-1, keepdims=True)
-            return adv_norm.reshape(n_rows, *adv_norm.shape[2:]), returns.reshape(n_rows, *returns.shape[2:])
+            return flatten_rows(adv_norm), flatten_rows(returns)
 
         accum = max(1, cfg.grad_accum_steps)
         assert mb_size % accum == 0, (
@@ -219,6 +228,12 @@ class MATTrainer:
 
         def ppo_update(carry, mb_idx):
             params, opt_state, value_norm, adv_flat, ret_flat = carry
+            # ONE gather per minibatch (the old path re-gathered per accum
+            # chunk); indices-as-xs keeps peak memory at flat + one minibatch
+            # — materializing all permuted minibatches as scan xs would add a
+            # full extra copy of the batch to HBM
+            batch_mb = jax.tree.map(lambda x: x[mb_idx], flat)
+            adv_mb = adv_flat[mb_idx]
             ret_b = ret_flat[mb_idx]
 
             # ValueNorm update precedes normalize (mat_trainer.py:68-71),
@@ -228,15 +243,14 @@ class MATTrainer:
 
             # full-minibatch denominators: per-chunk losses scaled by these
             # sum to the unchunked loss, so accumulated gradients are exact
-            active_full_sum = flat["active_masks"][mb_idx].sum()
+            active_full_sum = batch_mb["active_masks"].sum()
 
-            def loss_fn(params, cidx):
-                batch = jax.tree.map(lambda x: x[cidx], flat)
-                adv_b = adv_flat[cidx]
+            def loss_fn(params, chunk):
+                batch, adv_b, ret_chunk = chunk
                 if cfg.use_valuenorm or cfg.use_popart:
-                    ret_target = value_norm_normalize(value_norm, ret_flat[cidx])
+                    ret_target = value_norm_normalize(value_norm, ret_chunk)
                 else:
-                    ret_target = ret_flat[cidx]
+                    ret_target = ret_chunk
                 values, logp, ent = self.policy.evaluate_actions(
                     params, batch["share_obs"], batch["obs"], batch["actions"], batch["available_actions"]
                 )
@@ -273,11 +287,16 @@ class MATTrainer:
                 aux = (value_loss, policy_loss, entropy, ratio.sum() / (ratio.size * accum))
                 return loss, aux
 
-            idx_chunks = mb_idx.reshape(accum, mb_size // accum)
+            # chunks for gradient accumulation: a leading (accum, chunk_rows)
+            # reshape of the already-contiguous minibatch — no gathers
+            chunks = jax.tree.map(
+                lambda x: x.reshape(accum, mb_size // accum, *x.shape[1:]),
+                (batch_mb, adv_mb, ret_b),
+            )
 
-            def chunk_step(acc, cidx):
+            def chunk_step(acc, chunk):
                 g_acc, aux_acc = acc
-                (_, aux), g = jax.value_and_grad(loss_fn, has_aux=True)(params, cidx)
+                (_, aux), g = jax.value_and_grad(loss_fn, has_aux=True)(params, chunk)
                 acc = (
                     jax.tree.map(jnp.add, g_acc, g),
                     jax.tree.map(jnp.add, aux_acc, aux),
@@ -288,7 +307,7 @@ class MATTrainer:
                 jax.tree.map(jnp.zeros_like, params),
                 tuple(jnp.zeros(()) for _ in range(4)),
             )
-            (grads, aux), _ = jax.lax.scan(chunk_step, zero, idx_chunks)
+            (grads, aux), _ = jax.lax.scan(chunk_step, zero, chunks)
 
             gnorm = optax.global_norm(grads)
             updates, opt_state = self.tx.update(grads, opt_state, params)
@@ -300,6 +319,8 @@ class MATTrainer:
         def run_epoch(carry, key_e, targets):
             params, opt_state, value_norm = carry
             adv_flat, ret_flat = targets if targets is not None else compute_targets(params, value_norm)
+            # Rows past mb_size*num_mini_batch are dropped, as the reference
+            # floors (shared_buffer.py:250-261).
             perm = jax.random.permutation(key_e, n_rows)
             mb_idxs = perm[: mb_size * cfg.num_mini_batch].reshape(cfg.num_mini_batch, mb_size)
             (params, opt_state, value_norm, _, _), metrics = jax.lax.scan(

@@ -43,11 +43,7 @@ def main(argv=None):
     ns.scenario = run.scenario
     run = dataclasses.replace(run, scenario=f"{ns.scenario}_{ns.agent_conf}")
     if ns.backend == "gym":
-        raise SystemExit(
-            "--backend gym needs gymnasium+mujoco (not bundled); wire "
-            "MujocoMultiHostEnv through ShareSubprocVecEnv + "
-            "HostRolloutCollector (envs/mamujoco/env.py docstring)."
-        )
+        return _main_gym(run, ppo, ns)
     env = MJLiteEnv(MJLiteConfig(
         scenario=ns.scenario, agent_conf=ns.agent_conf,
         agent_obsk=ns.agent_obsk, episode_length=run.episode_length,
@@ -63,6 +59,55 @@ def main(argv=None):
         nodes = [int(x) for x in ns.eval_faulty_node.split(",") if x]
         print("faulty sweep:", runner.evaluate_faulty_sweep(
             state, nodes, n_steps=run.episode_length))
+
+
+def _main_gym(run, ppo, ns):
+    """Real MuJoCo through the host bridge (``mujoco_multi.py:39-260``)."""
+    # gate BEFORE forking bridge workers (same reasoning as train_football.py)
+    try:
+        import gymnasium  # noqa: F401
+        import mujoco  # noqa: F401
+    except ImportError as err:
+        raise SystemExit(
+            "--backend gym needs gymnasium + mujoco; use --backend lite for "
+            "the binary-free pure-JAX dynamics"
+        ) from err
+    import re
+
+    from mat_dcml_tpu.envs.mamujoco.env import MujocoMultiHostEnv
+    from mat_dcml_tpu.envs.vec_env import ShareDummyVecEnv, ShareSubprocVecEnv
+    from mat_dcml_tpu.training.mujoco_runner import MujocoHostRunner
+
+    if ns.random_order:
+        raise SystemExit("--random_order is a pure-JAX wrapper; use --backend lite")
+    # the reference pins gym==0.21 robots (HalfCheetah-v2); gymnasium ships
+    # v4/v5 of the same models — map old version suffixes forward
+    scenario = re.sub(r"-v[0-3]$", "-v4", ns.scenario)
+
+    def make_env(i, scenario=scenario, conf=ns.agent_conf, obsk=ns.agent_obsk,
+                 limit=run.episode_length, seed0=run.seed):
+        def thunk():
+            return MujocoMultiHostEnv(
+                scenario, conf, agent_obsk=obsk, episode_limit=limit,
+                seed=seed0 * 1000 + i,
+            )
+        return thunk
+
+    fns = [make_env(i) for i in range(run.n_rollout_threads)]
+    vec = ShareDummyVecEnv(fns) if run.n_rollout_threads == 1 else ShareSubprocVecEnv(fns)
+    runner = MujocoHostRunner(run, ppo, vec, faulty_node=ns.faulty_node,
+                              eval_env_fn=make_env(run.n_rollout_threads))
+    print(f"algorithm={run.algorithm_name} env=mujoco-gym/{scenario}/{ns.agent_conf} "
+          f"agents={vec.n_agents} episodes={run.episodes}")
+    try:
+        state, _ = runner.train_loop()
+        print("eval (healthy):", runner.evaluate(state, n_steps=run.episode_length))
+        if ns.eval_faulty_node:
+            nodes = [int(x) for x in ns.eval_faulty_node.split(",") if x]
+            print("faulty sweep:", runner.evaluate_faulty_sweep(
+                state, nodes, n_steps=run.episode_length))
+    finally:
+        vec.close()
 
 
 if __name__ == "__main__":
