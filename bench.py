@@ -546,12 +546,14 @@ def _orchestrate() -> None:
     def remaining() -> float:
         return deadline - (time.monotonic() - t0)
 
-    # Phase A — provisional CPU liveness line, printed IMMEDIATELY on success
+    # Phase A — provisional CPU liveness line, printed IMMEDIATELY on success.
+    # Budget floor of 240s: a cold compile of even the tiny config needs
+    # ~165s on this box, and a timed-out liveness leg wastes the work
     live = _run_child(
         {"JAX_PLATFORMS": "cpu", "BENCH_N_ENVS": "8",
          "BENCH_EPISODE_LENGTH": "8", "BENCH_ITERS": "1",
          "BENCH_BREAKDOWN": "0", "BENCH_PROFILE_DIR": "", "BENCH_SWEEP": "0"},
-        min(600.0, max(60.0, remaining() * 0.4)),
+        min(600.0, max(240.0, remaining() * 0.4)),
     )
     if live is not None:
         live["provisional"] = True
@@ -579,12 +581,13 @@ def _orchestrate() -> None:
         else:
             log("TPU probe failed or no budget; falling through to the CPU leg")
 
-    # CPU floor (the r2 record, 8.15 env-steps/s at E=32): only worth running
-    # if the budget still covers a cold compile.  Knobs the caller set
-    # explicitly are honored (and can exceed the deadline — the leg is then
-    # killed at the budget and the liveness line stands); unset ones get
-    # bounded floor defaults.
-    if (remaining() > 240 and live is None) or remaining() > 400:
+    # CPU floor (the r2 record, 8.15 env-steps/s at E=32).  When NOTHING has
+    # printed yet, any remaining budget is better spent trying than exiting
+    # silently; with a provisional line down, only run if the budget still
+    # covers a cold compile.  Knobs the caller set explicitly are honored
+    # (and can exceed the deadline — the leg is then killed at the budget
+    # and the liveness line stands); unset ones get bounded floor defaults.
+    if live is None or remaining() > 400:
         overrides = {"JAX_PLATFORMS": "cpu"}
         for knob, floor_default in (("BENCH_N_ENVS", "32"),
                                     ("BENCH_ITERS", "2"),
