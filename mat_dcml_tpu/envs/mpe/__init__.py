@@ -3,7 +3,11 @@ from mat_dcml_tpu.envs.mpe.simple_adversary import (
     SimpleAdversaryEnv,
 )
 from mat_dcml_tpu.envs.mpe.simple_attack import SimpleAttackConfig, SimpleAttackEnv
-from mat_dcml_tpu.envs.mpe.simple_crypto import SimpleCryptoConfig, SimpleCryptoEnv
+from mat_dcml_tpu.envs.mpe.simple_crypto import (
+    SimpleCryptoConfig,
+    SimpleCryptoDisplayEnv,
+    SimpleCryptoEnv,
+)
 from mat_dcml_tpu.envs.mpe.simple_push import SimplePushConfig, SimplePushEnv
 from mat_dcml_tpu.envs.mpe.simple_reference import (
     SimpleReferenceConfig,
@@ -35,6 +39,7 @@ SCENARIOS = {
     "simple_push": (SimplePushEnv, SimplePushConfig),
     "simple_reference": (SimpleReferenceEnv, SimpleReferenceConfig),
     "simple_crypto": (SimpleCryptoEnv, SimpleCryptoConfig),
+    "simple_crypto_display": (SimpleCryptoDisplayEnv, SimpleCryptoConfig),
     "simple_attack": (SimpleAttackEnv, SimpleAttackConfig),
     "simple_world_comm": (SimpleWorldCommEnv, SimpleWorldCommConfig),
 }
@@ -45,6 +50,7 @@ __all__ = [
     "SimpleAttackConfig",
     "SimpleAttackEnv",
     "SimpleCryptoConfig",
+    "SimpleCryptoDisplayEnv",
     "SimpleCryptoEnv",
     "SimplePushConfig",
     "SimplePushEnv",

@@ -33,17 +33,52 @@ CAM_RANGE = 1.4  # world box drawn; MPE viewer uses a similar fixed zoom
 
 
 def is_renderable(env) -> bool:
-    """True when the env's state carries positions (everything but the
-    pure-comm scenarios).  Costs one eager reset of a tiny env."""
+    """True when the env's state carries positions, or the env declares a
+    static display layout (``simple_crypto_display``).  Costs one eager
+    reset of a tiny env."""
     import jax
 
+    if hasattr(env, "display_layout"):
+        return True
     state, _ = env.reset(jax.random.key(0))
     return hasattr(state, "agent_pos")
+
+
+GOAL_LANDMARK = (38, 38, 191)   # simple_crypto_display.py:87 [0.15,0.15,0.75]
+SPEAKER = (64, 191, 64)         # simple_crypto_display.py:52 [0.25,0.75,0.25]
+
+
+def _display_entities(env, state):
+    """Entities for a static-layout scenario (``simple_crypto_display``):
+    fixed spawns, goal landmark highlighted, agents tinted by their latest
+    comm symbol (the headless stand-in for the reference's debug prints)."""
+    agents, landmarks = env.display_layout()
+    goal = int(np.asarray(state.goal))
+    out = [
+        (p, 0.08, GOAL_LANDMARK if i == goal else LANDMARK)
+        for i, p in enumerate(landmarks)
+    ]
+    comm = np.asarray(state.comm)
+    for i, p in enumerate(agents):
+        if getattr(env, "ALICE", None) == i:
+            base = SPEAKER
+        elif i == 0:                       # Eve, the adversary
+            base = ADVERSARY
+        else:
+            base = GOOD
+        # tint toward white by comm-symbol index so utterances animate
+        sym = int(comm[i].argmax()) if comm[i].any() else -1
+        tint = 0.0 if sym < 0 else min(0.15 * (sym + 1), 1.0)   # dim_c can be >6
+        color = tuple(int(c + (255 - c) * tint) for c in base)
+        out.append((p, 0.05, color))
+    return out
 
 
 def _entities(env, state) -> List[Tuple[np.ndarray, float, Tuple[int, int, int]]]:
     """(pos(2,), radius, color) per entity, back-to-front draw order."""
     cfg = env.cfg
+    if hasattr(env, "display_layout"):
+        return _display_entities(env, state)
     if not hasattr(state, "agent_pos"):
         raise TypeError(
             f"{type(state).__name__} has no positions to render "

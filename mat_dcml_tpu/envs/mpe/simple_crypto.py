@@ -139,3 +139,32 @@ class SimpleCryptoEnv:
             obs, share, avail, reward[:, None],
             jnp.broadcast_to(done_now, (3,)), zero, zero,
         )
+
+
+class SimpleCryptoDisplayEnv(SimpleCryptoEnv):
+    """``simple_crypto_display`` — the demo/visualization variant.
+
+    Reference: ``mat_src/mat/envs/mpe/scenarios/simple_crypto_display.py``.
+    Its diff vs ``simple_crypto`` is entirely presentational: agents spawn on
+    a fixed vertical line at x=0, landmarks on a fixed column at x=0.5, the
+    goal landmark is highlighted blue, the speaker green, and debug prints
+    are enabled — the signalling game itself (rewards, observations, comm)
+    is IDENTICAL math (positions never enter either scenario's observations;
+    the ``channel``/``color`` attribute rename carries the same one-hot).
+    Here the fixed layout feeds the headless renderer (``render.py``)
+    instead of stdout prints: agents are drawn at the reference's
+    deterministic positions, tinted by their latest comm symbol."""
+
+    def display_layout(self):
+        """Static (agent_pos (3, 2), landmark_pos (n_landmarks, 2)) — the
+        reference's fixed spawns (``simple_crypto_display.py:71-81``)."""
+        import numpy as np
+
+        n, nl = self.n_agents, self.cfg.n_landmarks
+        agents = np.stack([
+            np.array([0.0, -0.5 + 1.0 / (n - 1) * i]) for i in range(n)
+        ])
+        landmarks = np.stack([
+            np.array([0.5, 0.5 - 0.5 / max(nl - 1, 1) * i]) for i in range(nl)
+        ])
+        return agents, landmarks

@@ -45,3 +45,32 @@ def test_save_gif(tmp_path):
     out = tmp_path / "ep.gif"
     save_gif(frames, str(out))
     assert out.exists() and out.stat().st_size > 100
+
+
+def test_crypto_display_renders_static_layout():
+    """simple_crypto_display: identical game math to simple_crypto, plus the
+    reference's fixed demo layout feeding the renderer
+    (simple_crypto_display.py:71-87)."""
+    from mat_dcml_tpu.envs.mpe import SimpleCryptoConfig, SimpleCryptoDisplayEnv, SimpleCryptoEnv
+    from mat_dcml_tpu.envs.mpe.render import is_renderable
+
+    cfg = SimpleCryptoConfig()
+    disp = SimpleCryptoDisplayEnv(cfg)
+    base = SimpleCryptoEnv(cfg)
+    assert is_renderable(disp) and not is_renderable(base)
+
+    # dynamics are bit-identical to simple_crypto under the same key/actions
+    k = jax.random.key(3)
+    sd, td = disp.reset(k)
+    sb, tb = base.reset(k)
+    act = jax.numpy.array([[1.0], [2.0], [3.0]])
+    sd, td = disp.step(sd, act)
+    sb, tb = base.step(sb, act)
+    np.testing.assert_array_equal(np.asarray(td.reward), np.asarray(tb.reward))
+    np.testing.assert_array_equal(np.asarray(td.obs), np.asarray(tb.obs))
+
+    frame = render_frame(disp, sd, size=96)
+    assert frame.shape == (96, 96, 3)
+    from mat_dcml_tpu.envs.mpe.render import GOAL_LANDMARK
+    colors = {tuple(c) for c in frame.reshape(-1, 3)}
+    assert GOAL_LANDMARK in colors          # highlighted goal landmark drawn
