@@ -27,6 +27,6 @@ run_leg() {
     --log_interval 25 "$@"
   python convergence_report.py "results/DCML/AS/$algo/$exp/metrics.jsonl" || true
 }
-run_leg momat conv_r3
-run_leg momat conv_r3_w19 --objective_weights 1,9
-run_leg mat conv_r3
+run_leg momat conv_r4
+run_leg momat conv_r4_w19 --objective_weights 1,9
+run_leg mat conv_r4

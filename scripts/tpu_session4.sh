@@ -1,36 +1,38 @@
 #!/bin/bash
 # Round-4 chip session (VERDICT r3 "Next round" items 2-5).
-# Priority: convergence evidence first (item 3 — the artifact that needs
-# hours), then the measurement legs (items 2, 4, 5).  One TPU client at a
-# time; this script assumes the caller (tpu_retry_session4.sh) verified a
+#
+# Ordering rationale (differs from the r3 plan): the r3 outage granted ONE
+# ~25-minute window, which the session burned before reaching its
+# measurement legs.  The short legs (collect decomposition, decode A/B,
+# combined A/B + trace, attention A/B — ~30 min total) close VERDICT items
+# 2/4/5 and run FIRST; the E-ladder follows; the convergence legs (hours,
+# and already covered by the round-4 CPU insurance run in
+# artifacts/r4/conv_cpu_w19.log) run LAST so a short grant still produces
+# the numbers that have been plans for two rounds.
+# One TPU client at a time; the caller (tpu_retry_session4.sh) verified a
 # healthy grant.
 set -x
 cd "$(dirname "$0")/.."
 mkdir -p artifacts/r4
 export BENCH_TPU_PROBE_TIMEOUT=0
-export MAT_DCML_TPU_DECODE_IMPL=xla   # measured r3 winner; leg 4 re-checks
+export MAT_DCML_TPU_DECODE_IMPL=xla   # measured r3 winner; leg 2 re-checks
 
-echo "=== 1. convergence runs (reference recipe, full budget) ==="
-timeout 16000 bash scripts/tpu_convergence.sh 1000000 1 \
-  > artifacts/r4/convergence.log 2>&1
-tail -40 artifacts/r4/convergence.log
-
-echo "=== 2. collect decomposition (on-chip effect of the sampler fix) ==="
+echo "=== 1. collect decomposition (on-chip effect of the sampler fix) ==="
 timeout 3000 python scripts/tpu_collect_bench.py 256 \
   > artifacts/r4/collect_bench.json 2> artifacts/r4/collect_bench.log
 cat artifacts/r4/collect_bench.json
 
-echo "=== 3. decode micro-bench: fixed Pallas whole-decode vs XLA scan ==="
+echo "=== 2. decode micro-bench: fixed Pallas whole-decode vs XLA scan ==="
 timeout 3000 python scripts/tpu_decode_bench.py 256 512 \
   > artifacts/r4/decode_bench.json 2> artifacts/r4/decode_bench.log
 cat artifacts/r4/decode_bench.json
 
-echo "=== 4. combined-step A/B at E=256 + op trace ==="
+echo "=== 3. combined-step A/B at E=256 + op trace ==="
 for impl in xla pallas; do
   prof=""
   [ "$impl" = xla ] && prof="artifacts/r4/trace_e256"
   MAT_DCML_TPU_DECODE_IMPL=$impl BENCH_N_ENVS=256 BENCH_ITERS=3 \
-    BENCH_PROFILE_DIR=$prof timeout 3000 python bench.py \
+    BENCH_BREAKDOWN=1 BENCH_PROFILE_DIR=$prof timeout 3000 python bench.py \
     > "artifacts/r4/bench_e256_${impl}.json" 2> "artifacts/r4/bench_e256_${impl}.log"
   cat "artifacts/r4/bench_e256_${impl}.json"
 done
@@ -38,16 +40,21 @@ JAX_PLATFORMS=cpu python scripts/trace_report.py artifacts/r4/trace_e256 40 \
   > artifacts/r4/trace_e256_report.txt 2>&1 || true
 tail -50 artifacts/r4/trace_e256_report.txt
 
+echo "=== 4. attention A/B in the PPO update (E=256) ==="
+MAT_DCML_TPU_ATTN_IMPL=pallas BENCH_N_ENVS=256 BENCH_ITERS=3 BENCH_BREAKDOWN=1 \
+  timeout 3000 python bench.py \
+  > artifacts/r4/bench_e256_attnpallas.json 2> artifacts/r4/bench_e256_attnpallas.log
+cat artifacts/r4/bench_e256_attnpallas.json
+
 echo "=== 5. E-ladder with remat+grad-accum (the unmeasured r3 lever) ==="
 BENCH_SWEEP=1 BENCH_SWEEP_ENVS=256,512,1024,2048,4096,8192 BENCH_BREAKDOWN=1 \
   BENCH_ITERS=3 timeout 5400 python bench.py \
   > artifacts/r4/bench_sweep.json 2> artifacts/r4/bench_sweep.log
 cat artifacts/r4/bench_sweep.json
 
-echo "=== 6. attention A/B in the PPO update (E=256) ==="
-MAT_DCML_TPU_ATTN_IMPL=pallas BENCH_N_ENVS=256 BENCH_ITERS=3 BENCH_BREAKDOWN=1 \
-  timeout 3000 python bench.py \
-  > artifacts/r4/bench_e256_attnpallas.json 2> artifacts/r4/bench_e256_attnpallas.log
-cat artifacts/r4/bench_e256_attnpallas.json
+echo "=== 6. convergence runs (reference recipe, full budget) ==="
+timeout 14000 bash scripts/tpu_convergence.sh 1000000 1 \
+  > artifacts/r4/convergence.log 2>&1
+tail -40 artifacts/r4/convergence.log
 
 echo "=== session 4 complete ==="
