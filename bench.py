@@ -571,7 +571,15 @@ def _orchestrate() -> None:
         if user_cap:
             probe_t = min(probe_t, float(user_cap))
         if probe_t > 30 and _probe_tpu(int(probe_t)):
-            res = _run_child({"BENCH_TPU_PROBE_TIMEOUT": "0"}, remaining() - 30.0)
+            # breakdown on (unless the caller pinned it): the per-phase
+            # collect/train seconds + roofline fields ride into the record
+            # (2 extra compiles, well inside the post-probe budget on a
+            # healthy chip; the child itself drops breakdown if it has to
+            # fall back to CPU mid-leg)
+            overrides = {"BENCH_TPU_PROBE_TIMEOUT": "0"}
+            if "BENCH_BREAKDOWN" not in os.environ:
+                overrides["BENCH_BREAKDOWN"] = "1"
+            res = _run_child(overrides, remaining() - 30.0)
             if res is not None:
                 # a child that itself fell back to CPU already produced the
                 # shrunk floor measurement — print it rather than recompute
@@ -634,8 +642,12 @@ def main() -> None:
     jax, fell_back = _setup_jax()
     if fell_back:
         # a CPU fallback run exists to prove liveness, not throughput — the
-        # TPU-sized default batch would grind for hours on the host
+        # TPU-sized default batch would grind for hours on the host, and the
+        # breakdown's two extra cold compiles would blow the leg budget
         E, ITERS = min(E, 32), min(ITERS, 2)
+        if breakdown:
+            log("CPU fallback: dropping breakdown")
+            breakdown = False
         log(f"CPU fallback: shrinking to E={E} ITERS={ITERS}")
 
     if sweep:
@@ -656,9 +668,8 @@ def main() -> None:
                 results.append(r)
         if not results:
             raise SystemExit("every sweep batch size OOMed")
-        best = max(results, key=lambda r: r["steps_per_sec"])
         log("sweep results: " + json.dumps(results))
-        steps_per_sec = best["steps_per_sec"]
+        res = max(results, key=lambda r: r["steps_per_sec"])
     else:
         res = None
         rung = (remat, accum)
@@ -678,22 +689,27 @@ def main() -> None:
                 # restart from the user's requested knobs, not hard defaults
                 rung = (remat, accum)
                 log(f"retrying at E={E}")
-        steps_per_sec = res["steps_per_sec"]
 
+    steps_per_sec = res["steps_per_sec"]
     dev = jax.devices()[0]
+    record = {
+        "metric": "dcml_mat_train_env_steps_per_sec",
+        "value": round(steps_per_sec, 2),
+        "unit": "env_steps/s",
+        "vs_baseline": round(steps_per_sec / BASELINE_STEPS_PER_SEC, 2),
+        # self-documenting evidence: a CPU fallback number must never
+        # be mistaken for a chip measurement (VERDICT r2 weak #3)
+        "platform": dev.platform,
+        "device": dev.device_kind,
+    }
+    # per-phase breakdown + roofline evidence rides along when measured
+    record.update({
+        k: (round(v, 4) if isinstance(v, float) else v)
+        for k, v in res.items()
+        if k.startswith(("collect_", "train_")) or k in ("E", "remat", "accum")
+    })
     print(
-        json.dumps(
-            {
-                "metric": "dcml_mat_train_env_steps_per_sec",
-                "value": round(steps_per_sec, 2),
-                "unit": "env_steps/s",
-                "vs_baseline": round(steps_per_sec / BASELINE_STEPS_PER_SEC, 2),
-                # self-documenting evidence: a CPU fallback number must never
-                # be mistaken for a chip measurement (VERDICT r2 weak #3)
-                "platform": dev.platform,
-                "device": dev.device_kind,
-            }
-        ),
+        json.dumps(record),
         flush=True,  # a teardown wedge after this point must not eat the line
     )
 

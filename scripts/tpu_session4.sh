@@ -17,13 +17,32 @@ mkdir -p artifacts/r4
 export BENCH_TPU_PROBE_TIMEOUT=0
 export MAT_DCML_TPU_DECODE_IMPL=xla   # measured r3 winner; leg 2 re-checks
 
+# Hard wall-clock stop (default 04:45 UTC, ~45 min before the round-4
+# driver window): the driver's own bench.py needs the single-client tunnel
+# uncontended at round end — a convergence leg must never still hold it.
+STOP_AT="${TPU_SESSION_STOP_AT:-04:45}"
+now=$(date -u +%s)
+stop=$(date -u -d "today $STOP_AT" +%s) || { echo "bad TPU_SESSION_STOP_AT=$STOP_AT"; exit 1; }
+[ "$stop" -le "$now" ] && stop=$(date -u -d "tomorrow $STOP_AT" +%s)
+budget() {  # budget <leg-cap-seconds> -> min(cap, seconds-to-stop); 0 = stop
+  local cap=$1 rem=$(( stop - $(date -u +%s) ))
+  [ "$rem" -lt 60 ] && { echo 0; return; }
+  [ "$rem" -lt "$cap" ] && echo "$rem" || echo "$cap"
+}
+# computing a budget inside $(...) cannot exit the script (subshell), so
+# every leg fetches its budget FIRST and bails past the wall
+need() { t=$(budget "$1"); [ "$t" -gt 0 ] && return 0
+         echo "=== past hard stop $STOP_AT UTC; ending session ==="; exit 0; }
+
 echo "=== 1. collect decomposition (on-chip effect of the sampler fix) ==="
-timeout 3000 python scripts/tpu_collect_bench.py 256 \
+need 3000
+timeout "$t" python scripts/tpu_collect_bench.py 256 \
   > artifacts/r4/collect_bench.json 2> artifacts/r4/collect_bench.log
 cat artifacts/r4/collect_bench.json
 
 echo "=== 2. decode micro-bench: fixed Pallas whole-decode vs XLA scan ==="
-timeout 3000 python scripts/tpu_decode_bench.py 256 512 \
+need 3000
+timeout "$t" python scripts/tpu_decode_bench.py 256 512 \
   > artifacts/r4/decode_bench.json 2> artifacts/r4/decode_bench.log
 cat artifacts/r4/decode_bench.json
 
@@ -31,8 +50,9 @@ echo "=== 3. combined-step A/B at E=256 + op trace ==="
 for impl in xla pallas; do
   prof=""
   [ "$impl" = xla ] && prof="artifacts/r4/trace_e256"
+  need 3000
   MAT_DCML_TPU_DECODE_IMPL=$impl BENCH_N_ENVS=256 BENCH_ITERS=3 \
-    BENCH_BREAKDOWN=1 BENCH_PROFILE_DIR=$prof timeout 3000 python bench.py \
+    BENCH_BREAKDOWN=1 BENCH_PROFILE_DIR=$prof timeout "$t" python bench.py \
     > "artifacts/r4/bench_e256_${impl}.json" 2> "artifacts/r4/bench_e256_${impl}.log"
   cat "artifacts/r4/bench_e256_${impl}.json"
 done
@@ -41,19 +61,22 @@ JAX_PLATFORMS=cpu python scripts/trace_report.py artifacts/r4/trace_e256 40 \
 tail -50 artifacts/r4/trace_e256_report.txt
 
 echo "=== 4. attention A/B in the PPO update (E=256) ==="
+need 3000
 MAT_DCML_TPU_ATTN_IMPL=pallas BENCH_N_ENVS=256 BENCH_ITERS=3 BENCH_BREAKDOWN=1 \
-  timeout 3000 python bench.py \
+  timeout "$t" python bench.py \
   > artifacts/r4/bench_e256_attnpallas.json 2> artifacts/r4/bench_e256_attnpallas.log
 cat artifacts/r4/bench_e256_attnpallas.json
 
 echo "=== 5. E-ladder with remat+grad-accum (the unmeasured r3 lever) ==="
+need 5400
 BENCH_SWEEP=1 BENCH_SWEEP_ENVS=256,512,1024,2048,4096,8192 BENCH_BREAKDOWN=1 \
-  BENCH_ITERS=3 timeout 5400 python bench.py \
+  BENCH_ITERS=3 timeout "$t" python bench.py \
   > artifacts/r4/bench_sweep.json 2> artifacts/r4/bench_sweep.log
 cat artifacts/r4/bench_sweep.json
 
 echo "=== 6. convergence runs (reference recipe, full budget) ==="
-timeout 14000 bash scripts/tpu_convergence.sh 1000000 1 \
+need 14000
+timeout "$t" bash scripts/tpu_convergence.sh 1000000 1 \
   > artifacts/r4/convergence.log 2>&1
 tail -40 artifacts/r4/convergence.log
 
