@@ -40,14 +40,18 @@ class DecodeResult(NamedTuple):
     log_prob: jax.Array     # (B, n_agent, act_prob) float32
 
 
-# "auto": XLA until the whole-decode fused kernel demonstrates a measured win
-# on the chip, then Pallas on TPU for the discrete families (the flip is
-# _AUTO_PALLAS_ON_TPU below, with the BENCHLOG.md row as evidence).
+# "auto" = XLA.  DECIDED (round 4, BENCHLOG "whole-decode kernel: decided"):
+# the only on-chip measurement of record (r3 session 1) put the XLA decode
+# scan at 3 µs/position — far below any regime where a fused kernel matters
+# — so the whole-decode Pallas kernel (ops/pallas_decode.py) is a documented
+# PORTABILITY ARTIFACT, selectable via MAT_DCML_TPU_DECODE_IMPL=pallas and
+# kept interpret-mode parity-tested, not the default.  Revisit only if a
+# future measured A/B (scripts/tpu_session4.sh leg 2) shows a win.
 _DECODE_IMPL_ENV = "MAT_DCML_TPU_DECODE_IMPL"
 _VALID_DECODE_IMPLS = ("auto", "xla", "pallas", "pallas_interpret")
 
-# Flipped to True once the whole-decode kernel's win is measured on the chip
-# (BENCHLOG.md); kill switch: MAT_DCML_TPU_DECODE_IMPL=xla.
+# Permanently False absent a measured on-chip win (see above); kill switch
+# for experiments: MAT_DCML_TPU_DECODE_IMPL=xla.
 _AUTO_PALLAS_ON_TPU = False
 
 
