@@ -110,6 +110,18 @@ class Actor(nn.Module):
         (``act.py:evaluate_actions_trpo``).  Discrete -> masked logits;
         Box/extra -> (mean, std)."""
         x, _ = self._features(obs, rnn_states, masks)
+        return self._dist_from_features(x, available_actions)
+
+    def dist_params_seq(self, obs, rnn_states, masks, available_actions=None):
+        """``dist_params`` over ``(T, B, ...)`` sequences from a chunk-start
+        hidden state — the recurrent HATRPO KL path."""
+        if not self.cfg.use_recurrent_policy:
+            raise ValueError("dist_params_seq requires use_recurrent_policy=True")
+        x = self.base(obs)
+        x, _ = self.rnn.run_sequence(x, rnn_states, masks)
+        return self._dist_from_features(x, available_actions)
+
+    def _dist_from_features(self, x, available_actions):
         sp = self.space
         if isinstance(sp, Discrete) or (
             isinstance(sp, DCMLActionSpace) and not sp.mixed and not sp.extra

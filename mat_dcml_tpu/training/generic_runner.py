@@ -26,7 +26,7 @@ from mat_dcml_tpu.training.ppo import MATTrainer, PPOConfig
 from mat_dcml_tpu.training.rollout import RolloutCollector
 
 MAT_FAMILY = ("mat", "mat_dec", "mat_encoder", "mat_decoder", "mat_gru")
-AC_FAMILY = ("mappo", "rmappo", "ippo", "happo", "hatrpo")
+AC_FAMILY = ("mappo", "rmappo", "ippo", "happo", "hatrpo", "rhappo", "rhatrpo")
 SUPPORTED_ALGOS = MAT_FAMILY + AC_FAMILY
 
 
@@ -96,9 +96,10 @@ class GenericRunner(BaseRunner):
             self.trainer = MATTrainer(self.policy, ppo, total_updates=run.episodes)
             self.collector = RolloutCollector(env, self.policy, run.episode_length)
         else:
+            use_rec = run.algorithm_name in ("rmappo", "rhappo", "rhatrpo")
             ac = ACConfig(
                 hidden_size=run.n_embd,
-                use_recurrent_policy=run.algorithm_name == "rmappo",
+                use_recurrent_policy=use_rec,
             )
             self.policy = ActorCriticPolicy(
                 ac,
@@ -107,7 +108,7 @@ class GenericRunner(BaseRunner):
                 space=_env_space(env),
             )
             mcfg = MAPPOConfig(
-                use_recurrent_policy=run.algorithm_name == "rmappo",
+                use_recurrent_policy=use_rec,
                 **ac_config_kwargs(ppo),
             )
             if run.algorithm_name == "ippo":
@@ -115,7 +116,7 @@ class GenericRunner(BaseRunner):
                 self.collector = IPPORolloutCollector(
                     env, self.policy, run.episode_length, use_local_value=True
                 )
-            elif run.algorithm_name in ("happo", "hatrpo"):
+            elif run.algorithm_name in ("happo", "hatrpo", "rhappo", "rhatrpo"):
                 from mat_dcml_tpu.training.happo import (
                     HAPPOConfig,
                     HAPPORolloutCollector,
@@ -123,8 +124,10 @@ class GenericRunner(BaseRunner):
                     HATRPOTrainer,
                 )
 
-                hcfg = HAPPOConfig(**ac_config_kwargs(ppo))
-                cls = HATRPOTrainer if run.algorithm_name == "hatrpo" else HAPPOTrainer
+                hcfg = HAPPOConfig(use_recurrent_policy=use_rec,
+                                   **ac_config_kwargs(ppo))
+                cls = (HATRPOTrainer if run.algorithm_name.endswith("hatrpo")
+                       else HAPPOTrainer)
                 self.trainer = cls(self.policy, hcfg, n_agents=env.n_agents)
                 self.collector = HAPPORolloutCollector(env, self.policy, run.episode_length)
             else:

@@ -17,6 +17,14 @@ TPU-native shape: agent parameters are stacked along a leading axis; the
 inherently-serial agent loop is a ``lax.scan`` over a permuted index vector,
 updating one agent's slice of the stacked pytree per step.  Everything jits.
 
+Recurrent variants (``rhappo``/``rhatrpo``) follow the reference's chunked
+recurrent generator (``separated_buffer.py:320-430``): ``data_chunk_length``
+windows are the minibatch items, the GRU re-runs each window from the stored
+chunk-start hidden, and the sequential ``factor`` is computed by re-running
+the FULL episode from the t=0 hidden — matching the reference, which passes
+``rnn_states[0:1]`` and lets the torch RNN layer unroll all T steps
+(``base_runner.py:335-413``).
+
 HATRPO's actor step (``hatrpo_trainer.py:125-349``) is the classic natural
 gradient: CG-solve ``F x = g`` with Fisher-vector products (Hessian of the
 self-KL, damping 0.1), step size ``1/sqrt(sᵀFs / 2δ)``-style scaling to the
@@ -45,6 +53,8 @@ from mat_dcml_tpu.training.mappo import (
     MAPPOConfig,
     MAPPOTrainer,
     MAPPOTrainState,
+    chunk_start_states,
+    chunk_windows,
 )
 
 
@@ -91,13 +101,6 @@ class HAPPOTrainer:
     """
 
     def __init__(self, policy: ActorCriticPolicy, cfg: HAPPOConfig, n_agents: int):
-        if cfg.use_recurrent_policy:
-            raise NotImplementedError(
-                "HAPPO/HATRPO are feedforward-only here: the sequential-factor "
-                "update evaluates stored per-step hidden states as constants, "
-                "which would silently train a GRU wrong. Use MAPPOTrainer for "
-                "the recurrent chunked path."
-            )
         self.policy = policy
         self.cfg = cfg
         self.n_agents = n_agents
@@ -154,6 +157,13 @@ class HAPPOTrainer:
         order = jax.random.permutation(k_perm, A)  # randperm (:334)
         agent_keys = jax.random.split(k_train, A)
 
+        use_rec = self.cfg.use_recurrent_policy
+        L = self.cfg.data_chunk_length
+        if use_rec:
+            assert T % L == 0, (
+                f"episode_length {T} must be divisible by data_chunk_length {L}"
+            )
+
         def one_agent(carry, inp):
             params_s, aopt_s, copt_s, vn_s, factor = carry
             idx, k_agent = inp
@@ -161,29 +171,64 @@ class HAPPOTrainer:
             params_i, aopt_i, copt_i, vn_i = (
                 take(params_s), take(aopt_s), take(copt_s), take(vn_s)
             )
-            data = {
-                "cent_obs": _rows(traj_a.share_obs[idx]),
-                "obs": _rows(traj_a.obs[idx]),
-                "avail": _rows(traj_a.available_actions[idx]),
-                "actions": _rows(traj_a.actions[idx]),
-                "log_probs": _rows(traj_a.log_probs[idx]),
-                "values": _rows(traj_a.values[idx]),
-                "masks": _rows(traj_a.masks[idx][:-1]),
-                "active": _rows(traj_a.active_masks[idx][:-1]),
-                "actor_h": _rows(traj_a.actor_h[idx]),
-                "critic_h": _rows(traj_a.critic_h[idx]),
-                "adv": _rows(adv_a[idx]),
-                "returns": _rows(ret_a[idx]),
-                "factor": factor.reshape(T * E, 1),
-            }
-            old_logp = self._eval_logp(params_i, data)
+            sq = lambda x: x[idx][:, :, 0]            # agent slice -> (T', E, ...)
+            if use_rec:
+                # the reference's recurrent generator semantics
+                # (separated_buffer.py:320-430): data_chunk_length windows as
+                # minibatch items, GRU re-run from stored chunk-start hiddens
+                to_chunks = lambda x: chunk_windows(x, L, n_batch=1)
+                starts = lambda x: chunk_start_states(x, L, n_batch=1)
+                data = {
+                    "cent_obs": to_chunks(sq(traj_a.share_obs)),
+                    "obs": to_chunks(sq(traj_a.obs)),
+                    "avail": to_chunks(sq(traj_a.available_actions)),
+                    "actions": to_chunks(sq(traj_a.actions)),
+                    "log_probs": to_chunks(sq(traj_a.log_probs)),
+                    "values": to_chunks(sq(traj_a.values)),
+                    "masks": to_chunks(sq(traj_a.masks)[:-1]),
+                    "active": to_chunks(sq(traj_a.active_masks)[:-1]),
+                    "actor_h0": starts(sq(traj_a.actor_h)),
+                    "critic_h0": starts(sq(traj_a.critic_h)),
+                    "adv": to_chunks(adv_a[idx][:, :, 0]),
+                    "returns": to_chunks(ret_a[idx][:, :, 0]),
+                    "factor": to_chunks(factor),
+                }
+                # factor evaluation re-runs the FULL episode from the t=0
+                # hidden — the reference passes rnn_states[0:1] and lets the
+                # torch RNN layer unroll all T steps (base_runner.py:335-413)
+                seqd = {
+                    "obs": sq(traj_a.obs),
+                    "actions": sq(traj_a.actions),
+                    "masks": sq(traj_a.masks)[:-1],
+                    "avail": sq(traj_a.available_actions),
+                    "active": sq(traj_a.active_masks)[:-1],
+                    "h0": sq(traj_a.actor_h)[0],
+                }
+                eval_logp = lambda p: self._eval_logp_seq(p, seqd)  # (T, E, ad)
+            else:
+                data = {
+                    "cent_obs": _rows(traj_a.share_obs[idx]),
+                    "obs": _rows(traj_a.obs[idx]),
+                    "avail": _rows(traj_a.available_actions[idx]),
+                    "actions": _rows(traj_a.actions[idx]),
+                    "log_probs": _rows(traj_a.log_probs[idx]),
+                    "values": _rows(traj_a.values[idx]),
+                    "masks": _rows(traj_a.masks[idx][:-1]),
+                    "active": _rows(traj_a.active_masks[idx][:-1]),
+                    "actor_h": _rows(traj_a.actor_h[idx]),
+                    "critic_h": _rows(traj_a.critic_h[idx]),
+                    "adv": _rows(adv_a[idx]),
+                    "returns": _rows(ret_a[idx]),
+                    "factor": factor.reshape(T * E, 1),
+                }
+                eval_logp = lambda p: self._eval_logp(p, data).reshape(T, E, -1)
+            old_logp = eval_logp(params_i)
             params_i, aopt_i, copt_i, vn_i, metrics = self._update_agent(
                 params_i, aopt_i, copt_i, vn_i, data, k_agent
             )
-            new_logp = self._eval_logp(params_i, data)
+            new_logp = eval_logp(params_i)
             # factor update (:413): prod over action dims of the logp shift.
-            shift = jnp.exp((new_logp - old_logp).sum(-1, keepdims=True))
-            factor = factor * shift.reshape(T, E, 1)
+            factor = factor * jnp.exp((new_logp - old_logp).sum(-1, keepdims=True))
 
             put = lambda t, v: jax.tree.map(lambda full, new: full.at[idx].set(new), t, v)
             carry = (
@@ -209,11 +254,21 @@ class HAPPOTrainer:
         )
         return logp
 
+    def _eval_logp_seq(self, params_i, seqd):
+        """Full-episode GRU re-run from the t=0 hidden -> (T, E, adim)."""
+        logp, _ = self.policy.actor.apply(
+            params_i["actor"], seqd["obs"], seqd["h0"], seqd["actions"],
+            seqd["masks"], seqd["avail"], seqd["active"], method="evaluate_seq",
+        )
+        return logp
+
     def _update_agent(self, params, aopt, copt, vn, data, key):
         """PPO epochs with the ``factor`` weighting (``happo_trainer.py:96-160``)."""
         cfg, inner = self.cfg, self.inner
-        N = data["obs"].shape[0]
+        use_rec = cfg.use_recurrent_policy
+        N = data["obs"].shape[0]                      # rows (ff) / chunks (rec)
         mb_size = N // cfg.num_mini_batch
+        seq = lambda x: jnp.swapaxes(x, 0, 1)         # (mb, L, ...) -> (L, mb, ...)
 
         def ppo_update(carry, mb_idx):
             params, aopt, copt, vn = carry
@@ -221,20 +276,35 @@ class HAPPOTrainer:
             vn, params, ret_norm = inner._normalize_targets(vn, params, b["returns"])
 
             def loss_fn(p):
-                values, logp, ent = self.policy.evaluate_actions(
-                    p, b["cent_obs"], b["obs"], b["actor_h"], b["critic_h"],
-                    b["actions"], b["masks"], b["avail"], b["active"],
-                )
-                ratio = jnp.exp((logp - b["log_probs"]).sum(-1, keepdims=True))
-                surr1 = ratio * b["adv"]
-                surr2 = jnp.clip(ratio, 1 - cfg.clip_param, 1 + cfg.clip_param) * b["adv"]
+                if use_rec:
+                    values, logp, ent = self.policy.evaluate_actions_seq(
+                        p, seq(b["cent_obs"]), seq(b["obs"]),
+                        b["actor_h0"], b["critic_h0"], seq(b["actions"]),
+                        seq(b["masks"]), seq(b["avail"]), seq(b["active"]),
+                    )
+                    lp_old, adv_b, active_b, fct, val_b, ret_b = (
+                        seq(b["log_probs"]), seq(b["adv"]), seq(b["active"]),
+                        seq(b["factor"]), seq(b["values"]), seq(ret_norm),
+                    )
+                else:
+                    values, logp, ent = self.policy.evaluate_actions(
+                        p, b["cent_obs"], b["obs"], b["actor_h"], b["critic_h"],
+                        b["actions"], b["masks"], b["avail"], b["active"],
+                    )
+                    lp_old, adv_b, active_b, fct, val_b, ret_b = (
+                        b["log_probs"], b["adv"], b["active"],
+                        b["factor"], b["values"], ret_norm,
+                    )
+                ratio = jnp.exp((logp - lp_old).sum(-1, keepdims=True))
+                surr1 = ratio * adv_b
+                surr2 = jnp.clip(ratio, 1 - cfg.clip_param, 1 + cfg.clip_param) * adv_b
                 # factor multiplies the clipped surrogate (happo_trainer.py:128-140)
-                surr = (b["factor"] * jnp.minimum(surr1, surr2)).sum(-1, keepdims=True)
+                surr = (fct * jnp.minimum(surr1, surr2)).sum(-1, keepdims=True)
                 if cfg.use_policy_active_masks:
-                    policy_loss = -(surr * b["active"]).sum() / b["active"].sum()
+                    policy_loss = -(surr * active_b).sum() / active_b.sum()
                 else:
                     policy_loss = -surr.mean()
-                value_loss = inner._value_loss(values, b["values"], ret_norm, b["active"])
+                value_loss = inner._value_loss(values, val_b, ret_b, active_b)
                 total = policy_loss - ent * cfg.entropy_coef + value_loss * cfg.value_loss_coef
                 return total, (value_loss, policy_loss, ent, ratio.mean())
 
@@ -263,13 +333,33 @@ class HATRPOTrainer(HAPPOTrainer):
     reference's ``train`` has no epoch loop — ``:351-412``)."""
 
     # ------------------------------------------------------------ kl machinery
+    #
+    # All helpers take the minibatch in EVAL layout: feedforward rows as-is,
+    # or time-major ``(L, mb, ...)`` sequences + chunk-start hiddens when
+    # ``use_recurrent_policy`` (built once per minibatch in ``_update_agent``).
 
     def _logp_fn(self, actor_params, b):
+        if self.cfg.use_recurrent_policy:
+            return self.policy.actor.apply(
+                actor_params, b["obs"], b["actor_h0"], b["actions"], b["masks"],
+                b["avail"], b["active"], method="evaluate_seq",
+            )
         logp, ent = self.policy.actor.apply(
             actor_params, b["obs"], b["actor_h"], b["actions"], b["masks"],
             b["avail"], b["active"], method="evaluate",
         )
         return logp, ent
+
+    def _dist_params(self, actor_params, b):
+        if self.cfg.use_recurrent_policy:
+            return self.policy.actor.apply(
+                actor_params, b["obs"], b["actor_h0"], b["masks"], b["avail"],
+                method="dist_params_seq",
+            )
+        return self.policy.actor.apply(
+            actor_params, b["obs"], b["actor_h"], b["masks"], b["avail"],
+            method="dist_params",
+        )
 
     def _kl_vs(self, actor_params, old_ref, b):
         """Mean KL(old || new).  Continuous: closed-form diag-gaussian
@@ -277,10 +367,7 @@ class HATRPOTrainer(HAPPOTrainer):
         actions ``exp(Δ) - 1 - Δ`` (``kl_approx``, ``:125-128``)."""
         if isinstance(self.policy.space, Box):
             mu_old, std_old = old_ref
-            mu, std = self.policy.actor.apply(
-                actor_params, b["obs"], b["actor_h"], b["masks"], b["avail"],
-                method="dist_params",
-            )
+            mu, std = self._dist_params(actor_params, b)
             kl = (
                 jnp.log(std) - jnp.log(std_old)
                 + (std_old**2 + (mu_old - mu) ** 2) / (2.0 * std**2)
@@ -295,10 +382,7 @@ class HATRPOTrainer(HAPPOTrainer):
 
     def _old_ref(self, actor_params, b):
         if isinstance(self.policy.space, Box):
-            mu, std = self.policy.actor.apply(
-                actor_params, b["obs"], b["actor_h"], b["masks"], b["avail"],
-                method="dist_params",
-            )
+            mu, std = self._dist_params(actor_params, b)
             return jax.lax.stop_gradient(mu), jax.lax.stop_gradient(std)
         lp, _ = self._logp_fn(actor_params, b)
         return jax.lax.stop_gradient(lp)
@@ -307,19 +391,34 @@ class HATRPOTrainer(HAPPOTrainer):
 
     def _update_agent(self, params, aopt, copt, vn, data, key):
         cfg, inner = self.cfg, self.inner
+        use_rec = cfg.use_recurrent_policy
         N = data["obs"].shape[0]
         mb_size = N // cfg.num_mini_batch
+        seq = lambda x: jnp.swapaxes(x, 0, 1)
 
         def trpo_update(carry, mb_idx):
             params, aopt, copt, vn = carry
-            b = jax.tree.map(lambda x: x[mb_idx], data)
-            vn, params, ret_norm = inner._normalize_targets(vn, params, b["returns"])
+            mb = jax.tree.map(lambda x: x[mb_idx], data)
+            vn, params, ret_norm = inner._normalize_targets(vn, params, mb["returns"])
+            if use_rec:
+                # eval layout: time-major sequences + chunk-start hiddens
+                b = {k: (v if k in ("actor_h0", "critic_h0") else seq(v))
+                     for k, v in mb.items()}
+                ret_norm = seq(ret_norm)
+            else:
+                b = mb
 
             # ---- critic: plain Adam on the clipped/huber value loss (:215-227)
             def critic_loss_fn(cp):
-                values, _ = self.policy.critic.apply(
-                    cp, b["cent_obs"], b["critic_h"], b["masks"]
-                )
+                if use_rec:
+                    values = self.policy.critic.apply(
+                        cp, b["cent_obs"], b["critic_h0"], b["masks"],
+                        method="values_seq",
+                    )
+                else:
+                    values, _ = self.policy.critic.apply(
+                        cp, b["cent_obs"], b["critic_h"], b["masks"]
+                    )
                 return inner._value_loss(values, b["values"], ret_norm, b["active"]) * cfg.value_loss_coef
 
             vl, cgrads = jax.value_and_grad(critic_loss_fn)(params["critic"])

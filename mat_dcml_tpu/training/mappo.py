@@ -48,6 +48,26 @@ from mat_dcml_tpu.ops.popart import (
 from mat_dcml_tpu.training.ac_rollout import ACTrajectory
 
 
+def chunk_windows(x: jax.Array, L: int, n_batch: int) -> jax.Array:
+    """``(T, *batch, ...) -> (nC*prod(batch), L, ...)`` data-chunk windows.
+
+    The reference's recurrent generator layout (``separated_buffer.py:320-430``):
+    time splits into ``nC = T//L`` windows, each (window, batch-element) pair
+    becomes one minibatch item.  ``n_batch`` = number of leading batch axes
+    after time (shared buffers: 2 = (E, A); separated/HAPPO slices: 1 = (E,)).
+    """
+    nC = x.shape[0] // L
+    y = x.reshape(nC, L, *x.shape[1:])
+    y = jnp.moveaxis(y, 1, 1 + n_batch)         # (nC, *batch, L, ...)
+    return y.reshape(-1, L, *x.shape[1 + n_batch:])
+
+
+def chunk_start_states(x: jax.Array, L: int, n_batch: int) -> jax.Array:
+    """Hidden state entering each window (``x[c*L]`` per batch element) ->
+    ``(nC*prod(batch), ...)``; item order matches :func:`chunk_windows`."""
+    return x[::L].reshape(-1, *x.shape[1 + n_batch:])
+
+
 @dataclasses.dataclass(frozen=True)
 class MAPPOConfig:
     """Defaults follow ``config.py`` (lr 5e-4 group, ppo group)."""
@@ -284,17 +304,8 @@ class MAPPOTrainer:
         nC = T // L
         n_items = nC * E * A
         mb_size = n_items // cfg.num_mini_batch
-
-        def to_chunks(x):
-            # (T, E, A, ...) -> (n_items, L, ...)
-            y = x.reshape(nC, L, E, A, *x.shape[3:])
-            y = jnp.moveaxis(y, 1, 3)          # (nC, E, A, L, ...)
-            return y.reshape(n_items, L, *x.shape[3:])
-
-        def chunk_starts(x):
-            # hidden at chunk start: x[(c*L)] per env/agent -> (n_items, ...)
-            y = x[::L]                          # (nC, E, A, ...)
-            return y.reshape(n_items, *x.shape[3:])
+        to_chunks = lambda x: chunk_windows(x, L, n_batch=2)
+        chunk_starts = lambda x: chunk_start_states(x, L, n_batch=2)
 
         data = {
             "cent_obs": to_chunks(traj.share_obs),

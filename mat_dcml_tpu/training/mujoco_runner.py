@@ -116,11 +116,13 @@ class MujocoHostRunner(BaseRunner):
     (``mujoco_multi.py:39-260`` factorization), fault injection at the
     bridge boundary.
 
-    ``eval_env_fn`` (a zero-arg factory for ONE host env) enables evaluation:
-    eval runs on its own short-lived :class:`ShareDummyVecEnv` fleet — the
-    reference keeps eval envs separate too (``config.py`` n_eval_rollout
-    _threads), and resetting the TRAINING fleet mid-run would desynchronize
-    the collector's held observations from worker state."""
+    ``eval_env_fn`` enables evaluation on its own short-lived
+    :class:`ShareDummyVecEnv` fleet — the reference keeps eval envs separate
+    too (``config.py`` n_eval_rollout_threads), and resetting the TRAINING
+    fleet mid-run would desynchronize the collector's held observations from
+    worker state.  An index-parameterized factory (``f(i) -> env``) gets
+    ``n_envs`` independently-seeded envs; a zero-arg factory gets a fleet of
+    one (same-seed duplicates add no variance reduction)."""
 
     def __init__(self, run: RunConfig, ppo: PPOConfig, vec_env,
                  faulty_node: int = -1, eval_env_fn=None, log_fn=print):
@@ -161,11 +163,21 @@ class MujocoHostRunner(BaseRunner):
     def evaluate(self, train_state, n_steps: int = 200, seed: int = 0,
                  faulty_node: int = -1, n_envs: int = 2):
         """Deterministic mean step reward on a FRESH eval fleet."""
+        import inspect
+
         from mat_dcml_tpu.envs.vec_env import ShareDummyVecEnv
 
         if self.eval_env_fn is None:
             raise ValueError("evaluate() needs eval_env_fn (see class docstring)")
-        env = ShareDummyVecEnv([self.eval_env_fn] * n_envs)
+        # an index-parameterized factory gets a distinct index (and thus seed)
+        # per eval env; a bare thunk yields a fleet of ONE — n_envs same-seed
+        # rollouts would be identical duplicates whose mean adds nothing
+        takes_idx = len(inspect.signature(self.eval_env_fn).parameters) >= 1
+        if takes_idx:
+            fns = [(lambda i=i: self.eval_env_fn(i)) for i in range(n_envs)]
+        else:
+            fns = [self.eval_env_fn]
+        env = ShareDummyVecEnv(fns)
         if faulty_node >= 0:
             env = _FaultyVecEnv(env, faulty_node)
         try:

@@ -44,7 +44,8 @@ from mat_dcml_tpu.training.rollout import RolloutCollector, RolloutState
 
 
 MAT_DCML_ALGOS = ("mat", "mat_dec", "momat", "dmomat")
-AC_DCML_ALGOS = ("ppo", "mappo", "rmappo", "ippo", "happo", "hatrpo")
+AC_DCML_ALGOS = ("ppo", "mappo", "rmappo", "ippo", "happo", "hatrpo",
+                 "rhappo", "rhatrpo")
 SUPPORTED_DCML_ALGOS = MAT_DCML_ALGOS + AC_DCML_ALGOS + ("random",)
 
 
@@ -128,9 +129,10 @@ class DCMLRunner(BaseRunner):
             )
         else:
             mcfg_kwargs = ac_config_kwargs(ppo)
+            use_rec = algo in ("rmappo", "rhappo", "rhatrpo")
             ac = ACConfig(
                 hidden_size=run.n_embd,
-                use_recurrent_policy=algo == "rmappo",
+                use_recurrent_policy=use_rec,
             )
             if algo == "ppo":
                 # centralized PPO over the joint action (ppo_policy.py +
@@ -164,10 +166,12 @@ class DCMLRunner(BaseRunner):
                     self.collector = IPPORolloutCollector(
                         wrapped, self.policy, run.episode_length, use_local_value=True
                     )
-                else:  # happo / hatrpo
-                    trainer_cls = HATRPOTrainer if algo == "hatrpo" else HAPPOTrainer
+                else:  # happo / hatrpo (r* = recurrent chunked variants)
+                    trainer_cls = HATRPOTrainer if algo.endswith("hatrpo") else HAPPOTrainer
                     self.trainer = trainer_cls(
-                        self.policy, HAPPOConfig(**mcfg_kwargs), n_agents=wrapped.n_agents
+                        self.policy,
+                        HAPPOConfig(use_recurrent_policy=use_rec, **mcfg_kwargs),
+                        n_agents=wrapped.n_agents,
                     )
                     self.collector = HAPPORolloutCollector(wrapped, self.policy, run.episode_length)
 

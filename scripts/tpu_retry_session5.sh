@@ -1,0 +1,32 @@
+#!/bin/bash
+# Wait for the first healthy TPU grant, then run scripts/tpu_session5.sh.
+# Each probe is itself a claim attempt that can queue ~25 min before the
+# tunnel reports UNAVAILABLE (round-2/3/4 outage signature), so probe with a
+# generous timeout and loop.  Designed to run detached (nohup).
+# Stops probing at TPU_RETRY_STOP_AT (default 17:00 UTC) so a late grant
+# never collides with the round driver's own bench window.
+cd "$(dirname "$0")/.."
+mkdir -p artifacts/r5
+STOP_AT="${TPU_RETRY_STOP_AT:-17:00}"
+stop=$(date -u -d "today $STOP_AT" +%s)
+[ "$stop" -le "$(date -u +%s)" ] && stop=$(date -u -d "tomorrow $STOP_AT" +%s)
+n=0
+while [ "$(date -u +%s)" -lt "$stop" ]; do
+  n=$((n + 1))
+  echo "[retry] probe $n at $(date -u +%H:%M:%S)" >> artifacts/r5/retry.log
+  if timeout 2400 python -c "
+import jax
+d = jax.devices()
+assert d and d[0].platform == 'tpu', d
+import jax.numpy as jnp
+assert float((jnp.ones((8,8)) @ jnp.ones((8,8))).sum()) == 512.0
+print('healthy:', d)
+" >> artifacts/r5/retry.log 2>&1; then
+    echo "[retry] healthy at $(date -u +%H:%M:%S); starting session 5" >> artifacts/r5/retry.log
+    bash scripts/tpu_session5.sh >> artifacts/r5/session5.log 2>&1
+    echo "[retry] session 5 finished at $(date -u +%H:%M:%S)" >> artifacts/r5/retry.log
+    exit 0
+  fi
+  sleep 120
+done
+echo "[retry] stop time $STOP_AT reached; no healthy grant" >> artifacts/r5/retry.log

@@ -1,0 +1,76 @@
+#!/bin/bash
+# Round-5 chip session (VERDICT r4 "Next round" item 1).
+#
+# SHORT measurement legs only, highest-information first — the w99
+# convergence run lives on CPU this round (resumed across rounds via Orbax,
+# results/DCML/AS/momat/conv_r4_w99_cpu), so the chip is purely for the
+# numbers that have been plans since round 3: post-restructure combined-step
+# bench, the fixed decode-kernel A/B, the attention A/B inside the PPO
+# update, per-phase MFU breakdown, and the E-ladder.
+# One TPU client at a time; the caller (tpu_retry_session5.sh) verified a
+# healthy grant.
+set -x
+cd "$(dirname "$0")/.."
+mkdir -p artifacts/r5
+export BENCH_TPU_PROBE_TIMEOUT=0
+export MAT_DCML_TPU_DECODE_IMPL=xla   # measured r3 winner; leg 3 re-checks
+
+# Hard wall-clock stop (default 17:30 UTC, ~1 h before the round-5 driver
+# window): the driver's own bench.py needs the single-client tunnel
+# uncontended at round end — a long leg must never still hold it.
+STOP_AT="${TPU_SESSION_STOP_AT:-17:30}"
+now=$(date -u +%s)
+stop=$(date -u -d "today $STOP_AT" +%s) || { echo "bad TPU_SESSION_STOP_AT=$STOP_AT"; exit 1; }
+[ "$stop" -le "$now" ] && stop=$(date -u -d "tomorrow $STOP_AT" +%s)
+budget() {  # budget <leg-cap-seconds> -> min(cap, seconds-to-stop); 0 = stop
+  local cap=$1 rem=$(( stop - $(date -u +%s) ))
+  [ "$rem" -lt 60 ] && { echo 0; return; }
+  [ "$rem" -lt "$cap" ] && echo "$rem" || echo "$cap"
+}
+need() { t=$(budget "$1"); [ "$t" -gt 0 ] && return 0
+         echo "=== past hard stop $STOP_AT UTC; ending session ==="; exit 0; }
+
+echo "=== 1. combined-step bench at E=256 + op trace (the round-5 number of record) ==="
+need 3000
+BENCH_N_ENVS=256 BENCH_ITERS=3 BENCH_BREAKDOWN=1 \
+  BENCH_PROFILE_DIR=artifacts/r5/trace_e256 timeout "$t" python bench.py \
+  > artifacts/r5/bench_e256_xla.json 2> artifacts/r5/bench_e256_xla.log
+cat artifacts/r5/bench_e256_xla.json
+JAX_PLATFORMS=cpu python scripts/trace_report.py artifacts/r5/trace_e256 40 \
+  > artifacts/r5/trace_e256_report.txt 2>&1 || true
+tail -50 artifacts/r5/trace_e256_report.txt
+
+echo "=== 2. attention A/B in the PPO update (E=256) — the roofline's top lever ==="
+need 3000
+MAT_DCML_TPU_ATTN_IMPL=pallas BENCH_N_ENVS=256 BENCH_ITERS=3 BENCH_BREAKDOWN=1 \
+  timeout "$t" python bench.py \
+  > artifacts/r5/bench_e256_attnpallas.json 2> artifacts/r5/bench_e256_attnpallas.log
+cat artifacts/r5/bench_e256_attnpallas.json
+
+echo "=== 3. decode micro-bench: fixed Pallas whole-decode vs XLA scan ==="
+need 3000
+timeout "$t" python scripts/tpu_decode_bench.py 256 512 \
+  > artifacts/r5/decode_bench.json 2> artifacts/r5/decode_bench.log
+cat artifacts/r5/decode_bench.json
+
+echo "=== 4. collect decomposition (on-chip effect of the sampler fix) ==="
+need 3000
+timeout "$t" python scripts/tpu_collect_bench.py 256 \
+  > artifacts/r5/collect_bench.json 2> artifacts/r5/collect_bench.log
+cat artifacts/r5/collect_bench.json
+
+echo "=== 5. E-ladder with remat+grad-accum ==="
+need 5400
+BENCH_SWEEP=1 BENCH_SWEEP_ENVS=256,512,1024,2048,4096,8192 BENCH_BREAKDOWN=1 \
+  BENCH_ITERS=3 timeout "$t" python bench.py \
+  > artifacts/r5/bench_sweep.json 2> artifacts/r5/bench_sweep.log
+cat artifacts/r5/bench_sweep.json
+
+echo "=== 6. f32-trunk baseline (isolates the dtype lever; legs 1/2 are bf16 by default) ==="
+need 3000
+BENCH_DTYPE=float32 BENCH_N_ENVS=256 BENCH_ITERS=3 BENCH_BREAKDOWN=1 \
+  timeout "$t" python bench.py \
+  > artifacts/r5/bench_e256_f32.json 2> artifacts/r5/bench_e256_f32.log
+cat artifacts/r5/bench_e256_f32.json
+
+echo "=== session 5 complete ==="

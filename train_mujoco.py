@@ -95,11 +95,16 @@ def _main_gym(run, ppo, ns):
 
     fns = [make_env(i) for i in range(run.n_rollout_threads)]
     vec = ShareDummyVecEnv(fns) if run.n_rollout_threads == 1 else ShareSubprocVecEnv(fns)
-    runner = MujocoHostRunner(run, ppo, vec, faulty_node=ns.faulty_node,
-                              eval_env_fn=make_env(run.n_rollout_threads))
-    print(f"algorithm={run.algorithm_name} env=mujoco-gym/{scenario}/{ns.agent_conf} "
-          f"agents={vec.n_agents} episodes={run.episodes}")
     try:
+        # construct inside the try: a raising constructor (thread-count
+        # mismatch, non-MAT algorithm) must not leak the spawned workers
+        runner = MujocoHostRunner(
+            run, ppo, vec, faulty_node=ns.faulty_node,
+            # index-parameterized eval factory: each eval env gets its own seed
+            eval_env_fn=lambda i=0: make_env(run.n_rollout_threads + i)(),
+        )
+        print(f"algorithm={run.algorithm_name} env=mujoco-gym/{scenario}/{ns.agent_conf} "
+              f"agents={vec.n_agents} episodes={run.episodes}")
         state, _ = runner.train_loop()
         print("eval (healthy):", runner.evaluate(state, n_steps=run.episode_length))
         if ns.eval_faulty_node:
