@@ -84,7 +84,7 @@ def ac_config_kwargs(ppo: PPOConfig) -> dict:
         num_mini_batch=ppo.num_mini_batch, clip_param=ppo.clip_param,
         entropy_coef=ppo.entropy_coef, value_loss_coef=ppo.value_loss_coef,
         max_grad_norm=ppo.max_grad_norm, gamma=ppo.gamma,
-        gae_lambda=ppo.gae_lambda,
+        gae_lambda=ppo.gae_lambda, data_chunk_length=ppo.data_chunk_length,
     )
 
 

@@ -75,6 +75,13 @@ class PPOConfig:
     # the summed chunk gradients equal the unchunked gradient; pinned by
     # tests/test_ppo_accum.py).  The big-batch enabler alongside MATConfig.remat.
     grad_accum_steps: int = 1
+    # Recurrent chunk window for the AC families (rmappo/rhappo/rhatrpo;
+    # ignored by the MAT trainer): minibatch items are data_chunk_length
+    # windows re-run from stored chunk-start hiddens (separated_buffer.py
+    # recurrent generator).  Setting it EQUAL to episode_length degenerates
+    # to the reference's naive-recurrent generator (full-episode items from
+    # the t=0 hidden) — one knob covers both generators.
+    data_chunk_length: int = 10
     # MO-MAT scalarization weights, comma-separated floats ("99,1" etc.);
     # empty = equal weights.  Reconstruction of the missing ``momat_trainer``
     # around the surviving ``mo_shared_buffer.py`` per-objective GAE.

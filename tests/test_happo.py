@@ -157,6 +157,29 @@ class TestRecurrentHAPPO:
         _, _, _, metrics = _train_loop(trainer, collector, 2)
         assert abs(float(metrics.factor_mean) - 1.0) > 1e-4
 
+    def test_naive_recurrent_mode(self):
+        """data_chunk_length == episode_length degenerates to the reference's
+        NAIVE-recurrent generator: whole episodes as minibatch items, GRU
+        re-run from the t=0 hidden (separated_buffer.py:236-318)."""
+        from mat_dcml_tpu.training.mappo import chunk_start_states, chunk_windows
+
+        # pin the generator semantics at the L == T edge: one window per env,
+        # the window IS the whole episode, h0 IS the stored t=0 hidden
+        x = jnp.arange(T * 4 * 3, dtype=jnp.float32).reshape(T, 4, 3)
+        w = chunk_windows(x, L=T, n_batch=1)
+        assert w.shape == (4, T, 3)
+        np.testing.assert_array_equal(np.asarray(w), np.asarray(x).swapaxes(0, 1))
+        h = jnp.arange(T * 4 * 2, dtype=jnp.float32).reshape(T, 4, 2)
+        h0 = chunk_start_states(h, L=T, n_batch=1)
+        np.testing.assert_array_equal(np.asarray(h0), np.asarray(h[0]))
+
+        env, pol, cfg, collector = _setup_recurrent({"data_chunk_length": T,
+                                                     "ppo_epoch": 2})
+        trainer = HAPPOTrainer(pol, cfg, n_agents=env.n_agents)
+        _, _, _, metrics = _train_loop(trainer, collector, 2)
+        for m in metrics:
+            assert np.isfinite(float(m)), metrics
+
     def test_chunk_length_must_divide_episode(self):
         env, pol, cfg, collector = _setup_recurrent({"data_chunk_length": 3})
         trainer = HAPPOTrainer(pol, cfg, n_agents=env.n_agents)
