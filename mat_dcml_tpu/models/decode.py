@@ -132,6 +132,12 @@ def ar_decode(
 
         fused_weights, _ = pack_decode_weights(params, cfg)
         cache_keys = ("k1", "v1", "k2", "v2")
+        # the kernel holds KV caches position-major ((L, B, D) — Mosaic can't
+        # lower the per-position write in (B, L, D) layout); fresh caches are
+        # zeros, so the transpose folds away at trace time
+        caches = [
+            {k: jnp.swapaxes(c[k], 0, 1) for k in cache_keys} for c in caches
+        ]
 
         def decode_step(caches, shifted_in, i):
             rep_i = jax.lax.dynamic_slice_in_dim(obs_rep, i, 1, axis=1)[:, 0]

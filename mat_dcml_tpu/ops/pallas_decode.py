@@ -181,25 +181,32 @@ def _layer_norm(x, scale, bias, eps=1e-6):
 def _cached_attention(q, k_cache, v_cache, i, n_head):
     """Single-position attention over a cache; f32 scores + softmax.
 
-    q: (TB, D); k_cache/v_cache: (TB, L, D); mask positions > i.
+    q: (TB, D); k_cache/v_cache: (L, TB, D); mask positions > i.
+
+    Caches are laid out position-MAJOR: the per-position write then only
+    needs a leading-unit-dim expand of the (TB, D) value, which Mosaic
+    lowers (the (TB, L, D) layout's write needs a sublane->major relayout
+    — ``tpu.reshape vector<TBxD> -> vector<TBx1xD>`` — that
+    infer-vector-layout rejects; every pattern below is validated by
+    ``scripts/mosaic_probe.py`` via chipless AOT compilation).
     """
-    TB, L, D = k_cache.shape
+    L, TB, D = k_cache.shape
     dh = D // n_head
     scale = 1.0 / math.sqrt(dh)
-    pos = jax.lax.broadcasted_iota(jnp.int32, (1, L), 1)
-    valid = pos <= i                                       # (1, L)
+    pos = jax.lax.broadcasted_iota(jnp.int32, (L, 1), 0)
+    valid = pos <= i                                       # (L, 1)
     outs = []
     for h in range(n_head):
         qh = q[:, h * dh : (h + 1) * dh].astype(jnp.float32)          # (TB, dh)
-        kh = k_cache[:, :, h * dh : (h + 1) * dh].astype(jnp.float32)  # (TB, L, dh)
+        kh = k_cache[:, :, h * dh : (h + 1) * dh].astype(jnp.float32)  # (L, TB, dh)
         vh = v_cache[:, :, h * dh : (h + 1) * dh]
         # broadcast-multiply-reduce instead of batched dot_general: the
         # contractions are tiny (dh<=64) and this form always lowers on
-        # Mosaic (lane reduce for scores, sublane reduce for the output)
-        scores = jnp.sum(qh[:, None, :] * kh, axis=-1) * scale         # (TB, L)
+        # Mosaic (lane reduce for scores, major reduce for the output)
+        scores = jnp.sum(qh[None] * kh, axis=-1) * scale               # (L, TB)
         scores = jnp.where(valid, scores, NEG_INF)
-        w = jax.nn.softmax(scores, axis=-1)
-        outs.append(jnp.sum(w[:, :, None] * vh.astype(jnp.float32), axis=1))
+        w = jax.nn.softmax(scores, axis=0)
+        outs.append(jnp.sum(w[:, :, None] * vh.astype(jnp.float32), axis=0))
     return jnp.concatenate(outs, axis=-1)                  # (TB, D) f32
 
 
@@ -209,8 +216,9 @@ def _decoder_block_body(
     mlp_w1_ref, mlp_b1_ref, mlp_w2_ref, mlp_b2_ref, lns_ref,
     k1_ref, v1_ref, k2_ref, v2_ref,
 ):
-    """One DecodeBlock position: write K/V at ``i`` into the given cache refs,
-    attend over them, LN/MLP — shared by the per-position and whole-decode
+    """One DecodeBlock position: write K/V at ``i`` into the given cache refs
+    (position-major (L, TB, D) layout — see ``_cached_attention``), attend
+    over them, LN/MLP — shared by the per-position and whole-decode
     kernels so their numerics cannot drift apart (models/modules.py
     ``DecodeBlock.decode_step`` is the XLA twin both are pinned to)."""
     lns = lns_ref[b]
@@ -220,8 +228,8 @@ def _decoder_block_body(
     q1 = _mm(x, w1[:, :D]) + b1[:D]
     k1 = _mm(x, w1[:, D : 2 * D]) + b1[D : 2 * D]
     v1 = _mm(x, w1[:, 2 * D : 3 * D]) + b1[2 * D : 3 * D]
-    k1_ref[:, pl.ds(i, 1), :] = k1[:, None, :]
-    v1_ref[:, pl.ds(i, 1), :] = v1[:, None, :]
+    k1_ref[pl.ds(i, 1)] = k1[None]
+    v1_ref[pl.ds(i, 1)] = v1[None]
     att1 = _cached_attention(q1, k1_ref[:], v1_ref[:], i, n_head).astype(dtype)
     y1 = _mm(att1, w1[:, 3 * D :]) + b1[3 * D :]
     h = _layer_norm(x + y1, lns[0], lns[1])
@@ -232,8 +240,8 @@ def _decoder_block_body(
     q2 = _mm(rep, w2[:, :D]) + b2[:D]
     k2 = _mm(h, w2[:, D : 2 * D]) + b2[D : 2 * D]
     v2 = _mm(h, w2[:, 2 * D : 3 * D]) + b2[2 * D : 3 * D]
-    k2_ref[:, pl.ds(i, 1), :] = k2[:, None, :]
-    v2_ref[:, pl.ds(i, 1), :] = v2[:, None, :]
+    k2_ref[pl.ds(i, 1)] = k2[None]
+    v2_ref[pl.ds(i, 1)] = v2[None]
     att2 = _cached_attention(q2, k2_ref[:], v2_ref[:], i, n_head).astype(dtype)
     y2 = _mm(att2, w2[:, 3 * D :]) + b2[3 * D :]
     h2 = _layer_norm(rep + y2, lns[2], lns[3])
@@ -417,7 +425,7 @@ def _ar_decode_kernel(
     TB, _, D = rep_ref.shape
     adim_pad = gumbel_ref.shape[2]
     n_rows = normal_ref.shape[1]
-    Ap = cache_refs[0].shape[1]
+    Ap = cache_refs[0].shape[0]
     dtype = cache_refs[0].dtype
     j = pl.program_id(1)
 
@@ -593,7 +601,7 @@ def fused_ar_decode(
         out_specs=[pl.BlockSpec((TB, Ap), lambda g, j: (g, 0))] * 2,
         out_shape=[jax.ShapeDtypeStruct((Bp, Ap), jnp.float32)] * 2,
         scratch_shapes=[pltpu.VMEM((TB, adim_pad), jnp.float32)]
-        + [pltpu.VMEM((TB, Ap, D), obs_rep.dtype)] * (4 * n_block),
+        + [pltpu.VMEM((Ap, TB, D), obs_rep.dtype)] * (4 * n_block),
         interpret=interpret,
     )(*ops)
     return act[:B, :A], logp[:B, :A]
@@ -603,7 +611,7 @@ def fused_decode_step(
     weights: DecodeStepWeights,
     x_in: jax.Array,            # (B, in_dim) current position's input
     rep_i: jax.Array,           # (B, D) encoder rep at position i
-    caches: Sequence[jax.Array],  # 4*n_block arrays (B, L, D)
+    caches: Sequence[jax.Array],  # 4*n_block arrays (L, B, D) position-major
     i: jax.Array,               # scalar int32 position
     *,
     n_head: int,
@@ -614,7 +622,7 @@ def fused_decode_step(
     """Returns (logits (B, adim) f32, new_caches)."""
     B, D = rep_i.shape
     n_block = weights.block_qkvp1_w.shape[0]
-    L = caches[0].shape[1]
+    L = caches[0].shape[0]
     in_dim_pad = weights.embed_w.shape[0]
     adim_pad = weights.head_w2.shape[1]
 
@@ -630,7 +638,7 @@ def fused_decode_step(
     if pad_b:
         x_in = jnp.pad(x_in, ((0, pad_b), (0, 0)))
         rep_i = jnp.pad(rep_i, ((0, pad_b), (0, 0)))
-        caches = [jnp.pad(c, ((0, pad_b), (0, 0), (0, 0))) for c in caches]
+        caches = [jnp.pad(c, ((0, 0), (0, pad_b), (0, 0))) for c in caches]
     Bp = B + pad_b
     if x_in.shape[1] < in_dim_pad:
         x_in = jnp.pad(x_in, ((0, 0), (0, in_dim_pad - x_in.shape[1])))
@@ -646,7 +654,7 @@ def fused_decode_step(
         w.block_mlp_w1, w.block_mlp_b1, w.block_mlp_w2, w.block_mlp_b2,
         w.block_lns, w.head_w1, w.head_b1, w.head_ln, w.head_w2, w.head_b2,
     )]
-    cache_spec = pl.BlockSpec((TB, L, D), lambda g, i_s: (g, 0, 0))
+    cache_spec = pl.BlockSpec((L, TB, D), lambda g, i_s: (0, g, 0))
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
@@ -663,7 +671,7 @@ def fused_decode_step(
     aliases = {first_cache_arg + k: 1 + k for k in range(4 * n_block)}
 
     out_shapes = [jax.ShapeDtypeStruct((Bp, adim_pad), jnp.float32)] + [
-        jax.ShapeDtypeStruct((Bp, L, D), caches[0].dtype) for _ in range(4 * n_block)
+        jax.ShapeDtypeStruct((L, Bp, D), caches[0].dtype) for _ in range(4 * n_block)
     ]
 
     kernel = functools.partial(_decode_step_kernel, n_block=n_block, n_head=n_head)
@@ -681,5 +689,5 @@ def fused_decode_step(
       *caches)
 
     logits = outs[0][:B, :adim]
-    new_caches = [c[:B] for c in outs[1:]]
+    new_caches = [c[:, :B] for c in outs[1:]]
     return logits, new_caches

@@ -58,13 +58,26 @@ def main():
     policy = build_mat_policy(run, env)
     params = policy.init_params(jax.random.key(0))
 
-    def timed(fn, *args, iters=20):
+    def timed(fn, *args, iters=20, chain=None, vary_key=None):
+        """Time ``fn`` with a block after EVERY call, never re-dispatching
+        identical args: chain=(out_idx, arg_idx) feeds that output component
+        back into the args; vary_key=arg_idx swaps in a fresh PRNG key each
+        call.  Repeat dispatches of one executable with unchanged args
+        measured dispatch-only on the tunneled TPU runtime (r5 session legs
+        1/3: 0.12 ms for a full AR decode), so every call must differ and
+        block before the next."""
+        args = list(args)
         out = fn(*args)
         jax.block_until_ready(out)
         t0 = time.perf_counter()
-        for _ in range(iters):
+        for i in range(iters):
+            if chain is not None:
+                out_idx, arg_idx = chain
+                args[arg_idx] = out if out_idx is None else out[out_idx]
+            if vary_key is not None:
+                args[vary_key] = jax.random.key(1000 + i)
             out = fn(*args)
-        jax.block_until_ready(out)
+            jax.block_until_ready(out)
         return (time.perf_counter() - t0) / iters
 
     row = {"E": E, "T": T}
@@ -78,14 +91,15 @@ def main():
     ga = jax.jit(
         lambda p, k, s, o, a: policy.get_actions(p, k, s, o, a, deterministic=False)
     )
-    dt = timed(ga, params, jax.random.key(7), ts0.share_obs, ts0.obs, ts0.available_actions)
+    dt = timed(ga, params, jax.random.key(7), ts0.share_obs, ts0.obs,
+               ts0.available_actions, vary_key=1)
     row["get_actions_ms"] = round(dt * 1e3, 3)
     log(f"get_actions: {dt*1e3:.3f} ms")
 
     # --- env.step variants
     def bench_step(tag):
         fn = jax.jit(jax.vmap(env.step))
-        dt = timed(fn, states, act)
+        dt = timed(fn, states, act, chain=(0, 0))
         row[f"env_step_{tag}_ms"] = round(dt * 1e3, 3)
         log(f"env.step [{tag}]: {dt*1e3:.3f} ms")
         return dt
@@ -113,7 +127,7 @@ def main():
         collector = RolloutCollector(env, policy, T)
         rstate = collector.init_state(jax.random.key(1), E)
         fn = jax.jit(collector.collect)
-        dt = timed(fn, params, rstate, iters=5)
+        dt = timed(fn, params, rstate, iters=5, chain=(0, 1))
         row[f"collect_{tag}_s"] = round(dt, 4)
         row[f"collect_{tag}_ms_per_step"] = round(dt / T * 1e3, 3)
         log(f"collect [{tag}]: {dt:.3f} s ({dt/T*1e3:.2f} ms/env-step)")

@@ -1,0 +1,38 @@
+#!/bin/bash
+# Wait for any in-flight chip session to end, then probe for a healthy TPU
+# grant and run scripts/tpu_session5b.sh (the session-5 recovery legs).
+# Single-client discipline: never probe while tpu_session5.sh still runs.
+# Stops probing at TPU_RETRY_STOP_AT (default 01:30 UTC) so a late grant
+# never collides with the round driver's own bench window.
+cd "$(dirname "$0")/.."
+mkdir -p artifacts/r5
+STOP_AT="${TPU_RETRY_STOP_AT:-01:30}"
+stop=$(date -u -d "today $STOP_AT" +%s)
+[ "$stop" -le "$(date -u +%s)" ] && stop=$(date -u -d "tomorrow $STOP_AT" +%s)
+
+while pgrep -f "bash scripts/tpu_session5.sh" > /dev/null; do
+  echo "[retry5b] session 5 still running at $(date -u +%H:%M:%S); waiting" >> artifacts/r5/retry5b.log
+  sleep 300
+  [ "$(date -u +%s)" -ge "$stop" ] && { echo "[retry5b] stop reached while waiting" >> artifacts/r5/retry5b.log; exit 0; }
+done
+
+n=0
+while [ "$(date -u +%s)" -lt "$stop" ]; do
+  n=$((n + 1))
+  echo "[retry5b] probe $n at $(date -u +%H:%M:%S)" >> artifacts/r5/retry5b.log
+  if timeout 2400 python -c "
+import jax
+d = jax.devices()
+assert d and d[0].platform == 'tpu', d
+import jax.numpy as jnp
+assert float((jnp.ones((8,8)) @ jnp.ones((8,8))).sum()) == 512.0
+print('healthy:', d)
+" >> artifacts/r5/retry5b.log 2>&1; then
+    echo "[retry5b] healthy at $(date -u +%H:%M:%S); starting session 5b" >> artifacts/r5/retry5b.log
+    bash scripts/tpu_session5b.sh >> artifacts/r5/session5b.log 2>&1
+    echo "[retry5b] session 5b finished at $(date -u +%H:%M:%S)" >> artifacts/r5/retry5b.log
+    exit 0
+  fi
+  sleep 120
+done
+echo "[retry5b] stop time $STOP_AT reached; no healthy grant" >> artifacts/r5/retry5b.log

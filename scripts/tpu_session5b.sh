@@ -1,0 +1,68 @@
+#!/bin/bash
+# Round-5 chip session 5b: re-measure what session 5 lost to the 16:20 UTC
+# tunnel outage and to the repeat-dispatch timing artifact.
+#
+# Session 5 landed leg 1's combined number of record (2409 env-steps/s, 330x)
+# and leg 2's attention A/B (Pallas attention LOSES: 1654 vs 2409 — XLA
+# default confirmed).  But (a) legs 1/3's per-phase and micro timings used
+# repeat dispatches of identical args, which this runtime measures as
+# dispatch-only (bench.py/scripts now chain outputs + block per call), (b)
+# leg 3's whole-decode kernel failed Mosaic lowering (fixed: position-major
+# cache layout, see ops/pallas_decode.py + scripts/mosaic_probe.py), and
+# (c) legs 4/5/6 died when the tunnel's compile endpoint went down.
+# One TPU client at a time; the caller verified a healthy grant.
+set -x
+cd "$(dirname "$0")/.."
+mkdir -p artifacts/r5
+export BENCH_TPU_PROBE_TIMEOUT=0
+export MAT_DCML_TPU_DECODE_IMPL=xla
+
+STOP_AT="${TPU_SESSION_STOP_AT:-02:00}"
+now=$(date -u +%s)
+stop=$(date -u -d "today $STOP_AT" +%s) || { echo "bad TPU_SESSION_STOP_AT=$STOP_AT"; exit 1; }
+[ "$stop" -le "$now" ] && stop=$(date -u -d "tomorrow $STOP_AT" +%s)
+budget() {
+  local cap=$1 rem=$(( stop - $(date -u +%s) ))
+  [ "$rem" -lt 60 ] && { echo 0; return; }
+  [ "$rem" -lt "$cap" ] && echo "$rem" || echo "$cap"
+}
+need() { t=$(budget "$1"); [ "$t" -gt 0 ] && return 0
+         echo "=== past hard stop $STOP_AT UTC; ending session ==="; exit 0; }
+
+echo "=== 5b.1 combined bench + CHAINED per-phase breakdown (E=256, bf16, XLA) ==="
+need 3000
+BENCH_N_ENVS=256 BENCH_ITERS=3 BENCH_BREAKDOWN=1 timeout "$t" python bench.py \
+  > artifacts/r5/bench_e256_xla_b.json 2> artifacts/r5/bench_e256_xla_b.log
+cat artifacts/r5/bench_e256_xla_b.json
+
+echo "=== 5b.2 decode A/B: layout-fixed whole-decode kernel vs XLA scan ==="
+need 3000
+timeout "$t" python scripts/tpu_decode_bench.py 256 512 \
+  > artifacts/r5/decode_bench_b.json 2> artifacts/r5/decode_bench_b.log
+cat artifacts/r5/decode_bench_b.json
+
+echo "=== 5b.3 collect decomposition (chained timing) ==="
+need 3000
+timeout "$t" python scripts/tpu_collect_bench.py 256 \
+  > artifacts/r5/collect_bench_b.json 2> artifacts/r5/collect_bench_b.log
+cat artifacts/r5/collect_bench_b.json
+
+if [ ! -s artifacts/r5/bench_sweep.json ]; then
+  echo "=== 5b.4 E-ladder with remat+grad-accum (lost to the outage) ==="
+  need 5400
+  BENCH_SWEEP=1 BENCH_SWEEP_ENVS=256,512,1024,2048,4096,8192 BENCH_BREAKDOWN=1 \
+    BENCH_ITERS=3 timeout "$t" python bench.py \
+    > artifacts/r5/bench_sweep.json 2> artifacts/r5/bench_sweep.log
+  cat artifacts/r5/bench_sweep.json
+fi
+
+if [ ! -s artifacts/r5/bench_e256_f32.json ]; then
+  echo "=== 5b.5 f32-trunk baseline (lost to the outage) ==="
+  need 3000
+  BENCH_DTYPE=float32 BENCH_N_ENVS=256 BENCH_ITERS=3 BENCH_BREAKDOWN=1 \
+    timeout "$t" python bench.py \
+    > artifacts/r5/bench_e256_f32.json 2> artifacts/r5/bench_e256_f32.log
+  cat artifacts/r5/bench_e256_f32.json
+fi
+
+echo "=== session 5b complete ==="
