@@ -42,6 +42,14 @@ class RunConfig:
     # capture a jax.profiler trace of one post-warmup training iteration
     # (collect + train) into this directory; TensorBoard-viewable
     profile_dir: Optional[str] = None
+    # telemetry (telemetry/): sample the blocking step timers, NaN-guard
+    # fetch, and device/host gauges every N iterations (0 disables sampling;
+    # counters and the recompile detector stay on).  The registry flushes
+    # into the jsonl record at every log_interval.
+    telemetry_interval: int = 1
+    # annotate model/trainer phases with jax.named_scope so xplane traces and
+    # scripts/trace_report.py group op time semantically; trace-time only
+    trace_named_scopes: bool = True
     # model
     n_block: int = 2
     n_embd: int = 64

@@ -149,12 +149,15 @@ class GRULayer(nn.Module):
         to the reference's flattened (T*B) path (``rnn.py:31-74``).
         """
 
-        def body(h, inp):
+        def body(mdl, h, inp):
             x_t, m_t = inp
-            out, h = self(x_t, h, m_t)
+            out, h = mdl(x_t, h, m_t)
             return h, out
 
-        # Plain lax.scan over the bound module: parameters are created by the
-        # single-step path at init time, so apply-time scanning is safe.
-        final_h, outs = jax.lax.scan(body, hxs, (xs, masks))
+        # nn.scan (not raw lax.scan over the bound module): flax forbids
+        # calling submodules from a different trace level than they were
+        # bound at; params broadcast across steps, no per-step rngs
+        final_h, outs = nn.scan(
+            body, variable_broadcast="params", split_rngs={"params": False}
+        )(self, hxs, (xs, masks))
         return outs, final_h

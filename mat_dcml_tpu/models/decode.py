@@ -33,6 +33,7 @@ from mat_dcml_tpu.models.mat import (
     NORMAL_STD,
 )
 from mat_dcml_tpu.ops import distributions as D
+from mat_dcml_tpu.telemetry.scopes import named_scope
 
 
 class DecodeResult(NamedTuple):
@@ -196,9 +197,10 @@ def ar_decode(
             nxt = jnp.zeros((B, 1, in_dim), jnp.float32).at[:, 0, 1:].set(act)
         return (caches, nxt, key), (act, logp)
 
-    (_, _, _), (acts, logps) = jax.lax.scan(
-        body, (caches, start_token, key), jnp.arange(A)
-    )
+    with named_scope("mat/ar_decode"):
+        (_, _, _), (acts, logps) = jax.lax.scan(
+            body, (caches, start_token, key), jnp.arange(A)
+        )
     # scan stacks on axis 0 -> (A, B, d); move agents to axis 1.
     action = jnp.swapaxes(acts, 0, 1)
     log_prob = jnp.swapaxes(logps, 0, 1)

@@ -34,6 +34,7 @@ from mat_dcml_tpu.models.modules import (
     dense,
     init_decode_cache,
 )
+from mat_dcml_tpu.telemetry.scopes import named_scope
 
 DISCRETE = "discrete"
 SEMI_DISCRETE = "semi_discrete"
@@ -135,12 +136,13 @@ class Encoder(nn.Module):
         self.head = Head(c.n_embd, c.n_objective)
 
     def __call__(self, state: jax.Array, obs: jax.Array):
-        x = self.state_encoder(state) if self.cfg.encode_state else self.obs_encoder(obs)
-        rep = self.ln(x)
-        for blk in self.blocks:
-            rep = blk(rep)
-        v_loc = self.head(rep)
-        return v_loc, rep
+        with named_scope("mat/encoder"):
+            x = self.state_encoder(state) if self.cfg.encode_state else self.obs_encoder(obs)
+            rep = self.ln(x)
+            for blk in self.blocks:
+                rep = blk(rep)
+            v_loc = self.head(rep)
+            return v_loc, rep
 
 
 class DecActorMlp(nn.Module):
@@ -205,12 +207,13 @@ class Decoder(nn.Module):
 
     def __call__(self, shifted_action: jax.Array, obs_rep: jax.Array, obs: jax.Array) -> jax.Array:
         """Full teacher-forced pass -> ``(B, n_agent, action_dim)`` logits."""
-        if self.cfg.dec_actor:
-            return self.mlp(obs)
-        x = self.ln(self._embed_action(shifted_action))
-        for blk in self.blocks:
-            x = blk(x, obs_rep)
-        return self.head(x)
+        with named_scope("mat/decoder"):
+            if self.cfg.dec_actor:
+                return self.mlp(obs)
+            x = self.ln(self._embed_action(shifted_action))
+            for blk in self.blocks:
+                x = blk(x, obs_rep)
+            return self.head(x)
 
     def decode_step(self, shifted_action_i: jax.Array, rep_i: jax.Array, obs_i: jax.Array, caches, i):
         """One autoregressive position with KV caches.
@@ -226,14 +229,15 @@ class Decoder(nn.Module):
         Returns:
           ``(B, 1, action_dim)`` logits and updated caches.
         """
-        if self.cfg.dec_actor:
-            return self.mlp(obs_i) if self.cfg.share_actor else self._dec_actor_step(obs_i, i), caches
-        x = self.ln(self._embed_action(shifted_action_i))
-        new_caches = []
-        for blk, cache in zip(self.blocks, caches):
-            x, cache = blk.decode_step(x, rep_i, cache, i)
-            new_caches.append(cache)
-        return self.head(x), new_caches
+        with named_scope("mat/decoder_step"):
+            if self.cfg.dec_actor:
+                return self.mlp(obs_i) if self.cfg.share_actor else self._dec_actor_step(obs_i, i), caches
+            x = self.ln(self._embed_action(shifted_action_i))
+            new_caches = []
+            for blk, cache in zip(self.blocks, caches):
+                x, cache = blk.decode_step(x, rep_i, cache, i)
+                new_caches.append(cache)
+            return self.head(x), new_caches
 
     def _dec_actor_step(self, obs_i: jax.Array, i):
         # Per-agent MLP selected by index: run all agents' MLPs on the same

@@ -20,6 +20,8 @@ from typing import Tuple
 import jax
 import jax.numpy as jnp
 
+from mat_dcml_tpu.telemetry.scopes import named_scope
+
 
 def compute_gae(
     rewards: jax.Array,
@@ -47,8 +49,9 @@ def compute_gae(
         gae = delta + gamma * gae_lambda * m_next * gae
         return gae, gae
 
-    inputs = (rewards, values[:-1], values[1:], masks[1:])
-    init = jnp.zeros_like(rewards[0])
-    _, adv = jax.lax.scan(step, init, inputs, reverse=True)
-    returns = adv + values[:-1]
-    return adv, returns
+    with named_scope("ops/gae"):
+        inputs = (rewards, values[:-1], values[1:], masks[1:])
+        init = jnp.zeros_like(rewards[0])
+        _, adv = jax.lax.scan(step, init, inputs, reverse=True)
+        returns = adv + values[:-1]
+        return adv, returns

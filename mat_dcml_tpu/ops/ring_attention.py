@@ -117,7 +117,11 @@ def ring_attention_sharded(
     """Convenience wrapper: shard_map ``ring_attention`` with the length axis
     of global ``(B, H, L, Dh)`` inputs sharded over ``axis_name``."""
     from jax.sharding import PartitionSpec as P
-    from jax import shard_map
+
+    try:                                    # top-level API (jax >= 0.6)
+        from jax import shard_map
+    except ImportError:                     # jax 0.4.x
+        from jax.experimental.shard_map import shard_map
 
     spec = P(None, None, axis_name, None)
     fn = shard_map(

@@ -19,7 +19,11 @@ idle n-1 devices; collection scales over the ``data`` axis instead.
 from __future__ import annotations
 
 import jax
-from jax import shard_map
+
+try:                                        # top-level API (jax >= 0.6)
+    from jax import shard_map
+except ImportError:                         # jax 0.4.x
+    from jax.experimental.shard_map import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from mat_dcml_tpu.ops import attention as _attn

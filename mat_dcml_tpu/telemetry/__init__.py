@@ -1,0 +1,25 @@
+"""Unified observability layer: metric registry, jit instrumentation, scopes.
+
+Podracer-style (arXiv:2104.06272) visible accounting for the collect/train
+loop: a :class:`Telemetry` registry of counters/gauges/timers flushed into the
+jsonl metrics stream, a recompile-detecting ``jax.jit`` wrapper, semantic
+``jax.named_scope`` annotations for xplane traces, and device/host gauges.
+Everything is dependency-free and jit-safe — host-side observation happens
+only at call boundaries and flush time, never inside a trace.
+"""
+
+from mat_dcml_tpu.telemetry.jit_instrument import InstrumentedJit, instrumented_jit
+from mat_dcml_tpu.telemetry.registry import Telemetry
+from mat_dcml_tpu.telemetry.scopes import named_scope, named_scopes_enabled, set_named_scopes
+from mat_dcml_tpu.telemetry.system import device_memory_gauges, host_rss_bytes
+
+__all__ = [
+    "InstrumentedJit",
+    "Telemetry",
+    "device_memory_gauges",
+    "host_rss_bytes",
+    "instrumented_jit",
+    "named_scope",
+    "named_scopes_enabled",
+    "set_named_scopes",
+]

@@ -1,0 +1,131 @@
+"""Recompile-detecting ``jax.jit`` wrapper.
+
+The trainers' two hot functions (``collect`` / ``train``) compile once at
+warmup and must never recompile in steady state — a silent steady-state
+recompile (shape drift, weak-type flip, python-scalar leak) is the classic
+"why did steps/sec fall off a cliff" failure in JAX RL stacks.  This wrapper
+makes every compile *visible*:
+
+- explicit AOT compile cache keyed by the abstract signature of the call
+  (treedef + per-leaf shape/dtype/weak-type), so compiles are counted and
+  timed exactly — no heuristics;
+- per-function and aggregate counters into a :class:`Telemetry` registry:
+  ``compile_count``, ``compile_seconds_total``, ``compile_count_<name>``;
+- after :meth:`InstrumentedJit.mark_steady` (the runner calls it once warmup
+  is done), further compiles also bump ``steady_state_recompiles`` and log a
+  loud warning naming the function;
+- the compiler's analytic FLOP count for the compiled executable is kept on
+  ``flops_per_call`` (the THOP hook of ``utils/profiling.py``, now free at
+  compile time).
+
+Any failure in the AOT path falls back to a plain ``jax.jit`` call — the
+wrapper may under-count in that case but can never break training.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Optional
+
+import jax
+
+from mat_dcml_tpu.telemetry.registry import Telemetry
+from mat_dcml_tpu.utils.profiling import compiled_flops
+
+
+def _abstract_signature(args, kwargs):
+    """Hashable key matching jit's cache granularity for array-only calls:
+    pytree structure + (shape, dtype, weak_type) per array leaf; python
+    scalars key by type only (jit treats them as weak-typed values)."""
+    leaves, treedef = jax.tree.flatten((args, kwargs))
+    sig = []
+    for leaf in leaves:
+        if hasattr(leaf, "shape") and hasattr(leaf, "dtype"):
+            sig.append((
+                tuple(leaf.shape),
+                str(leaf.dtype),
+                bool(getattr(leaf, "weak_type", False)),
+            ))
+        else:
+            sig.append(("py", type(leaf).__name__))
+    return treedef, tuple(sig)
+
+
+class InstrumentedJit:
+    def __init__(
+        self,
+        fn: Callable,
+        name: str,
+        telemetry: Optional[Telemetry] = None,
+        log_fn: Callable[[str], Any] = print,
+        **jit_kwargs,
+    ):
+        self._jit = jax.jit(fn, **jit_kwargs)
+        self.name = name
+        self.telemetry = telemetry if telemetry is not None else Telemetry()
+        self.log = log_fn
+        self._compiled = {}            # signature -> compiled executable | None
+        self._steady = False
+        self.compile_count = 0
+        self.compile_seconds = 0.0
+        self.flops_per_call: Optional[float] = None
+
+    def mark_steady(self) -> None:
+        """Warmup is over: any compile from now on is unexpected."""
+        self._steady = True
+
+    def _compile(self, key, args, kwargs):
+        t0 = time.perf_counter()
+        try:
+            compiled = self._jit.lower(*args, **kwargs).compile()
+        except Exception:
+            compiled = None            # plain-jit fallback (still compiles there)
+        dt = time.perf_counter() - t0
+        self.compile_count += 1
+        self.compile_seconds += dt
+        tel = self.telemetry
+        tel.count("compile_count")
+        tel.count("compile_seconds_total", dt)
+        tel.count(f"compile_count_{self.name}")
+        if self._steady:
+            tel.count("steady_state_recompiles")
+            self.log(
+                f"[telemetry] WARNING: steady-state recompile of '{self.name}' "
+                f"(compile #{self.compile_count}, {dt:.2f}s) — check for shape/"
+                f"dtype drift in its inputs"
+            )
+        if compiled is not None:
+            flops = compiled_flops(compiled)
+            if flops is not None:
+                self.flops_per_call = flops
+        self._compiled[key] = compiled
+        return compiled
+
+    def __call__(self, *args, **kwargs):
+        try:
+            key = _abstract_signature(args, kwargs)
+        except Exception:
+            return self._jit(*args, **kwargs)
+        if key not in self._compiled:
+            self._compile(key, args, kwargs)
+        compiled = self._compiled[key]
+        if compiled is None:
+            return self._jit(*args, **kwargs)
+        try:
+            return compiled(*args, **kwargs)
+        except Exception:
+            # AOT executables are stricter than jit (committed devices,
+            # layouts); never let instrumentation break the call.
+            self._compiled[key] = None
+            return self._jit(*args, **kwargs)
+
+
+def instrumented_jit(
+    fn: Callable,
+    name: str,
+    telemetry: Optional[Telemetry] = None,
+    log_fn: Callable[[str], Any] = print,
+    **jit_kwargs,
+) -> InstrumentedJit:
+    """Drop-in for ``jax.jit(fn)`` that counts and times every compile."""
+    return InstrumentedJit(fn, name, telemetry, log_fn, **jit_kwargs)

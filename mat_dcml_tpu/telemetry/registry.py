@@ -1,0 +1,108 @@
+"""Counter / gauge / timer registry with per-interval aggregation.
+
+One :class:`Telemetry` instance rides along a training run.  Host code records
+observations between metric flushes; :meth:`Telemetry.flush` collapses them
+into a flat ``{name: scalar}`` dict that merges into the jsonl record the
+existing :class:`~mat_dcml_tpu.utils.metrics.MetricsWriter` already streams,
+so BENCH tooling consumes telemetry unchanged.
+
+Semantics:
+
+- **counters** are cumulative for the life of the run (``compile_count``,
+  ``nonfinite_grad_steps``, ...) and emitted as-is on every flush.  Counters
+  registered with :meth:`rate` additionally emit a ``*_per_sec`` rate over the
+  flush interval (used for env/agent-step throughput).
+- **gauges** are last-value-wins samples (device memory, host RSS).
+- **observations** (incl. :meth:`timer`) aggregate per flush interval: the
+  mean is emitted under the bare name plus ``<name>_max`` and ``<name>_sum``,
+  then the series resets.
+- **once** values appear in exactly one flush (``flops_per_step``).
+
+Nothing here touches jax: recording is plain Python and safe to call from
+anywhere on the host, but never from inside a traced function.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Dict, List, Optional
+
+
+class Telemetry:
+    def __init__(self, enabled: bool = True):
+        self.enabled = enabled
+        self.counters: Dict[str, float] = {}
+        self._gauges: Dict[str, float] = {}
+        self._obs: Dict[str, List[float]] = {}
+        self._once: Dict[str, float] = {}
+        self._rates: Dict[str, str] = {}            # counter name -> rate name
+        self._last_flush: Optional[float] = None
+        self._counters_at_flush: Dict[str, float] = {}
+
+    # ------------------------------------------------------------- recording
+
+    def count(self, name: str, n: float = 1.0) -> None:
+        if self.enabled:
+            self.counters[name] = self.counters.get(name, 0.0) + n
+
+    def gauge(self, name: str, value: float) -> None:
+        if self.enabled:
+            self._gauges[name] = float(value)
+
+    def observe(self, name: str, value: float) -> None:
+        if self.enabled:
+            self._obs.setdefault(name, []).append(float(value))
+
+    def once(self, name: str, value: float) -> None:
+        """Record a value emitted in the next flush only."""
+        if self.enabled:
+            self._once[name] = float(value)
+
+    @contextlib.contextmanager
+    def timer(self, name: str):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.observe(name, time.perf_counter() - t0)
+
+    def rate(self, counter_name: str, rate_name: str) -> None:
+        """Emit ``rate_name`` = delta(counter) / flush-interval seconds."""
+        self._rates[counter_name] = rate_name
+
+    # --------------------------------------------------------------- flushing
+
+    def start_interval(self) -> None:
+        """(Re)anchor the rate clock — call once right before the loop starts
+        so the first flush's rates exclude setup/compile time spent earlier."""
+        self._last_flush = time.perf_counter()
+        self._counters_at_flush = dict(self.counters)
+
+    def flush(self) -> Dict[str, float]:
+        """Aggregate the interval and return a flat record fragment.
+
+        Counters persist (cumulative); gauges persist (last value); observed
+        series and once-values reset.
+        """
+        if not self.enabled:
+            return {}
+        now = time.perf_counter()
+        rec: Dict[str, float] = {}
+        for name, v in self.counters.items():
+            rec[name] = v
+        dt = (now - self._last_flush) if self._last_flush is not None else None
+        for cname, rname in self._rates.items():
+            delta = self.counters.get(cname, 0.0) - self._counters_at_flush.get(cname, 0.0)
+            rec[rname] = (delta / dt) if dt and dt > 0 else 0.0
+        rec.update(self._gauges)
+        for name, series in self._obs.items():
+            rec[name] = sum(series) / len(series)
+            rec[name + "_max"] = max(series)
+            rec[name + "_sum"] = sum(series)
+        rec.update(self._once)
+        self._obs.clear()
+        self._once.clear()
+        self._last_flush = now
+        self._counters_at_flush = dict(self.counters)
+        return rec

@@ -1,0 +1,33 @@
+"""Semantic ``jax.named_scope`` annotations, globally toggleable.
+
+Models and trainers wrap their phases in :func:`named_scope` so xplane traces
+(and ``scripts/trace_report.py``) group op time by meaning — encoder forward,
+autoregressive decode, GAE, PPO update — instead of a flat HLO op soup.
+Scopes are applied at *trace* time only (zero steady-state cost); the
+``--trace_named_scopes`` flag flips the module-level switch before anything
+compiles, and disabling yields a no-op context manager.
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+import jax
+
+_ENABLED = True
+
+
+def set_named_scopes(enabled: bool) -> None:
+    global _ENABLED
+    _ENABLED = bool(enabled)
+
+
+def named_scopes_enabled() -> bool:
+    return _ENABLED
+
+
+def named_scope(name: str):
+    """``jax.named_scope(name)`` when enabled, else a null context."""
+    if _ENABLED:
+        return jax.named_scope(name)
+    return contextlib.nullcontext()

@@ -1,0 +1,52 @@
+"""Device and host resource gauges, dependency-free.
+
+Sampled at flush boundaries only (host-side; never inside a trace).  Device
+memory comes from the PJRT client's ``memory_stats()`` — populated on TPU/GPU,
+``None`` on CPU, where the gauges degrade to 0 so the jsonl schema stays
+stable across backends.  Host RSS reads ``/proc/self/statm`` (Linux) with a
+``resource.getrusage`` peak-RSS fallback elsewhere.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, Optional
+
+import jax
+
+
+def device_memory_gauges(device=None) -> Dict[str, int]:
+    """``bytes_in_use`` / ``peak_bytes_in_use`` of one local device (0 when
+    the backend exposes no allocator stats, e.g. CPU)."""
+    try:
+        d = device if device is not None else jax.local_devices()[0]
+        stats = d.memory_stats() or {}
+    except Exception:
+        stats = {}
+    return {
+        "device_bytes_in_use": int(stats.get("bytes_in_use", 0)),
+        "device_peak_bytes": int(stats.get("peak_bytes_in_use", 0)),
+    }
+
+
+def host_rss_bytes() -> int:
+    """Current resident set size of this process in bytes (0 if unknown)."""
+    try:
+        with open("/proc/self/statm") as f:
+            rss_pages = int(f.read().split()[1])
+        return rss_pages * os.sysconf("SC_PAGE_SIZE")
+    except Exception:
+        pass
+    try:
+        import resource
+
+        # ru_maxrss is *peak* RSS in KiB on Linux (bytes on macOS); close
+        # enough for a fallback gauge.
+        peak = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+        return int(peak if peak > 1 << 32 else peak * 1024)
+    except Exception:
+        return 0
+
+
+def host_gauges() -> Dict[str, int]:
+    return {"host_rss_bytes": host_rss_bytes()}

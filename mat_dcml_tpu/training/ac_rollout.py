@@ -114,8 +114,11 @@ class ACRolloutCollector:
             out = self._apply(params, k_act, st)
             env_states, ts = jax.vmap(self.env.step)(st.env_states, out.action)
             done_env = ts.done.all(axis=1)
+            # strongly-typed float32 (see rollout.py): weak-typed masks in the
+            # scan carry force one steady-state recompile per run
             next_mask = jnp.broadcast_to(
-                jnp.where(done_env[:, None, None], 0.0, 1.0), st.mask.shape
+                jnp.where(done_env[:, None, None], jnp.float32(0.0), jnp.float32(1.0)),
+                st.mask.shape,
             )
             transition = dict(
                 share_obs=self._cent(st),

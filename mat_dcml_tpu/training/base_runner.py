@@ -23,6 +23,14 @@ import jax
 import numpy as np
 
 from mat_dcml_tpu.config import RunConfig
+from mat_dcml_tpu.telemetry import (
+    InstrumentedJit,
+    Telemetry,
+    device_memory_gauges,
+    host_rss_bytes,
+    instrumented_jit,
+    set_named_scopes,
+)
 from mat_dcml_tpu.training.checkpoint import CheckpointManager
 from mat_dcml_tpu.training.mappo import Bootstrap
 from mat_dcml_tpu.training.ppo import PPOConfig
@@ -104,13 +112,19 @@ class BaseRunner:
     def finalize(self, run: RunConfig, log_fn=print) -> None:
         self.run_cfg = run
         self.log = log_fn
+        set_named_scopes(run.trace_named_scopes)
+        self.telemetry = Telemetry()
+        self.telemetry.rate("env_steps", "env_steps_per_sec")
+        self.telemetry.rate("agent_steps", "agent_steps_per_sec")
         # host-loop collectors (vec-env bridge) drive jitted policy calls
         # internally and cannot themselves be traced
         if getattr(self.collector, "jittable", True):
-            self._collect = jax.jit(self.collector.collect)
+            self._collect = instrumented_jit(
+                self.collector.collect, "collect", self.telemetry, log_fn
+            )
         else:
             self._collect = self.collector.collect
-        self._train = jax.jit(self.trainer.train)
+        self._train = instrumented_jit(self.trainer.train, "train", self.telemetry, log_fn)
         self.run_dir = (
             Path(run.run_dir) / run.env_name / run.scenario / run.algorithm_name / run.experiment_name
         )
@@ -192,6 +206,11 @@ class BaseRunner:
         # on-device accounting aggregates (collectors emitting chunk_stats)
         agg_done = agg_rew = agg_delay = agg_pay = 0.0
 
+        tel = self.telemetry
+        env = getattr(self, "env", None) or getattr(self.collector, "env", None)
+        n_agents = int(getattr(env, "n_agents", 1) or 1)
+        tel.start_interval()
+
         start = time.time()
         for episode in range(self.start_episode, episodes):
             # profile ONE post-warmup iteration (episode start+1: compiles are
@@ -200,21 +219,32 @@ class BaseRunner:
             profiling = (
                 run.profile_dir is not None and episode == self.start_episode + 1
             )
+            # blocking step timers + NaN-guard fetch every telemetry_interval
+            # iterations (cheap — the collect->train chain is serially
+            # dependent anyway, the sync only pins wall time to a phase)
+            sampled = run.telemetry_interval > 0 and (
+                (episode - self.start_episode) % run.telemetry_interval == 0
+            )
             if profiling:
                 jax.profiler.start_trace(run.profile_dir)
             t_collect = time.perf_counter()
             rollout_state, traj = self._collect(train_state.params, rollout_state)
-            if profiling:
+            if profiling or sampled:
                 jax.block_until_ready(traj)
                 t_collect = time.perf_counter() - t_collect
+                if sampled:
+                    tel.observe("step_time_collect", t_collect)
             key, k_train = jax.random.split(key)
             t_train = time.perf_counter()
             train_state, metrics = self._train(
                 train_state, traj, self._bootstrap(rollout_state), k_train
             )
-            if profiling:
+            if profiling or sampled:
                 jax.block_until_ready(train_state)
                 t_train = time.perf_counter() - t_train
+                if sampled:
+                    tel.observe("step_time_train", t_train)
+            if profiling:
                 jax.profiler.stop_trace()
                 self.log(
                     f"[profile] trace -> {run.profile_dir}; compiled-step wall: "
@@ -225,6 +255,15 @@ class BaseRunner:
                      "profile_train_sec": t_train},
                     step=episode,
                 )
+
+            tel.count("env_steps", run.episode_length * E)
+            tel.count("agent_steps", run.episode_length * E * n_agents)
+            if sampled:
+                tel.count("nonfinite_grad_steps", float(np.sum(np.asarray(
+                    jax.device_get(getattr(metrics, "nonfinite_grads", 0.0))
+                ))))
+            if episode == self.start_episode:
+                self._mark_steady()
 
             stats = getattr(traj, "chunk_stats", None)
             if stats is not None:
@@ -282,6 +321,8 @@ class BaseRunner:
                     "policy_loss": float(np.mean(metrics.policy_loss)),
                     "dist_entropy": float(np.mean(metrics.dist_entropy)),
                     "grad_norm": float(np.mean(getattr(metrics, "grad_norm", 0.0))),
+                    "param_norm": float(np.mean(getattr(metrics, "param_norm", 0.0))),
+                    "update_ratio": float(np.mean(getattr(metrics, "update_ratio", 0.0))),
                     "ratio": float(np.mean(getattr(metrics, "ratio", 1.0))),
                 }
                 if stats is not None:
@@ -305,6 +346,10 @@ class BaseRunner:
                             record["aver_episode_delays"] = float(np.mean(done_delays))
                             record["aver_episode_payments"] = float(np.mean(done_payments))
                         done_rewards, done_delays, done_payments = [], [], []
+                for k, v in device_memory_gauges().items():
+                    tel.gauge(k, v)
+                tel.gauge("host_rss_bytes", host_rss_bytes())
+                record.update(tel.flush())
                 self._extra_metrics(record)
                 self._log_record(record)
 
@@ -323,6 +368,25 @@ class BaseRunner:
                 self.log(f"eval ep {episode}: {eval_info}")
 
         return train_state, rollout_state
+
+    def _mark_steady(self) -> None:
+        """First episode done: all warmup compiles happened.  Arm the
+        recompile detector and emit ``flops_per_step`` (compiler-counted FLOPs
+        of collect+train per env step) into the next metrics record."""
+        jits = [j for j in (self._collect, self._train) if isinstance(j, InstrumentedJit)]
+        for j in jits:
+            j.mark_steady()
+        tel = self.telemetry
+        n_compiles = int(tel.counters.get("compile_count", 0))
+        secs = tel.counters.get("compile_seconds_total", 0.0)
+        line = f"[telemetry] warmup done: {n_compiles} compiles in {secs:.1f}s"
+        flops = [j.flops_per_call for j in jits]
+        if flops and all(f is not None for f in flops):
+            steps = self.run_cfg.episode_length * self.run_cfg.n_rollout_threads
+            per_step = sum(flops) / steps
+            tel.once("flops_per_step", per_step)
+            line += f"; flops/env-step {per_step:.3e}"
+        self.log(line)
 
     def _extra_metrics(self, record: dict) -> None:
         """Hook for env-specific metric shaping (e.g. SMAC win rate from the

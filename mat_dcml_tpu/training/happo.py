@@ -46,6 +46,7 @@ from jax.flatten_util import ravel_pytree
 
 from mat_dcml_tpu.envs.spaces import Box
 from mat_dcml_tpu.models.actor_critic import ActorCriticPolicy
+from mat_dcml_tpu.telemetry.scopes import named_scope
 from mat_dcml_tpu.training.ac_rollout import ACTrajectory
 from mat_dcml_tpu.training.ippo import IPPORolloutCollector
 from mat_dcml_tpu.training.mappo import (
@@ -84,6 +85,11 @@ class HAPPOMetrics(NamedTuple):
     factor_mean: jax.Array
     kl: jax.Array            # HATRPO only; 0 for HAPPO
     accepted: jax.Array      # HATRPO line-search acceptance rate; 1 for HAPPO
+    # training-health telemetry (see ppo.TrainMetrics)
+    grad_norm: jax.Array = 0.0
+    param_norm: jax.Array = 0.0
+    update_ratio: jax.Array = 0.0
+    nonfinite_grads: jax.Array = 0.0
 
 
 def _rows(x: jax.Array) -> jax.Array:
@@ -239,11 +245,14 @@ class HAPPOTrainer:
 
         factor0 = jnp.ones((T, E, 1), jnp.float32)
         carry0 = (state.params, state.actor_opt, state.critic_opt, state.value_norm, factor0)
-        (params_s, aopt_s, copt_s, vn_s, _), metrics = jax.lax.scan(
-            one_agent, carry0, (order, agent_keys)
-        )
+        with named_scope("train/happo_update"):
+            (params_s, aopt_s, copt_s, vn_s, _), metrics = jax.lax.scan(
+                one_agent, carry0, (order, agent_keys)
+            )
         new_state = MAPPOTrainState(params_s, aopt_s, copt_s, vn_s, state.update_step + 1)
-        return new_state, jax.tree.map(lambda m: m.mean(), metrics)
+        return new_state, jax.tree.map(lambda m: m.mean(), metrics)._replace(
+            nonfinite_grads=metrics.nonfinite_grads.sum()
+        )
 
     # ---------------------------------------------------------------- helpers
 
@@ -309,11 +318,13 @@ class HAPPOTrainer:
                 return total, (value_loss, policy_loss, ent, ratio.mean())
 
             (_, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
-            params, aopt, copt, _, _ = inner._apply_updates(params, grads, aopt, copt)
+            params, aopt, copt, _, _, health = inner._apply_updates(params, grads, aopt, copt)
             vl, pl, ent, ratio = aux
+            gn, pn, ur, nf = health
             zero = jnp.zeros(())
             return (params, aopt, copt, vn), HAPPOMetrics(
-                vl, pl, ent, ratio, zero, zero, jnp.ones(())
+                vl, pl, ent, ratio, zero, zero, jnp.ones(()),
+                grad_norm=gn, param_norm=pn, update_ratio=ur, nonfinite_grads=nf,
             )
 
         def epoch(carry, key_e):
@@ -323,7 +334,9 @@ class HAPPOTrainer:
 
         keys = jax.random.split(key, cfg.ppo_epoch)
         (params, aopt, copt, vn), metrics = jax.lax.scan(epoch, (params, aopt, copt, vn), keys)
-        return params, aopt, copt, vn, jax.tree.map(lambda m: m.mean(), metrics)
+        return params, aopt, copt, vn, jax.tree.map(lambda m: m.mean(), metrics)._replace(
+            nonfinite_grads=metrics.nonfinite_grads.sum()
+        )
 
 
 class HATRPOTrainer(HAPPOTrainer):
@@ -500,6 +513,12 @@ class HATRPOTrainer(HAPPOTrainer):
             kl_sel = jnp.where(accepted, kls[first], 0.0)
             params = {**params, "actor": unravel(new_flat)}
 
+            # health: critic Adam grad + actor surrogate grad combined; the
+            # applied update is the critic step plus the accepted actor step
+            gnorm = jnp.sqrt(optax.global_norm(cgrads) ** 2 + jnp.vdot(g, g))
+            pnorm = optax.global_norm(params)
+            astep = new_flat - flat0
+            unorm = jnp.sqrt(optax.global_norm(c_up) ** 2 + jnp.vdot(astep, astep))
             metrics = HAPPOMetrics(
                 value_loss=vl,
                 policy_loss=-loss0,
@@ -508,6 +527,10 @@ class HATRPOTrainer(HAPPOTrainer):
                 factor_mean=jnp.zeros(()),
                 kl=kl_sel,
                 accepted=accepted.astype(jnp.float32),
+                grad_norm=gnorm,
+                param_norm=pnorm,
+                update_ratio=unorm / (pnorm + 1e-12),
+                nonfinite_grads=(~jnp.isfinite(gnorm)).astype(jnp.float32),
             )
             return (params, aopt, copt, vn), metrics
 
@@ -516,4 +539,6 @@ class HATRPOTrainer(HAPPOTrainer):
         (params, aopt, copt, vn), metrics = jax.lax.scan(
             trpo_update, (params, aopt, copt, vn), mb_idxs
         )
-        return params, aopt, copt, vn, jax.tree.map(lambda m: m.mean(), metrics)
+        return params, aopt, copt, vn, jax.tree.map(lambda m: m.mean(), metrics)._replace(
+            nonfinite_grads=metrics.nonfinite_grads.sum()
+        )

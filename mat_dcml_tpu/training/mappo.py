@@ -45,6 +45,7 @@ from mat_dcml_tpu.ops.popart import (
     popart_normalize,
     popart_update,
 )
+from mat_dcml_tpu.telemetry.scopes import named_scope
 from mat_dcml_tpu.training.ac_rollout import ACTrajectory
 
 
@@ -120,6 +121,12 @@ class MAPPOMetrics(NamedTuple):
     actor_grad_norm: jax.Array
     critic_grad_norm: jax.Array
     ratio: jax.Array
+    # training-health telemetry (see ppo.TrainMetrics): combined actor+critic
+    # grad/param norms, |update|/|params|, non-finite-gradient step count
+    grad_norm: jax.Array = 0.0
+    param_norm: jax.Array = 0.0
+    update_ratio: jax.Array = 0.0
+    nonfinite_grads: jax.Array = 0.0
 
 
 def _rows(x):
@@ -189,19 +196,20 @@ class MAPPOTrainer:
         return -surr.mean(), ratio
 
     def _compute_targets(self, state: MAPPOTrainState, traj: ACTrajectory, boot: Bootstrap):
-        next_v = self.policy.get_values(
-            state.params, _rows(boot.cent_obs), _rows(boot.critic_h), _rows(boot.mask)
-        ).reshape(1, *traj.values.shape[1:])
-        values_all = self._denorm(state.value_norm, jnp.concatenate([traj.values, next_v], 0))
-        adv, returns = compute_gae(
-            traj.rewards, values_all, traj.masks, self.cfg.gamma, self.cfg.gae_lambda
-        )
-        active = traj.active_masks[:-1]
-        denom = active.sum()
-        mean = (adv * active).sum() / denom
-        var = (((adv - mean) ** 2) * active).sum() / denom
-        adv_norm = (adv - mean) / (jnp.sqrt(var) + 1e-5)
-        return adv_norm, returns
+        with named_scope("train/compute_targets"):
+            next_v = self.policy.get_values(
+                state.params, _rows(boot.cent_obs), _rows(boot.critic_h), _rows(boot.mask)
+            ).reshape(1, *traj.values.shape[1:])
+            values_all = self._denorm(state.value_norm, jnp.concatenate([traj.values, next_v], 0))
+            adv, returns = compute_gae(
+                traj.rewards, values_all, traj.masks, self.cfg.gamma, self.cfg.gae_lambda
+            )
+            active = traj.active_masks[:-1]
+            denom = active.sum()
+            mean = (adv * active).sum() / denom
+            var = (((adv - mean) ** 2) * active).sum() / denom
+            adv_norm = (adv - mean) / (jnp.sqrt(var) + 1e-5)
+            return adv_norm, returns
 
     def _normalize_targets(self, value_norm, params, ret_b):
         """ValueNorm/PopArt update-then-normalize; PopArt also rescales the
@@ -238,7 +246,23 @@ class MAPPOTrainer:
             "actor": optax.apply_updates(params["actor"], a_up),
             "critic": optax.apply_updates(params["critic"], c_up),
         }
-        return params, actor_opt, critic_opt, optax.global_norm(grads["actor"]), optax.global_norm(grads["critic"])
+        gnorm = optax.global_norm(grads)
+        pnorm = optax.global_norm(params)
+        unorm = optax.global_norm({"actor": a_up, "critic": c_up})
+        health = (
+            gnorm,
+            pnorm,
+            unorm / (pnorm + 1e-12),
+            (~jnp.isfinite(gnorm)).astype(jnp.float32),
+        )
+        return (
+            params,
+            actor_opt,
+            critic_opt,
+            optax.global_norm(grads["actor"]),
+            optax.global_norm(grads["critic"]),
+            health,
+        )
 
     def _train_ff(self, state, traj, adv, returns, key):
         cfg = self.cfg
@@ -276,12 +300,14 @@ class MAPPOTrainer:
                 return total, (value_loss, policy_loss, ent, ratio)
 
             (_, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
-            params, actor_opt, critic_opt, a_gn, c_gn = self._apply_updates(
+            params, actor_opt, critic_opt, a_gn, c_gn, health = self._apply_updates(
                 params, grads, actor_opt, critic_opt
             )
             vl, pl, ent, ratio = aux
+            gn, pn, ur, nf = health
             return (params, actor_opt, critic_opt, value_norm), MAPPOMetrics(
-                vl, pl, ent, a_gn, c_gn, ratio.mean()
+                vl, pl, ent, a_gn, c_gn, ratio.mean(),
+                grad_norm=gn, param_norm=pn, update_ratio=ur, nonfinite_grads=nf,
             )
 
         def epoch(carry, key_e):
@@ -291,9 +317,12 @@ class MAPPOTrainer:
 
         keys = jax.random.split(key, cfg.ppo_epoch)
         carry = (state.params, state.actor_opt, state.critic_opt, state.value_norm)
-        (params, actor_opt, critic_opt, value_norm), metrics = jax.lax.scan(epoch, carry, keys)
+        with named_scope("train/mappo_update"):
+            (params, actor_opt, critic_opt, value_norm), metrics = jax.lax.scan(epoch, carry, keys)
         new_state = MAPPOTrainState(params, actor_opt, critic_opt, value_norm, state.update_step + 1)
-        return new_state, jax.tree.map(lambda m: m.mean(), metrics)
+        return new_state, jax.tree.map(lambda m: m.mean(), metrics)._replace(
+            nonfinite_grads=metrics.nonfinite_grads.sum()
+        )
 
     def _train_recurrent(self, state, traj, adv, returns, key):
         """Chunked-sequence training (``separated_buffer.py:320-430``)."""
@@ -344,12 +373,14 @@ class MAPPOTrainer:
                 return total, (value_loss, policy_loss, ent, ratio)
 
             (_, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
-            params, actor_opt, critic_opt, a_gn, c_gn = self._apply_updates(
+            params, actor_opt, critic_opt, a_gn, c_gn, health = self._apply_updates(
                 params, grads, actor_opt, critic_opt
             )
             vl, pl, ent, ratio = aux
+            gn, pn, ur, nf = health
             return (params, actor_opt, critic_opt, value_norm), MAPPOMetrics(
-                vl, pl, ent, a_gn, c_gn, ratio.mean()
+                vl, pl, ent, a_gn, c_gn, ratio.mean(),
+                grad_norm=gn, param_norm=pn, update_ratio=ur, nonfinite_grads=nf,
             )
 
         def epoch(carry, key_e):
@@ -359,6 +390,9 @@ class MAPPOTrainer:
 
         keys = jax.random.split(key, cfg.ppo_epoch)
         carry = (state.params, state.actor_opt, state.critic_opt, state.value_norm)
-        (params, actor_opt, critic_opt, value_norm), metrics = jax.lax.scan(epoch, carry, keys)
+        with named_scope("train/mappo_update"):
+            (params, actor_opt, critic_opt, value_norm), metrics = jax.lax.scan(epoch, carry, keys)
         new_state = MAPPOTrainState(params, actor_opt, critic_opt, value_norm, state.update_step + 1)
-        return new_state, jax.tree.map(lambda m: m.mean(), metrics)
+        return new_state, jax.tree.map(lambda m: m.mean(), metrics)._replace(
+            nonfinite_grads=metrics.nonfinite_grads.sum()
+        )

@@ -31,6 +31,7 @@ import jax.numpy as jnp
 import optax
 
 from mat_dcml_tpu.models.policy import TransformerPolicy
+from mat_dcml_tpu.telemetry.scopes import named_scope
 from mat_dcml_tpu.ops.distributions import huber_loss
 from mat_dcml_tpu.ops.gae import compute_gae
 from mat_dcml_tpu.ops.normalize import (
@@ -115,6 +116,12 @@ class TrainMetrics(NamedTuple):
     dist_entropy: jax.Array
     grad_norm: jax.Array
     ratio: jax.Array
+    # training-health telemetry: post-update parameter norm, |update|/|params|
+    # per optimizer step, and a NaN/Inf guard (count of minibatch updates
+    # whose global grad norm was non-finite; summed over the whole train call)
+    param_norm: jax.Array = 0.0
+    update_ratio: jax.Array = 0.0
+    nonfinite_grads: jax.Array = 0.0
 
 
 class MATTrainer:
@@ -197,35 +204,36 @@ class MATTrainer:
         })
 
         def compute_targets(params, value_norm):
-            # bootstrap + GAE (base_runner.compute / mat_trainer.py:180-192)
-            next_values = self.policy.get_values(params, rollout_state.share_obs, rollout_state.obs)
-            values_all = jnp.concatenate([traj.values, next_values[None]], axis=0)
-            if cfg.use_valuenorm or cfg.use_popart:
-                values_all = value_norm_denormalize(value_norm, values_all)
-            adv, returns = compute_gae(traj.rewards, values_all, traj.masks, cfg.gamma, cfg.gae_lambda)
-            if self.n_objective > 1:
-                # scalarization weights: per-step DMO coefficients (broadcast
-                # over agents) when collected, else the static weights
-                if traj.objective_coefficients is not None:
-                    w = traj.objective_coefficients[:, :, None, :]  # (T, E, 1, n_obj)
-                else:
-                    w = self.objective_weights
-                if cfg.mo_combined_norm:
-                    # scalarize RAW advantages before normalizing (see
-                    # PPOConfig.mo_combined_norm rationale)
-                    adv = (adv * w).sum(-1, keepdims=True)
-            # advantage normalization over active entries (mat_trainer.py:193-197);
-            # identical to the reference's global statistics when the
-            # (remaining) channel count is 1.
-            active = traj.active_masks[:-1]
-            axes = tuple(range(adv.ndim - 1))
-            denom = active.sum()
-            mean = (adv * active).sum(axes) / denom
-            var = (((adv - mean) ** 2) * active).sum(axes) / denom
-            adv_norm = (adv - mean) / (jnp.sqrt(var) + 1e-5)
-            if self.n_objective > 1 and not cfg.mo_combined_norm:
-                adv_norm = (adv_norm * w).sum(-1, keepdims=True)
-            return flatten_rows(adv_norm), flatten_rows(returns)
+            with named_scope("train/compute_targets"):
+                # bootstrap + GAE (base_runner.compute / mat_trainer.py:180-192)
+                next_values = self.policy.get_values(params, rollout_state.share_obs, rollout_state.obs)
+                values_all = jnp.concatenate([traj.values, next_values[None]], axis=0)
+                if cfg.use_valuenorm or cfg.use_popart:
+                    values_all = value_norm_denormalize(value_norm, values_all)
+                adv, returns = compute_gae(traj.rewards, values_all, traj.masks, cfg.gamma, cfg.gae_lambda)
+                if self.n_objective > 1:
+                    # scalarization weights: per-step DMO coefficients (broadcast
+                    # over agents) when collected, else the static weights
+                    if traj.objective_coefficients is not None:
+                        w = traj.objective_coefficients[:, :, None, :]  # (T, E, 1, n_obj)
+                    else:
+                        w = self.objective_weights
+                    if cfg.mo_combined_norm:
+                        # scalarize RAW advantages before normalizing (see
+                        # PPOConfig.mo_combined_norm rationale)
+                        adv = (adv * w).sum(-1, keepdims=True)
+                # advantage normalization over active entries (mat_trainer.py:193-197);
+                # identical to the reference's global statistics when the
+                # (remaining) channel count is 1.
+                active = traj.active_masks[:-1]
+                axes = tuple(range(adv.ndim - 1))
+                denom = active.sum()
+                mean = (adv * active).sum(axes) / denom
+                var = (((adv - mean) ** 2) * active).sum(axes) / denom
+                adv_norm = (adv - mean) / (jnp.sqrt(var) + 1e-5)
+                if self.n_objective > 1 and not cfg.mo_combined_norm:
+                    adv_norm = (adv_norm * w).sum(-1, keepdims=True)
+                return flatten_rows(adv_norm), flatten_rows(returns)
 
         accum = max(1, cfg.grad_accum_steps)
         assert mb_size % accum == 0, (
@@ -319,8 +327,15 @@ class MATTrainer:
             gnorm = optax.global_norm(grads)
             updates, opt_state = self.tx.update(grads, opt_state, params)
             params = optax.apply_updates(params, updates)
+            pnorm = optax.global_norm(params)
+            unorm = optax.global_norm(updates)
             value_loss, policy_loss, entropy, ratio_mean = aux
-            metrics = TrainMetrics(value_loss, policy_loss, entropy, gnorm, ratio_mean)
+            metrics = TrainMetrics(
+                value_loss, policy_loss, entropy, gnorm, ratio_mean,
+                param_norm=pnorm,
+                update_ratio=unorm / (pnorm + 1e-12),
+                nonfinite_grads=(~jnp.isfinite(gnorm)).astype(jnp.float32),
+            )
             return (params, opt_state, value_norm, adv_flat, ret_flat), metrics
 
         def run_epoch(carry, key_e, targets):
@@ -337,12 +352,16 @@ class MATTrainer:
 
         keys = jax.random.split(key, cfg.ppo_epoch)
         targets = None if cfg.recompute_returns_per_epoch else compute_targets(state.params, state.value_norm)
-        (params, opt_state, value_norm), metrics = jax.lax.scan(
-            lambda c, k: run_epoch(c, k, targets),
-            (state.params, state.opt_state, state.value_norm),
-            keys,
-        )
+        with named_scope("train/ppo_update"):
+            (params, opt_state, value_norm), metrics = jax.lax.scan(
+                lambda c, k: run_epoch(c, k, targets),
+                (state.params, state.opt_state, state.value_norm),
+                keys,
+            )
 
         new_state = TrainState(params, opt_state, value_norm, state.update_step + 1)
-        mean_metrics = jax.tree.map(lambda m: m.mean(), metrics)
+        # mean over (epoch, minibatch) — except the NaN guard, which counts
+        mean_metrics = jax.tree.map(lambda m: m.mean(), metrics)._replace(
+            nonfinite_grads=metrics.nonfinite_grads.sum()
+        )
         return new_state, mean_metrics

@@ -143,7 +143,12 @@ class RolloutCollector:
             )
             env_states, ts = jax.vmap(self.env.step)(st.env_states, out.action)
             done_env = ts.done.all(axis=1)                      # (E,)
-            next_mask = jnp.where(done_env[:, None, None], 0.0, 1.0)
+            # strongly-typed float32: a weak-typed mask in the carry would give
+            # the next chunk's input a different jit signature than init_state's
+            # jnp.ones mask — one silent recompile per run (telemetry catches it)
+            next_mask = jnp.where(
+                done_env[:, None, None], jnp.float32(0.0), jnp.float32(1.0)
+            )
             next_mask = jnp.broadcast_to(next_mask, st.mask.shape)
             reward = ts.objectives if self.n_objective > 1 else ts.reward
 

@@ -223,7 +223,8 @@ class DCMLRunner(BaseRunner):
                 env_states, ts = jax.vmap(env.step)(st.env_states, out.action)
                 done_env = ts.done.all(axis=1)
                 mask = jnp.broadcast_to(
-                    jnp.where(done_env[:, None, None], 0.0, 1.0), st.mask.shape
+                    jnp.where(done_env[:, None, None], jnp.float32(0.0), jnp.float32(1.0)),
+                    st.mask.shape,
                 )
                 new_st = ACRolloutState(
                     env_states, ts.obs, ts.share_obs, ts.available_actions,

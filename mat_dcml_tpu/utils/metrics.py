@@ -14,6 +14,37 @@ import json
 from pathlib import Path
 from typing import Optional
 
+import numpy as np
+
+
+def _json_default(o):
+    """Coerce numpy/jax leaves that ``json.dumps`` rejects: scalars via
+    ``.item()``, arrays via ``.tolist()`` (0-d arrays become scalars)."""
+    if isinstance(o, np.generic):
+        return o.item()
+    if isinstance(o, np.ndarray):
+        return o.tolist()
+    if hasattr(o, "__array__"):            # jax.Array and friends
+        arr = np.asarray(o)
+        return arr.item() if arr.ndim == 0 else arr.tolist()
+    raise TypeError(f"{type(o).__name__} is not JSON serializable")
+
+
+def scalar_metrics(record: dict) -> dict:
+    """Numeric fields suitable for TB/wandb scalar mirrors.
+
+    Excludes booleans explicitly — ``isinstance(True, int)`` holds, so a bare
+    numeric check would mirror flags as 0/1 scalar charts — and casts numpy
+    scalar types (``np.floating``/``np.integer``) to plain floats.
+    """
+    return {
+        k: float(v)
+        for k, v in record.items()
+        if isinstance(v, (int, float, np.floating, np.integer))
+        and not isinstance(v, (bool, np.bool_))
+        and k not in ("episode", "total_steps")
+    }
+
 
 class MetricsWriter:
     def __init__(
@@ -32,6 +63,7 @@ class MetricsWriter:
         self.enabled = enabled
         self._tb = None
         self._wandb = None
+        self._file = None          # lazy persistent jsonl handle (one open)
         if not enabled:
             return
         if use_tensorboard:
@@ -54,14 +86,15 @@ class MetricsWriter:
     def write(self, record: dict, step: Optional[int] = None) -> None:
         if not self.enabled:
             return
-        self.jsonl_path.parent.mkdir(parents=True, exist_ok=True)
-        with open(self.jsonl_path, "a") as f:
-            f.write(json.dumps(record) + "\n")
+        if self._file is None or self._file.closed:
+            self.jsonl_path.parent.mkdir(parents=True, exist_ok=True)
+            self._file = open(self.jsonl_path, "a")
+        self._file.write(json.dumps(record, default=_json_default) + "\n")
+        self._file.flush()
         step = step if step is not None else record.get("total_steps", record.get("episode"))
-        scalars = {
-            k: v for k, v in record.items()
-            if isinstance(v, (int, float)) and k not in ("episode", "total_steps")
-        }
+        if step is not None and not isinstance(step, int):
+            step = int(step)
+        scalars = scalar_metrics(record)
         if self._tb is not None:
             for k, v in scalars.items():
                 self._tb.add_scalar(k, v, global_step=step)
@@ -69,6 +102,9 @@ class MetricsWriter:
             self._wandb.log(scalars, step=step)
 
     def close(self) -> None:
+        if self._file is not None:
+            self._file.close()
+            self._file = None
         if self._tb is not None:
             self._tb.close()
         if self._wandb is not None:

@@ -28,6 +28,26 @@ def param_bytes(params: Any) -> int:
     return int(sum(l.size * l.dtype.itemsize for l in jax.tree.leaves(params)))
 
 
+def compiled_flops(compiled: Any) -> Optional[float]:
+    """FLOPs from a compiled executable's cost analysis, or None.
+
+    ``cost_analysis()`` returns a dict on newer jax and a one-per-program
+    list of dicts on older backends; both are handled.  Used by
+    ``flop_estimate`` and by the telemetry jit wrapper, which gets the count
+    for free at compile time (``flops_per_step`` in the metrics stream).
+    """
+    try:
+        cost = compiled.cost_analysis()
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0] if cost else None
+        if not cost:  # some backends return {} / None
+            return None
+        flops = cost.get("flops")
+        return float(flops) if flops is not None else None
+    except Exception:
+        return None
+
+
 def flop_estimate(fn: Callable, *args, **kwargs) -> Optional[float]:
     """XLA's analytic FLOP count for one call of ``fn(*args)``.
 
@@ -37,11 +57,7 @@ def flop_estimate(fn: Callable, *args, **kwargs) -> Optional[float]:
     """
     try:
         lowered = jax.jit(fn).lower(*args, **kwargs)
-        cost = lowered.compile().cost_analysis()
-        if not cost:  # some backends return {} / None
-            return None
-        flops = cost.get("flops")
-        return float(flops) if flops is not None else None
+        return compiled_flops(lowered.compile())
     except Exception:
         return None
 

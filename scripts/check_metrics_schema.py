@@ -1,0 +1,174 @@
+#!/usr/bin/env python
+"""Validate a training run's ``metrics.jsonl`` against the documented schema.
+
+The jsonl stream (utils/metrics.py + telemetry/) is the machine-readable
+contract BENCH tooling and tests consume; this validator keeps it honest:
+
+- every line is a flat JSON object of finite numbers (no strings, nulls,
+  NaN/Inf — and no booleans: flags must never leak into the scalar stream);
+- training records (identified by ``fps``) carry the required core fields
+  plus the telemetry fields the runner flushes every log interval;
+- counters/rates/timers are non-negative;
+- every field name is known — either an exact name or one of the documented
+  prefix/suffix families — so schema drift fails loudly instead of silently
+  growing unconsumed keys.
+
+Usage:
+    python scripts/check_metrics_schema.py <metrics.jsonl | run_dir>
+
+Exit 0 when valid; exit 1 with one line per violation otherwise.  Importable:
+``validate_record`` / ``validate_file`` are used by tests/test_telemetry.py.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import sys
+from pathlib import Path
+from typing import List
+
+# exact field names (README.md "Observability" documents units)
+KNOWN_FIELDS = {
+    # core training record (base_runner.train_loop)
+    "episode", "total_steps", "fps", "average_step_rewards",
+    "value_loss", "policy_loss", "dist_entropy", "grad_norm", "param_norm",
+    "update_ratio", "ratio",
+    "aver_episode_rewards", "aver_episode_delays", "aver_episode_payments",
+    # telemetry counters / rates (telemetry/registry.py flush)
+    "env_steps", "agent_steps", "env_steps_per_sec", "agent_steps_per_sec",
+    "compile_count", "compile_seconds_total", "steady_state_recompiles",
+    "nonfinite_grad_steps",
+    # gauges (telemetry/system.py)
+    "device_bytes_in_use", "device_peak_bytes", "host_rss_bytes",
+    # one-shot
+    "flops_per_step",
+    # profiling record (base_runner profiling branch)
+    "profile_collect_sec", "profile_train_sec",
+    # SMAC win rate (smac_runner._extra_metrics)
+    "incre_win_rate",
+}
+
+# open families: per-objective channels, eval protocol fields, per-function
+# compile counters, sampled step timers (with registry _max/_sum suffixes)
+KNOWN_PREFIXES = (
+    "average_step_objective_",
+    "eval_",
+    "compile_count_",
+    "step_time_",
+)
+
+# fields that must never go negative (counters, rates, timers, gauges)
+NON_NEGATIVE = (
+    "env_steps", "agent_steps", "env_steps_per_sec", "agent_steps_per_sec",
+    "compile_count", "compile_seconds_total", "steady_state_recompiles",
+    "nonfinite_grad_steps", "device_bytes_in_use", "device_peak_bytes",
+    "host_rss_bytes", "flops_per_step", "fps",
+)
+
+# a training record (vs eval/profile records, which are sparse) must have:
+REQUIRED_CORE = (
+    "episode", "total_steps", "fps", "average_step_rewards",
+    "value_loss", "policy_loss", "dist_entropy", "grad_norm", "ratio",
+)
+REQUIRED_TELEMETRY = (
+    "env_steps_per_sec", "step_time_collect", "step_time_train",
+    "compile_count", "compile_seconds_total", "device_bytes_in_use",
+    "host_rss_bytes",
+)
+
+
+def _known(name: str) -> bool:
+    if name in KNOWN_FIELDS:
+        return True
+    base = name
+    for suffix in ("_max", "_sum"):
+        if base.endswith(suffix):
+            base = base[: -len(suffix)]
+            break
+    if base in KNOWN_FIELDS:
+        return True
+    return any(base.startswith(p) for p in KNOWN_PREFIXES)
+
+
+def validate_record(record, index: int = 0, strict_names: bool = True) -> List[str]:
+    """Errors for one parsed jsonl record (empty list = valid)."""
+    errs: List[str] = []
+    where = f"record {index}"
+    if not isinstance(record, dict):
+        return [f"{where}: not a JSON object"]
+    for k, v in record.items():
+        if isinstance(v, bool):
+            errs.append(f"{where}: field {k!r} is a boolean (flags must not "
+                        f"enter the scalar stream)")
+            continue
+        if not isinstance(v, (int, float)):
+            errs.append(f"{where}: field {k!r} is {type(v).__name__}, not numeric")
+            continue
+        if not math.isfinite(v):
+            errs.append(f"{where}: field {k!r} is non-finite ({v})")
+            continue
+        if k in NON_NEGATIVE and v < 0:
+            errs.append(f"{where}: field {k!r} is negative ({v})")
+        if strict_names and not _known(k):
+            errs.append(f"{where}: unknown field {k!r} — document it in "
+                        f"README.md and scripts/check_metrics_schema.py")
+    if "fps" in record:  # training record: enforce the full contract
+        for k in REQUIRED_CORE:
+            if k not in record:
+                errs.append(f"{where}: training record missing {k!r}")
+        for k in REQUIRED_TELEMETRY:
+            if k not in record:
+                errs.append(f"{where}: training record missing telemetry "
+                            f"field {k!r}")
+    return errs
+
+
+def validate_file(path, strict_names: bool = True) -> List[str]:
+    """Errors for a whole metrics.jsonl (empty list = valid)."""
+    errs: List[str] = []
+    n = 0
+    with open(path) as f:
+        for i, line in enumerate(f):
+            if not line.strip():
+                continue
+            n += 1
+            try:
+                record = json.loads(line)
+            except json.JSONDecodeError as e:
+                errs.append(f"record {i}: invalid JSON ({e})")
+                continue
+            errs.extend(validate_record(record, i, strict_names=strict_names))
+    if n == 0:
+        errs.append(f"{path}: no records")
+    return errs
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    if not argv:
+        print(__doc__, file=sys.stderr)
+        return 2
+    target = Path(argv[0])
+    if target.is_dir():
+        hits = sorted(target.rglob("metrics.jsonl"))
+        if not hits:
+            print(f"no metrics.jsonl under {target}", file=sys.stderr)
+            return 2
+    else:
+        hits = [target]
+    failed = False
+    for path in hits:
+        errs = validate_file(path)
+        if errs:
+            failed = True
+            for e in errs:
+                print(f"{path}: {e}")
+        else:
+            n = sum(1 for l in open(path) if l.strip())
+            print(f"{path}: OK ({n} records)")
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -10,7 +10,11 @@ line, the top ops by summed duration.  Run on the artifacts captured by
     PROTOCOL_BUFFERS_PYTHON_IMPLEMENTATION=python \
       python scripts/trace_report.py artifacts/r3/trace_e256 [top_n]
 
-Writes <dir>/op_summary.json and prints top-N tables for the device lines.
+Writes <dir>/op_summary.json and prints top-N tables for the device lines,
+plus a per-scope rollup: ops carry their ``jax.named_scope`` path in the
+display name (``jit(train)/train/ppo_update/...``), so op time groups by the
+semantic phases the telemetry layer annotates (``mat/encoder``,
+``mat/ar_decode``, ``train/compute_targets``, ``ops/gae``, ...).
 """
 
 from __future__ import annotations
@@ -22,6 +26,22 @@ import sys
 from collections import defaultdict
 
 os.environ.setdefault("PROTOCOL_BUFFERS_PYTHON_IMPLEMENTATION", "python")
+
+
+def scope_of(name: str, depth: int = 2) -> str:
+    """Named-scope path of an op display name, depth-limited.
+
+    Display names look like ``jit(train)/train/ppo_update/while/body/dot``:
+    jit/pjit frames (parenthesized) and the trailing op component are dropped,
+    the rest is the ``jax.named_scope`` stack.  Ops with no scope group under
+    ``(unscoped)``.
+    """
+    parts = [p for p in name.split("/") if p]
+    parts = parts[:-1]                       # trailing component = the op itself
+    parts = [p for p in parts if "(" not in p]
+    if not parts:
+        return "(unscoped)"
+    return "/".join(parts[:depth])
 
 
 def find_xspace(root: str) -> str:
@@ -57,6 +77,7 @@ def main():
         planes = [p for p in xspace.planes if has_xla_line(p)]
 
     summary = {}
+    scope_agg = defaultdict(lambda: [0.0, 0])     # scope path -> [total_ps, count]
     for plane in planes:
         meta = {m_id: m.name for m_id, m in plane.event_metadata.items()}
         disp = {m_id: (m.display_name or m.name) for m_id, m in plane.event_metadata.items()}
@@ -68,6 +89,9 @@ def main():
                 a = agg[name]
                 a[0] += ev.duration_ps
                 a[1] += 1
+                s = scope_agg[scope_of(name)]
+                s[0] += ev.duration_ps
+                s[1] += 1
                 t0 = ev.offset_ps
                 t1 = ev.offset_ps + ev.duration_ps
                 t_min = t0 if t_min is None else min(t_min, t0)
@@ -90,17 +114,36 @@ def main():
                 ],
             }
 
+    total_scoped_ms = sum(v[0] for v in scope_agg.values()) / 1e9
+    scope_rows = sorted(
+        ((n, v[0] / 1e9, v[1]) for n, v in scope_agg.items()),
+        key=lambda r: r[1], reverse=True,
+    )
+    summary["scopes"] = [
+        {"scope": n, "total_ms": round(ms, 3), "count": c,
+         "pct": round(100 * ms / total_scoped_ms, 2) if total_scoped_ms else None}
+        for n, ms, c in scope_rows
+    ]
+
     out_path = os.path.join(root, "op_summary.json")
     with open(out_path, "w") as f:
         json.dump(summary, f, indent=1)
     print(f"[trace] wrote {out_path}", file=sys.stderr)
 
     for key, s in summary.items():
+        if key == "scopes":
+            continue
         print(f"\n== {key}  (span {s['span_ms']:.1f} ms, busy {s['busy_ms']:.1f} ms)")
         print(f"{'op':64s} {'total-ms':>10s} {'%span':>7s} {'count':>8s}")
         for r in s["top"]:
             pct = f"{r['pct_of_span']:.1f}" if r["pct_of_span"] is not None else ""
             print(f"{r['op'][:64]:64s} {r['total_ms']:>10.2f} {pct:>7s} {r['count']:>8d}")
+
+    print(f"\n== named scopes  (busy {total_scoped_ms:.1f} ms across device lines)")
+    print(f"{'scope':48s} {'total-ms':>10s} {'%busy':>7s} {'count':>8s}")
+    for n, ms, c in scope_rows[:top_n]:
+        pct = f"{100 * ms / total_scoped_ms:.1f}" if total_scoped_ms else ""
+        print(f"{n[:48]:48s} {ms:>10.2f} {pct:>7s} {c:>8d}")
 
 
 if __name__ == "__main__":

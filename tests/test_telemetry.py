@@ -1,0 +1,256 @@
+"""Observability layer: registry aggregation, recompile detection, NaN guard,
+and the jsonl schema of a short DCML training run.
+
+The smoke run doubles as the schema fixture: its metrics.jsonl is validated
+by scripts/check_metrics_schema.py (the same validator the CLI exposes), so
+schema drift in the runner fails here first.
+"""
+
+import importlib.util
+import json
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mat_dcml_tpu.config import RunConfig
+from mat_dcml_tpu.envs.dcml import DCMLEnv, DCMLEnvConfig
+from mat_dcml_tpu.envs.dcml.env import DCMLConsts
+from mat_dcml_tpu.telemetry import Telemetry, instrumented_jit
+from mat_dcml_tpu.training.ppo import PPOConfig
+from mat_dcml_tpu.training.runner import DCMLRunner
+from mat_dcml_tpu.utils.metrics import MetricsWriter, scalar_metrics
+
+_SCHEMA_PATH = Path(__file__).resolve().parent.parent / "scripts" / "check_metrics_schema.py"
+_spec = importlib.util.spec_from_file_location("check_metrics_schema", _SCHEMA_PATH)
+check_metrics_schema = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(check_metrics_schema)
+
+
+# ---------------------------------------------------------------- registry
+
+def test_registry_aggregation():
+    tel = Telemetry()
+    tel.count("compile_count")
+    tel.count("compile_count")
+    tel.count("env_steps", 100)
+    tel.rate("env_steps", "env_steps_per_sec")
+    tel.gauge("host_rss_bytes", 1.0)
+    tel.gauge("host_rss_bytes", 2.0)          # last value wins
+    for v in (1.0, 2.0, 3.0):
+        tel.observe("step_time_train", v)
+    tel.once("flops_per_step", 7.0)
+    tel.start_interval()
+    tel.count("env_steps", 50)                # rate counts post-anchor delta only
+
+    rec = tel.flush()
+    assert rec["compile_count"] == 2
+    assert rec["env_steps"] == 150            # counters are cumulative
+    assert rec["env_steps_per_sec"] > 0
+    assert rec["host_rss_bytes"] == 2.0
+    assert rec["step_time_train"] == pytest.approx(2.0)   # mean
+    assert rec["step_time_train_max"] == 3.0
+    assert rec["step_time_train_sum"] == 6.0
+    assert rec["flops_per_step"] == 7.0
+
+    rec2 = tel.flush()
+    assert rec2["compile_count"] == 2         # counters persist
+    assert "flops_per_step" not in rec2       # once-values flush once
+    assert "step_time_train" not in rec2      # observed series reset
+    assert rec2["env_steps_per_sec"] == 0.0   # no new steps this interval
+
+
+def test_timer_context():
+    tel = Telemetry()
+    with tel.timer("step_time_collect"):
+        pass
+    rec = tel.flush()
+    assert rec["step_time_collect"] >= 0.0
+    assert rec["step_time_collect_sum"] == rec["step_time_collect"]
+
+
+# -------------------------------------------------------- recompile detector
+
+def test_instrumented_jit_counts_recompiles():
+    tel = Telemetry()
+    logs = []
+    f = instrumented_jit(lambda x: (x @ x.T).sum(), "matmul", tel, logs.append)
+
+    a = jnp.ones((4, 8))
+    _ = f(a)
+    _ = f(a)                                  # cache hit: no new compile
+    assert f.compile_count == 1
+    assert tel.counters["compile_count"] == 1
+    assert tel.counters["compile_seconds_total"] > 0
+    assert tel.counters["compile_count_matmul"] == 1
+    assert f.flops_per_call is not None and f.flops_per_call > 0
+
+    f.mark_steady()
+    _ = f(jnp.ones((8, 8)))                   # forced shape-change recompile
+    assert f.compile_count == 2
+    assert tel.counters["steady_state_recompiles"] == 1
+    assert any("steady-state recompile" in l for l in logs)
+    # results still correct through the fallback-capable call path
+    assert float(f(a)) == pytest.approx(float((np.ones((4, 8)) @ np.ones((8, 4))).sum()))
+
+
+def test_instrumented_jit_weak_type_is_a_distinct_signature():
+    f = instrumented_jit(lambda x: x * 2, "mul", Telemetry(), lambda s: None)
+    _ = f(jnp.float32(3.0))                   # strongly-typed scalar
+    _ = f(3.0)                                # weak-typed python float
+    assert f.compile_count == 2               # jit would recompile too
+
+
+# ------------------------------------------------------------ metrics writer
+
+def test_writer_accepts_numpy_and_jax_scalars(tmp_path):
+    w = MetricsWriter(tmp_path)
+    w.write({
+        "episode": 0,
+        "total_steps": np.int64(10),
+        "value_loss": np.float32(0.5),
+        "grad_norm": np.array(1.25),             # 0-d array
+        "ratio": jnp.asarray(1.0),               # jax scalar
+        "fps": np.float64(3.0),
+    })
+    w.write({"episode": 1, "vec": np.arange(3)})  # arrays -> json lists
+    w.close()
+    recs = [json.loads(l) for l in (tmp_path / "metrics.jsonl").read_text().splitlines()]
+    assert recs[0]["value_loss"] == 0.5
+    assert recs[0]["grad_norm"] == 1.25
+    assert recs[0]["ratio"] == 1.0
+    assert recs[1]["vec"] == [0, 1, 2]
+
+
+def test_writer_keeps_one_file_handle(tmp_path):
+    w = MetricsWriter(tmp_path)
+    w.write({"episode": 0})
+    handle = w._file
+    w.write({"episode": 1})
+    assert w._file is handle                  # opened once, flushed per write
+    w.close()
+    assert w._file is None
+    w.write({"episode": 2})                   # reopens (append) after close
+    w.close()
+    assert len((tmp_path / "metrics.jsonl").read_text().splitlines()) == 3
+
+
+def test_scalar_metrics_excludes_bools_and_indices():
+    rec = {
+        "episode": 3, "total_steps": 30, "value_loss": 0.5,
+        "flag": True, "np_flag": np.bool_(False), "np_loss": np.float32(1.5),
+        "name": "x",
+    }
+    scalars = scalar_metrics(rec)
+    assert scalars == {"value_loss": 0.5, "np_loss": 1.5}
+
+
+# ------------------------------------------------------------ schema checker
+
+def test_schema_validator_accepts_valid_and_rejects_invalid():
+    good = {
+        "episode": 0, "total_steps": 16, "fps": 1.0,
+        "average_step_rewards": -1.0, "value_loss": 0.5, "policy_loss": 0.1,
+        "dist_entropy": 0.2, "grad_norm": 1.0, "param_norm": 17.0,
+        "update_ratio": 1e-4, "ratio": 1.0,
+        "env_steps": 16, "agent_steps": 144,
+        "env_steps_per_sec": 1.0, "agent_steps_per_sec": 9.0,
+        "compile_count": 2, "compile_seconds_total": 10.0,
+        "compile_count_collect": 1, "compile_count_train": 1,
+        "step_time_collect": 1.0, "step_time_collect_max": 1.0,
+        "step_time_collect_sum": 1.0, "step_time_train": 2.0,
+        "step_time_train_max": 2.0, "step_time_train_sum": 2.0,
+        "device_bytes_in_use": 0, "device_peak_bytes": 0,
+        "host_rss_bytes": 1000, "flops_per_step": 2.8e5,
+        "nonfinite_grad_steps": 0,
+    }
+    assert check_metrics_schema.validate_record(good) == []
+
+    eval_rec = {"episode": 5, "total_steps": 80, "eval_average_step_rewards": -2.0}
+    assert check_metrics_schema.validate_record(eval_rec) == []
+
+    assert check_metrics_schema.validate_record({**good, "use_eval": True})
+    assert check_metrics_schema.validate_record({**good, "grad_norm": float("nan")})
+    assert check_metrics_schema.validate_record({**good, "compile_count": -1})
+    assert check_metrics_schema.validate_record({**good, "mystery_field": 1.0})
+    missing = dict(good)
+    del missing["step_time_train"]
+    assert check_metrics_schema.validate_record(missing)
+
+
+def test_schema_validator_cli_on_file(tmp_path, capsys):
+    path = tmp_path / "metrics.jsonl"
+    path.write_text(json.dumps({"episode": 0, "total_steps": 1, "value_loss": 0.1}) + "\n")
+    assert check_metrics_schema.main([str(path)]) == 0
+    path.write_text(json.dumps({"episode": 0, "bad": "string"}) + "\n")
+    assert check_metrics_schema.main([str(path)]) == 1
+
+
+# ------------------------------------------------- end-to-end DCML smoke run
+
+W = 8
+
+
+@pytest.fixture(scope="module")
+def small_runner(tmp_path_factory):
+    consts = DCMLConsts(worker_number_max=W, sob_dim=W + 2)
+    rng = np.random.default_rng(0)
+    workloads = rng.integers(0, 5, size=(W, consts.local_workload_period)).astype(np.float32)
+    env = DCMLEnv(DCMLEnvConfig(consts=consts), base_workloads=workloads)
+    run = RunConfig(
+        algorithm_name="mat", n_rollout_threads=2, episode_length=8,
+        num_env_steps=2 * 8 * 2, log_interval=1, save_interval=0,
+        n_block=1, n_embd=16, n_head=1,
+        run_dir=str(tmp_path_factory.mktemp("telemetry_smoke")),
+    )
+    ppo = PPOConfig(ppo_epoch=2, num_mini_batch=2)
+    return DCMLRunner(run, ppo, env=env, log_fn=lambda s: None)
+
+
+def test_smoke_run_metrics_schema(small_runner):
+    r = small_runner
+    r.train_loop()
+    r.writer.close()
+    recs = [json.loads(l) for l in open(r.metrics_path)]
+    assert len(recs) == 2
+
+    required = (
+        "env_steps_per_sec", "step_time_collect", "step_time_train",
+        "grad_norm", "compile_count", "compile_seconds_total",
+        "device_bytes_in_use", "param_norm", "update_ratio",
+        "host_rss_bytes", "agent_steps_per_sec", "nonfinite_grad_steps",
+    )
+    for rec in recs:
+        for k in required:
+            assert k in rec, f"missing {k} in {sorted(rec)}"
+
+    # exactly the warmup compiles (collect + train), no steady-state recompiles
+    assert recs[-1]["compile_count"] == 2
+    assert all(rec.get("steady_state_recompiles", 0) == 0 for rec in recs)
+    # compiler-counted FLOPs land in the FIRST record only
+    assert recs[0]["flops_per_step"] > 0
+    assert "flops_per_step" not in recs[1]
+    assert recs[0]["nonfinite_grad_steps"] == 0
+    assert recs[1]["env_steps"] == 32         # 2 episodes * T=8 * E=2
+
+    errs = check_metrics_schema.validate_file(r.metrics_path)
+    assert errs == [], errs
+
+
+def test_nan_guard_counts_bad_gradients(small_runner):
+    r = small_runner
+    train_state, rollout_state = r.setup()
+    key = jax.random.key(0)
+    rollout_state, traj = r._collect(train_state.params, rollout_state)
+
+    _, clean = r._train(train_state, traj, rollout_state, key)
+    assert float(clean.nonfinite_grads) == 0
+
+    bad_traj = traj._replace(rewards=jnp.full_like(traj.rewards, jnp.nan))
+    _, dirty = r._train(train_state, bad_traj, rollout_state, key)
+    # every minibatch update saw a non-finite global grad norm
+    assert float(dirty.nonfinite_grads) == 2 * 2   # ppo_epoch * num_mini_batch
+    # same signature as the smoke run: the NaN injection must NOT recompile
+    assert r._train.compile_count == 1
