@@ -45,6 +45,11 @@ Knobs (environment variables):
                         backward (MATConfig.remat; default 0)
   BENCH_ACCUM           gradient-accumulation chunks per PPO minibatch
                         (PPOConfig.grad_accum_steps; default 1)
+  BENCH_K_SWEEP         comma list of --iters_per_dispatch values (e.g.
+                        "1,4,16") → A/B the runner's fused dispatch path
+                        (base_runner.make_dispatch_fn, donated buffers,
+                        DeferredFetch metric transfer) instead of the normal
+                        measurement; one json line per K, record = best K
 
 On device OOM the bench walks a backoff ladder before shrinking the batch:
 remat on -> accumulation x2 (up to 8) -> halve E — big batches get memory
@@ -496,6 +501,112 @@ def _breakdown_mfu(jax, result: dict, E: int, T: int) -> None:
         )
 
 
+def _measure_fused(jax, E: int, T: int, iters: int, K: int) -> dict:
+    """Time ``iters`` fused dispatches of K train iterations each, exactly the
+    runner's ``--iters_per_dispatch`` path: one jitted ``lax.scan`` over
+    collect+train with donated carried state and the stacked metrics pulled
+    through a :class:`DeferredFetch` (host touches dispatch N-1's metrics
+    while N runs).  States are rebuilt per K — donation consumes them."""
+    from mat_dcml_tpu.config import RunConfig
+    from mat_dcml_tpu.envs.dcml import DCMLEnv, DCMLEnvConfig
+    from mat_dcml_tpu.telemetry import DeferredFetch
+    from mat_dcml_tpu.training.base_runner import make_dispatch_fn
+    from mat_dcml_tpu.training.ppo import MATTrainer, PPOConfig
+    from mat_dcml_tpu.training.rollout import RolloutCollector
+    from mat_dcml_tpu.training.runner import build_mat_policy
+
+    data_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)), "data")
+    dtype = os.environ.get(
+        "BENCH_DTYPE",
+        "bfloat16" if jax.default_backend() == "tpu" else "float32",
+    )
+    run = RunConfig(n_rollout_threads=E, episode_length=T, model_dtype=dtype)
+    env = DCMLEnv(DCMLEnvConfig(), data_dir=data_dir)
+    policy = build_mat_policy(run, env)
+    # the K sweep A/Bs dispatch overhead, not update math — a CPU sweep can
+    # shrink the PPO inner loop (identical across the swept Ks) to keep the
+    # K=16 leg inside a bench budget; chip runs keep the recipe defaults
+    ppo = PPOConfig(
+        ppo_epoch=int(os.environ.get("BENCH_PPO_EPOCH", PPOConfig.ppo_epoch)),
+        num_mini_batch=int(os.environ.get("BENCH_MINI_BATCH",
+                                          PPOConfig.num_mini_batch)),
+    )
+    trainer = MATTrainer(policy, ppo)
+    collector = RolloutCollector(env, policy, T)
+
+    train_state = trainer.init_state(policy.init_params(jax.random.key(0)))
+    rollout_state = collector.init_state(jax.random.key(1), E)
+    key = jax.random.key(2)
+
+    dispatch = jax.jit(make_dispatch_fn(trainer, collector, K),
+                      donate_argnums=(0, 1))
+
+    t0 = time.perf_counter()
+    # two warmups, same rationale as _measure: compile + weak-type recompile
+    for w in range(2):
+        train_state, rollout_state, key, stacked = dispatch(
+            train_state, rollout_state, key)
+        jax.block_until_ready(train_state)
+        log(f"K={K}: warmup {w + 1} done at {time.perf_counter() - t0:.1f}s")
+
+    pending = None
+    host_block = 0.0
+    start = time.perf_counter()
+    for _ in range(iters):
+        train_state, rollout_state, key, stacked = dispatch(
+            train_state, rollout_state, key)
+        fetch = DeferredFetch(stacked)
+        if pending is not None:
+            tb = time.perf_counter()
+            pending.get()
+            host_block += time.perf_counter() - tb
+        pending = fetch
+    tb = time.perf_counter()
+    pending.get()
+    host_block += time.perf_counter() - tb
+    jax.block_until_ready(train_state)
+    elapsed = time.perf_counter() - start
+
+    steps = iters * K * E * T
+    result = {
+        "K": K,
+        "steps_per_sec": steps / elapsed,
+        "dispatch_sec": elapsed / iters,
+        "host_block_sec": host_block / iters,
+    }
+    log(f"K={K}: {result['steps_per_sec']:.1f} env-steps/s "
+        f"({elapsed / iters:.2f}s/dispatch, host_block "
+        f"{host_block / iters * 1e3:.1f} ms/dispatch)")
+    return result
+
+
+def _k_sweep(jax, E: int, T: int, iters: int, ks: list) -> None:
+    """BENCH_K_SWEEP leg: one json line per K on stdout, then the record line
+    for the best K (same shape as the main record so consumers parse it)."""
+    results = []
+    for k in ks:
+        r = _measure_fused(jax, E, T, iters, max(1, k))
+        print(json.dumps(r), flush=True)
+        results.append(r)
+    best = max(results, key=lambda r: r["steps_per_sec"])
+    dev = jax.devices()[0]
+    record = {
+        "metric": "dcml_mat_fused_dispatch_env_steps_per_sec",
+        "value": round(best["steps_per_sec"], 2),
+        "unit": "env_steps/s",
+        "vs_baseline": round(best["steps_per_sec"] / BASELINE_STEPS_PER_SEC, 2),
+        "platform": dev.platform,
+        "device": dev.device_kind,
+        "provisional": False,
+        "E": E,
+        "best_K": best["K"],
+    }
+    for r in results:
+        record[f"k{r['K']}_steps_per_sec"] = round(r["steps_per_sec"], 2)
+        record[f"k{r['K']}_host_block_sec"] = round(r["host_block_sec"], 5)
+    print(json.dumps(record), flush=True)
+
+
 def _is_oom(e: Exception) -> bool:
     s = f"{type(e).__name__}: {e}"
     return "RESOURCE_EXHAUSTED" in s or "Out of memory" in s or "out of memory" in s
@@ -717,6 +828,11 @@ def main() -> None:
             log("CPU fallback: dropping breakdown")
             breakdown = False
         log(f"CPU fallback: shrinking to E={E} ITERS={ITERS}")
+
+    k_sweep = os.environ.get("BENCH_K_SWEEP", "")
+    if k_sweep:
+        _k_sweep(jax, E, T, ITERS, [int(x) for x in k_sweep.split(",")])
+        return
 
     if sweep:
         env_list = [int(x) for x in os.environ.get(

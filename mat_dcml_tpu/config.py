@@ -47,6 +47,12 @@ class RunConfig:
     # counters and the recompile detector stay on).  The registry flushes
     # into the jsonl record at every log_interval.
     telemetry_interval: int = 1
+    # fused multi-episode dispatch: lax.scan K collect+train iterations inside
+    # ONE jitted call with donated train/rollout state, so the host re-enters
+    # once per K episodes instead of twice per episode (Podracer-style).  1 =
+    # the classic two-dispatch loop.  Log/save/eval cadences snap UP to
+    # dispatch boundaries; see README "Observability" for when not to raise it.
+    iters_per_dispatch: int = 1
     # annotate model/trainer phases with jax.named_scope so xplane traces and
     # scripts/trace_report.py group op time semantically; trace-time only
     trace_named_scopes: bool = True

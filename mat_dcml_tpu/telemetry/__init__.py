@@ -8,12 +8,14 @@ Everything is dependency-free and jit-safe — host-side observation happens
 only at call boundaries and flush time, never inside a trace.
 """
 
+from mat_dcml_tpu.telemetry.async_fetch import DeferredFetch
 from mat_dcml_tpu.telemetry.jit_instrument import InstrumentedJit, instrumented_jit
 from mat_dcml_tpu.telemetry.registry import Telemetry
 from mat_dcml_tpu.telemetry.scopes import named_scope, named_scopes_enabled, set_named_scopes
 from mat_dcml_tpu.telemetry.system import device_memory_gauges, host_rss_bytes
 
 __all__ = [
+    "DeferredFetch",
     "InstrumentedJit",
     "Telemetry",
     "device_memory_gauges",

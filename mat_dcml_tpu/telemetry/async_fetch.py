@@ -1,0 +1,35 @@
+"""Future-style non-blocking device->host metric transfer.
+
+The fused dispatch loop (``base_runner._train_loop_fused``) gets its per-
+dispatch metrics back as a small pytree of stacked ``(K,)`` scalars.  Calling
+``jax.device_get`` on it directly would block the host until the dispatch
+finishes — exactly the per-iteration sync the fused path exists to remove.
+:class:`DeferredFetch` instead starts the device->host copy asynchronously at
+construction (right after the dispatch is enqueued) and defers the blocking
+read to :meth:`get`, which the runner calls one dispatch later — so the host
+formats and logs dispatch N-1's metrics while dispatch N runs on device, and
+the only host-blocking time left is whatever compute is still in flight when
+``get`` is finally called.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+
+
+class DeferredFetch:
+    """Starts an async device->host copy of ``tree``; ``get()`` blocks only
+    on whatever is still outstanding and returns the numpy pytree."""
+
+    def __init__(self, tree: Any):
+        self._tree = tree
+        for leaf in jax.tree.leaves(tree):
+            # jax.Array exposes copy_to_host_async; anything else (python
+            # scalars in hand-built trees) is already on the host
+            if hasattr(leaf, "copy_to_host_async"):
+                leaf.copy_to_host_async()
+
+    def get(self) -> Any:
+        return jax.device_get(self._tree)

@@ -20,6 +20,14 @@ makes every compile *visible*:
 
 Any failure in the AOT path falls back to a plain ``jax.jit`` call — the
 wrapper may under-count in that case but can never break training.
+
+Buffer donation: extra ``jit_kwargs`` (notably ``donate_argnums``) pass
+through to both the plain ``jax.jit`` and the AOT ``lower().compile()`` path,
+so the fused dispatch can donate its carried train/rollout state without
+losing recompile detection.  With donation configured the retry-with-same-args
+fallback is disabled for the *executing* call — a donated input may already be
+invalidated by the time an executable raises, and retrying would turn a loud
+error into a confusing use-after-donation one.
 """
 
 from __future__ import annotations
@@ -61,6 +69,8 @@ class InstrumentedJit:
         **jit_kwargs,
     ):
         self._jit = jax.jit(fn, **jit_kwargs)
+        self._donating = bool(jit_kwargs.get("donate_argnums") or
+                              jit_kwargs.get("donate_argnames"))
         self.name = name
         self.telemetry = telemetry if telemetry is not None else Telemetry()
         self.log = log_fn
@@ -115,7 +125,12 @@ class InstrumentedJit:
             return compiled(*args, **kwargs)
         except Exception:
             # AOT executables are stricter than jit (committed devices,
-            # layouts); never let instrumentation break the call.
+            # layouts); never let instrumentation break the call.  Unless the
+            # call donates buffers: the failed attempt may already have
+            # invalidated its inputs, so retrying with the same args would
+            # mask the real error behind a use-after-donation one.
+            if self._donating:
+                raise
             self._compiled[key] = None
             return self._jit(*args, **kwargs)
 

@@ -18,6 +18,16 @@ Semantics:
   then the series resets.
 - **once** values appear in exactly one flush (``flops_per_step``).
 
+Per-dispatch rate accounting (``--iters_per_dispatch K > 1``): the fused
+runner counts ``env_steps`` in bursts of ``K * T * E`` when a dispatch's
+results *arrive* (not when it is enqueued — launches are async and would
+front-run the device), and re-anchors the rate clock via
+:meth:`start_interval` once warmup compilation is done, so the first flushed
+``*_per_sec`` rates measure steady-state throughput instead of averaging over
+the one large fused compile.  Counters therefore arrive in bursts at dispatch
+cadence; rates stay exact because both the delta and the interval are taken
+at the same flush boundary.
+
 Nothing here touches jax: recording is plain Python and safe to call from
 anywhere on the host, but never from inside a traced function.
 """

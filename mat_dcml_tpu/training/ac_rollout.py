@@ -41,6 +41,10 @@ class ACTrajectory(NamedTuple):
     dones: jax.Array             # (T, E)
     delays: Optional[jax.Array] = None    # (T, E) DCML per-step info, else None
     payments: Optional[jax.Array] = None
+    # On-device episode accounting over this chunk (see rollout.Trajectory):
+    # n_done, done_reward_sum, step_reward_mean always; done_delay_sum /
+    # done_payment_sum only for envs whose TimeStep carries the info channels.
+    chunk_stats: Optional[dict] = None
 
 
 class ACRolloutState(NamedTuple):
@@ -52,6 +56,10 @@ class ACRolloutState(NamedTuple):
     actor_h: jax.Array           # (E, A, N, h)
     critic_h: jax.Array
     rng: jax.Array
+    # per-env running (reward, delay, payment) episode sums carried across
+    # chunks (rollout.RolloutState.episode_acc); zeros stand in for the info
+    # channels on envs without them
+    episode_acc: Optional[jax.Array] = None             # (E, 3)
 
 
 def _rows(x: jax.Array) -> jax.Array:
@@ -104,6 +112,7 @@ class ACRolloutCollector:
             actor_h=_unrows(ah, E, A),
             critic_h=_unrows(ch, E, A),
             rng=key,
+            episode_acc=jnp.zeros((E, 3), jnp.float32),
         )
 
     def collect(self, params, rollout_state: ACRolloutState) -> Tuple[ACRolloutState, ACTrajectory]:
@@ -120,6 +129,19 @@ class ACRolloutCollector:
                 jnp.where(done_env[:, None, None], jnp.float32(0.0), jnp.float32(1.0)),
                 st.mask.shape,
             )
+            has_info = hasattr(ts, "delay")   # DCML info channels (env TimeStep)
+            # on-device episode accounting (rollout.py): accumulate per-env
+            # sums, flush finished episodes' totals into the chunk aggregates
+            step_vals = jnp.stack([
+                ts.reward.sum(-1).mean(-1),
+                ts.delay if has_info else jnp.zeros_like(done_env, jnp.float32),
+                ts.payment if has_info else jnp.zeros_like(done_env, jnp.float32),
+            ], axis=-1)                                          # (E, 3)
+            acc = st.episode_acc + step_vals
+            flushed = jnp.where(done_env[:, None], acc, 0.0).sum(axis=0)   # (3,)
+            n_done = done_env.sum().astype(jnp.float32)
+            acc = jnp.where(done_env[:, None], 0.0, acc)
+
             transition = dict(
                 share_obs=self._cent(st),
                 obs=st.obs,
@@ -132,8 +154,10 @@ class ACRolloutCollector:
                 actor_h=st.actor_h,
                 critic_h=st.critic_h,
                 done=done_env,
+                _flushed=flushed,
+                _n_done=n_done,
             )
-            if hasattr(ts, "delay"):     # DCML info channels (env.py TimeStep)
+            if has_info:
                 transition["delay"] = ts.delay
                 transition["payment"] = ts.payment
             # Hidden states reset via the mask multiply inside the GRU on the
@@ -147,10 +171,30 @@ class ACRolloutCollector:
                 actor_h=out.actor_h,
                 critic_h=out.critic_h,
                 rng=key,
+                episode_acc=acc,
             )
             return new_st, transition
 
+        if rollout_state.episode_acc is None:      # hand-built legacy state
+            rollout_state = rollout_state._replace(
+                episode_acc=jnp.zeros((E, 3), jnp.float32)
+            )
         final_state, tr = jax.lax.scan(body, rollout_state, None, length=self.T)
+
+        flushed = tr.pop("_flushed").sum(axis=0)            # (3,)
+        n_done = tr.pop("_n_done").sum()
+        chunk_stats = {
+            "n_done": n_done,
+            "done_reward_sum": flushed[0],
+            "step_reward_mean": tr["rewards"].sum(-1).mean(),
+        }
+        if "delay" in tr:
+            chunk_stats["done_delay_sum"] = flushed[1]
+            chunk_stats["done_payment_sum"] = flushed[2]
+        if tr["rewards"].shape[-1] > 1:            # per-objective channel means
+            for i in range(tr["rewards"].shape[-1]):
+                chunk_stats[f"step_objective_{i}_mean"] = tr["rewards"][..., i].mean()
+
         masks = jnp.concatenate([rollout_state.mask[None], tr["next_mask"]], axis=0)
         active = jnp.ones_like(masks)
         traj = ACTrajectory(
@@ -168,5 +212,6 @@ class ACRolloutCollector:
             dones=tr["done"],
             delays=tr.get("delay"),
             payments=tr.get("payment"),
+            chunk_stats=chunk_stats,
         )
         return final_state, traj

@@ -24,6 +24,7 @@ import numpy as np
 
 from mat_dcml_tpu.config import RunConfig
 from mat_dcml_tpu.telemetry import (
+    DeferredFetch,
     InstrumentedJit,
     Telemetry,
     device_memory_gauges,
@@ -84,6 +85,46 @@ def apply_seq_shards(run: RunConfig, policy) -> None:
     policy.seq_mesh = Mesh(np.array(devs[: run.seq_shards]), ("seq",))
 
 
+def make_dispatch_fn(trainer, collector, iters: int):
+    """Build the fused multi-episode dispatch: ONE jittable function that
+    ``lax.scan``-s ``iters`` collect+train iterations, so a single host
+    dispatch advances ``iters`` episodes (the Podracer anakin pattern).
+
+    Key handling matches the K=1 host loop exactly — one
+    ``jax.random.split(key)`` per iteration off the carried key, the evolved
+    key returned — so a K-iteration dispatch chain is equivalent to K
+    sequential host-loop episodes started from the same key (pinned by
+    tests/test_fused_dispatch.py).  Per-iteration train metrics and
+    chunk_stats come back stacked ``(iters, ...)``; jit this with
+    ``donate_argnums=(0, 1)`` so the carried train/rollout state reuses its
+    own buffers instead of being copied every call.
+    """
+
+    def dispatch(train_state, rollout_state, key):
+        def body(carry, _):
+            ts, rs, k = carry
+            k, k_train = jax.random.split(k)
+            ts, rs, metrics, stats = trainer.train_iteration(collector, ts, rs, k_train)
+            return (ts, rs, k), (metrics, stats)
+
+        (train_state, rollout_state, key), stacked = jax.lax.scan(
+            body, (train_state, rollout_state, key), None, length=iters
+        )
+        return train_state, rollout_state, key, stacked
+
+    return dispatch
+
+
+def _cadence_hits(interval: int, ep0: int, k: int) -> bool:
+    """True when any episode in ``[ep0, ep0 + k)`` lands on the cadence
+    (``episode % interval == 0``) — the dispatch-granular version of the K=1
+    loop's per-episode checks, so log/save/eval intervals effectively round
+    UP to dispatch boundaries."""
+    if interval <= 0:
+        return False
+    return (ep0 + interval - 1) // interval * interval < ep0 + k
+
+
 def ac_config_kwargs(ppo: PPOConfig) -> dict:
     """PPOConfig -> MAPPOConfig shared-field mapping (one place, so CLI flags
     behave identically across entry points)."""
@@ -125,6 +166,10 @@ class BaseRunner:
         else:
             self._collect = self.collector.collect
         self._train = instrumented_jit(self.trainer.train, "train", self.telemetry, log_fn)
+        # fused multi-episode dispatch (built lazily by _train_loop_fused when
+        # --iters_per_dispatch > 1 and the trainer/collector pair supports it)
+        self._dispatch = None
+        self._dispatch_iters = 1
         self.run_dir = (
             Path(run.run_dir) / run.env_name / run.scenario / run.algorithm_name / run.experiment_name
         )
@@ -196,6 +241,17 @@ class BaseRunner:
         if train_state is None:
             train_state, rollout_state = self.setup()
         key = jax.random.key(run.seed + 7919)
+
+        K = max(1, int(getattr(run, "iters_per_dispatch", 1)))
+        if K > 1:
+            if not getattr(self.collector, "jittable", True):
+                self.log("[dispatch] collector is host-driven (jittable=False); "
+                         "--iters_per_dispatch ignored")
+            elif not hasattr(self.trainer, "train_iteration"):
+                self.log(f"[dispatch] {type(self.trainer).__name__} has no "
+                         f"train_iteration; --iters_per_dispatch ignored")
+            else:
+                return self._train_loop_fused(episodes, train_state, rollout_state, key, K)
 
         # episode accounting (dcml_runner.py:29-74)
         E = run.n_rollout_threads
@@ -273,9 +329,10 @@ class BaseRunner:
                 stats = {k: float(v) for k, v in jax.device_get(stats).items()}
                 agg_done += stats["n_done"]
                 agg_rew += stats["done_reward_sum"]
-                agg_delay += stats["done_delay_sum"]
-                agg_pay += stats["done_payment_sum"]
-                has_info = True
+                # AC collectors omit the info channels on envs without them
+                has_info = "done_delay_sum" in stats
+                agg_delay += stats.get("done_delay_sum", 0.0)
+                agg_pay += stats.get("done_payment_sum", 0.0)
             else:
                 # host-side episode metric accumulation (one device->host copy)
                 rew_arr = np.asarray(traj.rewards)             # (T, E, A, n_obj)
@@ -333,8 +390,9 @@ class BaseRunner:
                             record[f"average_step_objective_{i}"] = v
                     if agg_done > 0:
                         record["aver_episode_rewards"] = agg_rew / agg_done
-                        record["aver_episode_delays"] = agg_delay / agg_done
-                        record["aver_episode_payments"] = agg_pay / agg_done
+                        if has_info:
+                            record["aver_episode_delays"] = agg_delay / agg_done
+                            record["aver_episode_payments"] = agg_pay / agg_done
                         agg_done = agg_rew = agg_delay = agg_pay = 0.0
                 else:
                     if rew_arr.shape[-1] > 1:
@@ -369,11 +427,160 @@ class BaseRunner:
 
         return train_state, rollout_state
 
+    # ------------------------------------------------------- fused dispatch
+
+    def _train_loop_fused(self, episodes, train_state, rollout_state, key, K: int):
+        """K>1 loop: one donated jitted dispatch advances K episodes, metrics
+        come back as stacked ``(K,)`` scalars fetched asynchronously — the
+        host formats and logs dispatch N-1 while dispatch N runs on device.
+        Log/save/eval cadences snap up to dispatch boundaries
+        (:func:`_cadence_hits`); the episode count rounds up to whole
+        dispatches so every dispatch compiles to the same program."""
+        run = self.run_cfg
+        tel = self.telemetry
+        E = run.n_rollout_threads
+        T = run.episode_length
+        env = getattr(self, "env", None) or getattr(self.collector, "env", None)
+        n_agents = int(getattr(env, "n_agents", 1) or 1)
+
+        self._dispatch = instrumented_jit(
+            make_dispatch_fn(self.trainer, self.collector, K),
+            "dispatch", tel, self.log, donate_argnums=(0, 1),
+        )
+        self._dispatch_iters = K
+        tel.gauge("iters_per_dispatch", float(K))
+        tel.rate("dispatch_count", "dispatches_per_sec")
+
+        first = self.start_episode
+        n_disp = -(-(episodes - first) // K)
+        if first + n_disp * K != episodes:
+            self.log(f"[dispatch] {episodes - first} episodes round up to "
+                     f"{n_disp} dispatches of {K}")
+        agg = {"done": 0.0, "rew": 0.0, "delay": 0.0, "pay": 0.0, "has_info": False}
+        tel.start_interval()
+        start = time.time()
+
+        def process(d, ep_last, fetch, t_launch):
+            # blocks only on compute still in flight for THIS dispatch — the
+            # next one is already enqueued, so the device never idles on the
+            # host-side formatting below
+            t_get = time.perf_counter()
+            metrics, stats = fetch.get()
+            t_done = time.perf_counter()
+            if run.telemetry_interval > 0 and d % run.telemetry_interval == 0:
+                # sync-free derived timer: get() returns when this dispatch's
+                # results landed, so done-minus-launch is its wall duration
+                tel.observe("step_time_dispatch", t_done - t_launch)
+                tel.observe("step_time_host_block", t_done - t_get)
+            # count work at COMPLETION, not enqueue (launches are async and
+            # would front-run the device — registry.py rate semantics)
+            tel.count("env_steps", T * E * K)
+            tel.count("agent_steps", T * E * K * n_agents)
+            tel.count("dispatch_count")
+            tel.count("nonfinite_grad_steps", float(np.sum(np.asarray(
+                getattr(metrics, "nonfinite_grads", 0.0)))))
+            stats = {k: np.asarray(v) for k, v in stats.items()}
+            agg["done"] += float(stats["n_done"].sum())
+            agg["rew"] += float(stats["done_reward_sum"].sum())
+            if "done_delay_sum" in stats:
+                agg["has_info"] = True
+                agg["delay"] += float(stats["done_delay_sum"].sum())
+                agg["pay"] += float(stats["done_payment_sum"].sum())
+            if not (d == 0 or _cadence_hits(run.log_interval, ep_last - K + 1, K)):
+                return
+            total_steps = (ep_last + 1) * T * E
+            elapsed = time.time() - start
+            fps = (ep_last + 1 - first) * T * E / max(elapsed, 1e-9)
+            record = {
+                "episode": ep_last,
+                "total_steps": total_steps,
+                "fps": fps,
+                # stacked (K,) per-iteration metrics -> means over the dispatch
+                "average_step_rewards": float(np.mean(stats["step_reward_mean"])),
+                "value_loss": float(np.mean(metrics.value_loss)),
+                "policy_loss": float(np.mean(metrics.policy_loss)),
+                "dist_entropy": float(np.mean(metrics.dist_entropy)),
+                "grad_norm": float(np.mean(getattr(metrics, "grad_norm", 0.0))),
+                "param_norm": float(np.mean(getattr(metrics, "param_norm", 0.0))),
+                "update_ratio": float(np.mean(getattr(metrics, "update_ratio", 0.0))),
+                "ratio": float(np.mean(getattr(metrics, "ratio", 1.0))),
+            }
+            for k, v in stats.items():
+                if k.startswith("step_objective_"):
+                    i = k.split("_")[2]
+                    record[f"average_step_objective_{i}"] = float(np.mean(v))
+            if agg["done"] > 0:
+                record["aver_episode_rewards"] = agg["rew"] / agg["done"]
+                if agg["has_info"]:
+                    record["aver_episode_delays"] = agg["delay"] / agg["done"]
+                    record["aver_episode_payments"] = agg["pay"] / agg["done"]
+                agg.update(done=0.0, rew=0.0, delay=0.0, pay=0.0)
+            for k, v in device_memory_gauges().items():
+                tel.gauge(k, v)
+            tel.gauge("host_rss_bytes", host_rss_bytes())
+            record.update(tel.flush())
+            self._extra_metrics(record)
+            self._log_record(record)
+
+        def boundary(ep0, ep_last, state, final):
+            should_save = run.save_interval > 0 and (
+                _cadence_hits(run.save_interval, ep0, K) or final
+            )
+            if should_save and run.algorithm_name != "random":
+                self.ckpt.save(ep_last, state)
+            if run.use_eval and _cadence_hits(run.eval_interval, ep0, K) and hasattr(self, "evaluate"):
+                eval_info = self.evaluate(state)
+                eval_info.update(episode=ep_last, total_steps=(ep_last + 1) * T * E)
+                self.writer.write(eval_info, step=(ep_last + 1) * T * E)
+                self.log(f"eval ep {ep_last}: {eval_info}")
+
+        pending = None            # (d, ep_last, fetch, t_launch) in flight
+        for d in range(n_disp):
+            ep0 = first + d * K
+            # checkpoint/eval for the previous dispatch boundary must run
+            # BEFORE this dispatch donates (invalidates) train_state's buffers
+            if d > 0:
+                boundary(ep0 - K, ep0 - 1, train_state, final=False)
+            profiling = run.profile_dir is not None and d == 1
+            if profiling:
+                jax.profiler.start_trace(run.profile_dir)
+            t_launch = time.perf_counter()
+            train_state, rollout_state, key, stacked = self._dispatch(
+                train_state, rollout_state, key
+            )
+            if profiling:
+                jax.block_until_ready(train_state)
+                dt = time.perf_counter() - t_launch
+                jax.profiler.stop_trace()
+                self.log(f"[profile] trace -> {run.profile_dir}; compiled-"
+                         f"dispatch wall: {dt:.3f}s for {K} iterations")
+                self.writer.write(
+                    {"episode": ep0 + K - 1, "profile_dispatch_sec": dt},
+                    step=ep0 + K - 1,
+                )
+            fetch = DeferredFetch(stacked)
+            if d == 0:
+                self._mark_steady()
+                tel.start_interval()   # rates measure steady state, not the
+                                       # one large fused warmup compile
+            if pending is not None:
+                process(*pending)      # overlaps dispatch d running on device
+            pending = (d, ep0 + K - 1, fetch, t_launch)
+
+        boundary(first + (n_disp - 1) * K, first + n_disp * K - 1, train_state,
+                 final=True)
+        process(*pending)
+        return train_state, rollout_state
+
     def _mark_steady(self) -> None:
-        """First episode done: all warmup compiles happened.  Arm the
-        recompile detector and emit ``flops_per_step`` (compiler-counted FLOPs
-        of collect+train per env step) into the next metrics record."""
-        jits = [j for j in (self._collect, self._train) if isinstance(j, InstrumentedJit)]
+        """First episode (or fused dispatch) done: all warmup compiles
+        happened.  Arm the recompile detector and emit ``flops_per_step``
+        (compiler-counted FLOPs per env step) into the next metrics record."""
+        if self._dispatch is not None:
+            fns = (self._dispatch,)
+        else:
+            fns = (self._collect, self._train)
+        jits = [j for j in fns if isinstance(j, InstrumentedJit)]
         for j in jits:
             j.mark_steady()
         tel = self.telemetry
@@ -382,7 +589,8 @@ class BaseRunner:
         line = f"[telemetry] warmup done: {n_compiles} compiles in {secs:.1f}s"
         flops = [j.flops_per_call for j in jits]
         if flops and all(f is not None for f in flops):
-            steps = self.run_cfg.episode_length * self.run_cfg.n_rollout_threads
+            steps = (self.run_cfg.episode_length * self.run_cfg.n_rollout_threads
+                     * self._dispatch_iters)
             per_step = sum(flops) / steps
             tel.once("flops_per_step", per_step)
             line += f"; flops/env-step {per_step:.3e}"

@@ -54,6 +54,7 @@ from mat_dcml_tpu.training.mappo import (
     MAPPOConfig,
     MAPPOTrainer,
     MAPPOTrainState,
+    ac_train_iteration,
     chunk_start_states,
     chunk_windows,
 )
@@ -126,6 +127,13 @@ class HAPPOTrainer:
         return jax.vmap(self.inner.init_state)(stacked_params)
 
     # ------------------------------------------------------------------ train
+
+    def train_iteration(self, collector, state: MAPPOTrainState, rollout_state,
+                        key: jax.Array):
+        """Fused collect+train unit for ``--iters_per_dispatch`` (see
+        :func:`mat_dcml_tpu.training.mappo.ac_train_iteration`).  HATRPO
+        inherits this unchanged — its ``train`` has the same signature."""
+        return ac_train_iteration(self, collector, state, rollout_state, key)
 
     def train(self, state: MAPPOTrainState, traj: ACTrajectory, boot: Bootstrap,
               key: jax.Array) -> Tuple[MAPPOTrainState, HAPPOMetrics]:

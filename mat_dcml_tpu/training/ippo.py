@@ -24,6 +24,7 @@ from mat_dcml_tpu.training.mappo import (
     MAPPOMetrics,
     MAPPOTrainer,
     MAPPOTrainState,
+    ac_train_iteration,
 )
 
 
@@ -74,6 +75,12 @@ class IPPOTrainer:
 
     def init_state(self, stacked_params) -> MAPPOTrainState:
         return jax.vmap(self.inner.init_state)(stacked_params)
+
+    def train_iteration(self, collector, state: MAPPOTrainState, rollout_state,
+                        key: jax.Array):
+        """Fused collect+train unit for ``--iters_per_dispatch`` (see
+        :func:`mat_dcml_tpu.training.mappo.ac_train_iteration`)."""
+        return ac_train_iteration(self, collector, state, rollout_state, key)
 
     def train(self, state: MAPPOTrainState, traj: ACTrajectory, boot: Bootstrap,
               key: jax.Array) -> Tuple[MAPPOTrainState, MAPPOMetrics]:

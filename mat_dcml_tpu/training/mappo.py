@@ -133,6 +133,23 @@ def _rows(x):
     return x.reshape(-1, *x.shape[2:])
 
 
+def ac_train_iteration(trainer, collector, state, rollout_state, key):
+    """One fused collect+train iteration for the actor-critic family — the
+    unit ``base_runner``'s ``--iters_per_dispatch`` scans over.  Builds the
+    :class:`Bootstrap` from the post-collect rollout state exactly the way
+    ``BaseRunner._bootstrap`` does on the host (IPPO's decentralized-V reads
+    local obs via ``collector.use_local_value``).  Shared by MAPPO / IPPO /
+    HAPPO / HATRPO trainers, whose ``train`` signatures are identical.
+    Returns ``(state, rollout_state, metrics, chunk_stats)``."""
+    rollout_state, traj = collector.collect(state.params, rollout_state)
+    use_local = getattr(collector, "use_local_value", False)
+    cent = rollout_state.obs if use_local else rollout_state.share_obs
+    boot = Bootstrap(cent_obs=cent, critic_h=rollout_state.critic_h,
+                     mask=rollout_state.mask)
+    state, metrics = trainer.train(state, traj, boot, key)
+    return state, rollout_state, metrics, traj.chunk_stats
+
+
 class MAPPOTrainer:
     def __init__(self, policy: ActorCriticPolicy, cfg: MAPPOConfig):
         self.policy = policy
@@ -231,6 +248,12 @@ class MAPPOTrainer:
         return value_norm, params, ret_b
 
     # ------------------------------------------------------------------- train
+
+    def train_iteration(self, collector, state: MAPPOTrainState, rollout_state,
+                        key: jax.Array):
+        """Fused collect+train unit for ``--iters_per_dispatch`` (see
+        :func:`ac_train_iteration`)."""
+        return ac_train_iteration(self, collector, state, rollout_state, key)
 
     def train(self, state: MAPPOTrainState, traj: ACTrajectory, boot: Bootstrap,
               key: jax.Array) -> Tuple[MAPPOTrainState, MAPPOMetrics]:

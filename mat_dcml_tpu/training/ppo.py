@@ -169,6 +169,16 @@ class MATTrainer:
 
     # ------------------------------------------------------------------ train
 
+    def train_iteration(self, collector, state: TrainState, rollout_state, key: jax.Array):
+        """One fused collect+train iteration — the unit ``base_runner``'s
+        ``--iters_per_dispatch`` scans over.  Pure and jittable; the MAT
+        trainer bootstraps from the post-collect rollout state directly, so
+        the composition is exactly the K=1 host loop's two calls.  Returns
+        ``(state, rollout_state, metrics, chunk_stats)``."""
+        rollout_state, traj = collector.collect(state.params, rollout_state)
+        state, metrics = self.train(state, traj, rollout_state, key)
+        return state, rollout_state, metrics, traj.chunk_stats
+
     def train(
         self, state: TrainState, traj: Trajectory, rollout_state: RolloutState, key: jax.Array
     ) -> Tuple[TrainState, TrainMetrics]:

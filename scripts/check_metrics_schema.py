@@ -39,12 +39,16 @@ KNOWN_FIELDS = {
     "env_steps", "agent_steps", "env_steps_per_sec", "agent_steps_per_sec",
     "compile_count", "compile_seconds_total", "steady_state_recompiles",
     "nonfinite_grad_steps",
+    # fused multi-episode dispatch (--iters_per_dispatch K > 1,
+    # base_runner._train_loop_fused): core metric fields become means over
+    # the stacked (K,) per-iteration values; these ride along
+    "iters_per_dispatch", "dispatch_count", "dispatches_per_sec",
     # gauges (telemetry/system.py)
     "device_bytes_in_use", "device_peak_bytes", "host_rss_bytes",
     # one-shot
     "flops_per_step",
     # profiling record (base_runner profiling branch)
-    "profile_collect_sec", "profile_train_sec",
+    "profile_collect_sec", "profile_train_sec", "profile_dispatch_sec",
     # SMAC win rate (smac_runner._extra_metrics)
     "incre_win_rate",
 }
@@ -64,6 +68,8 @@ NON_NEGATIVE = (
     "compile_count", "compile_seconds_total", "steady_state_recompiles",
     "nonfinite_grad_steps", "device_bytes_in_use", "device_peak_bytes",
     "host_rss_bytes", "flops_per_step", "fps",
+    "iters_per_dispatch", "dispatch_count", "dispatches_per_sec",
+    "profile_dispatch_sec",
 )
 
 # a training record (vs eval/profile records, which are sparse) must have:
@@ -75,6 +81,14 @@ REQUIRED_TELEMETRY = (
     "env_steps_per_sec", "step_time_collect", "step_time_train",
     "compile_count", "compile_seconds_total", "device_bytes_in_use",
     "host_rss_bytes",
+)
+# under --iters_per_dispatch K > 1 the per-phase blocking timers do not exist
+# (collect+train fuse into one dispatch); the dispatch-level timers replace
+# them.  Records advertise the mode via the iters_per_dispatch gauge.
+REQUIRED_TELEMETRY_FUSED = (
+    "env_steps_per_sec", "step_time_dispatch", "step_time_host_block",
+    "compile_count", "compile_seconds_total", "device_bytes_in_use",
+    "host_rss_bytes", "dispatch_count",
 )
 
 
@@ -114,10 +128,11 @@ def validate_record(record, index: int = 0, strict_names: bool = True) -> List[s
             errs.append(f"{where}: unknown field {k!r} — document it in "
                         f"README.md and scripts/check_metrics_schema.py")
     if "fps" in record:  # training record: enforce the full contract
+        fused = record.get("iters_per_dispatch", 1) > 1
         for k in REQUIRED_CORE:
             if k not in record:
                 errs.append(f"{where}: training record missing {k!r}")
-        for k in REQUIRED_TELEMETRY:
+        for k in (REQUIRED_TELEMETRY_FUSED if fused else REQUIRED_TELEMETRY):
             if k not in record:
                 errs.append(f"{where}: training record missing telemetry "
                             f"field {k!r}")

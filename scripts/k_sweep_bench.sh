@@ -1,0 +1,18 @@
+#!/bin/sh
+# A/B the fused multi-episode dispatch (--iters_per_dispatch) against the
+# classic two-dispatch loop: BENCH_K_SWEEP drives bench.py's fused leg
+# (base_runner.make_dispatch_fn with donated buffers + DeferredFetch metric
+# transfer) at several K values and reports env-steps/s per K.  Small E/T by
+# default so the sweep finishes on CPU in minutes; on a chip session export
+# BENCH_N_ENVS/BENCH_EPISODE_LENGTH back up to production sizes.
+cd "$(dirname "$0")/.."
+exec env \
+  JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
+  BENCH_DIRECT=1 \
+  BENCH_K_SWEEP="${BENCH_K_SWEEP:-1,4,16}" \
+  BENCH_N_ENVS="${BENCH_N_ENVS:-8}" \
+  BENCH_EPISODE_LENGTH="${BENCH_EPISODE_LENGTH:-4}" \
+  BENCH_ITERS="${BENCH_ITERS:-4}" \
+  BENCH_PPO_EPOCH="${BENCH_PPO_EPOCH:-2}" \
+  BENCH_MINI_BATCH="${BENCH_MINI_BATCH:-2}" \
+  python bench.py

@@ -112,28 +112,33 @@ def main():
     bf = jnp.bfloat16
     i32 = jnp.int32
     f32 = jnp.float32
-    run("store (TB,1,D) rank-expand   [current kernel]", k_store_expand,
-        [((TB, D), bf), ((1,), i32)], (TB, L, D))
-    run("store squeezed middle index", k_store_squeeze,
-        [((TB, D), bf), ((1,), i32)], (TB, L, D))
-    run("store (1,TB,D) leading expand [cache as (L,TB,D)]", k_store_leading,
-        [((TB, D), bf), ((1,), i32)], (L, TB, D))
-    run("store squeezed leading index  [cache as (L,TB,D)]", k_store_leading_squeeze,
-        [((TB, D), bf), ((1,), i32)], (L, TB, D))
-    run("scores q (TB,1,dh) mid expand [current kernel]", k_q_expand,
-        [((TB, D), f32), ((TB, L, D), f32)], (TB, L), f32)
-    run("scores q (1,TB,dh) leading    [cache as (L,TB,D)]", k_q_leading,
-        [((TB, D), f32), ((L, TB, D), f32)], (L, TB), f32)
-    run("out w (TB,L,1) trailing expand [current kernel]", k_w_expand,
-        [((TB, L), f32), ((TB, L, D), f32)], (TB, D), f32)
-    run("out w (L,TB,1) trailing expand [cache as (L,TB,D)]", k_w_leading,
-        [((L, TB), f32), ((L, TB, D), f32)], (TB, D), f32)
-    run("out w broadcast_in_dim (L,TB)->(L,TB,D)", k_w_bcast,
-        [((L, TB), f32), ((L, TB, D), f32)], (TB, D), f32)
-    run("out w broadcast_in_dim (TB,L)->(TB,L,D)", k_w_bcast_mid,
-        [((TB, L), f32), ((TB, L, D), f32)], (TB, D), f32)
-    run("softmax over sublane axis of (L,TB)", k_sublane_softmax,
-        [((L, TB), f32)], (L, TB), f32)
+    oks = [
+        run("store (TB,1,D) rank-expand   [current kernel]", k_store_expand,
+            [((TB, D), bf), ((1,), i32)], (TB, L, D)),
+        run("store squeezed middle index", k_store_squeeze,
+            [((TB, D), bf), ((1,), i32)], (TB, L, D)),
+        run("store (1,TB,D) leading expand [cache as (L,TB,D)]", k_store_leading,
+            [((TB, D), bf), ((1,), i32)], (L, TB, D)),
+        run("store squeezed leading index  [cache as (L,TB,D)]", k_store_leading_squeeze,
+            [((TB, D), bf), ((1,), i32)], (L, TB, D)),
+        run("scores q (TB,1,dh) mid expand [current kernel]", k_q_expand,
+            [((TB, D), f32), ((TB, L, D), f32)], (TB, L), f32),
+        run("scores q (1,TB,dh) leading    [cache as (L,TB,D)]", k_q_leading,
+            [((TB, D), f32), ((L, TB, D), f32)], (L, TB), f32),
+        run("out w (TB,L,1) trailing expand [current kernel]", k_w_expand,
+            [((TB, L), f32), ((TB, L, D), f32)], (TB, D), f32),
+        run("out w (L,TB,1) trailing expand [cache as (L,TB,D)]", k_w_leading,
+            [((L, TB), f32), ((L, TB, D), f32)], (TB, D), f32),
+        run("out w broadcast_in_dim (L,TB)->(L,TB,D)", k_w_bcast,
+            [((L, TB), f32), ((L, TB, D), f32)], (TB, D), f32),
+        run("out w broadcast_in_dim (TB,L)->(TB,L,D)", k_w_bcast_mid,
+            [((TB, L), f32), ((TB, L, D), f32)], (TB, D), f32),
+        run("softmax over sublane axis of (L,TB)", k_sublane_softmax,
+            [((L, TB), f32)], (L, TB), f32),
+    ]
+    # exit code = number of failed probes, so CI and shell callers see FAILs
+    # instead of an unconditional 0
+    return sum(not ok for ok in oks)
 
 
 if __name__ == "__main__":

@@ -61,17 +61,19 @@ def main():
         ava = jnp.ones((E, A, env.action_dim))
         return share, obs, ava
 
-    def timed(fn, *args, iters=20, vary_key=1):
-        """Block after EVERY call and swap in a fresh PRNG key each call:
-        repeat dispatches of one executable with unchanged args measured
-        dispatch-only on the tunneled TPU runtime (r5 session leg 3 printed
-        0.12 ms for a full 101-position AR decode)."""
+    def timed(fn, *args, iters=20, vary_key=None):
+        """Block after EVERY call and, when ``vary_key`` names a positional
+        arg, swap in a fresh PRNG key each call: repeat dispatches of one
+        executable with unchanged args measured dispatch-only on the tunneled
+        TPU runtime (r5 session leg 3 printed 0.12 ms for a full 101-position
+        AR decode)."""
         args = list(args)
         out = fn(*args)
         jax.block_until_ready(out)
         t0 = time.perf_counter()
         for i in range(iters):
-            args[vary_key] = jax.random.key(1000 + i)
+            if vary_key is not None:
+                args[vary_key] = jax.random.key(1000 + i)
             out = fn(*args)
             jax.block_until_ready(out)
         return (time.perf_counter() - t0) / iters, out
@@ -88,7 +90,8 @@ def main():
                 fn = jax.jit(
                     lambda p, k, s, o, a: policy.get_actions(p, k, s, o, a)
                 )
-                dt, out = timed(fn, params, jax.random.key(7), share, obs, ava)
+                dt, out = timed(fn, params, jax.random.key(7), share, obs, ava,
+                                vary_key=1)
             finally:
                 pd.fused_ar_decode = orig
                 os.environ["MAT_DCML_TPU_DECODE_IMPL"] = "auto"

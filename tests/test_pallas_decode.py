@@ -158,6 +158,96 @@ def test_deterministic_decode_identical():
     np.testing.assert_array_equal(np.asarray(ref.action), np.asarray(fused.action))
 
 
+# ------------------------------------------------- chipless AOT compilation
+#
+# Interpret mode checks semantics, not Mosaic legality: a pattern interpret
+# accepts can still be rejected by the real TPU lowering (the whole point of
+# scripts/mosaic_probe.py).  These tests AOT-compile the kernels against a
+# v5e topology description — the same TpuAotCompiler path the probe uses, no
+# chip needed — so Mosaic regressions fail in CI, not in the next chip
+# session.  Everything runs in a subprocess with a hard timeout: on hosts
+# without libtpu, get_topology_desc can HANG (not raise) inside a C++ wait.
+
+_AOT_CHILD = r"""
+import os, sys
+action_type = sys.argv[1]
+os.environ["MAT_DCML_TPU_DECODE_IMPL"] = "pallas"
+import jax, jax.numpy as jnp
+jax.config.update("jax_platforms", "cpu")
+from jax.experimental import topologies
+print("imports done", flush=True)
+topo = topologies.get_topology_desc(
+    "v5e:1x1x1", platform="tpu", chips_per_host_bounds=[1, 1, 1])
+print("topology ok", flush=True)
+sh = jax.sharding.SingleDeviceSharding(topo.devices[0])
+
+from mat_dcml_tpu.models.mat import CONTINUOUS, DISCRETE, MATConfig
+from mat_dcml_tpu.models.policy import TransformerPolicy
+
+B, A = 64, 5
+at = DISCRETE if action_type == "discrete" else CONTINUOUS
+cfg = MATConfig(n_agent=A, obs_dim=4, state_dim=12, action_dim=3,
+                n_block=2, n_embd=32, n_head=2, action_type=at,
+                semi_index=-1, dtype="float32")
+policy = TransformerPolicy(cfg)
+params = policy.init_params(jax.random.key(42))
+args = (params, jax.random.key(7), jnp.zeros((B, A, 12)),
+        jnp.zeros((B, A, 4)), jnp.ones((B, A, cfg.action_dim)))
+abstract = jax.tree.map(
+    lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=sh), args)
+jax.jit(lambda p, k, s, o, a: policy.get_actions(p, k, s, o, a)).lower(
+    *abstract).compile()
+print("COMPILE_OK", flush=True)
+"""
+
+
+@functools.lru_cache(maxsize=1)
+def _chipless_aot_available() -> bool:
+    """One cheap subprocess probe, cached across the parametrized cases: can
+    this host build a TPU topology description at all?  90s cap — on hosts
+    without libtpu the call hangs rather than raising."""
+    import subprocess
+    import sys as _sys
+
+    probe = (
+        "import jax; jax.config.update('jax_platforms', 'cpu'); "
+        "from jax.experimental import topologies; "
+        "topologies.get_topology_desc('v5e:1x1x1', platform='tpu', "
+        "chips_per_host_bounds=[1, 1, 1]); print('ok')"
+    )
+    try:
+        proc = subprocess.run([_sys.executable, "-c", probe],
+                              capture_output=True, text=True, timeout=90)
+    except subprocess.TimeoutExpired:
+        return False
+    return proc.returncode == 0 and "ok" in proc.stdout
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("action_type", ["discrete", "continuous"])
+def test_kernels_aot_compile_for_tpu(action_type):
+    """fused_ar_decode (discrete) / fused_decode_step (continuous fallback)
+    must pass the real Mosaic lowering for a v5e, compiled chiplessly."""
+    import subprocess
+    import sys as _sys
+
+    if not _chipless_aot_available():
+        pytest.skip("chipless AOT unavailable: no usable libtpu/topology "
+                    "support on this host")
+    try:
+        proc = subprocess.run(
+            [_sys.executable, "-c", _AOT_CHILD, action_type],
+            capture_output=True, text=True, timeout=420,
+            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        )
+    except subprocess.TimeoutExpired as e:
+        out = e.stdout.decode() if isinstance(e.stdout, bytes) else (e.stdout or "")
+        pytest.fail(f"AOT compile timed out:\n{out}")
+    if "COMPILE_OK" not in proc.stdout:
+        pytest.fail(f"TPU AOT compile failed for {action_type}:\n"
+                    f"{proc.stdout}\n{(proc.stderr or '')[-3000:]}")
+
+
 def test_semi_discrete_dcml_shape():
     """DCML-shaped config (larger A, one continuous tail agent): exact draw
     parity and a batch tile that divides unevenly into the agent count."""

@@ -239,6 +239,50 @@ def test_smoke_run_metrics_schema(small_runner):
     assert errs == [], errs
 
 
+def test_fused_smoke_run_metrics_schema(tmp_path):
+    """The 2-record smoke contract must hold under --iters_per_dispatch K>1:
+    same episodes, ONE fused compile, dispatch-level timers in place of the
+    per-phase ones, and the validator's fused branch green."""
+    consts = DCMLConsts(worker_number_max=W, sob_dim=W + 2)
+    rng = np.random.default_rng(0)
+    workloads = rng.integers(0, 5, size=(W, consts.local_workload_period)).astype(np.float32)
+    env = DCMLEnv(DCMLEnvConfig(consts=consts), base_workloads=workloads)
+    run = RunConfig(
+        algorithm_name="mat", n_rollout_threads=2, episode_length=8,
+        num_env_steps=4 * 8 * 2, log_interval=2, save_interval=0,
+        n_block=1, n_embd=16, n_head=1, iters_per_dispatch=2,
+        run_dir=str(tmp_path),
+    )
+    r = DCMLRunner(run, PPOConfig(ppo_epoch=2, num_mini_batch=2),
+                   env=env, log_fn=lambda s: None)
+    r.train_loop()
+    r.writer.close()
+    recs = [json.loads(l) for l in open(r.metrics_path)]
+    assert len(recs) == 2                     # 4 episodes as 2 fused dispatches
+
+    required = (
+        "env_steps_per_sec", "step_time_dispatch", "step_time_host_block",
+        "grad_norm", "compile_count", "compile_seconds_total",
+        "device_bytes_in_use", "param_norm", "update_ratio",
+        "host_rss_bytes", "agent_steps_per_sec", "nonfinite_grad_steps",
+        "iters_per_dispatch", "dispatch_count", "dispatches_per_sec",
+    )
+    for rec in recs:
+        for k in required:
+            assert k in rec, f"missing {k} in {sorted(rec)}"
+        assert rec["iters_per_dispatch"] == 2
+
+    # ONE fused executable compiles once; never again in steady state
+    assert recs[-1]["compile_count"] == 1
+    assert recs[-1]["compile_count_dispatch"] == 1
+    assert all(rec.get("steady_state_recompiles", 0) == 0 for rec in recs)
+    assert recs[-1]["env_steps"] == 64        # 4 episodes * T=8 * E=2
+    assert recs[-1]["dispatch_count"] == 2
+
+    errs = check_metrics_schema.validate_file(r.metrics_path)
+    assert errs == [], errs
+
+
 def test_nan_guard_counts_bad_gradients(small_runner):
     r = small_runner
     train_state, rollout_state = r.setup()
