@@ -282,20 +282,23 @@ def _measure(jax, E: int, T: int, iters: int, profile_dir: str | None = None,
 
     iter_secs = []
     start = time.perf_counter()
-    for i in range(iters):
-        t_it = time.perf_counter()
-        if combined:
-            train_state, rollout_state, _ = step(train_state, rollout_state, jax.random.key(3 + i))
-        else:
-            rollout_state, traj = collect(train_state.params, rollout_state)
-            train_state, _ = train(train_state, traj, rollout_state, jax.random.key(3 + i))
-        jax.block_until_ready(train_state)
-        iter_secs.append(time.perf_counter() - t_it)
+    try:
+        for i in range(iters):
+            t_it = time.perf_counter()
+            if combined:
+                train_state, rollout_state, _ = step(train_state, rollout_state, jax.random.key(3 + i))
+            else:
+                rollout_state, traj = collect(train_state.params, rollout_state)
+                train_state, _ = train(train_state, traj, rollout_state, jax.random.key(3 + i))
+            jax.block_until_ready(train_state)
+            iter_secs.append(time.perf_counter() - t_it)
+    finally:
+        # a crash mid-loop must still terminate the trace, or the partial
+        # xplane.pb is unreadable
+        if profile_dir:
+            jax.profiler.stop_trace()
+            log(f"profile trace written to {profile_dir}")
     elapsed = time.perf_counter() - start
-
-    if profile_dir:
-        jax.profiler.stop_trace()
-        log(f"profile trace written to {profile_dir}")
 
     steps = iters * inner * E * T
     result = {

@@ -56,6 +56,26 @@ class RunConfig:
     # annotate model/trainer phases with jax.named_scope so xplane traces and
     # scripts/trace_report.py group op time semantically; trace-time only
     trace_named_scopes: bool = True
+    # anomaly tripwires (telemetry/anomaly.py): EMA-baselined detection over
+    # nonfinite grads, grad/param-norm and update-ratio spikes, step-time
+    # regressions, and steady-state recompiles; trips emit typed "anomaly"
+    # records into metrics.jsonl and drive the flight recorder / profiler
+    # window below
+    anomaly_tripwires: bool = True
+    # where tripped runs dump repro bundles (and tripwire profiler traces)
+    anomaly_dir: str = "artifacts"
+    # flight recorder (telemetry/flight_recorder.py): keep host snapshots of
+    # the last N dispatch inputs, taken BEFORE each launch (the donated
+    # buffers are gone afterwards).  0 disables (default — snapshots are a
+    # blocking device->host copy).  Under --iters_per_dispatch K>1, detection
+    # lags launch by one dispatch, so use a depth of at least 2.
+    flight_recorder_depth: int = 0
+    # snapshot every N-th episode/dispatch (amortizes the blocking copy)
+    flight_recorder_interval: int = 1
+    # on a tripwire, capture a bounded jax.profiler trace window spanning this
+    # many subsequent dispatches into anomaly_dir (0 disables); at most one
+    # window per run
+    anomaly_profile_dispatches: int = 0
     # model
     n_block: int = 2
     n_embd: int = 64

@@ -33,7 +33,7 @@ from mat_dcml_tpu.models.mat import (
     NORMAL_STD,
 )
 from mat_dcml_tpu.ops import distributions as D
-from mat_dcml_tpu.telemetry.scopes import named_scope
+from mat_dcml_tpu.telemetry.scopes import named_scope, probe
 
 
 class DecodeResult(NamedTuple):
@@ -204,6 +204,7 @@ def ar_decode(
     # scan stacks on axis 0 -> (A, B, d); move agents to axis 1.
     action = jnp.swapaxes(acts, 0, 1)
     log_prob = jnp.swapaxes(logps, 0, 1)
+    probe("mat/ar_decode", {"action": action, "log_prob": log_prob})
     return DecodeResult(action, log_prob)
 
 

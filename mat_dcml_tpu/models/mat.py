@@ -34,7 +34,7 @@ from mat_dcml_tpu.models.modules import (
     dense,
     init_decode_cache,
 )
-from mat_dcml_tpu.telemetry.scopes import named_scope
+from mat_dcml_tpu.telemetry.scopes import named_scope, probe
 
 DISCRETE = "discrete"
 SEMI_DISCRETE = "semi_discrete"
@@ -142,6 +142,7 @@ class Encoder(nn.Module):
             for blk in self.blocks:
                 rep = blk(rep)
             v_loc = self.head(rep)
+            probe("mat/encoder", {"rep": rep, "v_loc": v_loc})
             return v_loc, rep
 
 
@@ -209,11 +210,14 @@ class Decoder(nn.Module):
         """Full teacher-forced pass -> ``(B, n_agent, action_dim)`` logits."""
         with named_scope("mat/decoder"):
             if self.cfg.dec_actor:
-                return self.mlp(obs)
-            x = self.ln(self._embed_action(shifted_action))
-            for blk in self.blocks:
-                x = blk(x, obs_rep)
-            return self.head(x)
+                logits = self.mlp(obs)
+            else:
+                x = self.ln(self._embed_action(shifted_action))
+                for blk in self.blocks:
+                    x = blk(x, obs_rep)
+                logits = self.head(x)
+            probe("mat/decoder", {"logits": logits})
+            return logits
 
     def decode_step(self, shifted_action_i: jax.Array, rep_i: jax.Array, obs_i: jax.Array, caches, i):
         """One autoregressive position with KV caches.

@@ -20,7 +20,7 @@ from typing import Tuple
 import jax
 import jax.numpy as jnp
 
-from mat_dcml_tpu.telemetry.scopes import named_scope
+from mat_dcml_tpu.telemetry.scopes import named_scope, probe
 
 
 def compute_gae(
@@ -54,4 +54,5 @@ def compute_gae(
         init = jnp.zeros_like(rewards[0])
         _, adv = jax.lax.scan(step, init, inputs, reverse=True)
         returns = adv + values[:-1]
+        probe("ops/gae", {"advantages": adv, "returns": returns})
         return adv, returns

@@ -8,20 +8,50 @@ Everything is dependency-free and jit-safe — host-side observation happens
 only at call boundaries and flush time, never inside a trace.
 """
 
+from mat_dcml_tpu.telemetry.anomaly import (
+    Anomaly,
+    AnomalyConfig,
+    AnomalyDetector,
+    ProfilerWindow,
+)
 from mat_dcml_tpu.telemetry.async_fetch import DeferredFetch
+from mat_dcml_tpu.telemetry.flight_recorder import (
+    FlightRecorder,
+    load_bundle,
+    pack_tree,
+    unpack_tree,
+)
 from mat_dcml_tpu.telemetry.jit_instrument import InstrumentedJit, instrumented_jit
 from mat_dcml_tpu.telemetry.registry import Telemetry
-from mat_dcml_tpu.telemetry.scopes import named_scope, named_scopes_enabled, set_named_scopes
+from mat_dcml_tpu.telemetry.scopes import (
+    ProbeSink,
+    named_scope,
+    named_scopes_enabled,
+    probe,
+    set_named_scopes,
+    set_probe_sink,
+)
 from mat_dcml_tpu.telemetry.system import device_memory_gauges, host_rss_bytes
 
 __all__ = [
+    "Anomaly",
+    "AnomalyConfig",
+    "AnomalyDetector",
     "DeferredFetch",
+    "FlightRecorder",
     "InstrumentedJit",
+    "ProbeSink",
+    "ProfilerWindow",
     "Telemetry",
     "device_memory_gauges",
     "host_rss_bytes",
     "instrumented_jit",
+    "load_bundle",
     "named_scope",
     "named_scopes_enabled",
+    "pack_tree",
+    "probe",
     "set_named_scopes",
+    "set_probe_sink",
+    "unpack_tree",
 ]

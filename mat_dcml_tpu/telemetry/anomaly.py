@@ -1,0 +1,230 @@
+"""Tripwire engine: EMA-baselined anomaly detection over registry signals.
+
+The telemetry layer streams *that* training is healthy; this module decides
+*when it stopped being healthy*, cheaply enough to run at every metric
+observation point.  :class:`AnomalyDetector` keeps an exponential-moving
+baseline per signal and trips on:
+
+- ``nonfinite_grads`` — any minibatch update with a NaN/Inf global grad norm
+  (immediate; no baseline needed);
+- a nonfinite *value* of any observed signal (a NaN loss is an anomaly even
+  before it poisons a gradient);
+- spike signals (``grad_norm``, ``param_norm``, ``update_ratio``) exceeding
+  ``spike_factor`` x their EMA baseline after ``warmup`` observations;
+- step-time signals (``step_time_dispatch`` / ``step_time_train`` /
+  ``step_time_collect``) exceeding ``time_factor`` x baseline — a steady-state
+  perf regression, e.g. a device falling off its fast path;
+- the ``steady_state_recompiles`` counter increasing — the recompile detector
+  (jit_instrument.py) already logs the signature diff; the tripwire turns it
+  into a typed record and a captured repro bundle.
+
+Trips become :class:`Anomaly` records written into the same metrics.jsonl
+stream (``scripts/check_metrics_schema.py`` has a dedicated ``anomaly``
+branch), and the runner reacts by dumping a flight-recorder bundle and
+opening a bounded profiler window (:class:`ProfilerWindow`).
+
+Nothing here touches jax except ``ProfilerWindow`` (host-side profiler
+start/stop); detection is plain Python arithmetic on host floats.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Dict, List, Optional
+
+SPIKE_SIGNALS = ("grad_norm", "param_norm", "update_ratio")
+TIME_SIGNALS = ("step_time_dispatch", "step_time_train", "step_time_collect")
+
+
+@dataclasses.dataclass(frozen=True)
+class AnomalyConfig:
+    spike_factor: float = 4.0   # trip when signal > factor * EMA baseline
+    time_factor: float = 2.0    # step-time regression threshold
+    warmup: int = 8             # observations before a baseline is trusted
+    cooldown: int = 16          # units (episodes/dispatches) between repeat
+                                # trips of the same kind — one bad regime must
+                                # not flood the stream with identical records
+    beta: float = 0.9           # EMA decay per observation
+
+
+@dataclasses.dataclass(frozen=True)
+class Anomaly:
+    kind: str                   # e.g. "nonfinite_grads", "grad_norm_spike"
+    signal: str                 # the registry signal that tripped
+    value: float
+    baseline: Optional[float]
+    episode: int
+    total_steps: int
+
+    def to_record(self) -> dict:
+        """Jsonl-safe record: the ``anomaly`` key routes validators to the
+        anomaly branch; nonfinite values encode as strings because strict
+        JSON has no NaN/Inf literal."""
+
+        def enc(v):
+            if v is None or math.isfinite(v):
+                return v
+            if math.isnan(v):
+                return "nan"
+            return "inf" if v > 0 else "-inf"
+
+        return {
+            "anomaly": self.kind,
+            "signal": self.signal,
+            "value": enc(self.value),
+            "baseline": enc(self.baseline),
+            "episode": self.episode,
+            "total_steps": self.total_steps,
+        }
+
+
+class AnomalyDetector:
+    """Feed ``observe`` a flat ``{signal: float}`` dict once per unit
+    (episode or fused dispatch); it returns the anomalies that tripped."""
+
+    def __init__(self, cfg: AnomalyConfig = AnomalyConfig(), telemetry=None):
+        self.cfg = cfg
+        self.telemetry = telemetry
+        self._ema: Dict[str, float] = {}
+        self._n: Dict[str, int] = {}
+        self._last_trip: Dict[str, int] = {}
+        self._unit = 0
+        self._recompiles_seen = 0.0
+
+    # ------------------------------------------------------------- internals
+
+    def _cooled(self, kind: str) -> bool:
+        last = self._last_trip.get(kind)
+        return last is None or self._unit - last >= self.cfg.cooldown
+
+    def _trip(self, out: List[Anomaly], kind: str, signal: str, value: float,
+              baseline: Optional[float], episode: int, total_steps: int) -> None:
+        if not self._cooled(kind):
+            return
+        self._last_trip[kind] = self._unit
+        out.append(Anomaly(kind, signal, float(value), baseline, episode, total_steps))
+        if self.telemetry is not None:
+            self.telemetry.count("anomalies_total")
+            self.telemetry.count(f"anomalies_{kind}")
+
+    def _baseline(self, name: str, value: float) -> Optional[float]:
+        """Current trusted baseline for ``name`` (None during warmup); call
+        ``_absorb`` separately so tripped values never dilute the baseline."""
+        if self._n.get(name, 0) < self.cfg.warmup:
+            return None
+        return self._ema[name]
+
+    def _absorb(self, name: str, value: float) -> None:
+        if name in self._ema:
+            b = self.cfg.beta
+            self._ema[name] = b * self._ema[name] + (1.0 - b) * value
+        else:
+            self._ema[name] = value
+        self._n[name] = self._n.get(name, 0) + 1
+
+    # -------------------------------------------------------------- observe
+
+    def observe(self, signals: Dict[str, float], episode: int,
+                total_steps: int) -> List[Anomaly]:
+        """One detection pass.  ``signals`` maps registry names to host
+        floats; unknown names are baselined but only the documented families
+        can trip.  Nonfinite signal values trip regardless of family."""
+        out: List[Anomaly] = []
+        self._unit += 1
+
+        nf = signals.get("nonfinite_grads", 0.0)
+        if nf is not None and (not math.isfinite(nf) or nf > 0):
+            self._trip(out, "nonfinite_grads", "nonfinite_grads", nf, None,
+                       episode, total_steps)
+
+        recompiles = signals.get("steady_state_recompiles", 0.0) or 0.0
+        if recompiles > self._recompiles_seen:
+            self._trip(out, "steady_state_recompile", "steady_state_recompiles",
+                       recompiles, self._recompiles_seen, episode, total_steps)
+            self._recompiles_seen = recompiles
+
+        for name, value in signals.items():
+            if value is None or name in ("nonfinite_grads", "steady_state_recompiles"):
+                continue
+            value = float(value)
+            if not math.isfinite(value):
+                self._trip(out, "nonfinite_value", name, value, None,
+                           episode, total_steps)
+                continue
+            factor = None
+            if name in SPIKE_SIGNALS:
+                factor = self.cfg.spike_factor
+            elif name in TIME_SIGNALS:
+                factor = self.cfg.time_factor
+            if factor is not None:
+                base = self._baseline(name, value)
+                if base is not None and value > factor * max(base, 1e-12):
+                    self._trip(out, f"{name}_spike", name, value, base,
+                               episode, total_steps)
+                    continue  # spikes stay out of their own baseline
+            self._absorb(name, value)
+        return out
+
+
+class ProfilerWindow:
+    """Bounded tripwire-triggered ``jax.profiler`` trace window.
+
+    ``trigger`` starts a trace into ``<dir>/anomaly_<tag>``; ``tick`` (called
+    once per episode/dispatch, AFTER the unit's work) counts it down and stops
+    after ``n_units``.  Fires at most once per run so a persistent anomaly
+    cannot re-trace forever, and ``close`` (runner's try/finally) guarantees a
+    crash mid-window still terminates the trace instead of leaving a corrupt
+    xplane.pb.
+    """
+
+    def __init__(self, directory: Optional[str], n_units: int, log=print):
+        self.directory = directory
+        self.n_units = int(n_units)
+        self.log = log
+        self.active = False
+        self._remaining = 0
+        self._fired = False
+
+    @property
+    def enabled(self) -> bool:
+        return self.directory is not None and self.n_units > 0
+
+    def trigger(self, tag: str) -> bool:
+        if not self.enabled or self.active or self._fired:
+            return False
+        import jax
+
+        target = f"{self.directory}/anomaly_{tag}"
+        try:
+            jax.profiler.start_trace(target)
+        except Exception as e:  # another trace active (scheduled --profile_dir)
+            self.log(f"[anomaly] profiler window skipped: {e}")
+            return False
+        self._fired = True
+        self.active = True
+        self._remaining = self.n_units
+        self.log(f"[anomaly] profiler window open -> {target} "
+                 f"({self.n_units} dispatches)")
+        return True
+
+    def tick(self) -> None:
+        if not self.active:
+            return
+        self._remaining -= 1
+        if self._remaining <= 0:
+            self._stop()
+
+    def close(self) -> None:
+        if self.active:
+            self._stop()
+
+    def _stop(self) -> None:
+        import jax
+
+        self.active = False
+        try:
+            jax.profiler.stop_trace()
+            self.log("[anomaly] profiler window closed")
+        except Exception as e:
+            self.log(f"[anomaly] profiler stop failed: {e}")

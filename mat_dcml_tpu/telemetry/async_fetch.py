@@ -25,11 +25,18 @@ class DeferredFetch:
 
     def __init__(self, tree: Any):
         self._tree = tree
-        for leaf in jax.tree.leaves(tree):
-            # jax.Array exposes copy_to_host_async; anything else (python
-            # scalars in hand-built trees) is already on the host
-            if hasattr(leaf, "copy_to_host_async"):
-                leaf.copy_to_host_async()
+        self._start_error: Exception | None = None
+        try:
+            for leaf in jax.tree.leaves(tree):
+                # jax.Array exposes copy_to_host_async; anything else (python
+                # scalars in hand-built trees) is already on the host
+                if hasattr(leaf, "copy_to_host_async"):
+                    leaf.copy_to_host_async()
+        except Exception as e:  # deleted/donated buffers, runtime errors: the
+            # launch site must stay non-blocking, so surface it at get()
+            self._start_error = e
 
     def get(self) -> Any:
+        if self._start_error is not None:
+            raise self._start_error
         return jax.device_get(self._tree)

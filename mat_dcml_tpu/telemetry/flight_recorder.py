@@ -1,0 +1,227 @@
+"""Host-side dispatch flight recorder: snapshot-before-donate repro bundles.
+
+The fused dispatch donates its carried train/rollout state, so by the time a
+tripwire fires (one dispatch *after* launch — metrics arrive via
+:class:`~mat_dcml_tpu.telemetry.async_fetch.DeferredFetch`), the offending
+device buffers are gone.  :class:`FlightRecorder` keeps a ring of the last
+``depth`` *host* copies of the dispatch inputs — params, optimizer state,
+rollout carry, the RNG key chain position — taken at a configurable cadence
+BEFORE each dispatch launch (the only point where the buffers are still
+valid), and on a trip dumps the newest snapshot at-or-before the offending
+episode as a self-contained bundle under ``artifacts/``:
+
+    bundle_ep<episode>_<kind>/
+      manifest.json   # run/ppo config, algorithm, iters_per_dispatch,
+                      # snapshot + target episodes, anomaly record, git hash,
+                      # jax/python versions
+      state.pkl       # packed (numpy) train_state / rollout_state / key
+      reference.pkl   # metrics fetched at detection time (bit-exact target)
+      env.pkl         # the env object, when picklable (self-contained replay)
+
+``scripts/replay_bundle.py`` re-executes the captured dispatch from the
+bundle alone and bisects the first nonfinite value by named scope.
+
+Typed PRNG keys cannot round-trip through numpy directly
+(``jax.device_get`` returns a ``PRNGKeyArray``); :func:`pack_tree` stores
+them as :class:`PRNGKeyLeaf` (impl name + raw ``key_data``) and
+:func:`unpack_tree` rebuilds them with ``jax.random.wrap_key_data`` —
+bit-exact round trip.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import json
+import pickle
+import subprocess
+import sys
+from pathlib import Path
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class PRNGKeyLeaf:
+    """Host-serializable typed PRNG key: impl name + raw key data."""
+
+    impl: str
+    data: np.ndarray
+
+
+def pack_tree(tree: Any) -> Any:
+    """Blocking device->host copy of a pytree, numpy leaves; typed PRNG keys
+    become :class:`PRNGKeyLeaf`.  Safe to pickle."""
+    import jax
+    import jax.numpy as jnp
+
+    def pack_leaf(x):
+        # np.array(copy=True), not np.asarray: on the CPU backend device_get
+        # can return a zero-copy VIEW of the XLA buffer, and the dispatch
+        # about to launch donates that buffer — XLA then reuses the memory in
+        # place and a view-based "snapshot" is silently clobbered before the
+        # bundle is pickled.
+        if isinstance(x, jax.Array) and jnp.issubdtype(x.dtype, jax.dtypes.prng_key):
+            return PRNGKeyLeaf(str(jax.random.key_impl(x)),
+                               np.array(jax.random.key_data(x), copy=True))
+        if hasattr(x, "__array__") or isinstance(x, (bool, int, float, complex)):
+            return np.array(jax.device_get(x), copy=True)
+        return x
+
+    return jax.tree.map(pack_leaf, tree)
+
+
+def unpack_tree(tree: Any) -> Any:
+    """Inverse of :func:`pack_tree`: numpy -> device arrays, key leaves ->
+    typed PRNG keys."""
+    import jax
+    import jax.numpy as jnp
+
+    def unpack_leaf(x):
+        if isinstance(x, PRNGKeyLeaf):
+            return jax.random.wrap_key_data(jnp.asarray(x.data), impl=x.impl)
+        if isinstance(x, np.ndarray) or isinstance(x, (bool, int, float, complex)):
+            return jnp.asarray(x)
+        return x
+
+    return jax.tree.map(unpack_leaf, tree,
+                        is_leaf=lambda x: isinstance(x, PRNGKeyLeaf))
+
+
+def git_hash(repo_root: Optional[Path] = None) -> str:
+    root = repo_root or Path(__file__).resolve().parents[2]
+    try:
+        out = subprocess.run(
+            ["git", "rev-parse", "HEAD"], cwd=root, capture_output=True,
+            text=True, timeout=10,
+        )
+        return out.stdout.strip() or "unknown"
+    except Exception:
+        return "unknown"
+
+
+@dataclasses.dataclass
+class Bundle:
+    path: Path
+    manifest: Dict[str, Any]
+    state: Dict[str, Any]          # packed: episode / train_state / rollout_state / key
+    reference: Optional[Dict[str, Any]]
+    env: Any
+
+
+def load_bundle(path) -> Bundle:
+    path = Path(path)
+    manifest = json.loads((path / "manifest.json").read_text())
+    with open(path / "state.pkl", "rb") as f:
+        state = pickle.load(f)
+    reference = None
+    if (path / "reference.pkl").exists():
+        with open(path / "reference.pkl", "rb") as f:
+            reference = pickle.load(f)
+    env = None
+    if (path / "env.pkl").exists():
+        with open(path / "env.pkl", "rb") as f:
+            env = pickle.load(f)
+    return Bundle(path, manifest, state, reference, env)
+
+
+class FlightRecorder:
+    """Ring buffer of packed dispatch inputs + bundle dumping.
+
+    ``depth=0`` disables everything (the default: zero steady-state cost).
+    ``interval`` amortizes the blocking pack over that many snapshot calls —
+    the runner calls :meth:`snapshot` once per episode/dispatch, immediately
+    before launch, while the input buffers are still un-donated.
+    """
+
+    def __init__(self, depth: int, interval: int, directory,
+                 run_config=None, ppo_config=None, env=None,
+                 iters_per_dispatch: int = 1, telemetry=None, log=print):
+        self.depth = int(depth)
+        self.interval = max(1, int(interval))
+        self.directory = Path(directory)
+        self.run_config = run_config
+        self.ppo_config = ppo_config
+        self.env = env
+        self.iters_per_dispatch = int(iters_per_dispatch)
+        self.telemetry = telemetry
+        self.log = log
+        self._ring = collections.deque(maxlen=max(self.depth, 1))
+        self._calls = 0
+        self._dumped_kinds = set()
+
+    @property
+    def enabled(self) -> bool:
+        return self.depth > 0
+
+    # ------------------------------------------------------------- snapshot
+
+    def snapshot(self, episode: int, train_state, rollout_state, key) -> bool:
+        """Pack the dispatch inputs onto the ring (blocking device->host) at
+        the configured cadence.  Returns True when a snapshot was taken."""
+        if not self.enabled:
+            return False
+        take = self._calls % self.interval == 0
+        self._calls += 1
+        if not take:
+            return False
+        self._ring.append({
+            "episode": int(episode),
+            "train_state": pack_tree(train_state),
+            "rollout_state": pack_tree(rollout_state),
+            "key": pack_tree(key),
+        })
+        if self.telemetry is not None:
+            self.telemetry.count("flight_snapshots")
+        return True
+
+    # ----------------------------------------------------------------- dump
+
+    def dump(self, anomaly, target_episode: int,
+             reference: Optional[Dict[str, Any]] = None) -> Optional[Path]:
+        """Write the repro bundle for ``anomaly``: the newest snapshot whose
+        episode is at or before ``target_episode`` (the first episode of the
+        offending dispatch), once per anomaly kind per run."""
+        if not self.enabled or not self._ring:
+            return None
+        if anomaly.kind in self._dumped_kinds:
+            return None
+        self._dumped_kinds.add(anomaly.kind)
+        snap = None
+        for cand in self._ring:
+            if cand["episode"] <= target_episode:
+                snap = cand  # ring is oldest->newest; keep the newest match
+        if snap is None:
+            snap = self._ring[0]
+        out = self.directory / f"bundle_ep{target_episode}_{anomaly.kind}"
+        out.mkdir(parents=True, exist_ok=True)
+        manifest = {
+            "run_config": dataclasses.asdict(self.run_config) if self.run_config else None,
+            "ppo_config": dataclasses.asdict(self.ppo_config) if self.ppo_config else None,
+            "algorithm_name": getattr(self.run_config, "algorithm_name", None),
+            "iters_per_dispatch": self.iters_per_dispatch,
+            "snapshot_episode": snap["episode"],
+            "target_episode": int(target_episode),
+            "anomaly": anomaly.to_record(),
+            "git_hash": git_hash(),
+            "jax_version": __import__("jax").__version__,
+            "python_version": sys.version.split()[0],
+        }
+        (out / "manifest.json").write_text(json.dumps(manifest, indent=1, default=str))
+        with open(out / "state.pkl", "wb") as f:
+            pickle.dump(snap, f)
+        if reference is not None:
+            with open(out / "reference.pkl", "wb") as f:
+                pickle.dump(reference, f)
+        if self.env is not None:
+            try:
+                with open(out / "env.pkl", "wb") as f:
+                    pickle.dump(self.env, f)
+            except Exception as e:   # env holds unpicklable handles: still
+                self.log(f"[flight] env not picklable ({e}); bundle replays "
+                         f"only with a caller-built env")
+        if self.telemetry is not None:
+            self.telemetry.count("flight_bundles")
+        self.log(f"[flight] repro bundle -> {out}")
+        return out

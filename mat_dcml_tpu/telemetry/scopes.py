@@ -6,15 +6,26 @@ autoregressive decode, GAE, PPO update — instead of a flat HLO op soup.
 Scopes are applied at *trace* time only (zero steady-state cost); the
 ``--trace_named_scopes`` flag flips the module-level switch before anything
 compiles, and disabling yields a no-op context manager.
+
+The same scope sites double as value :func:`probe` points for nonfinite
+bisection (``scripts/replay_bundle.py``): with no :class:`ProbeSink`
+installed — the always case in training — ``probe`` returns before touching
+jax, so compiled programs contain no callbacks.  Replay installs a sink and
+re-runs the offending dispatch under ``jax.disable_jit()``, where
+``jax.debug.callback`` fires eagerly and in program order, so the first
+recorded nonfinite value names the first offending scope.
 """
 
 from __future__ import annotations
 
 import contextlib
+import functools
+from typing import Any, List, Optional, Tuple
 
 import jax
 
 _ENABLED = True
+_PROBE_SINK: Optional["ProbeSink"] = None
 
 
 def set_named_scopes(enabled: bool) -> None:
@@ -31,3 +42,51 @@ def named_scope(name: str):
     if _ENABLED:
         return jax.named_scope(name)
     return contextlib.nullcontext()
+
+
+class ProbeSink:
+    """Ordered collection of ``(scope_name, host_value)`` probe events."""
+
+    def __init__(self):
+        self.events: List[Tuple[str, Any]] = []
+
+    def _record(self, name: str, value) -> None:
+        import numpy as np
+
+        self.events.append((name, jax.tree.map(np.asarray, value)))
+
+    def mark(self, label: str) -> None:
+        """Host-side phase marker (value ``None``; never nonfinite)."""
+        self.events.append((label, None))
+
+    def first_nonfinite(self) -> Optional[Tuple[str, Any]]:
+        """First probe event containing a NaN/Inf leaf, or ``None``."""
+        import numpy as np
+
+        for name, value in self.events:
+            if value is None:
+                continue
+            for leaf in jax.tree.leaves(value):
+                arr = np.asarray(leaf)
+                if arr.dtype.kind == "f" and not np.isfinite(arr).all():
+                    return name, arr
+        return None
+
+
+def set_probe_sink(sink: Optional[ProbeSink]) -> Optional[ProbeSink]:
+    """Install (or clear, with ``None``) the global probe sink; returns the
+    previous sink so callers can restore it."""
+    global _PROBE_SINK
+    prev = _PROBE_SINK
+    _PROBE_SINK = sink
+    return prev
+
+
+def probe(name: str, value) -> None:
+    """Record ``value`` under ``name`` when a sink is installed; no-op (and
+    absent from compiled programs) otherwise.  Call at named-scope sites with
+    the scope's name so bisection verdicts match trace_report.py rollups."""
+    sink = _PROBE_SINK
+    if sink is None:
+        return
+    jax.debug.callback(functools.partial(sink._record, name), value)

@@ -24,8 +24,11 @@ import numpy as np
 
 from mat_dcml_tpu.config import RunConfig
 from mat_dcml_tpu.telemetry import (
+    AnomalyDetector,
     DeferredFetch,
+    FlightRecorder,
     InstrumentedJit,
+    ProfilerWindow,
     Telemetry,
     device_memory_gauges,
     host_rss_bytes,
@@ -125,6 +128,18 @@ def _cadence_hits(interval: int, ep0: int, k: int) -> bool:
     return (ep0 + interval - 1) // interval * interval < ep0 + k
 
 
+def bootstrap_input(is_mat: bool, collector, rs):
+    """The trainer's bootstrap argument for a post-collect rollout state:
+    MAT-family trainers consume the rollout state directly; the AC family
+    takes a :class:`Bootstrap`.  Module-level so ``scripts/replay_bundle.py``
+    can mirror the host loop's train call exactly."""
+    if is_mat:
+        return rs
+    use_local = getattr(collector, "use_local_value", False)
+    cent = rs.obs if use_local else rs.share_obs
+    return Bootstrap(cent_obs=cent, critic_h=rs.critic_h, mask=rs.mask)
+
+
 def ac_config_kwargs(ppo: PPOConfig) -> dict:
     """PPOConfig -> MAPPOConfig shared-field mapping (one place, so CLI flags
     behave identically across entry points)."""
@@ -170,6 +185,27 @@ class BaseRunner:
         # --iters_per_dispatch > 1 and the trainer/collector pair supports it)
         self._dispatch = None
         self._dispatch_iters = 1
+        # tripwires + capture-at-failure (telemetry/anomaly.py,
+        # telemetry/flight_recorder.py): detection feeds off the metrics the
+        # loop already fetches; the recorder snapshots dispatch inputs BEFORE
+        # launch, the only point where donated buffers are still valid
+        self.anomaly = (
+            AnomalyDetector(telemetry=self.telemetry)
+            if run.anomaly_tripwires else None
+        )
+        self.profile_window = ProfilerWindow(
+            run.anomaly_dir, run.anomaly_profile_dispatches, log_fn
+        )
+        self.flight = FlightRecorder(
+            depth=run.flight_recorder_depth,
+            interval=run.flight_recorder_interval,
+            directory=run.anomaly_dir,
+            run_config=run,
+            ppo_config=getattr(self, "ppo_cfg", None),
+            env=getattr(self, "env", None) or getattr(self.collector, "env", None),
+            telemetry=self.telemetry,
+            log=log_fn,
+        )
         self.run_dir = (
             Path(run.run_dir) / run.env_name / run.scenario / run.algorithm_name / run.experiment_name
         )
@@ -187,11 +223,7 @@ class BaseRunner:
     # ------------------------------------------------------------------ setup
 
     def _bootstrap(self, rs):
-        if self.is_mat:
-            return rs
-        use_local = getattr(self.collector, "use_local_value", False)
-        cent = rs.obs if use_local else rs.share_obs
-        return Bootstrap(cent_obs=cent, critic_h=rs.critic_h, mask=rs.mask)
+        return bootstrap_input(self.is_mat, self.collector, rs)
 
     def setup(self, seed: Optional[int] = None):
         seed = self.run_cfg.seed if seed is None else seed
@@ -243,16 +275,26 @@ class BaseRunner:
         key = jax.random.key(run.seed + 7919)
 
         K = max(1, int(getattr(run, "iters_per_dispatch", 1)))
-        if K > 1:
-            if not getattr(self.collector, "jittable", True):
-                self.log("[dispatch] collector is host-driven (jittable=False); "
-                         "--iters_per_dispatch ignored")
-            elif not hasattr(self.trainer, "train_iteration"):
-                self.log(f"[dispatch] {type(self.trainer).__name__} has no "
-                         f"train_iteration; --iters_per_dispatch ignored")
-            else:
-                return self._train_loop_fused(episodes, train_state, rollout_state, key, K)
+        try:
+            if K > 1:
+                if not getattr(self.collector, "jittable", True):
+                    self.log("[dispatch] collector is host-driven (jittable=False); "
+                             "--iters_per_dispatch ignored")
+                elif not hasattr(self.trainer, "train_iteration"):
+                    self.log(f"[dispatch] {type(self.trainer).__name__} has no "
+                             f"train_iteration; --iters_per_dispatch ignored")
+                else:
+                    return self._train_loop_fused(episodes, train_state, rollout_state, key, K)
+            return self._train_loop_episodic(episodes, train_state, rollout_state, key)
+        finally:
+            # a tripwire profiler window still open at exit — normal return OR
+            # a crash mid-run — must stop its trace or the xplane.pb is corrupt
+            self.profile_window.close()
 
+    def _train_loop_episodic(self, episodes, train_state, rollout_state, key):
+        """K=1 loop: two dispatches (collect, train) per episode."""
+        run = self.run_cfg
+        self.flight.iters_per_dispatch = 1
         # episode accounting (dcml_runner.py:29-74)
         E = run.n_rollout_threads
         acc_rew = np.zeros(E)
@@ -269,11 +311,13 @@ class BaseRunner:
 
         start = time.time()
         for episode in range(self.start_episode, episodes):
+            self.profile_window.tick()
             # profile ONE post-warmup iteration (episode start+1: compiles are
             # done, steady-state schedule) — the jax.profiler hook the
             # reference lacked entirely (SURVEY.md §5 tracing)
             profiling = (
                 run.profile_dir is not None and episode == self.start_episode + 1
+                and not self.profile_window.active
             )
             # blocking step timers + NaN-guard fetch every telemetry_interval
             # iterations (cheap — the collect->train chain is serially
@@ -281,27 +325,35 @@ class BaseRunner:
             sampled = run.telemetry_interval > 0 and (
                 (episode - self.start_episode) % run.telemetry_interval == 0
             )
+            # flight recorder: the iteration's inputs, including the pre-split
+            # key, so a bundle replays this episode from here
+            self.flight.snapshot(episode, train_state, rollout_state, key)
             if profiling:
                 jax.profiler.start_trace(run.profile_dir)
-            t_collect = time.perf_counter()
-            rollout_state, traj = self._collect(train_state.params, rollout_state)
-            if profiling or sampled:
-                jax.block_until_ready(traj)
-                t_collect = time.perf_counter() - t_collect
-                if sampled:
-                    tel.observe("step_time_collect", t_collect)
-            key, k_train = jax.random.split(key)
-            t_train = time.perf_counter()
-            train_state, metrics = self._train(
-                train_state, traj, self._bootstrap(rollout_state), k_train
-            )
-            if profiling or sampled:
-                jax.block_until_ready(train_state)
-                t_train = time.perf_counter() - t_train
-                if sampled:
-                    tel.observe("step_time_train", t_train)
+            try:
+                t_collect = time.perf_counter()
+                rollout_state, traj = self._collect(train_state.params, rollout_state)
+                if profiling or sampled:
+                    jax.block_until_ready(traj)
+                    t_collect = time.perf_counter() - t_collect
+                    if sampled:
+                        tel.observe("step_time_collect", t_collect)
+                key, k_train = jax.random.split(key)
+                t_train = time.perf_counter()
+                train_state, metrics = self._train(
+                    train_state, traj, self._bootstrap(rollout_state), k_train
+                )
+                if profiling or sampled:
+                    jax.block_until_ready(train_state)
+                    t_train = time.perf_counter() - t_train
+                    if sampled:
+                        tel.observe("step_time_train", t_train)
+            finally:
+                # an exception mid-iteration must still terminate the trace —
+                # an unterminated capture leaves a corrupt xplane.pb
+                if profiling:
+                    jax.profiler.stop_trace()
             if profiling:
-                jax.profiler.stop_trace()
                 self.log(
                     f"[profile] trace -> {run.profile_dir}; compiled-step wall: "
                     f"collect {t_collect:.3f}s train {t_train:.3f}s"
@@ -314,10 +366,33 @@ class BaseRunner:
 
             tel.count("env_steps", run.episode_length * E)
             tel.count("agent_steps", run.episode_length * E * n_agents)
+            total_steps = (episode + 1) * run.episode_length * E
             if sampled:
-                tel.count("nonfinite_grad_steps", float(np.sum(np.asarray(
-                    jax.device_get(getattr(metrics, "nonfinite_grads", 0.0))
-                ))))
+                # one small blocking fetch covers the NaN guard AND the
+                # tripwire signals
+                health = jax.device_get({
+                    "nonfinite_grads": getattr(metrics, "nonfinite_grads", 0.0),
+                    "grad_norm": getattr(metrics, "grad_norm", 0.0),
+                    "param_norm": getattr(metrics, "param_norm", 0.0),
+                    "update_ratio": getattr(metrics, "update_ratio", 0.0),
+                })
+                nf = float(np.sum(np.asarray(health["nonfinite_grads"])))
+                tel.count("nonfinite_grad_steps", nf)
+                if self.anomaly is not None:
+                    signals = {
+                        "nonfinite_grads": nf,
+                        "grad_norm": float(np.max(np.asarray(health["grad_norm"]))),
+                        "param_norm": float(np.max(np.asarray(health["param_norm"]))),
+                        "update_ratio": float(np.max(np.asarray(health["update_ratio"]))),
+                        "steady_state_recompiles":
+                            tel.counters.get("steady_state_recompiles", 0.0),
+                        "step_time_collect": t_collect,
+                        "step_time_train": t_train,
+                    }
+                    trips = self.anomaly.observe(signals, episode, total_steps)
+                    if trips:
+                        reference = self._metrics_reference(metrics)
+                        self._handle_anomalies(trips, episode, total_steps, reference)
             if episode == self.start_episode:
                 self._mark_steady()
 
@@ -355,7 +430,6 @@ class BaseRunner:
                         acc_delay[finished] = 0
                         acc_pay[finished] = 0
 
-            total_steps = (episode + 1) * run.episode_length * E
             # the first episode after a resume always logs, so every run
             # contributes at least one metrics record
             if episode % run.log_interval == 0 or episode == self.start_episode:
@@ -442,6 +516,7 @@ class BaseRunner:
         T = run.episode_length
         env = getattr(self, "env", None) or getattr(self.collector, "env", None)
         n_agents = int(getattr(env, "n_agents", 1) or 1)
+        self.flight.iters_per_dispatch = K
 
         self._dispatch = instrumented_jit(
             make_dispatch_fn(self.trainer, self.collector, K),
@@ -465,9 +540,17 @@ class BaseRunner:
             # next one is already enqueued, so the device never idles on the
             # host-side formatting below
             t_get = time.perf_counter()
-            metrics, stats = fetch.get()
+            try:
+                metrics, stats = fetch.get()
+            except Exception as e:
+                # a failed fetch must not leave a half-formed record behind:
+                # count it, log it, and skip this dispatch's bookkeeping
+                tel.count("deferred_fetch_errors")
+                self.log(f"[telemetry] deferred fetch failed for dispatch {d}: {e!r}")
+                return
             t_done = time.perf_counter()
-            if run.telemetry_interval > 0 and d % run.telemetry_interval == 0:
+            timed = run.telemetry_interval > 0 and d % run.telemetry_interval == 0
+            if timed:
                 # sync-free derived timer: get() returns when this dispatch's
                 # results landed, so done-minus-launch is its wall duration
                 tel.observe("step_time_dispatch", t_done - t_launch)
@@ -477,8 +560,32 @@ class BaseRunner:
             tel.count("env_steps", T * E * K)
             tel.count("agent_steps", T * E * K * n_agents)
             tel.count("dispatch_count")
-            tel.count("nonfinite_grad_steps", float(np.sum(np.asarray(
-                getattr(metrics, "nonfinite_grads", 0.0)))))
+            nf = float(np.sum(np.asarray(getattr(metrics, "nonfinite_grads", 0.0))))
+            tel.count("nonfinite_grad_steps", nf)
+            if self.anomaly is not None:
+                # metrics are already host numpy (DeferredFetch resolved) —
+                # detection runs every dispatch at zero extra transfer cost.
+                # Spike signals take the max over the K stacked iterations.
+                signals = {
+                    "nonfinite_grads": nf,
+                    "grad_norm": float(np.max(np.asarray(
+                        getattr(metrics, "grad_norm", 0.0)))),
+                    "param_norm": float(np.max(np.asarray(
+                        getattr(metrics, "param_norm", 0.0)))),
+                    "update_ratio": float(np.max(np.asarray(
+                        getattr(metrics, "update_ratio", 0.0)))),
+                    "steady_state_recompiles":
+                        tel.counters.get("steady_state_recompiles", 0.0),
+                }
+                if timed:
+                    signals["step_time_dispatch"] = t_done - t_launch
+                trips = self.anomaly.observe(signals, ep_last, (ep_last + 1) * T * E)
+                if trips:
+                    reference = self._metrics_reference(metrics, stats)
+                    # the bundle targets the FIRST episode of this dispatch —
+                    # its snapshot is the dispatch's input state
+                    self._handle_anomalies(trips, ep_last - K + 1,
+                                           (ep_last + 1) * T * E, reference)
             stats = {k: np.asarray(v) for k, v in stats.items()}
             agg["done"] += float(stats["n_done"].sum())
             agg["rew"] += float(stats["done_reward_sum"].sum())
@@ -537,21 +644,33 @@ class BaseRunner:
         pending = None            # (d, ep_last, fetch, t_launch) in flight
         for d in range(n_disp):
             ep0 = first + d * K
+            self.profile_window.tick()
             # checkpoint/eval for the previous dispatch boundary must run
             # BEFORE this dispatch donates (invalidates) train_state's buffers
             if d > 0:
                 boundary(ep0 - K, ep0 - 1, train_state, final=False)
-            profiling = run.profile_dir is not None and d == 1
+            # snapshot-before-donate: the dispatch about to launch invalidates
+            # these buffers, and its metrics are only inspected one dispatch
+            # later — the ring (depth >= 2) is what still holds this state
+            # when a tripwire fires
+            self.flight.snapshot(ep0, train_state, rollout_state, key)
+            profiling = (run.profile_dir is not None and d == 1
+                         and not self.profile_window.active)
             if profiling:
                 jax.profiler.start_trace(run.profile_dir)
-            t_launch = time.perf_counter()
-            train_state, rollout_state, key, stacked = self._dispatch(
-                train_state, rollout_state, key
-            )
+            try:
+                t_launch = time.perf_counter()
+                train_state, rollout_state, key, stacked = self._dispatch(
+                    train_state, rollout_state, key
+                )
+                if profiling:
+                    jax.block_until_ready(train_state)
+                    dt = time.perf_counter() - t_launch
+            finally:
+                # exception between start/stop must not leave the trace open
+                if profiling:
+                    jax.profiler.stop_trace()
             if profiling:
-                jax.block_until_ready(train_state)
-                dt = time.perf_counter() - t_launch
-                jax.profiler.stop_trace()
                 self.log(f"[profile] trace -> {run.profile_dir}; compiled-"
                          f"dispatch wall: {dt:.3f}s for {K} iterations")
                 self.writer.write(
@@ -571,6 +690,33 @@ class BaseRunner:
                  final=True)
         process(*pending)
         return train_state, rollout_state
+
+    # ------------------------------------------------------------- anomalies
+
+    def _metrics_reference(self, metrics, stats=None):
+        """Host copy of the offending unit's train metrics (and fused
+        chunk_stats), stored in the repro bundle so ``replay_bundle.py`` can
+        assert bit-exact reproduction."""
+        ref = {}
+        if hasattr(metrics, "_fields"):
+            fetched = jax.device_get(tuple(metrics))
+            ref["metrics"] = {f: np.asarray(v)
+                              for f, v in zip(metrics._fields, fetched)}
+        if stats is not None:
+            ref["stats"] = {k: np.asarray(v)
+                            for k, v in jax.device_get(stats).items()}
+        return ref or None
+
+    def _handle_anomalies(self, anomalies, target_episode: int,
+                          total_steps: int, reference=None) -> None:
+        """A tripwire fired: emit the typed records, dump a repro bundle for
+        the offending dispatch, and open the bounded profiler window."""
+        for a in anomalies:
+            self.log(f"[anomaly] {a.kind}: {a.signal}={a.value!r} "
+                     f"baseline={a.baseline} at episode {a.episode}")
+            self.writer.write(a.to_record(), step=total_steps)
+            self.flight.dump(a, target_episode, reference=reference)
+        self.profile_window.trigger(f"ep{target_episode}_{anomalies[0].kind}")
 
     def _mark_steady(self) -> None:
         """First episode (or fused dispatch) done: all warmup compiles

@@ -46,7 +46,7 @@ from jax.flatten_util import ravel_pytree
 
 from mat_dcml_tpu.envs.spaces import Box
 from mat_dcml_tpu.models.actor_critic import ActorCriticPolicy
-from mat_dcml_tpu.telemetry.scopes import named_scope
+from mat_dcml_tpu.telemetry.scopes import named_scope, probe
 from mat_dcml_tpu.training.ac_rollout import ACTrajectory
 from mat_dcml_tpu.training.ippo import IPPORolloutCollector
 from mat_dcml_tpu.training.mappo import (
@@ -240,6 +240,8 @@ class HAPPOTrainer:
             params_i, aopt_i, copt_i, vn_i, metrics = self._update_agent(
                 params_i, aopt_i, copt_i, vn_i, data, k_agent
             )
+            probe("train/happo_update",
+                  {"grad_norm": metrics.grad_norm, "factor": factor})
             new_logp = eval_logp(params_i)
             # factor update (:413): prod over action dims of the logp shift.
             factor = factor * jnp.exp((new_logp - old_logp).sum(-1, keepdims=True))

@@ -26,6 +26,7 @@ from mat_dcml_tpu.training.mappo import (
     MAPPOTrainState,
     ac_train_iteration,
 )
+from mat_dcml_tpu.telemetry.scopes import probe
 
 
 class IPPORolloutCollector(ACRolloutCollector):
@@ -112,4 +113,6 @@ class IPPOTrainer:
             mask=jnp.moveaxis(boot.mask, 1, 0)[:, :, None],
         )
         keys = jax.random.split(key, A)
-        return jax.vmap(self.inner.train)(state, traj_a, boot_a, keys)
+        state, metrics = jax.vmap(self.inner.train)(state, traj_a, boot_a, keys)
+        probe("train/ippo_update", {"grad_norm": metrics.grad_norm})
+        return state, metrics

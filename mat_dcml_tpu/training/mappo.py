@@ -45,7 +45,7 @@ from mat_dcml_tpu.ops.popart import (
     popart_normalize,
     popart_update,
 )
-from mat_dcml_tpu.telemetry.scopes import named_scope
+from mat_dcml_tpu.telemetry.scopes import named_scope, probe
 from mat_dcml_tpu.training.ac_rollout import ACTrajectory
 
 
@@ -226,6 +226,8 @@ class MAPPOTrainer:
             mean = (adv * active).sum() / denom
             var = (((adv - mean) ** 2) * active).sum() / denom
             adv_norm = (adv - mean) / (jnp.sqrt(var) + 1e-5)
+            probe("train/compute_targets",
+                  {"advantages": adv_norm, "returns": returns})
             return adv_norm, returns
 
     def _normalize_targets(self, value_norm, params, ret_b):
@@ -270,6 +272,7 @@ class MAPPOTrainer:
             "critic": optax.apply_updates(params["critic"], c_up),
         }
         gnorm = optax.global_norm(grads)
+        probe("train/mappo_update", {"grad_norm": gnorm})
         pnorm = optax.global_norm(params)
         unorm = optax.global_norm({"actor": a_up, "critic": c_up})
         health = (

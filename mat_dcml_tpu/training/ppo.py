@@ -31,7 +31,7 @@ import jax.numpy as jnp
 import optax
 
 from mat_dcml_tpu.models.policy import TransformerPolicy
-from mat_dcml_tpu.telemetry.scopes import named_scope
+from mat_dcml_tpu.telemetry.scopes import named_scope, probe
 from mat_dcml_tpu.ops.distributions import huber_loss
 from mat_dcml_tpu.ops.gae import compute_gae
 from mat_dcml_tpu.ops.normalize import (
@@ -243,6 +243,8 @@ class MATTrainer:
                 adv_norm = (adv - mean) / (jnp.sqrt(var) + 1e-5)
                 if self.n_objective > 1 and not cfg.mo_combined_norm:
                     adv_norm = (adv_norm * w).sum(-1, keepdims=True)
+                probe("train/compute_targets",
+                      {"advantages": adv_norm, "returns": returns})
                 return flatten_rows(adv_norm), flatten_rows(returns)
 
         accum = max(1, cfg.grad_accum_steps)
@@ -335,6 +337,8 @@ class MATTrainer:
             (grads, aux), _ = jax.lax.scan(chunk_step, zero, chunks)
 
             gnorm = optax.global_norm(grads)
+            probe("train/ppo_update",
+                  {"grad_norm": gnorm, "value_loss": aux[0], "policy_loss": aux[1]})
             updates, opt_state = self.tx.update(grads, opt_state, params)
             params = optax.apply_updates(params, updates)
             pnorm = optax.global_norm(params)

@@ -88,6 +88,89 @@ def build_mat_policy(run: RunConfig, env: DCMLEnv) -> TransformerPolicy:
     return TransformerPolicy(cfg)
 
 
+def build_dcml_components(run: RunConfig, ppo: PPOConfig, env: DCMLEnv):
+    """Construct ``(policy, trainer, collector, is_mat)`` for a DCML run.
+
+    Shared by :class:`DCMLRunner` and ``scripts/replay_bundle.py`` — the
+    replay path must rebuild the exact same jittable functions from a bundle
+    manifest without triggering the runner's finalize side effects (writers,
+    telemetry, checkpoint restore).
+    """
+    if run.algorithm_name not in SUPPORTED_DCML_ALGOS:
+        raise NotImplementedError(
+            f"algorithm_name={run.algorithm_name!r}; supported on DCML: "
+            f"{SUPPORTED_DCML_ALGOS}"
+        )
+    algo = run.algorithm_name
+
+    if algo == "random":
+        # uniform-random-valid-actions sanity anchor (random_policy.py:79-109)
+        from mat_dcml_tpu.training.random_baseline import RandomPolicy, RandomTrainer
+
+        policy = RandomPolicy(env.n_agents, env.action_dim)
+        trainer = RandomTrainer(policy)
+        collector = RolloutCollector(env, policy, run.episode_length)
+    elif algo in MAT_DCML_ALGOS:
+        policy = build_mat_policy(run, env)
+        trainer = MATTrainer(policy, ppo, total_updates=run.episodes)
+        collector = RolloutCollector(
+            env,
+            policy,
+            run.episode_length,
+            dynamic_coefficients=algo == "dmomat",
+        )
+    else:
+        mcfg_kwargs = ac_config_kwargs(ppo)
+        use_rec = algo in ("rmappo", "rhappo", "rhatrpo")
+        ac = ACConfig(
+            hidden_size=run.n_embd,
+            use_recurrent_policy=use_rec,
+        )
+        if algo == "ppo":
+            # centralized PPO over the joint action (ppo_policy.py +
+            # SingleReplayBuffer): one agent, mixed action space, prod
+            # importance weights (ppo_trainer.py:128)
+            wrapped = JointDCMLEnv(env)
+            policy = ActorCriticPolicy(
+                ac, obs_dim=wrapped.obs_dim, cent_obs_dim=wrapped.share_obs_dim,
+                space=wrapped.action_space,
+            )
+            trainer = MAPPOTrainer(
+                policy, MAPPOConfig(importance_prod=True, **mcfg_kwargs)
+            )
+            collector = ACRolloutCollector(wrapped, policy, run.episode_length)
+        else:
+            wrapped = PerAgentDCMLEnv(env)
+            policy = ActorCriticPolicy(
+                ac,
+                obs_dim=wrapped.obs_dim,
+                cent_obs_dim=wrapped.obs_dim if algo == "ippo" else wrapped.share_obs_dim,
+                space=wrapped.action_space,
+            )
+            if algo in ("mappo", "rmappo"):
+                trainer = MAPPOTrainer(policy, MAPPOConfig(
+                    use_recurrent_policy=algo == "rmappo", **mcfg_kwargs))
+                collector = ACRolloutCollector(wrapped, policy, run.episode_length)
+            elif algo == "ippo":
+                trainer = IPPOTrainer(
+                    policy, MAPPOConfig(**mcfg_kwargs), n_agents=wrapped.n_agents
+                )
+                collector = IPPORolloutCollector(
+                    wrapped, policy, run.episode_length, use_local_value=True
+                )
+            else:  # happo / hatrpo (r* = recurrent chunked variants)
+                trainer_cls = HATRPOTrainer if algo.endswith("hatrpo") else HAPPOTrainer
+                trainer = trainer_cls(
+                    policy,
+                    HAPPOConfig(use_recurrent_policy=use_rec, **mcfg_kwargs),
+                    n_agents=wrapped.n_agents,
+                )
+                collector = HAPPORolloutCollector(wrapped, policy, run.episode_length)
+
+    is_mat = algo in MAT_DCML_ALGOS or algo == "random"
+    return policy, trainer, collector, is_mat
+
+
 class DCMLRunner(BaseRunner):
     """Rollout-train loop with episode metric accounting
     (``dcml_runner.py:22-124``)."""
@@ -100,81 +183,11 @@ class DCMLRunner(BaseRunner):
         data_dir: str = "data",
         log_fn=print,
     ):
-        if run.algorithm_name not in SUPPORTED_DCML_ALGOS:
-            raise NotImplementedError(
-                f"algorithm_name={run.algorithm_name!r}; supported on DCML: "
-                f"{SUPPORTED_DCML_ALGOS}"
-            )
         self.ppo_cfg = ppo
         self.env = env if env is not None else DCMLEnv(DCMLEnvConfig(), data_dir=data_dir)
-        algo = run.algorithm_name
-        # "mat-like" trainers consume the rollout state directly (no Bootstrap)
-        self.is_mat = algo in MAT_DCML_ALGOS or algo == "random"
-
-        if algo == "random":
-            # uniform-random-valid-actions sanity anchor (random_policy.py:79-109)
-            from mat_dcml_tpu.training.random_baseline import RandomPolicy, RandomTrainer
-
-            self.policy = RandomPolicy(self.env.n_agents, self.env.action_dim)
-            self.trainer = RandomTrainer(self.policy)
-            self.collector = RolloutCollector(self.env, self.policy, run.episode_length)
-        elif algo in MAT_DCML_ALGOS:
-            self.policy = build_mat_policy(run, self.env)
-            self.trainer = MATTrainer(self.policy, ppo, total_updates=run.episodes)
-            self.collector = RolloutCollector(
-                self.env,
-                self.policy,
-                run.episode_length,
-                dynamic_coefficients=algo == "dmomat",
-            )
-        else:
-            mcfg_kwargs = ac_config_kwargs(ppo)
-            use_rec = algo in ("rmappo", "rhappo", "rhatrpo")
-            ac = ACConfig(
-                hidden_size=run.n_embd,
-                use_recurrent_policy=use_rec,
-            )
-            if algo == "ppo":
-                # centralized PPO over the joint action (ppo_policy.py +
-                # SingleReplayBuffer): one agent, mixed action space, prod
-                # importance weights (ppo_trainer.py:128)
-                wrapped = JointDCMLEnv(self.env)
-                self.policy = ActorCriticPolicy(
-                    ac, obs_dim=wrapped.obs_dim, cent_obs_dim=wrapped.share_obs_dim,
-                    space=wrapped.action_space,
-                )
-                self.trainer = MAPPOTrainer(
-                    self.policy, MAPPOConfig(importance_prod=True, **mcfg_kwargs)
-                )
-                self.collector = ACRolloutCollector(wrapped, self.policy, run.episode_length)
-            else:
-                wrapped = PerAgentDCMLEnv(self.env)
-                self.policy = ActorCriticPolicy(
-                    ac,
-                    obs_dim=wrapped.obs_dim,
-                    cent_obs_dim=wrapped.obs_dim if algo == "ippo" else wrapped.share_obs_dim,
-                    space=wrapped.action_space,
-                )
-                if algo in ("mappo", "rmappo"):
-                    self.trainer = MAPPOTrainer(self.policy, MAPPOConfig(
-                        use_recurrent_policy=algo == "rmappo", **mcfg_kwargs))
-                    self.collector = ACRolloutCollector(wrapped, self.policy, run.episode_length)
-                elif algo == "ippo":
-                    self.trainer = IPPOTrainer(
-                        self.policy, MAPPOConfig(**mcfg_kwargs), n_agents=wrapped.n_agents
-                    )
-                    self.collector = IPPORolloutCollector(
-                        wrapped, self.policy, run.episode_length, use_local_value=True
-                    )
-                else:  # happo / hatrpo (r* = recurrent chunked variants)
-                    trainer_cls = HATRPOTrainer if algo.endswith("hatrpo") else HAPPOTrainer
-                    self.trainer = trainer_cls(
-                        self.policy,
-                        HAPPOConfig(use_recurrent_policy=use_rec, **mcfg_kwargs),
-                        n_agents=wrapped.n_agents,
-                    )
-                    self.collector = HAPPORolloutCollector(wrapped, self.policy, run.episode_length)
-
+        self.policy, self.trainer, self.collector, self.is_mat = (
+            build_dcml_components(run, ppo, self.env)
+        )
         apply_seq_shards(run, self.policy)
         self.finalize(run, log_fn)
 

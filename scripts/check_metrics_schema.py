@@ -38,7 +38,10 @@ KNOWN_FIELDS = {
     # telemetry counters / rates (telemetry/registry.py flush)
     "env_steps", "agent_steps", "env_steps_per_sec", "agent_steps_per_sec",
     "compile_count", "compile_seconds_total", "steady_state_recompiles",
-    "nonfinite_grad_steps",
+    "nonfinite_grad_steps", "deferred_fetch_errors",
+    # anomaly tripwires + flight recorder (telemetry/anomaly.py,
+    # telemetry/flight_recorder.py)
+    "anomalies_total", "flight_snapshots", "flight_bundles",
     # fused multi-episode dispatch (--iters_per_dispatch K > 1,
     # base_runner._train_loop_fused): core metric fields become means over
     # the stacked (K,) per-iteration values; these ride along
@@ -60,13 +63,16 @@ KNOWN_PREFIXES = (
     "eval_",
     "compile_count_",
     "step_time_",
+    "anomalies_",           # per-kind trip counters (anomalies_<kind>)
 )
 
 # fields that must never go negative (counters, rates, timers, gauges)
 NON_NEGATIVE = (
     "env_steps", "agent_steps", "env_steps_per_sec", "agent_steps_per_sec",
     "compile_count", "compile_seconds_total", "steady_state_recompiles",
-    "nonfinite_grad_steps", "device_bytes_in_use", "device_peak_bytes",
+    "nonfinite_grad_steps", "deferred_fetch_errors",
+    "anomalies_total", "flight_snapshots", "flight_bundles",
+    "device_bytes_in_use", "device_peak_bytes",
     "host_rss_bytes", "flops_per_step", "fps",
     "iters_per_dispatch", "dispatch_count", "dispatches_per_sec",
     "profile_dispatch_sec",
@@ -105,12 +111,58 @@ def _known(name: str) -> bool:
     return any(base.startswith(p) for p in KNOWN_PREFIXES)
 
 
+# anomaly records (telemetry/anomaly.py Anomaly.to_record) are the one
+# sanctioned exception to the numbers-only rule: kind/signal are strings,
+# nonfinite values encode as "nan"/"inf"/"-inf" strings (strict JSON has no
+# NaN literal), and baseline is null before warmup.
+ANOMALY_FIELDS = ("anomaly", "signal", "value", "baseline", "episode",
+                  "total_steps")
+_NONFINITE_STRINGS = ("nan", "inf", "-inf")
+
+
+def _validate_anomaly(record, where: str) -> List[str]:
+    errs: List[str] = []
+    for k in ANOMALY_FIELDS:
+        if k not in record:
+            errs.append(f"{where}: anomaly record missing {k!r}")
+    for k in ("anomaly", "signal"):
+        if k in record and not isinstance(record[k], str):
+            errs.append(f"{where}: anomaly field {k!r} must be a string")
+    for k in ("value", "baseline"):
+        v = record.get(k)
+        if v is None or isinstance(v, bool):
+            if isinstance(v, bool):
+                errs.append(f"{where}: anomaly field {k!r} is a boolean")
+            continue  # null baseline = tripped before warmup
+        if isinstance(v, str):
+            if v not in _NONFINITE_STRINGS:
+                errs.append(f"{where}: anomaly field {k!r} string must be one "
+                            f"of {_NONFINITE_STRINGS}, got {v!r}")
+        elif not isinstance(v, (int, float)):
+            errs.append(f"{where}: anomaly field {k!r} is {type(v).__name__}")
+        elif not math.isfinite(v):
+            errs.append(f"{where}: anomaly field {k!r} must encode nonfinite "
+                        f"values as strings, got {v}")
+    for k in ("episode", "total_steps"):
+        v = record.get(k)
+        if v is not None and (isinstance(v, bool) or not isinstance(v, int) or v < 0):
+            errs.append(f"{where}: anomaly field {k!r} must be a non-negative "
+                        f"integer")
+    for k in record:
+        if k not in ANOMALY_FIELDS:
+            errs.append(f"{where}: unexpected field {k!r} in anomaly record")
+    return errs
+
+
 def validate_record(record, index: int = 0, strict_names: bool = True) -> List[str]:
     """Errors for one parsed jsonl record (empty list = valid)."""
     errs: List[str] = []
     where = f"record {index}"
     if not isinstance(record, dict):
         return [f"{where}: not a JSON object"]
+    if "anomaly" in record:
+        # typed tripwire record — its own schema, BEFORE the numbers-only rule
+        return _validate_anomaly(record, where)
     for k, v in record.items():
         if isinstance(v, bool):
             errs.append(f"{where}: field {k!r} is a boolean (flags must not "

@@ -1,0 +1,267 @@
+#!/usr/bin/env python
+"""Re-execute a flight-recorder repro bundle and bisect nonfinite values.
+
+A bundle (``telemetry/flight_recorder.py``) is a self-contained capture of
+one dispatch's inputs — params, optimizer state, rollout carry, RNG key chain
+position, configs, env — written when an anomaly tripwire fired.  This script
+rebuilds the exact jittable program from the manifest and:
+
+1. **replays** the captured dispatch(es) from the snapshot episode through
+   the target episode, deterministically, and compares the final train
+   metrics bit-exactly against ``reference.pkl`` (the values fetched at
+   detection time);
+2. **bisects** (``--bisect``, or automatically when the replay reproduces a
+   nonfinite value): re-runs the offending iteration under
+   ``jax.disable_jit()`` with a :class:`~mat_dcml_tpu.telemetry.scopes.ProbeSink`
+   installed, where the ``probe()`` sites at every named scope fire eagerly
+   and in program order — the first recorded NaN/Inf names the first
+   offending scope (``mat/encoder``, ``ops/gae``, ``train/ppo_update``, ...).
+
+Usage:
+    JAX_PLATFORMS=cpu python scripts/replay_bundle.py artifacts/bundle_ep3_nonfinite_grads [--bisect] [--data_dir data]
+
+Exit 0: replay matched the reference (bit-exact).  Exit 1: mismatch.
+Exit 2: usage / unloadable bundle.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+import dataclasses
+
+import numpy as np
+
+
+def _config_from_dict(cls, d):
+    """Rebuild a (frozen) config dataclass from a manifest dict, tolerating
+    schema drift: unknown keys are dropped, missing keys take defaults."""
+    names = {f.name for f in dataclasses.fields(cls)}
+    return cls(**{k: v for k, v in d.items() if k in names})
+
+
+def load(bundle_dir: str, data_dir: str):
+    """Bundle -> (bundle, run, ppo, env, components)."""
+    from mat_dcml_tpu.config import RunConfig
+    from mat_dcml_tpu.envs.dcml import DCMLEnv, DCMLEnvConfig
+    from mat_dcml_tpu.telemetry.flight_recorder import load_bundle
+    from mat_dcml_tpu.training.ppo import PPOConfig
+    from mat_dcml_tpu.training.runner import build_dcml_components
+
+    bundle = load_bundle(bundle_dir)
+    m = bundle.manifest
+    if m.get("run_config") is None:
+        raise ValueError(f"{bundle_dir}: manifest has no run_config")
+    run = _config_from_dict(RunConfig, m["run_config"])
+    ppo = _config_from_dict(PPOConfig, m["ppo_config"] or {})
+    env = bundle.env
+    if env is None:
+        print(f"[replay] no env.pkl in bundle; rebuilding DCMLEnv from "
+              f"--data_dir {data_dir}")
+        env = DCMLEnv(DCMLEnvConfig(), data_dir=data_dir)
+    policy, trainer, collector, is_mat = build_dcml_components(run, ppo, env)
+    return bundle, run, ppo, env, (policy, trainer, collector, is_mat)
+
+
+def _unpack_state(bundle):
+    from mat_dcml_tpu.telemetry.flight_recorder import unpack_tree
+
+    st = bundle.state
+    return (unpack_tree(st["train_state"]), unpack_tree(st["rollout_state"]),
+            unpack_tree(st["key"]))
+
+
+def replay(bundle, components):
+    """Re-execute snapshot..target with the SAME program structure as the
+    training loop (bit-exactness demands it: K=1 uses two separately jitted
+    collect/train calls with the host-side key split between them; K>1 jits
+    the fused ``make_dispatch_fn`` scan with the same ``donate_argnums`` as
+    the training loop, so the replay exercises the very same executable.
+    Donation is safe here: the loop never reuses its inputs, and
+    :func:`bisect` re-unpacks fresh state from the bundle.  Returns
+    host-numpy metric dicts."""
+    import jax
+
+    from mat_dcml_tpu.training.base_runner import bootstrap_input, make_dispatch_fn
+
+    policy, trainer, collector, is_mat = components
+    m = bundle.manifest
+    K = int(m.get("iters_per_dispatch") or 1)
+    snap_ep = int(m["snapshot_episode"])
+    target_ep = int(m["target_episode"])
+    train_state, rollout_state, key = _unpack_state(bundle)
+
+    out = {}
+    if K == 1:
+        collect_j = jax.jit(collector.collect)
+        train_j = jax.jit(trainer.train)
+        metrics = None
+        for ep in range(snap_ep, target_ep + 1):
+            rollout_state, traj = collect_j(train_state.params, rollout_state)
+            key, k_train = jax.random.split(key)
+            train_state, metrics = train_j(
+                train_state, traj, bootstrap_input(is_mat, collector, rollout_state),
+                k_train,
+            )
+        stats = getattr(traj, "chunk_stats", None)
+    else:
+        dispatch_j = jax.jit(
+            make_dispatch_fn(trainer, collector, K), donate_argnums=(0, 1)
+        )
+        n_disp = (target_ep - snap_ep) // K + 1
+        metrics = stats = None
+        for _ in range(n_disp):
+            train_state, rollout_state, key, (metrics, stats) = dispatch_j(
+                train_state, rollout_state, key
+            )
+    if metrics is not None and hasattr(metrics, "_fields"):
+        fetched = jax.device_get(tuple(metrics))
+        out["metrics"] = {f: np.asarray(v)
+                          for f, v in zip(metrics._fields, fetched)}
+    if K > 1 and stats is not None:
+        out["stats"] = {k: np.asarray(v)
+                        for k, v in jax.device_get(stats).items()}
+    return out
+
+
+def compare(replayed, reference):
+    """Bit-exact comparison (``array_equal(equal_nan=True)``) per field.
+    Returns (ok, lines)."""
+    lines = []
+    ok = True
+    if reference is None:
+        return False, ["no reference.pkl in bundle; nothing to compare against"]
+    for section, ref_fields in reference.items():
+        rep_fields = replayed.get(section, {})
+        for name, ref_v in ref_fields.items():
+            if name not in rep_fields:
+                ok = False
+                lines.append(f"  {section}.{name}: MISSING from replay")
+                continue
+            rep_v = np.asarray(rep_fields[name])
+            ref_v = np.asarray(ref_v)
+            if rep_v.shape == ref_v.shape and np.array_equal(
+                rep_v, ref_v, equal_nan=True
+            ):
+                lines.append(f"  {section}.{name}: bit-exact")
+            else:
+                ok = False
+                lines.append(
+                    f"  {section}.{name}: MISMATCH "
+                    f"(replay={np.ravel(rep_v)[:4]} ref={np.ravel(ref_v)[:4]})"
+                )
+    return ok, lines
+
+
+def _has_nonfinite(replayed) -> bool:
+    for fields in replayed.values():
+        for v in fields.values():
+            arr = np.asarray(v)
+            if arr.dtype.kind == "f" and not np.isfinite(arr).all():
+                return True
+    return False
+
+
+def bisect(bundle, components):
+    """Re-run from the snapshot under ``jax.disable_jit()`` with a probe sink
+    installed; stop at the first iteration that records a nonfinite probe.
+    Returns ``(scope_name, iteration)`` or ``None`` if nothing nonfinite
+    fires."""
+    import jax
+
+    from mat_dcml_tpu.telemetry.scopes import ProbeSink, set_probe_sink
+    from mat_dcml_tpu.training.base_runner import bootstrap_input
+
+    policy, trainer, collector, is_mat = components
+    m = bundle.manifest
+    K = int(m.get("iters_per_dispatch") or 1)
+    snap_ep = int(m["snapshot_episode"])
+    target_ep = int(m["target_episode"])
+    train_state, rollout_state, key = _unpack_state(bundle)
+    n_iters = (target_ep - snap_ep) + K if K > 1 else (target_ep - snap_ep + 1)
+
+    sink = ProbeSink()
+    prev = set_probe_sink(sink)
+    try:
+        with jax.disable_jit():
+            for i in range(n_iters):
+                ep = snap_ep + i
+                sink.mark(f"(iteration ep{ep} start)")
+                if K == 1:
+                    rollout_state, traj = collector.collect(
+                        train_state.params, rollout_state
+                    )
+                    key, k_train = jax.random.split(key)
+                    train_state, _ = trainer.train(
+                        train_state, traj,
+                        bootstrap_input(is_mat, collector, rollout_state), k_train,
+                    )
+                else:
+                    # eager mirror of the fused scan body (make_dispatch_fn):
+                    # one key split + one train_iteration per scanned step
+                    key, k_train = jax.random.split(key)
+                    train_state, rollout_state, _, _ = trainer.train_iteration(
+                        collector, train_state, rollout_state, k_train
+                    )
+                hit = sink.first_nonfinite()
+                if hit is not None:
+                    name, arr = hit
+                    bad = np.asarray(arr)
+                    n_bad = int(np.size(bad) - np.isfinite(bad).sum())
+                    return name, ep, n_bad
+                sink.events.clear()
+    finally:
+        set_probe_sink(prev)
+    return None
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    p.add_argument("bundle", help="bundle directory (manifest.json + state.pkl)")
+    p.add_argument("--bisect", action="store_true",
+                   help="always run the named-scope bisection, even when the "
+                        "replay reproduces no nonfinite value")
+    p.add_argument("--data_dir", default="data",
+                   help="DCML workload dir, used only when env.pkl is absent")
+    args = p.parse_args(argv)
+
+    try:
+        bundle, run, ppo, env, components = load(args.bundle, args.data_dir)
+    except Exception as e:
+        print(f"cannot load bundle {args.bundle}: {e}", file=sys.stderr)
+        return 2
+
+    m = bundle.manifest
+    anomaly = (m.get("anomaly") or {})
+    print(f"[replay] bundle {bundle.path.name}: algo={m.get('algorithm_name')} "
+          f"K={m.get('iters_per_dispatch')} episodes "
+          f"{m['snapshot_episode']}..{m['target_episode']} "
+          f"anomaly={anomaly.get('anomaly')}({anomaly.get('signal')}) "
+          f"git={str(m.get('git_hash'))[:12]}")
+
+    replayed = replay(bundle, components)
+    ok, lines = compare(replayed, bundle.reference)
+    print("[replay] reference comparison:")
+    for line in lines:
+        print(line)
+    print(f"[replay] {'REPRODUCED bit-exactly' if ok else 'DID NOT reproduce'}")
+
+    if args.bisect or _has_nonfinite(replayed):
+        print("[bisect] re-running eagerly with probe sink "
+              "(jax.disable_jit) ...")
+        hit = bisect(bundle, components)
+        if hit is None:
+            print("[bisect] no probe recorded a nonfinite value")
+        else:
+            name, ep, n_bad = hit
+            print(f"[bisect] first nonfinite scope: {name} "
+                  f"(episode {ep}, {n_bad} nonfinite elements)")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

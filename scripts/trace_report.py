@@ -10,6 +10,11 @@ line, the top ops by summed duration.  Run on the artifacts captured by
     PROTOCOL_BUFFERS_PYTHON_IMPLEMENTATION=python \
       python scripts/trace_report.py artifacts/r3/trace_e256 [top_n]
 
+``diff`` mode compares two previously-written op_summary.json files (or the
+directories holding them) with per-scope time deltas — baseline first:
+
+    python scripts/trace_report.py diff artifacts/base artifacts/anomaly_ep40
+
 Writes <dir>/op_summary.json and prints top-N tables for the device lines,
 plus a per-scope rollup: ops carry their ``jax.named_scope`` path in the
 display name (``jit(train)/train/ppo_update/...``), so op time groups by the
@@ -51,7 +56,52 @@ def find_xspace(root: str) -> str:
     return hits[-1]
 
 
+def _load_scopes(path: str) -> dict:
+    """``op_summary.json`` (or a dir containing one) -> {scope: row}."""
+    if os.path.isdir(path):
+        path = os.path.join(path, "op_summary.json")
+    with open(path) as f:
+        summary = json.load(f)
+    rows = summary.get("scopes") or []
+    if not rows:
+        raise SystemExit(f"{path}: no 'scopes' section — regenerate with "
+                         f"scripts/trace_report.py <trace_dir>")
+    return {r["scope"]: r for r in rows}
+
+
+def diff_main(argv):
+    """``diff`` mode: per-scope time deltas between two op_summary.json files
+    (baseline first) — the A/B companion to the single-trace report, e.g. an
+    anomaly-window capture vs the scheduled steady-state trace.
+
+        python scripts/trace_report.py diff artifacts/base artifacts/anomaly_ep40
+    """
+    if len(argv) != 2:
+        raise SystemExit("usage: trace_report.py diff <baseline_summary> <candidate_summary>")
+    base = _load_scopes(argv[0])
+    cand = _load_scopes(argv[1])
+    names = sorted(set(base) | set(cand),
+                   key=lambda n: -(cand.get(n, {}).get("total_ms", 0.0)
+                                   - base.get(n, {}).get("total_ms", 0.0)))
+    base_total = sum(r["total_ms"] for r in base.values())
+    cand_total = sum(r["total_ms"] for r in cand.values())
+    print(f"== scope diff  (baseline busy {base_total:.1f} ms -> "
+          f"candidate {cand_total:.1f} ms, "
+          f"{'+' if cand_total >= base_total else ''}{cand_total - base_total:.1f} ms)")
+    print(f"{'scope':48s} {'base-ms':>10s} {'cand-ms':>10s} {'delta-ms':>10s} {'ratio':>7s}")
+    for n in names:
+        b = base.get(n, {}).get("total_ms", 0.0)
+        c = cand.get(n, {}).get("total_ms", 0.0)
+        ratio = f"{c / b:.2f}x" if b else "new"
+        marker = "" if n in base else "  (only in candidate)"
+        if n not in cand:
+            marker = "  (only in baseline)"
+        print(f"{n[:48]:48s} {b:>10.2f} {c:>10.2f} {c - b:>+10.2f} {ratio:>7s}{marker}")
+
+
 def main():
+    if len(sys.argv) > 1 and sys.argv[1] == "diff":
+        return diff_main(sys.argv[2:])
     root = sys.argv[1] if len(sys.argv) > 1 else "."
     top_n = int(sys.argv[2]) if len(sys.argv) > 2 else 25
     xspace_path = find_xspace(root)

@@ -1,0 +1,308 @@
+"""Anomaly tripwires, flight recorder, and bundle replay.
+
+Unit layer: the EMA detector's warmup/spike/cooldown state machine, typed-key
+pack/unpack round-trips, the snapshot ring + bundle dump, the bounded
+profiler window, and the probe sink.
+
+End-to-end layer (the acceptance scenario): a tiny CPU DCML run with a
+poisoned encoder head trips ``nonfinite_grads``, writes a repro bundle whose
+replay (``scripts/replay_bundle.py``) reproduces the offending dispatch
+bit-exactly and whose bisection names the first nonfinite named scope
+(``mat/encoder``) — and the anomaly records it emitted pass the schema
+validator's dedicated branch.
+"""
+
+import importlib.util
+import json
+import pickle
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mat_dcml_tpu.config import RunConfig
+from mat_dcml_tpu.envs.dcml import DCMLEnv, DCMLEnvConfig
+from mat_dcml_tpu.envs.dcml.env import DCMLConsts
+from mat_dcml_tpu.telemetry import Telemetry
+from mat_dcml_tpu.telemetry.anomaly import (
+    Anomaly,
+    AnomalyConfig,
+    AnomalyDetector,
+    ProfilerWindow,
+)
+from mat_dcml_tpu.telemetry.flight_recorder import (
+    FlightRecorder,
+    PRNGKeyLeaf,
+    load_bundle,
+    pack_tree,
+    unpack_tree,
+)
+from mat_dcml_tpu.telemetry.scopes import ProbeSink, probe, set_probe_sink
+from mat_dcml_tpu.training.ppo import PPOConfig
+from mat_dcml_tpu.training.runner import DCMLRunner
+
+
+def _load_script(name):
+    path = Path(__file__).resolve().parent.parent / "scripts" / f"{name}.py"
+    spec = importlib.util.spec_from_file_location(name, path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+check_metrics_schema = _load_script("check_metrics_schema")
+
+
+# ---------------------------------------------------------------- detector
+
+def test_detector_spike_after_warmup_with_cooldown():
+    det = AnomalyDetector(AnomalyConfig(warmup=3, cooldown=2, spike_factor=4.0))
+    for i in range(3):
+        assert det.observe({"grad_norm": 1.0}, episode=i, total_steps=i) == []
+    trips = det.observe({"grad_norm": 10.0}, episode=3, total_steps=3)
+    assert [t.kind for t in trips] == ["grad_norm_spike"]
+    assert trips[0].signal == "grad_norm"
+    assert trips[0].value == 10.0
+    assert trips[0].baseline == pytest.approx(1.0)
+    # cooldown suppresses the immediate repeat
+    assert det.observe({"grad_norm": 10.0}, episode=4, total_steps=4) == []
+    # the tripped value was NOT absorbed into the baseline: after cooldown the
+    # same spike trips again against the ~1.0 baseline
+    assert det.observe({"grad_norm": 1.0}, episode=5, total_steps=5) == []
+    trips = det.observe({"grad_norm": 10.0}, episode=6, total_steps=6)
+    assert [t.kind for t in trips] == ["grad_norm_spike"]
+    assert trips[0].baseline == pytest.approx(1.0, rel=0.2)
+
+
+def test_detector_nonfinite_and_recompile_trip_immediately():
+    tel = Telemetry()
+    det = AnomalyDetector(AnomalyConfig(warmup=100), telemetry=tel)
+    trips = det.observe(
+        {"nonfinite_grads": 2.0, "value_loss": float("nan")},
+        episode=0, total_steps=16,
+    )
+    kinds = sorted(t.kind for t in trips)
+    assert kinds == ["nonfinite_grads", "nonfinite_value"]
+    assert tel.counters["anomalies_total"] == 2
+    assert tel.counters["anomalies_nonfinite_grads"] == 1
+    # the nan encodes as a string in the jsonl record (strict JSON)
+    rec = [t for t in trips if t.kind == "nonfinite_value"][0].to_record()
+    assert rec["value"] == "nan"
+    assert check_metrics_schema.validate_record(rec) == []
+
+    trips = det.observe({"steady_state_recompiles": 1.0}, episode=1, total_steps=32)
+    assert [t.kind for t in trips] == ["steady_state_recompile"]
+    # same counter value again: no new trip
+    assert det.observe({"steady_state_recompiles": 1.0}, episode=30,
+                       total_steps=60) == []
+
+
+def test_detector_time_regression():
+    det = AnomalyDetector(AnomalyConfig(warmup=2, time_factor=2.0, cooldown=1))
+    for i in range(2):
+        det.observe({"step_time_dispatch": 0.1}, episode=i, total_steps=i)
+    trips = det.observe({"step_time_dispatch": 0.5}, episode=2, total_steps=2)
+    assert [t.kind for t in trips] == ["step_time_dispatch_spike"]
+
+
+# ------------------------------------------------------------- pack/unpack
+
+def test_pack_unpack_roundtrip_with_typed_keys():
+    tree = {
+        "key": jax.random.key(42),
+        "nested": {"w": jnp.arange(6, dtype=jnp.float32).reshape(2, 3),
+                   "step": jnp.int32(7)},
+        "scalar": 1.5,
+        "none": None,
+    }
+    packed = pack_tree(tree)
+    assert isinstance(packed["key"], PRNGKeyLeaf)
+    # the packed tree must survive pickling (that's what bundles do)
+    packed = pickle.loads(pickle.dumps(packed))
+    restored = unpack_tree(packed)
+    np.testing.assert_array_equal(
+        np.asarray(jax.random.key_data(restored["key"])),
+        np.asarray(jax.random.key_data(tree["key"])),
+    )
+    # and the restored key is a USABLE typed key: same splits
+    np.testing.assert_array_equal(
+        np.asarray(jax.random.key_data(jax.random.split(restored["key"]))),
+        np.asarray(jax.random.key_data(jax.random.split(tree["key"]))),
+    )
+    np.testing.assert_array_equal(np.asarray(restored["nested"]["w"]),
+                                  np.asarray(tree["nested"]["w"]))
+    assert int(restored["nested"]["step"]) == 7
+
+
+# ---------------------------------------------------------- flight recorder
+
+def _anomaly(kind="nonfinite_grads"):
+    return Anomaly(kind, kind, float("nan"), None, 1, 64)
+
+
+def test_flight_recorder_ring_dump_and_dedup(tmp_path):
+    tel = Telemetry()
+    run = RunConfig(n_rollout_threads=2, episode_length=4)
+    fr = FlightRecorder(depth=2, interval=1, directory=tmp_path,
+                        run_config=run, ppo_config=PPOConfig(),
+                        env=None, telemetry=tel, log=lambda s: None)
+    ts = {"params": jnp.ones((3,))}
+    for ep in range(3):
+        assert fr.snapshot(ep, ts, {"obs": jnp.zeros((2,))}, jax.random.key(ep))
+    assert tel.counters["flight_snapshots"] == 3
+    # depth=2 ring: episodes 0 fell off; dump targeting ep 1 picks snapshot 1
+    out = fr.dump(_anomaly(), target_episode=1)
+    assert out is not None
+    b = load_bundle(out)
+    assert b.manifest["snapshot_episode"] == 1
+    assert b.manifest["target_episode"] == 1
+    assert b.manifest["run_config"]["episode_length"] == 4
+    assert b.manifest["anomaly"]["anomaly"] == "nonfinite_grads"
+    assert check_metrics_schema.validate_record(b.manifest["anomaly"]) == []
+    restored = unpack_tree(b.state["train_state"])
+    np.testing.assert_array_equal(np.asarray(restored["params"]), np.ones((3,)))
+    # same kind again: deduped; a different kind dumps a second bundle
+    assert fr.dump(_anomaly(), target_episode=2) is None
+    assert fr.dump(_anomaly("grad_norm_spike"), target_episode=2) is not None
+    assert tel.counters["flight_bundles"] == 2
+
+
+def test_flight_recorder_disabled_is_free(tmp_path):
+    fr = FlightRecorder(depth=0, interval=1, directory=tmp_path)
+    assert not fr.snapshot(0, {}, {}, jax.random.key(0))
+    assert fr.dump(_anomaly(), target_episode=0) is None
+    assert list(tmp_path.iterdir()) == []
+
+
+# ---------------------------------------------------------- profiler window
+
+def test_profiler_window_bounded_and_single_shot(tmp_path):
+    w = ProfilerWindow(str(tmp_path), n_units=2, log=lambda s: None)
+    assert w.enabled
+    assert w.trigger("ep1_test")
+    assert w.active
+    assert not w.trigger("ep2_other")      # one window at a time
+    w.tick()
+    assert w.active
+    w.tick()                               # countdown exhausted -> stopped
+    assert not w.active
+    assert not w.trigger("ep3_again")      # at most once per run
+    w.close()                              # idempotent
+    assert (tmp_path / "anomaly_ep1_test").exists()
+
+
+def test_profiler_window_disabled():
+    w = ProfilerWindow(None, n_units=4)
+    assert not w.enabled and not w.trigger("x")
+    w0 = ProfilerWindow("somewhere", n_units=0)
+    assert not w0.enabled and not w0.trigger("x")
+
+
+# ----------------------------------------------------------------- probes
+
+def test_probe_sink_records_in_order_and_finds_first_nonfinite():
+    def f(x):
+        probe("scope/a", {"v": x})
+        y = x / 0.0                       # -> inf
+        probe("scope/b", {"v": y})
+        return y
+
+    # no sink installed: probe is a no-op inside jit (and compiles clean)
+    out = jax.jit(f)(jnp.float32(2.0))
+    assert np.isinf(out)
+
+    sink = ProbeSink()
+    prev = set_probe_sink(sink)
+    try:
+        with jax.disable_jit():
+            f(jnp.float32(2.0))
+    finally:
+        set_probe_sink(prev)
+    assert [name for name, _ in sink.events] == ["scope/a", "scope/b"]
+    hit = sink.first_nonfinite()
+    assert hit is not None and hit[0] == "scope/b"
+
+
+# --------------------------------------------------- end-to-end NaN capture
+
+W = 8
+
+
+def _tiny_env():
+    consts = DCMLConsts(worker_number_max=W, sob_dim=W + 2)
+    rng = np.random.default_rng(0)
+    workloads = rng.integers(0, 5, size=(W, consts.local_workload_period)).astype(
+        np.float32)
+    return DCMLEnv(DCMLEnvConfig(consts=consts), base_workloads=workloads)
+
+
+def _poison_encoder_head(params):
+    """Set the encoder value-head input kernel to 3e38: the head matmul
+    overflows to inf inside the ``mat/encoder`` scope while every *captured
+    input* stays finite — the failure only manifests downstream (GAE inf-inf
+    -> NaN losses/grads)."""
+
+    def leaf(path, x):
+        p = jax.tree_util.keystr(path)
+        if "encoder" in p and "head" in p and "kernel" in p and "Dense_0" in p:
+            return jnp.full_like(x, 3e38)
+        return x
+
+    return jax.tree_util.tree_map_with_path(leaf, params)
+
+
+def test_nan_trip_writes_bundle_replay_reproduces_and_bisects(tmp_path):
+    env = _tiny_env()
+    run = RunConfig(
+        algorithm_name="mat", n_rollout_threads=2, episode_length=8,
+        num_env_steps=4 * 8 * 2, log_interval=1, save_interval=0,
+        n_block=1, n_embd=16, n_head=1, iters_per_dispatch=2,
+        run_dir=str(tmp_path / "runs"), anomaly_dir=str(tmp_path / "artifacts"),
+        flight_recorder_depth=2, flight_recorder_interval=1,
+    )
+    r = DCMLRunner(run, PPOConfig(ppo_epoch=2, num_mini_batch=2),
+                   env=env, log_fn=lambda s: None)
+    train_state, rollout_state = r.setup()
+    train_state = train_state._replace(
+        params=_poison_encoder_head(train_state.params))
+    r.train_loop(train_state=train_state, rollout_state=rollout_state)
+    r.writer.close()
+
+    # the tripwire fired and emitted a schema-valid typed record
+    recs = [json.loads(l) for l in open(r.metrics_path)]
+    anomalies = [rec for rec in recs if "anomaly" in rec]
+    assert any(rec["anomaly"] == "nonfinite_grads" for rec in anomalies)
+    for rec in anomalies:
+        errs = check_metrics_schema.validate_record(rec)
+        assert errs == [], errs
+    assert r.telemetry.counters["anomalies_total"] >= 1
+    assert r.telemetry.counters["flight_bundles"] >= 1
+
+    # the repro bundle is self-contained: state + manifest + env + reference
+    bundles = sorted((tmp_path / "artifacts").glob("bundle_ep*_nonfinite_grads"))
+    assert len(bundles) == 1
+    bundle = bundles[0]
+    for f in ("manifest.json", "state.pkl", "reference.pkl", "env.pkl"):
+        assert (bundle / f).exists(), f
+    manifest = json.loads((bundle / "manifest.json").read_text())
+    assert manifest["algorithm_name"] == "mat"
+    assert manifest["iters_per_dispatch"] == 2
+    assert manifest["snapshot_episode"] <= manifest["target_episode"]
+
+    # replay reproduces the captured dispatch bit-exactly from the bundle
+    # alone, and the bisection names the poisoned scope
+    replay_bundle = _load_script("replay_bundle")
+    b, run2, ppo2, env2, components = replay_bundle.load(str(bundle), "data")
+    assert env2 is not None                   # from env.pkl, not rebuilt
+    replayed = replay_bundle.replay(b, components)
+    ok, lines = replay_bundle.compare(replayed, b.reference)
+    assert ok, "\n".join(lines)
+    assert replay_bundle._has_nonfinite(replayed)
+    hit = replay_bundle.bisect(b, components)
+    assert hit is not None
+    scope, episode, n_bad = hit
+    assert scope == "mat/encoder"
+    assert episode == manifest["snapshot_episode"]
+    assert n_bad >= 1

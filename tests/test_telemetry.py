@@ -298,3 +298,60 @@ def test_nan_guard_counts_bad_gradients(small_runner):
     assert float(dirty.nonfinite_grads) == 2 * 2   # ppo_epoch * num_mini_batch
     # same signature as the smoke run: the NaN injection must NOT recompile
     assert r._train.compile_count == 1
+
+
+# ------------------------------------------------ deferred fetch error paths
+
+def test_deferred_fetch_resolves_healthy_tree():
+    from mat_dcml_tpu.telemetry.async_fetch import DeferredFetch
+
+    tree = {"a": jnp.arange(3.0), "b": (jnp.ones(()), 2.0)}
+    out = DeferredFetch(tree).get()
+    assert np.array_equal(out["a"], np.arange(3.0))
+    assert out["b"] == (1.0, 2.0)
+
+
+def test_deferred_fetch_defers_start_errors_to_get():
+    """The launch site must stay non-blocking: constructing a DeferredFetch
+    over dead (donated/deleted) buffers cannot raise — the error surfaces at
+    ``get()``, where the runner's fallback path handles it."""
+    from mat_dcml_tpu.telemetry.async_fetch import DeferredFetch
+
+    x = jnp.arange(4.0) + 1.0
+    x.delete()
+    fetch = DeferredFetch({"x": x})          # must not raise here
+    with pytest.raises(Exception):
+        fetch.get()
+
+
+def test_runner_skips_record_on_fetch_error(tmp_path, monkeypatch):
+    """When every deferred fetch fails, the fused loop must complete anyway,
+    count each failure, and leave NO half-formed training records behind."""
+    from mat_dcml_tpu.telemetry import async_fetch
+
+    def boom(self):
+        raise RuntimeError("fetch exploded")
+
+    monkeypatch.setattr(async_fetch.DeferredFetch, "get", boom)
+
+    consts = DCMLConsts(worker_number_max=W, sob_dim=W + 2)
+    rng = np.random.default_rng(0)
+    workloads = rng.integers(0, 5, size=(W, consts.local_workload_period)).astype(np.float32)
+    env = DCMLEnv(DCMLEnvConfig(consts=consts), base_workloads=workloads)
+    run = RunConfig(
+        algorithm_name="mat", n_rollout_threads=2, episode_length=8,
+        num_env_steps=4 * 8 * 2, log_interval=1, save_interval=0,
+        n_block=1, n_embd=16, n_head=1, iters_per_dispatch=2,
+        run_dir=str(tmp_path),
+    )
+    r = DCMLRunner(run, PPOConfig(ppo_epoch=2, num_mini_batch=2),
+                   env=env, log_fn=lambda s: None)
+    r.train_loop()
+    r.writer.close()
+
+    assert r.telemetry.counters["deferred_fetch_errors"] == 2   # both dispatches
+    recs = [json.loads(l) for l in open(r.metrics_path)] if r.metrics_path.exists() else []
+    assert all("fps" not in rec for rec in recs), recs          # no training records
+    # whatever DID land (if anything) still validates
+    for i, rec in enumerate(recs):
+        assert check_metrics_schema.validate_record(rec, i) == []
