@@ -254,6 +254,34 @@ def _build(jax, E: int, T: int, remat: bool = False, accum: int = 1):
     return collect, train, step, inner, train_state, rollout_state, ppo, policy
 
 
+def _mark_lost(artifact_dir: str, reason: str) -> None:
+    """Leave a ``{"lost": reason}`` marker instead of a bare/empty artifact
+    dir.  A 0-byte or missing trace silently reads as "bench never ran";
+    the marker makes the loss self-describing for whoever collects the run."""
+    try:
+        os.makedirs(artifact_dir, exist_ok=True)
+        with open(os.path.join(artifact_dir, "LOST.json"), "w") as f:
+            json.dump({"lost": reason}, f)
+            f.write("\n")
+        log(f"artifact loss marker written to {artifact_dir}/LOST.json: {reason}")
+    except Exception as e:  # marker is best-effort; never mask the real error
+        log(f"could not write loss marker in {artifact_dir}: {e}")
+
+
+def _has_artifacts(artifact_dir: str) -> bool:
+    """True when the dir holds at least one non-empty, non-marker file."""
+    try:
+        for root, _, files in os.walk(artifact_dir):
+            for name in files:
+                if name == "LOST.json":
+                    continue
+                if os.path.getsize(os.path.join(root, name)) > 0:
+                    return True
+    except OSError:
+        pass
+    return False
+
+
 def _measure(jax, E: int, T: int, iters: int, profile_dir: str | None = None,
              breakdown: bool = False, combined: bool = True,
              remat: bool = False, accum: int = 1) -> dict:
@@ -296,8 +324,16 @@ def _measure(jax, E: int, T: int, iters: int, profile_dir: str | None = None,
         # a crash mid-loop must still terminate the trace, or the partial
         # xplane.pb is unreadable
         if profile_dir:
-            jax.profiler.stop_trace()
-            log(f"profile trace written to {profile_dir}")
+            try:
+                jax.profiler.stop_trace()
+            except Exception as e:
+                _mark_lost(profile_dir, f"profiler stop_trace failed: {e}")
+                raise
+            if _has_artifacts(profile_dir):
+                log(f"profile trace written to {profile_dir}")
+            else:
+                _mark_lost(profile_dir,
+                           "profiler stopped cleanly but produced no trace data")
     elapsed = time.perf_counter() - start
 
     steps = iters * inner * E * T
@@ -331,7 +367,14 @@ def _measure(jax, E: int, T: int, iters: int, profile_dir: str | None = None,
         # not epochs*minibatches) gets overscaled by ~num_mini_batch x, so
         # train flops/bytes are an upper bound by roughly +25% at defaults.
         # Read both rooflines directionally, not as exact MFU.
-        _ppo_trips = ppo.ppo_epoch * ppo.num_mini_batch * max(1, ppo.grad_accum_steps)
+        # effective_accum mirrors the trainer: update_stream_chunks turns on
+        # byte-streaming accumulation even when grad_accum_steps is 1, and the
+        # trip count must follow or the roofline under-scales the inner scan.
+        from mat_dcml_tpu.training.minibatch import effective_accum
+
+        _mb_size = (E * T) // ppo.num_mini_batch
+        _ppo_trips = ppo.ppo_epoch * ppo.num_mini_batch * effective_accum(
+            _mb_size, ppo.grad_accum_steps, ppo.update_stream_chunks)
         # collect's nested decode scan (A positions per env step on the XLA
         # decode path) is invisible to single-level trip scaling — add the
         # analytic correction so the collect roofline is no longer an ~A x
@@ -371,7 +414,34 @@ def _measure(jax, E: int, T: int, iters: int, profile_dir: str | None = None,
             log(f"E={E}: {name} {dt:.3f}s/iter")
             _roofline(jax, result, E, name, compiled, trips, extras)
         _breakdown_mfu(jax, result, E, T)
+        _breakdown_sanity(result, E)
     return result
+
+
+def _breakdown_sanity(result: dict, E: int) -> None:
+    """Drop time-derived breakdown columns when the parts don't add up.
+
+    On the tunneled TPU runtime, re-dispatching an AOT executable with
+    identical args has measured DISPATCH-ONLY time (r5 leg 1: "train
+    0.009s/iter" inside a 5.3s combined iteration) — any roofline ratio or
+    %-of-peak computed from such a phase time is an impossible number.  When
+    collect+train cover less than half the combined iteration, keep the
+    static XLA flop/byte counts (still valid) but suppress every derived
+    column and flag the record instead of printing nonsense percentages."""
+    parts = result.get("collect_sec", 0.0) + result.get("train_sec", 0.0)
+    if "collect_sec" not in result and "train_sec" not in result:
+        return
+    if parts >= 0.5 * result["iter_sec"]:
+        return
+    dropped = [k for k in list(result) if k.endswith(
+        ("_roofline_sec", "_roofline_bound", "_tflops", "_pct_peak"))]
+    for k in dropped:
+        del result[k]
+    result["breakdown_suspect"] = round(parts / result["iter_sec"], 4)
+    log(f"E={E}: WARNING breakdown suspect — collect+train {parts:.3f}s is "
+        f"under half the {result['iter_sec']:.3f}s combined iteration "
+        f"(dispatch-only timing?); suppressed {len(dropped)} roofline/MFU "
+        f"columns")
 
 
 # bf16 peak TFLOP/s per chip by device_kind substring (public spec sheets);
@@ -407,6 +477,8 @@ def _roofline(jax, result: dict, E: int, name: str, compiled, trips: int = 1,
     _, peak, bw = _chip_specs(jax)
     try:
         ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):  # one-per-program list on older jax
+            ca = ca[0] if ca else {}
         flops = float(ca.get("flops", 0.0)) * trips + extras[0]
         byts = float(ca.get("bytes accessed", 0.0)) * trips + extras[1]
     except Exception as e:  # cost analysis is best-effort diagnostics
@@ -637,6 +709,9 @@ def _measure_safe(jax, E: int, T: int, iters: int, **kw) -> dict | None:
                 jax.profiler.stop_trace()
             except Exception:
                 pass
+            if not _has_artifacts(kw["profile_dir"]):
+                _mark_lost(kw["profile_dir"],
+                           f"device OOM at E={E} before trace completed")
         jax.clear_caches()
         gc.collect()
         return None
@@ -898,7 +973,8 @@ def main() -> None:
     record.update({
         k: (round(v, 4) if isinstance(v, float) else v)
         for k, v in res.items()
-        if k.startswith(("collect_", "train_")) or k in ("E", "remat", "accum")
+        if k.startswith(("collect_", "train_"))
+        or k in ("E", "remat", "accum", "breakdown_suspect")
     })
     print(
         json.dumps(record),

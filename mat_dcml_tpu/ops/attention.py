@@ -2,9 +2,11 @@
 
 The reference computes plain QKV attention with an optional causal
 (lower-triangular) mask over agents (``ma_transformer.py:24-69``).  Here the
-math is a single fused function over already-projected q/k/v; on TPU it
-dispatches to the Pallas fused kernel (``ops/pallas_attention.py``), elsewhere
-to the XLA einsum path below (same numerics, unit-tested equal).
+math is a single fused function over already-projected q/k/v dispatched to
+the XLA einsum path below by default; the Pallas fused kernel
+(``ops/pallas_attention.py``) is an env-var opt-in portability artifact
+(same numerics, unit-tested equal — see the dispatch note at
+``_VALID_IMPLS``).
 
 Shapes follow TPU conventions: ``(batch, heads, length, head_dim)``.
 """
@@ -28,14 +30,16 @@ _RING_AXIS_ENV = "MAT_DCML_TPU_ATTN_RING_AXIS"
 # ring; read at trace time by the "ring" dispatch below ("0" = no padding)
 _RING_VALID_ENV = "MAT_DCML_TPU_ATTN_RING_VALID"
 
-# Measured on one v4 chip (bench.py, E=256, T=50, full train loop): XLA 683
-# env-steps/s vs fused kernel 543 (grouped grid) / 318 (per-(b,h) grid).  At
-# n_embd=64 / L=101 the XLA fusion pipeline already keeps the op VMEM-resident,
-# so "auto" stays on XLA; the kernel remains selectable (env var or impl=) and
-# wins only when the score matrix outgrows what XLA will fuse (bigger L).
-_PALLAS_MIN_SEQ = 256
-
-
+# "auto" always resolves to XLA.  Measured twice, both against the kernel:
+# r1 on a v4 chip (bench.py, E=256, T=50, full train loop) XLA 683 env-steps/s
+# vs fused kernel 543 (grouped grid) / 318 (per-(b,h) grid); r5 on the v5-lite
+# driver chip XLA 2409 env-steps/s vs 1654 with the kernel in dispatch, the
+# collect phase regressing ~4x (the kernel re-enters per decode position,
+# where XLA keeps the tiny L=101 score matrix fused and VMEM-resident).  The
+# kernel is a portability artifact like ops/pallas_decode.py: opt in via
+# MAT_DCML_TPU_ATTN_IMPL=pallas (or impl=), parity held by
+# tests/test_pallas_attention.py + tests/test_update_attn_parity.py in
+# interpret mode.  See BENCHLOG.md (pallas-attention close-out).
 _VALID_IMPLS = ("auto", "xla", "pallas", "pallas_interpret", "ring")
 
 # process-local trace-time override installed by parallel/seq_parallel.py's
@@ -72,8 +76,6 @@ def _resolve_impl(impl: str | None, lk: int) -> str:
     if impl not in _VALID_IMPLS:
         raise ValueError(f"attention impl must be one of {_VALID_IMPLS}, got {impl!r}")
     if impl == "auto":
-        if jax.default_backend() == "tpu" and lk >= _PALLAS_MIN_SEQ:
-            return "pallas"
         return "xla"
     return impl
 

@@ -56,3 +56,56 @@ def compute_gae(
         returns = adv + values[:-1]
         probe("ops/gae", {"advantages": adv, "returns": returns})
         return adv, returns
+
+
+def compute_gae_chunked(
+    rewards: jax.Array,
+    values: jax.Array,
+    masks: jax.Array,
+    gamma: float,
+    gae_lambda: float,
+    chunk: int,
+) -> Tuple[jax.Array, jax.Array]:
+    """Bit-exact ``compute_gae`` as a reverse scan over time CHUNKS.
+
+    Identical per-step arithmetic in identical order (the outer reverse scan
+    carries the GAE boundary between chunks, the inner reverse scan runs the
+    same ``step`` over each chunk), so advantages and returns are bitwise
+    equal to the monolithic path (pinned by tests/test_stream_equivalence.py).
+    What changes is the *counted* data motion: the per-step elementwise chain
+    lives in a chunk-shaped scan body that XLA's ``cost_analysis`` counts
+    once, instead of full-(T,...) slice/concat intermediates materializing in
+    the caller's (per-epoch) scope — the streamed-recompute half of the
+    byte-lean update.
+
+    ``chunk`` must divide ``T`` (callers round with
+    ``minibatch.largest_divisor_leq``); ``chunk == T`` degenerates to one
+    outer step.
+    """
+    T = rewards.shape[0]
+    assert T % chunk == 0, f"chunk ({chunk}) must divide T ({T})"
+    n_chunks = T // chunk
+
+    def step(gae, inp):
+        r, v, v_next, m_next = inp
+        delta = r + gamma * v_next * m_next - v
+        gae = delta + gamma * gae_lambda * m_next * gae
+        return gae, gae
+
+    def chunk_step(gae, inp):
+        r_c, v_c, v_next_c, m_next_c = inp
+        gae, adv_c = jax.lax.scan(step, gae, (r_c, v_c, v_next_c, m_next_c), reverse=True)
+        # returns for this chunk while its inputs are still live
+        return gae, (adv_c, adv_c + v_c)
+
+    def split(x):
+        return x.reshape(n_chunks, chunk, *x.shape[1:])
+
+    with named_scope("ops/gae_chunked"):
+        inputs = (split(rewards), split(values[:-1]), split(values[1:]), split(masks[1:]))
+        init = jnp.zeros_like(rewards[0])
+        _, (adv, returns) = jax.lax.scan(chunk_step, init, inputs, reverse=True)
+        adv = adv.reshape(T, *adv.shape[2:])
+        returns = returns.reshape(T, *returns.shape[2:])
+        probe("ops/gae", {"advantages": adv, "returns": returns})
+        return adv, returns

@@ -38,7 +38,7 @@ from typing import Any, Callable, Optional
 import jax
 
 from mat_dcml_tpu.telemetry.registry import Telemetry
-from mat_dcml_tpu.utils.profiling import compiled_flops
+from mat_dcml_tpu.utils.profiling import compiled_bytes, compiled_flops
 
 
 def _abstract_signature(args, kwargs):
@@ -79,6 +79,7 @@ class InstrumentedJit:
         self.compile_count = 0
         self.compile_seconds = 0.0
         self.flops_per_call: Optional[float] = None
+        self.bytes_per_call: Optional[float] = None
 
     def mark_steady(self) -> None:
         """Warmup is over: any compile from now on is unexpected."""
@@ -108,8 +109,33 @@ class InstrumentedJit:
             flops = compiled_flops(compiled)
             if flops is not None:
                 self.flops_per_call = flops
+            nbytes = compiled_bytes(compiled)
+            if nbytes is not None:
+                self.bytes_per_call = nbytes
+            self._maybe_dump_hlo(compiled)
         self._compiled[key] = compiled
         return compiled
+
+    def _maybe_dump_hlo(self, compiled) -> None:
+        """Write the optimized HLO text to ``$MAT_DCML_TPU_HLO_DIR/<name>.hlo.txt``
+        when that env var is set — the input ``scripts/trace_report.py bytes``
+        parses into a bytes-by-scope table.  Best-effort; never breaks a
+        compile."""
+        import os
+
+        out_dir = os.environ.get("MAT_DCML_TPU_HLO_DIR")
+        if not out_dir:
+            return
+        try:
+            os.makedirs(out_dir, exist_ok=True)
+            path = os.path.join(
+                out_dir, f"{self.name}_{self.compile_count}.hlo.txt"
+            )
+            with open(path, "w") as f:
+                f.write(compiled.as_text())
+            self.log(f"[telemetry] dumped optimized HLO to {path}")
+        except Exception:
+            pass
 
     def __call__(self, *args, **kwargs):
         try:

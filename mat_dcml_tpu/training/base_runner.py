@@ -149,6 +149,7 @@ def ac_config_kwargs(ppo: PPOConfig) -> dict:
         entropy_coef=ppo.entropy_coef, value_loss_coef=ppo.value_loss_coef,
         max_grad_norm=ppo.max_grad_norm, gamma=ppo.gamma,
         gae_lambda=ppo.gae_lambda, data_chunk_length=ppo.data_chunk_length,
+        minibatch_layout=ppo.minibatch_layout,
     )
 
 
@@ -721,25 +722,31 @@ class BaseRunner:
     def _mark_steady(self) -> None:
         """First episode (or fused dispatch) done: all warmup compiles
         happened.  Arm the recompile detector and emit ``flops_per_step``
-        (compiler-counted FLOPs per env step) into the next metrics record."""
+        (compiler-counted FLOPs per env step) plus the per-entry-point
+        ``bytes_per_*`` gauges (XLA cost_analysis "bytes accessed" of one
+        jitted call — the statistic tests/test_update_bytes.py budgets) into
+        the next metrics record."""
         if self._dispatch is not None:
-            fns = (self._dispatch,)
+            fns = {"dispatch": self._dispatch}
         else:
-            fns = (self._collect, self._train)
-        jits = [j for j in fns if isinstance(j, InstrumentedJit)]
-        for j in jits:
+            fns = {"collect": self._collect, "update": self._train}
+        jits = {n: j for n, j in fns.items() if isinstance(j, InstrumentedJit)}
+        for j in jits.values():
             j.mark_steady()
         tel = self.telemetry
         n_compiles = int(tel.counters.get("compile_count", 0))
         secs = tel.counters.get("compile_seconds_total", 0.0)
         line = f"[telemetry] warmup done: {n_compiles} compiles in {secs:.1f}s"
-        flops = [j.flops_per_call for j in jits]
+        flops = [j.flops_per_call for j in jits.values()]
         if flops and all(f is not None for f in flops):
             steps = (self.run_cfg.episode_length * self.run_cfg.n_rollout_threads
                      * self._dispatch_iters)
             per_step = sum(flops) / steps
             tel.once("flops_per_step", per_step)
             line += f"; flops/env-step {per_step:.3e}"
+        for name, j in jits.items():
+            if j.bytes_per_call is not None:
+                tel.gauge(f"bytes_per_{name}", float(j.bytes_per_call))
         self.log(line)
 
     def _extra_metrics(self, record: dict) -> None:

@@ -58,6 +58,7 @@ from mat_dcml_tpu.training.mappo import (
     chunk_start_states,
     chunk_windows,
 )
+from mat_dcml_tpu.training.minibatch import permute_rows, slice_rows
 
 
 class HAPPORolloutCollector(IPPORolloutCollector):
@@ -289,9 +290,8 @@ class HAPPOTrainer:
         mb_size = N // cfg.num_mini_batch
         seq = lambda x: jnp.swapaxes(x, 0, 1)         # (mb, L, ...) -> (L, mb, ...)
 
-        def ppo_update(carry, mb_idx):
+        def ppo_update(carry, b):
             params, aopt, copt, vn = carry
-            b = jax.tree.map(lambda x: x[mb_idx], data)
             vn, params, ret_norm = inner._normalize_targets(vn, params, b["returns"])
 
             def loss_fn(p):
@@ -339,8 +339,15 @@ class HAPPOTrainer:
 
         def epoch(carry, key_e):
             perm = jax.random.permutation(key_e, N)
-            mb_idxs = perm[: mb_size * cfg.num_mini_batch].reshape(cfg.num_mini_batch, mb_size)
-            return jax.lax.scan(ppo_update, carry, mb_idxs)
+            keep = mb_size * cfg.num_mini_batch
+            if cfg.minibatch_layout == "contiguous":
+                data_p = permute_rows(data, perm[:keep])
+                step = lambda c, start: ppo_update(c, slice_rows(data_p, start, mb_size))
+                xs = jnp.arange(cfg.num_mini_batch) * mb_size
+            else:
+                step = lambda c, mb_idx: ppo_update(c, jax.tree.map(lambda x: x[mb_idx], data))
+                xs = perm[:keep].reshape(cfg.num_mini_batch, mb_size)
+            return jax.lax.scan(step, carry, xs)
 
         keys = jax.random.split(key, cfg.ppo_epoch)
         (params, aopt, copt, vn), metrics = jax.lax.scan(epoch, (params, aopt, copt, vn), keys)
@@ -419,9 +426,8 @@ class HATRPOTrainer(HAPPOTrainer):
         mb_size = N // cfg.num_mini_batch
         seq = lambda x: jnp.swapaxes(x, 0, 1)
 
-        def trpo_update(carry, mb_idx):
+        def trpo_update(carry, mb):
             params, aopt, copt, vn = carry
-            mb = jax.tree.map(lambda x: x[mb_idx], data)
             vn, params, ret_norm = inner._normalize_targets(vn, params, mb["returns"])
             if use_rec:
                 # eval layout: time-major sequences + chunk-start hiddens
@@ -545,9 +551,16 @@ class HATRPOTrainer(HAPPOTrainer):
             return (params, aopt, copt, vn), metrics
 
         perm = jax.random.permutation(key, N)
-        mb_idxs = perm[: mb_size * cfg.num_mini_batch].reshape(cfg.num_mini_batch, mb_size)
+        keep = mb_size * cfg.num_mini_batch
+        if cfg.minibatch_layout == "contiguous":
+            data_p = permute_rows(data, perm[:keep])
+            step = lambda c, start: trpo_update(c, slice_rows(data_p, start, mb_size))
+            xs = jnp.arange(cfg.num_mini_batch) * mb_size
+        else:
+            step = lambda c, mb_idx: trpo_update(c, jax.tree.map(lambda x: x[mb_idx], data))
+            xs = perm[:keep].reshape(cfg.num_mini_batch, mb_size)
         (params, aopt, copt, vn), metrics = jax.lax.scan(
-            trpo_update, (params, aopt, copt, vn), mb_idxs
+            step, (params, aopt, copt, vn), xs
         )
         return params, aopt, copt, vn, jax.tree.map(lambda m: m.mean(), metrics)._replace(
             nonfinite_grads=metrics.nonfinite_grads.sum()

@@ -47,6 +47,7 @@ from mat_dcml_tpu.ops.popart import (
 )
 from mat_dcml_tpu.telemetry.scopes import named_scope, probe
 from mat_dcml_tpu.training.ac_rollout import ACTrajectory
+from mat_dcml_tpu.training.minibatch import check_layout, permute_rows, slice_rows
 
 
 def chunk_windows(x: jax.Array, L: int, n_batch: int) -> jax.Array:
@@ -96,6 +97,11 @@ class MAPPOConfig:
     importance_prod: bool = False
     use_recurrent_policy: bool = False
     data_chunk_length: int = 10
+    # Minibatch assembly recipe (see ppo.PPOConfig.minibatch_layout): "gather"
+    # (default, per-minibatch gathers) or "contiguous" (one permutation gather
+    # per epoch + dynamic_slice minibatches; byte-identical minibatch content
+    # under the same permutation — tests/test_stream_equivalence.py).
+    minibatch_layout: str = "gather"
 
 
 class Bootstrap(NamedTuple):
@@ -154,6 +160,7 @@ class MAPPOTrainer:
     def __init__(self, policy: ActorCriticPolicy, cfg: MAPPOConfig):
         self.policy = policy
         self.cfg = cfg
+        check_layout(cfg.minibatch_layout)
 
         def make_tx(lr):
             tx = optax.adam(lr, eps=cfg.opti_eps)
@@ -310,9 +317,8 @@ class MAPPOTrainer:
             "returns": returns.reshape(n_rows, -1),
         }
 
-        def ppo_update(carry, mb_idx):
+        def ppo_update(carry, b):
             params, actor_opt, critic_opt, value_norm = carry
-            b = jax.tree.map(lambda x: x[mb_idx], flat)
             value_norm, params, ret_norm = self._normalize_targets(value_norm, params, b["returns"])
 
             def loss_fn(p):
@@ -338,8 +344,15 @@ class MAPPOTrainer:
 
         def epoch(carry, key_e):
             perm = jax.random.permutation(key_e, n_rows)
-            mb_idxs = perm[: mb_size * cfg.num_mini_batch].reshape(cfg.num_mini_batch, mb_size)
-            return jax.lax.scan(ppo_update, carry, mb_idxs)
+            keep = mb_size * cfg.num_mini_batch
+            if cfg.minibatch_layout == "contiguous":
+                data_p = permute_rows(flat, perm[:keep])
+                step = lambda c, start: ppo_update(c, slice_rows(data_p, start, mb_size))
+                xs = jnp.arange(cfg.num_mini_batch) * mb_size
+            else:
+                step = lambda c, mb_idx: ppo_update(c, jax.tree.map(lambda x: x[mb_idx], flat))
+                xs = perm[:keep].reshape(cfg.num_mini_batch, mb_size)
+            return jax.lax.scan(step, carry, xs)
 
         keys = jax.random.split(key, cfg.ppo_epoch)
         carry = (state.params, state.actor_opt, state.critic_opt, state.value_norm)
@@ -381,9 +394,8 @@ class MAPPOTrainer:
             # (mb, L, ...) -> (L, mb, ...)
             return jnp.swapaxes(x, 0, 1)
 
-        def ppo_update(carry, mb_idx):
+        def ppo_update(carry, b):
             params, actor_opt, critic_opt, value_norm = carry
-            b = jax.tree.map(lambda x: x[mb_idx], data)
             value_norm, params, ret_norm = self._normalize_targets(value_norm, params, b["returns"])
 
             def loss_fn(p):
@@ -411,8 +423,15 @@ class MAPPOTrainer:
 
         def epoch(carry, key_e):
             perm = jax.random.permutation(key_e, n_items)
-            mb_idxs = perm[: mb_size * cfg.num_mini_batch].reshape(cfg.num_mini_batch, mb_size)
-            return jax.lax.scan(ppo_update, carry, mb_idxs)
+            keep = mb_size * cfg.num_mini_batch
+            if cfg.minibatch_layout == "contiguous":
+                data_p = permute_rows(data, perm[:keep])
+                step = lambda c, start: ppo_update(c, slice_rows(data_p, start, mb_size))
+                xs = jnp.arange(cfg.num_mini_batch) * mb_size
+            else:
+                step = lambda c, mb_idx: ppo_update(c, jax.tree.map(lambda x: x[mb_idx], data))
+                xs = perm[:keep].reshape(cfg.num_mini_batch, mb_size)
+            return jax.lax.scan(step, carry, xs)
 
         keys = jax.random.split(key, cfg.ppo_epoch)
         carry = (state.params, state.actor_opt, state.critic_opt, state.value_norm)

@@ -1,0 +1,72 @@
+"""Shared minibatch-assembly helpers for the PPO-family trainers.
+
+Two byte-diet knobs live here (Podracer, arXiv:2104.06272 §3: keep the
+learner's working set small and streaming):
+
+- ``largest_divisor_leq``: the auto-chunking rule.  Streaming knobs ask for a
+  *target* chunk count; the effective count is the largest divisor of the
+  row count not above the target, so any shape degrades gracefully to fewer
+  chunks (worst case 1 == the monolithic path) instead of tripping a
+  divisibility assert.
+
+- ``permute_rows`` / ``slice_rows``: the ``minibatch_layout=contiguous``
+  recipe.  One full-permutation gather per epoch up front, then every
+  minibatch is a contiguous ``dynamic_slice`` — byte-identical minibatch
+  CONTENT to the default per-minibatch gather under the same permutation
+  (``permuted[k*mb:(k+1)*mb] == x[perm[k*mb:(k+1)*mb]]``), so the loss
+  trajectory matches bitwise (pinned by tests/test_stream_equivalence.py).
+  The trade is n_gathers for one gather plus a materialized permuted copy:
+  fewer counted gather ops, one full extra batch of peak memory — which is
+  why ``gather`` stays the default (BENCHLOG r4 measured the copy's HBM
+  cost on chip).
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+MINIBATCH_LAYOUTS = ("gather", "contiguous")
+
+
+def largest_divisor_leq(n: int, cap: int) -> int:
+    """Largest divisor of ``n`` that is ``<= cap`` (>= 1).  ``cap <= 0`` -> 1."""
+    if cap <= 0 or n <= 0:
+        return 1
+    for d in range(min(cap, n), 0, -1):
+        if n % d == 0:
+            return d
+    return 1
+
+
+def effective_accum(mb_size: int, grad_accum_steps: int, stream_chunks: int) -> int:
+    """Effective per-minibatch chunk count.
+
+    An explicit ``grad_accum_steps > 1`` wins (its divisibility is asserted by
+    the caller — the user asked for that exact split); otherwise the streaming
+    target ``stream_chunks`` is rounded down to the largest divisor of
+    ``mb_size`` so the split always exists.  0/1 for both -> monolithic.
+    """
+    if grad_accum_steps > 1:
+        return grad_accum_steps
+    return largest_divisor_leq(mb_size, stream_chunks)
+
+
+def check_layout(layout: str) -> str:
+    if layout not in MINIBATCH_LAYOUTS:
+        raise ValueError(
+            f"minibatch_layout={layout!r} not in {MINIBATCH_LAYOUTS}"
+        )
+    return layout
+
+
+def permute_rows(tree, perm):
+    """One full-permutation gather over every leaf's leading row axis."""
+    return jax.tree.map(lambda x: x[perm], tree)
+
+
+def slice_rows(tree, start, size: int):
+    """Contiguous ``dynamic_slice`` of ``size`` rows at (traced) ``start``."""
+    return jax.tree.map(
+        lambda x: jax.lax.dynamic_slice_in_dim(x, start, size, axis=0), tree
+    )

@@ -33,13 +33,20 @@ import optax
 from mat_dcml_tpu.models.policy import TransformerPolicy
 from mat_dcml_tpu.telemetry.scopes import named_scope, probe
 from mat_dcml_tpu.ops.distributions import huber_loss
-from mat_dcml_tpu.ops.gae import compute_gae
+from mat_dcml_tpu.ops.gae import compute_gae, compute_gae_chunked
 from mat_dcml_tpu.ops.normalize import (
     ValueNormState,
     value_norm_denormalize,
     value_norm_init,
     value_norm_normalize,
     value_norm_update,
+)
+from mat_dcml_tpu.training.minibatch import (
+    check_layout,
+    effective_accum,
+    largest_divisor_leq,
+    permute_rows,
+    slice_rows,
 )
 from mat_dcml_tpu.training.rollout import RolloutState, Trajectory
 
@@ -83,6 +90,30 @@ class PPOConfig:
     # to the reference's naive-recurrent generator (full-episode items from
     # the t=0 hidden) — one knob covers both generators.
     data_chunk_length: int = 10
+    # ---- byte-diet knobs (Podracer arXiv:2104.06272: stream the learner's
+    # working set through small donated buffers) ------------------------------
+    # Target number of streamed chunks each PPO minibatch's fwd/bwd runs as
+    # (largest divisor of mb_size <= this; 0/1 = monolithic).  Reuses the
+    # exact gradient-accumulation machinery — chunk losses are normalized by
+    # full-minibatch denominators so summed chunk gradients equal the
+    # unchunked gradient up to float summation order.  The XLA-counted bytes
+    # of one update shrink ~proportionally (the fwd/bwd scan body is counted
+    # once at chunk size); an explicit grad_accum_steps > 1 takes precedence.
+    update_stream_chunks: int = 4
+    # Time-chunk length for the streamed per-epoch target recompute: GAE runs
+    # as a chunked reverse scan (ops/gae.compute_gae_chunked) and the
+    # flattened advantage/return rows are assembled E-major chunk-by-chunk
+    # into carry buffers instead of two full-size transpose copies per epoch.
+    # Bit-exact vs the monolithic path (tests/test_stream_equivalence.py);
+    # rounded to the largest divisor of episode_length; 0 = monolithic.
+    target_stream_chunk: int = 10
+    # Minibatch assembly recipe: "gather" (default; one gather of mb_size
+    # rows per minibatch — exact round-4 behavior) or "contiguous" (permute
+    # all rows once per epoch into a flat buffer, minibatches are contiguous
+    # dynamic_slices; byte-identical minibatch content under the same
+    # permutation, but materializes a full permuted copy — trades counted
+    # gather traffic for peak memory, which is why it is opt-in).
+    minibatch_layout: str = "gather"
     # MO-MAT scalarization weights, comma-separated floats ("99,1" etc.);
     # empty = equal weights.  Reconstruction of the missing ``momat_trainer``
     # around the surviving ``mo_shared_buffer.py`` per-objective GAE.
@@ -203,6 +234,27 @@ class MATTrainer:
         def flatten_rows(x):
             return x.swapaxes(0, 1).reshape(n_rows, *x.shape[2:])
 
+        # Streamed E-major flatten: identical VALUES to flatten_rows (a
+        # transpose is exact), assembled chunk-by-chunk into a scan-carried
+        # buffer XLA donates in place, instead of one full-size transpose
+        # copy materializing in the per-epoch scope.
+        t_chunk = largest_divisor_leq(T, cfg.target_stream_chunk)
+
+        def flatten_rows_streamed(x):
+            n_chunks = T // t_chunk
+            blocks = x.reshape(n_chunks, t_chunk, E, *x.shape[2:])
+
+            def write(buf, inp):
+                c, blk = inp
+                blk = blk.swapaxes(0, 1)  # (E, t_chunk, ...)
+                return jax.lax.dynamic_update_slice_in_dim(
+                    buf, blk, c * t_chunk, axis=1
+                ), None
+
+            buf0 = jnp.zeros((E, T, *x.shape[2:]), x.dtype)
+            buf, _ = jax.lax.scan(write, buf0, (jnp.arange(n_chunks), blocks))
+            return buf.reshape(n_rows, *x.shape[2:])
+
         flat = jax.tree.map(flatten_rows, {
             "share_obs": traj.share_obs,
             "obs": traj.obs,
@@ -220,7 +272,13 @@ class MATTrainer:
                 values_all = jnp.concatenate([traj.values, next_values[None]], axis=0)
                 if cfg.use_valuenorm or cfg.use_popart:
                     values_all = value_norm_denormalize(value_norm, values_all)
-                adv, returns = compute_gae(traj.rewards, values_all, traj.masks, cfg.gamma, cfg.gae_lambda)
+                if cfg.target_stream_chunk > 0:
+                    adv, returns = compute_gae_chunked(
+                        traj.rewards, values_all, traj.masks,
+                        cfg.gamma, cfg.gae_lambda, t_chunk,
+                    )
+                else:
+                    adv, returns = compute_gae(traj.rewards, values_all, traj.masks, cfg.gamma, cfg.gae_lambda)
                 if self.n_objective > 1:
                     # scalarization weights: per-step DMO coefficients (broadcast
                     # over agents) when collected, else the static weights
@@ -245,24 +303,23 @@ class MATTrainer:
                     adv_norm = (adv_norm * w).sum(-1, keepdims=True)
                 probe("train/compute_targets",
                       {"advantages": adv_norm, "returns": returns})
-                return flatten_rows(adv_norm), flatten_rows(returns)
+                flatten = flatten_rows_streamed if cfg.target_stream_chunk > 0 else flatten_rows
+                return flatten(adv_norm), flatten(returns)
 
-        accum = max(1, cfg.grad_accum_steps)
-        assert mb_size % accum == 0, (
-            f"grad_accum_steps ({accum}) must divide the minibatch size "
-            f"({mb_size} = {n_rows} rows / {cfg.num_mini_batch} minibatches)"
-        )
+        if cfg.grad_accum_steps > 1:
+            assert mb_size % cfg.grad_accum_steps == 0, (
+                f"grad_accum_steps ({cfg.grad_accum_steps}) must divide the minibatch size "
+                f"({mb_size} = {n_rows} rows / {cfg.num_mini_batch} minibatches)"
+            )
+        # Streamed update: the minibatch fwd/bwd runs as `accum` donated-carry
+        # chunks (exact accumulation, full-minibatch denominators).  Besides
+        # the grad_accum memory story, this is the byte diet's main course:
+        # the chunk-shaped fwd/bwd scan body is what XLA's cost model counts,
+        # so counted bytes-per-update drop ~proportionally (BENCHLOG r6 A/B).
+        accum = effective_accum(mb_size, cfg.grad_accum_steps, cfg.update_stream_chunks)
+        layout = check_layout(cfg.minibatch_layout)
 
-        def ppo_update(carry, mb_idx):
-            params, opt_state, value_norm, adv_flat, ret_flat = carry
-            # ONE gather per minibatch (the old path re-gathered per accum
-            # chunk); indices-as-xs keeps peak memory at flat + one minibatch
-            # — materializing all permuted minibatches as scan xs would add a
-            # full extra copy of the batch to HBM
-            batch_mb = jax.tree.map(lambda x: x[mb_idx], flat)
-            adv_mb = adv_flat[mb_idx]
-            ret_b = ret_flat[mb_idx]
-
+        def apply_minibatch(params, opt_state, value_norm, batch_mb, adv_mb, ret_b):
             # ValueNorm update precedes normalize (mat_trainer.py:68-71),
             # always on the FULL minibatch regardless of accumulation
             if cfg.use_valuenorm or cfg.use_popart:
@@ -350,7 +407,7 @@ class MATTrainer:
                 update_ratio=unorm / (pnorm + 1e-12),
                 nonfinite_grads=(~jnp.isfinite(gnorm)).astype(jnp.float32),
             )
-            return (params, opt_state, value_norm, adv_flat, ret_flat), metrics
+            return params, opt_state, value_norm, metrics
 
         def run_epoch(carry, key_e, targets):
             params, opt_state, value_norm = carry
@@ -358,9 +415,41 @@ class MATTrainer:
             # Rows past mb_size*num_mini_batch are dropped, as the reference
             # floors (shared_buffer.py:250-261).
             perm = jax.random.permutation(key_e, n_rows)
-            mb_idxs = perm[: mb_size * cfg.num_mini_batch].reshape(cfg.num_mini_batch, mb_size)
-            (params, opt_state, value_norm, _, _), metrics = jax.lax.scan(
-                ppo_update, (params, opt_state, value_norm, adv_flat, ret_flat), mb_idxs
+            keep = mb_size * cfg.num_mini_batch
+
+            if layout == "contiguous":
+                # one full-permutation gather per epoch; each minibatch is a
+                # contiguous dynamic_slice of the permuted copy — identical
+                # minibatch CONTENT to the gather path under the same perm
+                data_p = permute_rows((flat, adv_flat, ret_flat), perm[:keep])
+
+                def ppo_update(c, start):
+                    params, opt_state, value_norm = c
+                    batch_mb, adv_mb, ret_b = slice_rows(data_p, start, mb_size)
+                    params, opt_state, value_norm, metrics = apply_minibatch(
+                        params, opt_state, value_norm, batch_mb, adv_mb, ret_b
+                    )
+                    return (params, opt_state, value_norm), metrics
+
+                xs = jnp.arange(cfg.num_mini_batch) * mb_size
+            else:
+                # ONE gather per minibatch (the old path re-gathered per accum
+                # chunk); indices-as-xs keeps peak memory at flat + one
+                # minibatch — materializing all permuted minibatches as scan
+                # xs would add a full extra copy of the batch to HBM
+                def ppo_update(c, mb_idx):
+                    params, opt_state, value_norm = c
+                    batch_mb = jax.tree.map(lambda x: x[mb_idx], flat)
+                    params, opt_state, value_norm, metrics = apply_minibatch(
+                        params, opt_state, value_norm,
+                        batch_mb, adv_flat[mb_idx], ret_flat[mb_idx],
+                    )
+                    return (params, opt_state, value_norm), metrics
+
+                xs = perm[:keep].reshape(cfg.num_mini_batch, mb_size)
+
+            (params, opt_state, value_norm), metrics = jax.lax.scan(
+                ppo_update, (params, opt_state, value_norm), xs
             )
             return (params, opt_state, value_norm), metrics
 

@@ -48,6 +48,27 @@ def compiled_flops(compiled: Any) -> Optional[float]:
         return None
 
 
+def compiled_bytes(compiled: Any) -> Optional[float]:
+    """"bytes accessed" from a compiled executable's cost analysis, or None.
+
+    XLA's static per-call count: every ``lax.scan``/``while`` BODY is counted
+    ONCE regardless of trip count (callers that want per-run traffic multiply
+    by trips themselves, as ``bench._roofline`` does).  This is the number the
+    ``bytes_per_update`` / ``bytes_per_collect`` gauges report and that
+    ``tests/test_update_bytes.py`` budgets against regression.
+    """
+    try:
+        cost = compiled.cost_analysis()
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0] if cost else None
+        if not cost:
+            return None
+        nbytes = cost.get("bytes accessed")
+        return float(nbytes) if nbytes is not None else None
+    except Exception:
+        return None
+
+
 def flop_estimate(fn: Callable, *args, **kwargs) -> Optional[float]:
     """XLA's analytic FLOP count for one call of ``fn(*args)``.
 

@@ -50,6 +50,10 @@ KNOWN_FIELDS = {
     "device_bytes_in_use", "device_peak_bytes", "host_rss_bytes",
     # one-shot
     "flops_per_step",
+    # cost_analysis bytes-accessed per jitted call (base_runner._mark_steady;
+    # update/collect in the unfused loop, dispatch when --iters_per_dispatch
+    # fuses them)
+    "bytes_per_update", "bytes_per_collect", "bytes_per_dispatch",
     # profiling record (base_runner profiling branch)
     "profile_collect_sec", "profile_train_sec", "profile_dispatch_sec",
     # SMAC win rate (smac_runner._extra_metrics)
@@ -74,6 +78,7 @@ NON_NEGATIVE = (
     "anomalies_total", "flight_snapshots", "flight_bundles",
     "device_bytes_in_use", "device_peak_bytes",
     "host_rss_bytes", "flops_per_step", "fps",
+    "bytes_per_update", "bytes_per_collect", "bytes_per_dispatch",
     "iters_per_dispatch", "dispatch_count", "dispatches_per_sec",
     "profile_dispatch_sec",
 )

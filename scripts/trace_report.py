@@ -15,6 +15,16 @@ directories holding them) with per-scope time deltas — baseline first:
 
     python scripts/trace_report.py diff artifacts/base artifacts/anomaly_ep40
 
+``bytes`` mode answers "where do the bytes go": it parses an optimized-HLO
+text dump (``compiled.as_text()`` — written by any InstrumentedJit entry
+point when ``MAT_DCML_TPU_HLO_DIR`` is set, or by hand from
+``jax.jit(f).lower(...).compile().as_text()``) and prints a bytes-by-scope
+table of materialized output buffers, naming the top byte consumers.  Ops
+inside fusion bodies don't materialize and are excluded; each scan/while
+body is counted once, matching ``cost_analysis`` semantics:
+
+    python scripts/trace_report.py bytes artifacts/hlo/update_1.hlo.txt [depth] [top_n]
+
 Writes <dir>/op_summary.json and prints top-N tables for the device lines,
 plus a per-scope rollup: ops carry their ``jax.named_scope`` path in the
 display name (``jit(train)/train/ppo_update/...``), so op time groups by the
@@ -27,6 +37,7 @@ from __future__ import annotations
 import glob
 import json
 import os
+import re
 import sys
 from collections import defaultdict
 
@@ -99,9 +110,119 @@ def diff_main(argv):
         print(f"{n[:48]:48s} {b:>10.2f} {c:>10.2f} {c - b:>+10.2f} {ratio:>7s}{marker}")
 
 
+# --------------------------------------------------------------------- bytes
+
+_DTYPE_BYTES = {
+    "f64": 8, "f32": 4, "f16": 2, "bf16": 2, "f8e4m3fn": 1, "f8e5m2": 1,
+    "s64": 8, "u64": 8, "s32": 4, "u32": 4, "s16": 2, "u16": 2,
+    "s8": 1, "u8": 1, "pred": 1,
+}
+_SHAPE_RE = re.compile(
+    r"\b(" + "|".join(_DTYPE_BYTES) + r")\[([0-9,]*)\]"
+)
+_OP_NAME_RE = re.compile(r'op_name="([^"]+)"')
+# "<result-shapes> <opcode>(" — result shapes may be a tuple "(f32[..], ...)"
+_INSTR_RE = re.compile(r"^((?:\([^)]*\))|(?:\S+))\s+([\w\-]+)\(")
+
+
+def _shape_nbytes(dtype: str, dims: str) -> int:
+    n = 1
+    for d in dims.split(","):
+        if d:
+            n *= int(d)
+    return n * _DTYPE_BYTES[dtype]
+
+
+def hlo_scope_of(op_name: str, depth: int) -> str:
+    """Scope path of an HLO ``metadata op_name`` (``jit(train)/train/...``):
+    jit/pjit frames drop, the rest is the named-scope + traced-fn stack."""
+    parts = [p for p in op_name.split("/") if p and not p.startswith(("jit(", "pjit"))]
+    return "/".join(parts[:depth]) or "(unscoped)"
+
+
+def parse_hlo_bytes(text: str, depth: int) -> dict:
+    """Optimized-HLO text -> {scope: [output_bytes, op_count]}.
+
+    Counts the materialized RESULT buffer of every instruction outside fusion
+    bodies (fusion-internal ops never materialize; reduction regions are
+    scalar).  Like ``cost_analysis``, a scan/while body is counted once
+    whatever its trip count.  Output-buffer bytes understate total traffic
+    (operand reads are excluded) but rank scopes the same way, which is what
+    a "top byte consumers" table is for.
+    """
+    by_scope = defaultdict(lambda: [0.0, 0])
+    in_fusion = False
+    for raw in text.splitlines():
+        ls = raw.strip()
+        if ls.endswith("{") and ("->" in ls or ls.startswith(("ENTRY", "%"))):
+            name = ls.split(" ", 1)[0].lstrip("%")
+            in_fusion = name.startswith(("fused_computation", "region_"))
+            continue
+        if in_fusion or " = " not in ls:
+            continue
+        _, rhs = ls.split(" = ", 1)
+        m = _INSTR_RE.match(rhs)
+        if not m:
+            continue
+        shapes_txt, opcode = m.group(1), m.group(2)
+        if opcode in ("parameter", "constant"):
+            continue
+        nbytes = sum(
+            _shape_nbytes(sm.group(1), sm.group(2))
+            for sm in _SHAPE_RE.finditer(shapes_txt)
+        )
+        if not nbytes:
+            continue
+        op = _OP_NAME_RE.search(ls)
+        scope = hlo_scope_of(op.group(1), depth) if op else f"(no-metadata:{opcode})"
+        row = by_scope[scope]
+        row[0] += nbytes
+        row[1] += 1
+    return by_scope
+
+
+def bytes_main(argv):
+    if not argv:
+        raise SystemExit(
+            "usage: trace_report.py bytes <hlo.txt | dir with *.hlo.txt> [depth] [top_n]"
+        )
+    path = argv[0]
+    depth = int(argv[1]) if len(argv) > 1 else 4
+    top_n = int(argv[2]) if len(argv) > 2 else 20
+    if os.path.isdir(path):
+        hits = sorted(glob.glob(os.path.join(path, "**", "*.hlo.txt"), recursive=True))
+        if not hits:
+            raise SystemExit(f"no *.hlo.txt under {path} — set MAT_DCML_TPU_HLO_DIR "
+                             f"(or dump compiled.as_text()) first")
+        path = hits[-1]
+    print(f"[bytes] {path}", file=sys.stderr)
+    with open(path) as f:
+        by_scope = parse_hlo_bytes(f.read(), depth)
+    total = sum(v[0] for v in by_scope.values())
+    rows = sorted(((n, v[0], v[1]) for n, v in by_scope.items()),
+                  key=lambda r: r[1], reverse=True)
+    named = [r for r in rows if not r[0].startswith("(no-metadata")]
+    top3 = ", ".join(f"{n} ({b / 1e6:.1f} MB)" for n, b, _ in named[:3])
+    print(f"== bytes by scope  (materialized outputs, each op once; "
+          f"total {total / 1e9:.3f} GB)")
+    print(f"top-3 byte consumers: {top3}")
+    print(f"{'scope':56s} {'MB':>10s} {'%':>6s} {'ops':>6s}")
+    for n, b, c in rows[:top_n]:
+        pct = 100 * b / total if total else 0.0
+        print(f"{n[:56]:56s} {b / 1e6:>10.1f} {pct:>6.1f} {c:>6d}")
+    out_path = os.path.join(os.path.dirname(path) or ".", "bytes_summary.json")
+    with open(out_path, "w") as f:
+        json.dump({"total_bytes": total, "depth": depth, "scopes": [
+            {"scope": n, "bytes": b, "ops": c} for n, b, c in rows
+        ]}, f, indent=1)
+    print(f"[bytes] wrote {out_path}", file=sys.stderr)
+
+
 def main():
     if len(sys.argv) > 1 and sys.argv[1] == "diff":
         return diff_main(sys.argv[2:])
+    if len(sys.argv) > 1 and sys.argv[1] == "bytes":
+        return bytes_main(sys.argv[2:])
     root = sys.argv[1] if len(sys.argv) > 1 else "."
     top_n = int(sys.argv[2]) if len(sys.argv) > 2 else 25
     xspace_path = find_xspace(root)

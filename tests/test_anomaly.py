@@ -253,6 +253,8 @@ def _poison_encoder_head(params):
     return jax.tree_util.tree_map_with_path(leaf, params)
 
 
+@pytest.mark.slow  # heaviest fast-tier test by far (~170s contended: full
+# train -> trip -> bundle -> bit-exact replay -> eager bisect, many compiles)
 def test_nan_trip_writes_bundle_replay_reproduces_and_bisects(tmp_path):
     env = _tiny_env()
     run = RunConfig(

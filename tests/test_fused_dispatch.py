@@ -101,6 +101,8 @@ def _check_equivalence(trainer, collector, init_states, seed=42):
     return metrics_f
 
 
+@pytest.mark.slow  # ~60s of MAT compiles; the MAPPO twin below keeps the
+# fused-equals-sequential contract in the fast tier
 def test_mat_fused_equals_sequential():
     W = 8
     consts = DCMLConsts(worker_number_max=W, sob_dim=W + 2)

@@ -71,6 +71,9 @@ def _run(action_type, dtype, impl, seed=0, block_b=None, ava="ones"):
     return out
 
 
+@pytest.mark.slow  # interpret-mode kernel parity: compile-heavy, and the
+# kernel is a non-default portability artifact (masked/deterministic
+# variants below keep a fast-tier smoke on the same code path)
 @pytest.mark.parametrize("action_type", [DISCRETE, SEMI_DISCRETE, CONTINUOUS])
 def test_fused_matches_unfused(action_type):
     ref = _run(action_type, "float32", "xla")
@@ -120,6 +123,7 @@ def test_fused_matches_unfused_masked_avail(action_type):
     )
 
 
+@pytest.mark.slow  # see test_fused_matches_unfused
 def test_fused_matches_unfused_no_avail():
     ref = _run(DISCRETE, "float32", "xla", ava=None)
     fused = _run(DISCRETE, "float32", "pallas_interpret", block_b=2, ava=None)
@@ -129,6 +133,7 @@ def test_fused_matches_unfused_no_avail():
     )
 
 
+@pytest.mark.slow  # see test_fused_matches_unfused
 def test_fused_matches_unfused_bf16():
     ref = _run(DISCRETE, "bfloat16", "xla")
     fused = _run(DISCRETE, "bfloat16", "pallas_interpret", block_b=2)

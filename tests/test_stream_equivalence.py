@@ -1,0 +1,135 @@
+"""Exactness of the byte-lean PPO update paths.
+
+Three config knobs reshape the update's memory traffic without being allowed
+to change its math:
+
+- ``target_stream_chunk``: the per-epoch returns recompute assembles
+  advantage/return rows through chunked ``dynamic_update_slice`` writes and
+  computes GAE as a chunked reverse scan — BIT-exact by construction (same
+  per-step op order; stats taken on the fully assembled array), enforced here
+  bitwise.
+- ``update_stream_chunks``: streams each minibatch's fwd/bwd through the
+  exact grad-accumulation machinery (chunk losses normalized by
+  full-minibatch denominators) — equal up to float summation order, enforced
+  to tolerance (same contract as tests/test_ppo_accum.py).
+- ``minibatch_layout="contiguous"``: permutes rows once per epoch so each
+  minibatch is a contiguous ``dynamic_slice``.  ``permuted[k*mb:(k+1)*mb]``
+  is elementwise identical to ``x[perm[k*mb:(k+1)*mb]]`` under the same
+  permutation, so the whole training trajectory must stay BIT-exact vs the
+  default gather layout — for MAT and MAPPO.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from mat_dcml_tpu.config import RunConfig
+from mat_dcml_tpu.envs.dcml import DCMLEnv, DCMLEnvConfig
+from mat_dcml_tpu.envs.spaces import Discrete
+from mat_dcml_tpu.envs.toy import MatchingEnv, MatchingEnvConfig
+from mat_dcml_tpu.models.actor_critic import ACConfig, ActorCriticPolicy
+from mat_dcml_tpu.training.ac_rollout import ACRolloutCollector
+from mat_dcml_tpu.training.mappo import Bootstrap, MAPPOConfig, MAPPOTrainer
+from mat_dcml_tpu.training.ppo import MATTrainer, PPOConfig
+from mat_dcml_tpu.training.rollout import RolloutCollector
+from mat_dcml_tpu.training.runner import build_mat_policy
+
+pytestmark = pytest.mark.slow  # heavy compiles (see pytest.ini fast tier)
+
+
+def _assert_trees_bitexact(a, b, what):
+    for la, lb in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+        na, nb = np.asarray(la), np.asarray(lb)
+        assert na.dtype == nb.dtype and na.shape == nb.shape
+        np.testing.assert_array_equal(na, nb, err_msg=f"{what}: not bit-exact")
+
+
+@pytest.fixture(scope="module")
+def mat_rollout():
+    run = RunConfig(n_rollout_threads=4, episode_length=6,
+                    n_embd=16, n_head=2, n_block=1)
+    env = DCMLEnv(DCMLEnvConfig(), data_dir="data")
+    policy = build_mat_policy(run, env)
+    params = policy.init_params(jax.random.key(0))
+    collector = RolloutCollector(env, policy, run.episode_length)
+    rs = collector.init_state(jax.random.key(1), run.n_rollout_threads)
+    rs2, traj = jax.jit(collector.collect)(params, rs)
+    return policy, params, rs2, traj
+
+
+def _mat_train(mat_rollout, **ppo_kwargs):
+    policy, params, rs2, traj = mat_rollout
+    trainer = MATTrainer(policy, PPOConfig(ppo_epoch=3, num_mini_batch=2,
+                                           **ppo_kwargs))
+    state = trainer.init_state(params)
+    state2, metrics = jax.jit(trainer.train)(state, traj, rs2,
+                                             jax.random.key(2))
+    return state2, metrics
+
+
+def test_streamed_targets_bitexact_mat(mat_rollout):
+    """Chunked GAE + chunked row assembly vs the monolithic recompute:
+    identical parameters after 3 epochs x 2 minibatches, bit for bit."""
+    seed, m_seed = _mat_train(mat_rollout,
+                              update_stream_chunks=0, target_stream_chunk=0)
+    tgt, m_tgt = _mat_train(mat_rollout,
+                            update_stream_chunks=0, target_stream_chunk=3)
+    _assert_trees_bitexact(seed.params, tgt.params, "streamed targets")
+    _assert_trees_bitexact(m_seed, m_tgt, "streamed-target metrics")
+
+
+def test_update_stream_chunks_match_unchunked_mat(mat_rollout):
+    """Default byte-streaming (update_stream_chunks) changes only float
+    summation order — the accumulation-exactness contract."""
+    seed, _ = _mat_train(mat_rollout,
+                         update_stream_chunks=0, target_stream_chunk=0)
+    stream, _ = _mat_train(mat_rollout)  # defaults: streaming on
+    for a, b in zip(jax.tree.leaves(seed.params),
+                    jax.tree.leaves(stream.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-6)
+
+
+def test_contiguous_layout_bitexact_mat(mat_rollout):
+    """Same epoch permutation, contiguous slices vs gather: the minibatch
+    CONTENT is identical, so the loss/param trajectory must be too."""
+    g, mg = _mat_train(mat_rollout, minibatch_layout="gather")
+    c, mc = _mat_train(mat_rollout, minibatch_layout="contiguous")
+    _assert_trees_bitexact(g.params, c.params, "contiguous layout (MAT)")
+    _assert_trees_bitexact(mg, mc, "contiguous layout metrics (MAT)")
+
+
+def test_contiguous_layout_bitexact_mappo():
+    env = MatchingEnv(MatchingEnvConfig(n_agents=3, n_actions=4, horizon=5))
+    pol = ActorCriticPolicy(ACConfig(hidden_size=32), obs_dim=env.obs_dim,
+                            cent_obs_dim=env.share_obs_dim,
+                            space=Discrete(env.action_dim))
+    params = pol.init_params(jax.random.key(0))
+    collector = ACRolloutCollector(env, pol, 8)
+    rs = collector.init_state(jax.random.key(1), 6)
+    rs2, traj = jax.jit(collector.collect)(params, rs)
+    boot = Bootstrap(cent_obs=rs2.share_obs, critic_h=rs2.critic_h,
+                     mask=rs2.mask)
+
+    def train(layout):
+        cfg = MAPPOConfig(ppo_epoch=3, num_mini_batch=2,
+                          minibatch_layout=layout)
+        trainer = MAPPOTrainer(pol, cfg)
+        state = trainer.init_state(params)
+        state2, metrics = jax.jit(trainer.train)(state, traj, boot,
+                                                 jax.random.key(2))
+        return state2, metrics
+
+    g, mg = train("gather")
+    c, mc = train("contiguous")
+    _assert_trees_bitexact(g.params, c.params, "contiguous layout (MAPPO)")
+    _assert_trees_bitexact(mg, mc, "contiguous layout metrics (MAPPO)")
+
+
+def test_bad_layout_rejected():
+    with pytest.raises(ValueError, match="minibatch_layout"):
+        MAPPOTrainer(
+            ActorCriticPolicy(ACConfig(hidden_size=8), obs_dim=4,
+                              cent_obs_dim=4, space=Discrete(2)),
+            MAPPOConfig(minibatch_layout="striped"),
+        )

@@ -1,0 +1,106 @@
+"""Bytes-accessed regression gate for the PPO update.
+
+XLA's ``cost_analysis()`` on the compiled update is a *static* per-call
+count (every scan body counted once) — deterministic for a fixed config on a
+fixed backend, which makes it a cheap, CPU-runnable tripwire: a change that
+silently re-materializes the epoch buffers or un-fuses the minibatch
+fwd/bwd shows up as a bytes jump long before anyone reruns the chip bench.
+
+Budgets live in ``tests/data/update_bytes_budget.json``.  The gate fails
+when a config's counted bytes exceed its recorded budget by >10%.  After an
+*intentional* change to the update's memory traffic, regenerate with:
+
+    MAT_DCML_TPU_UPDATE_BYTES_REGEN=1 pytest tests/test_update_bytes.py
+
+and commit the refreshed budget alongside the change.
+"""
+
+import json
+import os
+from pathlib import Path
+
+import jax
+import pytest
+
+from mat_dcml_tpu.config import RunConfig
+from mat_dcml_tpu.envs.dcml import DCMLEnv, DCMLEnvConfig
+from mat_dcml_tpu.training.ppo import MATTrainer, PPOConfig
+from mat_dcml_tpu.training.rollout import RolloutCollector
+from mat_dcml_tpu.training.runner import build_mat_policy
+from mat_dcml_tpu.utils.profiling import compiled_bytes
+
+BUDGET_PATH = Path(__file__).parent / "data" / "update_bytes_budget.json"
+REGEN_ENV = "MAT_DCML_TPU_UPDATE_BYTES_REGEN"
+TOLERANCE = 0.10  # fail when counted bytes exceed budget by more than this
+
+# (config key, PPOConfig overrides).  "default" is the shipped streaming
+# config; "unstreamed" is the monolithic seed path — keeping both budgeted
+# documents the streaming win and catches a regression in either path.
+CONFIGS = [
+    ("mat_tiny_default", {}),
+    ("mat_tiny_unstreamed",
+     {"update_stream_chunks": 0, "target_stream_chunk": 0}),
+]
+
+
+def _counted_update_bytes(ppo_overrides) -> float | None:
+    """Static bytes-accessed for one compiled ``trainer.train`` at the tiny
+    CPU config.  Shapes come from ``eval_shape`` on collect — no rollout
+    compile, only the train compile is paid."""
+    run = RunConfig(n_rollout_threads=4, episode_length=6,
+                    n_embd=16, n_head=2, n_block=1)
+    env = DCMLEnv(DCMLEnvConfig(), data_dir="data")
+    policy = build_mat_policy(run, env)
+    params = policy.init_params(jax.random.key(0))
+    collector = RolloutCollector(env, policy, run.episode_length)
+    rs = collector.init_state(jax.random.key(1), run.n_rollout_threads)
+    rs2_shape, traj_shape = jax.eval_shape(collector.collect, params, rs)
+    trainer = MATTrainer(policy, PPOConfig(ppo_epoch=2, num_mini_batch=2,
+                                           **ppo_overrides))
+    state = trainer.init_state(params)
+    compiled = jax.jit(trainer.train).lower(
+        state, traj_shape, rs2_shape, jax.random.key(2)).compile()
+    return compiled_bytes(compiled)
+
+
+@pytest.fixture(scope="module")
+def measured():
+    out = {}
+    for key, overrides in CONFIGS:
+        nbytes = _counted_update_bytes(overrides)
+        if nbytes is None:
+            pytest.skip("backend exposes no cost model")
+        out[key] = nbytes
+    if os.environ.get(REGEN_ENV):
+        BUDGET_PATH.parent.mkdir(parents=True, exist_ok=True)
+        BUDGET_PATH.write_text(json.dumps(
+            {k: {"bytes": v} for k, v in out.items()}, indent=2) + "\n")
+    return out
+
+
+@pytest.mark.parametrize("key", [k for k, _ in CONFIGS])
+def test_update_bytes_within_budget(measured, key):
+    assert BUDGET_PATH.exists(), (
+        f"{BUDGET_PATH} missing — generate it with {REGEN_ENV}=1")
+    budget = json.loads(BUDGET_PATH.read_text())[key]["bytes"]
+    nbytes = measured[key]
+    assert nbytes <= budget * (1 + TOLERANCE), (
+        f"{key}: update accesses {nbytes:,.0f} bytes, budget {budget:,.0f} "
+        f"(+{(nbytes / budget - 1) * 100:.1f}% > {TOLERANCE:.0%} tolerance). "
+        f"If the increase is intentional, regenerate with {REGEN_ENV}=1."
+    )
+    if nbytes < budget * (1 - TOLERANCE):
+        # improvements should be locked in, not silently absorbed
+        pytest.xfail(
+            f"{key}: bytes dropped {(1 - nbytes / budget) * 100:.1f}% below "
+            f"budget — regenerate the budget to lock in the win ({REGEN_ENV}=1)"
+        )
+
+
+def test_streaming_reduces_counted_bytes(measured):
+    """The shipped default must actually be byte-leaner than the monolithic
+    path it replaced — the tentpole's reason to exist."""
+    assert measured["mat_tiny_default"] < measured["mat_tiny_unstreamed"], (
+        f"streaming default counts {measured['mat_tiny_default']:,.0f} bytes "
+        f">= unstreamed {measured['mat_tiny_unstreamed']:,.0f}"
+    )
