@@ -50,6 +50,15 @@ Knobs (environment variables):
                         (base_runner.make_dispatch_fn, donated buffers,
                         DeferredFetch metric transfer) instead of the normal
                         measurement; one json line per K, record = best K
+  BENCH_SERVING         "1" → serving A/B instead of training: continuous
+                        batching over the bucket ladder vs batch-size-1
+                        dispatch, same AOT engine (serving/).  Record value =
+                        batched QPS, vs_baseline = speedup over batch-1.
+                        Knobs: BENCH_SERVING_REQUESTS (256),
+                        BENCH_SERVING_CONCURRENCY (16),
+                        BENCH_SERVING_BUCKETS (1,4,16),
+                        BENCH_SERVING_RUN_DIR (append the serving records to
+                        <dir>/metrics.jsonl)
 
 On device OOM the bench walks a backoff ladder before shrinking the batch:
 remat on -> accumulation x2 (up to 8) -> halve E — big batches get memory
@@ -682,6 +691,84 @@ def _k_sweep(jax, E: int, T: int, iters: int, ks: list) -> None:
     print(json.dumps(record), flush=True)
 
 
+def _measure_serving(jax) -> None:
+    """BENCH_SERVING=1 leg: serving throughput A/B on the production DCML
+    policy shape (101 agents).  Leg A runs the continuous batcher over the
+    bucket ladder; leg B pins the ladder to (1,) — every request dispatched
+    alone — with the identical AOT engine and params.  Both legs report the
+    full serving record (QPS, p50/p95/p99, shed rate, bucket occupancy)
+    through the telemetry registry; the stdout record's ``vs_baseline`` is
+    the batched-over-single speedup, the number BENCHLOG tracks."""
+    from mat_dcml_tpu.config import RunConfig
+    from mat_dcml_tpu.envs.dcml import DCMLEnv, DCMLEnvConfig
+    from mat_dcml_tpu.serving.batcher import BatcherConfig, ContinuousBatcher
+    from mat_dcml_tpu.serving.engine import DecodeEngine, EngineConfig
+    from mat_dcml_tpu.serving.loadgen import run_load, write_serving_record
+    from mat_dcml_tpu.serving.server import PolicyClient
+    from mat_dcml_tpu.training.runner import build_mat_policy
+
+    data_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)), "data")
+    env = DCMLEnv(DCMLEnvConfig(), data_dir=data_dir)
+    policy = build_mat_policy(RunConfig(), env)
+    params = policy.init_params(jax.random.key(0))
+
+    n_req = int(os.environ.get("BENCH_SERVING_REQUESTS", "256"))
+    conc = int(os.environ.get("BENCH_SERVING_CONCURRENCY", "16"))
+    buckets = tuple(
+        int(b) for b in os.environ.get("BENCH_SERVING_BUCKETS", "1,4,16").split(",")
+    )
+    run_dir = os.environ.get("BENCH_SERVING_RUN_DIR", "")
+
+    legs = {}
+    for name, bks, wait_ms in (("batched", buckets, 2.0), ("single", (1,), 0.0)):
+        engine = DecodeEngine(
+            params, policy.cfg, EngineConfig(buckets=bks), log_fn=log
+        )
+        t0 = time.perf_counter()
+        engine.warmup()
+        log(f"serving[{name}]: {len(bks)} bucket programs compiled in "
+            f"{time.perf_counter() - t0:.1f}s")
+        batcher = ContinuousBatcher(
+            engine, BatcherConfig(max_batch_wait_ms=wait_ms), log_fn=log
+        )
+        rec = run_load(PolicyClient(batcher), n_requests=n_req, concurrency=conc)
+        rec["steady_state_recompiles"] = engine.steady_state_recompiles()
+        batcher.close()
+        legs[name] = rec
+        log(f"serving[{name}]: {rec['serving_qps']:.1f} req/s, "
+            f"p50 {rec['serving_p50_ms']:.1f} ms, p99 {rec['serving_p99_ms']:.1f} ms, "
+            f"shed {rec['serving_shed_rate']:.3f}, "
+            f"recompiles {rec['steady_state_recompiles']:.0f}")
+        if run_dir:
+            write_serving_record(run_dir, rec)
+
+    dev = jax.devices()[0]
+    batched, single = legs["batched"], legs["single"]
+    record = {
+        "metric": "dcml_mat_serving_qps",
+        "value": round(batched["serving_qps"], 2),
+        "unit": "req/s",
+        # for the serving leg the baseline IS the unbatched dispatch: the A/B
+        # this bench exists to pin (continuous batching must win)
+        "vs_baseline": round(
+            batched["serving_qps"] / max(single["serving_qps"], 1e-9), 2
+        ),
+        "platform": dev.platform,
+        "device": dev.device_kind,
+        "provisional": False,
+        "buckets": ",".join(str(b) for b in buckets),
+        "requests": n_req,
+        "concurrency": conc,
+        "single_qps": round(single["serving_qps"], 2),
+        "p50_ms": round(batched["serving_p50_ms"], 2),
+        "p95_ms": round(batched["serving_p95_ms"], 2),
+        "p99_ms": round(batched["serving_p99_ms"], 2),
+        "shed_rate": round(batched["serving_shed_rate"], 4),
+        "steady_state_recompiles": batched["steady_state_recompiles"],
+    }
+    print(json.dumps(record), flush=True)
+
+
 def _is_oom(e: Exception) -> bool:
     s = f"{type(e).__name__}: {e}"
     return "RESOURCE_EXHAUSTED" in s or "Out of memory" in s or "out of memory" in s
@@ -867,6 +954,13 @@ def _orchestrate() -> None:
 
 
 def main() -> None:
+    # Serving A/B leg: self-contained, no orchestration (the caller pins the
+    # platform — the BENCHLOG A/B is a CPU measurement)
+    if os.environ.get("BENCH_SERVING", "0") == "1":
+        jax, _ = _setup_jax()
+        _measure_serving(jax)
+        return
+
     # Orchestrated (deadline-aware) unless the caller manages the chip
     # itself: BENCH_DIRECT=1, or the legacy session-script signal
     # BENCH_TPU_PROBE_TIMEOUT=0, or an explicit BENCH_DEADLINE=0.

@@ -80,6 +80,58 @@ def _action_std(model: MultiAgentTransformer, params) -> jax.Array:
 
 
 # ---------------------------------------------------------------------------
+# Params-only serving entry (shared by training rollout and serving/engine)
+# ---------------------------------------------------------------------------
+
+DECODE_MODES = ("scan", "stride")
+
+
+def serve_decode(
+    cfg: MATConfig,
+    params,
+    key: jax.Array,
+    state: jax.Array,
+    obs: jax.Array,
+    available_actions: Optional[jax.Array] = None,
+    deterministic: bool = True,
+    mode: str = "scan",
+    stride: int = 2,
+) -> Tuple[jax.Array, DecodeResult]:
+    """One params-only signature for the full encode+decode forward.
+
+    This is the seam serving and training share: ``policy.get_actions`` /
+    ``policy.act_stride`` and ``serving/engine.py`` all route through here, so
+    the served action path IS the training rollout path (parity pinned by
+    tests/test_serving.py).  Everything non-array is static — ``cfg`` is a
+    frozen hashable dataclass (MATConfig round-trips through
+    ``training/checkpoint.export_policy``), and the model module is
+    constructed *inside* from ``cfg`` alone, so a jit/AOT-lowered closure over
+    this function captures no module state and donated caches stay legal.
+
+    ``mode``: ``"scan"`` = exact single-scan autoregressive decode with
+    per-block KV caches (:func:`ar_decode`); ``"stride"`` = the reference's
+    block-commit approximation (:func:`stride_decode`, deterministic only).
+    ``key`` is always taken (ignored by the deterministic stride path) so the
+    two modes present the same call signature to AOT compilation.
+
+    Returns ``(values, DecodeResult)``.
+    """
+    if mode not in DECODE_MODES:
+        raise ValueError(f"mode must be one of {DECODE_MODES}, got {mode!r}")
+    model = MultiAgentTransformer(cfg)
+    v_loc, obs_rep = model.apply(params, state, obs, method="encode")
+    if mode == "stride":
+        res = stride_decode(
+            model, params, obs_rep, obs, available_actions, stride=stride
+        )
+    else:
+        res = ar_decode(
+            model, params, key, obs_rep, obs, available_actions, deterministic
+        )
+    return v_loc, res
+
+
+# ---------------------------------------------------------------------------
 # Autoregressive decode (exact; scan + KV cache)
 # ---------------------------------------------------------------------------
 

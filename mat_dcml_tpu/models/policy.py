@@ -78,10 +78,14 @@ class TransformerPolicy:
         available_actions: Optional[jax.Array] = None,
         deterministic: bool = False,
     ) -> PolicyOutput:
-        """Autoregressive decode (``ma_transformer.py:298-329``)."""
-        v_loc, obs_rep = self.model.apply(params, state, obs, method="encode")
-        res = decode_lib.ar_decode(
-            self.model, params, key, obs_rep, obs, available_actions, deterministic
+        """Autoregressive decode (``ma_transformer.py:298-329``).
+
+        Routes through :func:`decode.serve_decode` — the same params-only
+        entry ``serving/engine.py`` compiles — so rollout and serving share
+        one code path."""
+        v_loc, res = decode_lib.serve_decode(
+            self.cfg, params, key, state, obs, available_actions,
+            deterministic=deterministic, mode="scan",
         )
         return PolicyOutput(v_loc, res.action, res.log_prob)
 
@@ -95,9 +99,9 @@ class TransformerPolicy:
     ) -> PolicyOutput:
         """Deterministic stride-batched decode for benchmark-protocol parity
         (``transformer_policy.py:219-241`` with ``stride``)."""
-        v_loc, obs_rep = self.model.apply(params, state, obs, method="encode")
-        res = decode_lib.stride_decode(
-            self.model, params, obs_rep, obs, available_actions, stride=stride
+        v_loc, res = decode_lib.serve_decode(
+            self.cfg, params, jax.random.key(0), state, obs, available_actions,
+            mode="stride", stride=stride,
         )
         return PolicyOutput(v_loc, res.action, res.log_prob)
 

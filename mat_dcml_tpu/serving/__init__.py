@@ -1,0 +1,34 @@
+"""Policy serving: AOT decode engine + bucketed continuous batching.
+
+The deployment half of the MAT-AS scheduler: ``engine.py`` holds a checkpoint
+in an ahead-of-time-compiled decode program per batch bucket (zero compiles in
+the request path), ``batcher.py`` packs concurrent requests into those
+buckets, ``server.py`` fronts it with a stdlib JSON endpoint plus an
+in-process client, and ``loadgen.py`` measures the whole stack (QPS,
+latency percentiles, shed rate, bucket occupancy) through the telemetry
+registry.  No dependencies beyond the training stack itself.
+"""
+
+from mat_dcml_tpu.serving.batcher import (
+    BatcherConfig,
+    ContinuousBatcher,
+    DeadlineExceededError,
+    EngineFailureError,
+    QueueFullError,
+    ServingError,
+)
+from mat_dcml_tpu.serving.engine import DecodeEngine, EngineConfig
+from mat_dcml_tpu.serving.server import PolicyClient, PolicyServer
+
+__all__ = [
+    "BatcherConfig",
+    "ContinuousBatcher",
+    "DeadlineExceededError",
+    "DecodeEngine",
+    "EngineConfig",
+    "EngineFailureError",
+    "PolicyClient",
+    "PolicyServer",
+    "QueueFullError",
+    "ServingError",
+]

@@ -1,0 +1,167 @@
+"""AOT-compiled decode engine: fixed-shape programs, zero request-path compiles.
+
+The TPU serving shape (PAPERS.md "Fine-Tuning and Serving Gemma on Cloud
+TPU"): never let the compiler into the request path.  At startup the engine
+lowers and compiles the deterministic decode forward — the *same* params-only
+entry training rollouts use, :func:`mat_dcml_tpu.models.decode.serve_decode` —
+once per batch bucket in a small ladder (default 1/8/32/128).  Steady-state
+serving then only ever calls pre-compiled executables; the recompile detector
+(:class:`telemetry.jit_instrument.InstrumentedJit`) is armed after warmup, so
+any stray compile is counted loudly in ``steady_state_recompiles``.
+
+A request is one joint observation: ``state (A, state_dim)``, ``obs (A,
+obs_dim)``, optional ``available_actions (A, action_dim)``.  The engine
+consumes host numpy stacked to a bucket's batch size and returns host numpy
+actions/log-probs — device handles never leak to the batcher.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from mat_dcml_tpu.models.decode import serve_decode
+from mat_dcml_tpu.models.mat import MATConfig
+from mat_dcml_tpu.telemetry import Telemetry, instrumented_jit
+
+
+@dataclasses.dataclass(frozen=True)
+class EngineConfig:
+    """Engine knobs.  ``buckets`` is the batch-size ladder, ascending; the
+    batcher pads each dispatch up to the smallest bucket that fits."""
+
+    buckets: Tuple[int, ...] = (1, 8, 32, 128)
+    decode_mode: str = "scan"     # "scan" (exact) | "stride" (block-commit)
+    stride: int = 2
+    deterministic: bool = True
+
+    def __post_init__(self):
+        if not self.buckets:
+            raise ValueError("EngineConfig.buckets must be non-empty")
+        if list(self.buckets) != sorted(set(self.buckets)):
+            raise ValueError(f"buckets must be strictly ascending, got {self.buckets}")
+
+
+class DecodeEngine:
+    """Params + MATConfig in, pre-compiled fixed-shape decode programs out."""
+
+    def __init__(
+        self,
+        params,
+        cfg: MATConfig,
+        engine_cfg: EngineConfig = EngineConfig(),
+        telemetry: Optional[Telemetry] = None,
+        log_fn=print,
+    ):
+        self.cfg = cfg
+        self.engine_cfg = engine_cfg
+        self.telemetry = telemetry if telemetry is not None else Telemetry()
+        self.log = log_fn
+        self._params = jax.device_put(params)   # resident once, shared by all buckets
+        ecfg = engine_cfg
+
+        def _decode(params, key, state, obs, avail):
+            _, res = serve_decode(
+                cfg, params, key, state, obs, avail,
+                deterministic=ecfg.deterministic,
+                mode=ecfg.decode_mode, stride=ecfg.stride,
+            )
+            return res.action, res.log_prob
+
+        self._decode = instrumented_jit(
+            _decode, "serve_decode", self.telemetry, log_fn
+        )
+        # deterministic serving still threads a key through the shared
+        # signature (decode.serve_decode); one fixed resident key avoids a
+        # fresh host->device transfer per dispatch
+        self._key = jax.random.key(0)
+
+    # ------------------------------------------------------------- lifecycle
+
+    @classmethod
+    def from_export(
+        cls,
+        directory,
+        engine_cfg: EngineConfig = EngineConfig(),
+        telemetry: Optional[Telemetry] = None,
+        log_fn=print,
+    ) -> "DecodeEngine":
+        """Build from a weights-only export (``checkpoint.export_policy``)."""
+        from mat_dcml_tpu.training.checkpoint import load_policy
+
+        params, cfg, space_meta = load_policy(directory)
+        eng = cls(params, cfg, engine_cfg, telemetry, log_fn)
+        eng.space_meta = space_meta
+        return eng
+
+    def warmup(self) -> None:
+        """AOT-compile every bucket's program, then arm the recompile
+        detector: from here on the request path must never compile."""
+        import time
+
+        for b in self.engine_cfg.buckets:
+            t0 = time.perf_counter()
+            out = self._decode(self._params, self._key, *self._zero_batch(b))
+            jax.block_until_ready(out)
+            self.log(
+                f"[serving] bucket {b}: compiled in {time.perf_counter() - t0:.1f}s"
+            )
+        self._decode.mark_steady()
+        self.telemetry.gauge("serving_buckets", float(len(self.engine_cfg.buckets)))
+
+    def _zero_batch(self, b: int):
+        cfg = self.cfg
+        state = jnp.zeros((b, cfg.n_agent, cfg.state_dim), jnp.float32)
+        obs = jnp.zeros((b, cfg.n_agent, cfg.obs_dim), jnp.float32)
+        avail = jnp.ones((b, cfg.n_agent, cfg.action_dim), jnp.float32)
+        return state, obs, avail
+
+    # --------------------------------------------------------------- serving
+
+    def bucket_for(self, n: int) -> int:
+        """Smallest bucket holding ``n`` requests (largest bucket caps it)."""
+        for b in self.engine_cfg.buckets:
+            if n <= b:
+                return b
+        return self.engine_cfg.buckets[-1]
+
+    @property
+    def max_batch(self) -> int:
+        return self.engine_cfg.buckets[-1]
+
+    @property
+    def min_bucket(self) -> int:
+        return self.engine_cfg.buckets[0]
+
+    def decode(
+        self, state: np.ndarray, obs: np.ndarray, avail: np.ndarray
+    ) -> Tuple[np.ndarray, np.ndarray]:
+        """Run one pre-compiled bucket program.  Inputs must already be padded
+        to a bucket size (the batcher's job); a non-bucket batch raises rather
+        than silently compiling a new program."""
+        b = state.shape[0]
+        if b not in self.engine_cfg.buckets:
+            raise ValueError(
+                f"batch {b} is not a compiled bucket {self.engine_cfg.buckets}"
+            )
+        # availability guards the discrete heads; the mask rows for padding
+        # slots are all-ones so masked-softmax never sees a -inf-only row
+        action, log_prob = self._decode(
+            self._params, self._key,
+            jnp.asarray(state, jnp.float32),
+            jnp.asarray(obs, jnp.float32),
+            jnp.asarray(avail, jnp.float32),
+        )
+        return np.asarray(action), np.asarray(log_prob)
+
+    # ------------------------------------------------------------ accounting
+
+    def compile_count(self) -> int:
+        return self._decode.compile_count
+
+    def steady_state_recompiles(self) -> float:
+        return self.telemetry.counters.get("steady_state_recompiles", 0.0)

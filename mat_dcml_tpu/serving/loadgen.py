@@ -1,0 +1,202 @@
+"""Load generator + serving benchmark reporting.
+
+Drives a :class:`PolicyClient` (in-process) with either an **open-loop**
+arrival process (fixed target QPS, Poisson-ish via fixed inter-arrival
+spacing; measures the latency the *system* imposes under an offered load,
+sheds and all) or a **closed-loop** worker pool (``concurrency`` blocking
+callers; measures max sustainable throughput).  Reports sustained QPS,
+p50/p95/p99 latency, shed rate, deadline-miss rate, and bucket-occupancy
+through the telemetry registry into ``metrics.jsonl`` — the same stream the
+trainer writes, so BENCH tooling consumes serving records unchanged
+(``scripts/check_metrics_schema.py`` knows the ``serving_*`` family).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from mat_dcml_tpu.serving.batcher import ServingError
+from mat_dcml_tpu.serving.server import PolicyClient
+
+
+def synth_requests(cfg, n: int, seed: int = 0):
+    """Synthetic joint observations shaped for ``cfg`` (MATConfig): the DCML
+    serving payload without needing the env — availability keeps action 0
+    legal so every request is valid."""
+    rng = np.random.default_rng(seed)
+    states = rng.normal(size=(n, cfg.n_agent, cfg.state_dim)).astype(np.float32)
+    obs = rng.normal(size=(n, cfg.n_agent, cfg.obs_dim)).astype(np.float32)
+    avail = np.ones((n, cfg.n_agent, cfg.action_dim), np.float32)
+    if cfg.action_dim > 1:
+        avail[:, :, 1:] = (
+            rng.random((n, cfg.n_agent, cfg.action_dim - 1)) > 0.3
+        ).astype(np.float32)
+    return states, obs, avail
+
+
+def percentiles(latencies_ms: List[float]) -> Dict[str, float]:
+    if not latencies_ms:
+        return {"serving_p50_ms": 0.0, "serving_p95_ms": 0.0, "serving_p99_ms": 0.0}
+    arr = np.asarray(latencies_ms)
+    return {
+        "serving_p50_ms": float(np.percentile(arr, 50)),
+        "serving_p95_ms": float(np.percentile(arr, 95)),
+        "serving_p99_ms": float(np.percentile(arr, 99)),
+    }
+
+
+def run_load(
+    client: PolicyClient,
+    n_requests: int,
+    concurrency: int = 8,
+    target_qps: Optional[float] = None,
+    timeout_s: Optional[float] = None,
+    seed: int = 0,
+) -> Dict[str, float]:
+    """Fire ``n_requests`` at the stack and return a flat serving record.
+
+    ``target_qps=None`` = closed loop (each of ``concurrency`` workers fires
+    its next request as soon as the previous returns); a number = open loop
+    (requests launched on schedule from a thread pool regardless of
+    completions, so queueing/shedding behavior is exercised honestly).
+    """
+    cfg = client.batcher.engine.cfg
+    states, obs, avail = synth_requests(cfg, n_requests, seed)
+    latencies: List[float] = []
+    outcomes = {"ok": 0, "shed": 0, "deadline": 0, "error": 0}
+    lock = threading.Lock()
+
+    def fire(i: int) -> None:
+        t0 = time.perf_counter()
+        try:
+            client.act(states[i], obs[i], avail[i], timeout_s=timeout_s)
+        except ServingError as e:
+            kind = type(e).__name__
+            with lock:
+                if "QueueFull" in kind:
+                    outcomes["shed"] += 1
+                elif "Deadline" in kind:
+                    outcomes["deadline"] += 1
+                else:
+                    outcomes["error"] += 1
+            return
+        dt_ms = (time.perf_counter() - t0) * 1e3
+        with lock:
+            outcomes["ok"] += 1
+            latencies.append(dt_ms)
+
+    t_start = time.perf_counter()
+    if target_qps is None:
+        idx = iter(range(n_requests))
+        idx_lock = threading.Lock()
+
+        def worker():
+            while True:
+                with idx_lock:
+                    i = next(idx, None)
+                if i is None:
+                    return
+                fire(i)
+
+        threads = [threading.Thread(target=worker) for _ in range(concurrency)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+    else:
+        period = 1.0 / target_qps
+        threads = []
+        for i in range(n_requests):
+            due = t_start + i * period
+            lag = due - time.perf_counter()
+            if lag > 0:
+                time.sleep(lag)
+            t = threading.Thread(target=fire, args=(i,))
+            t.start()
+            threads.append(t)
+        for t in threads:
+            t.join()
+    elapsed = time.perf_counter() - t_start
+
+    record: Dict[str, float] = {
+        "serving_qps": outcomes["ok"] / max(elapsed, 1e-9),
+        "serving_offered_qps": n_requests / max(elapsed, 1e-9),
+        "serving_ok": float(outcomes["ok"]),
+        "serving_shed_rate": outcomes["shed"] / max(n_requests, 1),
+        "serving_deadline_miss_rate": outcomes["deadline"] / max(n_requests, 1),
+        "serving_error_rate": outcomes["error"] / max(n_requests, 1),
+        "serving_wall_s": elapsed,
+    }
+    record.update(percentiles(latencies))
+    tel = client.batcher.telemetry
+    # bucket-occupancy histogram + engine-side aggregates ride along
+    record.update(tel.flush())
+    return record
+
+
+def write_serving_record(run_dir, record: Dict[str, float]) -> None:
+    """Append the serving record to ``<run_dir>/metrics.jsonl`` via the
+    training stack's writer (same schema pipeline)."""
+    from mat_dcml_tpu.utils.metrics import MetricsWriter
+
+    writer = MetricsWriter(run_dir)
+    writer.write(record)
+    writer.close()
+
+
+def main(argv=None) -> None:
+    """CLI: load-test a policy export end to end (engine in-process).
+
+    Usage: python -m mat_dcml_tpu.serving.loadgen --policy_dir <export>
+           [--requests 2000] [--concurrency 16] [--qps 0 = closed-loop]
+           [--buckets 1,8,32,128] [--run_dir results/serving]
+    """
+    import argparse
+
+    from mat_dcml_tpu.serving.batcher import BatcherConfig, ContinuousBatcher
+    from mat_dcml_tpu.serving.engine import DecodeEngine, EngineConfig
+
+    p = argparse.ArgumentParser(description="MAT serving load generator")
+    p.add_argument("--policy_dir", required=True)
+    p.add_argument("--requests", type=int, default=2000)
+    p.add_argument("--concurrency", type=int, default=16)
+    p.add_argument("--qps", type=float, default=0.0, help="0 = closed loop")
+    p.add_argument("--timeout_s", type=float, default=0.0, help="0 = none")
+    p.add_argument("--buckets", default="1,8,32,128")
+    p.add_argument("--max_batch_wait_ms", type=float, default=2.0)
+    p.add_argument("--run_dir", default=None,
+                   help="append the record to <run_dir>/metrics.jsonl")
+    args = p.parse_args(argv)
+
+    engine = DecodeEngine.from_export(
+        args.policy_dir,
+        EngineConfig(buckets=tuple(int(b) for b in args.buckets.split(","))),
+    )
+    engine.warmup()
+    batcher = ContinuousBatcher(
+        engine, BatcherConfig(max_batch_wait_ms=args.max_batch_wait_ms)
+    )
+    client = PolicyClient(batcher)
+    record = run_load(
+        client,
+        n_requests=args.requests,
+        concurrency=args.concurrency,
+        target_qps=args.qps or None,
+        timeout_s=args.timeout_s or None,
+    )
+    recompiles = engine.steady_state_recompiles()
+    record["steady_state_recompiles"] = recompiles
+    import json as _json
+
+    print(_json.dumps(record))
+    if args.run_dir:
+        write_serving_record(args.run_dir, record)
+    batcher.close()
+
+
+if __name__ == "__main__":
+    main()

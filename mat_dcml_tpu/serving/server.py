@@ -1,0 +1,206 @@
+"""Minimal serving frontend: in-process client + stdlib JSON-over-HTTP.
+
+``PolicyClient`` is the canonical interface (tests, loadgen, and co-located
+schedulers use it directly — no serialization, no sockets).  ``PolicyServer``
+wraps the same batcher in a ``ThreadingHTTPServer`` JSON endpoint for
+out-of-process callers; intentionally stdlib-only (no new dependencies):
+
+- ``POST /v1/act``   {"state": [[..]], "obs": [[..]], "available_actions":
+  [[..]]?, "timeout_s": float?} -> {"action": [[..]], "log_prob": [[..]]}
+- ``GET /healthz``   liveness + warmup state
+- ``GET /stats``     telemetry counter/gauge snapshot (queue depth, shed
+  counts, bucket occupancy, recompiles)
+
+Typed rejections map onto HTTP: queue-full -> 429, deadline -> 504, engine
+failure -> 500, malformed request -> 400.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional, Tuple
+
+import numpy as np
+
+from mat_dcml_tpu.serving.batcher import (
+    BatcherConfig,
+    ContinuousBatcher,
+    DeadlineExceededError,
+    QueueFullError,
+    ServingError,
+)
+from mat_dcml_tpu.serving.engine import DecodeEngine, EngineConfig
+
+
+class PolicyClient:
+    """In-process client: one joint observation in, one joint action out."""
+
+    def __init__(self, batcher: ContinuousBatcher):
+        self.batcher = batcher
+
+    def act(
+        self,
+        state,
+        obs,
+        available_actions=None,
+        timeout_s: Optional[float] = None,
+    ) -> Tuple[np.ndarray, np.ndarray]:
+        """Blocking request -> ``(action, log_prob)``; raises the batcher's
+        typed :class:`ServingError` subclasses on shed/deadline/failure."""
+        fut = self.batcher.submit(state, obs, available_actions, timeout_s)
+        # the batcher enforces the deadline; the client-side wait gets slack
+        # on top so the typed DeadlineExceededError (not a bare concurrent
+        # .futures timeout) is what surfaces
+        wait = None if timeout_s is None else timeout_s + 5.0
+        return fut.result(timeout=wait)
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "mat-dcml-serving/1"
+
+    def log_message(self, fmt, *args):   # route through the server's logger
+        self.server.log_fn("[serving] " + fmt % args)
+
+    def _reply(self, code: int, payload: dict) -> None:
+        body = json.dumps(payload).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):
+        srv: "PolicyServer" = self.server.policy_server
+        if self.path == "/healthz":
+            self._reply(200, {"ok": True, "warm": srv.warm,
+                              "buckets": list(srv.engine.engine_cfg.buckets)})
+        elif self.path == "/stats":
+            tel = srv.engine.telemetry
+            self._reply(200, {"counters": dict(tel.counters),
+                              "gauges": dict(tel._gauges)})
+        else:
+            self._reply(404, {"error": f"no route {self.path}"})
+
+    def do_POST(self):
+        srv: "PolicyServer" = self.server.policy_server
+        if self.path != "/v1/act":
+            self._reply(404, {"error": f"no route {self.path}"})
+            return
+        try:
+            length = int(self.headers.get("Content-Length", "0"))
+            req = json.loads(self.rfile.read(length))
+            state = np.asarray(req["state"], np.float32)
+            obs = np.asarray(req["obs"], np.float32)
+            avail = req.get("available_actions")
+            avail = None if avail is None else np.asarray(avail, np.float32)
+            timeout_s = req.get("timeout_s")
+        except (KeyError, ValueError, json.JSONDecodeError) as e:
+            self._reply(400, {"error": f"malformed request: {e!r}"})
+            return
+        try:
+            action, log_prob = srv.client.act(state, obs, avail, timeout_s)
+        except QueueFullError as e:
+            self._reply(429, {"error": str(e), "kind": "queue_full"})
+        except DeadlineExceededError as e:
+            self._reply(504, {"error": str(e), "kind": "deadline_exceeded"})
+        except ValueError as e:
+            self._reply(400, {"error": str(e), "kind": "bad_shape"})
+        except Exception as e:  # ServingError + engine failures
+            self._reply(500, {"error": repr(e), "kind": "engine_failure"})
+        else:
+            self._reply(200, {"action": action.tolist(),
+                              "log_prob": log_prob.tolist()})
+
+
+class PolicyServer:
+    """HTTP frontend over (engine, batcher).  ``start()`` binds and serves on
+    a background thread; ``port=0`` picks a free port (tests)."""
+
+    def __init__(
+        self,
+        engine: DecodeEngine,
+        batcher_cfg: BatcherConfig = BatcherConfig(),
+        host: str = "127.0.0.1",
+        port: int = 8420,
+        log_fn=print,
+    ):
+        self.engine = engine
+        self.batcher = ContinuousBatcher(engine, batcher_cfg, log_fn=log_fn)
+        self.client = PolicyClient(self.batcher)
+        self.log_fn = log_fn
+        self.warm = False
+        self._httpd = ThreadingHTTPServer((host, port), _Handler)
+        self._httpd.policy_server = self
+        self._httpd.log_fn = log_fn
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    def warmup(self) -> None:
+        self.engine.warmup()
+        self.warm = True
+
+    def start(self) -> None:
+        if not self.warm:
+            self.warmup()
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="serving-http", daemon=True
+        )
+        self._thread.start()
+        self.log_fn(f"[serving] listening on http://{self._httpd.server_address[0]}"
+                    f":{self.port} (buckets {self.engine.engine_cfg.buckets})")
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+        self.batcher.close()
+
+
+def main(argv=None) -> None:
+    """CLI: serve a weights-only export.
+
+    Usage: python -m mat_dcml_tpu.serving.server --policy_dir <export>
+           [--port 8420] [--buckets 1,8,32,128] [--max_batch_wait_ms 2.0]
+           [--max_queue 256] [--decode_mode scan|stride]
+    """
+    import argparse
+
+    p = argparse.ArgumentParser(description="MAT policy server")
+    p.add_argument("--policy_dir", required=True,
+                   help="export dir from scripts/export_policy.py")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=8420)
+    p.add_argument("--buckets", default="1,8,32,128")
+    p.add_argument("--max_batch_wait_ms", type=float, default=2.0)
+    p.add_argument("--max_queue", type=int, default=256)
+    p.add_argument("--decode_mode", default="scan", choices=("scan", "stride"))
+    args = p.parse_args(argv)
+
+    engine = DecodeEngine.from_export(
+        args.policy_dir,
+        EngineConfig(
+            buckets=tuple(int(b) for b in args.buckets.split(",")),
+            decode_mode=args.decode_mode,
+        ),
+    )
+    server = PolicyServer(
+        engine,
+        BatcherConfig(max_queue=args.max_queue,
+                      max_batch_wait_ms=args.max_batch_wait_ms),
+        host=args.host, port=args.port,
+    )
+    server.start()
+    try:
+        threading.Event().wait()
+    except KeyboardInterrupt:
+        server.stop()
+
+
+if __name__ == "__main__":
+    main()

@@ -251,9 +251,9 @@ class BaseRunner:
         restored = mgr.restore(template=train_state)
         if restored is None:
             raise FileNotFoundError(f"no checkpoint under {self.run_cfg.model_dir}")
-        self._restored_step = mgr.latest_step or 0
+        self._restored_step = mgr.latest_step() or 0
         kind = "params" if params_only else "full state"
-        self.log(f"restored checkpoint step {mgr.latest_step} ({kind}) "
+        self.log(f"restored checkpoint step {mgr.latest_step()} ({kind}) "
                  f"from {self.run_cfg.model_dir}")
         if params_only:
             return train_state._replace(params=restored.params)
@@ -291,6 +291,9 @@ class BaseRunner:
             # a tripwire profiler window still open at exit — normal return OR
             # a crash mid-run — must stop its trace or the xplane.pb is corrupt
             self.profile_window.close()
+            # saves are async (checkpoint.py): the loop's last scheduled save
+            # must land before the run dir is read (resume, serving export)
+            self.ckpt.finish()
 
     def _train_loop_episodic(self, episodes, train_state, rollout_state, key):
         """K=1 loop: two dispatches (collect, train) per episode."""

@@ -4,15 +4,34 @@ The reference only saves model weights (``transformer_policy.py:243-248``) —
 optimizer and ValueNorm state are lost, so "resume" is weight reload only
 (SURVEY.md §5).  Here the whole ``TrainState`` (params, optimizer moments,
 ValueNorm statistics, update counter) round-trips, giving true resume.
+
+Two additions for the serving stack (serving/):
+
+- **async saves**: ``save(..., blocking=False)`` (the default) schedules the
+  write and returns — the training loop no longer stalls on checkpoint I/O
+  every ``save_interval``.  The previous in-flight save is finalized at the
+  *next* save (by which time it has long completed) and in :meth:`close`,
+  which the runner's exit path and tests call to guarantee durability.
+- **weights-only export**: :func:`export_policy` / :func:`load_policy` write
+  just the params subtree plus a JSON manifest (MATConfig fields + obs/act
+  space metadata), so a server restores a policy without ever deserializing
+  optimizer moments or ValueNorm state — and without importing any trainer.
 """
 
 from __future__ import annotations
 
+import dataclasses
+import json
 from pathlib import Path
-from typing import Optional
+from typing import Any, Dict, Optional, Tuple
 
 import jax
 import orbax.checkpoint as ocp
+
+from mat_dcml_tpu.models.mat import MATConfig
+
+POLICY_MANIFEST = "policy_manifest.json"
+_PARAMS_SUBDIR = "params"
 
 
 class CheckpointManager:
@@ -24,11 +43,22 @@ class CheckpointManager:
             options=ocp.CheckpointManagerOptions(max_to_keep=max_to_keep, create=True),
         )
 
-    def save(self, step: int, train_state) -> None:
+    def save(self, step: int, train_state, blocking: bool = False) -> None:
+        """Checkpoint ``train_state`` at ``step``.
+
+        ``blocking=False`` (default) returns as soon as the save is scheduled;
+        the device->host copy and write happen off-thread (orbax async). The
+        previous save is finalized here first, so at most one save is ever in
+        flight and the wait is ~free in steady state.  ``blocking=True``
+        restores the old synchronous behavior (used right before reads).
+        """
+        self.manager.wait_until_finished()   # finalize any in-flight save
         self.manager.save(step, args=ocp.args.StandardSave(train_state))
-        self.manager.wait_until_finished()
+        if blocking:
+            self.manager.wait_until_finished()
 
     def restore(self, step: Optional[int] = None, template=None):
+        self.manager.wait_until_finished()   # a just-scheduled save must land
         step = step if step is not None else self.manager.latest_step()
         if step is None:
             return None
@@ -36,6 +66,73 @@ class CheckpointManager:
             return self.manager.restore(step, args=ocp.args.StandardRestore(template))
         return self.manager.restore(step)
 
-    @property
     def latest_step(self) -> Optional[int]:
+        """Most recent finalized checkpoint step (None when empty) — the
+        serving loader polls this to pick up fresh exports."""
         return self.manager.latest_step()
+
+    def finish(self) -> None:
+        """Finalize any in-flight async save (manager stays usable)."""
+        self.manager.wait_until_finished()
+
+    def close(self) -> None:
+        """Finalize any in-flight async save and release the manager."""
+        self.finish()
+        self.manager.close()
+
+
+# ---------------------------------------------------------------------------
+# Weights-only policy export (the serving artifact)
+# ---------------------------------------------------------------------------
+
+def export_policy(
+    directory: str | Path,
+    params,
+    mat_config: MATConfig,
+    space_meta: Optional[Dict[str, Any]] = None,
+) -> Path:
+    """Write a self-contained serving artifact: params + policy manifest.
+
+    The manifest carries every MATConfig field (round-tripped verbatim by
+    :func:`load_policy`) plus free-form ``space_meta`` (env name, obs/act
+    space dims/bounds) so a server can validate request shapes without
+    importing the env.  No optimizer or ValueNorm state is written.
+    """
+    directory = Path(directory).absolute()
+    directory.mkdir(parents=True, exist_ok=True)
+    ckptr = ocp.StandardCheckpointer()
+    ckptr.save(directory / _PARAMS_SUBDIR, params, force=True)
+    ckptr.wait_until_finished()
+    manifest = {
+        "format": "mat_dcml_tpu/policy/v1",
+        "mat_config": dataclasses.asdict(mat_config),
+        "space_meta": space_meta or {},
+    }
+    (directory / POLICY_MANIFEST).write_text(json.dumps(manifest, indent=2))
+    return directory
+
+
+def load_policy(directory: str | Path) -> Tuple[Any, MATConfig, Dict[str, Any]]:
+    """Restore ``(params, MATConfig, space_meta)`` from an export directory.
+
+    The params template comes from re-initializing the model off the
+    manifest's MATConfig — structure and dtypes are therefore guaranteed to
+    match what the serving forward expects, independent of who exported.
+    """
+    directory = Path(directory).absolute()
+    manifest_path = directory / POLICY_MANIFEST
+    if not manifest_path.exists():
+        raise FileNotFoundError(f"no {POLICY_MANIFEST} under {directory}")
+    manifest = json.loads(manifest_path.read_text())
+    if manifest.get("format") != "mat_dcml_tpu/policy/v1":
+        raise ValueError(f"unrecognized policy export format: {manifest.get('format')!r}")
+    cfg = MATConfig(**manifest["mat_config"])
+    # template init on the abstract-eval path only (no real compute/compile)
+    from mat_dcml_tpu.models.policy import TransformerPolicy
+
+    template = jax.eval_shape(
+        lambda: TransformerPolicy(cfg).init_params(jax.random.key(0))
+    )
+    ckptr = ocp.StandardCheckpointer()
+    params = ckptr.restore(directory / _PARAMS_SUBDIR, target=template)
+    return params, cfg, manifest.get("space_meta", {})

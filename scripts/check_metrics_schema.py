@@ -68,6 +68,11 @@ KNOWN_PREFIXES = (
     "compile_count_",
     "step_time_",
     "anomalies_",           # per-kind trip counters (anomalies_<kind>)
+    # serving records (serving/loadgen.py run_load + the batcher/engine
+    # telemetry that rides along): QPS, latency percentiles, shed/deadline
+    # rates, queue depth, per-bucket occupancy (serving_bucket_<B>), batch
+    # fill, engine timings — all serving_<field>
+    "serving_",
 )
 
 # fields that must never go negative (counters, rates, timers, gauges)
@@ -81,6 +86,14 @@ NON_NEGATIVE = (
     "bytes_per_update", "bytes_per_collect", "bytes_per_dispatch",
     "iters_per_dispatch", "dispatch_count", "dispatches_per_sec",
     "profile_dispatch_sec",
+)
+
+# a serving record (identified by serving_qps) must carry the benchmark
+# contract BENCHLOG consumes: throughput, latency percentiles, shed rate
+REQUIRED_SERVING = (
+    "serving_qps", "serving_ok", "serving_wall_s",
+    "serving_p50_ms", "serving_p95_ms", "serving_p99_ms",
+    "serving_shed_rate", "serving_deadline_miss_rate", "serving_error_rate",
 )
 
 # a training record (vs eval/profile records, which are sparse) must have:
@@ -179,11 +192,15 @@ def validate_record(record, index: int = 0, strict_names: bool = True) -> List[s
         if not math.isfinite(v):
             errs.append(f"{where}: field {k!r} is non-finite ({v})")
             continue
-        if k in NON_NEGATIVE and v < 0:
+        if (k in NON_NEGATIVE or k.startswith("serving_")) and v < 0:
             errs.append(f"{where}: field {k!r} is negative ({v})")
         if strict_names and not _known(k):
             errs.append(f"{where}: unknown field {k!r} — document it in "
                         f"README.md and scripts/check_metrics_schema.py")
+    if "serving_qps" in record:  # serving benchmark record
+        for k in REQUIRED_SERVING:
+            if k not in record:
+                errs.append(f"{where}: serving record missing {k!r}")
     if "fps" in record:  # training record: enforce the full contract
         fused = record.get("iters_per_dispatch", 1) > 1
         for k in REQUIRED_CORE:

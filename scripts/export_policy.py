@@ -1,0 +1,79 @@
+#!/usr/bin/env python
+"""Export a training checkpoint as a weights-only serving artifact.
+
+Restores the latest (or ``--step``) full TrainState from a run's checkpoint
+directory and writes just the policy params + MATConfig + space metadata via
+``training/checkpoint.export_policy`` — the input ``serving/server.py`` and
+``serving/loadgen.py`` consume.  A server restoring this artifact never
+deserializes optimizer moments or ValueNorm state.
+
+Usage:
+  python scripts/export_policy.py --model_dir results/DCML/AS/mat/check/models \
+      --out exports/dcml_as_mat [--step N] [model flags matching the run, e.g.
+      --n_block 2 --n_embd 64 --n_head 2 --algorithm_name mat]
+
+Model flags must match the training run (they size the params template); a
+mismatch fails loudly at restore time with a tree-structure error.
+"""
+
+import argparse
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from mat_dcml_tpu.utils.platform import apply_platform_override
+
+apply_platform_override()
+
+import jax  # noqa: E402
+
+from mat_dcml_tpu.config import parse_cli_with_extras  # noqa: E402
+from mat_dcml_tpu.envs.dcml import DCMLEnv, DCMLEnvConfig  # noqa: E402
+from mat_dcml_tpu.training.checkpoint import CheckpointManager, export_policy  # noqa: E402
+from mat_dcml_tpu.training.ppo import MATTrainer  # noqa: E402
+from mat_dcml_tpu.training.runner import build_mat_policy  # noqa: E402
+
+
+def main(argv=None) -> int:
+    extras = argparse.ArgumentParser(add_help=False)
+    extras.add_argument("--out", required=True, help="export directory")
+    extras.add_argument("--step", type=int, default=None,
+                        help="checkpoint step (default: latest)")
+    extras.add_argument("--data_dir", default="data")
+    run, ppo, ns = parse_cli_with_extras(argv, extras=extras)
+    if not run.model_dir:
+        print("--model_dir is required (the run's models/ directory)",
+              file=sys.stderr)
+        return 2
+
+    env = DCMLEnv(DCMLEnvConfig(), data_dir=ns.data_dir)
+    policy = build_mat_policy(run, env)
+    trainer = MATTrainer(policy, ppo, total_updates=run.episodes)
+    template = jax.eval_shape(
+        lambda: trainer.init_state(policy.init_params(jax.random.key(0)))
+    )
+    mgr = CheckpointManager(run.model_dir)
+    step = ns.step if ns.step is not None else mgr.latest_step()
+    if step is None:
+        print(f"no checkpoint under {run.model_dir}", file=sys.stderr)
+        return 1
+    state = mgr.restore(step, template=template)
+    space_meta = {
+        "env_name": run.env_name,
+        "scenario": run.scenario,
+        "algorithm_name": run.algorithm_name,
+        "n_agents": env.n_agents,
+        "obs_dim": env.obs_dim,
+        "share_obs_dim": env.share_obs_dim,
+        "action_dim": env.action_dim,
+        "checkpoint_step": int(step),
+    }
+    out = export_policy(ns.out, state.params, policy.cfg, space_meta)
+    n_params = sum(x.size for x in jax.tree.leaves(state.params))
+    print(f"exported step {step} ({n_params} params) -> {out}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv[1:]))

@@ -1,0 +1,190 @@
+"""Checkpoint round-trips the serving stack depends on (fast tier).
+
+Three contracts:
+
+- **async save**: ``CheckpointManager.save`` no longer blocks the caller; the
+  scheduled write is finalized by ``finish()``/``close()``/the next save, and
+  a restore after finalization is bit-exact — the full TrainState (params,
+  optimizer moments, ValueNorm stats, step counter) resumes losslessly.
+- **resume equivalence**: training N iterations straight equals training,
+  checkpointing mid-way, restoring into a fresh template, and finishing —
+  bit-exact params, pinned on a tiny DCML instance.
+- **weights-only export**: ``export_policy`` -> ``load_policy`` round-trips
+  params + MATConfig and yields *identical* deterministic actions through the
+  shared ``decode.serve_decode`` seam — the artifact a server loads acts
+  exactly like the training policy.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mat_dcml_tpu.config import RunConfig
+from mat_dcml_tpu.envs.dcml import DCMLEnv, DCMLEnvConfig
+from mat_dcml_tpu.envs.dcml.constants import DCMLConsts
+from mat_dcml_tpu.models import decode as decode_lib
+from mat_dcml_tpu.models.mat import MATConfig, SEMI_DISCRETE
+from mat_dcml_tpu.models.policy import TransformerPolicy
+from mat_dcml_tpu.training.checkpoint import (
+    CheckpointManager,
+    export_policy,
+    load_policy,
+)
+from mat_dcml_tpu.training.ppo import MATTrainer, PPOConfig
+from mat_dcml_tpu.training.rollout import RolloutCollector
+from mat_dcml_tpu.training.runner import build_mat_policy
+
+W = 6   # tiny DCML: 6 workers + master
+E = 2
+T = 4
+
+
+def tiny_env() -> DCMLEnv:
+    consts = DCMLConsts(worker_number_max=W, sob_dim=W + 2)
+    rng = np.random.default_rng(0)
+    workloads = rng.integers(0, 5, size=(W, consts.local_workload_period)).astype(
+        np.float32
+    )
+    return DCMLEnv(DCMLEnvConfig(consts=consts), base_workloads=workloads)
+
+
+def tiny_components():
+    run = RunConfig(
+        n_rollout_threads=E, episode_length=T, n_embd=16, n_head=2, n_block=1
+    )
+    env = tiny_env()
+    policy = build_mat_policy(run, env)
+    trainer = MATTrainer(policy, PPOConfig(ppo_epoch=2, num_mini_batch=1))
+    collector = RolloutCollector(env, policy, T)
+    return run, env, policy, trainer, collector
+
+
+def tree_equal(a, b) -> bool:
+    return bool(
+        jax.tree.all(
+            jax.tree.map(lambda x, y: bool(jnp.array_equal(x, y)), a, b)
+        )
+    )
+
+
+def test_async_save_roundtrip_bitexact(tmp_path):
+    _, env, policy, trainer, _ = tiny_components()
+    params = policy.init_params(jax.random.key(0))
+    state = trainer.init_state(params)
+
+    mgr = CheckpointManager(tmp_path / "models")
+    mgr.save(3, state)                      # async: returns immediately
+    mgr.finish()                            # finalize the in-flight write
+    assert mgr.latest_step() == 3
+
+    template = jax.eval_shape(lambda: trainer.init_state(policy.init_params(jax.random.key(0))))
+    restored = CheckpointManager(tmp_path / "models").restore(template=template)
+    assert tree_equal(state, restored)
+    mgr.close()
+
+
+def test_next_save_finalizes_previous(tmp_path):
+    """Two back-to-back async saves: the second finalizes the first, and
+    both steps are restorable without an explicit finish()."""
+    _, env, policy, trainer, _ = tiny_components()
+    state = trainer.init_state(policy.init_params(jax.random.key(1)))
+    bumped = state._replace(update_step=state.update_step + 7)
+
+    mgr = CheckpointManager(tmp_path / "models")
+    mgr.save(0, state)
+    mgr.save(1, bumped)                     # finalizes save(0) on entry
+    # restore() finalizes the still-in-flight save(1) before reading
+    restored = mgr.restore()                # latest, template-free
+    assert int(np.asarray(restored["update_step"])) == 7
+    assert mgr.latest_step() == 1
+    mgr.close()
+
+
+def test_resume_equivalence_through_training(tmp_path):
+    """Train 2 iterations; checkpoint; restore into a fresh template; train 1
+    more on both sides -> bit-exact params/opt-state/ValueNorm (the full-state
+    resume the serving export path branches off of)."""
+    run, env, policy, trainer, collector = tiny_components()
+    collect = jax.jit(collector.collect)
+    train = jax.jit(trainer.train)
+
+    params = policy.init_params(jax.random.key(0))
+    state = trainer.init_state(params)
+    rs = collector.init_state(jax.random.key(1), E)
+
+    key = jax.random.key(2)
+    for _ in range(2):
+        rs, traj = collect(state.params, rs)
+        key, k = jax.random.split(key)
+        state, _ = train(state, traj, rs, k)
+
+    mgr = CheckpointManager(tmp_path / "models")
+    mgr.save(1, state, blocking=True)
+
+    template = jax.eval_shape(lambda: trainer.init_state(policy.init_params(jax.random.key(0))))
+    restored = CheckpointManager(tmp_path / "models").restore(template=template)
+    assert tree_equal(state, restored)
+
+    # continue one iteration from each; identical inputs -> identical outputs
+    rs2, traj = collect(state.params, rs)
+    key, k = jax.random.split(key)
+    cont, m1 = train(state, traj, rs2, k)
+    rcont, m2 = train(restored, traj, rs2, k)
+    assert tree_equal(cont.params, rcont.params)
+    assert tree_equal(cont.value_norm, rcont.value_norm)
+    assert float(np.asarray(m1.value_loss)) == float(np.asarray(m2.value_loss))
+    mgr.close()
+
+
+def test_export_load_policy_identical_actions(tmp_path):
+    """export_policy -> load_policy -> the served policy's deterministic
+    actions are bit-exact to the exporting policy's, through the shared
+    serve_decode seam (tiny DCML config)."""
+    run, env, policy, trainer, _ = tiny_components()
+    params = policy.init_params(jax.random.key(3))
+    cfg = policy.cfg
+
+    space_meta = {"env_name": "DCML", "n_agents": env.n_agents,
+                  "action_dim": env.action_dim}
+    out = export_policy(tmp_path / "export", params, cfg, space_meta)
+    params2, cfg2, meta2 = load_policy(out)
+
+    assert cfg2 == cfg                       # MATConfig round-trip, verbatim
+    assert isinstance(cfg2, MATConfig) and dataclasses.asdict(cfg2) == dataclasses.asdict(cfg)
+    assert meta2 == space_meta
+    assert tree_equal(params, params2)
+
+    rng = np.random.default_rng(5)
+    B = 3
+    state = jnp.asarray(rng.normal(size=(B, cfg.n_agent, cfg.state_dim)), jnp.float32)
+    obs = jnp.asarray(rng.normal(size=(B, cfg.n_agent, cfg.obs_dim)), jnp.float32)
+    ava = jnp.ones((B, cfg.n_agent, cfg.action_dim), jnp.float32)
+
+    _, r1 = decode_lib.serve_decode(cfg, params, jax.random.key(0), state, obs, ava)
+    _, r2 = decode_lib.serve_decode(cfg2, params2, jax.random.key(0), state, obs, ava)
+    assert np.array_equal(np.asarray(r1.action), np.asarray(r2.action))
+    assert np.array_equal(np.asarray(r1.log_prob), np.asarray(r2.log_prob))
+
+
+def test_load_policy_rejects_bad_dir(tmp_path):
+    with pytest.raises(FileNotFoundError):
+        load_policy(tmp_path / "nope")
+
+
+def test_export_for_nonstandard_config_roundtrip(tmp_path):
+    """Every MATConfig field must survive the JSON round-trip, including the
+    non-default ones serving relies on (semi_index, dtype, n_objective)."""
+    cfg = MATConfig(
+        n_agent=4, obs_dim=3, state_dim=5, action_dim=2, n_block=1, n_embd=8,
+        n_head=2, action_type=SEMI_DISCRETE, semi_index=-1, n_objective=2,
+        dtype="bfloat16",
+    )
+    pol = TransformerPolicy(cfg)
+    params = pol.init_params(jax.random.key(0))
+    export_policy(tmp_path / "e", params, cfg)
+    _, cfg2, meta = load_policy(tmp_path / "e")
+    assert cfg2 == cfg
+    assert meta == {}

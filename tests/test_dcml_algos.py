@@ -101,7 +101,7 @@ def test_resume_continues_episode_counter(tmp_path):
     cfg = run_cfg(tmp_path, "mat", num_env_steps=E * T * 4)
     runner = DCMLRunner(cfg, PPO, env=small_env(), log_fn=lambda *a: None)
     state, rs = runner.train_loop(num_episodes=3)
-    assert runner.ckpt.latest_step == 2
+    assert runner.ckpt.latest_step() == 2
 
     cfg2 = run_cfg(
         tmp_path, "mat", num_env_steps=E * T * 4,

@@ -45,7 +45,7 @@ def test_training_improves_reward(tmp_path, algo, iters, min_gain):
 def test_runner_restore_resume(tmp_path):
     runner, run = _make_runner(tmp_path, algo="mat")
     runner.train_loop(num_episodes=11)
-    assert runner.ckpt.latest_step == 10
+    assert runner.ckpt.latest_step() == 10
     model_dir = str(runner.run_dir / "models")
 
     # fresh runner restoring from the checkpoint continues the episode counter
