@@ -59,6 +59,18 @@ Knobs (environment variables):
                         BENCH_SERVING_BUCKETS (1,4,16),
                         BENCH_SERVING_RUN_DIR (append the serving records to
                         <dir>/metrics.jsonl)
+  BENCH_FLEET           "1" → replicated-fleet leg: closed-loop QPS at each
+                        replica count in BENCH_FLEET_REPLICAS (1,2,4), then a
+                        live canary-gated weight push under open-loop load on
+                        the largest fleet, reporting p50 + goodput-under-SLO
+                        during the push and the push's dropped-request count
+                        (contract: 0).  Record value = QPS at max replicas,
+                        vs_baseline = scaling vs 1 replica.  Knobs:
+                        BENCH_FLEET_REQUESTS (512), BENCH_FLEET_CONCURRENCY
+                        (16), BENCH_FLEET_BUCKETS (1,4,16),
+                        BENCH_FLEET_REPLICAS (1,2,4), BENCH_FLEET_SLO_MS (50),
+                        BENCH_FLEET_RUN_DIR (append records to
+                        <dir>/metrics.jsonl)
 
 On device OOM the bench walks a backoff ladder before shrinking the batch:
 remat on -> accumulation x2 (up to 8) -> halve E — big batches get memory
@@ -769,6 +781,137 @@ def _measure_serving(jax) -> None:
     print(json.dumps(record), flush=True)
 
 
+def _measure_fleet(jax) -> None:
+    """BENCH_FLEET=1 leg: replica scaling + hot weight push under live load.
+
+    Phase A sweeps BENCH_FLEET_REPLICAS with a closed-loop load at each fleet
+    size — on one CPU host the replicas share physical cores, so the measured
+    curve reports contention honestly rather than asserting linear scaling.
+    Phase B runs the largest fleet under an *open-loop* offered load at ~70%
+    of its measured capacity, pushes the same params mid-run through the full
+    canary gate, and reports p50/goodput-under-SLO for the requests that
+    overlapped the push plus the push report's dropped count (contract: 0)
+    and post-warm recompile count (contract: 0)."""
+    import threading as _threading
+
+    from mat_dcml_tpu.config import RunConfig
+    from mat_dcml_tpu.envs.dcml import DCMLEnv, DCMLEnvConfig
+    from mat_dcml_tpu.serving.batcher import BatcherConfig
+    from mat_dcml_tpu.serving.engine import EngineConfig
+    from mat_dcml_tpu.serving.fleet import EngineFleet, FleetConfig
+    from mat_dcml_tpu.serving.loadgen import run_load, write_serving_record
+    from mat_dcml_tpu.serving.rollout_ctl import RolloutConfig
+    from mat_dcml_tpu.serving.server import PolicyClient
+    from mat_dcml_tpu.training.runner import build_mat_policy
+
+    data_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)), "data")
+    env = DCMLEnv(DCMLEnvConfig(), data_dir=data_dir)
+    policy = build_mat_policy(RunConfig(), env)
+    params = policy.init_params(jax.random.key(0))
+
+    n_req = int(os.environ.get("BENCH_FLEET_REQUESTS", "512"))
+    conc = int(os.environ.get("BENCH_FLEET_CONCURRENCY", "16"))
+    buckets = tuple(
+        int(b) for b in os.environ.get("BENCH_FLEET_BUCKETS", "1,4,16").split(",")
+    )
+    replica_counts = [
+        int(r) for r in os.environ.get("BENCH_FLEET_REPLICAS", "1,2,4").split(",")
+    ]
+    slo_ms = float(os.environ.get("BENCH_FLEET_SLO_MS", "50"))
+    run_dir = os.environ.get("BENCH_FLEET_RUN_DIR", "")
+
+    def make_fleet(n: int) -> EngineFleet:
+        fleet = EngineFleet(
+            params, policy.cfg,
+            fleet_cfg=FleetConfig(n_replicas=n),
+            engine_cfg=EngineConfig(buckets=buckets),
+            batcher_cfg=BatcherConfig(max_batch_wait_ms=2.0),
+            rollout_cfg=RolloutConfig(canary_comparisons=8,
+                                      canary_timeout_s=120.0),
+            log_fn=log,
+        )
+        t0 = time.perf_counter()
+        fleet.warmup()
+        log(f"fleet[{n}]: {n}x{len(buckets)} bucket programs warm in "
+            f"{time.perf_counter() - t0:.1f}s")
+        return fleet
+
+    # ---- phase A: replica scaling (closed loop = max sustainable QPS)
+    scaling = {}
+    for n in replica_counts:
+        fleet = make_fleet(n)
+        rec = run_load(PolicyClient(fleet), n_requests=n_req, concurrency=conc)
+        rec["steady_state_recompiles"] = fleet.steady_state_recompiles()
+        rec.update(fleet.fleet_record())
+        fleet.close()
+        scaling[n] = rec
+        log(f"fleet[{n}]: {rec['serving_qps']:.1f} req/s, "
+            f"p50 {rec['serving_p50_ms']:.1f} ms, "
+            f"recompiles {rec['steady_state_recompiles']:.0f}")
+        if run_dir:
+            write_serving_record(run_dir, rec)
+
+    # ---- phase B: hot weight push under live open-loop load
+    n_max = max(replica_counts)
+    fleet = make_fleet(max(n_max, 2))   # the gate needs an incumbent
+    capacity = scaling[n_max]["serving_qps"]
+    offered = max(capacity * 0.7, 1.0)
+    push_report = {}
+    load_rec = {}
+
+    def _drive_load():
+        load_rec.update(run_load(
+            PolicyClient(fleet), n_requests=n_req, target_qps=offered,
+            slo_ms=slo_ms, n_clients=4,
+        ))
+
+    loader = _threading.Thread(target=_drive_load)
+    loader.start()
+    time.sleep(0.5)                     # let the load reach steady state
+    t0 = time.perf_counter()
+    push_report = fleet.push(params)    # same params: gate must promote
+    push_wall = time.perf_counter() - t0
+    loader.join()
+    recompiles = fleet.steady_state_recompiles()
+    load_rec["steady_state_recompiles"] = recompiles
+    load_rec.update(fleet.fleet_record())
+    fleet.close()
+    log(f"fleet push under load: status {push_report['status']}, "
+        f"{push_report['push_dropped']:.0f} dropped, {push_wall:.1f}s wall, "
+        f"goodput {load_rec.get('serving_goodput_slo', 0.0):.3f} @ "
+        f"SLO {slo_ms:.0f}ms, recompiles {recompiles:.0f}")
+    if run_dir:
+        write_serving_record(run_dir, load_rec)
+
+    dev = jax.devices()[0]
+    base_qps = scaling[replica_counts[0]]["serving_qps"]
+    record = {
+        "metric": "dcml_mat_fleet_qps",
+        "value": round(scaling[n_max]["serving_qps"], 2),
+        "unit": "req/s",
+        # scaling vs the 1-replica fleet: the honest replication curve (CPU
+        # replicas share cores; device-per-replica hosts approach linear)
+        "vs_baseline": round(scaling[n_max]["serving_qps"] / max(base_qps, 1e-9), 2),
+        "platform": dev.platform,
+        "device": dev.device_kind,
+        "provisional": False,
+        "buckets": ",".join(str(b) for b in buckets),
+        "requests": n_req,
+        "concurrency": conc,
+        "slo_ms": slo_ms,
+        "push_status": push_report["status"],
+        "push_dropped": float(push_report["push_dropped"]),
+        "push_wall_s": round(push_wall, 2),
+        "push_p50_ms": round(load_rec["serving_p50_ms"], 2),
+        "push_goodput_slo": round(load_rec.get("serving_goodput_slo", 0.0), 4),
+        "steady_state_recompiles": recompiles,
+    }
+    for n in replica_counts:
+        record[f"r{n}_qps"] = round(scaling[n]["serving_qps"], 2)
+        record[f"r{n}_p50_ms"] = round(scaling[n]["serving_p50_ms"], 2)
+    print(json.dumps(record), flush=True)
+
+
 def _is_oom(e: Exception) -> bool:
     s = f"{type(e).__name__}: {e}"
     return "RESOURCE_EXHAUSTED" in s or "Out of memory" in s or "out of memory" in s
@@ -959,6 +1102,12 @@ def main() -> None:
     if os.environ.get("BENCH_SERVING", "0") == "1":
         jax, _ = _setup_jax()
         _measure_serving(jax)
+        return
+
+    # Replicated-fleet leg: replica scaling + hot weight push under load
+    if os.environ.get("BENCH_FLEET", "0") == "1":
+        jax, _ = _setup_jax()
+        _measure_fleet(jax)
         return
 
     # Orchestrated (deadline-aware) unless the caller manages the chip

@@ -6,7 +6,10 @@ the request path), ``batcher.py`` packs concurrent requests into those
 buckets, ``server.py`` fronts it with a stdlib JSON endpoint plus an
 in-process client, and ``loadgen.py`` measures the whole stack (QPS,
 latency percentiles, shed rate, bucket occupancy) through the telemetry
-registry.  No dependencies beyond the training stack itself.
+registry.  ``fleet.py`` replicates the engine+batcher pair behind a
+load-aware router with fault tolerance and canary-gated hot weight pushes
+(``rollout_ctl.py`` owns the gate and the export-watching pusher).  No
+dependencies beyond the training stack itself.
 """
 
 from mat_dcml_tpu.serving.batcher import (
@@ -18,6 +21,12 @@ from mat_dcml_tpu.serving.batcher import (
     ServingError,
 )
 from mat_dcml_tpu.serving.engine import DecodeEngine, EngineConfig
+from mat_dcml_tpu.serving.fleet import EngineFleet, FleetConfig, FleetUnavailableError
+from mat_dcml_tpu.serving.rollout_ctl import (
+    RolloutConfig,
+    RolloutController,
+    WeightPusher,
+)
 from mat_dcml_tpu.serving.server import PolicyClient, PolicyServer
 
 __all__ = [
@@ -27,8 +36,14 @@ __all__ = [
     "DecodeEngine",
     "EngineConfig",
     "EngineFailureError",
+    "EngineFleet",
+    "FleetConfig",
+    "FleetUnavailableError",
     "PolicyClient",
     "PolicyServer",
     "QueueFullError",
+    "RolloutConfig",
+    "RolloutController",
     "ServingError",
+    "WeightPusher",
 ]

@@ -44,7 +44,15 @@ class ServingError(Exception):
 
 
 class QueueFullError(ServingError):
-    """Admission control: the bounded request queue is at capacity."""
+    """Admission control: the bounded request queue is at capacity.
+
+    Carries ``retry_after_s`` — the shed response's ``Retry-After`` hint,
+    derived from the current queue depth and the EMA per-request service
+    time at shed time."""
+
+    def __init__(self, msg: str = "queue full", retry_after_s: int = 1):
+        super().__init__(msg)
+        self.retry_after_s = int(retry_after_s)
 
 
 class DeadlineExceededError(ServingError):
@@ -88,6 +96,7 @@ class ContinuousBatcher:
         self._lock = threading.Lock()
         self._not_empty = threading.Condition(self._lock)
         self._closed = False
+        self._ema_ms_per_req: Optional[float] = None  # service-time estimate
         self._thread = threading.Thread(
             target=self._dispatch_loop, name="serving-batcher", daemon=True
         )
@@ -136,13 +145,36 @@ class ContinuousBatcher:
             if len(self._queue) >= self.cfg.max_queue:
                 self.telemetry.count("serving_shed")
                 raise QueueFullError(
-                    f"queue at capacity ({self.cfg.max_queue}); shedding"
+                    f"queue at capacity ({self.cfg.max_queue}); shedding",
+                    retry_after_s=self._retry_after_locked(),
                 )
             self._queue.append(req)
             self.telemetry.count("serving_requests")
             self.telemetry.gauge("serving_queue_depth", float(len(self._queue)))
             self._not_empty.notify()
         return req.future
+
+    def _retry_after_locked(self) -> int:
+        """Seconds a shed client should back off: queue depth x the EMA
+        per-request service time, floored at 1s (callers hold ``_lock``)."""
+        ms = self._ema_ms_per_req if self._ema_ms_per_req is not None else 10.0
+        est_s = len(self._queue) * ms / 1e3
+        return max(1, int(est_s + 0.999))
+
+    def retry_after_s(self) -> int:
+        with self._lock:
+            return self._retry_after_locked()
+
+    def stats_snapshot(self) -> dict:
+        """Counter/gauge snapshot taken under the batcher lock, so a reader
+        racing the submit path can't observe torn values (e.g. a bumped
+        ``serving_requests`` without its matching ``serving_queue_depth``)."""
+        with self._lock:
+            return {
+                "counters": dict(self.telemetry.counters),
+                "gauges": dict(self.telemetry._gauges),
+                "queue_depth": len(self._queue),
+            }
 
     def close(self, timeout_s: float = 5.0) -> None:
         """Stop the dispatcher; pending requests fail with ServingError."""
@@ -210,8 +242,13 @@ class ContinuousBatcher:
                 live.append(req)
         return live
 
-    def _run_bucket(self, batch):
-        """Pad ``batch`` to its bucket, run the engine, demux into futures."""
+    def _run_bucket(self, batch, degraded: bool = False):
+        """Pad ``batch`` to its bucket, run the engine, demux into futures.
+
+        ``degraded`` marks the single-request retry path: its successes count
+        under ``serving_degraded_ok`` instead of the normal served counters,
+        so fleet health scoring can distinguish a replica limping through
+        one-by-one retries from one serving full buckets."""
         n = len(batch)
         b = self.engine.bucket_for(n)
         pad = b - n
@@ -222,10 +259,17 @@ class ContinuousBatcher:
         action, log_prob = self.engine.decode(state, obs, avail)
         dt = time.perf_counter() - t0
         tel = self.telemetry
-        tel.count("serving_batches")
-        tel.count(f"serving_bucket_{b}")          # bucket-occupancy histogram
-        tel.observe("serving_batch_fill", n / b)
-        tel.observe("serving_engine_ms", dt * 1e3)
+        with self._lock:   # EMA feeds Retry-After; read under the same lock
+            per_req = dt * 1e3 / max(n, 1)
+            self._ema_ms_per_req = per_req if self._ema_ms_per_req is None \
+                else 0.8 * self._ema_ms_per_req + 0.2 * per_req
+        if degraded:
+            tel.count("serving_degraded_ok", float(n))
+        else:
+            tel.count("serving_batches")
+            tel.count(f"serving_bucket_{b}")      # bucket-occupancy histogram
+            tel.observe("serving_batch_fill", n / b)
+            tel.observe("serving_engine_ms", dt * 1e3)
         now = time.monotonic()
         for i, req in enumerate(batch):
             tel.observe("serving_latency_ms", (now - req.enqueued_at) * 1e3)
@@ -248,7 +292,8 @@ class ContinuousBatcher:
                 if req.future.done():
                     continue
                 try:
-                    self._run_bucket([req])
+                    self._run_bucket([req], degraded=True)
                 except Exception as e1:
+                    self.telemetry.count("serving_degraded_failed")
                     self.telemetry.count("serving_engine_failures")
                     req.future.set_exception(EngineFailureError(repr(e1)))

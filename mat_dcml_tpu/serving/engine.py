@@ -56,12 +56,17 @@ class DecodeEngine:
         engine_cfg: EngineConfig = EngineConfig(),
         telemetry: Optional[Telemetry] = None,
         log_fn=print,
+        device=None,
     ):
         self.cfg = cfg
         self.engine_cfg = engine_cfg
         self.telemetry = telemetry if telemetry is not None else Telemetry()
         self.log = log_fn
-        self._params = jax.device_put(params)   # resident once, shared by all buckets
+        # fleet mode pins each replica's engine to one device; every input
+        # (params, key, request arrays) is placed there so the AOT executables
+        # never see a cross-device argument
+        self.device = device
+        self._params = self._put(params)   # resident once, shared by all buckets
         ecfg = engine_cfg
 
         def _decode(params, key, state, obs, avail):
@@ -78,7 +83,12 @@ class DecodeEngine:
         # deterministic serving still threads a key through the shared
         # signature (decode.serve_decode); one fixed resident key avoids a
         # fresh host->device transfer per dispatch
-        self._key = jax.random.key(0)
+        self._key = self._put(jax.random.key(0))
+
+    def _put(self, tree):
+        if self.device is not None:
+            return jax.device_put(tree, self.device)
+        return jax.device_put(tree)
 
     # ------------------------------------------------------------- lifecycle
 
@@ -115,10 +125,36 @@ class DecodeEngine:
 
     def _zero_batch(self, b: int):
         cfg = self.cfg
-        state = jnp.zeros((b, cfg.n_agent, cfg.state_dim), jnp.float32)
-        obs = jnp.zeros((b, cfg.n_agent, cfg.obs_dim), jnp.float32)
-        avail = jnp.ones((b, cfg.n_agent, cfg.action_dim), jnp.float32)
+        state = self._put(jnp.zeros((b, cfg.n_agent, cfg.state_dim), jnp.float32))
+        obs = self._put(jnp.zeros((b, cfg.n_agent, cfg.obs_dim), jnp.float32))
+        avail = self._put(jnp.ones((b, cfg.n_agent, cfg.action_dim), jnp.float32))
         return state, obs, avail
+
+    # ---------------------------------------------------------- weight swap
+
+    def install_params(self, params, warm: bool = True) -> int:
+        """Hot weight-swap via atomic publish-then-swap.
+
+        The new params are published to the device *next to* the live set,
+        then (``warm=True``) every bucket program is run once against them
+        while the old params keep serving — the shapes/dtypes of a healthy
+        export hit the existing executables, so the warm pass compiles
+        nothing.  Only after the ladder is warm does the resident reference
+        flip, in one atomic attribute store; an in-flight :meth:`decode`
+        captured its params reference at entry and never observes mixed
+        weights.  Returns the number of compiles the warm pass triggered —
+        0 in the healthy path; anything else means the artifact drifted
+        (dtype/shape) and the caller should roll back before promoting.
+        """
+        before = self.compile_count()
+        new_params = self._put(params)
+        if warm:
+            for b in self.engine_cfg.buckets:
+                out = self._decode(new_params, self._key, *self._zero_batch(b))
+                jax.block_until_ready(out)
+        self._params = new_params   # atomic ref swap; old programs keep serving
+        self.telemetry.count("serving_weight_swaps")
+        return self.compile_count() - before
 
     # --------------------------------------------------------------- serving
 
@@ -148,13 +184,16 @@ class DecodeEngine:
             raise ValueError(
                 f"batch {b} is not a compiled bucket {self.engine_cfg.buckets}"
             )
+        # capture the resident params ONCE: install_params swaps the attribute
+        # atomically, so one dispatch is entirely old or entirely new weights
+        params = self._params
         # availability guards the discrete heads; the mask rows for padding
         # slots are all-ones so masked-softmax never sees a -inf-only row
         action, log_prob = self._decode(
-            self._params, self._key,
-            jnp.asarray(state, jnp.float32),
-            jnp.asarray(obs, jnp.float32),
-            jnp.asarray(avail, jnp.float32),
+            params, self._key,
+            self._put(jnp.asarray(state, jnp.float32)),
+            self._put(jnp.asarray(obs, jnp.float32)),
+            self._put(jnp.asarray(avail, jnp.float32)),
         )
         return np.asarray(action), np.asarray(log_prob)
 

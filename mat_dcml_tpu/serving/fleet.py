@@ -1,0 +1,785 @@
+"""Replicated serving fleet: load-aware router, fault tolerance, hot push.
+
+The Gemma-on-TPU serving shape (PAPERS.md): N :class:`DecodeEngine` replicas
+— one per local device when the host has several, N engines on one device on
+a CPU dev box — each behind its own :class:`ContinuousBatcher`, fronted by a
+load-aware router.  The fleet is the unit the HTTP server and the loadgen
+talk to; it duck-types the batcher interface (``submit``/``close``/
+``telemetry``/``engine``) so every existing client works unchanged.
+
+**Routing** — least-outstanding-requests over the healthy pool, with a
+health-score tie-break (a replica that has been limping through degraded
+single-request retries scores worse than a clean sibling) and a rotating
+round-robin tie-break so an idle fleet still spreads load.
+
+**Fault tolerance** — a replica that throws, times out an attempt, or trips
+its recompile detector is marked UNHEALTHY: its in-flight requests are
+retried on a sibling (bounded ``max_retries`` with jittered exponential
+backoff; safe because decode is pure — a duplicate attempt returns identical
+bits and the first resolution wins), and a background prober replays a
+synthetic bucket through the sick engine until ``probe_successes``
+consecutive passes readmit it.
+
+**Hot weight-swap** — :meth:`EngineFleet.push` installs a new params set one
+replica at a time via the engine's atomic publish-then-swap (the old program
+serves until the new bucket ladder is warm; a warm pass that re-enters XLA
+rejects the artifact before any client sees it).  The first swapped replica
+is the **canary**: it leaves the live pool and serves shadow traffic —
+duplicates of live incumbent-served requests, plus pusher-driven synthetic
+probes so a quiet fleet still gates — through the
+:class:`~mat_dcml_tpu.serving.rollout_ctl.RolloutController`'s parity/
+latency/error gate.  Promotion rolls the remaining replicas; any trip rolls
+every swapped replica back to the prior weights and records a typed
+``rollout_rollback`` anomaly.  Zero requests are shed by the push itself:
+the report carries the measured ``push_dropped`` delta.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+import threading
+import time
+from concurrent.futures import Future, InvalidStateError
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import numpy as np
+
+from mat_dcml_tpu.models.mat import MATConfig
+from mat_dcml_tpu.serving.batcher import (
+    BatcherConfig,
+    ContinuousBatcher,
+    DeadlineExceededError,
+    QueueFullError,
+    ServingError,
+)
+from mat_dcml_tpu.serving.engine import DecodeEngine, EngineConfig
+from mat_dcml_tpu.serving.rollout_ctl import (
+    COMPLETE,
+    PROMOTE,
+    ROLLED_BACK,
+    ROLLING,
+    RolloutConfig,
+    RolloutController,
+)
+from mat_dcml_tpu.telemetry import Telemetry
+from mat_dcml_tpu.telemetry.anomaly import rollout_anomaly
+
+HEALTHY = "healthy"
+UNHEALTHY = "unhealthy"
+CANARY_STATE = "canary"
+
+_STATE_CODE = {UNHEALTHY: 0.0, HEALTHY: 1.0, CANARY_STATE: 2.0}
+
+
+class FleetUnavailableError(ServingError):
+    """Every replica is unhealthy; the request cannot be placed."""
+
+
+@dataclasses.dataclass(frozen=True)
+class FleetConfig:
+    n_replicas: int = 2
+    max_retries: int = 2              # sibling retries per request
+    backoff_base_ms: float = 5.0      # jittered exponential backoff base
+    request_timeout_s: Optional[float] = None  # per-ATTEMPT watchdog; a late
+                                      # attempt fails over to a sibling while
+                                      # the original keeps running (decode is
+                                      # pure, first resolution wins)
+    probe_interval_s: float = 0.25    # unhealthy-replica probe cadence
+    probe_successes: int = 2          # consecutive passes before readmission
+
+    def __post_init__(self):
+        if self.n_replicas < 1:
+            raise ValueError("FleetConfig.n_replicas must be >= 1")
+
+
+class Replica:
+    """One engine + batcher + health record.  Mutable health fields are
+    guarded by the fleet lock."""
+
+    def __init__(self, rid: int, engine: DecodeEngine,
+                 batcher_cfg: BatcherConfig, log_fn):
+        self.rid = rid
+        self.engine = engine
+        self.batcher = ContinuousBatcher(
+            engine, batcher_cfg, telemetry=engine.telemetry, log_fn=log_fn)
+        self.state = HEALTHY
+        self.outstanding = 0
+        self.generation = 0
+        self.probe_ok = 0
+        self.unhealthy_since: Optional[float] = None
+
+    def health_penalty(self) -> float:
+        """Degraded-path history as a routing tie-break: a replica that has
+        been retrying requests one-by-one (or failing them) is a worse bet
+        than a clean sibling at equal queue depth."""
+        c = self.engine.telemetry.counters
+        return (c.get("serving_degraded_failed", 0.0) * 1.0
+                + c.get("serving_degraded_ok", 0.0) * 0.25)
+
+    def install(self, params, generation: int) -> int:
+        """Warm-then-swap; returns warm-pass compile count (0 = healthy)."""
+        recompiles = self.engine.install_params(params, warm=True)
+        self.generation = generation
+        return recompiles
+
+
+class _RequestCtx:
+    __slots__ = ("state", "obs", "avail", "timeout_s", "attempts", "tried")
+
+    def __init__(self, state, obs, avail, timeout_s):
+        self.state = state
+        self.obs = obs
+        self.avail = avail
+        self.timeout_s = timeout_s
+        self.attempts = 0
+        self.tried: set = set()
+
+
+def _resolve(fut: Future, result=None, exc: Optional[BaseException] = None) -> None:
+    """Set a future exactly once; duplicate resolutions (timeout failover
+    racing the original attempt) are expected and dropped."""
+    try:
+        if exc is not None:
+            fut.set_exception(exc)
+        else:
+            fut.set_result(result)
+    except InvalidStateError:
+        pass
+
+
+class EngineFleet:
+    """N replicas behind a load-aware router.  Duck-types the batcher
+    interface (``submit``/``close``/``telemetry``) plus ``engine``/``cfg`` so
+    :class:`~mat_dcml_tpu.serving.server.PolicyClient` and the loadgen drive
+    a fleet exactly like a single batcher."""
+
+    def __init__(
+        self,
+        params,
+        cfg: MATConfig,
+        fleet_cfg: FleetConfig = FleetConfig(),
+        engine_cfg: EngineConfig = EngineConfig(),
+        batcher_cfg: BatcherConfig = BatcherConfig(),
+        rollout_cfg: RolloutConfig = RolloutConfig(),
+        telemetry: Optional[Telemetry] = None,
+        log_fn=print,
+        generation: int = 0,
+    ):
+        self.cfg = cfg
+        self.fleet_cfg = fleet_cfg
+        self.engine_cfg = engine_cfg
+        self.rollout_cfg = rollout_cfg
+        self.telemetry = telemetry if telemetry is not None else Telemetry()
+        self.log = log_fn
+        self.current_generation = generation
+        self._params_current = params
+        self._prior: Optional[Tuple[object, int]] = None
+        self._lock = threading.Lock()
+        self._rr = 0
+        self._closed = False
+        self._warm = False
+        self._push_lock = threading.Lock()
+        self._canary_rid: Optional[int] = None
+        self._controller: Optional[RolloutController] = None
+        self.rollout_events: List[dict] = []
+
+        devices = jax.local_devices()
+        self.replicas: List[Replica] = []
+        for rid in range(fleet_cfg.n_replicas):
+            device = devices[rid % len(devices)] if len(devices) > 1 else None
+            engine = DecodeEngine(
+                params, cfg, engine_cfg,
+                telemetry=Telemetry(),      # per-replica metric isolation
+                log_fn=self._replica_log(rid), device=device,
+            )
+            replica = Replica(rid, engine, batcher_cfg, self._replica_log(rid))
+            replica.generation = generation
+            self.replicas.append(replica)
+
+        self._prober = threading.Thread(
+            target=self._probe_loop, name="fleet-prober", daemon=True)
+        self._prober.start()
+
+    def _replica_log(self, rid: int):
+        return lambda msg: self.log(f"[fleet r{rid}] {msg}")
+
+    # ------------------------------------------------------------- lifecycle
+
+    @classmethod
+    def from_export(cls, directory, **kwargs) -> "EngineFleet":
+        """Build a fleet from a weights-only export; the manifest's
+        ``generation`` seeds the fleet's ordering counter."""
+        from mat_dcml_tpu.training.checkpoint import load_policy, read_manifest
+
+        params, cfg, space_meta = load_policy(directory)
+        generation = int(read_manifest(directory).get("generation", 0))
+        fleet = cls(params, cfg, generation=generation, **kwargs)
+        fleet.space_meta = space_meta
+        return fleet
+
+    def warmup(self) -> None:
+        for replica in self.replicas:
+            t0 = time.perf_counter()
+            replica.engine.warmup()
+            self.log(f"[fleet] replica {replica.rid} warm "
+                     f"({time.perf_counter() - t0:.1f}s, device "
+                     f"{replica.engine.device})")
+        self._warm = True
+        self.telemetry.gauge("fleet_replicas", float(len(self.replicas)))
+
+    @property
+    def engine(self) -> DecodeEngine:
+        """Primary replica's engine — config/bucket introspection only."""
+        return self.replicas[0].engine
+
+    def close(self, timeout_s: float = 5.0) -> None:
+        self._closed = True
+        for replica in self.replicas:
+            replica.batcher.close(timeout_s=timeout_s)
+
+    # --------------------------------------------------------------- routing
+
+    def _pick(self, tried: set) -> Optional[Replica]:
+        """Least-outstanding healthy replica, health-penalty then rotating
+        tie-break.  The canary is excluded from live traffic unless it is the
+        only survivor."""
+        with self._lock:
+            self._rr += 1
+            pool = [r for r in self.replicas
+                    if r.state == HEALTHY and r.rid not in tried]
+            if not pool:
+                pool = [r for r in self.replicas
+                        if r.state == CANARY_STATE and r.rid not in tried]
+            if not pool:
+                return None
+            n = len(self.replicas)
+            pool.sort(key=lambda r: (
+                r.outstanding,
+                r.health_penalty(),
+                (r.rid - self._rr) % n,
+            ))
+            choice = pool[0]
+            choice.outstanding += 1
+            return choice
+
+    def submit(
+        self,
+        state: np.ndarray,
+        obs: np.ndarray,
+        avail: Optional[np.ndarray] = None,
+        timeout_s: Optional[float] = None,
+    ) -> Future:
+        """Route one joint observation; same contract as
+        :meth:`ContinuousBatcher.submit` with fleet semantics on top:
+        replica failures retry on siblings, total shed only when every
+        replica's queue is full."""
+        if self._closed:
+            raise ServingError("fleet is closed")
+        outer: Future = Future()
+        ctx = _RequestCtx(state, obs, avail, timeout_s)
+        self.telemetry.count("fleet_requests")
+        self._attempt(ctx, outer, first=True)
+        return outer
+
+    def _attempt(self, ctx: _RequestCtx, outer: Future, first: bool = False) -> None:
+        sheds: List[int] = []
+        while True:
+            if outer.done():
+                return
+            replica = self._pick(ctx.tried)
+            if replica is None:
+                if sheds:
+                    # every live replica refused admission — fleet-level shed
+                    self.telemetry.count("fleet_shed")
+                    exc: ServingError = QueueFullError(
+                        "all replica queues at capacity",
+                        retry_after_s=min(sheds))
+                else:
+                    self.telemetry.count("fleet_no_healthy")
+                    exc = FleetUnavailableError("no healthy replicas")
+                if first:
+                    raise exc    # keep the batcher's synchronous-shed contract
+                _resolve(outer, exc=exc)
+                return
+            try:
+                inner = replica.batcher.submit(
+                    ctx.state, ctx.obs, ctx.avail, ctx.timeout_s)
+            except QueueFullError as e:
+                with self._lock:
+                    replica.outstanding -= 1
+                ctx.tried.add(replica.rid)
+                sheds.append(e.retry_after_s)
+                continue
+            except ValueError:
+                with self._lock:
+                    replica.outstanding -= 1
+                raise    # malformed request: caller bug, not replica health
+            except ServingError as e:
+                with self._lock:
+                    replica.outstanding -= 1
+                self._mark_unhealthy(replica, f"submit refused: {e!r}")
+                ctx.tried.add(replica.rid)
+                continue
+            break
+
+        t0 = time.monotonic()
+        timer: Optional[threading.Timer] = None
+        if self.fleet_cfg.request_timeout_s is not None:
+            timer = threading.Timer(
+                self.fleet_cfg.request_timeout_s,
+                self._attempt_timed_out, args=(ctx, outer, replica, inner))
+            timer.daemon = True
+            timer.start()
+        inner.add_done_callback(
+            lambda fut: self._on_done(ctx, outer, replica, fut, t0, timer))
+        if first:
+            self._maybe_shadow(ctx, inner, t0)
+
+    def _on_done(self, ctx, outer, replica: Replica, inner: Future,
+                 t0: float, timer: Optional[threading.Timer]) -> None:
+        if timer is not None:
+            timer.cancel()
+        with self._lock:
+            replica.outstanding -= 1
+        exc = inner.exception()
+        latency_ms = (time.monotonic() - t0) * 1e3
+        if exc is None:
+            if (self._controller is not None
+                    and replica.rid != self._canary_rid):
+                self._controller._tripwire.observe_incumbent(latency_ms)
+            _resolve(outer, result=inner.result())
+            return
+        if isinstance(exc, DeadlineExceededError):
+            # the request's own budget elapsed — retrying can't help
+            _resolve(outer, exc=exc)
+            return
+        self._mark_unhealthy(replica, repr(exc))
+        self._retry(ctx, outer, replica)
+
+    def _attempt_timed_out(self, ctx, outer, replica: Replica,
+                           inner: Future) -> None:
+        if inner.done() or outer.done():
+            return
+        self.telemetry.count("fleet_attempt_timeouts")
+        self._mark_unhealthy(
+            replica, f"attempt exceeded {self.fleet_cfg.request_timeout_s}s")
+        # the original attempt keeps running; decode is pure, so if it lands
+        # first its bits are identical to the sibling's — first resolve wins
+        self._retry(ctx, outer, replica)
+
+    def _retry(self, ctx, outer, failed: Replica) -> None:
+        if outer.done():
+            return
+        ctx.tried.add(failed.rid)
+        if ctx.attempts >= self.fleet_cfg.max_retries:
+            self.telemetry.count("fleet_retries_exhausted")
+            _resolve(outer, exc=ServingError(
+                f"request failed on {ctx.attempts + 1} replicas"))
+            return
+        ctx.attempts += 1
+        self.telemetry.count("fleet_retries")
+        base = self.fleet_cfg.backoff_base_ms / 1e3
+        delay = base * (2 ** (ctx.attempts - 1)) * (0.5 + random.random())
+        timer = threading.Timer(delay, self._attempt, args=(ctx, outer))
+        timer.daemon = True
+        timer.start()
+
+    # ---------------------------------------------------------------- health
+
+    def _mark_unhealthy(self, replica: Replica, why: str) -> None:
+        with self._lock:
+            if replica.state == UNHEALTHY:
+                return
+            was_canary = replica.state == CANARY_STATE
+            replica.state = UNHEALTHY
+            replica.probe_ok = 0
+            replica.unhealthy_since = time.monotonic()
+        self.telemetry.count("fleet_unhealthy_marks")
+        self.log(f"[fleet] replica {replica.rid} marked UNHEALTHY: {why}")
+        if was_canary and self._controller is not None:
+            self._controller.record_canary_error(ServingError(why))
+
+    def _probe_loop(self) -> None:
+        while not self._closed:
+            time.sleep(self.fleet_cfg.probe_interval_s)
+            if not self._warm:
+                continue
+            for replica in self.replicas:
+                if replica.state != UNHEALTHY:
+                    continue
+                try:
+                    b = replica.engine.min_bucket
+                    cfg = self.cfg
+                    replica.engine.decode(
+                        np.zeros((b, cfg.n_agent, cfg.state_dim), np.float32),
+                        np.zeros((b, cfg.n_agent, cfg.obs_dim), np.float32),
+                        np.ones((b, cfg.n_agent, cfg.action_dim), np.float32),
+                    )
+                except Exception as e:
+                    replica.probe_ok = 0
+                    self.telemetry.count("fleet_probe_failures")
+                    self.log(f"[fleet] probe of replica {replica.rid} "
+                             f"failed: {e!r}")
+                    continue
+                replica.probe_ok += 1
+                if replica.probe_ok >= self.fleet_cfg.probe_successes:
+                    with self._lock:
+                        replica.state = HEALTHY
+                        replica.unhealthy_since = None
+                    self.telemetry.count("fleet_readmissions")
+                    self.log(f"[fleet] replica {replica.rid} readmitted "
+                             f"after {replica.probe_ok} clean probes")
+
+    # ------------------------------------------------------------ shadowing
+
+    def _maybe_shadow(self, ctx, primary: Future, p_t0: float) -> None:
+        """During CANARY, duplicate a live incumbent-served request onto the
+        canary and feed the pair to the controller.  The client only ever
+        sees the incumbent's answer."""
+        controller = self._controller
+        canary_rid = self._canary_rid
+        if controller is None or canary_rid is None:
+            return
+        canary = self.replicas[canary_rid]
+        if canary.state != CANARY_STATE:
+            return
+        try:
+            shadow = canary.batcher.submit(
+                ctx.state, ctx.obs, ctx.avail, ctx.timeout_s)
+        except Exception as e:
+            controller.record_canary_error(e)
+            return
+        s_t0 = time.monotonic()
+        pair: Dict[str, Optional[Future]] = {"primary": None, "shadow": None}
+        pair_lock = threading.Lock()
+
+        def arm(slot):
+            def cb(fut):
+                with pair_lock:
+                    pair[slot] = fut
+                    ready = pair["primary"] is not None and pair["shadow"] is not None
+                if ready:
+                    self._compare_pair(controller, pair["primary"],
+                                       pair["shadow"], p_t0, s_t0)
+            return cb
+
+        primary.add_done_callback(arm("primary"))
+        shadow.add_done_callback(arm("shadow"))
+
+    def _compare_pair(self, controller, primary: Future, shadow: Future,
+                      p_t0: float, s_t0: float) -> None:
+        if primary.exception() is not None:
+            return    # nothing to compare against; incumbent health is
+                      # handled by the normal retry path
+        if shadow.exception() is not None:
+            controller.record_canary_error(shadow.exception())
+            return
+        now = time.monotonic()
+        controller.compare(
+            primary.result(), shadow.result(),
+            (now - p_t0) * 1e3, (now - s_t0) * 1e3,
+        )
+
+    def _synthetic_shadow(self, controller, incumbent: Replica,
+                          canary: Replica, seed: int) -> None:
+        """Pusher-driven shadow probe: one synthetic request decoded by both
+        an incumbent and the canary directly at the engine, so a fleet with
+        no live traffic still accumulates gated comparisons."""
+        from mat_dcml_tpu.serving.loadgen import synth_requests
+
+        states, obs, avail = synth_requests(self.cfg, 1, seed=seed)
+        b = incumbent.engine.min_bucket
+        s = np.repeat(states, b, axis=0)
+        o = np.repeat(obs, b, axis=0)
+        a = np.repeat(avail, b, axis=0)
+        t0 = time.monotonic()
+        try:
+            inc_act, inc_logp = incumbent.engine.decode(s, o, a)
+        except Exception:
+            return   # incumbent trouble is the router's problem, not the gate's
+        t1 = time.monotonic()
+        try:
+            can_act, can_logp = canary.engine.decode(s, o, a)
+        except Exception as e:
+            controller.record_canary_error(e)
+            return
+        t2 = time.monotonic()
+        controller.compare(
+            (inc_act[0], inc_logp[0]), (can_act[0], can_logp[0]),
+            (t1 - t0) * 1e3, (t2 - t1) * 1e3,
+        )
+
+    # ------------------------------------------------------------ weight push
+
+    def push_from_export(self, directory) -> dict:
+        from mat_dcml_tpu.training.checkpoint import load_policy, read_manifest
+
+        params, cfg, _ = load_policy(directory)
+        generation = int(read_manifest(directory).get("generation",
+                                                      self.current_generation + 1))
+        return self.push(params, generation=generation)
+
+    def push(self, params, generation: Optional[int] = None) -> dict:
+        """Canary-gated hot weight push.  Blocks until the rollout resolves;
+        returns a report dict (``status`` promoted | rolled_back | rejected).
+        Raises RuntimeError if a push is already in flight."""
+        if not self._push_lock.acquire(blocking=False):
+            raise RuntimeError("a weight push is already in progress")
+        try:
+            return self._push_locked(params, generation)
+        finally:
+            self._canary_rid = None
+            self._controller = None
+            self._push_lock.release()
+
+    def _push_locked(self, params, generation: Optional[int]) -> dict:
+        if generation is None:
+            generation = self.current_generation + 1
+        prior_params = self._params_current
+        prior_generation = self.current_generation
+        dropped_before = self._client_drop_count()
+        t_start = time.perf_counter()
+        report = {
+            "status": "", "generation": generation,
+            "prior_generation": prior_generation,
+            "comparisons": 0, "mismatches": 0,
+            "warm_recompiles": 0, "push_dropped": 0, "events": [],
+        }
+
+        with self._lock:
+            healthy = [r for r in self.replicas if r.state == HEALTHY]
+        if not healthy:
+            raise ServingError("no healthy replica to canary")
+        canary = healthy[0]
+
+        # --- canary swap: warm the new ladder while the old params serve
+        recompiles = canary.install(params, generation)
+        report["warm_recompiles"] = recompiles
+        if recompiles > 0:
+            # artifact drift re-entered XLA during warm: reject before any
+            # client request can see the new weights
+            canary.install(prior_params, prior_generation)
+            self._record_rollout_event(rollout_anomaly(
+                "rollout_warm_recompile", "warm_pass_compiles",
+                float(recompiles), 0.0, generation, self.telemetry))
+            report["status"] = "rejected"
+            report["push_dropped"] = self._client_drop_count() - dropped_before
+            self.log(f"[fleet] push gen {generation} REJECTED: warm pass "
+                     f"compiled {recompiles} program(s)")
+            return report
+
+        if len(self.replicas) == 1:
+            # nothing to shadow against — swap is already done, promote
+            self.log("[fleet] single-replica fleet: skipping canary gate")
+            self._promote(params, generation)
+            report["status"] = "promoted"
+            report["wall_s"] = time.perf_counter() - t_start
+            return report
+
+        controller = RolloutController(
+            self.rollout_cfg, prior_generation, generation,
+            telemetry=self.telemetry, log_fn=self.log)
+        with self._lock:
+            canary.state = CANARY_STATE
+            self._canary_rid = canary.rid
+            self._controller = controller
+
+        # drive synthetic shadow probes until the gate decides (live traffic
+        # contributes concurrently through _maybe_shadow)
+        deadline = time.monotonic() + self.rollout_cfg.canary_timeout_s
+        seed = 0
+        while controller.verdict() is None and time.monotonic() < deadline:
+            with self._lock:
+                incumbents = [r for r in self.replicas
+                              if r.state == HEALTHY and r.rid != canary.rid]
+            if incumbents:
+                self._synthetic_shadow(controller, incumbents[seed % len(incumbents)],
+                                       canary, seed)
+                seed += 1
+            time.sleep(self.rollout_cfg.synthetic_interval_s)
+        verdict = controller.wait(timeout_s=0.0)
+
+        summary = controller.summary()
+        report["comparisons"] = summary["comparisons"]
+        report["mismatches"] = (summary["parity_mismatches"]
+                                + summary["value_mismatches"])
+        for event in summary["events"]:
+            self._record_rollout_event_dict(event)
+        report["events"] = list(summary["events"])
+
+        if verdict != PROMOTE:
+            controller.state = ROLLED_BACK
+            canary.install(prior_params, prior_generation)
+            with self._lock:
+                if canary.state == CANARY_STATE:
+                    canary.state = HEALTHY
+            rollback = rollout_anomaly(
+                "rollout_rollback", "canary_verdict",
+                float(report["mismatches"]), float(report["comparisons"]),
+                generation, self.telemetry)
+            self._record_rollout_event(rollback)
+            report["events"].append(rollback.to_record())
+            self.telemetry.count("rollout_rollbacks")
+            report["status"] = "rolled_back"
+            report["push_dropped"] = self._client_drop_count() - dropped_before
+            report["wall_s"] = time.perf_counter() - t_start
+            self.log(f"[fleet] push gen {generation} ROLLED BACK "
+                     f"({report['mismatches']}/{report['comparisons']} "
+                     f"mismatches)")
+            return report
+
+        # --- promote: roll the remaining replicas one at a time
+        controller.state = ROLLING
+        with self._lock:
+            if canary.state == CANARY_STATE:
+                canary.state = HEALTHY
+            self._canary_rid = None
+            self._controller = None
+        swapped = [canary]
+        for replica in self.replicas:
+            if replica is canary:
+                continue
+            recompiles = replica.install(params, generation)
+            if recompiles > 0:
+                # mid-roll drift: put EVERY swapped replica back
+                for r in swapped + [replica]:
+                    r.install(prior_params, prior_generation)
+                self._record_rollout_event(rollout_anomaly(
+                    "rollout_warm_recompile", "warm_pass_compiles",
+                    float(recompiles), 0.0, generation, self.telemetry))
+                self.telemetry.count("rollout_rollbacks")
+                report["status"] = "rolled_back"
+                report["push_dropped"] = self._client_drop_count() - dropped_before
+                report["wall_s"] = time.perf_counter() - t_start
+                return report
+            swapped.append(replica)
+
+        controller.state = COMPLETE
+        self._promote(params, generation)
+        report["status"] = "promoted"
+        report["push_dropped"] = self._client_drop_count() - dropped_before
+        report["wall_s"] = time.perf_counter() - t_start
+        self.log(f"[fleet] push gen {generation} PROMOTED "
+                 f"({report['comparisons']} comparisons, "
+                 f"{report['mismatches']} mismatches, "
+                 f"{report['push_dropped']} dropped)")
+        return report
+
+    def _promote(self, params, generation: int) -> None:
+        self._prior = (self._params_current, self.current_generation)
+        self._params_current = params
+        self.current_generation = generation
+        self.telemetry.count("rollout_pushes")
+
+    def rollback(self) -> dict:
+        """Manual rollback to the prior promoted manifest."""
+        if self._prior is None:
+            raise RuntimeError("no prior generation to roll back to")
+        prior_params, prior_generation = self._prior
+        for replica in self.replicas:
+            replica.install(prior_params, prior_generation)
+        rollback = rollout_anomaly(
+            "rollout_rollback", "manual",
+            float(self.current_generation), float(prior_generation),
+            self.current_generation, self.telemetry)
+        self._record_rollout_event(rollback)
+        self.telemetry.count("rollout_rollbacks")
+        self._params_current = prior_params
+        self.current_generation = prior_generation
+        self._prior = None
+        return {"status": "rolled_back", "generation": prior_generation}
+
+    def _client_drop_count(self) -> float:
+        """Client-visible request drops: fleet-level sheds, exhausted
+        retries, unplaceable requests, plus per-replica deadline misses.
+        Replica failures that were retried successfully are NOT drops."""
+        c = self.telemetry.counters
+        total = (c.get("fleet_shed", 0.0)
+                 + c.get("fleet_retries_exhausted", 0.0)
+                 + c.get("fleet_no_healthy", 0.0))
+        for replica in self.replicas:
+            total += replica.engine.telemetry.counters.get(
+                "serving_deadline_misses", 0.0)
+        return total
+
+    def _record_rollout_event(self, anomaly) -> None:
+        self._record_rollout_event_dict(anomaly.to_record())
+
+    def _record_rollout_event_dict(self, record: dict) -> None:
+        self.rollout_events.append(record)
+
+    # ------------------------------------------------------------ accounting
+
+    def status(self) -> dict:
+        """Human/HTTP-facing fleet state (the ``/fleet`` endpoint)."""
+        with self._lock:
+            replicas = [{
+                "rid": r.rid,
+                "state": r.state,
+                "outstanding": r.outstanding,
+                "generation": r.generation,
+                "compile_count": r.engine.compile_count(),
+                "steady_state_recompiles": r.engine.steady_state_recompiles(),
+            } for r in self.replicas]
+        return {
+            "replicas": replicas,
+            "generation": self.current_generation,
+            "healthy": sum(1 for r in replicas if r["state"] == HEALTHY),
+            "push_in_progress": self._push_lock.locked(),
+            "rollout_events": list(self.rollout_events[-16:]),
+        }
+
+    def stats_snapshot(self) -> dict:
+        """Aggregated counter snapshot: fleet counters plus each replica's
+        batcher snapshot (each taken under its own lock)."""
+        return {
+            "counters": dict(self.telemetry.counters),
+            "gauges": dict(self.telemetry._gauges),
+            "replicas": {r.rid: r.batcher.stats_snapshot()
+                         for r in self.replicas},
+        }
+
+    def fleet_record(self) -> Dict[str, float]:
+        """Flat metrics.jsonl fragment: the ``fleet_``/``rollout_`` families
+        (`scripts/check_metrics_schema.py` REQUIRED_FLEET contract) plus
+        per-replica labeled gauges."""
+        c = self.telemetry.counters
+        with self._lock:
+            replicas = list(self.replicas)
+            healthy = sum(1 for r in replicas if r.state == HEALTHY)
+        record: Dict[str, float] = {
+            "fleet_replicas": float(len(replicas)),
+            "fleet_healthy": float(healthy),
+            "fleet_requests": c.get("fleet_requests", 0.0),
+            "fleet_retries": c.get("fleet_retries", 0.0),
+            "fleet_retries_exhausted": c.get("fleet_retries_exhausted", 0.0),
+            "fleet_attempt_timeouts": c.get("fleet_attempt_timeouts", 0.0),
+            "fleet_shed": c.get("fleet_shed", 0.0),
+            "fleet_no_healthy": c.get("fleet_no_healthy", 0.0),
+            "fleet_unhealthy_marks": c.get("fleet_unhealthy_marks", 0.0),
+            "fleet_readmissions": c.get("fleet_readmissions", 0.0),
+            "fleet_probe_failures": c.get("fleet_probe_failures", 0.0),
+            "fleet_generation": float(self.current_generation),
+            "rollout_pushes": c.get("rollout_pushes", 0.0),
+            "rollout_rollbacks": c.get("rollout_rollbacks", 0.0),
+            "rollout_canary_comparisons": c.get("rollout_canary_comparisons", 0.0),
+            "rollout_canary_mismatches": c.get("rollout_canary_mismatches", 0.0),
+        }
+        # per-replica labels: one flat field per (replica, signal)
+        for r in replicas:
+            rc = r.engine.telemetry.counters
+            prefix = f"fleet_replica_{r.rid}"
+            record[f"{prefix}_state"] = _STATE_CODE[r.state]
+            record[f"{prefix}_outstanding"] = float(r.outstanding)
+            record[f"{prefix}_generation"] = float(r.generation)
+            record[f"{prefix}_recompiles"] = r.engine.steady_state_recompiles()
+            record[f"{prefix}_served"] = rc.get("serving_batches", 0.0)
+            record[f"{prefix}_degraded_ok"] = rc.get("serving_degraded_ok", 0.0)
+            record[f"{prefix}_degraded_failed"] = rc.get(
+                "serving_degraded_failed", 0.0)
+        return record
+
+    def steady_state_recompiles(self) -> float:
+        return sum(r.engine.steady_state_recompiles() for r in self.replicas)

@@ -56,6 +56,8 @@ def run_load(
     target_qps: Optional[float] = None,
     timeout_s: Optional[float] = None,
     seed: int = 0,
+    slo_ms: Optional[float] = None,
+    n_clients: int = 1,
 ) -> Dict[str, float]:
     """Fire ``n_requests`` at the stack and return a flat serving record.
 
@@ -63,11 +65,21 @@ def run_load(
     its next request as soon as the previous returns); a number = open loop
     (requests launched on schedule from a thread pool regardless of
     completions, so queueing/shedding behavior is exercised honestly).
+
+    ``n_clients > 1`` (open loop only) splits the offered load across that
+    many independent dispatcher threads, each keeping its own schedule — a
+    single python thread can't launch fast enough to saturate a fleet, and
+    real traffic is many clients, not one metronome.
+
+    ``slo_ms`` adds goodput accounting: a request counts toward
+    ``serving_goodput_slo`` (fraction of *offered* load) and
+    ``serving_goodput_qps`` only if it succeeded AND finished inside the SLO
+    — sheds, errors, and slow successes all count against goodput alike.
     """
     cfg = client.batcher.engine.cfg
     states, obs, avail = synth_requests(cfg, n_requests, seed)
     latencies: List[float] = []
-    outcomes = {"ok": 0, "shed": 0, "deadline": 0, "error": 0}
+    outcomes = {"ok": 0, "shed": 0, "deadline": 0, "error": 0, "good": 0}
     lock = threading.Lock()
 
     def fire(i: int) -> None:
@@ -87,6 +99,8 @@ def run_load(
         dt_ms = (time.perf_counter() - t0) * 1e3
         with lock:
             outcomes["ok"] += 1
+            if slo_ms is None or dt_ms <= slo_ms:
+                outcomes["good"] += 1
             latencies.append(dt_ms)
 
     t_start = time.perf_counter()
@@ -108,16 +122,30 @@ def run_load(
         for t in threads:
             t.join()
     else:
-        period = 1.0 / target_qps
-        threads = []
-        for i in range(n_requests):
-            due = t_start + i * period
-            lag = due - time.perf_counter()
-            if lag > 0:
-                time.sleep(lag)
-            t = threading.Thread(target=fire, args=(i,))
-            t.start()
-            threads.append(t)
+        n_clients = max(1, int(n_clients))
+        period = n_clients / target_qps   # per-client inter-arrival spacing
+        threads: List[threading.Thread] = []
+        threads_lock = threading.Lock()
+
+        def dispatcher(c: int) -> None:
+            # client c owns requests c, c+n_clients, ...; staggered start so
+            # the aggregate arrival process interleaves instead of bursting
+            for k, i in enumerate(range(c, n_requests, n_clients)):
+                due = t_start + (c / n_clients) * period + k * period
+                lag = due - time.perf_counter()
+                if lag > 0:
+                    time.sleep(lag)
+                t = threading.Thread(target=fire, args=(i,))
+                t.start()
+                with threads_lock:
+                    threads.append(t)
+
+        dispatchers = [threading.Thread(target=dispatcher, args=(c,))
+                       for c in range(n_clients)]
+        for d in dispatchers:
+            d.start()
+        for d in dispatchers:
+            d.join()
         for t in threads:
             t.join()
     elapsed = time.perf_counter() - t_start
@@ -131,6 +159,10 @@ def run_load(
         "serving_error_rate": outcomes["error"] / max(n_requests, 1),
         "serving_wall_s": elapsed,
     }
+    if slo_ms is not None:
+        record["serving_slo_ms"] = float(slo_ms)
+        record["serving_goodput_slo"] = outcomes["good"] / max(n_requests, 1)
+        record["serving_goodput_qps"] = outcomes["good"] / max(elapsed, 1e-9)
     record.update(percentiles(latencies))
     tel = client.batcher.telemetry
     # bucket-occupancy histogram + engine-side aggregates ride along
@@ -165,6 +197,10 @@ def main(argv=None) -> None:
     p.add_argument("--requests", type=int, default=2000)
     p.add_argument("--concurrency", type=int, default=16)
     p.add_argument("--qps", type=float, default=0.0, help="0 = closed loop")
+    p.add_argument("--clients", type=int, default=1,
+                   help="open-loop dispatcher threads sharing the offered load")
+    p.add_argument("--slo_ms", type=float, default=0.0,
+                   help="goodput SLO in ms; 0 disables goodput accounting")
     p.add_argument("--timeout_s", type=float, default=0.0, help="0 = none")
     p.add_argument("--buckets", default="1,8,32,128")
     p.add_argument("--max_batch_wait_ms", type=float, default=2.0)
@@ -187,6 +223,8 @@ def main(argv=None) -> None:
         concurrency=args.concurrency,
         target_qps=args.qps or None,
         timeout_s=args.timeout_s or None,
+        slo_ms=args.slo_ms or None,
+        n_clients=args.clients,
     )
     recompiles = engine.steady_state_recompiles()
     record["steady_state_recompiles"] = recompiles

@@ -1,0 +1,248 @@
+"""SLO-gated canary rollout control + weight pusher.
+
+The decoupled actor/learner update flow (PAPERS.md "Podracer architectures"):
+training exports weights-only artifacts on its own cadence; the serving fleet
+pulls them in without ever dropping a request.  This module owns the *gate*
+between those two worlds:
+
+- :class:`RolloutController` is the state machine for one weight push
+  (``IDLE -> CANARY -> ROLLING -> COMPLETE | ROLLED_BACK``).  During CANARY
+  the first swapped replica serves **shadow traffic**: every compared request
+  was answered by an incumbent replica (the client always gets the incumbent's
+  bits) and replayed against the canary; the controller demands bit-parity on
+  greedy actions (up to a configured mismatch fraction — successive PPO
+  exports legitimately flip a few argmaxes) and tolerance-level agreement on
+  the value/log-prob head, while :class:`telemetry.anomaly.CanaryTripwire`
+  watches canary latency (vs the incumbent EMA baseline) and error count.
+  Any trip produces a typed rollout anomaly record and a ``rollback``
+  verdict; surviving ``canary_comparisons`` comparisons produces ``promote``.
+- :class:`WeightPusher` watches an export root (``training/checkpoint.py``
+  writes a monotonic ``generation`` into every policy manifest) and pushes
+  each new generation into a live :class:`~mat_dcml_tpu.serving.fleet.
+  EngineFleet`, one replica at a time, through the controller's gate.
+
+Everything is stdlib + numpy; the fleet owns the actual weight swaps.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Callable, List, Optional
+
+import numpy as np
+
+from mat_dcml_tpu.telemetry.anomaly import Anomaly, CanaryTripwire, rollout_anomaly
+
+IDLE = "idle"
+CANARY = "canary"
+ROLLING = "rolling"
+COMPLETE = "complete"
+ROLLED_BACK = "rolled_back"
+
+PROMOTE = "promote"
+ROLLBACK = "rollback"
+
+
+@dataclasses.dataclass(frozen=True)
+class RolloutConfig:
+    canary_comparisons: int = 24    # shadow comparisons the gate demands
+    max_mismatch_frac: float = 0.25  # tolerated greedy-action flips (PPO-sized
+                                     # updates move a few argmaxes; a corrupt
+                                     # or wrong-model artifact moves far more)
+    value_rtol: float = 1e-4        # log-prob/value head tolerance vs incumbent
+    value_atol: float = 1e-5
+    latency_factor: float = 4.0     # canary latency trip vs incumbent EMA
+    latency_warmup: int = 8         # incumbent samples before the trip arms
+    error_budget: int = 0           # canary request errors tolerated
+    canary_timeout_s: float = 30.0  # give up (-> rollback) if comparisons stall
+    synthetic_interval_s: float = 0.01  # pusher-driven shadow probe cadence
+
+
+class RolloutController:
+    """Gate for one push.  Thread-safe: live-traffic shadow comparisons arrive
+    from replica dispatcher threads while the push thread polls the verdict."""
+
+    def __init__(self, cfg: RolloutConfig, prior_generation: int,
+                 new_generation: int, telemetry=None, log_fn=print):
+        self.cfg = cfg
+        self.prior_generation = prior_generation
+        self.new_generation = new_generation
+        self.telemetry = telemetry
+        self.log = log_fn
+        self.state = CANARY
+        self.comparisons = 0
+        self.parity_mismatches = 0
+        self.value_mismatches = 0
+        self.anomalies: List[Anomaly] = []
+        self._tripwire = CanaryTripwire(
+            latency_factor=cfg.latency_factor, warmup=cfg.latency_warmup,
+            error_budget=cfg.error_budget, generation=new_generation,
+            telemetry=telemetry,
+        )
+        self._lock = threading.Lock()
+        self._verdict: Optional[str] = None
+        self._decided = threading.Event()
+
+    # ------------------------------------------------------------ observation
+
+    def compare(self, incumbent_out, canary_out,
+                incumbent_ms: float, canary_ms: float) -> None:
+        """One shadow comparison: ``*_out`` are ``(action, log_prob)`` numpy
+        pairs for the SAME request served by an incumbent and the canary."""
+        inc_action, inc_logp = incumbent_out
+        can_action, can_logp = canary_out
+        with self._lock:
+            if self._verdict is not None:
+                return
+            self.comparisons += 1
+            if self.telemetry is not None:
+                self.telemetry.count("rollout_canary_comparisons")
+            parity_ok = np.array_equal(
+                np.asarray(inc_action), np.asarray(can_action))
+            value_ok = bool(np.allclose(
+                np.asarray(can_logp), np.asarray(inc_logp),
+                rtol=self.cfg.value_rtol, atol=self.cfg.value_atol))
+            if not parity_ok:
+                self.parity_mismatches += 1
+                self._count_mismatch("rollout_canary_parity",
+                                     "greedy_action_mismatches",
+                                     self.parity_mismatches)
+            elif not value_ok:
+                self.value_mismatches += 1
+                self._count_mismatch("rollout_canary_value",
+                                     "value_head_mismatches",
+                                     self.value_mismatches)
+            self._tripwire.observe_incumbent(incumbent_ms)
+            trip = self._tripwire.observe_canary(canary_ms)
+            if trip is not None:
+                self.anomalies.append(trip)
+                self._decide_locked(ROLLBACK, trip.kind)
+                return
+            self._maybe_decide_locked()
+
+    def record_canary_error(self, exc: BaseException) -> None:
+        with self._lock:
+            if self._verdict is not None:
+                return
+            self.log(f"[rollout] canary request failed: {exc!r}")
+            trip = self._tripwire.record_error()
+            if trip is not None:
+                self.anomalies.append(trip)
+                self._decide_locked(ROLLBACK, trip.kind)
+
+    def _count_mismatch(self, kind: str, signal: str, total: int) -> None:
+        # every mismatch is recorded; the *budget* decides the verdict below
+        if self.telemetry is not None:
+            self.telemetry.count("rollout_canary_mismatches")
+        self.anomalies.append(rollout_anomaly(
+            kind, signal, float(total),
+            float(self._mismatch_budget()), self.new_generation,
+            self.telemetry,
+        ))
+
+    def _mismatch_budget(self) -> int:
+        return int(self.cfg.max_mismatch_frac * self.cfg.canary_comparisons)
+
+    def _maybe_decide_locked(self) -> None:
+        budget = self._mismatch_budget()
+        mismatches = self.parity_mismatches + self.value_mismatches
+        if mismatches > budget:
+            self._decide_locked(ROLLBACK, "mismatch budget exceeded "
+                                f"({mismatches} > {budget})")
+        elif self.comparisons >= self.cfg.canary_comparisons:
+            self._decide_locked(PROMOTE, f"{self.comparisons} comparisons, "
+                                f"{mismatches} mismatches <= budget {budget}")
+
+    def _decide_locked(self, verdict: str, why: str) -> None:
+        if self._verdict is None:
+            self._verdict = verdict
+            self.log(f"[rollout] gen {self.new_generation} canary verdict: "
+                     f"{verdict} ({why})")
+            self._decided.set()
+
+    # ---------------------------------------------------------------- verdict
+
+    def verdict(self) -> Optional[str]:
+        return self._verdict
+
+    def wait(self, timeout_s: Optional[float] = None) -> str:
+        """Block until the gate decides; a timeout is a rollback (a canary
+        that can't attract or survive its comparisons must not be promoted)."""
+        timeout_s = timeout_s if timeout_s is not None else self.cfg.canary_timeout_s
+        if not self._decided.wait(timeout=timeout_s):
+            with self._lock:
+                self._decide_locked(ROLLBACK, f"canary timed out after "
+                                    f"{timeout_s:.1f}s with "
+                                    f"{self.comparisons} comparisons")
+        return self._verdict  # type: ignore[return-value]
+
+    def summary(self) -> dict:
+        with self._lock:
+            return {
+                "state": self.state,
+                "comparisons": self.comparisons,
+                "parity_mismatches": self.parity_mismatches,
+                "value_mismatches": self.value_mismatches,
+                "verdict": self._verdict,
+                "events": [a.to_record() for a in self.anomalies],
+            }
+
+
+class WeightPusher:
+    """Polls an export root for new policy generations and pushes them.
+
+    Training exports land under ``<watch_root>/<anything>/policy_manifest.json``
+    with a monotonically increasing ``generation`` (``training/checkpoint.py``
+    stamps it).  Each poll compares the newest on-disk generation against the
+    fleet's installed one and, when newer, drives a full canary-gated push.
+    ``poll_once`` is the synchronous unit (tests call it directly);
+    ``start``/``stop`` wrap it in a daemon polling thread.
+    """
+
+    def __init__(self, fleet, watch_root, poll_interval_s: float = 2.0,
+                 log_fn: Callable[[str], None] = print):
+        self.fleet = fleet
+        self.watch_root = watch_root
+        self.poll_interval_s = poll_interval_s
+        self.log = log_fn
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.pushes: List[dict] = []
+
+    def poll_once(self) -> Optional[dict]:
+        """One poll: returns the push report if a push happened, else None."""
+        from mat_dcml_tpu.training.checkpoint import latest_export
+
+        hit = latest_export(self.watch_root)
+        if hit is None:
+            return None
+        path, generation = hit
+        if generation <= self.fleet.current_generation:
+            return None
+        self.log(f"[rollout] pusher: found generation {generation} at {path} "
+                 f"(fleet at {self.fleet.current_generation})")
+        report = self.fleet.push_from_export(path)
+        self.pushes.append(report)
+        return report
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.poll_once()
+            except Exception as e:   # a bad artifact must not kill the poller
+                self.log(f"[rollout] pusher poll failed: {e!r}")
+            self._stop.wait(timeout=self.poll_interval_s)
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._thread = threading.Thread(
+            target=self._loop, name="weight-pusher", daemon=True)
+        self._thread.start()
+
+    def stop(self, timeout_s: float = 5.0) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=timeout_s)
+            self._thread = None

@@ -8,11 +8,19 @@ out-of-process callers; intentionally stdlib-only (no new dependencies):
 - ``POST /v1/act``   {"state": [[..]], "obs": [[..]], "available_actions":
   [[..]]?, "timeout_s": float?} -> {"action": [[..]], "log_prob": [[..]]}
 - ``GET /healthz``   liveness + warmup state
-- ``GET /stats``     telemetry counter/gauge snapshot (queue depth, shed
-  counts, bucket occupancy, recompiles)
+- ``GET /stats``     telemetry counter/gauge snapshot taken under the batcher
+  lock (queue depth, shed counts, bucket occupancy, recompiles)
 
-Typed rejections map onto HTTP: queue-full -> 429, deadline -> 504, engine
+Typed rejections map onto HTTP: queue-full -> 429 with a ``Retry-After``
+header derived from queue depth x EMA service time, deadline -> 504, engine
 failure -> 500, malformed request -> 400.
+
+Fleet mode (``PolicyServer(fleet=...)`` or ``scripts/serve_fleet.py``) serves
+the same ``/v1/act`` through the fleet router and adds:
+
+- ``GET /fleet``          per-replica health/generation/outstanding
+- ``POST /v1/push``       {"policy_dir": ...} -> canary-gated weight push
+- ``POST /v1/rollback``   roll every replica back to the prior manifest
 """
 
 from __future__ import annotations
@@ -63,28 +71,46 @@ class _Handler(BaseHTTPRequestHandler):
     def log_message(self, fmt, *args):   # route through the server's logger
         self.server.log_fn("[serving] " + fmt % args)
 
-    def _reply(self, code: int, payload: dict) -> None:
+    def _reply(self, code: int, payload: dict, headers=None) -> None:
         body = json.dumps(payload).encode()
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(body)))
+        for name, value in (headers or {}).items():
+            self.send_header(name, value)
         self.end_headers()
         self.wfile.write(body)
 
     def do_GET(self):
         srv: "PolicyServer" = self.server.policy_server
         if self.path == "/healthz":
-            self._reply(200, {"ok": True, "warm": srv.warm,
-                              "buckets": list(srv.engine.engine_cfg.buckets)})
+            payload = {"ok": True, "warm": srv.warm,
+                       "buckets": list(srv.engine.engine_cfg.buckets)}
+            if srv.fleet is not None:
+                status = srv.fleet.status()
+                payload["fleet"] = {"replicas": len(status["replicas"]),
+                                    "healthy": status["healthy"],
+                                    "generation": status["generation"]}
+            self._reply(200, payload)
         elif self.path == "/stats":
-            tel = srv.engine.telemetry
-            self._reply(200, {"counters": dict(tel.counters),
-                              "gauges": dict(tel._gauges)})
+            # snapshot under the batcher lock: no torn counter/gauge pairs
+            self._reply(200, srv.batcher.stats_snapshot())
+        elif self.path == "/fleet":
+            if srv.fleet is None:
+                self._reply(404, {"error": "not running in fleet mode"})
+            else:
+                self._reply(200, srv.fleet.status())
         else:
             self._reply(404, {"error": f"no route {self.path}"})
 
     def do_POST(self):
         srv: "PolicyServer" = self.server.policy_server
+        if self.path == "/v1/push":
+            self._do_push(srv)
+            return
+        if self.path == "/v1/rollback":
+            self._do_rollback(srv)
+            return
         if self.path != "/v1/act":
             self._reply(404, {"error": f"no route {self.path}"})
             return
@@ -102,7 +128,12 @@ class _Handler(BaseHTTPRequestHandler):
         try:
             action, log_prob = srv.client.act(state, obs, avail, timeout_s)
         except QueueFullError as e:
-            self._reply(429, {"error": str(e), "kind": "queue_full"})
+            # a shed client that retries immediately just gets shed again;
+            # the hint is queue depth x EMA service time at shed instant
+            self._reply(429, {"error": str(e), "kind": "queue_full",
+                              "retry_after_s": getattr(e, "retry_after_s", 1)},
+                        headers={"Retry-After":
+                                 str(getattr(e, "retry_after_s", 1))})
         except DeadlineExceededError as e:
             self._reply(504, {"error": str(e), "kind": "deadline_exceeded"})
         except ValueError as e:
@@ -113,21 +144,67 @@ class _Handler(BaseHTTPRequestHandler):
             self._reply(200, {"action": action.tolist(),
                               "log_prob": log_prob.tolist()})
 
+    def _do_push(self, srv: "PolicyServer") -> None:
+        if srv.fleet is None:
+            self._reply(404, {"error": "not running in fleet mode"})
+            return
+        try:
+            length = int(self.headers.get("Content-Length", "0"))
+            req = json.loads(self.rfile.read(length))
+            policy_dir = req["policy_dir"]
+        except (KeyError, ValueError, json.JSONDecodeError) as e:
+            self._reply(400, {"error": f"malformed request: {e!r}"})
+            return
+        try:
+            report = srv.fleet.push_from_export(policy_dir)
+        except RuntimeError as e:       # push already in progress
+            self._reply(409, {"error": str(e), "kind": "push_in_progress"})
+        except FileNotFoundError as e:
+            self._reply(400, {"error": str(e), "kind": "bad_artifact"})
+        except Exception as e:
+            self._reply(500, {"error": repr(e), "kind": "push_failure"})
+        else:
+            self._reply(200, report)
+
+    def _do_rollback(self, srv: "PolicyServer") -> None:
+        if srv.fleet is None:
+            self._reply(404, {"error": "not running in fleet mode"})
+            return
+        try:
+            report = srv.fleet.rollback()
+        except RuntimeError as e:       # nothing to roll back to
+            self._reply(409, {"error": str(e), "kind": "no_prior"})
+        except Exception as e:
+            self._reply(500, {"error": repr(e), "kind": "rollback_failure"})
+        else:
+            self._reply(200, report)
+
 
 class PolicyServer:
-    """HTTP frontend over (engine, batcher).  ``start()`` binds and serves on
-    a background thread; ``port=0`` picks a free port (tests)."""
+    """HTTP frontend over (engine, batcher) — or over an
+    :class:`~mat_dcml_tpu.serving.fleet.EngineFleet`, which duck-types the
+    batcher interface, in which case ``/fleet`` + ``/v1/push`` +
+    ``/v1/rollback`` come alive.  ``start()`` binds and serves on a
+    background thread; ``port=0`` picks a free port (tests)."""
 
     def __init__(
         self,
-        engine: DecodeEngine,
+        engine: Optional[DecodeEngine] = None,
         batcher_cfg: BatcherConfig = BatcherConfig(),
         host: str = "127.0.0.1",
         port: int = 8420,
         log_fn=print,
+        fleet=None,
     ):
-        self.engine = engine
-        self.batcher = ContinuousBatcher(engine, batcher_cfg, log_fn=log_fn)
+        if (engine is None) == (fleet is None):
+            raise ValueError("pass exactly one of engine= or fleet=")
+        self.fleet = fleet
+        if fleet is not None:
+            self.engine = fleet.engine     # bucket/config introspection
+            self.batcher = fleet           # router IS the batcher interface
+        else:
+            self.engine = engine
+            self.batcher = ContinuousBatcher(engine, batcher_cfg, log_fn=log_fn)
         self.client = PolicyClient(self.batcher)
         self.log_fn = log_fn
         self.warm = False
@@ -141,7 +218,10 @@ class PolicyServer:
         return self._httpd.server_address[1]
 
     def warmup(self) -> None:
-        self.engine.warmup()
+        if self.fleet is not None:
+            self.fleet.warmup()
+        else:
+            self.engine.warmup()
         self.warm = True
 
     def start(self) -> None:

@@ -36,6 +36,19 @@ from typing import Dict, List, Optional
 SPIKE_SIGNALS = ("grad_norm", "param_norm", "update_ratio")
 TIME_SIGNALS = ("step_time_dispatch", "step_time_train", "step_time_collect")
 
+# typed rollout anomaly kinds (serving/rollout_ctl.py): a canary or rollback
+# event becomes an Anomaly record in the same metrics.jsonl stream, with the
+# rollout GENERATION in the ``episode`` slot (a serving fleet has no episode
+# counter) and ``total_steps`` pinned to 0.
+ROLLOUT_KINDS = (
+    "rollout_canary_parity",     # canary greedy action != incumbent
+    "rollout_canary_value",      # canary value head outside tolerance
+    "rollout_canary_latency",    # canary latency > factor x incumbent EMA
+    "rollout_canary_error",      # canary request errored (budget exceeded)
+    "rollout_warm_recompile",    # weight-swap warm pass re-entered XLA
+    "rollout_rollback",          # the fleet rolled back to the prior manifest
+)
+
 
 @dataclasses.dataclass(frozen=True)
 class AnomalyConfig:
@@ -165,6 +178,78 @@ class AnomalyDetector:
                     continue  # spikes stay out of their own baseline
             self._absorb(name, value)
         return out
+
+
+def rollout_anomaly(kind: str, signal: str, value: float,
+                    baseline: Optional[float], generation: int,
+                    telemetry=None) -> Anomaly:
+    """Typed rollout anomaly: same record shape as training tripwires, with
+    the rollout generation riding in the ``episode`` slot.  ``kind`` must be
+    one of :data:`ROLLOUT_KINDS` so downstream dashboards can rely on the
+    vocabulary."""
+    if kind not in ROLLOUT_KINDS:
+        raise ValueError(f"unknown rollout anomaly kind {kind!r}")
+    if telemetry is not None:
+        telemetry.count("anomalies_total")
+        telemetry.count(f"anomalies_{kind}")
+    return Anomaly(kind=kind, signal=signal, value=float(value),
+                   baseline=baseline, episode=int(generation), total_steps=0)
+
+
+class CanaryTripwire:
+    """Latency + error tripwires over a canary replica during a rollout.
+
+    The baseline is an EMA of *incumbent* request latency (fed from live
+    traffic and synthetic shadow probes alike); the canary trips when its
+    latency exceeds ``latency_factor`` x that baseline after ``warmup``
+    incumbent observations, or when its error count exceeds ``error_budget``.
+    Detection is plain host arithmetic — safe to call from any serving
+    thread under the controller's lock.
+    """
+
+    def __init__(self, latency_factor: float = 4.0, warmup: int = 8,
+                 error_budget: int = 0, beta: float = 0.9,
+                 generation: int = 0, telemetry=None):
+        self.latency_factor = latency_factor
+        self.warmup = warmup
+        self.error_budget = error_budget
+        self.beta = beta
+        self.generation = generation
+        self.telemetry = telemetry
+        self._ema_ms: Optional[float] = None
+        self._n = 0
+        self._errors = 0
+
+    def observe_incumbent(self, latency_ms: float) -> None:
+        latency_ms = float(latency_ms)
+        if not math.isfinite(latency_ms):
+            return
+        if self._ema_ms is None:
+            self._ema_ms = latency_ms
+        else:
+            self._ema_ms = self.beta * self._ema_ms + (1 - self.beta) * latency_ms
+        self._n += 1
+
+    def observe_canary(self, latency_ms: float) -> Optional[Anomaly]:
+        if self._n < self.warmup or self._ema_ms is None:
+            return None
+        if float(latency_ms) > self.latency_factor * max(self._ema_ms, 1e-9):
+            return rollout_anomaly(
+                "rollout_canary_latency", "canary_latency_ms",
+                float(latency_ms), self._ema_ms, self.generation,
+                self.telemetry,
+            )
+        return None
+
+    def record_error(self) -> Optional[Anomaly]:
+        self._errors += 1
+        if self._errors > self.error_budget:
+            return rollout_anomaly(
+                "rollout_canary_error", "canary_errors",
+                float(self._errors), float(self.error_budget),
+                self.generation, self.telemetry,
+            )
+        return None
 
 
 class ProfilerWindow:

@@ -90,6 +90,7 @@ def export_policy(
     params,
     mat_config: MATConfig,
     space_meta: Optional[Dict[str, Any]] = None,
+    generation: Optional[int] = None,
 ) -> Path:
     """Write a self-contained serving artifact: params + policy manifest.
 
@@ -97,19 +98,63 @@ def export_policy(
     :func:`load_policy`) plus free-form ``space_meta`` (env name, obs/act
     space dims/bounds) so a server can validate request shapes without
     importing the env.  No optimizer or ValueNorm state is written.
+
+    ``generation`` is the monotonic ordering counter weight pushers key on
+    (``serving/rollout_ctl.WeightPusher`` pushes only strictly newer
+    generations).  ``None`` auto-assigns ``1 + max(sibling generations)``
+    under the parent directory, so a trainer exporting each interval into
+    ``<root>/<step>/`` gets ordered artifacts for free.
     """
     directory = Path(directory).absolute()
+    if generation is None:
+        generation = next_generation(directory.parent)
     directory.mkdir(parents=True, exist_ok=True)
     ckptr = ocp.StandardCheckpointer()
     ckptr.save(directory / _PARAMS_SUBDIR, params, force=True)
     ckptr.wait_until_finished()
     manifest = {
         "format": "mat_dcml_tpu/policy/v1",
+        "generation": int(generation),
         "mat_config": dataclasses.asdict(mat_config),
         "space_meta": space_meta or {},
     }
     (directory / POLICY_MANIFEST).write_text(json.dumps(manifest, indent=2))
     return directory
+
+
+def read_manifest(directory: str | Path) -> Dict[str, Any]:
+    """Parse an export's manifest without touching the params payload."""
+    manifest_path = Path(directory).absolute() / POLICY_MANIFEST
+    if not manifest_path.exists():
+        raise FileNotFoundError(f"no {POLICY_MANIFEST} under {directory}")
+    return json.loads(manifest_path.read_text())
+
+
+def next_generation(root: str | Path) -> int:
+    """1 + the highest generation of any export under ``root`` (1 if none).
+    Pre-generation manifests count as generation 0."""
+    newest = latest_export(root)
+    return 1 if newest is None else newest[1] + 1
+
+
+def latest_export(root: str | Path) -> Optional[Tuple[Path, int]]:
+    """Scan ``<root>/*/policy_manifest.json`` and return the export with the
+    highest generation as ``(path, generation)``, or None when the root holds
+    no exports.  Unreadable manifests are skipped — a half-written export
+    (the trainer is mid-save) must not wedge the pusher."""
+    root = Path(root).absolute()
+    if not root.is_dir():
+        return None
+    best: Optional[Tuple[Path, int]] = None
+    for manifest_path in root.glob(f"*/{POLICY_MANIFEST}"):
+        try:
+            generation = int(json.loads(manifest_path.read_text())
+                             .get("generation", 0))
+        except (json.JSONDecodeError, OSError, TypeError, ValueError):
+            continue
+        if best is None or generation > best[1]:
+            best = (manifest_path.parent, generation)
+    return best
 
 
 def load_policy(directory: str | Path) -> Tuple[Any, MATConfig, Dict[str, Any]]:

@@ -73,6 +73,13 @@ KNOWN_PREFIXES = (
     # rates, queue depth, per-bucket occupancy (serving_bucket_<B>), batch
     # fill, engine timings — all serving_<field>
     "serving_",
+    # replicated-fleet records (serving/fleet.py fleet_record): replica
+    # counts/health, router retries/sheds, per-replica labeled gauges
+    # (fleet_replica_<rid>_<signal>)
+    "fleet_",
+    # weight-push rollout records (serving/rollout_ctl.py): push/rollback
+    # counters, canary comparison/mismatch totals
+    "rollout_",
 )
 
 # fields that must never go negative (counters, rates, timers, gauges)
@@ -94,6 +101,15 @@ REQUIRED_SERVING = (
     "serving_qps", "serving_ok", "serving_wall_s",
     "serving_p50_ms", "serving_p95_ms", "serving_p99_ms",
     "serving_shed_rate", "serving_deadline_miss_rate", "serving_error_rate",
+)
+
+# a fleet record (identified by fleet_replicas) must carry the replication
+# contract: health/size, router outcome counters, and the rollout totals a
+# dashboard needs to tell "healthy fleet" from "fleet quietly rolling back"
+REQUIRED_FLEET = (
+    "fleet_replicas", "fleet_healthy", "fleet_requests", "fleet_retries",
+    "fleet_unhealthy_marks", "fleet_readmissions", "fleet_generation",
+    "rollout_pushes", "rollout_rollbacks",
 )
 
 # a training record (vs eval/profile records, which are sparse) must have:
@@ -192,7 +208,8 @@ def validate_record(record, index: int = 0, strict_names: bool = True) -> List[s
         if not math.isfinite(v):
             errs.append(f"{where}: field {k!r} is non-finite ({v})")
             continue
-        if (k in NON_NEGATIVE or k.startswith("serving_")) and v < 0:
+        if (k in NON_NEGATIVE
+                or k.startswith(("serving_", "fleet_", "rollout_"))) and v < 0:
             errs.append(f"{where}: field {k!r} is negative ({v})")
         if strict_names and not _known(k):
             errs.append(f"{where}: unknown field {k!r} — document it in "
@@ -201,6 +218,10 @@ def validate_record(record, index: int = 0, strict_names: bool = True) -> List[s
         for k in REQUIRED_SERVING:
             if k not in record:
                 errs.append(f"{where}: serving record missing {k!r}")
+    if "fleet_replicas" in record:  # fleet snapshot record
+        for k in REQUIRED_FLEET:
+            if k not in record:
+                errs.append(f"{where}: fleet record missing {k!r}")
     if "fps" in record:  # training record: enforce the full contract
         fused = record.get("iters_per_dispatch", 1) > 1
         for k in REQUIRED_CORE:

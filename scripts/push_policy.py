@@ -1,0 +1,60 @@
+#!/usr/bin/env python
+"""Push a policy export into a running fleet (canary-gated hot weight swap).
+
+Stdlib HTTP client against ``scripts/serve_fleet.py``'s control endpoints.
+The push blocks until the fleet's canary gate resolves and prints the full
+report (status promoted | rolled_back | rejected, comparison/mismatch counts,
+warm-pass recompiles, requests dropped during the push — expected 0).
+
+Usage:
+  python scripts/push_policy.py --policy_dir exports/gen2 [--host 127.0.0.1]
+      [--port 8420] [--rollback]   # --rollback ignores --policy_dir
+"""
+
+import argparse
+import json
+import sys
+import urllib.error
+import urllib.request
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description="push weights into a MAT fleet")
+    p.add_argument("--policy_dir", default=None,
+                   help="export dir to push (required unless --rollback)")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=8420)
+    p.add_argument("--timeout_s", type=float, default=300.0,
+                   help="HTTP timeout; covers warm passes + the canary gate")
+    p.add_argument("--rollback", action="store_true",
+                   help="roll the fleet back to its prior manifest instead")
+    args = p.parse_args(argv)
+
+    if args.rollback:
+        url = f"http://{args.host}:{args.port}/v1/rollback"
+        body = b"{}"
+    else:
+        if not args.policy_dir:
+            print("--policy_dir is required (or pass --rollback)",
+                  file=sys.stderr)
+            return 2
+        url = f"http://{args.host}:{args.port}/v1/push"
+        body = json.dumps({"policy_dir": args.policy_dir}).encode()
+
+    req = urllib.request.Request(
+        url, data=body, headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=args.timeout_s) as resp:
+            report = json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        print(json.dumps({"http_status": e.code,
+                          **json.loads(e.read() or b"{}")}, indent=2),
+              file=sys.stderr)
+        return 1
+    print(json.dumps(report, indent=2))
+    status = report.get("status", "rolled_back" if args.rollback else "")
+    return 0 if status in ("promoted", "rolled_back") else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv[1:]))

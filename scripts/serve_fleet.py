@@ -1,0 +1,98 @@
+#!/usr/bin/env python
+"""Serve a policy export behind a replicated fleet.
+
+Boots an ``EngineFleet`` (N decode replicas — one per local device when the
+host has several — behind the least-outstanding-requests router), fronts it
+with the stdlib HTTP server, and optionally starts a ``WeightPusher``
+watching an export root so new training generations roll out through the
+canary gate automatically.
+
+Usage:
+  python scripts/serve_fleet.py --policy_dir exports/gen1 \
+      [--replicas 2] [--port 8420] [--buckets 1,8,32,128] \
+      [--watch_root exports] [--poll_interval_s 2.0] \
+      [--canary_comparisons 24] [--max_mismatch_frac 0.25]
+
+Manual pushes hit the running server:
+  curl -X POST localhost:8420/v1/push -d '{"policy_dir": "exports/gen2"}'
+  curl -X POST localhost:8420/v1/rollback
+  curl localhost:8420/fleet
+"""
+
+import argparse
+import sys
+import threading
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from mat_dcml_tpu.utils.platform import apply_platform_override
+
+apply_platform_override()
+
+from mat_dcml_tpu.serving.batcher import BatcherConfig  # noqa: E402
+from mat_dcml_tpu.serving.engine import EngineConfig  # noqa: E402
+from mat_dcml_tpu.serving.fleet import EngineFleet, FleetConfig  # noqa: E402
+from mat_dcml_tpu.serving.rollout_ctl import RolloutConfig, WeightPusher  # noqa: E402
+from mat_dcml_tpu.serving.server import PolicyServer  # noqa: E402
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description="MAT replicated policy fleet")
+    p.add_argument("--policy_dir", required=True,
+                   help="export dir from scripts/export_policy.py")
+    p.add_argument("--replicas", type=int, default=2)
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=8420)
+    p.add_argument("--buckets", default="1,8,32,128")
+    p.add_argument("--max_batch_wait_ms", type=float, default=2.0)
+    p.add_argument("--max_queue", type=int, default=256)
+    p.add_argument("--max_retries", type=int, default=2)
+    p.add_argument("--request_timeout_s", type=float, default=0.0,
+                   help="per-attempt failover watchdog; 0 disables")
+    p.add_argument("--watch_root", default=None,
+                   help="export root to poll for new generations")
+    p.add_argument("--poll_interval_s", type=float, default=2.0)
+    p.add_argument("--canary_comparisons", type=int, default=24)
+    p.add_argument("--max_mismatch_frac", type=float, default=0.25)
+    p.add_argument("--canary_timeout_s", type=float, default=30.0)
+    args = p.parse_args(argv)
+
+    fleet = EngineFleet.from_export(
+        args.policy_dir,
+        fleet_cfg=FleetConfig(
+            n_replicas=args.replicas,
+            max_retries=args.max_retries,
+            request_timeout_s=args.request_timeout_s or None,
+        ),
+        engine_cfg=EngineConfig(
+            buckets=tuple(int(b) for b in args.buckets.split(","))),
+        batcher_cfg=BatcherConfig(max_queue=args.max_queue,
+                                  max_batch_wait_ms=args.max_batch_wait_ms),
+        rollout_cfg=RolloutConfig(
+            canary_comparisons=args.canary_comparisons,
+            max_mismatch_frac=args.max_mismatch_frac,
+            canary_timeout_s=args.canary_timeout_s,
+        ),
+    )
+    server = PolicyServer(fleet=fleet, host=args.host, port=args.port)
+    server.start()
+
+    pusher = None
+    if args.watch_root:
+        pusher = WeightPusher(fleet, args.watch_root,
+                              poll_interval_s=args.poll_interval_s)
+        pusher.start()
+        print(f"[fleet] pusher watching {args.watch_root} every "
+              f"{args.poll_interval_s}s")
+    try:
+        threading.Event().wait()
+    except KeyboardInterrupt:
+        if pusher is not None:
+            pusher.stop()
+        server.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv[1:]))

@@ -1,0 +1,464 @@
+"""Replicated serving fleet (fast tier): routing, failover, hot weight push.
+
+What the PR's acceptance hinges on:
+
+- **routing parity**: a request served through the fleet router is bit-exact
+  to a single reference engine on the same padded batch — replication and
+  per-device placement add nothing.
+- **fault tolerance**: a replica whose engine dies mid-flight is marked
+  unhealthy, its requests retry on a sibling (zero client-visible failures),
+  and the background prober readmits it after consecutive clean probes.
+- **hot weight-swap under live load**: a push with concurrent traffic drops
+  zero requests and triggers zero steady-state recompiles; the gate promotes
+  identical weights.
+- **canary rollback**: a push whose canary disagrees with the incumbent on
+  greedy actions (strict parity budget) rolls the fleet back automatically,
+  records a typed ``rollout_rollback`` anomaly, and keeps serving the prior
+  weights.
+- **schema**: a fleet run's metrics.jsonl (serving record + fleet record +
+  rollout anomaly events) passes scripts/check_metrics_schema.py.
+
+CFG/BUCKETS match tests/test_serving.py exactly so the persistent compile
+cache (tests/conftest.py) makes every fleet's warmup a cache hit.
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import jax
+import numpy as np
+import pytest
+
+from mat_dcml_tpu.models.mat import MATConfig
+from mat_dcml_tpu.models.policy import TransformerPolicy
+from mat_dcml_tpu.serving.batcher import BatcherConfig, QueueFullError
+from mat_dcml_tpu.serving.engine import DecodeEngine, EngineConfig
+from mat_dcml_tpu.serving.fleet import (
+    HEALTHY,
+    UNHEALTHY,
+    EngineFleet,
+    FleetConfig,
+)
+from mat_dcml_tpu.serving.loadgen import run_load, synth_requests
+from mat_dcml_tpu.serving.rollout_ctl import RolloutConfig, WeightPusher
+from mat_dcml_tpu.serving.server import PolicyClient, PolicyServer
+
+BUCKETS = (2, 4)
+
+CFG = MATConfig(
+    n_agent=3, obs_dim=4, state_dim=5, action_dim=3,
+    n_block=1, n_embd=16, n_head=2,
+)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return TransformerPolicy(CFG).init_params(jax.random.key(0))
+
+
+@pytest.fixture(scope="module")
+def params_other():
+    """A different random init: the 'corrupt/wrong artifact' stand-in whose
+    greedy actions disagree with the incumbent's."""
+    return TransformerPolicy(CFG).init_params(jax.random.key(1))
+
+
+def make_fleet(params, n_replicas=2, rollout_cfg=None, fleet_cfg=None):
+    fleet = EngineFleet(
+        params, CFG,
+        fleet_cfg=fleet_cfg or FleetConfig(
+            n_replicas=n_replicas, probe_interval_s=0.05),
+        engine_cfg=EngineConfig(buckets=BUCKETS),
+        batcher_cfg=BatcherConfig(max_batch_wait_ms=2.0),
+        rollout_cfg=rollout_cfg or RolloutConfig(
+            canary_comparisons=6, canary_timeout_s=60.0),
+        log_fn=lambda *a: None,
+    )
+    fleet.warmup()
+    return fleet
+
+
+# -------------------------------------------------------------------- routing
+
+
+def test_fleet_routing_parity_and_spread(params):
+    """Every row served through the router is bit-exact to a standalone
+    engine decoding the same request padded to the smallest bucket, and the
+    least-outstanding router puts work on BOTH replicas."""
+    ref = DecodeEngine(params, CFG, EngineConfig(buckets=BUCKETS),
+                       log_fn=lambda *a: None)
+    ref.warmup()
+    fleet = make_fleet(params)
+    try:
+        client = PolicyClient(fleet)
+        states, obs, avail = synth_requests(CFG, 8, seed=11)
+        for i in range(8):
+            action, log_prob = client.act(states[i], obs[i], avail[i])
+            # the batcher pads a lone request by replicating it to bucket 2
+            ra, rlp = ref.decode(
+                np.stack([states[i], states[i]]),
+                np.stack([obs[i], obs[i]]),
+                np.stack([avail[i], avail[i]]),
+            )
+            np.testing.assert_array_equal(action, ra[0])
+            np.testing.assert_array_equal(log_prob, rlp[0])
+        served = [r.engine.telemetry.counters.get("serving_requests", 0.0)
+                  for r in fleet.replicas]
+        assert all(s > 0 for s in served), f"router starved a replica: {served}"
+        assert fleet.telemetry.counters["fleet_requests"] == 8.0
+    finally:
+        fleet.close()
+
+
+# ----------------------------------------------------------- fault tolerance
+
+
+def test_replica_kill_midflight_retries_on_sibling(params):
+    """Kill replica 0's engine under a wave of traffic: every request still
+    succeeds (decode is pure, retries are idempotent), the victim is marked
+    UNHEALTHY, and after the fault clears the prober readmits it."""
+    fleet = make_fleet(params)
+    victim = fleet.replicas[0]
+    real_decode = victim.engine.decode
+    try:
+        def dead(*a, **kw):
+            raise RuntimeError("injected device loss")
+
+        victim.engine.decode = dead
+        states, obs, avail = synth_requests(CFG, 8, seed=12)
+        futs = [fleet.submit(states[i], obs[i], avail[i]) for i in range(8)]
+        results = [f.result(timeout=30) for f in futs]
+        assert len(results) == 8
+        for action, log_prob in results:
+            assert action.shape == (CFG.n_agent, 1)
+        assert victim.state == UNHEALTHY
+        c = fleet.telemetry.counters
+        assert c["fleet_unhealthy_marks"] >= 1.0
+        assert c["fleet_retries"] >= 1.0
+        assert c.get("fleet_retries_exhausted", 0.0) == 0.0
+
+        # fault clears -> consecutive clean probes readmit the replica
+        victim.engine.decode = real_decode
+        deadline = time.monotonic() + 20.0
+        while victim.state != HEALTHY and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert victim.state == HEALTHY
+        assert fleet.telemetry.counters["fleet_readmissions"] == 1.0
+        # and it serves again
+        action, _ = PolicyClient(fleet).act(states[0], obs[0], avail[0])
+        assert action.shape == (CFG.n_agent, 1)
+    finally:
+        victim.engine.decode = real_decode
+        fleet.close()
+
+
+def test_attempt_timeout_fails_over_to_sibling(params):
+    """A replica that hangs (no exception, just wall-clock) trips the
+    per-attempt watchdog: the request fails over and completes on the
+    sibling long before the hung attempt would have returned."""
+    fleet = make_fleet(
+        params,
+        fleet_cfg=FleetConfig(n_replicas=2, probe_interval_s=10.0,
+                              request_timeout_s=0.3),
+    )
+    victim = fleet.replicas[0]
+    real_decode = victim.engine.decode
+    try:
+        def hung(state, obs, avail):
+            time.sleep(3.0)
+            return real_decode(state, obs, avail)
+
+        victim.engine.decode = hung
+        fleet._rr = 1   # next _pick lands on replica 0 deterministically
+        states, obs, avail = synth_requests(CFG, 1, seed=13)
+        t0 = time.monotonic()
+        fut = fleet.submit(states[0], obs[0], avail[0])
+        action, _ = fut.result(timeout=30)
+        elapsed = time.monotonic() - t0
+        assert action.shape == (CFG.n_agent, 1)
+        assert elapsed < 2.5, f"failover took {elapsed:.1f}s (hung attempt won)"
+        assert fleet.telemetry.counters["fleet_attempt_timeouts"] >= 1.0
+        assert victim.state == UNHEALTHY
+    finally:
+        victim.engine.decode = real_decode
+        fleet.close()
+
+
+def test_all_queues_full_sheds_with_min_retry_after(params, monkeypatch):
+    """When every replica refuses admission the fleet sheds synchronously
+    with a QueueFullError carrying the smallest per-replica backoff hint."""
+    fleet = make_fleet(params)
+    try:
+        for r, hint in zip(fleet.replicas, (7, 3)):
+            def full(*a, _h=hint, **kw):
+                raise QueueFullError("full", retry_after_s=_h)
+            monkeypatch.setattr(r.batcher, "submit", full)
+        states, obs, avail = synth_requests(CFG, 1, seed=14)
+        with pytest.raises(QueueFullError) as exc:
+            fleet.submit(states[0], obs[0], avail[0])
+        assert exc.value.retry_after_s == 3
+        assert fleet.telemetry.counters["fleet_shed"] == 1.0
+    finally:
+        fleet.close()
+
+
+# ----------------------------------------------------------- hot weight push
+
+
+def test_push_under_live_load_drops_nothing(params):
+    """The tentpole contract: a canary-gated weight push with concurrent
+    traffic drops ZERO requests and compiles ZERO programs post-warm."""
+    fleet = make_fleet(params)
+    try:
+        client = PolicyClient(fleet)
+        load_rec = {}
+
+        def drive():
+            load_rec.update(run_load(client, n_requests=96, concurrency=8))
+
+        loader = threading.Thread(target=drive)
+        loader.start()
+        time.sleep(0.05)            # load in flight before the swap starts
+        report = fleet.push(params)  # identical weights: the gate must promote
+        loader.join(timeout=60)
+        assert not loader.is_alive()
+
+        assert report["status"] == "promoted"
+        assert report["push_dropped"] == 0.0
+        assert report["warm_recompiles"] == 0
+        assert report["comparisons"] >= 6
+        assert load_rec["serving_ok"] == 96.0          # zero dropped requests
+        assert load_rec["serving_shed_rate"] == 0.0
+        assert load_rec["serving_error_rate"] == 0.0
+        assert fleet.steady_state_recompiles() == 0.0  # zero recompiles
+        assert fleet.current_generation == 1
+        assert all(r.generation == 1 for r in fleet.replicas)
+        assert all(r.state == HEALTHY for r in fleet.replicas)
+        assert fleet.telemetry.counters["rollout_pushes"] == 1.0
+    finally:
+        fleet.close()
+
+
+def test_canary_parity_mismatch_rolls_back(params, params_other):
+    """Push weights whose greedy actions disagree with the incumbent under a
+    zero-mismatch budget: the gate must roll back, record the typed anomaly,
+    leave the generation unchanged, and keep serving the OLD weights."""
+    fleet = make_fleet(
+        params,
+        rollout_cfg=RolloutConfig(canary_comparisons=8, max_mismatch_frac=0.0,
+                                  canary_timeout_s=60.0),
+    )
+    try:
+        client = PolicyClient(fleet)
+        states, obs, avail = synth_requests(CFG, 1, seed=15)
+        before_action, _ = client.act(states[0], obs[0], avail[0])
+
+        report = fleet.push(params_other)
+        assert report["status"] == "rolled_back"
+        assert report["mismatches"] >= 1
+        kinds = [e["anomaly"] for e in report["events"]]
+        assert "rollout_rollback" in kinds
+        assert any(k.startswith("rollout_canary_") for k in kinds)
+        assert fleet.current_generation == 0           # generation unchanged
+        assert all(r.generation == 0 for r in fleet.replicas)
+        c = fleet.telemetry.counters
+        assert c["rollout_rollbacks"] == 1.0
+        assert c["anomalies_rollout_rollback"] == 1.0
+
+        # the fleet still answers with the incumbent weights, bit-exact
+        after_action, _ = client.act(states[0], obs[0], avail[0])
+        np.testing.assert_array_equal(before_action, after_action)
+        assert all(r.state == HEALTHY for r in fleet.replicas)
+    finally:
+        fleet.close()
+
+
+def test_concurrent_push_rejected(params):
+    fleet = make_fleet(params)
+    try:
+        assert fleet._push_lock.acquire(blocking=False)
+        try:
+            with pytest.raises(RuntimeError, match="already in progress"):
+                fleet.push(params)
+        finally:
+            fleet._push_lock.release()
+    finally:
+        fleet.close()
+
+
+def test_single_replica_push_skips_gate(params):
+    fleet = make_fleet(params, n_replicas=1)
+    try:
+        report = fleet.push(params)
+        assert report["status"] == "promoted"
+        assert fleet.current_generation == 1
+    finally:
+        fleet.close()
+
+
+# ---------------------------------------------- generation counter + pusher
+
+
+def test_export_generation_counter(tmp_path, params):
+    """export_policy auto-assigns 1 + max(sibling generation); latest_export
+    orders artifacts by generation, not mtime or name."""
+    from mat_dcml_tpu.training.checkpoint import (
+        export_policy, latest_export, next_generation, read_manifest,
+    )
+
+    root = tmp_path / "exports"
+    assert latest_export(root) is None
+    assert next_generation(root) == 1
+    export_policy(root / "zz_first", params, CFG)
+    assert read_manifest(root / "zz_first")["generation"] == 1
+    export_policy(root / "aa_second", params, CFG)
+    assert read_manifest(root / "aa_second")["generation"] == 2
+    path, generation = latest_export(root)
+    assert path == (root / "aa_second").absolute() and generation == 2
+    assert next_generation(root) == 3
+    # explicit generation wins over the counter
+    export_policy(root / "pinned", params, CFG, generation=41)
+    assert latest_export(root)[1] == 41
+    # a half-written export (manifest garbage) is skipped, not fatal
+    bad = root / "partial"
+    bad.mkdir()
+    (bad / "policy_manifest.json").write_text("{not json")
+    assert latest_export(root)[1] == 41
+
+
+def test_weight_pusher_pushes_only_newer_generations(tmp_path):
+    """WeightPusher polls latest_export and pushes iff the newest on-disk
+    generation is strictly ahead of the fleet's installed one."""
+    import dataclasses as _dc
+
+    root = tmp_path / "exports"
+    root.mkdir()
+
+    def fake_export(name, generation):
+        d = root / name
+        d.mkdir()
+        (d / "policy_manifest.json").write_text(json.dumps({
+            "format": "mat_dcml_tpu/policy/v1", "generation": generation,
+            "mat_config": _dc.asdict(CFG), "space_meta": {},
+        }))
+        return d
+
+    class FakeFleet:
+        current_generation = 2
+        pushed = []
+
+        def push_from_export(self, path):
+            gen = json.loads(
+                (path / "policy_manifest.json").read_text())["generation"]
+            self.pushed.append(gen)
+            self.current_generation = gen
+            return {"status": "promoted", "generation": gen}
+
+    fleet = FakeFleet()
+    pusher = WeightPusher(fleet, root, log_fn=lambda *a: None)
+    assert pusher.poll_once() is None          # empty root: nothing to push
+    fake_export("gen1", 1)
+    assert pusher.poll_once() is None          # stale generation: skipped
+    fake_export("gen3", 3)
+    report = pusher.poll_once()
+    assert report["status"] == "promoted" and fleet.pushed == [3]
+    assert pusher.poll_once() is None          # idempotent once caught up
+    assert len(pusher.pushes) == 1
+
+
+# ------------------------------------------------------------------- schema
+
+
+def test_fleet_metrics_schema(tmp_path, params):
+    """A fleet run's metrics.jsonl — serving record + fleet record + a typed
+    rollout anomaly event — passes the schema validator."""
+    import sys
+    from pathlib import Path
+
+    sys.path.insert(0, str(Path(__file__).resolve().parent.parent / "scripts"))
+    from check_metrics_schema import validate_file
+
+    from mat_dcml_tpu.utils.metrics import MetricsWriter
+
+    fleet = make_fleet(params)
+    try:
+        record = run_load(PolicyClient(fleet), n_requests=16, concurrency=4)
+        record["steady_state_recompiles"] = fleet.steady_state_recompiles()
+        report = fleet.push(params)
+        assert report["status"] == "promoted"
+        record.update(fleet.fleet_record())
+        writer = MetricsWriter(tmp_path)
+        writer.write(record)
+        for event in fleet.rollout_events:
+            writer.write(event)
+        # rollback events are typed anomaly records; synthesize one so the
+        # validator sees the full vocabulary even on a clean promote
+        from mat_dcml_tpu.telemetry.anomaly import rollout_anomaly
+        writer.write(rollout_anomaly(
+            "rollout_rollback", "canary_verdict", 1.0, 0.0, 2).to_record())
+        writer.close()
+        errs = validate_file(tmp_path / "metrics.jsonl")
+        assert errs == [], errs
+    finally:
+        fleet.close()
+
+
+# ------------------------------------------------------------ HTTP frontend
+
+
+def test_fleet_http_endpoints(tmp_path, params):
+    """Fleet-mode server: /fleet status, canary-gated /v1/push from a real
+    export, /v1/rollback, and 400/409 error mapping."""
+    from mat_dcml_tpu.training.checkpoint import export_policy
+
+    fleet = make_fleet(params)
+    server = PolicyServer(fleet=fleet, port=0, log_fn=lambda *a: None)
+    server.warm = True    # fleet is already warm; don't re-warm on start
+    server.start()
+    base = f"http://127.0.0.1:{server.port}"
+
+    def post(path, payload):
+        req = urllib.request.Request(
+            base + path, data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=120) as r:
+            return json.loads(r.read())
+
+    try:
+        with urllib.request.urlopen(base + "/healthz", timeout=10) as r:
+            health = json.loads(r.read())
+        assert health["fleet"]["replicas"] == 2
+        assert health["fleet"]["healthy"] == 2
+
+        with urllib.request.urlopen(base + "/fleet", timeout=10) as r:
+            status = json.loads(r.read())
+        assert [rep["state"] for rep in status["replicas"]] == [HEALTHY] * 2
+        assert status["generation"] == 0
+
+        # rollback with no prior promoted manifest -> 409
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            post("/v1/rollback", {})
+        assert exc.value.code == 409
+
+        # push a real export of the SAME weights -> gate promotes
+        export_dir = export_policy(tmp_path / "gen1", params, CFG, generation=1)
+        report = post("/v1/push", {"policy_dir": str(export_dir)})
+        assert report["status"] == "promoted"
+        assert report["generation"] == 1
+        assert report["push_dropped"] == 0.0
+
+        # now a prior exists -> manual rollback succeeds
+        report = post("/v1/rollback", {})
+        assert report["status"] == "rolled_back"
+        assert report["generation"] == 0
+
+        # bad artifact -> 400
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            post("/v1/push", {"policy_dir": str(tmp_path / "nope")})
+        assert exc.value.code == 400
+    finally:
+        server.stop()
+    assert fleet.steady_state_recompiles() == 0.0

@@ -270,6 +270,13 @@ def test_graceful_degradation_isolates_poisoned_request(engine, monkeypatch):
         assert action0.shape == action2.shape == (CFG.n_agent, 1)
         assert tel.counters["serving_degraded_batches"] == 1.0
         assert tel.counters["serving_engine_failures"] == 1.0
+        # the degraded path's outcomes are distinct counters: fleet health
+        # scoring tells a limping replica (retrying one-by-one) from a dead
+        # one (failing even the smallest bucket)
+        assert tel.counters["serving_degraded_ok"] == 2.0
+        assert tel.counters["serving_degraded_failed"] == 1.0
+        # degraded singles must NOT inflate the normal served counters
+        assert "serving_batches" not in tel.counters
     finally:
         b.close()
 
@@ -319,6 +326,55 @@ def test_percentiles_empty_and_ordered():
     }
     p = percentiles([1.0, 2.0, 100.0])
     assert p["serving_p50_ms"] <= p["serving_p95_ms"] <= p["serving_p99_ms"]
+
+
+def test_run_load_goodput_under_slo(engine):
+    """Goodput accounting: a generous SLO passes every success; an
+    impossible SLO passes none, even though every request succeeded."""
+    tel = Telemetry()
+    b = ContinuousBatcher(
+        engine, BatcherConfig(max_batch_wait_ms=2.0),
+        telemetry=tel, log_fn=lambda *a: None,
+    )
+    try:
+        rec = run_load(PolicyClient(b), n_requests=12, concurrency=4,
+                       slo_ms=1e9)
+        assert rec["serving_ok"] == 12.0
+        assert rec["serving_goodput_slo"] == 1.0
+        assert rec["serving_goodput_qps"] == pytest.approx(rec["serving_qps"])
+        rec = run_load(PolicyClient(b), n_requests=12, concurrency=4,
+                       slo_ms=1e-6)
+        assert rec["serving_ok"] == 12.0      # requests succeeded...
+        assert rec["serving_goodput_slo"] == 0.0   # ...but none inside SLO
+    finally:
+        b.close()
+
+
+def test_run_load_open_loop_multiclient(engine):
+    """Multi-client open loop: the offered load splits across independent
+    dispatcher schedules; every request is still fired exactly once."""
+    tel = Telemetry()
+    b = ContinuousBatcher(
+        engine, BatcherConfig(max_batch_wait_ms=2.0),
+        telemetry=tel, log_fn=lambda *a: None,
+    )
+    try:
+        rec = run_load(PolicyClient(b), n_requests=12, concurrency=4,
+                       target_qps=400.0, n_clients=3, slo_ms=1e9)
+        assert rec["serving_ok"] == 12.0
+        assert rec["serving_goodput_slo"] == 1.0
+    finally:
+        b.close()
+
+
+def test_stats_snapshot_taken_under_lock(engine, batcher):
+    states, obs, avail = synth_requests(CFG, 2, seed=21)
+    wave(batcher, states, obs, avail)
+    snap = batcher.stats_snapshot()
+    assert snap["queue_depth"] == 0
+    assert snap["counters"]["serving_requests"] == 2.0
+    assert snap["counters"]["serving_batches"] == 1.0
+    assert "serving_queue_depth" in snap["gauges"]
 
 
 # ------------------------------------------------------------ HTTP frontend
@@ -377,3 +433,52 @@ def test_http_server_end_to_end(engine):
     finally:
         server.stop()
     assert engine.steady_state_recompiles() == 0
+
+
+def test_http_429_carries_retry_after_header(engine, monkeypatch):
+    """A shed response tells the client WHEN to come back: the Retry-After
+    header carries the queue-depth-derived backoff hint from the typed
+    QueueFullError, not a constant."""
+    server = PolicyServer(
+        engine, BatcherConfig(max_batch_wait_ms=2.0), port=0,
+        log_fn=lambda *a: None,
+    )
+    server.start()
+    try:
+        def shed(*a, **kw):
+            raise QueueFullError("queue at capacity", retry_after_s=7)
+
+        monkeypatch.setattr(server.batcher, "submit", shed)
+        states, obs, avail = synth_requests(CFG, 1, seed=22)
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{server.port}/v1/act",
+            data=json.dumps({"state": states[0].tolist(),
+                             "obs": obs[0].tolist()}).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(req, timeout=10)
+        assert exc.value.code == 429
+        assert exc.value.headers["Retry-After"] == "7"
+        assert json.loads(exc.value.read())["retry_after_s"] == 7
+    finally:
+        server.stop()
+
+
+def test_retry_after_scales_with_queue_depth(engine):
+    """The batcher's backoff hint grows with queue depth x EMA service
+    time — a deeper queue tells shed clients to stay away longer."""
+    b = ContinuousBatcher(
+        engine, BatcherConfig(max_batch_wait_ms=2.0),
+        telemetry=Telemetry(), log_fn=lambda *a: None,
+    )
+    try:
+        assert b.retry_after_s() >= 1          # empty queue: the 1s floor
+        with b._lock:
+            b._ema_ms_per_req = 500.0
+            b._queue.extend([None] * 10)       # 10 queued x 0.5s = 5s backlog
+            hint = b._retry_after_locked()
+            b._queue.clear()
+        assert hint == 5
+    finally:
+        b.close()
