@@ -57,8 +57,19 @@ Knobs (environment variables):
                         Knobs: BENCH_SERVING_REQUESTS (256),
                         BENCH_SERVING_CONCURRENCY (16),
                         BENCH_SERVING_BUCKETS (1,4,16),
+                        BENCH_SERVING_DECODE_MODE (scan|stride|spec),
+                        BENCH_SERVING_SPEC_BLOCK (8),
                         BENCH_SERVING_RUN_DIR (append the serving records to
                         <dir>/metrics.jsonl)
+  BENCH_SPEC_DECODE     "1" → speculative-decode A/B instead of training:
+                        serve_decode mode="spec" vs mode="scan" on the DCML
+                        preset (A=101), same params/inputs/key, exactness
+                        asserted before timing.  Record value = spec decode
+                        throughput (joint actions/s), vs_baseline = speedup
+                        over scan, plus accept_rate and mean draft passes.
+                        Knobs: BENCH_SPEC_E (256), BENCH_SPEC_K (8 — comma
+                        list → one json line per K, record = best K),
+                        BENCH_SPEC_ITERS (3), BENCH_SPEC_STOCHASTIC ("0")
   BENCH_FLEET           "1" → replicated-fleet leg: closed-loop QPS at each
                         replica count in BENCH_FLEET_REPLICAS (1,2,4), then a
                         live canary-gated weight push under open-loop load on
@@ -731,10 +742,19 @@ def _measure_serving(jax) -> None:
     )
     run_dir = os.environ.get("BENCH_SERVING_RUN_DIR", "")
 
+    # BENCH_SERVING_DECODE_MODE=spec serves the speculative decode through
+    # the same ladder (AOT per bucket, recompile detector armed) so the
+    # serving p50/QPS surface of the spec-vs-scan A/B is one env var away
+    decode_mode = os.environ.get("BENCH_SERVING_DECODE_MODE", "scan")
+    spec_block = int(os.environ.get("BENCH_SERVING_SPEC_BLOCK", "8"))
+
     legs = {}
     for name, bks, wait_ms in (("batched", buckets, 2.0), ("single", (1,), 0.0)):
         engine = DecodeEngine(
-            params, policy.cfg, EngineConfig(buckets=bks), log_fn=log
+            params, policy.cfg,
+            EngineConfig(buckets=bks, decode_mode=decode_mode,
+                         spec_block=spec_block),
+            log_fn=log,
         )
         t0 = time.perf_counter()
         engine.warmup()
@@ -769,6 +789,7 @@ def _measure_serving(jax) -> None:
         "device": dev.device_kind,
         "provisional": False,
         "buckets": ",".join(str(b) for b in buckets),
+        "decode_mode": decode_mode,
         "requests": n_req,
         "concurrency": conc,
         "single_qps": round(single["serving_qps"], 2),
@@ -779,6 +800,100 @@ def _measure_serving(jax) -> None:
         "steady_state_recompiles": batched["steady_state_recompiles"],
     }
     print(json.dumps(record), flush=True)
+
+
+def _measure_spec_decode(jax) -> None:
+    """BENCH_SPEC_DECODE=1 leg: speculative vs sequential decode A/B on the
+    production DCML policy shape (101 agents).  Both legs run the same
+    jit-compiled :func:`serve_decode` entry with identical params, inputs and
+    key — only ``mode`` differs — and the A/B only counts if the outputs are
+    bit-identical, which is asserted before any timing.  The reported number
+    is decode-path throughput in joint actions per second (E x iters /
+    elapsed); ``vs_baseline`` is the spec-over-scan speedup, the number
+    BENCHLOG tracks, alongside the measured acceptance rate and mean draft
+    passes (effective committed-per-pass K-bar = A / passes)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from mat_dcml_tpu.config import RunConfig
+    from mat_dcml_tpu.envs.dcml import DCMLEnv, DCMLEnvConfig
+    from mat_dcml_tpu.models.decode import serve_decode, spec_accept_rate
+    from mat_dcml_tpu.training.runner import build_mat_policy
+
+    data_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)), "data")
+    env = DCMLEnv(DCMLEnvConfig(), data_dir=data_dir)
+    policy = build_mat_policy(RunConfig(), env)
+    cfg = policy.cfg
+    params = policy.init_params(jax.random.key(0))
+
+    E = int(os.environ.get("BENCH_SPEC_E", "256"))
+    iters = int(os.environ.get("BENCH_SPEC_ITERS", "3"))
+    ks = [int(k) for k in os.environ.get("BENCH_SPEC_K", "8").split(",")]
+    deterministic = os.environ.get("BENCH_SPEC_STOCHASTIC", "0") != "1"
+
+    rng = np.random.default_rng(0)
+    state = jnp.asarray(
+        rng.normal(size=(E, cfg.n_agent, cfg.state_dim)), jnp.float32)
+    obs = jnp.asarray(rng.normal(size=(E, cfg.n_agent, cfg.obs_dim)), jnp.float32)
+    avail = jnp.ones((E, cfg.n_agent, cfg.action_dim), jnp.float32)
+    key = jax.random.key(7)
+
+    def timed(fn, *a):
+        out = jax.block_until_ready(fn(*a))          # warm (compile) pass
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = jax.block_until_ready(fn(*a))
+        return out, (time.perf_counter() - t0) / iters
+
+    scan_fn = jax.jit(lambda p, k: serve_decode(
+        cfg, p, k, state, obs, avail, deterministic=deterministic, mode="scan"))
+    (v_ref, r_ref), t_scan = timed(scan_fn, params, key)
+    scan_tp = E / t_scan
+    log(f"spec_decode[scan]: {t_scan * 1e3:.1f} ms/call, "
+        f"{scan_tp:.1f} joint actions/s (E={E}, A={cfg.n_agent})")
+
+    dev = jax.devices()[0]
+    best = None
+    for K in ks:
+        spec_fn = jax.jit(lambda p, k, _K=K: serve_decode(
+            cfg, p, k, state, obs, avail, deterministic=deterministic,
+            mode="spec", spec_block=_K, return_spec_stats=True))
+        (v, r, stats), t_spec = timed(spec_fn, params, key)
+        # the A/B is meaningless unless spec is exact — assert, don't trust
+        assert np.array_equal(np.asarray(r_ref.action), np.asarray(r.action)), \
+            f"spec K={K} diverged from scan (actions)"
+        assert np.array_equal(np.asarray(r_ref.log_prob), np.asarray(r.log_prob)), \
+            f"spec K={K} diverged from scan (log-probs)"
+        passes = float(np.asarray(stats.draft_passes).mean())
+        rate = float(spec_accept_rate(stats))
+        record = {
+            "metric": "dcml_mat_spec_decode_throughput",
+            "value": round(E / t_spec, 2),
+            "unit": "joint_actions/s",
+            "vs_baseline": round(t_scan / t_spec, 2),   # speedup over scan
+            "platform": dev.platform,
+            "device": dev.device_kind,
+            "provisional": dev.platform == "cpu",
+            "E": E,
+            "n_agent": cfg.n_agent,
+            "spec_block": K,
+            "deterministic": deterministic,
+            "accept_rate": round(rate, 4),
+            "draft_passes": round(passes, 2),
+            "k_bar": round(cfg.n_agent / passes, 2),
+            "scan_ms": round(t_scan * 1e3, 2),
+            "spec_ms": round(t_spec * 1e3, 2),
+            "bit_exact": True,
+        }
+        log(f"spec_decode[K={K}]: {t_spec * 1e3:.1f} ms/call, "
+            f"{record['vs_baseline']:.2f}x vs scan, accept {rate:.3f}, "
+            f"passes {passes:.1f} (K-bar {record['k_bar']:.1f})")
+        print(json.dumps(record), flush=True)
+        if best is None or record["value"] > best["value"]:
+            best = record
+    if len(ks) > 1:
+        log(f"spec_decode: best K={best['spec_block']} at "
+            f"{best['value']:.1f} joint actions/s ({best['vs_baseline']:.2f}x)")
 
 
 def _measure_fleet(jax) -> None:
@@ -1108,6 +1223,12 @@ def main() -> None:
     if os.environ.get("BENCH_FLEET", "0") == "1":
         jax, _ = _setup_jax()
         _measure_fleet(jax)
+        return
+
+    # Speculative-decode A/B: exactness-asserted spec-vs-scan decode timing
+    if os.environ.get("BENCH_SPEC_DECODE", "0") == "1":
+        jax, _ = _setup_jax()
+        _measure_spec_decode(jax)
         return
 
     # Orchestrated (deadline-aware) unless the caller manages the chip

@@ -96,6 +96,14 @@ class RunConfig:
     # 1 = replicated. Indivisible agent counts (DCML's 101) zero-pad with
     # masked keys — numerics identical.
     seq_shards: int = 1
+    # rollout decode: "scan" = sequential AR decode, "spec" = speculative
+    # draft-verify decode (models/decode.py:spec_decode) — bit-exact to scan
+    # (actions AND log-probs, via gumbel/noise replay), ~n_agent/K̄ block
+    # passes instead of n_agent sequential steps.  "stride" is reserved for
+    # the deterministic benchmark-protocol path and is not valid here.
+    decode_mode: str = "scan"
+    # speculative window K: draft positions verified per block pass
+    spec_block: int = 8
 
     @property
     def episodes(self) -> int:

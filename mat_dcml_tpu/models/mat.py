@@ -243,6 +243,31 @@ class Decoder(nn.Module):
                 new_caches.append(cache)
             return self.head(x), new_caches
 
+    def decode_block(self, shifted_action_w: jax.Array, rep_w: jax.Array, caches, start):
+        """A window of ``K`` consecutive positions with KV caches (the
+        speculative draft-verify pass).  Not supported for ``dec_actor`` —
+        that ablation has no cached decode to speculate over.
+
+        Args:
+          shifted_action_w: ``(B, K, action_input_dim)`` window inputs
+            (previous agents' one-hot actions / start token at position 0).
+          rep_w: ``(B, K, n_embd)`` encoder rep over the window.
+          caches: list of per-block KV cache dicts.
+          start: scalar window start index (``start + K <= n_agent``).
+
+        Returns:
+          ``(B, K, action_dim)`` logits and updated caches.
+        """
+        with named_scope("mat/decoder_block"):
+            if self.cfg.dec_actor:
+                raise ValueError("decode_block does not support dec_actor")
+            x = self.ln(self._embed_action(shifted_action_w))
+            new_caches = []
+            for blk, cache in zip(self.blocks, caches):
+                x, cache = blk.decode_block(x, rep_w, cache, start)
+                new_caches.append(cache)
+            return self.head(x), new_caches
+
     def _dec_actor_step(self, obs_i: jax.Array, i):
         # Per-agent MLP selected by index: run all agents' MLPs on the same
         # obs and gather row i (tiny model; avoids dynamic param indexing).
@@ -277,6 +302,9 @@ class MultiAgentTransformer(nn.Module):
 
     def decode_step(self, shifted_action_i, rep_i, obs_i, caches, i):
         return self.decoder.decode_step(shifted_action_i, rep_i, obs_i, caches, i)
+
+    def decode_block(self, shifted_action_w, rep_w, caches, start):
+        return self.decoder.decode_block(shifted_action_w, rep_w, caches, start)
 
     def action_std(self):
         return self.decoder.std()

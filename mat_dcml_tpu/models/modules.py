@@ -84,6 +84,25 @@ class SelfAttention(nn.Module):
         y = multi_head_attention(q, k, v, kv_mask=kv_mask)
         return self.proj(merge_heads(y))
 
+    def attend_block(self, query: jax.Array, k_cache: jax.Array, v_cache: jax.Array, qk_mask: jax.Array) -> jax.Array:
+        """Attention for a window of query positions over a static-length cache.
+
+        The K=1 window with ``qk_mask = valid[None]`` is numerically the same
+        program as :meth:`attend_cached` — the speculative decode relies on
+        the per-row results matching the sequential path bit-for-bit.
+
+        Args:
+          query: ``(B, K, D)`` un-projected query inputs.
+          k_cache / v_cache: ``(B, L, D)`` raw projections.
+          qk_mask: ``(K, L)`` or ``(B, K, L)`` per-query validity mask (row
+            j's causal frontier within the cache).
+        """
+        q = split_heads(self.query_p(query), self.n_head)
+        k = split_heads(k_cache, self.n_head)
+        v = split_heads(v_cache, self.n_head)
+        y = multi_head_attention(q, k, v, qk_mask=qk_mask)
+        return self.proj(merge_heads(y))
+
 
 class MlpBlock(nn.Module):
     """The transformer block MLP: Linear-GELU-Linear (``ma_transformer.py:83-87``)."""
@@ -167,6 +186,57 @@ class DecodeBlock(nn.Module):
         cache["v2"] = jax.lax.dynamic_update_slice(cache["v2"], v2, (0, i, 0))
         y2 = self.attn2.attend_cached(rep_i, cache["k2"], cache["v2"], valid)
         h2 = self.ln2(rep_i + y2)
+
+        return self.ln3(h2 + self.mlp(h2)), cache
+
+    def decode_block(self, x: jax.Array, rep_w: jax.Array, cache: dict, start: jax.Array):
+        """Windowed multi-position decode with KV caches (speculative decode).
+
+        Processes ``K`` consecutive positions ``[start, start + K)`` in one
+        pass: cache rows for the window are (re)written from the current
+        draft inputs, and each query row ``j`` attends with its own causal
+        frontier ``<= start + j`` — so a row whose in-window context is
+        already correct produces exactly the ``decode_step`` output.
+
+        Args:
+          x: ``(B, K, D)`` the window's input embeddings.
+          rep_w: ``(B, K, D)`` encoder representation over the window.
+          cache: dict with ``k1, v1, k2, v2`` each ``(B, L, D)``; the caller
+            guarantees ``start + K <= L``.
+          start: scalar window start index, or ``(B,)`` per-row starts (the
+            speculative decode advances each batch row independently).
+
+        Returns:
+          ``(B, K, D)`` block outputs and the updated cache.
+        """
+        L = cache["k1"].shape[1]
+        K = x.shape[1]
+        start = jnp.asarray(start)
+        if start.ndim == 0:
+            qk = jnp.arange(L)[None, :] <= (start + jnp.arange(K))[:, None]
+
+            def put(buf, val):
+                return jax.lax.dynamic_update_slice(buf, val, (0, start, 0))
+        else:
+            rows = jnp.arange(x.shape[0])[:, None]
+            idx = start[:, None] + jnp.arange(K)                   # (B, K)
+            qk = jnp.arange(L)[None, None, :] <= idx[..., None]    # (B, K, L)
+
+            def put(buf, val):
+                return buf.at[rows, idx].set(val)
+
+        k1, v1 = self.attn1.project_kv(x)
+        cache = dict(cache)
+        cache["k1"] = put(cache["k1"], k1)
+        cache["v1"] = put(cache["v1"], v1)
+        y = self.attn1.attend_block(x, cache["k1"], cache["v1"], qk)
+        h = self.ln1(x + y)
+
+        k2, v2 = self.attn2.project_kv(h)
+        cache["k2"] = put(cache["k2"], k2)
+        cache["v2"] = put(cache["v2"], v2)
+        y2 = self.attn2.attend_block(rep_w, cache["k2"], cache["v2"], qk)
+        h2 = self.ln2(rep_w + y2)
 
         return self.ln3(h2 + self.mlp(h2)), cache
 

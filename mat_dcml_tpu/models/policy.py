@@ -40,8 +40,14 @@ class TransformerPolicy:
     evaluate_actions / act) with explicit PRNG keys instead of global torch RNG.
     """
 
-    def __init__(self, cfg: MATConfig):
+    def __init__(self, cfg: MATConfig, decode_mode: str = "scan", spec_block: int = 8):
+        if decode_mode not in decode_lib.DECODE_MODES:
+            raise ValueError(
+                f"decode_mode must be one of {decode_lib.DECODE_MODES}, got {decode_mode!r}"
+            )
         self.cfg = cfg
+        self.decode_mode = decode_mode
+        self.spec_block = spec_block
         self.model = MultiAgentTransformer(cfg)
         # optional context parallelism: when set (a Mesh with a "seq" axis),
         # the teacher-forced training forward ring-shards the agent axis
@@ -82,12 +88,39 @@ class TransformerPolicy:
 
         Routes through :func:`decode.serve_decode` — the same params-only
         entry ``serving/engine.py`` compiles — so rollout and serving share
-        one code path."""
+        one code path.  ``decode_mode="spec"`` swaps in the bit-exact
+        speculative decoder; outputs are identical, only speed differs."""
+        out, _ = self.get_actions_with_stats(
+            params, key, state, obs, available_actions, deterministic
+        )
+        return out
+
+    def get_actions_with_stats(
+        self,
+        params,
+        key: jax.Array,
+        state: jax.Array,
+        obs: jax.Array,
+        available_actions: Optional[jax.Array] = None,
+        deterministic: bool = False,
+    ) -> Tuple[PolicyOutput, Optional[decode_lib.SpecStats]]:
+        """:meth:`get_actions` plus the speculative-decode telemetry.
+
+        Returns ``(output, stats)`` where ``stats`` is a
+        :class:`decode.SpecStats` when ``decode_mode == "spec"`` and ``None``
+        otherwise (scan has no draft/verify structure to report)."""
+        if self.decode_mode == "spec":
+            v_loc, res, stats = decode_lib.serve_decode(
+                self.cfg, params, key, state, obs, available_actions,
+                deterministic=deterministic, mode="spec",
+                spec_block=self.spec_block, return_spec_stats=True,
+            )
+            return PolicyOutput(v_loc, res.action, res.log_prob), stats
         v_loc, res = decode_lib.serve_decode(
             self.cfg, params, key, state, obs, available_actions,
-            deterministic=deterministic, mode="scan",
+            deterministic=deterministic, mode=self.decode_mode,
         )
-        return PolicyOutput(v_loc, res.action, res.log_prob)
+        return PolicyOutput(v_loc, res.action, res.log_prob), None
 
     def act_stride(
         self,

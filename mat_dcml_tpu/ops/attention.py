@@ -87,6 +87,7 @@ def multi_head_attention(
     *,
     causal: bool = False,
     kv_mask: jax.Array | None = None,
+    qk_mask: jax.Array | None = None,
     impl: str | None = None,
 ) -> jax.Array:
     """Scaled dot-product attention.
@@ -101,11 +102,20 @@ def multi_head_attention(
       kv_mask: optional ``(Lk,)`` or ``(B, Lk)`` boolean mask of valid key
         positions (used by the KV-cached decode where the cache has static
         length but only a prefix is populated).
+      qk_mask: optional ``(Lq, Lk)`` or ``(B, Lq, Lk)`` boolean per-query
+        validity mask — the block-windowed cached decode (``spec_decode``)
+        attends a window of Lq queries against the full cache, each with its
+        own causal frontier (per batch row when the window start differs per
+        row).  XLA path only.
 
     Returns:
       ``(B, H, Lq, Dh)`` attention output (before the output projection).
     """
     chosen = _resolve_impl(impl, k.shape[-2])
+    if qk_mask is not None and chosen != "xla":
+        raise ValueError(
+            f"qk_mask is only supported by the XLA attention path, got impl={chosen!r}"
+        )
     if chosen == "ring":
         # context parallelism: this call site is inside shard_map with the
         # length axis sharded over the ring axis; K/V shards rotate with
@@ -145,6 +155,9 @@ def multi_head_attention(
             m = kv_mask[None, None, None, :]
         else:
             m = kv_mask[:, None, None, :]
+        att = jnp.where(m, att, NEG_INF)
+    if qk_mask is not None:
+        m = qk_mask[None, None] if qk_mask.ndim == 2 else qk_mask[:, None]
         att = jnp.where(m, att, NEG_INF)
     att = jax.nn.softmax(att, axis=-1).astype(v.dtype)
     return jnp.einsum("bhqk,bhkd->bhqd", att, v)

@@ -56,6 +56,15 @@ def normal_sample(key: jax.Array, mean: jax.Array, std: jax.Array) -> jax.Array:
     return mean + std * jax.random.normal(key, mean.shape, dtype=mean.dtype)
 
 
+def normal_sample_from_noise(mean: jax.Array, std: jax.Array, noise: jax.Array) -> jax.Array:
+    """``mean + std * noise`` with the product pinned behind an optimization
+    barrier, so the expression rounds identically in every compilation context
+    (scan body, while body, eager).  Without the barrier XLA may contract the
+    multiply-add into an FMA inside one loop body but not another — a 1-ulp
+    drift that breaks the speculative decode's bit-exactness contract."""
+    return mean + jax.lax.optimization_barrier(std * noise)
+
+
 def normal_log_prob(mean: jax.Array, std: jax.Array, action: jax.Array) -> jax.Array:
     var = std * std
     return -((action - mean) ** 2) / (2 * var) - jnp.log(std) - 0.5 * LOG_2PI

@@ -35,8 +35,12 @@ class EngineConfig:
     batcher pads each dispatch up to the smallest bucket that fits."""
 
     buckets: Tuple[int, ...] = (1, 8, 32, 128)
-    decode_mode: str = "scan"     # "scan" (exact) | "stride" (block-commit)
+    # "scan" (exact sequential) | "spec" (speculative draft-verify, bit-exact
+    # to scan — models/decode.py:spec_decode) | "stride" (block-commit
+    # approximation, benchmark-protocol parity only)
+    decode_mode: str = "scan"
     stride: int = 2
+    spec_block: int = 8           # speculative window K
     deterministic: bool = True
 
     def __post_init__(self):
@@ -44,6 +48,10 @@ class EngineConfig:
             raise ValueError("EngineConfig.buckets must be non-empty")
         if list(self.buckets) != sorted(set(self.buckets)):
             raise ValueError(f"buckets must be strictly ascending, got {self.buckets}")
+        if self.decode_mode not in ("scan", "stride", "spec"):
+            raise ValueError(
+                f"decode_mode must be 'scan', 'stride' or 'spec', got {self.decode_mode!r}"
+            )
 
 
 class DecodeEngine:
@@ -69,7 +77,17 @@ class DecodeEngine:
         self._params = self._put(params)   # resident once, shared by all buckets
         ecfg = engine_cfg
 
+        self._spec = ecfg.decode_mode == "spec"
+
         def _decode(params, key, state, obs, avail):
+            if ecfg.decode_mode == "spec":
+                _, res, stats = serve_decode(
+                    cfg, params, key, state, obs, avail,
+                    deterministic=ecfg.deterministic,
+                    mode="spec", spec_block=ecfg.spec_block,
+                    return_spec_stats=True,
+                )
+                return res.action, res.log_prob, stats
             _, res = serve_decode(
                 cfg, params, key, state, obs, avail,
                 deterministic=ecfg.deterministic,
@@ -189,12 +207,27 @@ class DecodeEngine:
         params = self._params
         # availability guards the discrete heads; the mask rows for padding
         # slots are all-ones so masked-softmax never sees a -inf-only row
-        action, log_prob = self._decode(
+        out = self._decode(
             params, self._key,
             self._put(jnp.asarray(state, jnp.float32)),
             self._put(jnp.asarray(obs, jnp.float32)),
             self._put(jnp.asarray(avail, jnp.float32)),
         )
+        if self._spec:
+            action, log_prob, stats = out
+            # per-dispatch speculative health (padding rows included — they
+            # run the same program and drag acceptance the same way)
+            passes = np.asarray(stats.draft_passes)
+            offered = float(np.asarray(stats.drafts_offered).sum())
+            accepted = float(np.asarray(stats.drafts_accepted).sum())
+            tel = self.telemetry
+            tel.gauge("decode_spec_draft_passes", float(passes.mean()))
+            tel.gauge("decode_spec_verify_passes",
+                      float(np.asarray(stats.verify_passes).mean()))
+            tel.gauge("decode_spec_accept_rate",
+                      accepted / offered if offered > 0 else 1.0)
+            return np.asarray(action), np.asarray(log_prob)
+        action, log_prob = out
         return np.asarray(action), np.asarray(log_prob)
 
     # ------------------------------------------------------------ accounting

@@ -247,7 +247,7 @@ def main(argv=None) -> None:
 
     Usage: python -m mat_dcml_tpu.serving.server --policy_dir <export>
            [--port 8420] [--buckets 1,8,32,128] [--max_batch_wait_ms 2.0]
-           [--max_queue 256] [--decode_mode scan|stride]
+           [--max_queue 256] [--decode_mode scan|stride|spec] [--spec_block 8]
     """
     import argparse
 
@@ -259,7 +259,8 @@ def main(argv=None) -> None:
     p.add_argument("--buckets", default="1,8,32,128")
     p.add_argument("--max_batch_wait_ms", type=float, default=2.0)
     p.add_argument("--max_queue", type=int, default=256)
-    p.add_argument("--decode_mode", default="scan", choices=("scan", "stride"))
+    p.add_argument("--decode_mode", default="scan", choices=("scan", "stride", "spec"))
+    p.add_argument("--spec_block", type=int, default=8)
     args = p.parse_args(argv)
 
     engine = DecodeEngine.from_export(
@@ -267,6 +268,7 @@ def main(argv=None) -> None:
         EngineConfig(
             buckets=tuple(int(b) for b in args.buckets.split(",")),
             decode_mode=args.decode_mode,
+            spec_block=args.spec_block,
         ),
     )
     server = PolicyServer(

@@ -412,6 +412,15 @@ class BaseRunner:
                 has_info = "done_delay_sum" in stats
                 agg_delay += stats.get("done_delay_sum", 0.0)
                 agg_pay += stats.get("done_payment_sum", 0.0)
+                if "spec_draft_passes" in stats:
+                    # speculative decode health: block passes per decode (K̄ =
+                    # n_agent / draft_passes) and the draft acceptance rate
+                    tel.gauge("decode_spec_draft_passes", stats["spec_draft_passes"])
+                    tel.gauge("decode_spec_verify_passes", stats["spec_verify_passes"])
+                    off = stats["spec_drafts_offered"]
+                    acc = stats["spec_drafts_accepted"]
+                    tel.gauge("decode_spec_accept_rate",
+                              acc / off if off > 0 else 1.0)
             else:
                 # host-side episode metric accumulation (one device->host copy)
                 rew_arr = np.asarray(traj.rewards)             # (T, E, A, n_obj)
@@ -591,6 +600,15 @@ class BaseRunner:
                     self._handle_anomalies(trips, ep_last - K + 1,
                                            (ep_last + 1) * T * E, reference)
             stats = {k: np.asarray(v) for k, v in stats.items()}
+            if "spec_draft_passes" in stats:
+                # stacked (K,) per-iteration values -> dispatch-level gauges
+                tel.gauge("decode_spec_draft_passes",
+                          float(np.mean(stats["spec_draft_passes"])))
+                tel.gauge("decode_spec_verify_passes",
+                          float(np.mean(stats["spec_verify_passes"])))
+                off = float(np.sum(stats["spec_drafts_offered"]))
+                acc = float(np.sum(stats["spec_drafts_accepted"]))
+                tel.gauge("decode_spec_accept_rate", acc / off if off > 0 else 1.0)
             agg["done"] += float(stats["n_done"].sum())
             agg["rew"] += float(stats["done_reward_sum"].sum())
             if "done_delay_sum" in stats:

@@ -135,12 +135,22 @@ class RolloutCollector:
     def collect(self, params, rollout_state: RolloutState) -> Tuple[RolloutState, Trajectory]:
         """Roll ``T`` steps; pure function of (params, rollout_state)."""
 
+        use_spec = getattr(self.policy, "decode_mode", "scan") == "spec"
+
         def body(carry, _):
             st = carry
             key, k_act = jax.random.split(st.rng)
-            out = self.policy.get_actions(
-                params, k_act, st.share_obs, st.obs, st.available_actions, deterministic=False
-            )
+            if use_spec:
+                out, spec = self.policy.get_actions_with_stats(
+                    params, k_act, st.share_obs, st.obs, st.available_actions,
+                    deterministic=False,
+                )
+            else:
+                spec = None
+                out = self.policy.get_actions(
+                    params, k_act, st.share_obs, st.obs, st.available_actions,
+                    deterministic=False,
+                )
             env_states, ts = jax.vmap(self.env.step)(st.env_states, out.action)
             done_env = ts.done.all(axis=1)                      # (E,)
             # strongly-typed float32: a weak-typed mask in the carry would give
@@ -177,6 +187,15 @@ class RolloutCollector:
                 _flushed=flushed,
                 _n_done=n_done,
             )
+            if use_spec:
+                # per-step speculative-decode aggregates: mean passes over the
+                # env batch, summed draft counters (ratio taken host-side)
+                transition["_spec"] = jnp.stack([
+                    spec.draft_passes.mean(),
+                    spec.verify_passes.mean(),
+                    spec.drafts_offered.sum(),
+                    spec.drafts_accepted.sum(),
+                ])
             if self.dynamic_coefficients:
                 # the weights in effect for THIS step; resample where the
                 # episode just ended so the next episode gets a fresh preference
@@ -216,6 +235,12 @@ class RolloutCollector:
         if self.n_objective > 1:
             for i in range(self.n_objective):
                 chunk_stats[f"step_objective_{i}_mean"] = tr["rewards"][..., i].mean()
+        if use_spec:
+            sp = tr.pop("_spec")                            # (T, 4)
+            chunk_stats["spec_draft_passes"] = sp[:, 0].mean()
+            chunk_stats["spec_verify_passes"] = sp[:, 1].mean()
+            chunk_stats["spec_drafts_offered"] = sp[:, 2].sum()
+            chunk_stats["spec_drafts_accepted"] = sp[:, 3].sum()
 
         masks = jnp.concatenate([rollout_state.mask[None], tr["next_mask"]], axis=0)
         active = jnp.ones_like(masks)

@@ -85,7 +85,21 @@ def build_mat_policy(run: RunConfig, env: DCMLEnv) -> TransformerPolicy:
         # channels — the reconstructed MO-MAT (SURVEY.md §2.4 missing modules)
         n_objective=n_objective,
     )
-    return TransformerPolicy(cfg)
+    if run.decode_mode == "spec" and cfg.dec_actor:
+        # spec_decode needs the shared autoregressive decoder: the dec_actor
+        # ablation's per-agent MLPs have no KV-cache/draft structure to verify.
+        raise ValueError(
+            "decode_mode='spec' is incompatible with dec_actor/mat_dec; "
+            "use decode_mode='scan'"
+        )
+    if run.decode_mode == "stride":
+        # stride is the deterministic benchmark-protocol decode (evaluate()'s
+        # stride= arg); it cannot sample, so it cannot collect rollouts.
+        raise ValueError(
+            "decode_mode='stride' is eval-only (see DCMLRunner.evaluate); "
+            "training collect needs 'scan' or 'spec'"
+        )
+    return TransformerPolicy(cfg, decode_mode=run.decode_mode, spec_block=run.spec_block)
 
 
 def build_dcml_components(run: RunConfig, ppo: PPOConfig, env: DCMLEnv):

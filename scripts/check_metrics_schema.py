@@ -58,6 +58,12 @@ KNOWN_FIELDS = {
     "profile_collect_sec", "profile_train_sec", "profile_dispatch_sec",
     # SMAC win rate (smac_runner._extra_metrics)
     "incre_win_rate",
+    # speculative decode health (models/decode.py spec_decode, gauged from
+    # both training collect — base_runner — and serving — engine.decode):
+    # mean block passes per decode call, passes that verified outstanding
+    # drafts, and the draft acceptance rate (bounded to [0, 1] below)
+    "decode_spec_draft_passes", "decode_spec_verify_passes",
+    "decode_spec_accept_rate",
 }
 
 # open families: per-objective channels, eval protocol fields, per-function
@@ -93,7 +99,12 @@ NON_NEGATIVE = (
     "bytes_per_update", "bytes_per_collect", "bytes_per_dispatch",
     "iters_per_dispatch", "dispatch_count", "dispatches_per_sec",
     "profile_dispatch_sec",
+    "decode_spec_draft_passes", "decode_spec_verify_passes",
+    "decode_spec_accept_rate",
 )
+
+# rates that must stay within [0, 1] (acceptance is accepted/offered)
+UNIT_INTERVAL = ("decode_spec_accept_rate",)
 
 # a serving record (identified by serving_qps) must carry the benchmark
 # contract BENCHLOG consumes: throughput, latency percentiles, shed rate
@@ -211,6 +222,8 @@ def validate_record(record, index: int = 0, strict_names: bool = True) -> List[s
         if (k in NON_NEGATIVE
                 or k.startswith(("serving_", "fleet_", "rollout_"))) and v < 0:
             errs.append(f"{where}: field {k!r} is negative ({v})")
+        if k in UNIT_INTERVAL and not (0.0 <= v <= 1.0):
+            errs.append(f"{where}: field {k!r} must be in [0, 1], got {v}")
         if strict_names and not _known(k):
             errs.append(f"{where}: unknown field {k!r} — document it in "
                         f"README.md and scripts/check_metrics_schema.py")

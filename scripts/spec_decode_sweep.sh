@@ -1,0 +1,18 @@
+#!/bin/sh
+# Sweep the speculative-decode window K: BENCH_SPEC_DECODE drives bench.py's
+# spec-vs-scan A/B (models/decode.py:spec_decode, bit-exactness asserted
+# before timing) once per K and emits one json record per K plus the best.
+# The interesting trade: larger K means fewer draft-verify passes when
+# acceptance is high but more wasted window compute per rejection.  Default
+# E is the production DCML rollout batch; on CPU the numbers are protocol
+# checks, not the TPU speedup of record — export JAX_PLATFORMS/BENCH_SPEC_E
+# on a chip session for the real curve.
+cd "$(dirname "$0")/.."
+exec env \
+  JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
+  BENCH_SPEC_DECODE=1 \
+  BENCH_SPEC_K="${BENCH_SPEC_K:-2,4,8,16}" \
+  BENCH_SPEC_E="${BENCH_SPEC_E:-256}" \
+  BENCH_SPEC_ITERS="${BENCH_SPEC_ITERS:-3}" \
+  BENCH_SPEC_STOCHASTIC="${BENCH_SPEC_STOCHASTIC:-0}" \
+  python bench.py

@@ -1,0 +1,339 @@
+"""Speculative decode (models/decode.py:spec_decode) contract tests.
+
+The load-bearing claim: ``mode="spec"`` is BIT-EXACT to ``mode="scan"`` —
+actions AND log-probs, deterministic and stochastic (gumbel/noise replay) —
+while replacing A sequential decoder steps with ~A/K̄ windowed block passes.
+Exactness holds because the committed prefix's feeds are always the exact
+one-hots, the windowed ``decode_block`` pass equals ``decode_step`` bitwise
+per row, and sampling is a pure function of logits once the noise is
+precomputed on the ar_decode key chain.
+
+Also pinned here: the serving engine's spec bucket programs (padding
+included, zero steady-state recompiles), the adversarial ≈0-acceptance
+construction proving graceful fallback to ~A passes, and the typed errors
+for unsupported modes/configs.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import flax.traverse_util
+
+from mat_dcml_tpu.models import decode as decode_lib
+from mat_dcml_tpu.models.decode import serve_decode, spec_accept_rate, stride_decode
+from mat_dcml_tpu.models.mat import (
+    CONTINUOUS,
+    DISCRETE,
+    SEMI_DISCRETE,
+    MATConfig,
+    MultiAgentTransformer,
+)
+from mat_dcml_tpu.models.policy import TransformerPolicy
+from tests.test_decode import make_policy, rollout_inputs
+
+
+def _spec_vs_scan(cfg, params, state, obs, ava, deterministic, block):
+    key = jax.random.key(42)
+    v1, r1 = serve_decode(
+        cfg, params, key, state, obs, ava, deterministic=deterministic, mode="scan"
+    )
+    v2, r2, stats = serve_decode(
+        cfg, params, key, state, obs, ava, deterministic=deterministic,
+        mode="spec", spec_block=block, return_spec_stats=True,
+    )
+    return (v1, r1), (v2, r2), stats
+
+
+@pytest.mark.parametrize("action_type", [DISCRETE, SEMI_DISCRETE])
+@pytest.mark.parametrize("deterministic", [True, False])
+def test_spec_bit_exact_vs_scan(action_type, deterministic):
+    """Actions, log-probs and values identical bit-for-bit, K=3 over A=7
+    (uneven windows: the final window overlaps already-committed rows)."""
+    kw = {"semi_index": -1} if action_type == SEMI_DISCRETE else {}
+    pol, params = make_policy(action_type, **kw)
+    cfg = pol.cfg
+    state, obs, ava = rollout_inputs(cfg)
+    (v1, r1), (v2, r2), stats = _spec_vs_scan(
+        cfg, params, state, obs, ava, deterministic, block=3
+    )
+    assert np.array_equal(np.asarray(r1.action), np.asarray(r2.action))
+    assert np.array_equal(np.asarray(r1.log_prob), np.asarray(r2.log_prob))
+    assert np.array_equal(np.asarray(v1), np.asarray(v2))
+    # stats sanity: every row decodes in [full-accept, sequential] passes
+    passes = np.asarray(stats.draft_passes)
+    assert np.all(passes >= 1) and np.all(passes <= cfg.n_agent)
+    offered = np.asarray(stats.drafts_offered)
+    accepted = np.asarray(stats.drafts_accepted)
+    assert np.all(accepted >= 0) and np.all(accepted <= offered)
+    assert 0.0 <= float(spec_accept_rate(stats)) <= 1.0
+    assert np.all(np.asarray(stats.verify_passes) <= passes)
+
+
+def test_spec_available_actions_none_and_k_clamp():
+    """``available_actions=None`` synthesizes the all-ones mask; block > A
+    clamps to A (single pure-draft window, nothing offered -> rate 1.0)."""
+    pol, params = make_policy(DISCRETE)
+    cfg = pol.cfg
+    state, obs, _ = rollout_inputs(cfg)
+    (v1, r1), (v2, r2), stats = _spec_vs_scan(
+        cfg, params, state, obs, None, False, block=64
+    )
+    assert np.array_equal(np.asarray(r1.action), np.asarray(r2.action))
+    assert np.array_equal(np.asarray(r1.log_prob), np.asarray(r2.log_prob))
+    assert np.array_equal(np.asarray(v1), np.asarray(v2))
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("block", [1, 5, 16])
+def test_spec_bit_exact_block_sweep(block):
+    """K=1 degenerates to sequential-equivalent; K>=A is one full window."""
+    pol, params = make_policy(SEMI_DISCRETE, semi_index=-1)
+    cfg = pol.cfg
+    state, obs, ava = rollout_inputs(cfg)
+    (v1, r1), (v2, r2), stats = _spec_vs_scan(
+        cfg, params, state, obs, ava, False, block=block
+    )
+    assert np.array_equal(np.asarray(r1.action), np.asarray(r2.action))
+    assert np.array_equal(np.asarray(r1.log_prob), np.asarray(r2.log_prob))
+    if block == 1:
+        assert np.all(np.asarray(stats.draft_passes) == cfg.n_agent)
+
+
+@pytest.mark.slow
+def test_spec_bit_exact_jitted_larger():
+    """Jit-compiled parity at a larger agent count / batch (DCML-shaped
+    semi-discrete: continuous tail on the last agent)."""
+    pol, params = make_policy(SEMI_DISCRETE, n_agent=13, semi_index=-1)
+    cfg = pol.cfg
+    state, obs, ava = rollout_inputs(cfg, batch=8)
+    key = jax.random.key(3)
+    f1 = jax.jit(lambda p, k: serve_decode(
+        cfg, p, k, state, obs, ava, deterministic=False, mode="scan"))
+    f2 = jax.jit(lambda p, k: serve_decode(
+        cfg, p, k, state, obs, ava, deterministic=False, mode="spec",
+        spec_block=4, return_spec_stats=True))
+    v1, r1 = f1(params, key)
+    v2, r2, stats = f2(params, key)
+    assert np.array_equal(np.asarray(r1.action), np.asarray(r2.action))
+    assert np.array_equal(np.asarray(r1.log_prob), np.asarray(r2.log_prob))
+    assert np.array_equal(np.asarray(v1), np.asarray(v2))
+
+
+# --------------------------------------------------------------- adversarial
+
+
+def adversarial_params(cfg, seed=0):
+    """Hand-built weights making every action depend on its predecessor.
+
+    All kernels/biases are zeroed except: the action embedding maps the
+    start token and action 1 to ``+d`` and action 0 to ``-d``; the decode
+    block's cross-attention value/proj are identity (uniform attention then
+    mixes the +-d feed chain into the stream); the head maps the two
+    reachable trunk directions to opposite argmaxes with a tie-breaking
+    bias.  The resulting policy alternates actions based on the running
+    feed sum — a draft computed from stale feeds is almost always wrong, so
+    acceptance collapses and spec must fall back to ~A sequential passes.
+    """
+    model = MultiAgentTransformer(cfg)
+    D = cfg.n_embd
+    rng = np.random.default_rng(seed)
+    z = jnp.zeros((1, cfg.n_agent, cfg.state_dim), jnp.float32)
+    o = jnp.zeros((1, cfg.n_agent, cfg.obs_dim), jnp.float32)
+    params = model.init(
+        jax.random.key(2), z, o,
+        jnp.zeros((1, cfg.n_agent, cfg.action_input_dim), jnp.float32),
+    )
+    flat = flax.traverse_util.flatten_dict(params["params"])
+    for k in list(flat):
+        if k[-1] != "scale":          # keep LayerNorm scales at 1
+            flat[k] = jnp.zeros_like(flat[k])
+    d = jnp.asarray(rng.normal(size=(D,)), jnp.float32)
+    ke = [k for k in flat
+          if "action_encoder_nobias" in "/".join(k) and k[-1] == "kernel"][0]
+    flat[ke] = jnp.stack([d, -d, d], axis=0)     # [start, action0, action1]
+    for k in list(flat):
+        name = "/".join(k)
+        if "attn2" in name and k[-1] == "kernel" and (
+                "value" in name or "proj" in name):
+            flat[k] = jnp.eye(D, dtype=jnp.float32)
+
+    def ln(v):
+        m = v.mean()
+        return (v - m) / jnp.sqrt(((v - m) ** 2).mean() + 1e-6)
+
+    z3 = ln(ln(ln(ln(d))))                       # trunk output for +d chains
+    gp = ln(jax.nn.gelu(z3, approximate=False))
+    gm = ln(jax.nn.gelu(-z3, approximate=False))
+    flat[("decoder", "head", "Dense_0", "kernel")] = jnp.eye(D, dtype=jnp.float32)
+    flat[("decoder", "head", "Dense_1", "kernel")] = jnp.stack([gp, gm], axis=1)
+    flat[("decoder", "head", "Dense_1", "bias")] = jnp.asarray([-0.1, 0.0], jnp.float32)
+    return {"params": flax.traverse_util.unflatten_dict(flat)}
+
+
+@pytest.mark.parametrize(
+    "deterministic",
+    [True, pytest.param(False, marks=pytest.mark.slow)],
+)
+def test_spec_adversarial_near_zero_acceptance(deterministic):
+    """Acceptance collapse is a SPEED regression only: outputs stay exact
+    and the loop degrades gracefully to at most A passes."""
+    cfg = MATConfig(n_agent=8, action_dim=2, obs_dim=5, state_dim=11,
+                    n_block=1, n_embd=16, n_head=2, action_type=DISCRETE)
+    params = adversarial_params(cfg)
+    rng = np.random.default_rng(0)
+    state = jnp.asarray(rng.normal(size=(3, cfg.n_agent, cfg.state_dim)), jnp.float32)
+    obs = jnp.asarray(rng.normal(size=(3, cfg.n_agent, cfg.obs_dim)), jnp.float32)
+    (v1, r1), (v2, r2), stats = _spec_vs_scan(
+        cfg, params, state, obs, None, deterministic, block=4
+    )
+    assert np.array_equal(np.asarray(r1.action), np.asarray(r2.action))
+    assert np.array_equal(np.asarray(r1.log_prob), np.asarray(r2.log_prob))
+    passes = np.asarray(stats.draft_passes)
+    assert np.all(passes <= cfg.n_agent)          # graceful: bounded by A
+    if deterministic:
+        # the crafted chain rejects essentially every draft
+        assert float(spec_accept_rate(stats)) < 0.15
+        assert np.all(passes >= cfg.n_agent - 1)
+
+
+# ------------------------------------------------------------------- serving
+
+
+BUCKETS = (2, 4)
+
+
+def _engines():
+    from mat_dcml_tpu.serving.engine import DecodeEngine, EngineConfig
+
+    pol, params = make_policy(SEMI_DISCRETE, semi_index=-1)
+    scan = DecodeEngine(params, pol.cfg, EngineConfig(buckets=BUCKETS),
+                        log_fn=lambda *a: None)
+    spec = DecodeEngine(params, pol.cfg,
+                        EngineConfig(buckets=BUCKETS, decode_mode="spec",
+                                     spec_block=3),
+                        log_fn=lambda *a: None)
+    scan.warmup()
+    spec.warmup()
+    return pol.cfg, scan, spec
+
+
+def test_spec_serving_buckets_bit_exact_with_padding():
+    """Both bucket programs agree with scan row-for-row, including dispatches
+    padded up to the bucket size, with zero steady-state recompiles."""
+    cfg, scan, spec = _engines()
+    rng = np.random.default_rng(5)
+    for n in (1, 2, 3, 4):                        # 1,3 pad; 2,4 exact fit
+        b = spec.bucket_for(n)
+        assert b in BUCKETS
+        state = rng.normal(size=(b, cfg.n_agent, cfg.state_dim)).astype(np.float32)
+        obs = rng.normal(size=(b, cfg.n_agent, cfg.obs_dim)).astype(np.float32)
+        avail = np.ones((b, cfg.n_agent, cfg.action_dim), np.float32)
+        a1, l1 = scan.decode(state, obs, avail)
+        a2, l2 = spec.decode(state, obs, avail)
+        assert np.array_equal(a1[:n], a2[:n])
+        assert np.array_equal(l1[:n], l2[:n])
+    assert spec.compile_count() == len(BUCKETS)
+    assert spec.steady_state_recompiles() == 0
+    # per-dispatch speculative gauges landed in telemetry
+    g = spec.telemetry._gauges
+    assert g["decode_spec_draft_passes"] >= 1.0
+    assert 0.0 <= g["decode_spec_accept_rate"] <= 1.0
+    assert g["decode_spec_verify_passes"] >= 0.0
+
+
+def test_engine_config_rejects_unknown_decode_mode():
+    from mat_dcml_tpu.serving.engine import EngineConfig
+
+    with pytest.raises(ValueError, match="decode_mode"):
+        EngineConfig(decode_mode="bogus")
+
+
+# -------------------------------------------------------------- typed errors
+
+
+def test_serve_decode_stride_stochastic_raises():
+    pol, params = make_policy(DISCRETE)
+    state, obs, ava = rollout_inputs(pol.cfg)
+    with pytest.raises(ValueError, match="deterministic-only"):
+        serve_decode(pol.cfg, params, jax.random.key(0), state, obs, ava,
+                     deterministic=False, mode="stride")
+
+
+def test_serve_decode_unknown_mode_raises():
+    pol, params = make_policy(DISCRETE)
+    state, obs, ava = rollout_inputs(pol.cfg)
+    with pytest.raises(ValueError, match="mode must be one of"):
+        serve_decode(pol.cfg, params, jax.random.key(0), state, obs, ava,
+                     mode="warp")
+
+
+def test_return_spec_stats_requires_spec_mode():
+    pol, params = make_policy(DISCRETE)
+    state, obs, ava = rollout_inputs(pol.cfg)
+    with pytest.raises(ValueError, match="return_spec_stats"):
+        serve_decode(pol.cfg, params, jax.random.key(0), state, obs, ava,
+                     mode="scan", return_spec_stats=True)
+
+
+def test_spec_rejects_continuous_and_dec_actor():
+    pol, params = make_policy(CONTINUOUS)
+    state, obs, _ = rollout_inputs(pol.cfg)
+    with pytest.raises(ValueError, match="DISCRETE/SEMI_DISCRETE"):
+        serve_decode(pol.cfg, params, jax.random.key(0), state, obs, None,
+                     mode="spec")
+    pol2, params2 = make_policy(DISCRETE, dec_actor=True, share_actor=True)
+    state2, obs2, ava2 = rollout_inputs(pol2.cfg)
+    with pytest.raises(ValueError, match="dec_actor"):
+        serve_decode(pol2.cfg, params2, jax.random.key(0), state2, obs2, ava2,
+                     mode="spec")
+
+
+def test_policy_rejects_unknown_decode_mode():
+    cfg = make_policy(DISCRETE)[0].cfg
+    with pytest.raises(ValueError, match="decode_mode"):
+        TransformerPolicy(cfg, decode_mode="bogus")
+
+
+# -------------------------------------------- stride availability synthesis
+
+
+@pytest.mark.slow
+def test_stride_decode_none_available_matches_all_ones():
+    """``available_actions=None`` must behave exactly like the all-ones
+    mask (same synthesis ar_decode performs) instead of crashing."""
+    pol, params = make_policy(DISCRETE)
+    cfg = pol.cfg
+    state, obs, _ = rollout_inputs(cfg)
+    ones = jnp.ones((state.shape[0], cfg.n_agent, cfg.action_dim), jnp.float32)
+    v1, r1 = serve_decode(cfg, params, jax.random.key(0), state, obs, None,
+                          mode="stride", stride=2)
+    v2, r2 = serve_decode(cfg, params, jax.random.key(0), state, obs, ones,
+                          mode="stride", stride=2)
+    assert np.array_equal(np.asarray(r1.action), np.asarray(r2.action))
+    assert np.array_equal(np.asarray(r1.log_prob), np.asarray(r2.log_prob))
+
+
+# -------------------------------------------------------- policy-level spec
+
+
+@pytest.mark.slow
+def test_policy_get_actions_with_stats_spec_matches_scan():
+    pol_scan, params = make_policy(SEMI_DISCRETE, semi_index=-1)
+    pol_spec = TransformerPolicy(pol_scan.cfg, decode_mode="spec", spec_block=3)
+    state, obs, ava = rollout_inputs(pol_scan.cfg)
+    key = jax.random.key(9)
+    out1 = pol_scan.get_actions(params, key, state, obs, ava, deterministic=False)
+    out2, stats = pol_spec.get_actions_with_stats(
+        params, key, state, obs, ava, deterministic=False
+    )
+    assert np.array_equal(np.asarray(out1.action), np.asarray(out2.action))
+    assert np.array_equal(np.asarray(out1.log_prob), np.asarray(out2.log_prob))
+    assert np.array_equal(np.asarray(out1.value), np.asarray(out2.value))
+    assert stats is not None
+    # scan-mode policies report no spec stats
+    _, none_stats = pol_scan.get_actions_with_stats(
+        params, key, state, obs, ava, deterministic=False
+    )
+    assert none_stats is None

@@ -175,6 +175,18 @@ def test_schema_validator_accepts_valid_and_rejects_invalid():
     assert check_metrics_schema.validate_record({**good, "grad_norm": float("nan")})
     assert check_metrics_schema.validate_record({**good, "compile_count": -1})
     assert check_metrics_schema.validate_record({**good, "mystery_field": 1.0})
+
+    # speculative-decode gauges: known fields, non-negative, rate in [0, 1]
+    spec_ok = {**good, "decode_spec_draft_passes": 13.0,
+               "decode_spec_verify_passes": 12.0,
+               "decode_spec_accept_rate": 0.83}
+    assert check_metrics_schema.validate_record(spec_ok) == []
+    assert check_metrics_schema.validate_record(
+        {**spec_ok, "decode_spec_accept_rate": 1.2})
+    assert check_metrics_schema.validate_record(
+        {**spec_ok, "decode_spec_accept_rate": -0.1})
+    assert check_metrics_schema.validate_record(
+        {**spec_ok, "decode_spec_draft_passes": -1.0})
     missing = dict(good)
     del missing["step_time_train"]
     assert check_metrics_schema.validate_record(missing)
