@@ -96,6 +96,13 @@ class RunConfig:
     # 1 = replicated. Indivisible agent counts (DCML's 101) zero-pad with
     # masked keys — numerics identical.
     seq_shards: int = 1
+    # data parallelism: shard the env-batch axis (n_rollout_threads) of the
+    # whole collect+train program over this many devices of a (data, seq)
+    # mesh (parallel/mesh.build_run_mesh).  Params/optimizer stay replicated;
+    # grad psums and the batch statistics fall out of jit.  0 = auto (all
+    # devices not consumed by --seq_shards); 1 = no data sharding.
+    # n_rollout_threads must be divisible by the resulting shard count.
+    data_shards: int = 1
     # rollout decode: "scan" = sequential AR decode, "spec" = speculative
     # draft-verify decode (models/decode.py:spec_decode) — bit-exact to scan
     # (actions AND log-probs, via gumbel/noise replay), ~n_agent/K̄ block

@@ -98,6 +98,13 @@ def global_init_state(collector, key, n_envs: int, mesh, data_axis: str = "data"
     import jax
     from jax.sharding import NamedSharding, PartitionSpec as P
 
+    n_data = dict(mesh.shape).get(data_axis, 1)
+    if n_envs % n_data:
+        raise ValueError(
+            f"env batch n_envs={n_envs} must be divisible by the mesh's "
+            f"{data_axis!r} axis ({n_data} shards); pick --n_rollout_threads "
+            f"a multiple of --data_shards"
+        )
     shard = NamedSharding(mesh, P(data_axis))
     repl = NamedSharding(mesh, P())
 
@@ -110,3 +117,15 @@ def global_init_state(collector, key, n_envs: int, mesh, data_axis: str = "data"
     probe = jax.eval_shape(init, key)
     shardings = jax.tree.map(out_sharding, probe)
     return jax.jit(init, out_shardings=shardings)(key)
+
+
+def put_replicated(tree, mesh):
+    """Place a host-local pytree (e.g. a restored checkpoint) as replicated
+    global arrays on ``mesh``.  Fully-replicated shardings are the one
+    multi-host-safe ``device_put`` — every process holds the complete value,
+    so no cross-host data movement is implied."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    repl = NamedSharding(mesh, P())
+    return jax.tree.map(lambda x: jax.device_put(x, repl), tree)

@@ -37,7 +37,13 @@ def make_mesh(
     if n_data is None:
         n_data = len(devices) // (n_model * n_seq)
     n_total = n_data * n_model * n_seq
-    assert n_total <= len(devices), f"need {n_total} devices, have {len(devices)}"
+    if n_total <= 0 or n_total > len(devices):
+        # a typed error, not an assert: asserts vanish under ``python -O``
+        # and a silently-oversized mesh dies later with an opaque XLA error
+        raise ValueError(
+            f"mesh ({n_data}, {n_model}, {n_seq}) needs {n_total} devices, "
+            f"have {len(devices)}"
+        )
     arr = np.array(devices[:n_total]).reshape(n_data, n_model, n_seq)
     return Mesh(arr, axis_names=("data", "model", "seq"))
 
@@ -67,6 +73,51 @@ def make_data_seq_mesh(n_seq: int, devices: Optional[Sequence[jax.Device]] = Non
                 f"count or reorder the device list"
             )
     return Mesh(np.array(devices).reshape(-1, n_seq), ("data", "seq"))
+
+
+def build_run_mesh(
+    data_shards: int,
+    seq_shards: int = 1,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Optional[Mesh]:
+    """The runner-facing ``(data, seq)`` mesh for ``--data_shards`` x
+    ``--seq_shards``.
+
+    ``data_shards=0`` means auto: every available device not consumed by
+    ``seq_shards`` becomes a data shard (global device count // seq_shards —
+    under multi-process this counts GLOBAL devices, so every process runs the
+    same SPMD program over one global mesh).  Returns ``None`` when no mesh
+    is needed (1x1 single-process) — the runner then keeps host-local state.
+
+    Always built through :func:`make_data_seq_mesh` so the seq-minor ICI-ring
+    placement invariant holds at every composition site.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    if seq_shards <= 0:
+        raise ValueError(f"seq_shards must be >= 1, got {seq_shards}")
+    if data_shards < 0:
+        raise ValueError(f"data_shards must be >= 0 (0 = auto), got {data_shards}")
+    n_data = data_shards if data_shards else max(1, len(devices) // seq_shards)
+    n_total = n_data * seq_shards
+    if n_total > len(devices):
+        raise ValueError(
+            f"--data_shards {n_data} x --seq_shards {seq_shards} needs "
+            f"{n_total} devices, have {len(devices)}"
+        )
+    import jax as _jax
+
+    if _jax.process_count() > 1 and n_total != len(devices):
+        # a partial mesh under multi-process would leave some processes with
+        # no addressable shard of the program state — every jitted call dies
+        # on non-addressable inputs.  Require full coverage (or auto).
+        raise ValueError(
+            f"multi-process meshes must cover all {len(devices)} global "
+            f"devices; --data_shards {n_data} x --seq_shards {seq_shards} "
+            f"covers {n_total} (use --data_shards 0 for auto)"
+        )
+    if n_total == 1 and _jax.process_count() == 1:
+        return None
+    return make_data_seq_mesh(seq_shards, devices[:n_total])
 
 
 def replicated(mesh: Mesh) -> NamedSharding:

@@ -1,0 +1,64 @@
+"""Host-memory offload annotations for the streamed PPO update.
+
+``--update_offload`` moves the streamed update's per-minibatch chunk stack to
+host memory and brings each chunk back on-device inside the accumulation scan
+(training/ppo.py apply_minibatch) — the XLA host-offloading streaming pattern:
+``device_put`` with a memory-kind annotation inside jit compiles to an async
+copy the scheduler overlaps with compute, and the device-resident working set
+of the fwd/bwd drops from a full minibatch to one chunk.  Composes with
+``--update_stream_chunks`` (defines the chunk grain) and ``remat`` (shrinks
+the activations that share the freed HBM).
+
+Backend honesty: a chip exposes a distinct ``pinned_host`` space, so the
+annotation is a real HBM<->host transfer there.  CPU has a single
+``unpinned_host`` space — the annotations trace and compile (pinned by
+tests/test_stream_equivalence.py: bit-exact, flag on vs off) but move nothing,
+so CPU runs prove compile/numerics only; the HBM relief claim needs the chip
+session recorded in ROADMAP.md.
+
+``TransferToMemoryKind`` is not in ``jax.sharding``'s public namespace until
+jax 0.5; import falls back to the private home it has in 0.4.x.
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache
+
+import jax
+
+try:  # public from jax 0.5
+    from jax.sharding import TransferToMemoryKind
+except ImportError:  # 0.4.x
+    from jax._src.sharding_impls import TransferToMemoryKind
+
+
+@lru_cache(maxsize=1)
+def memory_kinds() -> tuple:
+    """(host_kind, device_kind) for the local backend.  Equal kinds mean the
+    backend has no separate host space (CPU) and offload is a traced no-op."""
+    d = jax.local_devices()[0]
+    try:
+        kinds = {m.kind for m in d.addressable_memories()}
+        dev = d.default_memory().kind
+    except Exception:  # backends predating the memories API
+        return "device", "device"
+    host = "pinned_host" if "pinned_host" in kinds else dev
+    return host, dev
+
+
+def offload_is_real() -> bool:
+    """True when the backend has a host space distinct from device memory."""
+    host, dev = memory_kinds()
+    return host != dev
+
+
+def to_host(tree):
+    """Annotate a pytree for host memory (inside or outside jit)."""
+    host, _ = memory_kinds()
+    return jax.tree.map(lambda x: jax.device_put(x, TransferToMemoryKind(host)), tree)
+
+
+def to_device(tree):
+    """Annotate a pytree for device memory (inside or outside jit)."""
+    _, dev = memory_kinds()
+    return jax.tree.map(lambda x: jax.device_put(x, TransferToMemoryKind(dev)), tree)

@@ -31,7 +31,11 @@ from mat_dcml_tpu.telemetry.scopes import (
     set_named_scopes,
     set_probe_sink,
 )
-from mat_dcml_tpu.telemetry.system import device_memory_gauges, host_rss_bytes
+from mat_dcml_tpu.telemetry.system import (
+    device_memory_gauges,
+    host_rss_bytes,
+    replica_hbm_high_water_bytes,
+)
 
 __all__ = [
     "Anomaly",
@@ -51,6 +55,7 @@ __all__ = [
     "named_scopes_enabled",
     "pack_tree",
     "probe",
+    "replica_hbm_high_water_bytes",
     "set_named_scopes",
     "set_probe_sink",
     "unpack_tree",

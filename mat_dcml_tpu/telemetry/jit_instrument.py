@@ -41,6 +41,30 @@ from mat_dcml_tpu.telemetry.registry import Telemetry
 from mat_dcml_tpu.utils.profiling import compiled_bytes, compiled_flops
 
 
+def _collective_count(compiled) -> Optional[int]:
+    """Number of cross-device reduction ops (all-reduce, i.e. ``psum``) in a
+    compiled executable.  Prefers the compiler's cost_analysis keys; falls
+    back to counting ``all-reduce`` ops in the optimized HLO text.  Best
+    effort — returns None rather than raise."""
+    try:
+        costs = compiled.cost_analysis()
+        if isinstance(costs, (list, tuple)):
+            costs = costs[0] if costs else {}
+        hits = [v for k, v in (costs or {}).items() if "all-reduce" in k.lower()]
+        if hits:
+            return int(sum(float(v) for v in hits))
+    except Exception:
+        pass
+    try:
+        text = compiled.as_text()
+        return sum(
+            line.count("all-reduce(") + line.count("all-reduce-start(")
+            for line in text.splitlines()
+        )
+    except Exception:
+        return None
+
+
 def _abstract_signature(args, kwargs):
     """Hashable key matching jit's cache granularity for array-only calls:
     pytree structure + (shape, dtype, weak_type) per array leaf; python
@@ -66,6 +90,7 @@ class InstrumentedJit:
         name: str,
         telemetry: Optional[Telemetry] = None,
         log_fn: Callable[[str], Any] = print,
+        count_collectives: bool = False,
         **jit_kwargs,
     ):
         self._jit = jax.jit(fn, **jit_kwargs)
@@ -80,6 +105,11 @@ class InstrumentedJit:
         self.compile_seconds = 0.0
         self.flops_per_call: Optional[float] = None
         self.bytes_per_call: Optional[float] = None
+        # sharded runs: number of cross-device reduction ops (all-reduce /
+        # psum) in the compiled executable, counted at compile time (None
+        # until a compile lands or when counting is off)
+        self._count_collectives = bool(count_collectives)
+        self.collectives_per_call: Optional[int] = None
 
     def mark_steady(self) -> None:
         """Warmup is over: any compile from now on is unexpected."""
@@ -112,6 +142,10 @@ class InstrumentedJit:
             nbytes = compiled_bytes(compiled)
             if nbytes is not None:
                 self.bytes_per_call = nbytes
+            if self._count_collectives:
+                n = _collective_count(compiled)
+                if n is not None:
+                    self.collectives_per_call = n
             self._maybe_dump_hlo(compiled)
         self._compiled[key] = compiled
         return compiled

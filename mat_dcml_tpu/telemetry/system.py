@@ -29,6 +29,23 @@ def device_memory_gauges(device=None) -> Dict[str, int]:
     }
 
 
+def replica_hbm_high_water_bytes() -> Optional[int]:
+    """Max ``peak_bytes_in_use`` over the LOCAL devices — the per-replica HBM
+    high-water mark of a sharded run (each mesh position holds one replica of
+    the params plus its data shard, so the max local peak is the number that
+    decides whether a config fits the chip).  ``None`` when no local device
+    exposes allocator stats (CPU)."""
+    peaks = []
+    try:
+        for d in jax.local_devices():
+            stats = d.memory_stats() or {}
+            if "peak_bytes_in_use" in stats:
+                peaks.append(int(stats["peak_bytes_in_use"]))
+    except Exception:
+        return None
+    return max(peaks) if peaks else None
+
+
 def host_rss_bytes() -> int:
     """Current resident set size of this process in bytes (0 if unknown)."""
     try:

@@ -33,6 +33,7 @@ from mat_dcml_tpu.telemetry import (
     device_memory_gauges,
     host_rss_bytes,
     instrumented_jit,
+    replica_hbm_high_water_bytes,
     set_named_scopes,
 )
 from mat_dcml_tpu.training.checkpoint import CheckpointManager
@@ -41,51 +42,63 @@ from mat_dcml_tpu.training.ppo import PPOConfig
 from mat_dcml_tpu.utils.metrics import MetricsWriter
 
 
-def apply_seq_shards(run: RunConfig, policy) -> None:
-    """--seq_shards N: context-shard the training forward's agent axis over
-    an N-device ``seq`` mesh (parallel/seq_parallel.py).  MAT-family only —
-    the transformer policies carry a ``seq_mesh`` slot.  Called after EVERY
-    policy construction so an unsupported combination fails at startup, not
-    silently (or mid-first-update)."""
-    if getattr(run, "seq_shards", 1) <= 1:
-        return
-    if not hasattr(policy, "seq_mesh"):
-        raise NotImplementedError(
-            f"--seq_shards applies to the MAT transformer policy, not "
-            f"{type(policy).__name__}"
-        )
-    if getattr(policy.cfg, "dec_actor", False):
-        raise NotImplementedError(
-            "--seq_shards: MAT-Dec's per-agent MLPs are indexed by global "
-            "agent id; context-sharding applies to the transformer path"
-        )
-    from jax.sharding import Mesh
+def apply_mesh(run: RunConfig, policy):
+    """--data_shards / --seq_shards: build the run's global ``(data, seq)``
+    mesh (parallel/mesh.build_run_mesh) and attach the ``seq`` ring to the
+    policy when the agent axis is context-sharded.  Returns the mesh, or
+    ``None`` when the run is unsharded single-process — :meth:`BaseRunner
+    .setup` then keeps the classic host-local state construction.
 
-    if jax.process_count() > 1:
-        # The data x seq composition exists at library level — one global
-        # (data, seq) mesh via parallel.mesh.make_data_seq_mesh, batch over
-        # processes and agents ringing intra-process, pinned by
-        # tests/test_multihost.py::test_two_process_data_seq_mesh — but THIS
-        # runner builds its program state host-locally (BaseRunner.setup),
-        # so a process-spanning shard_map here would die mid-first-update on
-        # non-addressable inputs.  Until the runner constructs state through
-        # parallel.distributed.global_init_state, fail at startup with the
-        # supported route spelled out.
-        raise NotImplementedError(
-            "--seq_shards under multi-process training needs global-array "
-            "program state; build the loop on parallel.mesh.make_data_seq_mesh "
-            "+ parallel.distributed.global_init_state (see "
-            "tests/_mp_common.run_sharded_training) — the CLI runner does "
-            "not wire this yet"
-        )
+    Called after EVERY policy construction so an unsupported combination
+    fails at startup, not silently (or mid-first-update).  Multi-process runs
+    always get a mesh over the GLOBAL device set: program state is then built
+    through ``parallel.distributed.global_init_state``, which is what retired
+    the old ``--seq_shards`` + ``process_count > 1`` NotImplementedError.
+    """
+    seq = max(1, int(getattr(run, "seq_shards", 1)))
+    if seq > 1:
+        if not hasattr(policy, "seq_mesh"):
+            raise NotImplementedError(
+                f"--seq_shards applies to the MAT transformer policy, not "
+                f"{type(policy).__name__}"
+            )
+        if getattr(policy.cfg, "dec_actor", False):
+            raise NotImplementedError(
+                "--seq_shards: MAT-Dec's per-agent MLPs are indexed by global "
+                "agent id; context-sharding applies to the transformer path"
+            )
+    from mat_dcml_tpu.parallel.mesh import build_run_mesh
 
-    devs = jax.local_devices()
-    if len(devs) < run.seq_shards:
+    mesh = build_run_mesh(int(getattr(run, "data_shards", 1)), seq)
+    if mesh is None:
+        return None
+    n_data = dict(mesh.shape)["data"]
+    if run.n_rollout_threads % n_data:
         raise ValueError(
-            f"--seq_shards {run.seq_shards} needs that many local devices; "
-            f"{len(devs)} visible"
+            f"--n_rollout_threads {run.n_rollout_threads} must be divisible "
+            f"by the data shard count ({n_data})"
         )
-    policy.seq_mesh = Mesh(np.array(devs[: run.seq_shards]), ("seq",))
+    if seq > 1:
+        policy.seq_mesh = mesh
+    if seq > 1 and n_data > 1:
+        # Composed (data x seq) mesh: jax 0.4.x default threefry is NOT
+        # sharding-invariant on a multi-axis mesh with a replicated axis —
+        # sampling under P("data") inputs draws different bits than the same
+        # program unsharded (reproduced on plain jax.random.categorical), so
+        # rollout actions silently diverge across topologies.  Partitionable
+        # threefry restores invariance; it changes the raw stream, which is
+        # why it is scoped to composed runs only (goldens stay bit-exact on
+        # unsharded and data-only topologies).  Must run before the first
+        # trace, which apply_mesh — called at runner construction — is.
+        jax.config.update("jax_threefry_partitionable", True)
+    return mesh
+
+
+def apply_seq_shards(run: RunConfig, policy) -> None:
+    """Back-compat alias: validate + wire sharding flags, discarding the mesh
+    (callers that only need ``policy.seq_mesh`` set, e.g. replay/dryrun
+    paths).  Runners use :func:`apply_mesh` and keep the return value."""
+    apply_mesh(run, policy)
 
 
 def make_dispatch_fn(trainer, collector, iters: int):
@@ -169,6 +182,9 @@ class BaseRunner:
     def finalize(self, run: RunConfig, log_fn=print) -> None:
         self.run_cfg = run
         self.log = log_fn
+        # runners that shard set self.mesh (= apply_mesh(...)) before calling
+        # finalize; everything downstream branches on "is there a mesh"
+        self.mesh = getattr(self, "mesh", None)
         set_named_scopes(run.trace_named_scopes)
         self.telemetry = Telemetry()
         self.telemetry.rate("env_steps", "env_steps_per_sec")
@@ -177,11 +193,15 @@ class BaseRunner:
         # internally and cannot themselves be traced
         if getattr(self.collector, "jittable", True):
             self._collect = instrumented_jit(
-                self.collector.collect, "collect", self.telemetry, log_fn
+                self.collector.collect, "collect", self.telemetry, log_fn,
+                count_collectives=self.mesh is not None,
             )
         else:
             self._collect = self.collector.collect
-        self._train = instrumented_jit(self.trainer.train, "train", self.telemetry, log_fn)
+        self._train = instrumented_jit(
+            self.trainer.train, "train", self.telemetry, log_fn,
+            count_collectives=self.mesh is not None,
+        )
         # fused multi-episode dispatch (built lazily by _train_loop_fused when
         # --iters_per_dispatch > 1 and the trainer/collector pair supports it)
         self._dispatch = None
@@ -230,15 +250,35 @@ class BaseRunner:
         seed = self.run_cfg.seed if seed is None else seed
         key = jax.random.key(seed)
         k_model, k_roll = jax.random.split(key)
-        if hasattr(self.trainer, "init_params"):      # stacked per-agent params
-            params = self.trainer.init_params(k_model)
+        init_p = (self.trainer.init_params if hasattr(self.trainer, "init_params")
+                  else self.policy.init_params)  # stacked per-agent vs shared
+        if self.mesh is not None:
+            # sharded run: build state as GLOBAL arrays.  Params/optimizer are
+            # replicated (every process initializes identically inside jit
+            # with out_shardings, so no host-side full-size transfer);
+            # the rollout state's env-batch axis shards over "data".  The grad
+            # psum and batch-statistic reductions then fall out of jit.
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            from mat_dcml_tpu.parallel.distributed import global_init_state
+
+            repl = NamedSharding(self.mesh, P())
+            params = jax.jit(init_p, out_shardings=repl)(k_model)
+            train_state = jax.jit(self.trainer.init_state, out_shardings=repl)(params)
         else:
-            params = self.policy.init_params(k_model)
-        train_state = self.trainer.init_state(params)
+            params = init_p(k_model)
+            train_state = self.trainer.init_state(params)
         if self.run_cfg.model_dir:
             train_state = self._maybe_restore(train_state)
             self.start_episode = self._restored_step + 1
-        rollout_state = self.collector.init_state(k_roll, self.run_cfg.n_rollout_threads)
+        if self.mesh is not None:
+            rollout_state = global_init_state(
+                self.collector, k_roll, self.run_cfg.n_rollout_threads, self.mesh
+            )
+        else:
+            rollout_state = self.collector.init_state(
+                k_roll, self.run_cfg.n_rollout_threads
+            )
         self._log_model_stats(train_state)
         return train_state, rollout_state
 
@@ -256,7 +296,14 @@ class BaseRunner:
         self.log(f"restored checkpoint step {mgr.latest_step()} ({kind}) "
                  f"from {self.run_cfg.model_dir}")
         if params_only:
-            return train_state._replace(params=restored.params)
+            restored = train_state._replace(params=restored.params)
+        if self.mesh is not None:
+            # checkpoints restore as host-local arrays; re-place them as
+            # replicated global arrays so donation/sharding layouts match the
+            # jit-initialized cold-start state
+            from mat_dcml_tpu.parallel.distributed import put_replicated
+
+            restored = put_replicated(restored, self.mesh)
         return restored
 
     def _log_model_stats(self, train_state) -> None:
@@ -534,6 +581,7 @@ class BaseRunner:
         self._dispatch = instrumented_jit(
             make_dispatch_fn(self.trainer, self.collector, K),
             "dispatch", tel, self.log, donate_argnums=(0, 1),
+            count_collectives=self.mesh is not None,
         )
         self._dispatch_iters = K
         tel.gauge("iters_per_dispatch", float(K))
@@ -768,6 +816,24 @@ class BaseRunner:
         for name, j in jits.items():
             if j.bytes_per_call is not None:
                 tel.gauge(f"bytes_per_{name}", float(j.bytes_per_call))
+        if self.mesh is not None:
+            # sharded-run gauges (schema family "shard_"): XLA cost_analysis
+            # of a partitioned SPMD executable reports PER-DEVICE numbers, so
+            # bytes_per_call IS the per-shard traffic — no division
+            shape = dict(self.mesh.shape)
+            tel.gauge("shard_count", float(self.mesh.size))
+            tel.gauge("shard_data", float(shape.get("data", 1)))
+            tel.gauge("shard_seq", float(shape.get("seq", 1)))
+            for name, j in jits.items():
+                if j.bytes_per_call is not None:
+                    tel.gauge(f"shard_bytes_per_{name}", float(j.bytes_per_call))
+            n_coll = [j.collectives_per_call for j in jits.values()]
+            if any(c is not None for c in n_coll):
+                tel.gauge("shard_psum_count",
+                          float(sum(c for c in n_coll if c is not None)))
+            hbm = replica_hbm_high_water_bytes()
+            if hbm is not None:
+                tel.gauge("shard_hbm_high_water_bytes", float(hbm))
         self.log(line)
 
     def _extra_metrics(self, record: dict) -> None:

@@ -19,7 +19,7 @@ from mat_dcml_tpu.models.mat import CONTINUOUS, DISCRETE, MATConfig
 from mat_dcml_tpu.models.mat_variants import DecoderPolicy, EncoderPolicy, GRUPolicy
 from mat_dcml_tpu.models.policy import TransformerPolicy
 from mat_dcml_tpu.training.ac_rollout import ACRolloutCollector
-from mat_dcml_tpu.training.base_runner import BaseRunner, ac_config_kwargs, apply_seq_shards
+from mat_dcml_tpu.training.base_runner import BaseRunner, ac_config_kwargs, apply_mesh
 from mat_dcml_tpu.training.ippo import IPPORolloutCollector, IPPOTrainer
 from mat_dcml_tpu.training.mappo import MAPPOConfig, MAPPOTrainer
 from mat_dcml_tpu.training.ppo import MATTrainer, PPOConfig
@@ -134,7 +134,7 @@ class GenericRunner(BaseRunner):
                 self.trainer = MAPPOTrainer(self.policy, mcfg)
                 self.collector = ACRolloutCollector(env, self.policy, run.episode_length)
 
-        apply_seq_shards(run, self.policy)
+        self.mesh = apply_mesh(run, self.policy)
         self.finalize(run, log_fn)
 
     # ----------------------------------------------------------------- eval

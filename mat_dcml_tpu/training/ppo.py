@@ -114,6 +114,16 @@ class PPOConfig:
     # permutation, but materializes a full permuted copy — trades counted
     # gather traffic for peak memory, which is why it is opt-in).
     minibatch_layout: str = "gather"
+    # Host-offload the streamed update's chunk stream (parallel/offload.py):
+    # after the (accum, chunk) reshape the chunk stack moves to host memory
+    # and each chunk transfers back on-device inside the accumulation scan —
+    # the device-resident data working set of the fwd/bwd drops from a full
+    # minibatch to one chunk.  Composes with update_stream_chunks (the chunk
+    # grain) and remat (the activation side of the same HBM budget); the
+    # E=2048 memory-wall knob.  Numerically exact — transfers don't change
+    # values (tests/test_stream_equivalence.py pins bit-exactness).  On CPU
+    # (single memory space) it traces as a no-op; HBM relief is a chip claim.
+    update_offload: bool = False
     # MO-MAT scalarization weights, comma-separated floats ("99,1" etc.);
     # empty = equal weights.  Reconstruction of the missing ``momat_trainer``
     # around the surviving ``mo_shared_buffer.py`` per-objective GAE.
@@ -377,9 +387,19 @@ class MATTrainer:
                 lambda x: x.reshape(accum, mb_size // accum, *x.shape[1:]),
                 (batch_mb, adv_mb, ret_b),
             )
+            if cfg.update_offload:
+                # park the chunk stack in host memory; the scan below streams
+                # one chunk at a time back on-device (parallel/offload.py)
+                from mat_dcml_tpu.parallel.offload import to_host
+
+                chunks = to_host(chunks)
 
             def chunk_step(acc, chunk):
                 g_acc, aux_acc = acc
+                if cfg.update_offload:
+                    from mat_dcml_tpu.parallel.offload import to_device
+
+                    chunk = to_device(chunk)
                 (_, aux), g = jax.value_and_grad(loss_fn, has_aux=True)(params, chunk)
                 acc = (
                     jax.tree.map(jnp.add, g_acc, g),

@@ -11,6 +11,13 @@ The buffer (``shared_buffer.py``) collapses to the stacked scan outputs: a
 (``dcml_runner.py:261-272``) are reproduced: ``masks[t+1] = 1 - done_env[t]``;
 ``active_masks`` handling keeps the same shape contract (all-ones in DCML since
 every agent shares the episode done flag).
+
+Sharding contract (``--data_shards``): every :class:`RolloutState` leaf with a
+leading env-batch axis E shards over the mesh ``data`` axis; scalar leaves and
+the typed PRNG key stay replicated.  ``parallel.distributed.global_init_state``
+derives the placement from exactly this shape contract (ndim >= 1 => sharded),
+so new carry fields keep a leading E axis or are scalars — a per-env field
+hidden in a scalar-shaped leaf would silently replicate.
 """
 
 from __future__ import annotations

@@ -9,8 +9,13 @@ this module adds the DCML-specific policy/trainer construction and the
 deterministic eval protocol with episode delay/payment accounting and
 inference timing (``dcml_runner.py:319-448``).
 
-With a mesh, the same two jitted functions run with the env batch sharded
-over the ``data`` axis; everything else is unchanged (SURVEY.md §7.6).
+With a mesh (``--data_shards`` / ``--seq_shards``, parallel/mesh
+.build_run_mesh), the same jitted functions — the two-dispatch loop AND the
+fused ``--iters_per_dispatch`` scan — run with the env batch sharded over the
+``data`` axis: state is built as global arrays (params replicated via
+jit-with-out_shardings, rollout state via parallel.distributed
+.global_init_state), and the grad psums and batch-statistic reductions fall
+out of jit.  Everything else is unchanged (SURVEY.md §7.6).
 """
 
 from __future__ import annotations
@@ -30,7 +35,7 @@ from mat_dcml_tpu.models.actor_critic import ACConfig, ActorCriticPolicy
 from mat_dcml_tpu.models.mat import MATConfig, SEMI_DISCRETE
 from mat_dcml_tpu.models.policy import TransformerPolicy
 from mat_dcml_tpu.training.ac_rollout import ACRolloutCollector, ACRolloutState
-from mat_dcml_tpu.training.base_runner import BaseRunner, ac_config_kwargs, apply_seq_shards
+from mat_dcml_tpu.training.base_runner import BaseRunner, ac_config_kwargs, apply_mesh
 from mat_dcml_tpu.training.happo import (
     HAPPOConfig,
     HAPPORolloutCollector,
@@ -202,7 +207,7 @@ class DCMLRunner(BaseRunner):
         self.policy, self.trainer, self.collector, self.is_mat = (
             build_dcml_components(run, ppo, self.env)
         )
-        apply_seq_shards(run, self.policy)
+        self.mesh = apply_mesh(run, self.policy)
         self.finalize(run, log_fn)
 
     # ----------------------------------------------------------------- eval

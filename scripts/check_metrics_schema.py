@@ -86,6 +86,13 @@ KNOWN_PREFIXES = (
     # weight-push rollout records (serving/rollout_ctl.py): push/rollback
     # counters, canary comparison/mismatch totals
     "rollout_",
+    # sharded-run gauges (base_runner._mark_steady under a --data_shards/
+    # --seq_shards mesh): mesh shape (shard_count/shard_data/shard_seq),
+    # per-shard cost_analysis bytes (shard_bytes_per_<fn> — per-DEVICE, the
+    # SPMD executable's numbers), per-replica HBM high-water
+    # (shard_hbm_high_water_bytes, absent on CPU), and the compiled psum
+    # count (shard_psum_count)
+    "shard_",
 )
 
 # fields that must never go negative (counters, rates, timers, gauges)
@@ -220,7 +227,7 @@ def validate_record(record, index: int = 0, strict_names: bool = True) -> List[s
             errs.append(f"{where}: field {k!r} is non-finite ({v})")
             continue
         if (k in NON_NEGATIVE
-                or k.startswith(("serving_", "fleet_", "rollout_"))) and v < 0:
+                or k.startswith(("serving_", "fleet_", "rollout_", "shard_"))) and v < 0:
             errs.append(f"{where}: field {k!r} is negative ({v})")
         if k in UNIT_INTERVAL and not (0.0 <= v <= 1.0):
             errs.append(f"{where}: field {k!r} must be in [0, 1], got {v}")

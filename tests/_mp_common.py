@@ -36,12 +36,18 @@ def build_mesh_2d(devices, n_seq: int) -> Mesh:
     return make_data_seq_mesh(n_seq, devices)
 
 
-def run_sharded_training(mesh: Mesh, seq: bool = False) -> dict:
+def run_sharded_training(mesh: Mesh, seq: bool = False, fused_k: int = 0) -> dict:
     """Fixed-seed collect+train loop on ``mesh``; returns comparable scalars.
 
     ``seq=True`` additionally ring-shards the PPO update's agent axis over
     the mesh's ``seq`` axis (the data x seq composition) — numerics must be
     unchanged, which is exactly what the callers assert.
+
+    ``fused_k > 0`` switches to ONE donated fused dispatch (base_runner
+    .make_dispatch_fn) scanning ``fused_k`` collect+train iterations — the
+    sharded K>1 program.  Its key recipe differs from the ``fused_k=0`` host
+    loop (carried split vs per-step ``key(10+i)``), so fused runs compare
+    only against fused runs on other topologies.
     """
     env = MatchingEnv(MatchingEnvConfig(n_agents=3, n_actions=4, horizon=5))
     cfg = MATConfig(
@@ -62,12 +68,25 @@ def run_sharded_training(mesh: Mesh, seq: bool = False) -> dict:
         train_state = jax.jit(trainer.init_state, out_shardings=repl)(params)
         rollout_state = global_init_state(collector, jax.random.key(1), E, mesh)
 
-        collect = jax.jit(collector.collect)
-        train = jax.jit(trainer.train)
-        metrics = None
-        for i in range(STEPS):
-            rollout_state, traj = collect(train_state.params, rollout_state)
-            train_state, metrics = train(train_state, traj, rollout_state, jax.random.key(10 + i))
+        if fused_k:
+            from mat_dcml_tpu.training.base_runner import make_dispatch_fn
+
+            dispatch = jax.jit(
+                make_dispatch_fn(trainer, collector, fused_k),
+                donate_argnums=(0, 1),
+            )
+            train_state, rollout_state, _, (metrics, _stats) = dispatch(
+                train_state, rollout_state, jax.random.key(10)
+            )
+            # stacked (K,) per-iteration metrics -> the last iteration's
+            metrics = jax.tree.map(lambda x: x[-1], metrics)
+        else:
+            collect = jax.jit(collector.collect)
+            train = jax.jit(trainer.train)
+            metrics = None
+            for i in range(STEPS):
+                rollout_state, traj = collect(train_state.params, rollout_state)
+                train_state, metrics = train(train_state, traj, rollout_state, jax.random.key(10 + i))
         jax.block_until_ready(train_state)
 
     # global scalars every topology can agree on

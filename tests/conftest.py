@@ -17,6 +17,7 @@ if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
 
 import jax  # noqa: E402
+import pytest  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
@@ -29,6 +30,26 @@ _cache_dir = os.environ.get(
 jax.config.update("jax_compilation_cache_dir", _cache_dir)
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.1)
 jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+
+# Initialize the backend NOW (it reads XLA_FLAGS exactly once), then restore
+# the caller's XLA_FLAGS so subprocesses spawned BY tests (multi-process
+# workers, bench legs) don't silently inherit an 8-virtual-device CPU
+# topology they never asked for — they configure their own.
+jax.devices()
+if _flags:
+    os.environ["XLA_FLAGS"] = _flags
+else:
+    os.environ.pop("XLA_FLAGS", None)
+
+
+@pytest.fixture
+def forced8_cpu():
+    """The harness's 8 virtual CPU devices; skips when the topology is
+    smaller (e.g. a stray run outside this conftest)."""
+    devs = jax.devices()
+    if len(devs) < 8:
+        pytest.skip("needs the forced 8-device CPU topology")
+    return devs
 
 
 # ---------------------------------------------------------------- gate budget

@@ -33,11 +33,16 @@ def main() -> None:
 
     from _mp_common import build_mesh_2d, build_mesh_from, run_sharded_training
 
-    seq = len(sys.argv) > 4 and sys.argv[4] == "seq"
-    if seq:
+    mode = sys.argv[4] if len(sys.argv) > 4 else ""
+    if mode == "seq":
         # data x seq composition across processes: batch over `data` (spanning
         # both processes), agents ringing over `seq` (2 local devices each)
         result = run_sharded_training(build_mesh_2d(jax.devices(), 2), seq=True)
+    elif mode == "fused":
+        # the sharded fused-dispatch program (donated K-step scan) across
+        # processes; compared against a single-process fused run of the same
+        # recipe by the parent test
+        result = run_sharded_training(build_mesh_from(jax.devices()), fused_k=3)
     else:
         result = run_sharded_training(build_mesh_from(jax.devices()))
     result["process_id"] = pid

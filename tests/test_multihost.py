@@ -112,6 +112,147 @@ def test_two_process_data_seq_mesh():
 
 
 @pytest.mark.slow
+def test_two_process_fused_dispatch():
+    """--iters_per_dispatch under multi-process: the donated fused K-step
+    scan as ONE SPMD program over the 2-process global mesh.  Fused runs use
+    a different key recipe than the host loop, so the reference is the
+    single-process 8-device run of the SAME fused program."""
+    a, b = _run_two_process(("fused",))
+    assert a["n_global_devices"] == b["n_global_devices"] == 8
+    assert a["param_l1"] == b["param_l1"]
+    assert a["value_loss"] == b["value_loss"]
+    local = run_sharded_training(build_mesh_from(jax.devices()[:8]), fused_k=3)
+    np.testing.assert_allclose(a["param_l1"], local["param_l1"], rtol=1e-4)
+    np.testing.assert_allclose(a["value_loss"], local["value_loss"], rtol=1e-3)
+    np.testing.assert_allclose(
+        a["value_norm_sums"], local["value_norm_sums"], rtol=1e-4
+    )
+
+
+# ------------------------------------------------------- mesh error paths
+# Fast-tier coverage of the typed construction errors: a bad topology must
+# fail at startup with an actionable ValueError, not die later inside XLA.
+
+def test_make_mesh_oversized_raises(forced8_cpu):
+    from mat_dcml_tpu.parallel.mesh import make_mesh
+
+    with pytest.raises(ValueError, match="devices"):
+        make_mesh(n_data=len(forced8_cpu) + 1, devices=forced8_cpu)
+
+
+def test_make_mesh_empty_raises(forced8_cpu):
+    from mat_dcml_tpu.parallel.mesh import make_mesh
+
+    with pytest.raises(ValueError, match="devices"):
+        make_mesh(n_data=0, devices=forced8_cpu)
+
+
+def test_data_seq_mesh_indivisible_raises(forced8_cpu):
+    from mat_dcml_tpu.parallel.mesh import make_data_seq_mesh
+
+    with pytest.raises(ValueError, match="divide"):
+        make_data_seq_mesh(3, forced8_cpu)
+
+
+def test_data_seq_mesh_ring_spanning_raises():
+    """A ring spanning two processes must be rejected (ICI -> DCN).  The
+    check runs before Mesh construction, so process-index fakes suffice."""
+    import types
+
+    from mat_dcml_tpu.parallel.mesh import make_data_seq_mesh
+
+    fakes = [types.SimpleNamespace(process_index=i // 2) for i in range(8)]
+    with pytest.raises(ValueError, match="spans processes"):
+        make_data_seq_mesh(4, fakes)
+
+
+def test_build_run_mesh_validation(forced8_cpu):
+    from mat_dcml_tpu.parallel.mesh import build_run_mesh
+
+    with pytest.raises(ValueError, match="seq_shards"):
+        build_run_mesh(2, 0, devices=forced8_cpu)
+    with pytest.raises(ValueError, match="data_shards"):
+        build_run_mesh(-1, 1, devices=forced8_cpu)
+    with pytest.raises(ValueError, match="devices"):
+        build_run_mesh(8, 2, devices=forced8_cpu)
+    # 1x1 single-process: no mesh needed
+    assert build_run_mesh(1, 1, devices=forced8_cpu) is None
+    # auto: everything not consumed by seq becomes data
+    mesh = build_run_mesh(0, 2, devices=forced8_cpu)
+    assert dict(mesh.shape) == {"data": 4, "seq": 2}
+
+
+def test_apply_mesh_divisibility(forced8_cpu):
+    """apply_mesh rejects an env batch the data axis can't split evenly."""
+    import dataclasses
+
+    from mat_dcml_tpu.config import RunConfig
+    from mat_dcml_tpu.training.base_runner import apply_mesh
+
+    class _P:  # no seq_mesh needed at data-only sharding
+        pass
+
+    run = RunConfig(n_rollout_threads=6, data_shards=4)
+    with pytest.raises(ValueError, match="divisible"):
+        apply_mesh(run, _P())
+    ok = apply_mesh(dataclasses.replace(run, n_rollout_threads=8), _P())
+    assert dict(ok.shape)["data"] == 4
+
+
+def test_composed_mesh_sampling_invariant(forced8_cpu):
+    """Rollout sampling must not depend on the topology.  jax 0.4.x default
+    threefry draws DIFFERENT bits when the operands are sharded over "data"
+    on a mesh that also carries a nontrivial replicated "seq" axis (plain
+    jax.random.categorical reproduces it), which silently diverged the
+    composed-leg trajectory in the dryrun driver.  apply_mesh flips
+    jax_threefry_partitionable for composed runs; this pins that under the
+    flag a decode on the (4, 2) mesh samples the exact actions the
+    unsharded program does."""
+    import dataclasses
+
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from mat_dcml_tpu.config import RunConfig
+    from mat_dcml_tpu.models.policy import TransformerPolicy
+    from mat_dcml_tpu.models.mat import MATConfig
+    from mat_dcml_tpu.training.base_runner import apply_mesh
+
+    prev = jax.config.jax_threefry_partitionable
+    try:
+        cfg = MATConfig(n_agent=5, obs_dim=6, state_dim=8, action_dim=3,
+                        n_block=1, n_embd=16, n_head=2)
+        policy = TransformerPolicy(cfg)
+        run = RunConfig(n_rollout_threads=8, data_shards=4, seq_shards=2)
+        mesh = apply_mesh(run, policy)
+        assert dict(mesh.shape) == {"data": 4, "seq": 2}
+        assert jax.config.jax_threefry_partitionable  # composed => flipped
+
+        params = policy.init_params(jax.random.key(0))
+        E = run.n_rollout_threads
+        k = jax.random.key(7)
+        state = jax.random.normal(jax.random.fold_in(k, 1), (E, 5, 8))
+        obs = jax.random.normal(jax.random.fold_in(k, 2), (E, 5, 6))
+        key = jax.random.key(3)
+
+        act = jax.jit(lambda p, kk, s, o: policy.get_actions(p, kk, s, o))
+        ref = np.asarray(act(params, key, state, obs).action)
+        se = NamedSharding(mesh, P("data"))
+        sharded = np.asarray(act(
+            jax.device_put(params, NamedSharding(mesh, P())), key,
+            jax.device_put(state, se), jax.device_put(obs, se)).action)
+        np.testing.assert_array_equal(ref, sharded)
+
+        # data-only sharding never needed the flag — stays untouched
+        jax.config.update("jax_threefry_partitionable", False)
+        policy2 = TransformerPolicy(cfg)
+        apply_mesh(dataclasses.replace(run, data_shards=4, seq_shards=1),
+                   policy2)
+        assert not jax.config.jax_threefry_partitionable
+    finally:
+        jax.config.update("jax_threefry_partitionable", prev)
+
+
+@pytest.mark.slow
 def test_two_process_cpu_mesh():
     a, b = _run_two_process()
     assert a["n_global_devices"] == b["n_global_devices"] == 8

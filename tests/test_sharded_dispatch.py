@@ -1,0 +1,179 @@
+"""Sharded fused dispatch (--data_shards x --iters_per_dispatch) correctness.
+
+The tentpole composition: the donated K-step scan (base_runner
+.make_dispatch_fn) running on a ``(data, seq)`` mesh with the env-batch axis
+sharded over ``data``.  It must not be a second training algorithm — one
+sharded fused dispatch of K iterations has to reproduce K sequential
+UNSHARDED host-loop iterations from the same initial state.
+
+Equality tiers: the key chain and update_step are bit-exact (key evolution is
+replicated, never reduced).  Params / losses / ValueNorm moments are compared
+with the cross-topology tolerances test_multihost.py established (param level
+rtol 1e-4, ValueNorm rtol 1e-4): the sharded executable computes the batch
+statistics (advantage mean/std, ValueNorm moments) and grad means via XLA
+psum all-reduces, which reassociate the float sums a single device folds
+left-to-right — ULP-level reassociation noise, not algorithm drift.  That
+tolerance is the documented contract for every psum'd statistic.
+
+Donation must survive sharding: global sharded carries, one donated buffer
+per shard — asserted by checking the input buffers are invalidated.  And the
+steady state must stay recompile-free: dispatch #2 on fresh same-sharded
+state must hit the first compile's executable (instrumented_jit counters).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mat_dcml_tpu.envs.spaces import Discrete
+from mat_dcml_tpu.envs.toy import MatchingEnv, MatchingEnvConfig
+from mat_dcml_tpu.models.actor_critic import ACConfig, ActorCriticPolicy
+from mat_dcml_tpu.parallel.distributed import global_init_state
+from mat_dcml_tpu.parallel.mesh import build_run_mesh, replicated
+from mat_dcml_tpu.telemetry import Telemetry, instrumented_jit
+from mat_dcml_tpu.training.ac_rollout import ACRolloutCollector
+from mat_dcml_tpu.training.base_runner import make_dispatch_fn
+from mat_dcml_tpu.training.mappo import MAPPOConfig, MAPPOTrainer
+from mat_dcml_tpu.training.ppo import MATTrainer, PPOConfig
+from mat_dcml_tpu.training.rollout import RolloutCollector
+
+K = 4
+E = 8
+
+
+def _assert_close(a, b, what, rtol=1e-4, atol=1e-6):
+    la, lb = jax.tree.leaves(jax.device_get(a)), jax.tree.leaves(jax.device_get(b))
+    assert len(la) == len(lb), what
+    for x, y in zip(la, lb):
+        np.testing.assert_allclose(
+            np.asarray(x, np.float64), np.asarray(y, np.float64),
+            rtol=rtol, atol=atol, err_msg=what,
+        )
+
+
+def _mappo_components():
+    env = MatchingEnv(MatchingEnvConfig(n_agents=2, n_actions=3, horizon=5))
+    pol = ActorCriticPolicy(
+        ACConfig(hidden_size=16),
+        obs_dim=env.obs_dim,
+        cent_obs_dim=env.share_obs_dim,
+        space=Discrete(env.action_dim),
+    )
+    trainer = MAPPOTrainer(pol, MAPPOConfig(lr=3e-3, critic_lr=3e-3,
+                                            ppo_epoch=2, num_mini_batch=2))
+    collector = ACRolloutCollector(env, pol, 5)
+    return pol, trainer, collector
+
+
+def _mat_components():
+    env = MatchingEnv(MatchingEnvConfig(n_agents=3, n_actions=4, horizon=5))
+    from mat_dcml_tpu.models.mat import DISCRETE, MATConfig
+    from mat_dcml_tpu.models.policy import TransformerPolicy
+
+    cfg = MATConfig(
+        n_agent=env.n_agents, obs_dim=env.obs_dim, state_dim=env.share_obs_dim,
+        action_dim=env.action_dim, n_block=1, n_embd=16, n_head=2,
+        action_type=DISCRETE,
+    )
+    policy = TransformerPolicy(cfg)
+    trainer = MATTrainer(policy, PPOConfig(ppo_epoch=2, num_mini_batch=2))
+    collector = RolloutCollector(env, policy, 5)
+    return policy, trainer, collector
+
+
+def _sequential_reference(policy, trainer, collector, seed=42):
+    """K unsharded host-loop iterations — the runner's K=1 path."""
+    params = policy.init_params(jax.random.key(0))
+    ts = trainer.init_state(params)
+    rs = collector.init_state(jax.random.key(1), E)
+    key = jax.random.key(seed)
+    step = jax.jit(lambda ts, rs, k: trainer.train_iteration(collector, ts, rs, k))
+    for _ in range(K):
+        key, k_train = jax.random.split(key)
+        ts, rs, metrics, _ = step(ts, rs, k_train)
+    return ts, key, metrics
+
+
+def _sharded_init(policy, trainer, collector, mesh):
+    """BaseRunner.setup's sharded path: jit-init with out_shardings."""
+    repl = replicated(mesh)
+    params = jax.jit(policy.init_params, out_shardings=repl)(jax.random.key(0))
+    ts = jax.jit(trainer.init_state, out_shardings=repl)(params)
+    rs = global_init_state(collector, jax.random.key(1), E, mesh)
+    return ts, rs
+
+
+def _check_sharded_equivalence(policy, trainer, collector, seed=42):
+    mesh = build_run_mesh(4, 1, devices=jax.devices()[:4])
+    ts_ref, key_ref, metrics_ref = _sequential_reference(
+        policy, trainer, collector, seed)
+
+    with mesh:
+        ts0, rs0 = _sharded_init(policy, trainer, collector, mesh)
+        donated_leaf = jax.tree.leaves(ts0.params)[0]
+        dispatch = jax.jit(make_dispatch_fn(trainer, collector, K),
+                           donate_argnums=(0, 1))
+        ts_f, rs_f, key_f, (metrics_f, _) = dispatch(
+            ts0, rs0, jax.random.key(seed))
+        jax.block_until_ready(ts_f)
+
+    assert donated_leaf.is_deleted(), "sharded dispatch did not donate"
+    # env batch actually sharded over the data axis
+    batch_shardings = {
+        str(x.sharding.spec) for x in jax.tree.leaves(rs_f)
+        if getattr(x, "ndim", 0) >= 1 and hasattr(x, "sharding")
+    }
+    assert any("data" in s for s in batch_shardings), batch_shardings
+
+    np.testing.assert_array_equal(
+        np.asarray(jax.random.key_data(key_ref)),
+        np.asarray(jax.random.key_data(key_f)), err_msg="key chain")
+    assert int(ts_ref.update_step) == int(ts_f.update_step) == K
+    _assert_close(ts_ref.params, ts_f.params, "params (psum tolerance)")
+    if getattr(ts_ref, "value_norm", None) is not None:
+        _assert_close(ts_ref.value_norm, ts_f.value_norm,
+                      "value_norm (psum'd batch moments)")
+    # stacked (K,) per-iteration losses: last row vs the sequential final
+    for field in ("value_loss", "policy_loss"):
+        ref = np.asarray(getattr(metrics_ref, field), np.float64)
+        fused = np.asarray(getattr(metrics_f, field), np.float64)[-1]
+        np.testing.assert_allclose(fused, ref, rtol=1e-3, atol=1e-5,
+                                   err_msg=field)
+
+
+def test_mappo_sharded_fused_equals_sequential(forced8_cpu):
+    _check_sharded_equivalence(*_mappo_components())
+
+
+@pytest.mark.slow  # MAT compiles dominate; the MAPPO twin guards the fast tier
+def test_mat_sharded_fused_equals_sequential(forced8_cpu):
+    _check_sharded_equivalence(*_mat_components())
+
+
+def test_sharded_dispatch_donation_and_steady_state(forced8_cpu):
+    """Donation + zero steady-state recompiles under sharding: the second
+    dispatch on fresh identically-sharded state reuses compile #1."""
+    policy, trainer, collector = _mappo_components()
+    mesh = build_run_mesh(4, 1, devices=jax.devices()[:4])
+    tel = Telemetry()
+    dispatch = instrumented_jit(
+        make_dispatch_fn(trainer, collector, 2), "dispatch", tel,
+        donate_argnums=(0, 1), count_collectives=True,
+    )
+    with mesh:
+        ts, rs = _sharded_init(policy, trainer, collector, mesh)
+        donated = jax.tree.leaves(ts.params)[0]
+        out = dispatch(ts, rs, jax.random.key(3))
+        jax.block_until_ready(out[0])
+        assert donated.is_deleted(), "donation lost under sharding"
+        dispatch.mark_steady()
+        ts2, rs2 = _sharded_init(policy, trainer, collector, mesh)
+        out2 = dispatch(ts2, rs2, jax.random.key(4))
+        jax.block_until_ready(out2[0])
+    assert dispatch.compile_count == 1
+    assert tel.counters.get("steady_state_recompiles", 0) == 0
+    # the sharded executable must contain cross-device reductions (grad psum
+    # + batch statistics) — the collectives the telemetry gauges report
+    assert dispatch.collectives_per_call is not None
+    assert dispatch.collectives_per_call > 0

@@ -90,6 +90,20 @@ def test_update_stream_chunks_match_unchunked_mat(mat_rollout):
                                    rtol=2e-4, atol=2e-6)
 
 
+def test_update_offload_bitexact_mat(mat_rollout):
+    """--update_offload annotates the streamed chunk stack for host memory
+    and brings each chunk back inside the accumulation scan.  On CPU the
+    host and device memory kinds coincide (parallel/offload.py), so the
+    annotations compile to no-ops and the trajectory must stay BIT-exact —
+    this pins that the flag changes placement only, never math.  (On a chip
+    the same program does real HBM<->host streaming; numerics are unchanged
+    because device_put is value-preserving.)"""
+    seed, m_seed = _mat_train(mat_rollout, update_offload=False)
+    off, m_off = _mat_train(mat_rollout, update_offload=True)
+    _assert_trees_bitexact(seed.params, off.params, "update_offload")
+    _assert_trees_bitexact(m_seed, m_off, "update_offload metrics")
+
+
 def test_contiguous_layout_bitexact_mat(mat_rollout):
     """Same epoch permutation, contiguous slices vs gather: the minibatch
     CONTENT is identical, so the loss/param trajectory must be too."""
