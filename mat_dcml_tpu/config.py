@@ -111,6 +111,30 @@ class RunConfig:
     decode_mode: str = "scan"
     # speculative window K: draft positions verified per block pass
     spec_block: int = 8
+    # resume policy when a checkpoint source is configured (training/
+    # resilience.py): "strict" = --model_dir must hold a checkpoint (missing
+    # -> FileNotFoundError, the pre-PR-9 behavior); "auto" = resume from
+    # --model_dir OR this run's own <run_dir>/models when either holds a
+    # valid (or emergency) checkpoint, start fresh otherwise — one command
+    # line serves first launch and supervisor relaunch
+    resume: str = "strict"
+    # SIGTERM/SIGINT -> stop at the next dispatch boundary with a blocking
+    # emergency checkpoint of the full carry (exit code 75 = preempted)
+    graceful_stop: bool = True
+    # watchdog wall-clock bound on one fused dispatch, in seconds; >0 blocks
+    # on the dispatch outputs to enforce it (costs the async overlap), 0
+    # keeps launches async and only traps device errors
+    dispatch_deadline_s: float = 0.0
+    # retries per failed dispatch (re-placed from the last pre-launch
+    # snapshot, fleet.py-style jittered backoff) before the run emergency-
+    # saves and exits 76
+    dispatch_retries: int = 2
+    dispatch_backoff_ms: float = 100.0
+    # pre-launch full-carry snapshot cadence (dispatches) feeding watchdog
+    # retries and crash-path emergency checkpoints; each snapshot is a
+    # blocking device->host deep copy.  0 disables (graceful stop still
+    # works — it packs boundary state directly); raise to amortize
+    emergency_snapshot_interval: int = 1
 
     @property
     def episodes(self) -> int:

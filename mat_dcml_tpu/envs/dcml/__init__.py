@@ -2,3 +2,8 @@
 
 from mat_dcml_tpu.envs.dcml.constants import DCMLConsts
 from mat_dcml_tpu.envs.dcml.env import DCMLEnv, DCMLEnvConfig, DCMLState, TimeStep
+from mat_dcml_tpu.envs.dcml.fault import (
+    DCMLFaultConfig,
+    FaultyDCMLEnv,
+    fleet_stress_preset,
+)

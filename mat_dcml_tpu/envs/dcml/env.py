@@ -195,8 +195,11 @@ class DCMLEnv:
             upload_trans = bandwidth * jnp.log2(1.0 + worker_power * gain)
             download_trans = bandwidth * jnp.log2(1.0 + tx_power * gain)
         else:
-            upload_trans = jnp.full((W,), c.non_shannon_data_rate)
-            download_trans = jnp.full((W,), c.non_shannon_data_rate)
+            # dtype pinned: a bare python-float fill is weak-typed, and a
+            # checkpoint round trip strengthens it — the aval drift forces a
+            # one-time dispatch recompile on emergency resume
+            upload_trans = jnp.full((W,), c.non_shannon_data_rate, dtype=jnp.float32)
+            download_trans = jnp.full((W,), c.non_shannon_data_rate, dtype=jnp.float32)
 
         # per-worker unit price: mean of a period of Poisson(λ) arrivals / λ
         # (DCML_Worker...py:114-118); only observed under dynamic_price, and

@@ -1,0 +1,130 @@
+"""Fleet-stress fault injection for the DCML env.
+
+The `envs/mamujoco/fault.py` pattern (fault masking INSIDE the jitted step,
+one compiled program per fault preset, no host-side surgery) extended to the
+worker-selection env — the first rung of the ROADMAP fleet-stress item: a
+served scheduler should be trained against the traffic it will actually see,
+which includes dead nodes and stragglers, not just the uniform random
+disable draw the reference env makes.
+
+Two fault channels, both pure ``jnp`` transforms of :class:`DCMLState`:
+
+- **dead nodes**: permanently unavailable workers, ORed into the episode's
+  random ``unavailable`` draw.  ``disable_rate`` is recomputed from the
+  merged mask so the rank features in ``_observe`` (which divide by
+  ``W - disable_rate``) stay consistent with what the policy can select.
+- **stragglers**: workers whose failure probability is floored at
+  ``straggler_pr_floor`` (chronically lossy links -> more retries) and whose
+  local workload trace is shifted up by ``straggler_load`` (busy machines ->
+  slower queue drain).  They stay selectable — the policy has to *learn* to
+  route around them.
+
+Injection happens at every reset, including the auto-reset inside ``step``,
+so the faults persist across the episode stream; observations are rebuilt
+from the injected state so the policy sees the world it acts in.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Tuple
+
+import jax.numpy as jnp
+
+from mat_dcml_tpu.envs.dcml.env import DCMLEnv, DCMLState, TimeStep
+
+
+@dataclasses.dataclass(frozen=True)
+class DCMLFaultConfig:
+    """Static fault preset (hashable -> safe to close over in jit)."""
+
+    dead_nodes: Tuple[int, ...] = ()
+    straggler_nodes: Tuple[int, ...] = ()
+    # minimum failure probability for stragglers (0 = leave their draw alone)
+    straggler_pr_floor: float = 0.0
+    # additive local-workload shift for stragglers, clipped into [0, 1]
+    straggler_load: float = 0.0
+
+
+def fleet_stress_preset(n_dead: int = 1, n_stragglers: int = 2,
+                        pr_floor: float = 0.7,
+                        load: float = 0.5) -> DCMLFaultConfig:
+    """Minimal fleet-stress variant: the first ``n_dead`` workers are down,
+    the next ``n_stragglers`` are chronically slow.  Deterministic worker
+    indices (not a random draw) so train and eval stress the same nodes."""
+    return DCMLFaultConfig(
+        dead_nodes=tuple(range(n_dead)),
+        straggler_nodes=tuple(range(n_dead, n_dead + n_stragglers)),
+        straggler_pr_floor=pr_floor,
+        straggler_load=load,
+    )
+
+
+class FaultyDCMLEnv:
+    """DCMLEnv wrapper injecting a :class:`DCMLFaultConfig` into every state.
+
+    Mirrors ``mamujoco.fault.FaultyAgentWrapper``: forwards the attribute
+    surface runners/policies read (``cfg`` included — ``build_mat_policy``
+    reads ``env.cfg.consts``), keeps every method jit/vmap-safe.
+    """
+
+    def __init__(self, env: DCMLEnv, fault: DCMLFaultConfig = DCMLFaultConfig()):
+        self.env = env
+        self.fault = fault
+        self.cfg = env.cfg
+        for attr in ("n_agents", "obs_dim", "share_obs_dim", "action_dim",
+                     "base_workloads"):
+            if hasattr(env, attr):
+                setattr(self, attr, getattr(env, attr))
+        W = env.cfg.consts.worker_number_max
+        bad = [i for i in (*fault.dead_nodes, *fault.straggler_nodes)
+               if not 0 <= i < W]
+        if bad:
+            raise ValueError(f"fault node ids {bad} out of range [0, {W})")
+
+    def _inject(self, state: DCMLState) -> DCMLState:
+        W = self.env.cfg.consts.worker_number_max
+        iw = jnp.arange(W)
+        f = self.fault
+        unavailable = state.unavailable
+        worker_prs = state.worker_prs
+        trace = state.trace
+        if f.dead_nodes:
+            dead = jnp.isin(iw, jnp.asarray(f.dead_nodes))
+            unavailable = unavailable | dead
+        if f.straggler_nodes:
+            strag = jnp.isin(iw, jnp.asarray(f.straggler_nodes))
+            if f.straggler_pr_floor > 0.0:
+                worker_prs = jnp.where(
+                    strag, jnp.maximum(worker_prs, f.straggler_pr_floor),
+                    worker_prs)
+            if f.straggler_load > 0.0:
+                trace = jnp.where(strag[:, None],
+                                  jnp.clip(trace + f.straggler_load, 0.0, 1.0),
+                                  trace)
+        # keep the rank denominator (W - disable_rate) consistent with the
+        # merged availability mask
+        disable_rate = unavailable.sum().astype(jnp.int32)
+        return state._replace(unavailable=unavailable, worker_prs=worker_prs,
+                              trace=trace, disable_rate=disable_rate)
+
+    def _reobserve(self, state: DCMLState, ts: TimeStep) -> TimeStep:
+        obs, share_obs, ava = self.env._observe(state)
+        return ts._replace(obs=obs, share_obs=share_obs, available_actions=ava)
+
+    def reset(self, key, episode_idx=0):
+        state, ts = self.env.reset(key, episode_idx)
+        state = self._inject(state)
+        return state, self._reobserve(state, ts)
+
+    def step(self, state: DCMLState, action):
+        # the incoming state was already injected (reset/previous step), so
+        # the wrapped step's reward/delay math runs against the faulty fleet;
+        # only the auto-reset NEXT state (and its observations, which this
+        # timestep carries) needs injection here
+        new_state, ts = self.env.step(state, action)
+        new_state = self._inject(new_state)
+        return new_state, self._reobserve(new_state, ts)
+
+    def encode_single_agent_state(self, state: DCMLState, binary: bool = True):
+        return self.env.encode_single_agent_state(state, binary)

@@ -129,3 +129,37 @@ def put_replicated(tree, mesh):
 
     repl = NamedSharding(mesh, P())
     return jax.tree.map(lambda x: jax.device_put(x, repl), tree)
+
+
+def put_sharded_state(tree, mesh, data_axis: str = "data"):
+    """Place a host-local rollout-state pytree on ``mesh`` under the same
+    contract :func:`global_init_state` builds with: every ndim>=1 leaf
+    carries a leading env-batch axis and shards over ``data_axis``, scalars
+    (the rng key) replicate.  This is the elastic-resume half of that
+    contract — a carry packed on one mesh re-places onto another, as long as
+    the env batch still divides the new shard count.
+
+    Single-process only (the packed carry is a full host-local copy, which a
+    multi-host relaunch does not have); multi-host elastic resume goes
+    through the orbax path instead.
+    """
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    n_data = dict(mesh.shape).get(data_axis, 1)
+    shard = NamedSharding(mesh, P(data_axis))
+    repl = NamedSharding(mesh, P())
+
+    def place(x):
+        ndim = getattr(x, "ndim", 0)
+        if ndim >= 1:
+            if x.shape[0] % n_data:
+                raise ValueError(
+                    f"env batch axis ({x.shape[0]}) must be divisible by the "
+                    f"mesh's {data_axis!r} axis ({n_data} shards); pick "
+                    f"--n_rollout_threads a multiple of --data_shards"
+                )
+            return jax.device_put(x, shard)
+        return jax.device_put(x, repl)
+
+    return jax.tree.map(place, tree)

@@ -50,6 +50,26 @@ class PRNGKeyLeaf:
     data: np.ndarray
 
 
+@dataclasses.dataclass
+class WeakLeaf:
+    """Host copy of a weak-typed array.  Weak-typedness is part of the aval
+    jit caches on, so losing it across a pack/unpack round trip (numpy has no
+    such notion) makes a resumed carry recompile the steady-state dispatch
+    once — :func:`unpack_tree` rebuilds the weak aval instead."""
+
+    data: np.ndarray
+
+
+def _with_weak_type(arr):
+    """Re-weaken an array's aval; best-effort (the hook is private jax)."""
+    try:
+        from jax._src.lax.lax import _convert_element_type
+
+        return _convert_element_type(arr, arr.dtype, weak_type=True)
+    except Exception:
+        return arr  # aval stays strong: still correct, worst case one recompile
+
+
 def pack_tree(tree: Any) -> Any:
     """Blocking device->host copy of a pytree, numpy leaves; typed PRNG keys
     become :class:`PRNGKeyLeaf`.  Safe to pickle."""
@@ -65,6 +85,8 @@ def pack_tree(tree: Any) -> Any:
         if isinstance(x, jax.Array) and jnp.issubdtype(x.dtype, jax.dtypes.prng_key):
             return PRNGKeyLeaf(str(jax.random.key_impl(x)),
                                np.array(jax.random.key_data(x), copy=True))
+        if isinstance(x, jax.Array) and getattr(x.aval, "weak_type", False):
+            return WeakLeaf(np.array(jax.device_get(x), copy=True))
         if hasattr(x, "__array__") or isinstance(x, (bool, int, float, complex)):
             return np.array(jax.device_get(x), copy=True)
         return x
@@ -79,14 +101,23 @@ def unpack_tree(tree: Any) -> Any:
     import jax.numpy as jnp
 
     def unpack_leaf(x):
+        # copy=True: the rebuilt arrays feed donating dispatches (replay,
+        # watchdog retries, emergency resume).  jnp.asarray can alias the
+        # numpy buffer on the CPU backend, and donation would then write
+        # into — and corrupt — the retained snapshot itself.
         if isinstance(x, PRNGKeyLeaf):
-            return jax.random.wrap_key_data(jnp.asarray(x.data), impl=x.impl)
-        if isinstance(x, np.ndarray) or isinstance(x, (bool, int, float, complex)):
+            return jax.random.wrap_key_data(jnp.array(x.data, copy=True),
+                                            impl=x.impl)
+        if isinstance(x, WeakLeaf):
+            return _with_weak_type(jnp.array(x.data, copy=True))
+        if isinstance(x, np.ndarray):
+            return jnp.array(x, copy=True)
+        if isinstance(x, (bool, int, float, complex)):
             return jnp.asarray(x)
         return x
 
     return jax.tree.map(unpack_leaf, tree,
-                        is_leaf=lambda x: isinstance(x, PRNGKeyLeaf))
+                        is_leaf=lambda x: isinstance(x, (PRNGKeyLeaf, WeakLeaf)))
 
 
 def git_hash(repo_root: Optional[Path] = None) -> str:

@@ -39,6 +39,18 @@ from mat_dcml_tpu.telemetry import (
 from mat_dcml_tpu.training.checkpoint import CheckpointManager
 from mat_dcml_tpu.training.mappo import Bootstrap
 from mat_dcml_tpu.training.ppo import PPOConfig
+from mat_dcml_tpu.training.resilience import (
+    EXIT_WATCHDOG,
+    DispatchFailedError,
+    DispatchWatchdog,
+    ElasticResumeError,
+    EmergencyCheckpoint,
+    GracefulStopHandler,
+    PreemptedExit,
+    WatchdogConfig,
+    pack_carry,
+    place_carry,
+)
 from mat_dcml_tpu.utils.metrics import MetricsWriter
 
 
@@ -230,7 +242,29 @@ class BaseRunner:
         self.run_dir = (
             Path(run.run_dir) / run.env_name / run.scenario / run.algorithm_name / run.experiment_name
         )
-        self.ckpt = CheckpointManager(self.run_dir / "models")
+        self.ckpt = CheckpointManager(self.run_dir / "models",
+                                      telemetry=self.telemetry, log=log_fn)
+        # preemption safety (training/resilience.py): graceful-stop flag,
+        # one-slot full-carry emergency checkpoint, dispatch watchdog
+        self.stop = (GracefulStopHandler(log=log_fn)
+                     if getattr(run, "graceful_stop", True) else None)
+        self.emergency = EmergencyCheckpoint(
+            self.run_dir / "models" / "emergency",
+            telemetry=self.telemetry, log=log_fn,
+        )
+        self.watchdog = DispatchWatchdog(
+            WatchdogConfig(
+                deadline_s=float(getattr(run, "dispatch_deadline_s", 0.0)),
+                max_retries=int(getattr(run, "dispatch_retries", 2)),
+                backoff_base_ms=float(getattr(run, "dispatch_backoff_ms", 100.0)),
+                snapshot_interval=int(getattr(run, "emergency_snapshot_interval", 1)),
+            ),
+            mesh=self.mesh, telemetry=self.telemetry, log=log_fn,
+        )
+        self._resume_key = None           # PRNG position from an emergency resume
+        self._restored_carry = None       # {"rollout_state": ...} ditto
+        self._emergency_saved_episode = None
+        self._restored_step = -1
         self.metrics_path = self.run_dir / "metrics.jsonl"
         self.writer = MetricsWriter(
             self.run_dir,
@@ -268,10 +302,18 @@ class BaseRunner:
         else:
             params = init_p(k_model)
             train_state = self.trainer.init_state(params)
-        if self.run_cfg.model_dir:
-            train_state = self._maybe_restore(train_state)
+        resume = getattr(self.run_cfg, "resume", "strict")
+        restore_dir = self.run_cfg.model_dir or (
+            str(self.ckpt.directory) if resume == "auto" else None
+        )
+        if restore_dir:
+            train_state = self._maybe_restore(train_state, directory=restore_dir)
             self.start_episode = self._restored_step + 1
-        if self.mesh is not None:
+        if self._restored_carry is not None:
+            # emergency resume carries the rollout/env state too (placed for
+            # this run's mesh in _maybe_restore) — do not re-init it
+            rollout_state = self._restored_carry["rollout_state"]
+        elif self.mesh is not None:
             rollout_state = global_init_state(
                 self.collector, k_roll, self.run_cfg.n_rollout_threads, self.mesh
             )
@@ -282,19 +324,58 @@ class BaseRunner:
         self._log_model_stats(train_state)
         return train_state, rollout_state
 
-    def _maybe_restore(self, train_state, params_only: bool = False):
-        """Restore from ``model_dir``.  ``params_only=True`` = transfer
-        semantics: weights reload, fresh optimizer/normalizer/schedule (the
-        reference's restore loads only the state_dict, SURVEY §5 checkpoint
-        notes); False = full-state lossless resume."""
-        mgr = CheckpointManager(self.run_cfg.model_dir)
-        restored = mgr.restore(template=train_state)
+    def _maybe_restore(self, train_state, params_only: bool = False,
+                       directory: Optional[str] = None):
+        """Restore from ``directory`` (default ``model_dir``).
+        ``params_only=True`` = transfer semantics: weights reload, fresh
+        optimizer/normalizer/schedule (the reference's restore loads only the
+        state_dict, SURVEY §5 checkpoint notes); False = full-state lossless
+        resume.
+
+        Sources, newest-progress wins: the latest *valid* regular step
+        (damaged steps are quarantined, not fatal —
+        ``CheckpointManager.restore_latest_valid``) vs. the emergency
+        full-carry checkpoint a graceful stop / crash wrote.  The emergency
+        slot also restores the rollout state and PRNG position, making the
+        resumed run bit-exact with an uninterrupted one; it may have been
+        packed on a different mesh — ``place_carry`` re-shards it for this
+        run's topology.  ``resume="auto"`` turns "nothing found" into a
+        fresh start instead of FileNotFoundError."""
+        directory = Path(directory or self.run_cfg.model_dir).absolute()
+        resume = getattr(self.run_cfg, "resume", "strict")
+        # reuse self.ckpt when restoring from this run's own models dir — two
+        # managers on one directory would hold independent stale step caches
+        mgr = (self.ckpt if directory == self.ckpt.directory
+               else CheckpointManager(directory, telemetry=self.telemetry,
+                                      log=self.log))
+        step, restored = mgr.restore_latest_valid(template=train_state)
+
+        found = None if params_only else self._load_emergency(directory)
+        next_ep = found["manifest"]["next_episode"] if found else None
+        # a regular step S resumes at S+1 with a FRESH rollout state and key;
+        # the emergency carry resumes at next_ep with the interrupted run's
+        # exact rollout state and PRNG position.  Prefer it on ties (equal
+        # progress, strictly more faithful) and whenever it is newer.
+        if found is not None and next_ep > (step if step is not None else -1):
+            ts, rs, k = self._place_emergency(found["snap"], train_state)
+            self._restored_step = next_ep - 1
+            self._restored_carry = {"rollout_state": rs}
+            self._resume_key = k
+            self.log(f"restored emergency checkpoint "
+                     f"({found['manifest'].get('reason', '?')}) from "
+                     f"{directory / 'emergency'}; resuming at episode {next_ep}")
+            return ts
+
         if restored is None:
-            raise FileNotFoundError(f"no checkpoint under {self.run_cfg.model_dir}")
-        self._restored_step = mgr.latest_step() or 0
+            if resume == "auto":
+                self.log(f"[resume auto] no checkpoint under {directory}; "
+                         f"starting fresh")
+                self._restored_step = -1
+                return train_state
+            raise FileNotFoundError(f"no checkpoint under {directory}")
+        self._restored_step = step
         kind = "params" if params_only else "full state"
-        self.log(f"restored checkpoint step {mgr.latest_step()} ({kind}) "
-                 f"from {self.run_cfg.model_dir}")
+        self.log(f"restored checkpoint step {step} ({kind}) from {directory}")
         if params_only:
             restored = train_state._replace(params=restored.params)
         if self.mesh is not None:
@@ -305,6 +386,38 @@ class BaseRunner:
 
             restored = put_replicated(restored, self.mesh)
         return restored
+
+    def _load_emergency(self, directory: Path):
+        emergency = (self.emergency
+                     if Path(directory) == self.emergency.directory.parent
+                     else EmergencyCheckpoint(Path(directory) / "emergency",
+                                              telemetry=self.telemetry,
+                                              log=self.log))
+        return emergency.load()
+
+    def _place_emergency(self, snap, template):
+        """Place a packed emergency carry for this run's topology, with typed
+        errors when it cannot fit."""
+        try:
+            ts, rs, k = place_carry(snap, self.mesh)
+        except ElasticResumeError:
+            raise
+        if (jax.tree.structure(ts) != jax.tree.structure(template)):
+            raise ElasticResumeError(
+                "emergency checkpoint train-state structure does not match "
+                "this run's trainer (different algorithm or model config?)"
+            )
+        E = self.run_cfg.n_rollout_threads
+        leaves = jax.tree.leaves(rs)
+        batched = [x for x in leaves if getattr(x, "ndim", 0) >= 1]
+        if batched and any(int(x.shape[0]) != E for x in batched):
+            got = {int(x.shape[0]) for x in batched}
+            raise ElasticResumeError(
+                f"emergency checkpoint was taken with n_rollout_threads="
+                f"{sorted(got)} but this run uses {E}; elastic resume reshapes "
+                f"the mesh, not the env batch"
+            )
+        return ts, rs, k
 
     def _log_model_stats(self, train_state) -> None:
         """The reference's parameter-count block + THOP hook, XLA-native
@@ -320,8 +433,14 @@ class BaseRunner:
         episodes = num_episodes if num_episodes is not None else run.episodes
         if train_state is None:
             train_state, rollout_state = self.setup()
-        key = jax.random.key(run.seed + 7919)
+        # an emergency resume restores the PRNG position too — continuing the
+        # interrupted chain is what makes resume bit-exact with an
+        # uninterrupted run
+        key = (self._resume_key if self._resume_key is not None
+               else jax.random.key(run.seed + 7919))
 
+        if self.stop is not None:
+            self.stop.install()
         K = max(1, int(getattr(run, "iters_per_dispatch", 1)))
         try:
             if K > 1:
@@ -334,12 +453,26 @@ class BaseRunner:
                 else:
                     return self._train_loop_fused(episodes, train_state, rollout_state, key, K)
             return self._train_loop_episodic(episodes, train_state, rollout_state, key)
+        except PreemptedExit:
+            raise                      # already emergency-checkpointed
+        except DispatchFailedError as e:
+            self._emergency_on_failure(repr(e))
+            self.log(f"[resilience] dispatch retries exhausted: {e}")
+            raise SystemExit(EXIT_WATCHDOG) from e
+        except BaseException as e:
+            # unhandled crash: save what the watchdog last snapshotted so the
+            # relaunch loses at most emergency_snapshot_interval dispatches
+            self._emergency_on_failure(repr(e))
+            raise
         finally:
+            if self.stop is not None:
+                self.stop.uninstall()
             # a tripwire profiler window still open at exit — normal return OR
             # a crash mid-run — must stop its trace or the xplane.pb is corrupt
             self.profile_window.close()
             # saves are async (checkpoint.py): the loop's last scheduled save
-            # must land before the run dir is read (resume, serving export)
+            # must land before the run dir is read (resume, serving export) —
+            # and so a clean shutdown never leaves a half-written step
             self.ckpt.finish()
 
     def _train_loop_episodic(self, episodes, train_state, rollout_state, key):
@@ -362,6 +495,12 @@ class BaseRunner:
 
         start = time.time()
         for episode in range(self.start_episode, episodes):
+            self._graceful_stop_check(episode, train_state, rollout_state, key)
+            # crash-path snapshot (no donation here, so no retry use — this
+            # feeds the unhandled-exception emergency checkpoint).  Host-driven
+            # collectors may carry non-array state pack_tree can't deep-copy.
+            if getattr(self.collector, "jittable", True):
+                self.watchdog.arm(episode, train_state, rollout_state, key)
             self.profile_window.tick()
             # profile ONE post-warmup iteration (episode start+1: compiles are
             # done, steady-state schedule) — the jax.profiler hook the
@@ -714,6 +853,10 @@ class BaseRunner:
         pending = None            # (d, ep_last, fetch, t_launch) in flight
         for d in range(n_disp):
             ep0 = first + d * K
+            # graceful stop lands HERE: the carry is whole (outputs of
+            # dispatch d-1, not yet donated) — the only point a full-state
+            # emergency checkpoint is possible
+            self._graceful_stop_check(ep0, train_state, rollout_state, key)
             self.profile_window.tick()
             # checkpoint/eval for the previous dispatch boundary must run
             # BEFORE this dispatch donates (invalidates) train_state's buffers
@@ -724,14 +867,17 @@ class BaseRunner:
             # later — the ring (depth >= 2) is what still holds this state
             # when a tripwire fires
             self.flight.snapshot(ep0, train_state, rollout_state, key)
+            # watchdog snapshot (same pre-donation constraint): feeds dispatch
+            # retries and the crash-path emergency checkpoint
+            self.watchdog.arm(ep0, train_state, rollout_state, key)
             profiling = (run.profile_dir is not None and d == 1
                          and not self.profile_window.active)
             if profiling:
                 jax.profiler.start_trace(run.profile_dir)
             try:
                 t_launch = time.perf_counter()
-                train_state, rollout_state, key, stacked = self._dispatch(
-                    train_state, rollout_state, key
+                train_state, rollout_state, key, stacked = self.watchdog.run(
+                    self._dispatch, train_state, rollout_state, key
                 )
                 if profiling:
                     jax.block_until_ready(train_state)
@@ -760,6 +906,67 @@ class BaseRunner:
                  final=True)
         process(*pending)
         return train_state, rollout_state
+
+    # ------------------------------------------------------------ resilience
+
+    def _graceful_stop_check(self, episode: int, train_state, rollout_state,
+                             key) -> None:
+        """Honor a pending SIGTERM/SIGINT at a dispatch boundary: blocking
+        emergency checkpoint of the full carry, then :class:`PreemptedExit`
+        (process exit 75 — the supervisor relaunches with ``--resume auto``
+        and the run continues bit-exact)."""
+        if self.stop is None or not self.stop.stop_requested:
+            return
+        run = self.run_cfg
+        reason = self.stop.reason or "signal"
+        if jax.process_count() > 1 or not getattr(self.collector, "jittable",
+                                                  True):
+            # the packed carry needs fully-addressable arrays (and an
+            # array-only rollout state); multi-host and host-driven runs fall
+            # back to their latest regular checkpoint on relaunch
+            self.log("[resilience] emergency carry unavailable here; resume "
+                     "uses the latest regular checkpoint")
+        else:
+            snap = pack_carry(episode, train_state, rollout_state, key)
+            self.emergency.save(snap, reason)
+            self._emergency_saved_episode = episode
+        latency = self.stop.latency_s()
+        self.telemetry.gauge("resilience_stop_latency_s", latency)
+        total_steps = episode * run.episode_length * run.n_rollout_threads
+        self.writer.write(
+            {"emergency_checkpoint": reason, "episode": episode,
+             "total_steps": total_steps, "stop_latency_s": latency},
+            step=total_steps,
+        )
+        self.ckpt.finish()     # in-flight async save must land too
+        self.log(f"[resilience] graceful stop at episode {episode} "
+                 f"({latency:.2f}s after {reason}); exiting preempted")
+        raise PreemptedExit()
+
+    def _emergency_on_failure(self, reason: str) -> None:
+        """Crash path (unhandled exception, watchdog exhaustion): persist the
+        watchdog's last pre-launch snapshot so the relaunch loses at most
+        ``emergency_snapshot_interval`` dispatches.  Never masks the original
+        error."""
+        snap = self.watchdog.last_snapshot
+        if snap is None or snap["episode"] == self._emergency_saved_episode:
+            return
+        if jax.process_count() > 1:
+            return     # per-process carries are partial; rely on regular steps
+        try:
+            self.emergency.save(snap, f"failure: {reason}"[:200])
+            self._emergency_saved_episode = snap["episode"]
+            run = self.run_cfg
+            total_steps = (snap["episode"] * run.episode_length
+                           * run.n_rollout_threads)
+            self.writer.write(
+                {"emergency_checkpoint": f"failure: {reason}"[:200],
+                 "episode": snap["episode"], "total_steps": total_steps},
+                step=total_steps,
+            )
+        except Exception as e:
+            self.log(f"[resilience] emergency checkpoint on failure ALSO "
+                     f"failed: {e!r}")
 
     # ------------------------------------------------------------- anomalies
 

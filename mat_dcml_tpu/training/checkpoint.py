@@ -16,16 +16,33 @@ Two additions for the serving stack (serving/):
   just the params subtree plus a JSON manifest (MATConfig fields + obs/act
   space metadata), so a server restores a policy without ever deserializing
   optimizer moments or ValueNorm state — and without importing any trainer.
+
+And one for preemption safety (training/resilience.py):
+
+- **integrity manifests + fall-back restore**: every finalized save gets a
+  CRC32-per-file manifest under ``<dir>/integrity/<step>.json``, written only
+  after orbax finishes the async write.  :meth:`restore_latest_valid` walks
+  steps newest→oldest, quarantines any step whose files are missing/
+  truncated/bit-flipped (or that orbax can't deserialize) into
+  ``<dir>/quarantine/``, and restores the newest step that checks out — a
+  relaunch survives a SIGKILL mid-save instead of crashing in restore.  The
+  CRC check is the authoritative detector: orbax's ocdbt layout dedups
+  content, so a damaged or even missing payload file does NOT reliably make
+  ``restore`` raise for small trees.
 """
 
 from __future__ import annotations
 
 import dataclasses
 import json
+import shutil
+import time
+import zlib
 from pathlib import Path
 from typing import Any, Dict, Optional, Tuple
 
 import jax
+import jax.numpy as jnp
 import orbax.checkpoint as ocp
 
 from mat_dcml_tpu.models.mat import MATConfig
@@ -33,12 +50,45 @@ from mat_dcml_tpu.models.mat import MATConfig
 POLICY_MANIFEST = "policy_manifest.json"
 _PARAMS_SUBDIR = "params"
 
+INTEGRITY_FORMAT = "mat_dcml_tpu/ckpt-integrity/v1"
+_INTEGRITY_SUBDIR = "integrity"
+_QUARANTINE_SUBDIR = "quarantine"
+
+
+def _commit_to_device(tree):
+    """Copy restored leaves into device-owned buffers.
+
+    Orbax hands back host numpy arrays, which jit may alias zero-copy on the
+    CPU backend — feeding those straight into the donating fused dispatch
+    lets XLA write into memory it doesn't own (observed as denormal garbage
+    in the resumed train state).  An explicit committed copy makes restored
+    state safe to donate."""
+    return jax.tree.map(lambda x: jnp.array(x, copy=True), tree)
+
+
+def _crc32_file(path: Path, chunk: int = 1 << 20) -> int:
+    crc = 0
+    with path.open("rb") as f:
+        while True:
+            block = f.read(chunk)
+            if not block:
+                return crc
+            crc = zlib.crc32(block, crc)
+
 
 class CheckpointManager:
-    def __init__(self, directory: str | Path, max_to_keep: int = 5):
+    def __init__(self, directory: str | Path, max_to_keep: int = 5,
+                 telemetry=None, log=print):
         self.directory = Path(directory).absolute()
         self.directory.mkdir(parents=True, exist_ok=True)
-        self.manager = ocp.CheckpointManager(
+        self.telemetry = telemetry
+        self.log = log
+        self._pending_integrity: list[int] = []
+        self.manager = self._make_manager(max_to_keep)
+        self._max_to_keep = max_to_keep
+
+    def _make_manager(self, max_to_keep: int) -> ocp.CheckpointManager:
+        return ocp.CheckpointManager(
             self.directory,
             options=ocp.CheckpointManagerOptions(max_to_keep=max_to_keep, create=True),
         )
@@ -52,19 +102,23 @@ class CheckpointManager:
         flight and the wait is ~free in steady state.  ``blocking=True``
         restores the old synchronous behavior (used right before reads).
         """
-        self.manager.wait_until_finished()   # finalize any in-flight save
+        self._finish_and_flush()             # finalize any in-flight save
         self.manager.save(step, args=ocp.args.StandardSave(train_state))
+        self._pending_integrity.append(int(step))
         if blocking:
-            self.manager.wait_until_finished()
+            self._finish_and_flush()
 
     def restore(self, step: Optional[int] = None, template=None):
-        self.manager.wait_until_finished()   # a just-scheduled save must land
+        self._finish_and_flush()             # a just-scheduled save must land
         step = step if step is not None else self.manager.latest_step()
         if step is None:
             return None
-        if template is not None:
-            return self.manager.restore(step, args=ocp.args.StandardRestore(template))
-        return self.manager.restore(step)
+        # args= always: a bare manager.restore(step) raises KeyError("default")
+        # under orbax's registry dispatch when the save went through
+        # StandardSave; an empty StandardRestore means "no template"
+        restored = self.manager.restore(
+            step, args=ocp.args.StandardRestore(template))
+        return _commit_to_device(restored)
 
     def latest_step(self) -> Optional[int]:
         """Most recent finalized checkpoint step (None when empty) — the
@@ -73,12 +127,127 @@ class CheckpointManager:
 
     def finish(self) -> None:
         """Finalize any in-flight async save (manager stays usable)."""
-        self.manager.wait_until_finished()
+        self._finish_and_flush()
 
     def close(self) -> None:
         """Finalize any in-flight async save and release the manager."""
         self.finish()
         self.manager.close()
+
+    # ------------------------------------------------------------ integrity
+
+    def _finish_and_flush(self) -> None:
+        """Wait for in-flight saves, then write integrity manifests for every
+        step that just became durable.  The manifest MUST trail the orbax
+        finalize — hashing a step that's still being written would bless
+        torn bytes."""
+        self.manager.wait_until_finished()
+        for step in self._pending_integrity:
+            self._write_integrity(step)
+        self._pending_integrity.clear()
+
+    def _step_dir(self, step: int) -> Path:
+        return self.directory / str(int(step))
+
+    def _integrity_path(self, step: int) -> Path:
+        return self.directory / _INTEGRITY_SUBDIR / f"{int(step)}.json"
+
+    def _write_integrity(self, step: int) -> None:
+        step_dir = self._step_dir(step)
+        if not step_dir.is_dir():
+            return     # retention already dropped it (max_to_keep)
+        files = {}
+        for path in sorted(step_dir.rglob("*")):
+            if not path.is_file():
+                continue
+            rel = path.relative_to(step_dir).as_posix()
+            files[rel] = {"size": path.stat().st_size, "crc32": _crc32_file(path)}
+        manifest = {"format": INTEGRITY_FORMAT, "step": int(step), "files": files}
+        ipath = self._integrity_path(step)
+        ipath.parent.mkdir(parents=True, exist_ok=True)
+        tmp = ipath.with_suffix(".json.tmp")
+        tmp.write_text(json.dumps(manifest, indent=1))
+        tmp.replace(ipath)
+
+    def verify_step(self, step: int) -> Tuple[str, str]:
+        """``("ok" | "unverified" | "bad", reason)`` for one on-disk step.
+
+        "unverified" = no integrity manifest (a pre-manifest legacy save, or
+        a crash between finalize and manifest write) — restorable, but not
+        CRC-attested.  "bad" = the manifest exists and the step contradicts
+        it (missing/truncated/corrupt file)."""
+        step_dir = self._step_dir(step)
+        if not step_dir.is_dir():
+            return "bad", "step directory missing"
+        ipath = self._integrity_path(step)
+        if not ipath.exists():
+            return "unverified", "no integrity manifest"
+        try:
+            manifest = json.loads(ipath.read_text())
+            if manifest.get("format") != INTEGRITY_FORMAT:
+                return "unverified", f"unknown manifest format {manifest.get('format')!r}"
+            for rel, want in manifest["files"].items():
+                path = step_dir / rel
+                if not path.is_file():
+                    return "bad", f"missing file {rel}"
+                if path.stat().st_size != want["size"]:
+                    return "bad", f"size mismatch in {rel}"
+                if _crc32_file(path) != want["crc32"]:
+                    return "bad", f"CRC mismatch in {rel}"
+        except (OSError, json.JSONDecodeError, KeyError, TypeError) as e:
+            return "unverified", f"unreadable manifest: {e!r}"
+        return "ok", "verified"
+
+    def quarantine_step(self, step: int, reason: str) -> None:
+        """Move a damaged step (plus its manifest) into ``<dir>/quarantine/``
+        and rebuild the orbax manager so its step cache forgets it."""
+        qdir = self.directory / _QUARANTINE_SUBDIR / f"{int(step)}.{int(time.time())}"
+        qdir.parent.mkdir(parents=True, exist_ok=True)
+        step_dir = self._step_dir(step)
+        if step_dir.exists():
+            shutil.move(str(step_dir), str(qdir))
+            (qdir / "quarantine_reason.txt").write_text(reason + "\n")
+        ipath = self._integrity_path(step)
+        if ipath.exists():
+            qdir.mkdir(exist_ok=True)
+            shutil.move(str(ipath), str(qdir / ipath.name))
+        if self.telemetry is not None:
+            self.telemetry.count("resilience_quarantined_steps")
+        self.log(f"[checkpoint] quarantined step {step} ({reason}) -> {qdir}")
+        self.manager.close()
+        self.manager = self._make_manager(self._max_to_keep)
+
+    def restore_latest_valid(self, template=None):
+        """``(step, state)`` for the newest step that passes integrity and
+        deserializes, quarantining every damaged step it skips on the way
+        down; ``(None, None)`` when nothing on disk is usable.
+
+        This is the crash-safe replacement for ``restore()`` in resume paths:
+        a SIGKILL mid-save (or bit rot) costs one ``save_interval`` of
+        progress instead of wedging the relaunch."""
+        self._finish_and_flush()
+        steps = sorted(
+            (int(p.name) for p in self.directory.iterdir()
+             if p.is_dir() and p.name.isdigit()),
+            reverse=True,
+        )
+        for step in steps:
+            status, reason = self.verify_step(step)
+            if status == "bad":
+                self.quarantine_step(step, reason)
+                continue
+            if status == "unverified":
+                self.log(f"[checkpoint] step {step} has no integrity manifest "
+                         f"({reason}); restoring unverified")
+            try:
+                # args= always — see restore()
+                state = self.manager.restore(
+                    step, args=ocp.args.StandardRestore(template))
+            except Exception as e:
+                self.quarantine_step(step, f"unreadable: {e!r}")
+                continue
+            return step, _commit_to_device(state)
+        return None, None
 
 
 # ---------------------------------------------------------------------------

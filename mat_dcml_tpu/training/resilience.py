@@ -1,0 +1,416 @@
+"""Preemption-safe training: graceful stop, emergency checkpoints, watchdog.
+
+Chip sessions get preempted (the retired ``scripts/tpu_retry_session*.sh``
+probe loops are the fossil record); Podracer (PAPERS.md) makes preemptible-TPU
+tolerance an architectural property rather than an ops afterthought.  This
+module is the runner-side half of that property:
+
+- :class:`GracefulStopHandler` turns SIGTERM/SIGINT into a *requested* stop
+  that the training loop honors at the next dispatch boundary — the only
+  point where the donated carry (train state, rollout state, key chain) is
+  whole and un-donated.
+- :class:`EmergencyCheckpoint` is the blocking full-carry checkpoint taken at
+  that boundary: params + optimizer + ValueNorm + rollout/env state + the
+  PRNG key position, packed with the :func:`flight_recorder.pack_tree`
+  deep-copy pattern (typed keys survive as :class:`PRNGKeyLeaf`), written
+  atomically next to the regular orbax steps with a CRC-checked manifest.
+  Resuming from it re-enters the loop at exactly the captured boundary, so a
+  preempted run is bit-exact with an uninterrupted one (tests/
+  test_resilience.py pins this through real SIGTERM).
+- :class:`DispatchWatchdog` wraps the fused dispatch launch: device errors
+  (and, optionally, per-dispatch deadline overruns) re-place the carry from
+  the last pre-launch snapshot and retry with the bounded jittered backoff
+  policy ``serving/fleet.py`` uses; exhausted retries surface as
+  :class:`DispatchFailedError`, which the runner converts into an emergency
+  checkpoint plus a nonzero exit.
+- :func:`place_carry` is the elastic-resume seam: a packed carry re-places
+  onto *whatever* mesh the relaunch got — replicated leaves via
+  ``put_replicated``, env-batch leaves re-sharded over the new ``data`` axis
+  via ``put_sharded_state`` — with :class:`ElasticResumeError` when the env
+  batch no longer divides the shard count.
+
+Exit codes: ``EXIT_PREEMPTED`` (75, BSD EX_TEMPFAIL — "try again") tells
+``scripts/train_supervisor.py`` the stop was a clean preemption (relaunch
+immediately, don't count it as a crash); ``EXIT_WATCHDOG`` (76) marks a run
+the watchdog gave up on.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import pickle
+import random
+import shutil
+import signal
+import time
+import zlib
+from pathlib import Path
+from typing import Any, Callable, Dict, Optional
+
+from mat_dcml_tpu.telemetry.flight_recorder import pack_tree, unpack_tree
+
+EXIT_PREEMPTED = 75   # EX_TEMPFAIL: graceful stop, relaunch-and-resume me
+EXIT_WATCHDOG = 76    # EX_PROTOCOL: dispatch retries exhausted
+
+EMERGENCY_FORMAT = "mat_dcml_tpu/emergency/v1"
+_MANIFEST = "manifest.json"
+_STATE = "state.pkl"
+
+
+class PreemptedExit(SystemExit):
+    """Raised by the runner after a graceful-stop emergency checkpoint; the
+    process exits ``EXIT_PREEMPTED`` so supervisors can tell preemption from
+    crash."""
+
+    def __init__(self, code: int = EXIT_PREEMPTED):
+        super().__init__(code)
+
+
+class DispatchFailedError(RuntimeError):
+    """The watchdog exhausted its retries on one dispatch."""
+
+
+class ElasticResumeError(ValueError):
+    """A packed carry cannot be placed on the current topology/config (env
+    batch not divisible by the new ``data`` shard count, or the checkpoint
+    was written by an incompatible algorithm/config)."""
+
+
+# --------------------------------------------------------------------- carry
+
+def pack_carry(episode: int, train_state, rollout_state, key) -> Dict[str, Any]:
+    """Blocking host deep-copy of the full training carry at a dispatch
+    boundary.  Must run BEFORE the next dispatch launches: donation
+    invalidates these buffers, and on the CPU backend ``device_get`` can
+    alias them (pack_tree's copy=True is what makes the snapshot survive)."""
+    return {
+        "episode": int(episode),
+        "train_state": pack_tree(train_state),
+        "rollout_state": pack_tree(rollout_state),
+        "key": pack_tree(key),
+    }
+
+
+def place_carry(snap: Dict[str, Any], mesh=None):
+    """Rebuild ``(train_state, rollout_state, key)`` from a packed carry and
+    place it on ``mesh`` (None = host-local single-process placement).
+
+    The mesh does NOT have to match the one the carry was packed on: params/
+    optimizer/key leaves are replicated, and rollout leaves re-shard over the
+    new mesh's ``data`` axis by the same shape contract ``global_init_state``
+    uses (leading env-batch axis on every ndim>=1 leaf).  Divisibility
+    failures surface as :class:`ElasticResumeError`.
+    """
+    train_state = unpack_tree(snap["train_state"])
+    rollout_state = unpack_tree(snap["rollout_state"])
+    key = unpack_tree(snap["key"])
+    if mesh is not None:
+        from mat_dcml_tpu.parallel.distributed import (
+            put_replicated,
+            put_sharded_state,
+        )
+
+        train_state = put_replicated(train_state, mesh)
+        key = put_replicated(key, mesh)
+        try:
+            rollout_state = put_sharded_state(rollout_state, mesh)
+        except ValueError as e:
+            raise ElasticResumeError(
+                f"cannot re-place the checkpointed rollout state on this mesh: {e}"
+            ) from e
+    return train_state, rollout_state, key
+
+
+# ------------------------------------------------------------- graceful stop
+
+class GracefulStopHandler:
+    """SIGTERM/SIGINT -> a stop *request* the loop polls at boundaries.
+
+    The first signal only sets a flag (plus its arrival time, for the
+    ``resilience_stop_latency_s`` gauge); the second restores the previous
+    handler so a repeated Ctrl-C / kill still terminates a wedged run the
+    default way.  ``install`` is a no-op off the main thread (Python only
+    allows signal handlers there) — the loop then simply never sees a stop
+    request, which is the correct degradation for embedded/test use.
+    """
+
+    SIGNALS = (signal.SIGTERM, signal.SIGINT)
+
+    def __init__(self, log=print):
+        self.log = log
+        self.stop_requested = False
+        self.reason: Optional[str] = None
+        self._requested_at: Optional[float] = None
+        self._previous: Dict[int, Any] = {}
+        self.installed = False
+
+    def install(self) -> bool:
+        try:
+            for sig in self.SIGNALS:
+                self._previous[sig] = signal.signal(sig, self._handle)
+        except ValueError:       # not the main thread
+            self._previous.clear()
+            return False
+        self.installed = True
+        return True
+
+    def uninstall(self) -> None:
+        if not self.installed:
+            return
+        for sig, prev in self._previous.items():
+            try:
+                signal.signal(sig, prev)
+            except (ValueError, TypeError):
+                pass
+        self._previous.clear()
+        self.installed = False
+
+    def _handle(self, signum, frame) -> None:
+        name = signal.Signals(signum).name
+        if self.stop_requested:
+            # second signal: stop being graceful
+            prev = self._previous.get(signum, signal.SIG_DFL)
+            signal.signal(signum, prev)
+            self.log(f"[resilience] second {name}: restoring default handling")
+            os.kill(os.getpid(), signum)
+            return
+        self.stop_requested = True
+        self.reason = name
+        self._requested_at = time.monotonic()
+        self.log(f"[resilience] {name} received: stopping at the next "
+                 f"dispatch boundary (emergency checkpoint will be taken)")
+
+    def latency_s(self) -> float:
+        """Seconds between the stop request and now (0 when never requested)."""
+        if self._requested_at is None:
+            return 0.0
+        return time.monotonic() - self._requested_at
+
+
+# ------------------------------------------------------ emergency checkpoint
+
+class EmergencyCheckpoint:
+    """One-slot blocking full-carry checkpoint beside the regular steps.
+
+    Layout (``<models>/emergency/``): ``state.pkl`` — the pickled packed
+    carry — and ``manifest.json`` with the resume episode plus the payload's
+    size and CRC32.  Writes build a temp directory and atomically swap it in,
+    so a SIGKILL mid-write can never leave a half emergency checkpoint where
+    a resume would find it.  ``load`` verifies the CRC and quarantines a
+    corrupt slot instead of crashing the relaunch.
+    """
+
+    def __init__(self, directory, telemetry=None, log=print):
+        self.directory = Path(directory).absolute()
+        self.telemetry = telemetry
+        self.log = log
+        self.last_saved_episode: Optional[int] = None
+
+    # ------------------------------------------------------------------ save
+
+    def save(self, snap: Dict[str, Any], reason: str,
+             meta: Optional[Dict[str, Any]] = None) -> Path:
+        payload = pickle.dumps(snap, protocol=pickle.HIGHEST_PROTOCOL)
+        manifest = {
+            "format": EMERGENCY_FORMAT,
+            "reason": str(reason),
+            "episode": int(snap["episode"]),
+            # the episode the resumed loop starts AT: the carry is the input
+            # to the dispatch that begins at `episode`
+            "next_episode": int(snap["episode"]),
+            "state_bytes": len(payload),
+            "state_crc32": zlib.crc32(payload),
+            "wall_time": time.time(),
+        }
+        if meta:
+            manifest.update(meta)
+        tmp = self.directory.parent / f".{self.directory.name}.tmp.{os.getpid()}"
+        shutil.rmtree(tmp, ignore_errors=True)
+        tmp.mkdir(parents=True)
+        (tmp / _STATE).write_bytes(payload)
+        (tmp / _MANIFEST).write_text(json.dumps(manifest, indent=1))
+        # swap: move the old slot aside, rename the new one in, drop the old.
+        # Each rename is atomic, so every observable intermediate state is
+        # either the old complete slot, no slot, or the new complete slot.
+        old = self.directory.parent / f".{self.directory.name}.old.{os.getpid()}"
+        shutil.rmtree(old, ignore_errors=True)
+        if self.directory.exists():
+            os.rename(self.directory, old)
+        os.rename(tmp, self.directory)
+        shutil.rmtree(old, ignore_errors=True)
+        self.last_saved_episode = int(snap["episode"])
+        if self.telemetry is not None:
+            self.telemetry.count("resilience_emergency_saves")
+        self.log(f"[resilience] emergency checkpoint ({reason}) -> "
+                 f"{self.directory} (resume at episode {manifest['next_episode']})")
+        return self.directory
+
+    # ------------------------------------------------------------------ load
+
+    def load(self) -> Optional[Dict[str, Any]]:
+        """``{"snap": ..., "manifest": ...}`` or None (absent OR corrupt —
+        a corrupt slot is quarantined and reported, never fatal)."""
+        mpath = self.directory / _MANIFEST
+        spath = self.directory / _STATE
+        if not mpath.exists() and not spath.exists():
+            return None
+        why = None
+        try:
+            manifest = json.loads(mpath.read_text())
+            if manifest.get("format") != EMERGENCY_FORMAT:
+                why = f"unrecognized format {manifest.get('format')!r}"
+            else:
+                payload = spath.read_bytes()
+                if len(payload) != manifest["state_bytes"]:
+                    why = (f"truncated payload ({len(payload)} bytes, manifest "
+                           f"says {manifest['state_bytes']})")
+                elif zlib.crc32(payload) != manifest["state_crc32"]:
+                    why = "payload CRC mismatch"
+                else:
+                    snap = pickle.loads(payload)
+        except Exception as e:
+            why = f"unreadable: {e!r}"
+        if why is not None:
+            self._quarantine(why)
+            return None
+        return {"snap": snap, "manifest": manifest}
+
+    def _quarantine(self, why: str) -> None:
+        dest = self.directory.parent / (
+            f"{self.directory.name}.quarantined.{int(time.time())}"
+        )
+        try:
+            os.rename(self.directory, dest)
+            (dest / "reason.txt").write_text(why + "\n")
+        except OSError:
+            pass
+        if self.telemetry is not None:
+            self.telemetry.count("resilience_quarantined_steps")
+        self.log(f"[resilience] emergency checkpoint corrupt ({why}); "
+                 f"quarantined -> {dest}")
+
+
+# ------------------------------------------------------------------ watchdog
+
+@dataclasses.dataclass(frozen=True)
+class WatchdogConfig:
+    # wall-clock bound on one dispatch, enforced by blocking on its outputs
+    # (trading the async dispatch overlap for a deadline); 0 disables
+    deadline_s: float = 0.0
+    # retries per dispatch before DispatchFailedError
+    max_retries: int = 2
+    # fleet.py backoff: base * 2^(attempt-1) * (0.5 + U())
+    backoff_base_ms: float = 100.0
+    # pre-launch carry snapshot cadence (dispatches); 0 disables snapshots —
+    # graceful stop still works (it packs boundary state directly), but the
+    # crash paths (retry, emergency-on-exception) have nothing to restore
+    snapshot_interval: int = 1
+
+
+class DispatchDeadlineError(RuntimeError):
+    """One dispatch overran ``deadline_s`` (hung device / degraded chip)."""
+
+
+class DispatchWatchdog:
+    """Deadline + device-error trap around the fused dispatch launch.
+
+    ``arm`` packs the dispatch inputs (blocking device->host deep copy) at
+    the configured cadence, BEFORE launch — donation invalidates them right
+    after.  ``run`` launches through the trap: a raising dispatch (or one
+    overrunning the deadline) is retried from a re-placed copy of that
+    snapshot with fleet-style jittered backoff; once retries are exhausted it
+    raises :class:`DispatchFailedError`, leaving the snapshot available for
+    the runner's emergency-checkpoint path.
+    """
+
+    def __init__(self, cfg: WatchdogConfig, mesh=None, telemetry=None,
+                 log=print, sleep=time.sleep, rand=random.random):
+        self.cfg = cfg
+        self.mesh = mesh
+        self.telemetry = telemetry
+        self.log = log
+        self._sleep = sleep
+        self._rand = rand
+        self._snap: Optional[Dict[str, Any]] = None
+        self._snap_is_current = False
+        self._calls = 0
+
+    @property
+    def last_snapshot(self) -> Optional[Dict[str, Any]]:
+        return self._snap
+
+    def arm(self, episode: int, train_state, rollout_state, key) -> bool:
+        """Snapshot the carry about to be dispatched (cadenced).  Returns
+        True when a snapshot was taken this call."""
+        if self.cfg.snapshot_interval <= 0:
+            return False
+        import jax
+
+        if jax.process_count() > 1:
+            # cross-process sharded leaves are not fully addressable here;
+            # multi-host crash recovery rides the regular orbax steps
+            return False
+        take = self._calls % self.cfg.snapshot_interval == 0
+        self._calls += 1
+        self._snap_is_current = take
+        if not take:
+            return False
+        self._snap = pack_carry(episode, train_state, rollout_state, key)
+        if self.telemetry is not None:
+            self.telemetry.count("resilience_snapshots")
+        return True
+
+    def _count(self, name: str) -> None:
+        if self.telemetry is not None:
+            self.telemetry.count(name)
+
+    def run(self, fn: Callable, train_state, rollout_state, key):
+        """Launch ``fn(train_state, rollout_state, key)`` under the trap and
+        return its output.  With a deadline configured the call blocks on the
+        outputs to time it; without one, errors surface here anyway because
+        jax raises on the enqueueing call once the failed buffers are used."""
+        import jax
+
+        attempt = 0
+        while True:
+            started = time.perf_counter()
+            try:
+                out = fn(train_state, rollout_state, key)
+                if self.cfg.deadline_s > 0:
+                    jax.block_until_ready(out)
+                    elapsed = time.perf_counter() - started
+                    if elapsed > self.cfg.deadline_s:
+                        raise DispatchDeadlineError(
+                            f"dispatch took {elapsed:.2f}s "
+                            f"(deadline {self.cfg.deadline_s:.2f}s)"
+                        )
+                return out
+            except DispatchDeadlineError as e:
+                self._count("resilience_deadline_overruns")
+                err = e
+            except Exception as e:
+                err = e
+            # ---- failure path: re-place from the snapshot and retry
+            if self._snap is None or not self._snap_is_current:
+                # nothing valid to replay this dispatch from (snapshots off
+                # or cadenced past it) — escalate straight to the runner
+                self._count("resilience_dispatch_failures")
+                raise DispatchFailedError(
+                    f"dispatch failed with no replayable snapshot: {err!r}"
+                ) from err
+            attempt += 1
+            if attempt > self.cfg.max_retries:
+                self._count("resilience_dispatch_failures")
+                raise DispatchFailedError(
+                    f"dispatch failed {attempt} times (last: {err!r})"
+                ) from err
+            self._count("resilience_dispatch_retries")
+            base = self.cfg.backoff_base_ms / 1e3
+            delay = base * (2 ** (attempt - 1)) * (0.5 + self._rand())
+            self.log(f"[resilience] dispatch attempt {attempt} failed "
+                     f"({err!r}); retrying from the episode "
+                     f"{self._snap['episode']} snapshot in {delay * 1e3:.0f}ms")
+            self._sleep(delay)
+            train_state, rollout_state, key = place_carry(self._snap, self.mesh)

@@ -93,6 +93,10 @@ KNOWN_PREFIXES = (
     # (shard_hbm_high_water_bytes, absent on CPU), and the compiled psum
     # count (shard_psum_count)
     "shard_",
+    # preemption-safety gauges (training/resilience.py + base_runner):
+    # snapshot/retry/failure/emergency-save/quarantine counters,
+    # deadline-overrun count, graceful-stop latency (resilience_stop_latency_s)
+    "resilience_",
 )
 
 # fields that must never go negative (counters, rates, timers, gauges)
@@ -206,6 +210,39 @@ def _validate_anomaly(record, where: str) -> List[str]:
     return errs
 
 
+# emergency-checkpoint records (base_runner._graceful_stop_check /
+# _emergency_on_failure): like anomaly records, a typed exception to the
+# numbers-only rule — the marker field carries the stop reason as a string.
+EMERGENCY_FIELDS = ("emergency_checkpoint", "episode", "total_steps",
+                    "stop_latency_s")
+_EMERGENCY_REQUIRED = ("emergency_checkpoint", "episode", "total_steps")
+
+
+def _validate_emergency(record, where: str) -> List[str]:
+    errs: List[str] = []
+    for k in _EMERGENCY_REQUIRED:
+        if k not in record:
+            errs.append(f"{where}: emergency record missing {k!r}")
+    v = record.get("emergency_checkpoint")
+    if v is not None and not isinstance(v, str):
+        errs.append(f"{where}: emergency field 'emergency_checkpoint' must be "
+                    f"a string (the stop reason)")
+    for k in ("episode", "total_steps"):
+        v = record.get(k)
+        if v is not None and (isinstance(v, bool) or not isinstance(v, int) or v < 0):
+            errs.append(f"{where}: emergency field {k!r} must be a "
+                        f"non-negative integer")
+    v = record.get("stop_latency_s")
+    if v is not None and (isinstance(v, bool) or not isinstance(v, (int, float))
+                         or not math.isfinite(v) or v < 0):
+        errs.append(f"{where}: emergency field 'stop_latency_s' must be a "
+                    f"non-negative finite number")
+    for k in record:
+        if k not in EMERGENCY_FIELDS:
+            errs.append(f"{where}: unexpected field {k!r} in emergency record")
+    return errs
+
+
 def validate_record(record, index: int = 0, strict_names: bool = True) -> List[str]:
     """Errors for one parsed jsonl record (empty list = valid)."""
     errs: List[str] = []
@@ -215,6 +252,9 @@ def validate_record(record, index: int = 0, strict_names: bool = True) -> List[s
     if "anomaly" in record:
         # typed tripwire record — its own schema, BEFORE the numbers-only rule
         return _validate_anomaly(record, where)
+    if "emergency_checkpoint" in record:
+        # typed emergency-checkpoint record — ditto
+        return _validate_emergency(record, where)
     for k, v in record.items():
         if isinstance(v, bool):
             errs.append(f"{where}: field {k!r} is a boolean (flags must not "
@@ -227,7 +267,8 @@ def validate_record(record, index: int = 0, strict_names: bool = True) -> List[s
             errs.append(f"{where}: field {k!r} is non-finite ({v})")
             continue
         if (k in NON_NEGATIVE
-                or k.startswith(("serving_", "fleet_", "rollout_", "shard_"))) and v < 0:
+                or k.startswith(("serving_", "fleet_", "rollout_", "shard_",
+                                 "resilience_"))) and v < 0:
             errs.append(f"{where}: field {k!r} is negative ({v})")
         if k in UNIT_INTERVAL and not (0.0 <= v <= 1.0):
             errs.append(f"{where}: field {k!r} must be in [0, 1], got {v}")

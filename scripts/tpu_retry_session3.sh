@@ -1,4 +1,8 @@
 #!/bin/bash
+# SUPERSEDED: use scripts/train_supervisor.py (relaunch-with-backoff +
+# --resume auto emergency-checkpoint resume, training/resilience.py) instead
+# of these ad-hoc per-session probe loops; kept for the session logs they
+# reference.
 # Wait for the first healthy TPU grant, then run scripts/tpu_session3.sh.
 # Each probe is itself a claim attempt that can queue ~25 min before the
 # tunnel reports UNAVAILABLE (round-2/3 outage signature), so probe with a

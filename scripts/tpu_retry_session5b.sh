@@ -1,4 +1,8 @@
 #!/bin/bash
+# SUPERSEDED: use scripts/train_supervisor.py (relaunch-with-backoff +
+# --resume auto emergency-checkpoint resume, training/resilience.py) instead
+# of these ad-hoc per-session probe loops; kept for the session logs they
+# reference.
 # Wait for any in-flight chip session to end, then probe for a healthy TPU
 # grant and run scripts/tpu_session5b.sh (the session-5 recovery legs).
 # Single-client discipline: never probe while tpu_session5.sh still runs.

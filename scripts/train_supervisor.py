@@ -1,0 +1,111 @@
+#!/usr/bin/env python
+"""Relaunch-with-backoff supervisor for preemptible training.
+
+Retires the ad-hoc ``scripts/tpu_retry_session*.sh`` probe loops: instead of
+hand-rolled per-session retry shells, wrap ANY training command line once —
+
+    python scripts/train_supervisor.py -- \
+        python train_dcml.py --resume auto --iters_per_dispatch 8 ...
+
+Semantics (driven by the training side's exit codes, training/resilience.py):
+
+- exit 0      -> the run finished; the supervisor exits 0.
+- exit 75     -> graceful preemption (SIGTERM honored, emergency checkpoint
+                 written).  NOT a crash: the crash counter resets and the
+                 child relaunches after ``--preempt-delay`` seconds.  With
+                 ``--resume auto`` the relaunch restores the emergency carry
+                 and continues bit-exact.
+- anything else -> a crash.  Relaunch with jittered exponential backoff
+                 (base * 2^(crashes-1), capped at ``--backoff-max``) up to
+                 ``--max-relaunches`` consecutive crashes, then give up and
+                 exit with the child's last code.  A clean preemption or a
+                 normal exit resets the counter.
+
+SIGTERM/SIGINT to the supervisor forward to the child (which takes its
+emergency checkpoint) and the supervisor exits with the child's code — so
+killing the supervisor IS the graceful-stop path, one level up.
+"""
+
+from __future__ import annotations
+
+import argparse
+import random
+import signal
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from mat_dcml_tpu.training.resilience import EXIT_PREEMPTED  # noqa: E402
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        description=__doc__, formatter_class=argparse.RawDescriptionHelpFormatter
+    )
+    parser.add_argument("--max-relaunches", type=int, default=10,
+                        help="consecutive CRASH relaunches before giving up "
+                             "(preemptions don't count)")
+    parser.add_argument("--backoff-base", type=float, default=5.0,
+                        help="crash backoff base, seconds")
+    parser.add_argument("--backoff-max", type=float, default=300.0,
+                        help="crash backoff ceiling, seconds")
+    parser.add_argument("--preempt-delay", type=float, default=1.0,
+                        help="relaunch delay after a clean preemption, seconds")
+    parser.add_argument("cmd", nargs=argparse.REMAINDER,
+                        help="training command line (prefix with --)")
+    args = parser.parse_args(argv)
+
+    cmd = args.cmd
+    if cmd and cmd[0] == "--":
+        cmd = cmd[1:]
+    if not cmd:
+        parser.error("no command given; usage: train_supervisor.py [opts] -- cmd ...")
+
+    child: subprocess.Popen | None = None
+    forwarded = {"sig": None}
+
+    def forward(signum, frame):
+        forwarded["sig"] = signum
+        if child is not None and child.poll() is None:
+            child.send_signal(signum)
+
+    signal.signal(signal.SIGTERM, forward)
+    signal.signal(signal.SIGINT, forward)
+
+    crashes = 0
+    launches = 0
+    while True:
+        launches += 1
+        print(f"[supervisor] launch {launches}: {' '.join(cmd)}", flush=True)
+        child = subprocess.Popen(cmd)
+        rc = child.wait()
+        if forwarded["sig"] is not None:
+            # our own stop was forwarded; the child already checkpointed
+            print(f"[supervisor] stop forwarded; child exited {rc}", flush=True)
+            return rc
+        if rc == 0:
+            print("[supervisor] run complete", flush=True)
+            return 0
+        if rc == EXIT_PREEMPTED:
+            crashes = 0
+            print(f"[supervisor] child preempted (exit {rc}); relaunching in "
+                  f"{args.preempt_delay:.1f}s", flush=True)
+            time.sleep(args.preempt_delay)
+            continue
+        crashes += 1
+        if crashes > args.max_relaunches:
+            print(f"[supervisor] {crashes} consecutive crashes (last exit "
+                  f"{rc}); giving up", flush=True)
+            return rc
+        delay = min(args.backoff_max,
+                    args.backoff_base * (2 ** (crashes - 1))) * (0.5 + random.random())
+        print(f"[supervisor] child crashed (exit {rc}, crash {crashes}/"
+              f"{args.max_relaunches}); relaunching in {delay:.1f}s", flush=True)
+        time.sleep(delay)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,93 @@
+"""Training worker for the chaos harness (run via subprocess).
+
+A real training process the resilience tests can SIGTERM/SIGKILL at
+arbitrary points: tiny DCML env (the tests/test_checkpoint.py fixture),
+fused K=2 dispatch, --resume auto, graceful stop on.  One `ep N ...` log
+line per dispatch (log_interval=1) gives the parent a progress signal to
+time its kill against; PreemptedExit propagates so a honored SIGTERM exits
+75 (training/resilience.py EXIT_PREEMPTED).
+
+Usage:
+    python tests/chaos_worker.py --run_dir DIR --episodes N
+        [--seed 1] [--save_interval 2] [--data_shards 1] [--devices 1]
+"""
+
+import argparse
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+_n_dev = "--devices" in " ".join(sys.argv) and int(
+    sys.argv[sys.argv.index("--devices") + 1]) or 1
+if "xla_force_host_platform_device_count" not in _flags and _n_dev > 1:
+    os.environ["XLA_FLAGS"] = (
+        _flags + f" --xla_force_host_platform_device_count={_n_dev}"
+    ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, repo_root)
+
+# share the test suite's persistent compile cache — the worker compiles the
+# same tiny programs the in-process tests do
+_cache_dir = os.environ.get(
+    "MAT_DCML_TPU_TEST_CACHE",
+    os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache"),
+)
+jax.config.update("jax_compilation_cache_dir", _cache_dir)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.1)
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+
+import numpy as np  # noqa: E402
+
+from mat_dcml_tpu.config import RunConfig  # noqa: E402
+from mat_dcml_tpu.envs.dcml import DCMLConsts, DCMLEnv, DCMLEnvConfig  # noqa: E402
+from mat_dcml_tpu.training.ppo import PPOConfig  # noqa: E402
+from mat_dcml_tpu.training.runner import DCMLRunner  # noqa: E402
+
+W, E, T = 6, 2, 4
+
+
+def tiny_env() -> DCMLEnv:
+    rng = np.random.default_rng(7)
+    return DCMLEnv(
+        DCMLEnvConfig(consts=DCMLConsts(worker_number_max=W, sob_dim=W + 2)),
+        base_workloads=rng.integers(0, 5, (W, 20)).astype(np.float32),
+    )
+
+
+def log(*a):
+    print(*a, flush=True)
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--run_dir", required=True)
+    parser.add_argument("--episodes", type=int, required=True)
+    parser.add_argument("--seed", type=int, default=1)
+    parser.add_argument("--save_interval", type=int, default=2)
+    parser.add_argument("--data_shards", type=int, default=1)
+    parser.add_argument("--devices", type=int, default=1)
+    args = parser.parse_args()
+
+    run = RunConfig(
+        algorithm_name="mat", experiment_name="chaos", seed=args.seed,
+        n_rollout_threads=E, episode_length=T,
+        n_block=1, n_embd=16, n_head=2,
+        iters_per_dispatch=2, log_interval=1, telemetry_interval=1,
+        save_interval=args.save_interval, run_dir=args.run_dir,
+        anomaly_tripwires=False, resume="auto", graceful_stop=True,
+        emergency_snapshot_interval=1, data_shards=args.data_shards,
+    )
+    runner = DCMLRunner(run, PPOConfig(ppo_epoch=2, num_mini_batch=1),
+                        env=tiny_env(), log_fn=log)
+    runner.train_loop(num_episodes=args.episodes)
+    log("DONE")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,774 @@
+"""Chaos harness + unit tests for the preemption/resilience subsystem.
+
+The subprocess tests are the acceptance spine of the PR: a REAL training
+process (tests/chaos_worker.py — tiny DCML, fused K=2 dispatch, --resume
+auto) killed at adversarial points, then relaunched:
+
+- SIGTERM mid-run  -> graceful stop at the next dispatch boundary, exit 75,
+  emergency full-carry checkpoint; the relaunch continues BIT-EXACT against
+  an uninterrupted golden run of the same total length.
+- SIGKILL          -> no goodbye at all; ``restore_latest_valid`` resumes
+  from the newest step that passes the CRC manifest, quarantining damage
+  (orbax's ocdbt dedup means a corrupt payload does NOT reliably fail the
+  read — the manifest is the authoritative detector, see test below).
+
+The in-process tests pin the parts individually: signal handler, emergency
+save/load/quarantine, watchdog retry/deadline/exhaustion, integrity
+fallback, elastic re-placement across meshes, the DCML fault wrapper, the
+metrics-schema branch, and the relaunch supervisor.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mat_dcml_tpu.config import RunConfig
+from mat_dcml_tpu.envs.dcml import (
+    DCMLConsts,
+    DCMLEnv,
+    DCMLEnvConfig,
+    DCMLFaultConfig,
+    FaultyDCMLEnv,
+    fleet_stress_preset,
+)
+from mat_dcml_tpu.parallel.mesh import build_run_mesh, replicated
+from mat_dcml_tpu.parallel.distributed import global_init_state
+from mat_dcml_tpu.telemetry import Telemetry
+from mat_dcml_tpu.training.base_runner import make_dispatch_fn
+from mat_dcml_tpu.training.checkpoint import CheckpointManager
+from mat_dcml_tpu.training.ppo import MATTrainer, PPOConfig
+from mat_dcml_tpu.training.resilience import (
+    EMERGENCY_FORMAT,
+    EXIT_PREEMPTED,
+    DispatchDeadlineError,
+    DispatchFailedError,
+    ElasticResumeError,
+    EmergencyCheckpoint,
+    GracefulStopHandler,
+    WatchdogConfig,
+    DispatchWatchdog,
+    pack_carry,
+    place_carry,
+)
+from mat_dcml_tpu.training.rollout import RolloutCollector
+from mat_dcml_tpu.training.runner import DCMLRunner, build_mat_policy
+
+from test_anomaly import _load_script
+
+check_metrics_schema = _load_script("check_metrics_schema")
+
+W, E, T = 6, 2, 4     # the test_checkpoint.py tiny-DCML instance
+
+
+def tiny_env(seed=0) -> DCMLEnv:
+    consts = DCMLConsts(worker_number_max=W, sob_dim=W + 2)
+    rng = np.random.default_rng(seed)
+    workloads = rng.integers(0, 5, (W, consts.local_workload_period)).astype(
+        np.float32)
+    return DCMLEnv(DCMLEnvConfig(consts=consts), base_workloads=workloads)
+
+
+def tiny_components():
+    run = RunConfig(n_rollout_threads=E, episode_length=T,
+                    n_embd=16, n_head=2, n_block=1)
+    env = tiny_env()
+    policy = build_mat_policy(run, env)
+    trainer = MATTrainer(policy, PPOConfig(ppo_epoch=2, num_mini_batch=1))
+    collector = RolloutCollector(env, policy, T)
+    return run, env, policy, trainer, collector
+
+
+def _raw(x):
+    if isinstance(x, jax.Array) and jnp.issubdtype(x.dtype, jax.dtypes.prng_key):
+        x = jax.random.key_data(x)
+    return np.asarray(jax.device_get(x))
+
+
+def tree_bit_equal(a, b) -> bool:
+    la, lb = jax.tree.leaves(a), jax.tree.leaves(b)
+    return len(la) == len(lb) and all(
+        np.array_equal(_raw(x), _raw(y)) for x, y in zip(la, lb))
+
+
+# ===================================================================
+# subprocess chaos harness
+# ===================================================================
+
+_WORKER = Path(__file__).resolve().parent / "chaos_worker.py"
+_REPO = Path(__file__).resolve().parent.parent
+
+
+def _spawn_worker(run_dir, episodes, extra=()):
+    cmd = [sys.executable, str(_WORKER), "--run_dir", str(run_dir),
+           "--episodes", str(episodes), *map(str, extra)]
+    return subprocess.Popen(cmd, stdout=subprocess.PIPE,
+                            stderr=subprocess.STDOUT, text=True,
+                            cwd=str(_REPO))
+
+
+def _tail_lines(proc):
+    """Daemon-thread line reader: poll the returned list, never block on a
+    pipe that may outpace readline's buffering."""
+    lines = []
+
+    def pump():
+        for line in proc.stdout:
+            lines.append(line)
+
+    t = threading.Thread(target=pump, daemon=True)
+    t.start()
+    return lines, t
+
+
+def _wait_until(pred, timeout, what):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return
+        time.sleep(0.05)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+def _run_worker(run_dir, episodes, extra=(), timeout=300):
+    proc = _spawn_worker(run_dir, episodes, extra)
+    out, _ = proc.communicate(timeout=timeout)
+    return proc.returncode, out
+
+
+def _models_dir(run_dir):
+    hits = sorted(Path(run_dir).rglob("models"))
+    assert hits, f"no models dir under {run_dir}"
+    return hits[0]
+
+
+@pytest.mark.slow
+def test_sigterm_emergency_checkpoint_and_bitexact_resume(tmp_path):
+    """The headline contract: kill -TERM mid-training -> exit 75 + emergency
+    carry; relaunch with --resume auto finishes the run; final checkpoint is
+    bit-identical to an uninterrupted golden run of the same length."""
+    run_a, run_b = tmp_path / "interrupted", tmp_path / "golden"
+
+    proc = _spawn_worker(run_a, episodes=500)
+    lines, _ = _tail_lines(proc)
+    try:
+        # let it get past at least one full dispatch before pulling the plug
+        _wait_until(lambda: sum("ep " in ln for ln in lines) >= 2,
+                    timeout=240, what="2 episode log lines")
+        proc.send_signal(signal.SIGTERM)
+        rc = proc.wait(timeout=120)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+    out = "".join(lines)
+    assert rc == EXIT_PREEMPTED, out
+    assert "graceful stop" in out
+
+    manifest_path = _models_dir(run_a) / "emergency" / "manifest.json"
+    assert manifest_path.exists(), out
+    manifest = json.loads(manifest_path.read_text())
+    assert manifest["format"] == EMERGENCY_FORMAT
+    resume_ep = manifest["next_episode"]
+    assert resume_ep >= 2 and resume_ep % 2 == 0   # K=2 dispatch boundary
+    total = resume_ep + 4
+
+    rc2, out2 = _run_worker(run_a, episodes=total)
+    assert rc2 == 0, out2
+    assert "restored emergency checkpoint" in out2
+    assert "DONE" in out2
+
+    rc3, out3 = _run_worker(run_b, episodes=total)
+    assert rc3 == 0, out3
+
+    mgr_a = CheckpointManager(_models_dir(run_a))
+    mgr_b = CheckpointManager(_models_dir(run_b))
+    step_a, state_a = mgr_a.restore_latest_valid()
+    step_b, state_b = mgr_b.restore_latest_valid()
+    assert step_a is not None and step_a == step_b
+    assert tree_bit_equal(state_a, state_b), (
+        "resumed run diverged from the uninterrupted golden run")
+
+
+@pytest.mark.slow
+def test_sigkill_then_restore_latest_valid(tmp_path):
+    """SIGKILL with no goodbye: restore_latest_valid must come up anyway, a
+    relaunch must resume, and corrupting the step it came up from must fall
+    back to an older step + quarantine the damage (the CRC manifest is what
+    catches the byte flip — orbax's ocdbt dedup can read straight through
+    payload damage, so a plain restore would NOT notice)."""
+    run_dir = tmp_path / "killed"
+    proc = _spawn_worker(run_dir, episodes=500, extra=("--save_interval", "1"))
+    lines, _ = _tail_lines(proc)
+    try:
+        def two_committed_steps():
+            hits = sorted(Path(run_dir).rglob("models"))
+            if not hits:
+                return False
+            steps = [p for p in hits[0].iterdir()
+                     if p.is_dir() and p.name.isdigit()]
+            return len(steps) >= 2
+
+        _wait_until(two_committed_steps, timeout=240,
+                    what="two committed checkpoint steps")
+        proc.send_signal(signal.SIGKILL)
+        proc.wait(timeout=60)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+    assert proc.returncode == -signal.SIGKILL
+
+    models = _models_dir(run_dir)
+    mgr = CheckpointManager(models, log=lambda *a: None)
+    _, _, policy, trainer, _ = tiny_components()
+    template = jax.eval_shape(
+        lambda: trainer.init_state(policy.init_params(jax.random.key(0))))
+
+    # 1) whatever the kill left behind, the resume path comes up
+    step1, state1 = mgr.restore_latest_valid(template=template)
+    assert step1 is not None and state1 is not None
+
+    # 2) rot the step it came up from.  If the kill beat the (async-trailing)
+    # manifest write for this step, hash it now over the known-good bytes —
+    # the scenario stays "manifest landed, then the payload rotted".
+    if mgr.verify_step(step1)[0] != "ok":
+        mgr._write_integrity(step1)
+    assert mgr.verify_step(step1)[0] == "ok"
+    integrity = json.loads((models / "integrity" / f"{step1}.json").read_text())
+    rel = max(integrity["files"], key=lambda r: integrity["files"][r]["size"])
+    victim = models / str(step1) / rel
+    blob = bytearray(victim.read_bytes())
+    blob[: min(64, len(blob))] = b"\xde" * min(64, len(blob))
+    victim.write_bytes(bytes(blob))
+
+    assert mgr.verify_step(step1)[0] == "bad"
+    step2, state2 = mgr.restore_latest_valid(template=template)
+    assert step2 is not None and step2 < step1
+    assert state2 is not None
+    assert list((models / "quarantine").glob(f"{step1}.*"))
+    mgr.close()
+
+    # 3) and a relaunched worker resumes from what's left and finishes
+    rc, out = _run_worker(run_dir, episodes=step1 + 4,
+                          extra=("--save_interval", "1"))
+    assert rc == 0, out
+    assert "DONE" in out
+
+
+def test_supervisor_relaunches_on_preemption(tmp_path):
+    """scripts/train_supervisor.py: exit 75 relaunches (and resets the crash
+    counter), exit 0 ends the loop with success."""
+    marker = tmp_path / "launches.txt"
+    child = (
+        "import pathlib, sys; p = pathlib.Path(r'%s'); "
+        "n = int(p.read_text() or 0) if p.exists() else 0; "
+        "p.write_text(str(n + 1)); "
+        "sys.exit(75 if n == 0 else 0)" % marker
+    )
+    proc = subprocess.run(
+        [sys.executable, str(_REPO / "scripts" / "train_supervisor.py"),
+         "--preempt-delay", "0.01", "--backoff-base", "0.01", "--",
+         sys.executable, "-c", child],
+        capture_output=True, text=True, timeout=120, cwd=str(_REPO),
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert marker.read_text() == "2"          # preempted once, finished once
+    assert "preempted" in proc.stdout
+
+
+def test_supervisor_gives_up_after_max_crashes(tmp_path):
+    proc = subprocess.run(
+        [sys.executable, str(_REPO / "scripts" / "train_supervisor.py"),
+         "--max-relaunches", "2", "--backoff-base", "0.01", "--",
+         sys.executable, "-c", "import sys; sys.exit(3)"],
+        capture_output=True, text=True, timeout=120, cwd=str(_REPO),
+    )
+    assert proc.returncode == 3
+    assert "giving up" in proc.stdout
+
+
+# ===================================================================
+# graceful-stop handler
+# ===================================================================
+
+def test_graceful_stop_handler_flags_first_signal():
+    h = GracefulStopHandler(log=lambda *a: None)
+    assert h.install()
+    try:
+        assert not h.stop_requested
+        os.kill(os.getpid(), signal.SIGTERM)
+        assert h.stop_requested
+        assert h.reason == "SIGTERM"
+        assert h.latency_s() >= 0.0
+    finally:
+        h.uninstall()
+    # uninstalled: a pytest-managed process must have survived the signal
+
+
+# ===================================================================
+# emergency checkpoint (one-slot full carry)
+# ===================================================================
+
+def _small_carry():
+    _, _, policy, trainer, collector = tiny_components()
+    ts = trainer.init_state(policy.init_params(jax.random.key(2)))
+    rs = collector.init_state(jax.random.key(3), E)
+    return pack_carry(6, ts, rs, jax.random.key(4)), ts, rs
+
+
+def test_pack_roundtrip_preserves_weak_type():
+    """Weak-typedness is part of the aval jit caches on: losing it across
+    pack/unpack makes every emergency resume recompile the dispatch once."""
+    from mat_dcml_tpu.telemetry.flight_recorder import pack_tree, unpack_tree
+
+    tree = {
+        "weak": jnp.full((3, 2), 0.5),                      # python-float fill
+        "strong": jnp.full((3, 2), 0.5, dtype=jnp.float32),
+        "key": jax.random.key(0),
+    }
+    assert tree["weak"].aval.weak_type and not tree["strong"].aval.weak_type
+    back = unpack_tree(pack_tree(tree))
+    assert back["weak"].aval.weak_type, "weak type lost in pack/unpack"
+    assert not back["strong"].aval.weak_type
+    assert np.array_equal(np.asarray(back["weak"]), np.asarray(tree["weak"]))
+    assert np.array_equal(jax.random.key_data(back["key"]),
+                          jax.random.key_data(tree["key"]))
+
+
+@pytest.mark.slow
+def test_emergency_roundtrip_bit_exact(tmp_path):
+    snap, ts, rs = _small_carry()
+    tel = Telemetry()
+    ec = EmergencyCheckpoint(tmp_path / "emergency", telemetry=tel,
+                             log=lambda *a: None)
+    ec.save(snap, reason="SIGTERM")
+    found = ec.load()
+    assert found is not None
+    assert found["manifest"]["format"] == EMERGENCY_FORMAT
+    assert found["manifest"]["next_episode"] == 6
+    assert found["manifest"]["reason"] == "SIGTERM"
+    ts2, rs2, key2 = place_carry(found["snap"])
+    assert tree_bit_equal(ts, ts2)
+    assert tree_bit_equal(rs, rs2)
+    assert np.array_equal(_raw(jax.random.key(4)), _raw(key2))
+    assert tel.counters["resilience_emergency_saves"] == 1
+
+
+def test_emergency_save_overwrites_atomically(tmp_path):
+    snap, _, _ = _small_carry()
+    ec = EmergencyCheckpoint(tmp_path / "emergency", log=lambda *a: None)
+    ec.save(snap, reason="first")
+    snap2 = dict(snap, episode=8)
+    ec.save(snap2, reason="second")
+    found = ec.load()
+    assert found["manifest"]["next_episode"] == 8
+    assert found["manifest"]["reason"] == "second"
+
+
+def test_emergency_corruption_quarantines(tmp_path):
+    snap, _, _ = _small_carry()
+    tel = Telemetry()
+    ec = EmergencyCheckpoint(tmp_path / "emergency", telemetry=tel,
+                             log=lambda *a: None)
+    ec.save(snap, reason="SIGTERM")
+    state_file = ec.directory / "state.pkl"
+    blob = bytearray(state_file.read_bytes())
+    blob[len(blob) // 2] ^= 0xFF
+    state_file.write_bytes(bytes(blob))
+    assert ec.load() is None
+    quarantined = [p for p in (tmp_path / "emergency").parent.iterdir()
+                   if "quarantined" in p.name]
+    assert quarantined
+    assert tel.counters["resilience_quarantined_steps"] == 1
+
+
+# ===================================================================
+# dispatch watchdog
+# ===================================================================
+
+def _watchdog(tel=None, **cfg):
+    sleeps = []
+    wd = DispatchWatchdog(
+        WatchdogConfig(**cfg), telemetry=tel, log=lambda *a: None,
+        sleep=sleeps.append, rand=lambda: 0.5,
+    )
+    return wd, sleeps
+
+
+def test_watchdog_retries_from_snapshot_then_succeeds():
+    tel = Telemetry()
+    wd, sleeps = _watchdog(tel, max_retries=2, backoff_base_ms=100.0)
+    ts, rs, key = jnp.arange(3.0), jnp.arange(2.0), jax.random.key(0)
+    wd.arm(4, ts, rs, key)
+    calls = []
+
+    def fn(ts, rs, k):
+        calls.append((np.asarray(ts).copy(), np.asarray(rs).copy()))
+        if len(calls) < 3:
+            raise RuntimeError("device wedged")
+        return ts + 1, rs, k, None
+
+    out_ts, out_rs, out_key, _ = wd.run(fn, ts, rs, key)
+    assert np.array_equal(np.asarray(out_ts), np.arange(3.0) + 1)
+    # every retry started from the SNAPSHOT, not from whatever the failed
+    # attempt left behind
+    for seen_ts, seen_rs in calls:
+        assert np.array_equal(seen_ts, np.arange(3.0))
+        assert np.array_equal(seen_rs, np.arange(2.0))
+    # jittered exponential backoff: base * 2^(n-1) * (0.5 + 0.5)
+    assert sleeps == pytest.approx([0.1, 0.2])
+    assert tel.counters["resilience_dispatch_retries"] == 2
+    assert "resilience_dispatch_failures" not in tel.counters
+
+
+def test_watchdog_exhaustion_raises_dispatch_failed():
+    tel = Telemetry()
+    wd, _ = _watchdog(tel, max_retries=1)
+    ts, rs, key = jnp.zeros(2), jnp.zeros(2), jax.random.key(0)
+    wd.arm(0, ts, rs, key)
+
+    def always_fails(*a):
+        raise RuntimeError("boom")
+
+    with pytest.raises(DispatchFailedError, match="2 times"):
+        wd.run(always_fails, ts, rs, key)
+    assert tel.counters["resilience_dispatch_failures"] == 1
+    assert tel.counters["resilience_dispatch_retries"] == 1
+
+
+def test_watchdog_without_snapshot_escalates_immediately():
+    wd, sleeps = _watchdog(max_retries=5)
+
+    def always_fails(*a):
+        raise RuntimeError("boom")
+
+    with pytest.raises(DispatchFailedError, match="no replayable snapshot"):
+        wd.run(always_fails, jnp.zeros(2), jnp.zeros(2), jax.random.key(0))
+    assert sleeps == []          # no retry without a replay source
+
+
+def test_watchdog_deadline_overrun_is_a_failure():
+    tel = Telemetry()
+    wd, _ = _watchdog(tel, deadline_s=1e-9, max_retries=0)
+    ts, rs, key = jnp.zeros(2), jnp.zeros(2), jax.random.key(0)
+    wd.arm(0, ts, rs, key)
+    with pytest.raises(DispatchFailedError):
+        wd.run(lambda ts, rs, k: (ts, rs, k, None), ts, rs, key)
+    assert tel.counters["resilience_deadline_overruns"] >= 1
+
+
+def test_watchdog_snapshot_cadence():
+    wd, _ = _watchdog(snapshot_interval=2)
+    ts, rs, key = jnp.zeros(2), jnp.zeros(2), jax.random.key(0)
+    took = [wd.arm(i, ts, rs, key) for i in range(4)]
+    assert took == [True, False, True, False]
+    wd_off, _ = _watchdog(snapshot_interval=0)
+    assert wd_off.arm(0, ts, rs, key) is False
+
+
+# ===================================================================
+# checkpoint integrity: manifests, fallback, quarantine
+# ===================================================================
+
+def _saved_manager(tmp_path, steps=(1, 3)):
+    _, _, policy, trainer, _ = tiny_components()
+    mgr = CheckpointManager(tmp_path / "models", log=lambda *a: None)
+    state = trainer.init_state(policy.init_params(jax.random.key(0)))
+    for s in steps:
+        # vary the state so steps are distinguishable bit-wise
+        bumped = state._replace(update_step=state.update_step + s)
+        mgr.save(s, bumped, blocking=True)
+    template = jax.eval_shape(
+        lambda: trainer.init_state(policy.init_params(jax.random.key(0))))
+    return mgr, template
+
+
+def test_integrity_manifest_written_and_verifies(tmp_path):
+    mgr, _ = _saved_manager(tmp_path)
+    assert mgr.verify_step(1) == ("ok", "verified")
+    assert mgr.verify_step(3) == ("ok", "verified")
+    manifest = json.loads(
+        (tmp_path / "models" / "integrity" / "3.json").read_text())
+    assert manifest["files"]            # non-empty tracked set
+    assert all("crc32" in rec and "size" in rec
+               for rec in manifest["files"].values())
+
+
+def test_corrupt_newest_step_falls_back_to_previous(tmp_path):
+    tel = Telemetry()
+    mgr, template = _saved_manager(tmp_path)
+    mgr.telemetry = tel
+    manifest = json.loads(
+        (tmp_path / "models" / "integrity" / "3.json").read_text())
+    rel = max(manifest["files"], key=lambda r: manifest["files"][r]["size"])
+    victim = tmp_path / "models" / "3" / rel
+    blob = bytearray(victim.read_bytes())
+    blob[: min(32, len(blob))] = b"\xa5" * min(32, len(blob))
+    victim.write_bytes(bytes(blob))
+
+    assert mgr.verify_step(3)[0] == "bad"
+    step, state = mgr.restore_latest_valid(template=template)
+    assert step == 1
+    assert int(state.update_step) == 1
+    assert not (tmp_path / "models" / "3").exists()
+    assert list((tmp_path / "models" / "quarantine").glob("3.*"))
+    assert tel.counters["resilience_quarantined_steps"] == 1
+    # the manager stays usable after the quarantine rebuild
+    assert mgr.latest_step() == 1
+
+
+def test_missing_manifest_restores_unverified(tmp_path):
+    mgr, template = _saved_manager(tmp_path, steps=(2,))
+    (tmp_path / "models" / "integrity" / "2.json").unlink()
+    assert mgr.verify_step(2)[0] == "unverified"
+    step, state = mgr.restore_latest_valid(template=template)
+    assert step == 2 and state is not None
+
+
+def test_all_steps_bad_returns_none(tmp_path):
+    mgr, template = _saved_manager(tmp_path, steps=(1,))
+    manifest = json.loads(
+        (tmp_path / "models" / "integrity" / "1.json").read_text())
+    rel = next(iter(manifest["files"]))
+    (tmp_path / "models" / "1" / rel).unlink()
+    step, state = mgr.restore_latest_valid(template=template)
+    assert step is None and state is None
+
+
+# ===================================================================
+# elastic resume across meshes
+# ===================================================================
+
+def _fused_tiny(K=2):
+    _, _, policy, trainer, collector = tiny_components()
+    return policy, trainer, collector, jax.jit(
+        make_dispatch_fn(trainer, collector, K), donate_argnums=(0, 1))
+
+
+@pytest.mark.slow
+def test_elastic_resume_2shard_to_1shard(forced8_cpu):
+    """The acceptance case: a carry packed on a data=2 mesh resumes
+    unsharded — key chain bit-exact, params within the documented psum
+    tolerance — after one further dispatch on each side."""
+    policy, trainer, collector, dispatch = _fused_tiny()
+    mesh = build_run_mesh(2, 1, devices=forced8_cpu[:2])
+    with mesh:
+        repl = replicated(mesh)
+        params = jax.jit(policy.init_params, out_shardings=repl)(jax.random.key(0))
+        ts0 = jax.jit(trainer.init_state, out_shardings=repl)(params)
+        rs0 = global_init_state(collector, jax.random.key(1), E, mesh)
+        ts1, rs1, k1, _ = dispatch(ts0, rs0, jax.random.key(9))
+        jax.block_until_ready(ts1)
+        snap = pack_carry(2, ts1, rs1, k1)
+        # sharded continuation = the reference
+        ts2, _, k2, _ = dispatch(ts1, rs1, k1)
+        jax.block_until_ready(ts2)
+
+    # resume the same carry on a 1-device (unsharded) "fleet"
+    ts1b, rs1b, k1b = place_carry(snap)
+    ts2b, _, k2b, _ = dispatch(ts1b, rs1b, k1b)
+    jax.block_until_ready(ts2b)
+
+    assert np.array_equal(_raw(k2), _raw(k2b)), "key chain must be bit-exact"
+    for i, (x, y) in enumerate(zip(jax.tree.leaves(ts2.params),
+                                   jax.tree.leaves(ts2b.params))):
+        np.testing.assert_allclose(
+            _raw(x).astype(np.float64), _raw(y).astype(np.float64),
+            rtol=1e-4, atol=1e-6,
+            err_msg=f"param leaf {i} after cross-mesh resume")
+
+
+@pytest.mark.slow
+def test_elastic_resume_into_wider_mesh(forced8_cpu):
+    """1-device carry re-places onto a data=2 mesh (scale UP after resume)."""
+    policy, trainer, collector, dispatch = _fused_tiny()
+    ts = trainer.init_state(policy.init_params(jax.random.key(0)))
+    rs = collector.init_state(jax.random.key(1), E)
+    snap = pack_carry(0, ts, rs, jax.random.key(5))
+    mesh = build_run_mesh(2, 1, devices=forced8_cpu[:2])
+    with mesh:
+        ts2, rs2, key2 = place_carry(snap, mesh)
+        out = dispatch(ts2, rs2, key2)
+        jax.block_until_ready(out[0])
+
+
+def test_elastic_resume_divisibility_error(forced8_cpu):
+    policy, trainer, collector, _ = _fused_tiny()
+    ts = trainer.init_state(policy.init_params(jax.random.key(0)))
+    rs = collector.init_state(jax.random.key(1), E)   # E=2 env batch
+    snap = pack_carry(0, ts, rs, jax.random.key(5))
+    mesh = build_run_mesh(4, 1, devices=forced8_cpu[:4])  # 2 % 4 != 0
+    with pytest.raises(ElasticResumeError, match="divisible"):
+        place_carry(snap, mesh)
+
+
+# ===================================================================
+# resume policy (auto/strict) in the runner
+# ===================================================================
+
+def _tiny_runner(tmp_path, **overrides):
+    run = RunConfig(
+        algorithm_name="mat", experiment_name="resil", seed=1,
+        n_rollout_threads=E, episode_length=T, n_block=1, n_embd=16, n_head=2,
+        log_interval=1, telemetry_interval=0, save_interval=0,
+        run_dir=str(tmp_path), anomaly_tripwires=False,
+        graceful_stop=False, **overrides,
+    )
+    return DCMLRunner(run, PPOConfig(ppo_epoch=2, num_mini_batch=1),
+                      env=tiny_env(), log_fn=lambda *a: None)
+
+
+def test_resume_auto_starts_fresh_when_empty(tmp_path):
+    runner = _tiny_runner(tmp_path, resume="auto")
+    runner.setup()
+    assert runner.start_episode == 0
+
+
+def test_resume_strict_missing_dir_raises(tmp_path):
+    runner = _tiny_runner(tmp_path, resume="strict",
+                          model_dir=str(tmp_path / "nowhere"))
+    with pytest.raises(FileNotFoundError):
+        runner.setup()
+
+
+# ===================================================================
+# DCML fault wrapper
+# ===================================================================
+
+def test_fault_wrapper_dead_and_straggler_nodes():
+    env = tiny_env()
+    fault = DCMLFaultConfig(dead_nodes=(0,), straggler_nodes=(1, 2),
+                            straggler_pr_floor=0.7, straggler_load=0.4)
+    fenv = FaultyDCMLEnv(env, fault)
+    state, ts = jax.jit(fenv.reset)(jax.random.key(0))
+    assert bool(state.unavailable[0])
+    floor = np.float32(0.7)
+    assert float(state.worker_prs[1]) >= floor
+    assert float(state.worker_prs[2]) >= floor
+    assert int(state.disable_rate) == int(np.sum(_raw(state.unavailable)))
+    # dead node masked out of the action space: worker row = [1, af] (the
+    # base env disables its own random subset too, so assert consistency
+    # with the merged mask rather than a fixed pattern)
+    ava = _raw(ts.available_actions)
+    assert ava[0, 1] == 0                  # worker 0 never selectable
+    unavail = _raw(state.unavailable)
+    assert np.array_equal(ava[:W, 1], (~unavail).astype(ava.dtype))
+
+    # faults persist through the auto-resetting step
+    step = jax.jit(fenv.step)
+    action = jnp.ones((env.n_agents,))
+    for _ in range(T + 1):                  # crosses an episode boundary
+        state, ts = step(state, action)
+        assert bool(state.unavailable[0])
+        assert float(state.worker_prs[1]) >= np.float32(0.7)
+    assert np.isfinite(_raw(ts.reward)).all()
+
+
+def test_fault_wrapper_validates_node_ids():
+    env = tiny_env()
+    with pytest.raises(ValueError):
+        FaultyDCMLEnv(env, DCMLFaultConfig(dead_nodes=(W,)))
+
+
+def test_fleet_stress_preset_shapes():
+    preset = fleet_stress_preset(n_dead=1, n_stragglers=2)
+    assert preset.dead_nodes == (0,)
+    assert preset.straggler_nodes == (1, 2)
+
+
+@pytest.mark.slow
+def test_fused_training_under_faults_stays_finite():
+    """Smoke: the fused K-step dispatch trains through a fleet-stress fault
+    pattern without NaNs — the robustness scenario the wrapper exists for."""
+    run = RunConfig(n_rollout_threads=E, episode_length=T,
+                    n_embd=16, n_head=2, n_block=1)
+    env = FaultyDCMLEnv(tiny_env(), fleet_stress_preset())
+    policy = build_mat_policy(run, env)
+    trainer = MATTrainer(policy, PPOConfig(ppo_epoch=2, num_mini_batch=1))
+    collector = RolloutCollector(env, policy, T)
+    dispatch = jax.jit(make_dispatch_fn(trainer, collector, 2),
+                       donate_argnums=(0, 1))
+    ts = trainer.init_state(policy.init_params(jax.random.key(0)))
+    rs = collector.init_state(jax.random.key(1), E)
+    ts, rs, key, (metrics, _) = dispatch(ts, rs, jax.random.key(2))
+    fetched = jax.device_get(metrics)
+    for leaf in jax.tree.leaves(fetched):
+        assert np.isfinite(np.asarray(leaf, np.float64)).all()
+
+
+# ===================================================================
+# metrics schema: resilience gauges + emergency records
+# ===================================================================
+
+def test_schema_accepts_resilience_gauges():
+    rec = {"episode": 4, "resilience_snapshots": 2.0,
+           "resilience_dispatch_retries": 1.0,
+           "resilience_stop_latency_s": 0.42}
+    assert check_metrics_schema.validate_record(rec) == []
+
+
+def test_schema_rejects_negative_resilience_values():
+    rec = {"episode": 4, "resilience_dispatch_retries": -1.0}
+    assert check_metrics_schema.validate_record(rec)
+
+
+def test_schema_accepts_emergency_record():
+    rec = {"emergency_checkpoint": "SIGTERM", "episode": 6,
+           "total_steps": 48, "stop_latency_s": 0.03}
+    assert check_metrics_schema.validate_record(rec) == []
+    minimal = {"emergency_checkpoint": "failure: RuntimeError('x')",
+               "episode": 0, "total_steps": 0}
+    assert check_metrics_schema.validate_record(minimal) == []
+
+
+def test_schema_rejects_malformed_emergency_record():
+    assert check_metrics_schema.validate_record(
+        {"emergency_checkpoint": 7, "episode": 6, "total_steps": 48})
+    assert check_metrics_schema.validate_record(
+        {"emergency_checkpoint": "SIGTERM", "episode": -1, "total_steps": 0})
+    assert check_metrics_schema.validate_record(
+        {"emergency_checkpoint": "SIGTERM", "episode": 1, "total_steps": 8,
+         "surprise": 1.0})
+
+
+# ===================================================================
+# crash-path emergency checkpoint in the runner
+# ===================================================================
+
+def test_unhandled_dispatch_failure_writes_emergency_and_exits_76(tmp_path):
+    """Watchdog exhaustion inside train_loop -> emergency checkpoint from
+    the pre-launch snapshot + SystemExit(EXIT_WATCHDOG)."""
+    import mat_dcml_tpu.training.base_runner as base_runner_mod
+
+    runner = _tiny_runner(tmp_path, iters_per_dispatch=2,
+                          dispatch_retries=0, dispatch_backoff_ms=0.1)
+    ts, rs = runner.setup()
+
+    real_jit = base_runner_mod.instrumented_jit
+
+    def sabotaged_jit(fn, *a, **kw):
+        def wrapper(*args, **kwargs):
+            raise RuntimeError("injected device loss")
+
+        return wrapper
+
+    # patched AFTER setup: only the fused dispatch jit is built from here on
+    base_runner_mod.instrumented_jit = sabotaged_jit
+    try:
+        with pytest.raises(SystemExit) as exc:
+            runner.train_loop(num_episodes=4, train_state=ts, rollout_state=rs)
+    finally:
+        base_runner_mod.instrumented_jit = real_jit
+    assert exc.value.code == 76
+    found = runner.emergency.load()
+    assert found is not None
+    assert found["manifest"]["reason"].startswith("failure:")
+    assert found["manifest"]["next_episode"] == 0

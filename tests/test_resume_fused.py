@@ -1,0 +1,138 @@
+"""Resume equivalence at a fused-dispatch boundary (K > 1), MAT and MAPPO.
+
+PR 2's K=1 resume test (test_checkpoint.py) pinned save->restore->continue
+equivalence for the host loop.  The fused loop only touches the host every K
+iterations, so the contract the preemption machinery relies on is the
+K-boundary one: a checkpoint written between dispatch d and d+1, plus the
+carried rollout state and key (the emergency carry, resilience.pack_carry),
+must continue BIT-EXACT against the uninterrupted run.  Bit-exact, not
+close: same device, same executable, and the orbax + pack_tree roundtrips
+must not perturb a single bit — any tolerance here would hide a
+dtype/layout bug in the save path.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mat_dcml_tpu.envs.spaces import Discrete
+from mat_dcml_tpu.envs.toy import MatchingEnv, MatchingEnvConfig
+from mat_dcml_tpu.models.actor_critic import ACConfig, ActorCriticPolicy
+from mat_dcml_tpu.training.ac_rollout import ACRolloutCollector
+from mat_dcml_tpu.training.base_runner import make_dispatch_fn
+from mat_dcml_tpu.training.checkpoint import CheckpointManager
+from mat_dcml_tpu.training.mappo import MAPPOConfig, MAPPOTrainer
+from mat_dcml_tpu.training.ppo import MATTrainer, PPOConfig
+from mat_dcml_tpu.training.resilience import pack_carry, place_carry
+from mat_dcml_tpu.training.rollout import RolloutCollector
+
+K = 2
+E = 4
+
+
+def _mat_components():
+    env = MatchingEnv(MatchingEnvConfig(n_agents=3, n_actions=4, horizon=5))
+    from mat_dcml_tpu.models.mat import DISCRETE, MATConfig
+    from mat_dcml_tpu.models.policy import TransformerPolicy
+
+    cfg = MATConfig(
+        n_agent=env.n_agents, obs_dim=env.obs_dim, state_dim=env.share_obs_dim,
+        action_dim=env.action_dim, n_block=1, n_embd=16, n_head=2,
+        action_type=DISCRETE,
+    )
+    policy = TransformerPolicy(cfg)
+    trainer = MATTrainer(policy, PPOConfig(ppo_epoch=2, num_mini_batch=2))
+    collector = RolloutCollector(env, policy, 5)
+    return policy, trainer, collector
+
+
+def _mappo_components():
+    env = MatchingEnv(MatchingEnvConfig(n_agents=2, n_actions=3, horizon=5))
+    pol = ActorCriticPolicy(
+        ACConfig(hidden_size=16),
+        obs_dim=env.obs_dim,
+        cent_obs_dim=env.share_obs_dim,
+        space=Discrete(env.action_dim),
+    )
+    trainer = MAPPOTrainer(pol, MAPPOConfig(lr=3e-3, critic_lr=3e-3,
+                                            ppo_epoch=2, num_mini_batch=2))
+    collector = ACRolloutCollector(env, pol, 5)
+    return pol, trainer, collector
+
+
+def _raw(x):
+    if isinstance(x, jax.Array) and jnp.issubdtype(x.dtype, jax.dtypes.prng_key):
+        x = jax.random.key_data(x)
+    return np.asarray(jax.device_get(x))
+
+
+def _assert_bit_exact(a, b, what):
+    la, lb = jax.tree.leaves(a), jax.tree.leaves(b)
+    assert len(la) == len(lb), what
+    for i, (x, y) in enumerate(zip(la, lb)):
+        assert np.array_equal(_raw(x), _raw(y)), f"{what}: leaf {i} differs"
+
+
+def _check_boundary_resume(policy, trainer, collector, tmp_path, seed):
+    dispatch = jax.jit(make_dispatch_fn(trainer, collector, K),
+                      donate_argnums=(0, 1))
+    params = policy.init_params(jax.random.key(0))
+    ts0 = trainer.init_state(params)
+    rs0 = collector.init_state(jax.random.key(1), E)
+    key0 = jax.random.key(seed)
+
+    # dispatch #1, then the two resume artifacts AT the boundary: a regular
+    # orbax checkpoint of the train state, and the packed carry for the
+    # rollout state + key chain — both BEFORE dispatch #2 donates the buffers
+    ts1, rs1, k1, _ = dispatch(ts0, rs0, key0)
+    jax.block_until_ready(ts1)
+    mgr = CheckpointManager(tmp_path / "models")
+    mgr.save(K - 1, ts1, blocking=True)
+    snap = pack_carry(K, ts1, rs1, k1)
+    mgr.finish()
+
+    # uninterrupted reference: dispatch #2 straight through
+    ts2, rs2, k2, _ = dispatch(ts1, rs1, k1)
+    jax.block_until_ready(ts2)
+
+    # the resumed process: restore the train state from disk (integrity
+    # checked), the rollout state + key from the carry, run dispatch #2
+    template = jax.eval_shape(
+        lambda: trainer.init_state(policy.init_params(jax.random.key(0))))
+    step, restored = mgr.restore_latest_valid(template=template)
+    assert step == K - 1
+    _, rs1b, k1b = place_carry(snap)
+    ts2b, rs2b, k2b, _ = dispatch(restored, rs1b, k1b)
+    jax.block_until_ready(ts2b)
+
+    assert np.array_equal(np.asarray(jax.random.key_data(k2)),
+                          np.asarray(jax.random.key_data(k2b))), "key chain"
+    _assert_bit_exact(ts2, ts2b, "train state after resumed dispatch")
+    _assert_bit_exact(rs2, rs2b, "rollout state after resumed dispatch")
+
+
+@pytest.mark.slow
+def test_mat_boundary_resume_bit_exact(tmp_path):
+    policy, trainer, collector = _mat_components()
+    _check_boundary_resume(policy, trainer, collector, tmp_path, seed=42)
+
+
+@pytest.mark.slow
+def test_mappo_boundary_resume_bit_exact(tmp_path):
+    policy, trainer, collector = _mappo_components()
+    _check_boundary_resume(policy, trainer, collector, tmp_path, seed=43)
+
+
+def test_carry_alone_matches_checkpoint_path():
+    """place_carry(pack_carry(...)) of the train state is itself bit-exact —
+    the emergency path (no orbax involved) must agree with the orbax path."""
+    policy, trainer, collector = _mat_components()
+    ts = trainer.init_state(policy.init_params(jax.random.key(3)))
+    rs = collector.init_state(jax.random.key(4), E)
+    key = jax.random.key(5)
+    ts2, rs2, key2 = place_carry(pack_carry(7, ts, rs, key))
+    _assert_bit_exact(ts, ts2, "train state through pack/place")
+    _assert_bit_exact(rs, rs2, "rollout state through pack/place")
+    assert np.array_equal(np.asarray(jax.random.key_data(key)),
+                          np.asarray(jax.random.key_data(key2)))
