@@ -96,6 +96,13 @@ Knobs (environment variables):
                         BENCH_FLEET_REPLICAS (1,2,4), BENCH_FLEET_SLO_MS (50),
                         BENCH_FLEET_RUN_DIR (append records to
                         <dir>/metrics.jsonl)
+  BENCH_MULTI_SCENARIO  "1" → scenario-as-data overhead A/B: a 4-scenario
+                        DCML family (nominal + fleet_stress + straggler
+                        mixes, envs/scenario.py) vs the plain single-scenario
+                        env at the same E/T/K under the fused dispatch; both
+                        legs assert one compile + zero steady recompiles.
+                        Knobs: BENCH_MS_E (64), BENCH_MS_K (2),
+                        BENCH_MS_ITERS (3)
 
 On device OOM the bench walks a backoff ladder before shrinking the batch:
 remat on -> accumulation x2 (up to 8) -> halve E — big batches get memory
@@ -895,6 +902,109 @@ def _measure_shard_sweep() -> None:
     print(json.dumps(record), flush=True)
 
 
+def _measure_multi_scenario() -> None:
+    """BENCH_MULTI_SCENARIO=1 leg: scenario-as-data overhead A/B.
+
+    Same E/T/K, same model: a plain single-scenario DCML fused dispatch vs a
+    4-scenario family (nominal + the PR 9 fleet_stress preset + two straggler
+    mixes) through envs/scenario.py.  The wrapper's costs are real — a
+    one-hot widens obs by N columns, and the per-step commit/observe pass
+    recomputes observations for the possibly-resampled scenario — so the leg
+    reports the throughput ratio honestly rather than claiming free
+    generality.  Both legs assert ONE compile and zero steady-state
+    recompiles: the scenario id must be data, not a trace constant.
+
+    Small DCML instance (worker_number_max=8) on whatever platform the
+    caller pinned — on CPU this is a structure/overhead proxy, not a chip
+    number.  Knobs: BENCH_MS_E (64), BENCH_MS_K (2), BENCH_MS_ITERS (3)."""
+    jax, _ = _setup_jax()
+
+    import numpy as np
+
+    from mat_dcml_tpu.config import RunConfig
+    from mat_dcml_tpu.envs.dcml import DCMLEnv, DCMLEnvConfig
+    from mat_dcml_tpu.envs.dcml.env import DCMLConsts
+    from mat_dcml_tpu.telemetry import Telemetry, instrumented_jit
+    from mat_dcml_tpu.training.base_runner import make_dispatch_fn
+    from mat_dcml_tpu.training.multi_scenario import build_dcml_scenario_env
+    from mat_dcml_tpu.training.ppo import MATTrainer, PPOConfig
+    from mat_dcml_tpu.training.rollout import RolloutCollector
+    from mat_dcml_tpu.training.runner import build_mat_policy
+
+    E = int(os.environ.get("BENCH_MS_E", "64"))
+    K = int(os.environ.get("BENCH_MS_K", "2"))
+    iters = int(os.environ.get("BENCH_MS_ITERS", "3"))
+    T = 8
+    scenarios = ("nominal", "fleet_stress", "heavy_stragglers", "busy_fleet")
+
+    W = 8
+    consts = DCMLConsts(worker_number_max=W, sob_dim=W + 2)
+    rng = np.random.default_rng(0)
+    workloads = rng.integers(
+        0, 5, size=(W, consts.local_workload_period)).astype(np.float32)
+
+    def make_env():
+        return DCMLEnv(DCMLEnvConfig(consts=consts), base_workloads=workloads)
+
+    def leg(env, label):
+        run = RunConfig(n_rollout_threads=E, episode_length=T,
+                        n_block=1, n_embd=32, n_head=2)
+        policy = build_mat_policy(run, env)
+        trainer = MATTrainer(policy, PPOConfig(ppo_epoch=2, num_mini_batch=2))
+        collector = RolloutCollector(env, policy, T)
+        tel = Telemetry()
+        dispatch = instrumented_jit(make_dispatch_fn(trainer, collector, K),
+                                    "dispatch", tel, log,
+                                    donate_argnums=(0, 1))
+        ts = trainer.init_state(policy.init_params(jax.random.key(0)))
+        rs = collector.init_state(jax.random.key(1), E)
+        key = jax.random.key(2)
+        ts, rs, key, _ = dispatch(ts, rs, key)      # warmup (compile)
+        jax.block_until_ready(ts)
+        dispatch.mark_steady()
+        start = time.perf_counter()
+        for _ in range(iters):
+            ts, rs, key, _ = dispatch(ts, rs, key)
+        jax.block_until_ready(ts)
+        elapsed = time.perf_counter() - start
+        sps = iters * K * E * T / elapsed
+        recompiles = int(tel.counters.get("steady_state_recompiles", 0))
+        log(f"{label}: {sps:.1f} env-steps/s ({elapsed / iters:.2f}s/dispatch, "
+            f"compiles={dispatch.compile_count}, steady_recompiles={recompiles})")
+        return {"leg": label, "steps_per_sec": round(sps, 2),
+                "obs_dim": env.obs_dim, "compile_count": dispatch.compile_count,
+                "steady_state_recompiles": recompiles}
+
+    rows = [
+        leg(make_env(), "single_scenario"),
+        leg(build_dcml_scenario_env(make_env(), scenarios),
+            f"multi_scenario_x{len(scenarios)}"),
+    ]
+    for row in rows:
+        print(json.dumps(row), flush=True)
+
+    base, multi = rows
+    dev = jax.devices()[0]
+    record = {
+        "metric": "dcml_mat_multi_scenario_env_steps_per_sec",
+        "value": multi["steps_per_sec"],
+        "unit": "env_steps/s",
+        "platform": dev.platform,
+        "device": dev.device_kind,
+        "provisional": dev.platform != "tpu",
+        "K": K, "E": E, "T": T,
+        "n_scenarios": len(scenarios),
+        "single_scenario_steps_per_sec": base["steps_per_sec"],
+        "multi_vs_single_ratio": round(
+            multi["steps_per_sec"] / max(base["steps_per_sec"], 1e-9), 4),
+        "single_compile": base["compile_count"] == 1
+        and multi["compile_count"] == 1,
+        "steady_state_recompiles": base["steady_state_recompiles"]
+        + multi["steady_state_recompiles"],
+    }
+    print(json.dumps(record), flush=True)
+
+
 def _measure_serving(jax) -> None:
     """BENCH_SERVING=1 leg: serving throughput A/B on the production DCML
     policy shape (101 agents).  Leg A runs the continuous batcher over the
@@ -1396,6 +1506,11 @@ def main() -> None:
     # Sharded fused-dispatch leg: pins its own CPU topology before jax init
     if os.environ.get("BENCH_SHARD_SWEEP", "0") == "1":
         _measure_shard_sweep()
+        return
+
+    # Multi-scenario overhead A/B: scenario-as-data family vs plain env
+    if os.environ.get("BENCH_MULTI_SCENARIO", "0") == "1":
+        _measure_multi_scenario()
         return
 
     # Serving A/B leg: self-contained, no orchestration (the caller pins the

@@ -1,0 +1,530 @@
+"""Scenario-as-data: train one policy across a *distribution* of scenarios.
+
+The JaxMARL / Podracer-Anakin idiom (arXiv:2311.10090, arXiv:2104.06272):
+instead of one compiled program per scenario (or a host-side map cycle with
+one jitted collect per map, as ``SMACMultiRunner`` does), scenario
+parameterizations become ARRAYS.  A :class:`ScenarioSet` stacks N same-shape
+parameterizations along a leading axis; each env slot carries an ``int32``
+scenario id in its per-env state and gathers its own parameter row with
+``jax.tree.map(lambda leaf: leaf[sid], stacked)`` inside the jitted step.
+No ``lax.switch``, no static branching on the scenario, therefore ONE
+compiled program for the whole family — the fused ``--iters_per_dispatch``
+dispatch, ``--data_shards`` mesh sharding, and emergency-checkpoint resume
+all work unchanged because the scenario id and its PRNG key are ordinary
+leading-``E``-axis leaves of the rollout carry.
+
+Scenario switches happen on episode boundaries only: the id is resampled
+from the set's (optionally weighted) distribution inside ``step`` exactly
+when the wrapped env auto-resets, so mid-episode dynamics never change under
+an agent's feet.  Observations (and the centralized state) get the scenario
+one-hot appended — the ``dmomat`` preference-conditioning precedent — so a
+single MAT policy can learn per-scenario behavior.  With N == 1 the wrapper
+adds no key splits and no conditioning columns, which is what makes the
+single-scenario path bit-exact against the unwrapped env (pinned by
+tests/test_multi_scenario.py).
+
+Per-env-family adapters translate "a parameter row" into the wrapped env's
+terms through three hooks:
+
+- ``param_env(env, params)``: an ephemeral per-trace view of the env with
+  traced parameter arrays grafted over its roster/config attributes
+  (``copy.copy`` + setattr — never hashed, safe under jit/vmap), consumed by
+  ``step``/``reset``/``_observe``.
+- ``commit(env, params, state, done)``: repair the freshly auto-reset state
+  so it is consistent with the (possibly just-resampled) scenario — fault
+  injection for DCML (mirroring ``envs/dcml/fault.py``), roster hp/shield
+  re-seeding for SMACLite, target rescaling for MuJoCoLite.
+- ``observe(env, params, state)``: rebuild (obs, share_obs, avail) from the
+  committed state so the policy sees the world it will act in.
+"""
+
+from __future__ import annotations
+
+import copy
+import dataclasses
+from typing import NamedTuple, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from mat_dcml_tpu.envs.dcml.env import DCMLEnv, DCMLState
+from mat_dcml_tpu.envs.dcml.fault import DCMLFaultConfig
+from mat_dcml_tpu.envs.mamujoco.lite import MJLiteEnv, MJLiteState
+from mat_dcml_tpu.envs.smac.maps import UNIT_STATS, MapParams, get_map_params
+from mat_dcml_tpu.envs.smac.smaclite import (
+    MELEE_RANGE,
+    REWARD_DEATH_VALUE,
+    REWARD_SCALE_RATE,
+    REWARD_WIN,
+    SHOOT_RANGE,
+    SMACLiteConfig,
+    SMACLiteEnv,
+    SMACLiteState,
+    _roster_arrays,
+)
+
+# shield lookup for union-layout decisions (UNIT_STATS row 1 > 0)
+UNIT_HAS_SHIELD = {t: s[1] > 0 for t, s in UNIT_STATS.items()}
+
+
+class ScenarioSet:
+    """N same-shape scenario parameterizations stacked as one array pytree.
+
+    ``params``: a pytree whose every leaf has leading axis N (one row per
+    scenario).  ``weights``: optional sampling weights (normalized here);
+    None = uniform.
+    """
+
+    def __init__(self, names: Tuple[str, ...], params,
+                 weights: Optional[Sequence[float]] = None):
+        self.names = tuple(names)
+        self.params = params
+        n = len(self.names)
+        if n < 1:
+            raise ValueError("a ScenarioSet needs at least one scenario")
+        for leaf in jax.tree.leaves(params):
+            if leaf.shape[0] != n:
+                raise ValueError(
+                    f"scenario param leaf has leading axis {leaf.shape[0]}, "
+                    f"expected {n} (one row per scenario)"
+                )
+        if weights is not None:
+            w = jnp.asarray(weights, jnp.float32)
+            if w.shape != (n,):
+                raise ValueError(f"weights shape {w.shape} != ({n},)")
+            self.weights = w / w.sum()
+        else:
+            self.weights = None
+
+    @classmethod
+    def stack(cls, names: Sequence[str], param_list: Sequence,
+              weights: Optional[Sequence[float]] = None) -> "ScenarioSet":
+        """Stack per-scenario param pytrees (all the same structure/shapes)
+        along a new leading axis."""
+        if len(names) != len(param_list):
+            raise ValueError(f"{len(names)} names for {len(param_list)} params")
+        params = jax.tree.map(
+            lambda *leaves: jnp.stack([jnp.asarray(x) for x in leaves]),
+            *param_list,
+        )
+        return cls(tuple(names), params, weights)
+
+    def gather(self, sid: jax.Array):
+        """The parameter row for scenario ``sid`` (traced int32 gather — the
+        whole point: data, not program structure)."""
+        return jax.tree.map(lambda leaf: leaf[sid], self.params)
+
+    def __len__(self) -> int:
+        return len(self.names)
+
+
+class ScenarioState(NamedTuple):
+    """Per-env carry: the wrapped env's state plus this slot's scenario id
+    and the id-resampling PRNG chain.  Both extra leaves vmap to leading-E
+    arrays, so the sharding contract (rollout.py) and ``pack_carry`` apply
+    unchanged."""
+
+    base: object                 # wrapped env's state pytree
+    sid: jax.Array               # () int32 scenario id
+    rng: jax.Array               # typed PRNG key driving episode resampling
+
+
+class ScenarioEnv:
+    """TimeStep-protocol env over a :class:`ScenarioSet`; jit/vmap-safe.
+
+    ``frozen=True`` pins every slot to its current scenario (no resampling
+    on episode reset) — the deterministic per-scenario eval-matrix mode; use
+    :meth:`reset_pinned` to start slots in a chosen scenario.
+    """
+
+    jittable = True
+
+    _FORWARD = ("cfg", "n_agents", "action_dim", "episode_limit",
+                "base_workloads", "action_space", "n_actions")
+
+    def __init__(self, env, scenarios: ScenarioSet, family, frozen: bool = False):
+        self.env = env
+        self.scenarios = scenarios
+        self.family = family
+        self.frozen = frozen
+        self.n_scenarios = len(scenarios)
+        # N == 1 keeps the base obs layout: the conditioning block would be a
+        # constant column, and dropping it is what keeps the single-scenario
+        # wrapper bit-exact vs the plain env
+        self.cond_dim = self.n_scenarios if self.n_scenarios > 1 else 0
+        for attr in self._FORWARD:
+            if hasattr(env, attr):
+                setattr(self, attr, getattr(env, attr))
+        self.obs_dim = env.obs_dim + self.cond_dim
+        self.share_obs_dim = env.share_obs_dim + self.cond_dim
+
+    # ------------------------------------------------------------- sampling
+
+    def _sample(self, key: jax.Array) -> jax.Array:
+        if self.scenarios.weights is None:
+            return jax.random.randint(key, (), 0, self.n_scenarios, jnp.int32)
+        return jax.random.categorical(
+            key, jnp.log(self.scenarios.weights)
+        ).astype(jnp.int32)
+
+    # ---------------------------------------------------------- conditioning
+
+    def _condition(self, sid, obs, share_obs):
+        if self.cond_dim == 0:
+            return obs, share_obs
+        row = jax.nn.one_hot(sid, self.n_scenarios, dtype=obs.dtype)
+        block = jnp.broadcast_to(row, (obs.shape[0], self.n_scenarios))
+        return (jnp.concatenate([obs, block], axis=-1),
+                jnp.concatenate([share_obs, block], axis=-1))
+
+    def _finish(self, sid, params, base, ts):
+        obs, share_obs, avail = self.family.observe(self.env, params, base)
+        obs, share_obs = self._condition(sid, obs, share_obs)
+        return ts._replace(obs=obs, share_obs=share_obs,
+                           available_actions=avail)
+
+    # -------------------------------------------------------------- control
+
+    def reset(self, key: jax.Array, episode_idx=0):
+        if self.n_scenarios == 1:
+            # no extra splits: the base env consumes the caller's key exactly
+            # as it would unwrapped (bit-exactness of the N=1 path)
+            sid = jnp.zeros((), jnp.int32)
+            rng, k_base = key, key
+        else:
+            rng, k_sid, k_base = jax.random.split(key, 3)
+            sid = self._sample(k_sid)
+        return self._reset_in(k_base, sid, rng, episode_idx)
+
+    def reset_pinned(self, key: jax.Array, sid, episode_idx=0):
+        """Start in scenario ``sid`` (traced data — one compiled program
+        covers the whole eval matrix)."""
+        sid = jnp.asarray(sid, jnp.int32)
+        return self._reset_in(key, sid, key, episode_idx)
+
+    def _reset_in(self, k_base, sid, rng, episode_idx):
+        params = self.scenarios.gather(sid)
+        env_p = self.family.param_env(self.env, params)
+        base, ts = env_p.reset(k_base, episode_idx)
+        base = self.family.commit(self.env, params, base,
+                                  jnp.asarray(True))
+        ts = self._finish(sid, params, base, ts)
+        return ScenarioState(base=base, sid=sid, rng=rng), ts
+
+    def step(self, state: ScenarioState, action):
+        params = self.scenarios.gather(state.sid)
+        env_p = self.family.param_env(self.env, params)
+        base, ts = env_p.step(state.base, action)
+        done = ts.done.any()
+        if self.n_scenarios == 1 or self.frozen:
+            sid_next, rng = state.sid, state.rng
+        else:
+            rng, k_sid = jax.random.split(state.rng)
+            sid_next = jnp.where(done, self._sample(k_sid), state.sid)
+        # the step just played ran under state.sid's params (correct: it
+        # belonged to the old episode); the auto-reset state this timestep
+        # carries belongs to the NEXT episode, so it is committed — and
+        # observed — under the resampled scenario
+        params_next = self.scenarios.gather(sid_next)
+        base = self.family.commit(self.env, params_next, base, done)
+        ts = self._finish(sid_next, params_next, base, ts)
+        return ScenarioState(base=base, sid=sid_next, rng=rng), ts
+
+    def frozen_view(self) -> "ScenarioEnv":
+        """A no-resampling view sharing this env's set (eval matrix)."""
+        view = copy.copy(self)
+        view.frozen = True
+        return view
+
+    def encode_single_agent_state(self, state: ScenarioState, binary: bool = True):
+        return self.env.encode_single_agent_state(state.base, binary)
+
+
+# ======================================================================= DCML
+
+
+class DCMLScenarioParams(NamedTuple):
+    """Array-ized :class:`~mat_dcml_tpu.envs.dcml.fault.DCMLFaultConfig`:
+    per-worker channels instead of static index tuples, so N presets stack
+    into one ``(N, W)`` pytree."""
+
+    dead: jax.Array        # (W,) bool — permanently unavailable
+    pr_floor: jax.Array    # (W,) f32 — failure-probability floor (0 = none)
+    load: jax.Array        # (W,) f32 — additive workload shift (0 = none)
+
+
+class DCMLScenarioFamily:
+    """DCML adapter: parameters act by fault injection on the freshly reset
+    state (``envs/dcml/fault.py`` semantics) — DCML auto-resets every step,
+    so ``commit`` runs unconditionally and ignores ``done``."""
+
+    @staticmethod
+    def identity(env: DCMLEnv) -> DCMLScenarioParams:
+        W = env.cfg.consts.worker_number_max
+        return DCMLScenarioParams(
+            dead=jnp.zeros((W,), bool),
+            pr_floor=jnp.zeros((W,), jnp.float32),
+            load=jnp.zeros((W,), jnp.float32),
+        )
+
+    @staticmethod
+    def from_fault(fault: DCMLFaultConfig, W: int) -> DCMLScenarioParams:
+        bad = [i for i in (*fault.dead_nodes, *fault.straggler_nodes)
+               if not 0 <= i < W]
+        if bad:
+            raise ValueError(f"fault node ids {bad} out of range [0, {W})")
+        iw = jnp.arange(W)
+        dead = jnp.isin(iw, jnp.asarray(fault.dead_nodes, jnp.int32)) \
+            if fault.dead_nodes else jnp.zeros((W,), bool)
+        strag = jnp.isin(iw, jnp.asarray(fault.straggler_nodes, jnp.int32)) \
+            if fault.straggler_nodes else jnp.zeros((W,), bool)
+        return DCMLScenarioParams(
+            dead=dead,
+            pr_floor=jnp.where(strag, jnp.float32(fault.straggler_pr_floor),
+                               0.0).astype(jnp.float32),
+            load=jnp.where(strag, jnp.float32(fault.straggler_load),
+                           0.0).astype(jnp.float32),
+        )
+
+    @staticmethod
+    def param_env(env: DCMLEnv, params: DCMLScenarioParams) -> DCMLEnv:
+        return env          # faults act on state, not env attributes
+
+    @staticmethod
+    def commit(env: DCMLEnv, params: DCMLScenarioParams,
+               state: DCMLState, done) -> DCMLState:
+        del done
+        unavailable = state.unavailable | params.dead
+        # identity rows are exact no-ops: max(pr, 0) == pr, trace already in
+        # [0, 1] so clip(trace + 0) == trace
+        worker_prs = jnp.maximum(state.worker_prs, params.pr_floor)
+        trace = jnp.clip(state.trace + params.load[:, None], 0.0, 1.0)
+        # keep the rank denominator (W - disable_rate) consistent with the
+        # merged mask — but ONLY when this scenario kills nodes: the env
+        # draws disable_rate in [1, 80] independent of W, so recomputing it
+        # from the mask on an identity row would CHANGE state at W < 81
+        disable_rate = jnp.where(
+            params.dead.any(),
+            unavailable.sum().astype(jnp.int32),
+            state.disable_rate,
+        )
+        return state._replace(unavailable=unavailable, worker_prs=worker_prs,
+                              trace=trace, disable_rate=disable_rate)
+
+    @staticmethod
+    def observe(env: DCMLEnv, params: DCMLScenarioParams, state: DCMLState):
+        return env._observe(state)
+
+
+# =================================================================== SMACLite
+
+
+class SMACScenarioParams(NamedTuple):
+    """One map's roster arrays in the shared (union) obs layout, plus its
+    reward normalizer and episode limit — everything ``SMACLiteEnv`` reads
+    per-map inside its traced methods."""
+
+    a_hp0: jax.Array       # (A,)
+    a_sh0: jax.Array
+    a_dmg: jax.Array
+    a_cd0: jax.Array
+    a_range: jax.Array
+    a_type: jax.Array      # (A,) int32 into the union one-hot layout
+    e_hp0: jax.Array       # (Ne,)
+    e_sh0: jax.Array
+    e_dmg: jax.Array
+    e_cd0: jax.Array
+    e_range: jax.Array
+    e_type: jax.Array
+    reward_norm: jax.Array  # () f32
+    limit: jax.Array        # () int32
+
+
+_SMAC_ROSTER_ATTRS = ("a_hp0", "a_sh0", "a_dmg", "a_cd0", "a_range", "a_type",
+                      "e_hp0", "e_sh0", "e_dmg", "e_cd0", "e_range", "e_type")
+
+
+class SMACScenarioFamily:
+    """SMACLite adapter: the roster IS the scenario.  ``param_env`` grafts
+    the row's traced roster arrays over a shallow env copy (the copy is
+    ephemeral per trace and never hashed, so traced attributes are safe);
+    ``commit`` re-seeds hp/shield on episode boundaries because the env's
+    internal auto-reset spawned with the OLD scenario's roster.  Spawn
+    positions, cooldowns, and timers are roster-independent (asserted same
+    ``map_size`` at set construction), so hp/shield are the whole repair."""
+
+    @staticmethod
+    def identity(env: SMACLiteEnv) -> SMACScenarioParams:
+        return SMACScenarioParams(
+            **{a: getattr(env, a) for a in _SMAC_ROSTER_ATTRS},
+            reward_norm=jnp.float32(env._reward_norm),
+            limit=jnp.int32(env.episode_limit),
+        )
+
+    @staticmethod
+    def param_env(env: SMACLiteEnv, params: SMACScenarioParams) -> SMACLiteEnv:
+        env_p = copy.copy(env)
+        for attr in _SMAC_ROSTER_ATTRS:
+            setattr(env_p, attr, getattr(params, attr))
+        env_p._reward_norm = params.reward_norm
+        env_p.episode_limit = params.limit
+        return env_p
+
+    @staticmethod
+    def commit(env: SMACLiteEnv, params: SMACScenarioParams,
+               state: SMACLiteState, done) -> SMACLiteState:
+        reseed = lambda fresh, cur: jnp.where(done, fresh, cur)
+        return state._replace(
+            ally_hp=reseed(params.a_hp0, state.ally_hp),
+            ally_shield=reseed(params.a_sh0, state.ally_shield),
+            enemy_hp=reseed(params.e_hp0, state.enemy_hp),
+            enemy_shield=reseed(params.e_sh0, state.enemy_shield),
+        )
+
+    @staticmethod
+    def observe(env: SMACLiteEnv, params: SMACScenarioParams,
+                state: SMACLiteState):
+        return SMACScenarioFamily.param_env(env, params)._observe(state)
+
+
+def smac_map_scenario_params(mp: MapParams,
+                             layout_types: Tuple[str, ...]) -> SMACScenarioParams:
+    """One map's roster in the union one-hot ``layout_types`` layout."""
+    a = _roster_arrays(mp.agents, layout_types)
+    e = _roster_arrays(mp.enemies, layout_types)
+    max_reward = (float(e[0].sum() + e[1].sum())
+                  + mp.n_enemies * REWARD_DEATH_VALUE + REWARD_WIN)
+    return SMACScenarioParams(
+        a_hp0=jnp.asarray(a[0]), a_sh0=jnp.asarray(a[1]),
+        a_dmg=jnp.asarray(a[2]), a_cd0=jnp.asarray(a[3]),
+        a_range=jnp.where(jnp.asarray(a[4]), MELEE_RANGE, SHOOT_RANGE),
+        a_type=jnp.asarray(a[5]),
+        e_hp0=jnp.asarray(e[0]), e_sh0=jnp.asarray(e[1]),
+        e_dmg=jnp.asarray(e[2]), e_cd0=jnp.asarray(e[3]),
+        e_range=jnp.where(jnp.asarray(e[4]), MELEE_RANGE, SHOOT_RANGE),
+        e_type=jnp.asarray(e[5]),
+        reward_norm=jnp.float32(max_reward / REWARD_SCALE_RATE),
+        limit=jnp.int32(mp.limit),
+    )
+
+
+def smac_stat_variant(env: SMACLiteEnv, name_suffix: str = "",
+                      enemy_hp_scale: float = 1.0,
+                      enemy_dmg_scale: float = 1.0,
+                      ally_dmg_scale: float = 1.0) -> SMACScenarioParams:
+    """A same-roster stat variant (harder/easier fight on the same map) —
+    the SMAC analogue of a DCML fault preset.  The reward normalizer tracks
+    the scaled enemy pool so max episode return stays ``reward_scale_rate``.
+    """
+    del name_suffix
+    base = SMACScenarioFamily.identity(env)
+    e_hp0 = base.e_hp0 * enemy_hp_scale
+    e_sh0 = base.e_sh0 * enemy_hp_scale
+    max_reward = (float(e_hp0.sum() + e_sh0.sum())
+                  + env.n_enemies * REWARD_DEATH_VALUE + REWARD_WIN)
+    return base._replace(
+        e_hp0=e_hp0, e_sh0=e_sh0,
+        e_dmg=base.e_dmg * enemy_dmg_scale,
+        a_dmg=base.a_dmg * ally_dmg_scale,
+        reward_norm=jnp.float32(max_reward / REWARD_SCALE_RATE),
+    )
+
+
+def build_smac_scenario_set(map_names: Sequence[str],
+                            weights: Optional[Sequence[float]] = None):
+    """(env, ScenarioSet) for a same-shape SMAC map roster.
+
+    All maps must agree on (n_agents, n_enemies) — the action space is
+    ``6 + n_enemies`` and the obs layout is per-agent/per-enemy — and on
+    ``map_size`` (spawn geometry is not a scenario parameter).  Unit one-hot
+    columns use the UNION of the rosters' types (``layout_types`` on the
+    env config) so every map observes through the same feature layout.
+    """
+    if len(map_names) < 1:
+        raise ValueError("need at least one map")
+    mps = [get_map_params(m) for m in map_names]
+    shapes = {(mp.n_agents, mp.n_enemies) for mp in mps}
+    if len(shapes) > 1:
+        raise ValueError(
+            f"maps {list(map_names)} disagree on (n_agents, n_enemies): "
+            f"{sorted(shapes)} — heterogeneous rosters need the host-cycled "
+            f"SMACMultiRunner fallback"
+        )
+    sizes = {mp.map_size for mp in mps}
+    if len(sizes) > 1:
+        raise ValueError(f"maps disagree on map_size: {sorted(sizes)}")
+    union = tuple(sorted({t for mp in mps for t in (*mp.agents, *mp.enemies)}))
+    shield = any(
+        UNIT_HAS_SHIELD[t] for mp in mps for t in (*mp.agents, *mp.enemies)
+    )
+    env = SMACLiteEnv(SMACLiteConfig(
+        map_name=mps[0].name, layout_types=union, layout_shield=shield,
+    ))
+    params = [smac_map_scenario_params(mp, union) for mp in mps]
+    return env, ScenarioSet.stack(tuple(map_names), params, weights)
+
+
+# ================================================================= MuJoCoLite
+
+
+class MJLiteScenarioParams(NamedTuple):
+    """Dynamics/target variant of the jointed-chain env: actuator gain,
+    damping, stiffness (the ω' update's coefficients) and a target-posture
+    scale applied on episode reset."""
+
+    gain: jax.Array          # () f32
+    damping: jax.Array
+    stiffness: jax.Array
+    target_scale: jax.Array
+
+
+class MJLiteScenarioFamily:
+    """MuJoCoLite adapter: dynamics coefficients ride a config replace on a
+    shallow env copy (frozen dataclass holding traced scalars — never
+    hashed); ``commit`` rescales the freshly drawn target on done so each
+    scenario reaches for a different posture envelope."""
+
+    @staticmethod
+    def identity(env: MJLiteEnv) -> MJLiteScenarioParams:
+        c = env.cfg
+        return MJLiteScenarioParams(
+            gain=jnp.float32(c.gain), damping=jnp.float32(c.damping),
+            stiffness=jnp.float32(c.stiffness),
+            target_scale=jnp.float32(1.0),
+        )
+
+    @staticmethod
+    def variant(env: MJLiteEnv, gain: Optional[float] = None,
+                damping: Optional[float] = None,
+                stiffness: Optional[float] = None,
+                target_scale: float = 1.0) -> MJLiteScenarioParams:
+        c = env.cfg
+        return MJLiteScenarioParams(
+            gain=jnp.float32(c.gain if gain is None else gain),
+            damping=jnp.float32(c.damping if damping is None else damping),
+            stiffness=jnp.float32(c.stiffness if stiffness is None else stiffness),
+            target_scale=jnp.float32(target_scale),
+        )
+
+    @staticmethod
+    def param_env(env: MJLiteEnv, params: MJLiteScenarioParams) -> MJLiteEnv:
+        env_p = copy.copy(env)
+        env_p.cfg = dataclasses.replace(
+            env.cfg, gain=params.gain, damping=params.damping,
+            stiffness=params.stiffness,
+        )
+        return env_p
+
+    @staticmethod
+    def commit(env: MJLiteEnv, params: MJLiteScenarioParams,
+               state: MJLiteState, done) -> MJLiteState:
+        # identity rows are exact: target * 1.0 == target
+        return state._replace(
+            target=jnp.where(done, state.target * params.target_scale,
+                             state.target)
+        )
+
+    @staticmethod
+    def observe(env: MJLiteEnv, params: MJLiteScenarioParams,
+                state: MJLiteState):
+        return env._observe(state)

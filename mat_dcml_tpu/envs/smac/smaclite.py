@@ -89,6 +89,12 @@ class SMACLiteConfig:
     move_amount: float = MOVE_AMOUNT
     attack_own_team: bool = False          # reserved
     continuing_episode: bool = False
+    # union obs-layout overrides (scenario-as-data map families,
+    # envs/scenario.py): pin the one-hot type layout / shield columns to a
+    # roster-wide union so same-shape maps observe through identical feature
+    # widths.  () / False = this map's own layout.
+    layout_types: Tuple[str, ...] = ()
+    layout_shield: bool = False
 
 
 def _roster_arrays(types: Tuple[str, ...], all_types: Tuple[str, ...]):
@@ -114,8 +120,15 @@ class SMACLiteEnv:
         self.action_dim = self.n_actions
         self.episode_limit = mp.limit
 
-        all_types = mp.unit_types
-        self.unit_type_bits = mp.unit_type_bits
+        all_types = tuple(cfg.layout_types) if cfg.layout_types else mp.unit_types
+        missing = sorted(set(mp.unit_types) - set(all_types))
+        if missing:
+            raise ValueError(
+                f"map {mp.name!r} has unit types {missing} absent from "
+                f"layout_types={all_types}"
+            )
+        # same rule as MapParams.unit_type_bits, applied to the union layout
+        self.unit_type_bits = 0 if len(all_types) < 2 else len(all_types)
         a = _roster_arrays(mp.agents, all_types)
         e = _roster_arrays(mp.enemies, all_types)
         (self.a_hp0, self.a_sh0, self.a_dmg, self.a_cd0, a_melee, self.a_type) = (
@@ -126,7 +139,8 @@ class SMACLiteEnv:
         )
         self.a_range = jnp.where(jnp.asarray(a_melee), MELEE_RANGE, SHOOT_RANGE)
         self.e_range = jnp.where(jnp.asarray(e_melee), MELEE_RANGE, SHOOT_RANGE)
-        self.shield_bits = int((a[1].max() > 0) or (e[1].max() > 0))
+        self.shield_bits = int((a[1].max() > 0) or (e[1].max() > 0)
+                               or cfg.layout_shield)
         self.map_w, self.map_h = mp.map_size
 
         # obs layout widths (get_obs_*_size, StarCraft2_Env.py:1662-1686):

@@ -59,7 +59,7 @@ class SpecStats(NamedTuple):
 # — so the whole-decode Pallas kernel (ops/pallas_decode.py) is a documented
 # PORTABILITY ARTIFACT, selectable via MAT_DCML_TPU_DECODE_IMPL=pallas and
 # kept interpret-mode parity-tested, not the default.  Revisit only if a
-# future measured A/B (scripts/tpu_session4.sh leg 2) shows a win.
+# future measured on-chip A/B shows a win.
 _DECODE_IMPL_ENV = "MAT_DCML_TPU_DECODE_IMPL"
 _VALID_DECODE_IMPLS = ("auto", "xla", "pallas", "pallas_interpret")
 

@@ -72,6 +72,10 @@ def _unrows(x: jax.Array, E: int, A: int) -> jax.Array:
 
 
 class ACRolloutCollector:
+    # explicit fused-dispatch eligibility (base_runner gates on this;
+    # host-driven collectors declare False, host_rollout.py:45)
+    jittable = True
+
     def __init__(self, env, policy: ActorCriticPolicy, episode_length: int,
                  use_local_value: bool = False):
         """``use_local_value=True`` feeds the critic local obs instead of the
@@ -84,6 +88,13 @@ class ACRolloutCollector:
 
     def _cent(self, st: ACRolloutState) -> jax.Array:
         return st.obs if self.use_local_value else st.share_obs
+
+    def apply(self, params, key, st: ACRolloutState, deterministic: bool = False):
+        """Public policy application for eval loops and external drivers:
+        actions + values + next hidden states at the (E, A, ...) level.
+        Subclass dispatch (IPPO/HAPPO per-agent stacking) happens in
+        ``_apply``, so callers never reach into collector internals."""
+        return self._apply(params, key, st, deterministic)
 
     def _apply(self, params, key, st: ACRolloutState, deterministic: bool = False):
         """One policy application at the (E, A, ...) level.  The base class

@@ -444,13 +444,20 @@ class BaseRunner:
         K = max(1, int(getattr(run, "iters_per_dispatch", 1)))
         try:
             if K > 1:
+                # the fallback gauge makes the silently-taken path visible to
+                # metrics.jsonl consumers (BENCHLOG legs, schema checker):
+                # 1.0 = fused dispatch was requested but fell back to the
+                # classic loop, 0.0 = the fused path actually ran
                 if not getattr(self.collector, "jittable", True):
+                    self.telemetry.gauge("dispatch_fused_fallback", 1.0)
                     self.log("[dispatch] collector is host-driven (jittable=False); "
                              "--iters_per_dispatch ignored")
                 elif not hasattr(self.trainer, "train_iteration"):
+                    self.telemetry.gauge("dispatch_fused_fallback", 1.0)
                     self.log(f"[dispatch] {type(self.trainer).__name__} has no "
                              f"train_iteration; --iters_per_dispatch ignored")
                 else:
+                    self.telemetry.gauge("dispatch_fused_fallback", 0.0)
                     return self._train_loop_fused(episodes, train_state, rollout_state, key, K)
             return self._train_loop_episodic(episodes, train_state, rollout_state, key)
         except PreemptedExit:

@@ -165,7 +165,7 @@ class GenericRunner(BaseRunner):
         else:
             @jax.jit
             def eval_step(params, st):
-                out = self.collector._apply(params, jax.random.key(0), st, deterministic=True)
+                out = self.collector.apply(params, jax.random.key(0), st, deterministic=True)
                 env_states, ts = jax.vmap(self.env.step)(st.env_states, out.action)
                 new_st = st._replace(
                     env_states=env_states, obs=ts.obs, share_obs=ts.share_obs,

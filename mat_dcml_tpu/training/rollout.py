@@ -78,6 +78,10 @@ class RolloutState(NamedTuple):
 class RolloutCollector:
     """Builds the jittable ``collect`` function for a (policy, env) pair."""
 
+    # explicit fused-dispatch eligibility (base_runner gates on this;
+    # host-driven collectors declare False, host_rollout.py:45)
+    jittable = True
+
     def __init__(
         self,
         env,

@@ -249,7 +249,7 @@ class DCMLRunner(BaseRunner):
                 return new_st, (rew_env, ts.delay, ts.payment, ts.done)
         else:
             def act(params, st, key):
-                return self.collector._apply(params, key, st, deterministic=True)
+                return self.collector.apply(params, key, st, deterministic=True)
 
             def step(st: ACRolloutState, out):
                 env_states, ts = jax.vmap(env.step)(st.env_states, out.action)

@@ -22,7 +22,13 @@ import jax.numpy as jnp
 import numpy as np
 
 from mat_dcml_tpu.config import RunConfig
+from mat_dcml_tpu.envs.scenario import (
+    ScenarioEnv,
+    SMACScenarioFamily,
+    build_smac_scenario_set,
+)
 from mat_dcml_tpu.envs.smac import SMACLiteConfig, TranslatedSMACEnv
+from mat_dcml_tpu.envs.smac.maps import get_map_params
 from mat_dcml_tpu.training.base_runner import BaseRunner
 from mat_dcml_tpu.training.generic_runner import GenericRunner, build_discrete_policy
 from mat_dcml_tpu.training.ppo import MATTrainer, PPOConfig
@@ -57,7 +63,7 @@ class SMACRunner(GenericRunner):
                 )
                 extra = {}
             else:
-                out = self.collector._apply(params, jax.random.key(0), st, deterministic=True)
+                out = self.collector.apply(params, jax.random.key(0), st, deterministic=True)
                 extra = dict(actor_h=out.actor_h, critic_h=out.critic_h)
             env_states, ts = jax.vmap(env.step)(st.env_states, out.action)
             new_st = st._replace(
@@ -205,3 +211,125 @@ class SMACMultiRunner(BaseRunner):
             info = SMACRunner.evaluate(sub, train_state, n_episodes=n_episodes, seed=seed)
             out[f"eval_win_rate_{m}"] = info["eval_win_rate"]
         return out
+
+
+class SMACScenarioRunner(SMACRunner):
+    """One policy over a same-roster map family via scenario-as-data
+    (``envs/scenario.py``): the map is a per-slot parameter leaf in the
+    rollout carry, resampled on episode reset inside the jitted step, so a
+    single compiled program covers the whole roster and the fused
+    ``--iters_per_dispatch`` dispatch applies unchanged — unlike
+    :class:`SMACMultiRunner`'s host cycle, which compiles one program per
+    map and trains them round-robin from Python."""
+
+    def __init__(self, run: RunConfig, ppo: PPOConfig,
+                 train_maps: Sequence[str],
+                 weights: Optional[Sequence[float]] = None, log_fn=print):
+        if run.algorithm_name not in ("mat", "mat_dec"):
+            raise NotImplementedError(
+                "scenario-as-data multi-map training drives the MAT family"
+            )
+        self.train_maps = tuple(train_maps)
+        self._eval_roll = None
+        base_env, sset = build_smac_scenario_set(self.train_maps, weights)
+        super().__init__(run, ppo, ScenarioEnv(base_env, sset, SMACScenarioFamily),
+                         log_fn=log_fn)
+
+    def evaluate(self, train_state, maps: Optional[Sequence[str]] = None,
+                 n_episodes: int = 16, seed: int = 0):
+        """Per-map deterministic win-rate matrix: each map's scenario id is
+        pinned on a resampling-frozen view, so the SMAC win flag (the delay
+        info channel) attributes cleanly per map.  One jitted rollout with a
+        traced scenario id serves every map — N maps = N calls into ONE
+        compile.  Held-out maps are out of scope here: the policy's scenario
+        one-hot has no slot for them (use ``SMACMultiRunner`` for few-shot)."""
+        import numpy as np
+
+        names = self.env.scenarios.names
+        maps = tuple(maps) if maps is not None else names
+        skipped = [m for m in maps if m not in names]
+        if skipped:
+            self.log(f"[smac-scenario] skipping out-of-roster eval maps "
+                     f"{skipped} (no scenario one-hot slot; few-shot eval "
+                     f"needs the host-cycled SMACMultiRunner)")
+        maps = [m for m in maps if m in names]
+
+        if self._eval_roll is None:
+            senv = self.env.frozen_view()
+            E = self.run_cfg.n_rollout_threads
+            policy = self.policy
+            # enough steps for n_episodes battles at the longest limit in
+            # the roster, mirroring SMACRunner's eval-until-N budget
+            limit = int(np.asarray(self.env.scenarios.params.limit).max())
+            T = 2 * limit * (max(n_episodes // E, 1) + 1)
+
+            def roll(params, sid):
+                keys = jax.random.split(jax.random.key(seed + 17), E)
+                states, ts = jax.vmap(senv.reset_pinned, in_axes=(0, None))(keys, sid)
+
+                def body(carry, _):
+                    states, obs, share_obs, avail = carry
+                    out = policy.get_actions(
+                        params, jax.random.key(0), share_obs, obs, avail,
+                        deterministic=True,
+                    )
+                    states, ts = jax.vmap(senv.step)(states, out.action)
+                    done_env = ts.done.all(axis=1)
+                    stats = jnp.stack([
+                        done_env.astype(jnp.float32).sum(),
+                        jnp.where(done_env, ts.delay, 0.0).sum(),    # wins
+                        jnp.where(done_env, ts.payment, 0.0).sum(),  # dead ratio
+                        ts.reward.mean(),
+                    ])
+                    return (states, ts.obs, ts.share_obs,
+                            ts.available_actions), stats
+
+                carry = (states, ts.obs, ts.share_obs, ts.available_actions)
+                _, stats = jax.lax.scan(body, carry, None, length=T)
+                totals = stats.sum(axis=0)
+                return totals[0], totals[1], totals[2], stats[:, 3].mean()
+
+            self._eval_roll = jax.jit(roll)
+
+        out = {"scenario_count": float(len(maps))}
+        win_rates, rewards = [], []
+        for m in maps:
+            sid = jnp.asarray(names.index(m), jnp.int32)
+            eps, wins, dead, rew = self._eval_roll(train_state.params, sid)
+            eps = float(eps)
+            wr = float(wins) / max(eps, 1.0)
+            out[f"eval_win_rate_{m}"] = wr
+            out[f"scenario_{m}_win_rate"] = wr
+            out[f"scenario_{m}_dead_ratio"] = float(dead) / max(eps, 1.0)
+            out[f"scenario_{m}_episodes"] = eps
+            win_rates.append(wr)
+            rewards.append(float(rew))
+        if win_rates:
+            out["eval_win_rate"] = float(np.mean(win_rates))
+            out["eval_average_step_rewards"] = float(np.mean(rewards))
+        return out
+
+
+def make_multi_map_runner(run: RunConfig, ppo: PPOConfig,
+                          train_maps: Sequence[str], random_order: bool = False,
+                          log_fn=print):
+    """Pick the multi-map training backend for a map roster.
+
+    Same-shape rosters (equal ally/enemy counts and map size) compile to ONE
+    program via :class:`SMACScenarioRunner`; heterogeneous rosters — or
+    per-episode agent shuffling, which the scenario wrapper doesn't model —
+    keep the host-cycled :class:`SMACMultiRunner` fallback."""
+    maps = tuple(train_maps)
+    mps = [get_map_params(m) for m in maps]
+    same_shape = (
+        len({(len(mp.agents), len(mp.enemies)) for mp in mps}) == 1
+        and len({mp.map_size for mp in mps}) == 1
+    )
+    if same_shape and not random_order and len(maps) > 1:
+        log_fn(f"[smac-multi] same-shape roster {maps}: scenario-as-data path")
+        return SMACScenarioRunner(run, ppo, maps, log_fn=log_fn)
+    if len(maps) > 1:
+        why = "random_order" if random_order else "heterogeneous roster"
+        log_fn(f"[smac-multi] {why}: host-cycled fallback over {maps}")
+    return SMACMultiRunner(run, ppo, maps, random_order=random_order,
+                           log_fn=log_fn)

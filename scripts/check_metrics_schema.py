@@ -46,6 +46,10 @@ KNOWN_FIELDS = {
     # base_runner._train_loop_fused): core metric fields become means over
     # the stacked (K,) per-iteration values; these ride along
     "iters_per_dispatch", "dispatch_count", "dispatches_per_sec",
+    # 1.0 when --iters_per_dispatch > 1 was requested but the fused path
+    # silently fell back to the classic loop (host-driven collector or a
+    # trainer without train_iteration), 0.0 when the fused path actually ran
+    "dispatch_fused_fallback",
     # gauges (telemetry/system.py)
     "device_bytes_in_use", "device_peak_bytes", "host_rss_bytes",
     # one-shot
@@ -97,6 +101,13 @@ KNOWN_PREFIXES = (
     # snapshot/retry/failure/emergency-save/quarantine counters,
     # deadline-overrun count, graceful-stop latency (resilience_stop_latency_s)
     "resilience_",
+    # multi-scenario eval matrix (training/multi_scenario.py +
+    # SMACScenarioRunner): per-scenario gauges scenario_<name>_<signal>
+    # (reward/delay/payment, or win_rate/dead_ratio/episodes for SMAC) plus
+    # family aggregates (scenario_count/_reward_min/_reward_max/_spread/
+    # _specialist_count/_generalist_gap).  NOT in the blanket non-negative
+    # set: DCML per-scenario rewards are negative costs.
+    "scenario_",
 )
 
 # fields that must never go negative (counters, rates, timers, gauges)
@@ -112,10 +123,14 @@ NON_NEGATIVE = (
     "profile_dispatch_sec",
     "decode_spec_draft_passes", "decode_spec_verify_passes",
     "decode_spec_accept_rate",
+    "dispatch_fused_fallback",
+    # scenario-family aggregates (per-scenario rewards may be negative and
+    # are deliberately NOT constrained)
+    "scenario_count", "scenario_spread", "scenario_specialist_count",
 )
 
 # rates that must stay within [0, 1] (acceptance is accepted/offered)
-UNIT_INTERVAL = ("decode_spec_accept_rate",)
+UNIT_INTERVAL = ("decode_spec_accept_rate", "dispatch_fused_fallback")
 
 # a serving record (identified by serving_qps) must carry the benchmark
 # contract BENCHLOG consumes: throughput, latency percentiles, shed rate
@@ -132,6 +147,14 @@ REQUIRED_FLEET = (
     "fleet_replicas", "fleet_healthy", "fleet_requests", "fleet_retries",
     "fleet_unhealthy_marks", "fleet_readmissions", "fleet_generation",
     "rollout_pushes", "rollout_rollbacks",
+)
+
+# a DCML multi-scenario eval-matrix record (identified by scenario_spread —
+# the SMAC win-rate matrix emits scenario_count alone) must carry the full
+# family-aggregate contract so the generalist checkpoint is comparable
+REQUIRED_SCENARIO = (
+    "scenario_count", "scenario_reward_min", "scenario_reward_max",
+    "scenario_spread", "scenario_specialist_count", "scenario_generalist_gap",
 )
 
 # a training record (vs eval/profile records, which are sparse) must have:
@@ -279,6 +302,10 @@ def validate_record(record, index: int = 0, strict_names: bool = True) -> List[s
         for k in REQUIRED_SERVING:
             if k not in record:
                 errs.append(f"{where}: serving record missing {k!r}")
+    if "scenario_spread" in record:  # multi-scenario eval-matrix record
+        for k in REQUIRED_SCENARIO:
+            if k not in record:
+                errs.append(f"{where}: scenario eval record missing {k!r}")
     if "fleet_replicas" in record:  # fleet snapshot record
         for k in REQUIRED_FLEET:
             if k not in record:

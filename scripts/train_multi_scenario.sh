@@ -1,0 +1,14 @@
+#!/bin/sh
+# Generalist checkpoint over the DCML fault-scenario family (ROADMAP
+# multi-scenario item): the faithful DCML recipe, trained across four
+# scenarios (incl. the PR 9 fleet_stress preset) under the fused K-step
+# dispatch.  Per-scenario eval matrix lands in <run_dir>/metrics.jsonl as
+# the scenario_ gauge family; the checkpoint under models/ is the
+# generalist artifact.
+seed="${1:-1}"
+scenarios="${2:-nominal,fleet_stress,heavy_stragglers,busy_fleet}"
+exec python train_multi_scenario.py --algorithm_name mat \
+  --experiment_name generalist --seed "$seed" --scenarios "$scenarios" \
+  --n_rollout_threads 8 --num_env_steps 1000000 --episode_length 50 \
+  --lr 5e-5 --ppo_epoch 15 --num_mini_batch 4 --iters_per_dispatch 4 \
+  --use_eval true

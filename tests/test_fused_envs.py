@@ -1,0 +1,96 @@
+"""Fused K>1 dispatch smoke across the on-device env families.
+
+test_fused_dispatch.py pins fused == sequential on the DCML/Matching
+fixtures; what it does NOT pin is that the other jittable collectors
+(SMACLite, MPE, MuJoCo-lite) survive the donated K-step scan at all — a
+weak-typed carry leaf or host callback in any of their step functions would
+surface as a per-dispatch recompile and silently destroy the perf win.  So
+for each family: ONE compile for the instrumented donated dispatch, zero
+steady-state recompiles across repeated dispatches, and the donation
+actually invalidates the carried train state.
+"""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from mat_dcml_tpu.config import RunConfig
+from mat_dcml_tpu.telemetry import Telemetry, instrumented_jit
+from mat_dcml_tpu.training.base_runner import make_dispatch_fn
+from mat_dcml_tpu.training.generic_runner import build_discrete_policy
+from mat_dcml_tpu.training.ppo import MATTrainer, PPOConfig
+from mat_dcml_tpu.training.rollout import RolloutCollector
+
+K = 2
+E = 2
+T = 8
+
+
+def _run_fused_smoke(env, n_dispatches: int = 2):
+    run = RunConfig(algorithm_name="mat", n_rollout_threads=E,
+                    episode_length=T, n_block=1, n_embd=16, n_head=1)
+    policy = build_discrete_policy(run, env)
+    trainer = MATTrainer(policy, PPOConfig(ppo_epoch=2, num_mini_batch=1))
+    collector = RolloutCollector(env, policy, T)
+    assert getattr(collector, "jittable", False), "collector left the fused gate"
+
+    tel = Telemetry()
+    dispatch = instrumented_jit(make_dispatch_fn(trainer, collector, K),
+                                "dispatch", tel, donate_argnums=(0, 1))
+    ts = trainer.init_state(policy.init_params(jax.random.key(0)))
+    rs = collector.init_state(jax.random.key(1), E)
+    donated_leaf = jax.tree.leaves(ts.params)[0]
+    key = jax.random.key(2)
+
+    ts, rs, key, (metrics, _) = dispatch(ts, rs, key)
+    jax.block_until_ready(ts.params)
+    assert donated_leaf.is_deleted(), "dispatch did not donate the train state"
+    dispatch.mark_steady()
+    for _ in range(n_dispatches):
+        ts, rs, key, (metrics, _) = dispatch(ts, rs, key)
+    jax.block_until_ready(ts.params)
+
+    assert dispatch.compile_count == 1, "fused dispatch recompiled"
+    assert tel.counters.get("steady_state_recompiles", 0) == 0
+    assert jax.tree.leaves(metrics)[0].shape[0] == K   # stacked per-iteration
+    assert int(ts.update_step) == (1 + n_dispatches) * K
+    for leaf in jax.tree.leaves(ts.params):
+        assert bool(jnp.isfinite(leaf).all()), "non-finite params after dispatch"
+
+
+def test_smaclite_fused_dispatch():
+    from mat_dcml_tpu.envs.smac.smaclite import SMACLiteConfig, SMACLiteEnv
+
+    _run_fused_smoke(SMACLiteEnv(SMACLiteConfig(map_name="2m")))
+
+
+def test_mpe_fused_dispatch():
+    from mat_dcml_tpu.envs.mpe import SimpleSpreadConfig, SimpleSpreadEnv
+
+    _run_fused_smoke(SimpleSpreadEnv(SimpleSpreadConfig(episode_length=T)))
+
+
+def test_mamujoco_lite_fused_dispatch():
+    from mat_dcml_tpu.envs.mamujoco import MJLiteConfig, MJLiteEnv
+
+    _run_fused_smoke(MJLiteEnv(MJLiteConfig(episode_length=T)))
+
+
+@pytest.mark.parametrize("family", ["smac", "mpe", "mjlite"])
+def test_collectors_are_jittable(family):
+    """The fused gate (base_runner.train_loop) keys on ``collector.jittable``;
+    pin the attribute so a future host-driven rewrite fails loudly here
+    instead of silently falling back to the classic loop."""
+    if family == "smac":
+        from mat_dcml_tpu.envs.smac.smaclite import SMACLiteConfig, SMACLiteEnv
+        env = SMACLiteEnv(SMACLiteConfig(map_name="2m"))
+    elif family == "mpe":
+        from mat_dcml_tpu.envs.mpe import SimpleSpreadConfig, SimpleSpreadEnv
+        env = SimpleSpreadEnv(SimpleSpreadConfig(episode_length=T))
+    else:
+        from mat_dcml_tpu.envs.mamujoco import MJLiteConfig, MJLiteEnv
+        env = MJLiteEnv(MJLiteConfig(episode_length=T))
+    run = RunConfig(algorithm_name="mat", n_rollout_threads=E,
+                    episode_length=T, n_block=1, n_embd=16, n_head=1)
+    policy = build_discrete_policy(run, env)
+    assert RolloutCollector(env, policy, T).jittable

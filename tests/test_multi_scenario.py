@@ -1,0 +1,277 @@
+"""Scenario-as-data subsystem (envs/scenario.py + training/multi_scenario.py).
+
+The contract that makes scenario mixing safe to ship:
+
+- **N=1 is the identity**: wrapping a single scenario must be BIT-exact
+  against the plain env — same key chain (no extra splits), no one-hot
+  columns, identity commit a no-op — so the wrapper can sit in the stack
+  unconditionally without perturbing the validated single-scenario runs.
+- **N>1 is one program**: a 4-scenario DCML family (incl. the PR 9
+  fleet_stress preset) under the donated fused K-step dispatch compiles
+  exactly once and never recompiles in steady state — the scenario id is
+  data, not a trace constant.
+- **Resume is bit-exact**: the emergency carry (resilience.pack_carry /
+  place_carry) roundtrips the scenario leaves (per-slot sid + typed rng key)
+  so a preempted multi-scenario run continues identically.
+- **The eval matrix honors the metrics schema** the CLI validator enforces.
+"""
+
+import importlib.util
+import json
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mat_dcml_tpu.config import RunConfig
+from mat_dcml_tpu.envs.dcml import DCMLEnv, DCMLEnvConfig
+from mat_dcml_tpu.envs.dcml.env import DCMLConsts
+from mat_dcml_tpu.envs.scenario import (
+    DCMLScenarioFamily,
+    ScenarioEnv,
+    ScenarioSet,
+    build_smac_scenario_set,
+    smac_stat_variant,
+    SMACScenarioFamily,
+)
+from mat_dcml_tpu.telemetry import Telemetry, instrumented_jit
+from mat_dcml_tpu.training.base_runner import make_dispatch_fn
+from mat_dcml_tpu.training.multi_scenario import (
+    MultiScenarioDCMLRunner,
+    build_dcml_scenario_env,
+    dcml_fault_presets,
+)
+from mat_dcml_tpu.training.ppo import MATTrainer, PPOConfig
+from mat_dcml_tpu.training.resilience import pack_carry, place_carry
+from mat_dcml_tpu.training.rollout import RolloutCollector
+from mat_dcml_tpu.training.runner import build_mat_policy
+
+_SCHEMA_PATH = Path(__file__).resolve().parent.parent / "scripts" / "check_metrics_schema.py"
+_spec = importlib.util.spec_from_file_location("check_metrics_schema", _SCHEMA_PATH)
+check_metrics_schema = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(check_metrics_schema)
+
+W = 8
+E = 2
+T = 8
+
+
+def _dcml_env():
+    consts = DCMLConsts(worker_number_max=W, sob_dim=W + 2)
+    rng = np.random.default_rng(0)
+    workloads = rng.integers(0, 5, size=(W, consts.local_workload_period)).astype(
+        np.float32)
+    return DCMLEnv(DCMLEnvConfig(consts=consts), base_workloads=workloads)
+
+
+def _ts_fields(ts):
+    return {f: np.asarray(getattr(ts, f))
+            for f in ("obs", "share_obs", "available_actions", "reward",
+                      "done", "delay", "payment")}
+
+
+# --------------------------------------------------------------- N=1 identity
+
+def test_n1_dcml_bit_exact():
+    """ScenarioEnv over a single nominal scenario == the plain env, bit for
+    bit, over reset + a rollout of steps (same keys, same actions)."""
+    env = _dcml_env()
+    senv = build_dcml_scenario_env(_dcml_env(), ["nominal"])
+    assert senv.cond_dim == 0 and senv.obs_dim == env.obs_dim
+
+    key = jax.random.key(7)
+    st_p, ts_p = jax.jit(env.reset)(key, jnp.int32(0))
+    st_s, ts_s = jax.jit(senv.reset)(key, jnp.int32(0))
+    for f, v in _ts_fields(ts_p).items():
+        np.testing.assert_array_equal(v, _ts_fields(ts_s)[f], err_msg=f"reset {f}")
+
+    step_p, step_s = jax.jit(env.step), jax.jit(senv.step)
+    a_rng = np.random.default_rng(1)
+    for i in range(2 * W):     # cross several episode resets (done is frequent)
+        action = jnp.asarray(
+            a_rng.integers(0, env.action_dim, size=(env.n_agents,)), jnp.int32)
+        st_p, ts_p = step_p(st_p, action)
+        st_s, ts_s = step_s(st_s, action)
+        for f, v in _ts_fields(ts_p).items():
+            np.testing.assert_array_equal(v, _ts_fields(ts_s)[f],
+                                          err_msg=f"step {i} {f}")
+    # wrapped env state itself is bit-identical (identity commit is a no-op)
+    for lp, ls in zip(jax.tree.leaves(st_p), jax.tree.leaves(st_s.base)):
+        if jnp.issubdtype(lp.dtype, jax.dtypes.prng_key):
+            lp, ls = jax.random.key_data(lp), jax.random.key_data(ls)
+        np.testing.assert_array_equal(np.asarray(lp), np.asarray(ls))
+
+
+def test_n1_smac_bit_exact():
+    from mat_dcml_tpu.envs.smac.smaclite import SMACLiteConfig, SMACLiteEnv
+
+    env = SMACLiteEnv(SMACLiteConfig(map_name="2m"))
+    base, sset = build_smac_scenario_set(["2m"])
+    senv = ScenarioEnv(base, sset, SMACScenarioFamily)
+    assert senv.cond_dim == 0 and senv.obs_dim == env.obs_dim
+
+    key = jax.random.key(3)
+    st_p, ts_p = jax.jit(env.reset)(key, jnp.int32(0))
+    st_s, ts_s = jax.jit(senv.reset)(key, jnp.int32(0))
+    step_p, step_s = jax.jit(env.step), jax.jit(senv.step)
+    a_rng = np.random.default_rng(2)
+    for i in range(12):
+        avail = np.asarray(ts_p.available_actions)
+        action = jnp.asarray([a_rng.choice(np.nonzero(avail[a])[0])
+                              for a in range(env.n_agents)], jnp.int32)
+        st_p, ts_p = step_p(st_p, action)
+        st_s, ts_s = step_s(st_s, action)
+        for f, v in _ts_fields(ts_p).items():
+            np.testing.assert_array_equal(v, _ts_fields(ts_s)[f],
+                                          err_msg=f"step {i} {f}")
+
+
+# -------------------------------------------------- N>1 fused, one program
+
+def _scenario_components(names=("nominal", "fleet_stress",
+                                "heavy_stragglers", "busy_fleet")):
+    senv = build_dcml_scenario_env(_dcml_env(), list(names))
+    run = RunConfig(algorithm_name="mat", n_rollout_threads=E,
+                    episode_length=T, n_block=1, n_embd=16, n_head=1)
+    policy = build_mat_policy(run, senv)
+    trainer = MATTrainer(policy, PPOConfig(ppo_epoch=2, num_mini_batch=1))
+    collector = RolloutCollector(senv, policy, T)
+    return senv, run, policy, trainer, collector
+
+
+def test_four_scenario_fused_single_compile():
+    senv, run, policy, trainer, collector = _scenario_components()
+    assert senv.cond_dim == 4 and senv.obs_dim == _dcml_env().obs_dim + 4
+    K = 2
+    tel = Telemetry()
+    dispatch = instrumented_jit(make_dispatch_fn(trainer, collector, K),
+                                "dispatch", tel, donate_argnums=(0, 1))
+    ts = trainer.init_state(policy.init_params(jax.random.key(0)))
+    rs = collector.init_state(jax.random.key(1), E)
+    key = jax.random.key(2)
+    ts, rs, key, _ = dispatch(ts, rs, key)
+    dispatch.mark_steady()
+    for _ in range(2):
+        ts, rs, key, _ = dispatch(ts, rs, key)
+    jax.block_until_ready(ts.params)
+    assert dispatch.compile_count == 1
+    assert tel.counters.get("steady_state_recompiles", 0) == 0
+    # the per-slot scenario ids live in the rollout carry and actually mix
+    sids = np.asarray(rs.env_states.sid)
+    assert sids.shape == (E,) and sids.dtype == np.int32
+
+
+def test_fused_resume_bit_exact():
+    """Emergency-carry boundary resume of a multi-scenario fused run: the
+    scenario leaves (sid + typed rng key) roundtrip pack_carry/place_carry
+    and dispatch #2 continues bit-exact."""
+    senv, run, policy, trainer, collector = _scenario_components(
+        ("nominal", "fleet_stress"))
+    K = 2
+    dispatch = jax.jit(make_dispatch_fn(trainer, collector, K),
+                       donate_argnums=(0, 1))
+    ts0 = trainer.init_state(policy.init_params(jax.random.key(0)))
+    rs0 = collector.init_state(jax.random.key(1), E)
+    ts1, rs1, k1, _ = dispatch(ts0, rs0, jax.random.key(42))
+    jax.block_until_ready(ts1)
+    snap = pack_carry(K, ts1, rs1, k1)
+
+    ts2, rs2, k2, _ = dispatch(ts1, rs1, k1)
+    jax.block_until_ready(ts2)
+
+    ts1b, rs1b, k1b = place_carry(snap)
+    ts2b, rs2b, k2b, _ = dispatch(ts1b, rs1b, k1b)
+    jax.block_until_ready(ts2b)
+
+    def raw(x):
+        if jnp.issubdtype(x.dtype, jax.dtypes.prng_key):
+            x = jax.random.key_data(x)
+        return np.asarray(x)
+
+    np.testing.assert_array_equal(raw(k2), raw(k2b), err_msg="key chain")
+    for name, a, b in (("train", ts2, ts2b), ("rollout", rs2, rs2b)):
+        la, lb = jax.tree.leaves(a), jax.tree.leaves(b)
+        assert len(la) == len(lb)
+        for i, (x, y) in enumerate(zip(la, lb)):
+            np.testing.assert_array_equal(raw(x), raw(y),
+                                          err_msg=f"{name} leaf {i}")
+
+
+# ------------------------------------------------------------ eval + schema
+
+def test_eval_matrix_schema(tmp_path):
+    run = RunConfig(algorithm_name="mat", n_rollout_threads=E,
+                    episode_length=T, n_block=1, n_embd=16, n_head=1,
+                    run_dir=str(tmp_path))
+    senv = build_dcml_scenario_env(_dcml_env(),
+                                   ["nominal", "fleet_stress"])
+    runner = MultiScenarioDCMLRunner(run, PPOConfig(ppo_epoch=2,
+                                                    num_mini_batch=1),
+                                     senv, log_fn=lambda *a, **k: None,
+                                     specialist_baselines={"nominal": -1.0})
+    ts, _ = runner.setup()
+    info = runner.evaluate(ts, n_steps=4)
+    for name in ("nominal", "fleet_stress"):
+        for sig in ("reward", "delay", "payment"):
+            assert f"scenario_{name}_{sig}" in info
+    assert info["scenario_count"] == 2.0
+    assert info["scenario_spread"] >= 0.0
+    assert info["scenario_specialist_count"] == 1.0
+    # the record must pass the CLI schema validator verbatim
+    assert check_metrics_schema.validate_record(info) == []
+    # and the family-aggregate contract must trip when incomplete
+    broken = {k: v for k, v in info.items() if k != "scenario_reward_min"}
+    assert any("scenario_reward_min" in e
+               for e in check_metrics_schema.validate_record(broken))
+
+
+def test_unknown_scenario_rejected():
+    with pytest.raises(ValueError, match="unknown DCML scenario"):
+        build_dcml_scenario_env(_dcml_env(), ["nominal", "nope"])
+    presets = dcml_fault_presets(W)
+    assert "fleet_stress" in presets and "nominal" in presets
+
+
+# ------------------------------------------------------- SMAC scenario path
+
+def test_smac_stat_variant_scales():
+    from mat_dcml_tpu.envs.smac.smaclite import SMACLiteConfig, SMACLiteEnv
+
+    env = SMACLiteEnv(SMACLiteConfig(map_name="2m"))
+    base = SMACScenarioFamily.identity(env)
+    hard = smac_stat_variant(env, enemy_hp_scale=2.0)
+    np.testing.assert_allclose(np.asarray(hard.e_hp0),
+                               2.0 * np.asarray(base.e_hp0))
+    # reward normalizer tracks the scaled enemy pool so rewards stay bounded
+    assert float(hard.reward_norm) > float(base.reward_norm)
+
+
+def test_make_multi_map_runner_dispatch(tmp_path):
+    from mat_dcml_tpu.training.smac_runner import (
+        SMACMultiRunner,
+        SMACScenarioRunner,
+        make_multi_map_runner,
+    )
+
+    run = RunConfig(algorithm_name="mat", n_rollout_threads=E,
+                    episode_length=T, n_block=1, n_embd=16, n_head=1,
+                    run_dir=str(tmp_path))
+    ppo = PPOConfig(ppo_epoch=2, num_mini_batch=1)
+    log = lambda *a, **k: None
+    # same-roster pair -> scenario-as-data; heterogeneous -> host cycle
+    r = make_multi_map_runner(run, ppo, ["8m", "3s5z"], log_fn=log)
+    assert isinstance(r, SMACScenarioRunner)
+    assert r.env.cond_dim == 2
+    r2 = make_multi_map_runner(run, ppo, ["3m", "8m"], log_fn=log)
+    assert isinstance(r2, SMACMultiRunner)
+    # per-episode shuffling is out of the scenario wrapper's model
+    r3 = make_multi_map_runner(run, ppo, ["8m", "3s5z"], random_order=True,
+                               log_fn=log)
+    assert isinstance(r3, SMACMultiRunner)
+
+
+def test_heterogeneous_roster_rejected_by_scenario_set():
+    with pytest.raises(ValueError, match="host-cycled"):
+        build_smac_scenario_set(["3m", "8m"])

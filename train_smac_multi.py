@@ -2,14 +2,18 @@
 """Multi-map SMAC training: one MAT policy across several maps.
 
 Equivalent of the reference entry point ``train_smac_multi.py`` (+
-``train_smac_multi.sh`` / ``train_smac_few_shot.sh``): per-map features are
-padded to a universal layout with a task embedding
-(``mat_dcml_tpu/envs/smac/translation.py``), the policy trains round-robin
-across ``--train_maps``, and ``--eval_maps`` may include held-out maps for
-few-shot evaluation.
+``train_smac_multi.sh`` / ``train_smac_few_shot.sh``): same-shape map rosters
+train as a scenario distribution inside ONE compiled program
+(``mat_dcml_tpu/envs/scenario.py`` — map parameters are data in the rollout
+carry, resampled on episode reset), while heterogeneous rosters or
+``--random_order`` fall back to the host-cycled round-robin over per-map
+programs with the universal translated layout
+(``mat_dcml_tpu/envs/smac/translation.py``).  ``--eval_maps`` may include
+held-out maps for few-shot evaluation on the fallback path.
 
 Usage:
   python train_smac_multi.py --train_maps 3m,8m --eval_maps 3m,8m,5m_vs_6m
+  python train_smac_multi.py --train_maps 8m,3s5z        # scenario-as-data
 """
 
 import argparse
@@ -21,7 +25,7 @@ apply_platform_override()
 
 from mat_dcml_tpu.config import parse_cli_with_extras
 from mat_dcml_tpu.envs.smac import map_param_registry
-from mat_dcml_tpu.training.smac_runner import SMACMultiRunner
+from mat_dcml_tpu.training.smac_runner import make_multi_map_runner
 
 
 def _maps(arg: str):
@@ -43,8 +47,8 @@ def main(argv=None):
     })
     train_maps = _maps(ns.train_maps)
     eval_maps = _maps(ns.eval_maps) if ns.eval_maps else train_maps
-    runner = SMACMultiRunner(run, ppo, train_maps=train_maps,
-                             random_order=ns.random_order)
+    runner = make_multi_map_runner(run, ppo, train_maps=train_maps,
+                                   random_order=ns.random_order)
     print(f"algorithm={run.algorithm_name} maps={train_maps} "
           f"episodes={run.episodes} devices={len(__import__('jax').devices())}")
     state, _ = runner.train_loop()
