@@ -96,6 +96,18 @@ Knobs (environment variables):
                         BENCH_FLEET_REPLICAS (1,2,4), BENCH_FLEET_SLO_MS (50),
                         BENCH_FLEET_RUN_DIR (append records to
                         <dir>/metrics.jsonl)
+  BENCH_OBS             "1" → observability overhead A/B: the full observe
+                        plane ON (request tracing at the default 1% sample,
+                        SLO burn monitor, periodic Prometheus-text scrapes of
+                        the merged registries) vs the identical single-replica
+                        fleet with the plane OFF.  Record value = observed
+                        QPS, vs_baseline = on/off QPS ratio (contract:
+                        >= 0.98 — the <=2% overhead budget BENCHLOG pins).
+                        Knobs: BENCH_OBS_REQUESTS (512),
+                        BENCH_OBS_CONCURRENCY (16), BENCH_OBS_BUCKETS
+                        (1,4,16), BENCH_OBS_SAMPLE (0.01),
+                        BENCH_OBS_RUN_DIR (append records + trace.jsonl,
+                        then strict-validate the run dir)
   BENCH_MULTI_SCENARIO  "1" → scenario-as-data overhead A/B: a 4-scenario
                         DCML family (nominal + fleet_stress + straggler
                         mixes, envs/scenario.py) vs the plain single-scenario
@@ -1064,6 +1076,7 @@ def _measure_serving(jax) -> None:
             f"recompiles {rec['steady_state_recompiles']:.0f}")
         if run_dir:
             write_serving_record(run_dir, rec)
+    _validate_run_dir(run_dir)
 
     dev = jax.devices()[0]
     batched, single = legs["batched"], legs["single"]
@@ -1316,6 +1329,176 @@ def _measure_fleet(jax) -> None:
         record[f"r{n}_qps"] = round(scaling[n]["serving_qps"], 2)
         record[f"r{n}_p50_ms"] = round(scaling[n]["serving_p50_ms"], 2)
     print(json.dumps(record), flush=True)
+    _validate_run_dir(run_dir)
+
+
+def _validate_run_dir(run_dir: str) -> bool:
+    """Post-run contract: everything a leg appended to <run_dir> must pass
+    the schema validator in --strict mode (family suffix vocabularies
+    enforced).  Logs each file's verdict; returns overall validity."""
+    if not run_dir:
+        return True
+    try:
+        sys.path.insert(0, os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "scripts"))
+        from check_metrics_schema import discover, validate_file
+    except Exception as e:  # pragma: no cover - import environment drift
+        log(f"schema: validator unavailable: {e!r}")
+        return False
+    from pathlib import Path
+
+    ok = True
+    for path in discover(Path(run_dir)):
+        errs = validate_file(path, strict=True)
+        if errs:
+            ok = False
+            for err in errs[:10]:
+                log(f"schema[{path}]: {err}")
+        else:
+            log(f"schema[{path}]: OK (strict)")
+    return ok
+
+
+def _measure_obs(jax) -> None:
+    """BENCH_OBS=1 leg: observability-plane overhead A/B.
+
+    Both legs run the identical single-replica fleet (same AOT engine, same
+    params, same closed-loop load).  Leg A arms the full observe plane —
+    request tracing at the default 1% sample, the SLO burn-rate monitor fed
+    per request, and a background scraper rendering the merged registries to
+    Prometheus text every 100 ms (far hotter than a real poller's 1-15 s
+    cadence; one render measures ~0.25 ms).  Leg B runs with the plane off.
+    ``vs_baseline`` is the on/off QPS ratio — the <=2% overhead budget the
+    tentpole promises (contract: >= 0.98).
+
+    Each leg runs ``BENCH_OBS_TRIALS`` times in alternating order and the
+    BEST trial per leg is compared.  A shared-CPU container's transient
+    contention only ever *slows* a leg (single-shot ratios here swing
+    0.78-1.04 on identical code), so best-of-N per side is the honest
+    estimate of each configuration's capability."""
+    import threading as _threading
+
+    from mat_dcml_tpu.config import RunConfig
+    from mat_dcml_tpu.envs.dcml import DCMLEnv, DCMLEnvConfig
+    from mat_dcml_tpu.serving.batcher import BatcherConfig
+    from mat_dcml_tpu.serving.engine import EngineConfig
+    from mat_dcml_tpu.serving.fleet import EngineFleet, FleetConfig
+    from mat_dcml_tpu.serving.loadgen import run_load, write_serving_record
+    from mat_dcml_tpu.serving.server import PolicyClient
+    from mat_dcml_tpu.telemetry.slo import SLOConfig, SLOMonitor
+    from mat_dcml_tpu.telemetry.tracing import Tracer
+    from mat_dcml_tpu.training.runner import build_mat_policy
+
+    data_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)), "data")
+    env = DCMLEnv(DCMLEnvConfig(), data_dir=data_dir)
+    policy = build_mat_policy(RunConfig(), env)
+    params = policy.init_params(jax.random.key(0))
+
+    n_req = int(os.environ.get("BENCH_OBS_REQUESTS", "512"))
+    conc = int(os.environ.get("BENCH_OBS_CONCURRENCY", "16"))
+    buckets = tuple(
+        int(b) for b in os.environ.get("BENCH_OBS_BUCKETS", "1,4,16").split(",")
+    )
+    sample = float(os.environ.get("BENCH_OBS_SAMPLE", "0.01"))
+    run_dir = os.environ.get("BENCH_OBS_RUN_DIR", "")
+    # the observed leg must pay REAL trace I/O even without an explicit run
+    # dir, or the A/B under-measures; traces land in a scratch dir then
+    import tempfile
+
+    trace_dir = run_dir or tempfile.mkdtemp(prefix="bench_obs_")
+    trials = int(os.environ.get("BENCH_OBS_TRIALS", "5"))
+
+    def _run_leg(name: str) -> dict:
+        observed = name == "observed"
+        tracer = Tracer(trace_dir, sample=sample) if observed else None
+        slo = SLOMonitor(SLOConfig(latency_p99_ms=250.0)) if observed else None
+        fleet = EngineFleet(
+            params, policy.cfg,
+            fleet_cfg=FleetConfig(n_replicas=1),
+            engine_cfg=EngineConfig(buckets=buckets),
+            batcher_cfg=BatcherConfig(max_batch_wait_ms=2.0),
+            log_fn=lambda *a: None,
+            tracer=tracer,
+            slo_monitor=slo,
+        )
+        fleet.warmup()
+        scrape_stop = _threading.Event()
+        scrapes = [0]
+
+        def _scrape_loop(fl=fleet, stop=scrape_stop, counter=scrapes,
+                         monitor=slo):
+            while not stop.is_set():
+                extra = monitor.gauges() if monitor is not None else None
+                fl.aggregator().prometheus_text(extra_gauges=extra)
+                counter[0] += 1
+                stop.wait(timeout=0.1)
+
+        scraper = None
+        if observed:
+            scraper = _threading.Thread(target=_scrape_loop, daemon=True)
+            scraper.start()
+        rec = run_load(PolicyClient(fleet), n_requests=n_req, concurrency=conc)
+        if scraper is not None:
+            scrape_stop.set()
+            scraper.join(timeout=2.0)
+            rec["obs_metrics_renders"] = scrapes[0]
+            rec["obs_traces_sampled"] = tracer.traces_started
+        rec["steady_state_recompiles"] = fleet.steady_state_recompiles()
+        fleet.close()
+        if tracer is not None:
+            tracer.close()
+        log(f"obs[{name}]: {rec['serving_qps']:.1f} req/s, "
+            f"p50 {rec['serving_p50_ms']:.1f} ms, "
+            f"p99 {rec['serving_p99_ms']:.1f} ms")
+        return rec
+
+    legs = {"observed": [], "plain": []}
+    for trial in range(max(trials, 1)):
+        # alternate leg order so neither side systematically inherits a
+        # cold cache or a neighbour's transient load
+        order = ("observed", "plain") if trial % 2 == 0 else ("plain", "observed")
+        for name in order:
+            legs[name].append(_run_leg(name))
+    best = {name: max(recs, key=lambda r: r["serving_qps"])
+            for name, recs in legs.items()}
+    if run_dir:
+        for rec in best.values():
+            write_serving_record(
+                run_dir,
+                {k: v for k, v in rec.items()
+                 if not k.startswith("obs_")})
+
+    dev = jax.devices()[0]
+    obs_qps = best["observed"]["serving_qps"]
+    plain_qps = best["plain"]["serving_qps"]
+    record = {
+        "metric": "dcml_mat_obs_overhead_qps",
+        "value": round(obs_qps, 2),
+        "unit": "req/s",
+        # on/off ratio of best-of-N trials: the observability tax
+        # (contract >= 0.98)
+        "vs_baseline": round(obs_qps / max(plain_qps, 1e-9), 4),
+        "platform": dev.platform,
+        "device": dev.device_kind,
+        "provisional": False,
+        "buckets": ",".join(str(b) for b in buckets),
+        "requests": n_req,
+        "concurrency": conc,
+        "trials": max(trials, 1),
+        "trace_sample": sample,
+        "plain_qps": round(plain_qps, 2),
+        "observed_qps_all": [round(r["serving_qps"], 1)
+                             for r in legs["observed"]],
+        "plain_qps_all": [round(r["serving_qps"], 1) for r in legs["plain"]],
+        "observed_p50_ms": round(best["observed"]["serving_p50_ms"], 2),
+        "plain_p50_ms": round(best["plain"]["serving_p50_ms"], 2),
+        "observed_p99_ms": round(best["observed"]["serving_p99_ms"], 2),
+        "plain_p99_ms": round(best["plain"]["serving_p99_ms"], 2),
+        "metrics_renders": best["observed"].get("obs_metrics_renders", 0),
+        "traces_sampled": best["observed"].get("obs_traces_sampled", 0),
+        "schema_strict_ok": _validate_run_dir(run_dir),
+    }
+    print(json.dumps(record), flush=True)
 
 
 def _is_oom(e: Exception) -> bool:
@@ -1524,6 +1707,13 @@ def main() -> None:
     if os.environ.get("BENCH_FLEET", "0") == "1":
         jax, _ = _setup_jax()
         _measure_fleet(jax)
+        return
+
+    # Observability-plane overhead A/B: tracing + SLO + /metrics scrapes
+    # on vs off, identical fleet (the <=2% budget BENCHLOG pins)
+    if os.environ.get("BENCH_OBS", "0") == "1":
+        jax, _ = _setup_jax()
+        _measure_obs(jax)
         return
 
     # Speculative-decode A/B: exactness-asserted spec-vs-scan decode timing

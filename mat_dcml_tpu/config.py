@@ -47,6 +47,15 @@ class RunConfig:
     # counters and the recompile detector stay on).  The registry flushes
     # into the jsonl record at every log_interval.
     telemetry_interval: int = 1
+    # rotate metrics.jsonl to metrics.jsonl.1 when it exceeds this size
+    # (MB; 0 = unbounded, the classic behavior)
+    metrics_max_mb: float = 0.0
+    # request-scoped tracing (telemetry/tracing.py): sample this fraction of
+    # training dispatches into <run_dir>/trace.jsonl as span trees (root
+    # "dispatch" with collect/train/fetch/checkpoint children).  0 disables.
+    trace_sample: float = 0.0
+    # rotate trace.jsonl at this size (MB), same scheme as metrics_max_mb
+    trace_max_mb: float = 64.0
     # fused multi-episode dispatch: lax.scan K collect+train iterations inside
     # ONE jitted call with donated train/rollout state, so the host re-enters
     # once per K episodes instead of twice per episode (Podracer-style).  1 =

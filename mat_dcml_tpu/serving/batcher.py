@@ -37,6 +37,7 @@ import numpy as np
 
 from mat_dcml_tpu.serving.engine import DecodeEngine
 from mat_dcml_tpu.telemetry import Telemetry
+from mat_dcml_tpu.telemetry.tracing import TraceContext, Tracer
 
 
 class ServingError(Exception):
@@ -78,6 +79,10 @@ class _Request:
     deadline: Optional[float]     # absolute time.monotonic() or None
     future: Future
     enqueued_at: float
+    trace: Optional[TraceContext] = None  # sampled span tree (or None)
+    owns_trace: bool = False      # minted by this batcher => finished here
+    enqueued_pc: float = 0.0      # perf_counter twin of enqueued_at (spans
+                                  # and trace offsets share one clock)
 
 
 class ContinuousBatcher:
@@ -87,16 +92,19 @@ class ContinuousBatcher:
         cfg: BatcherConfig = BatcherConfig(),
         telemetry: Optional[Telemetry] = None,
         log_fn=print,
+        tracer: Optional[Tracer] = None,
     ):
         self.engine = engine
         self.cfg = cfg
         self.telemetry = telemetry if telemetry is not None else engine.telemetry
         self.log = log_fn
+        self.tracer = tracer
         self._queue: deque[_Request] = deque()
         self._lock = threading.Lock()
         self._not_empty = threading.Condition(self._lock)
         self._closed = False
         self._ema_ms_per_req: Optional[float] = None  # service-time estimate
+        self._ema_queue_wait_ms: Optional[float] = None  # Retry-After source
         self._thread = threading.Thread(
             target=self._dispatch_loop, name="serving-batcher", daemon=True
         )
@@ -110,10 +118,15 @@ class ContinuousBatcher:
         obs: np.ndarray,
         avail: Optional[np.ndarray] = None,
         timeout_s: Optional[float] = None,
+        trace: Optional[TraceContext] = None,
     ) -> Future:
         """Enqueue one joint observation; returns a future resolving to
         ``(action, log_prob)`` numpy arrays (``(A, act_out)``/``(A,
-        act_prob)``), or raising a typed :class:`ServingError`."""
+        act_prob)``), or raising a typed :class:`ServingError`.
+
+        ``trace`` carries a sampled span tree minted at ingress (server or
+        fleet); when None and the batcher owns a tracer, one is minted here so
+        a bare batcher still produces trees."""
         cfg = self.engine.cfg
         state = np.asarray(state, np.float32)
         obs = np.asarray(obs, np.float32)
@@ -133,17 +146,30 @@ class ContinuousBatcher:
                     f"{(cfg.n_agent, cfg.action_dim)}"
                 )
         timeout_s = timeout_s if timeout_s is not None else self.cfg.default_timeout_s
+        # trace ownership: a trace minted HERE is finished here on every exit
+        # path; a foreign trace (fleet/server ingress) is only finished on
+        # success — its owner may retry a failed attempt on a sibling replica
+        # under the same trace id.
+        owns_trace = False
+        if trace is None and self.tracer is not None:
+            trace = self.tracer.start_trace("serving")
+            owns_trace = trace is not None
         now = time.monotonic()
         req = _Request(
             state=state, obs=obs, avail=avail,
             deadline=(now + timeout_s) if timeout_s is not None else None,
-            future=Future(), enqueued_at=now,
+            future=Future(), enqueued_at=now, trace=trace,
+            owns_trace=owns_trace,
+            enqueued_pc=(trace.t0 if owns_trace else time.perf_counter())
+            if trace is not None else 0.0,
         )
         with self._not_empty:
             if self._closed:
                 raise ServingError("batcher is closed")
             if len(self._queue) >= self.cfg.max_queue:
                 self.telemetry.count("serving_shed")
+                if owns_trace:
+                    trace.finish(status="shed")
                 raise QueueFullError(
                     f"queue at capacity ({self.cfg.max_queue}); shedding",
                     retry_after_s=self._retry_after_locked(),
@@ -155,8 +181,14 @@ class ContinuousBatcher:
         return req.future
 
     def _retry_after_locked(self) -> int:
-        """Seconds a shed client should back off: queue depth x the EMA
-        per-request service time, floored at 1s (callers hold ``_lock``)."""
+        """Seconds a shed client should back off, floored at 1s (callers hold
+        ``_lock``).  Primary source: the EMA of *measured* server-side queue
+        wait (what a just-admitted request actually waited before dispatch) —
+        honest under bucket batching, where the old queue-depth x service-time
+        product overestimates by up to the bucket width.  Before any request
+        has been served, fall back to that coarse product."""
+        if self._ema_queue_wait_ms is not None:
+            return max(1, int(self._ema_queue_wait_ms / 1e3 + 0.999))
         ms = self._ema_ms_per_req if self._ema_ms_per_req is not None else 10.0
         est_s = len(self._queue) * ms / 1e3
         return max(1, int(est_s + 0.999))
@@ -224,6 +256,8 @@ class ContinuousBatcher:
                 self.log(f"[serving] dispatcher error: {e!r}")
                 for req in batch:
                     if not req.future.done():
+                        if req.trace is not None and req.owns_trace:
+                            req.trace.finish(status="error")
                         req.future.set_exception(EngineFailureError(repr(e)))
 
     def _expire(self, batch):
@@ -233,6 +267,8 @@ class ContinuousBatcher:
         for req in batch:
             if req.deadline is not None and now > req.deadline:
                 self.telemetry.count("serving_deadline_misses")
+                if req.trace is not None and req.owns_trace:
+                    req.trace.finish(status="deadline")
                 req.future.set_exception(DeadlineExceededError(
                     f"deadline exceeded after {now - req.enqueued_at:.3f}s in queue"
                 ))
@@ -252,17 +288,24 @@ class ContinuousBatcher:
         n = len(batch)
         b = self.engine.bucket_for(n)
         pad = b - n
+        t_assemble = time.perf_counter()
+        now_mono = time.monotonic()
+        waits_ms = [(now_mono - r.enqueued_at) * 1e3 for r in batch]
         state = np.stack([r.state for r in batch] + [batch[-1].state] * pad)
         obs = np.stack([r.obs for r in batch] + [batch[-1].obs] * pad)
         avail = np.stack([r.avail for r in batch] + [batch[-1].avail] * pad)
         t0 = time.perf_counter()
         action, log_prob = self.engine.decode(state, obs, avail)
-        dt = time.perf_counter() - t0
+        t1 = time.perf_counter()
+        dt = t1 - t0
         tel = self.telemetry
-        with self._lock:   # EMA feeds Retry-After; read under the same lock
+        with self._lock:   # EMAs feed Retry-After; read under the same lock
             per_req = dt * 1e3 / max(n, 1)
             self._ema_ms_per_req = per_req if self._ema_ms_per_req is None \
                 else 0.8 * self._ema_ms_per_req + 0.2 * per_req
+            for w in waits_ms:
+                self._ema_queue_wait_ms = w if self._ema_queue_wait_ms is None \
+                    else 0.8 * self._ema_queue_wait_ms + 0.2 * w
         if degraded:
             tel.count("serving_degraded_ok", float(n))
         else:
@@ -270,9 +313,26 @@ class ContinuousBatcher:
             tel.count(f"serving_bucket_{b}")      # bucket-occupancy histogram
             tel.observe("serving_batch_fill", n / b)
             tel.observe("serving_engine_ms", dt * 1e3)
+        for w in waits_ms:
+            tel.hist("serving_queue_wait_ms", w)
         now = time.monotonic()
+        # spans are recorded (and owned traces finished) BEFORE set_result:
+        # done-callbacks run synchronously in set_result, so a fleet owner
+        # finishing the trace must already see the demux span.
+        t_done = time.perf_counter()
         for i, req in enumerate(batch):
             tel.observe("serving_latency_ms", (now - req.enqueued_at) * 1e3)
+            tr = req.trace
+            if tr is not None:
+                # contiguous tiling of [trace start, t_done): the child spans
+                # sum exactly to the root end-to-end (test-pinned invariant)
+                tr.add_span("queue_wait", req.enqueued_pc, t_assemble)
+                tr.add_span("pad", t_assemble, t0, bucket=b, batch=n, pad=pad)
+                tr.add_span("device_decode", t0, t1, bucket=b,
+                            degraded=degraded)
+                tr.add_span("demux", t1, t_done)
+                if req.owns_trace:
+                    tr.finish(end=t_done, status="ok", bucket=b)
             if not req.future.done():
                 req.future.set_result((action[i], log_prob[i]))
 
@@ -296,4 +356,6 @@ class ContinuousBatcher:
                 except Exception as e1:
                     self.telemetry.count("serving_degraded_failed")
                     self.telemetry.count("serving_engine_failures")
+                    if req.trace is not None and req.owns_trace:
+                        req.trace.finish(status="error")
                     req.future.set_exception(EngineFailureError(repr(e1)))

@@ -197,11 +197,14 @@ class DecodeEngine:
         """Run one pre-compiled bucket program.  Inputs must already be padded
         to a bucket size (the batcher's job); a non-bucket batch raises rather
         than silently compiling a new program."""
+        import time
+
         b = state.shape[0]
         if b not in self.engine_cfg.buckets:
             raise ValueError(
                 f"batch {b} is not a compiled bucket {self.engine_cfg.buckets}"
             )
+        t0 = time.perf_counter()
         # capture the resident params ONCE: install_params swaps the attribute
         # atomically, so one dispatch is entirely old or entirely new weights
         params = self._params
@@ -226,9 +229,17 @@ class DecodeEngine:
                       float(np.asarray(stats.verify_passes).mean()))
             tel.gauge("decode_spec_accept_rate",
                       accepted / offered if offered > 0 else 1.0)
-            return np.asarray(action), np.asarray(log_prob)
-        action, log_prob = out
-        return np.asarray(action), np.asarray(log_prob)
+        else:
+            action, log_prob = out
+        result = (np.asarray(action), np.asarray(log_prob))
+        # server-side decode latency sketch, host-materialized (the dispatch
+        # itself is async): every decode path lands here — batcher dispatch,
+        # health probe, canary shadow — but only once the recompile detector
+        # is armed, so warmup compile seconds never poison the p99
+        if self._decode._steady:
+            self.telemetry.hist(
+                "serving_decode_ms", (time.perf_counter() - t0) * 1e3)
+        return result
 
     # ------------------------------------------------------------ accounting
 

@@ -64,7 +64,10 @@ from mat_dcml_tpu.serving.rollout_ctl import (
     RolloutController,
 )
 from mat_dcml_tpu.telemetry import Telemetry
-from mat_dcml_tpu.telemetry.anomaly import rollout_anomaly
+from mat_dcml_tpu.telemetry.aggregate import TelemetryAggregator
+from mat_dcml_tpu.telemetry.anomaly import AnomalyConfig, AnomalyDetector, rollout_anomaly
+from mat_dcml_tpu.telemetry.slo import SLOMonitor
+from mat_dcml_tpu.telemetry.tracing import Tracer
 
 HEALTHY = "healthy"
 UNHEALTHY = "unhealthy"
@@ -126,15 +129,18 @@ class Replica:
 
 
 class _RequestCtx:
-    __slots__ = ("state", "obs", "avail", "timeout_s", "attempts", "tried")
+    __slots__ = ("state", "obs", "avail", "timeout_s", "attempts", "tried",
+                 "trace", "t_ingress")
 
-    def __init__(self, state, obs, avail, timeout_s):
+    def __init__(self, state, obs, avail, timeout_s, trace=None):
         self.state = state
         self.obs = obs
         self.avail = avail
         self.timeout_s = timeout_s
         self.attempts = 0
         self.tried: set = set()
+        self.trace = trace            # sampled span tree; one id across hops
+        self.t_ingress = time.monotonic()
 
 
 def _resolve(fut: Future, result=None, exc: Optional[BaseException] = None) -> None:
@@ -166,6 +172,9 @@ class EngineFleet:
         telemetry: Optional[Telemetry] = None,
         log_fn=print,
         generation: int = 0,
+        tracer: Optional[Tracer] = None,
+        slo_monitor: Optional[SLOMonitor] = None,
+        anomaly_cfg: AnomalyConfig = AnomalyConfig(),
     ):
         self.cfg = cfg
         self.fleet_cfg = fleet_cfg
@@ -173,6 +182,17 @@ class EngineFleet:
         self.rollout_cfg = rollout_cfg
         self.telemetry = telemetry if telemetry is not None else Telemetry()
         self.log = log_fn
+        self.tracer = tracer
+        self.slo = slo_monitor
+        # SLO burns thread through the same detector the trainer uses: budget
+        # exhaustion becomes a typed slo_*_budget anomaly with cooldown, and
+        # a tripped budget gates weight-push promotion.
+        self.anomaly_detector = (
+            AnomalyDetector(anomaly_cfg, telemetry=self.telemetry)
+            if slo_monitor is not None else None)
+        self.anomalies: List[dict] = []
+        self._slo_seen = 0
+        self._slo_check_every = 16    # burn math is O(window); amortize it
         self.current_generation = generation
         self._params_current = params
         self._prior: Optional[Tuple[object, int]] = None
@@ -270,6 +290,7 @@ class EngineFleet:
         obs: np.ndarray,
         avail: Optional[np.ndarray] = None,
         timeout_s: Optional[float] = None,
+        trace=None,
     ) -> Future:
         """Route one joint observation; same contract as
         :meth:`ContinuousBatcher.submit` with fleet semantics on top:
@@ -277,10 +298,16 @@ class EngineFleet:
         replica's queue is full."""
         if self._closed:
             raise ServingError("fleet is closed")
+        if trace is None and self.tracer is not None:
+            trace = self.tracer.start_trace("serving")
         outer: Future = Future()
-        ctx = _RequestCtx(state, obs, avail, timeout_s)
+        ctx = _RequestCtx(state, obs, avail, timeout_s, trace=trace)
         self.telemetry.count("fleet_requests")
-        self._attempt(ctx, outer, first=True)
+        try:
+            self._attempt(ctx, outer, first=True)
+        except ServingError:
+            self._observe_outcome(ctx, ok=False, status="shed")
+            raise
         return outer
 
     def _attempt(self, ctx: _RequestCtx, outer: Future, first: bool = False) -> None:
@@ -301,11 +328,13 @@ class EngineFleet:
                     exc = FleetUnavailableError("no healthy replicas")
                 if first:
                     raise exc    # keep the batcher's synchronous-shed contract
+                self._observe_outcome(ctx, ok=False, status="unplaceable")
                 _resolve(outer, exc=exc)
                 return
             try:
                 inner = replica.batcher.submit(
-                    ctx.state, ctx.obs, ctx.avail, ctx.timeout_s)
+                    ctx.state, ctx.obs, ctx.avail, ctx.timeout_s,
+                    trace=ctx.trace)
             except QueueFullError as e:
                 with self._lock:
                     replica.outstanding -= 1
@@ -325,6 +354,7 @@ class EngineFleet:
             break
 
         t0 = time.monotonic()
+        t0_pc = time.perf_counter()   # span clock twin of t0
         timer: Optional[threading.Timer] = None
         if self.fleet_cfg.request_timeout_s is not None:
             timer = threading.Timer(
@@ -333,26 +363,38 @@ class EngineFleet:
             timer.daemon = True
             timer.start()
         inner.add_done_callback(
-            lambda fut: self._on_done(ctx, outer, replica, fut, t0, timer))
+            lambda fut: self._on_done(ctx, outer, replica, fut, t0, t0_pc, timer))
         if first:
             self._maybe_shadow(ctx, inner, t0)
 
     def _on_done(self, ctx, outer, replica: Replica, inner: Future,
-                 t0: float, timer: Optional[threading.Timer]) -> None:
+                 t0: float, t0_pc: float, timer: Optional[threading.Timer]) -> None:
         if timer is not None:
             timer.cancel()
         with self._lock:
             replica.outstanding -= 1
         exc = inner.exception()
         latency_ms = (time.monotonic() - t0) * 1e3
-        if exc is None:
+        ok = exc is None
+        if ctx.trace is not None:
+            # one hop of the tree: failover retries add further attempt spans
+            # under the same trace id
+            ctx.trace.add_span("attempt", t0_pc, time.perf_counter(),
+                               replica=replica.rid, retry=ctx.attempts,
+                               ok=ok)
+        if ok:
             if (self._controller is not None
                     and replica.rid != self._canary_rid):
                 self._controller._tripwire.observe_incumbent(latency_ms)
+            if not outer.done():   # a raced failover sibling already counted
+                self._observe_outcome(ctx, ok=True, status="ok",
+                                      replica=replica)
             _resolve(outer, result=inner.result())
             return
         if isinstance(exc, DeadlineExceededError):
             # the request's own budget elapsed — retrying can't help
+            self._observe_outcome(ctx, ok=False, status="deadline",
+                                  replica=replica)
             _resolve(outer, exc=exc)
             return
         self._mark_unhealthy(replica, repr(exc))
@@ -375,6 +417,7 @@ class EngineFleet:
         ctx.tried.add(failed.rid)
         if ctx.attempts >= self.fleet_cfg.max_retries:
             self.telemetry.count("fleet_retries_exhausted")
+            self._observe_outcome(ctx, ok=False, status="retries_exhausted")
             _resolve(outer, exc=ServingError(
                 f"request failed on {ctx.attempts + 1} replicas"))
             return
@@ -385,6 +428,52 @@ class EngineFleet:
         timer = threading.Timer(delay, self._attempt, args=(ctx, outer))
         timer.daemon = True
         timer.start()
+
+    # ------------------------------------------------------------ observe/SLO
+
+    def _observe_outcome(self, ctx: _RequestCtx, ok: bool, status: str,
+                         replica: Optional[Replica] = None) -> None:
+        """Terminal accounting for one request: finish its trace, feed the
+        SLO monitor, and (amortized) run the burn-rate tripwires."""
+        if ctx.trace is not None:
+            attrs = {"status": status}
+            if replica is not None:
+                attrs["replica"] = replica.rid
+            ctx.trace.finish(**attrs)
+        if self.slo is None:
+            return
+        latency_ms = (time.monotonic() - ctx.t_ingress) * 1e3
+        self.slo.observe_request(latency_ms, ok=ok)
+        self._slo_seen += 1
+        if self._slo_seen % self._slo_check_every == 0:
+            self.check_slo()
+
+    def check_slo(self) -> List[dict]:
+        """Run the SLO burn gauges through the anomaly detector; returns (and
+        remembers) any typed ``slo_*_budget`` trips.  Also callable by the
+        server's stats path so a quiet fleet still evaluates its windows."""
+        det = self.anomaly_detector
+        if det is None or self.slo is None:
+            return []
+        signals = self.slo.export_into(self.telemetry)
+        trips = det.observe(
+            {k: v for k, v in signals.items() if k.endswith("_burn")},
+            episode=int(self.current_generation),
+            total_steps=int(self.slo.total_requests))
+        out = [a.to_record() for a in trips]
+        for rec in out:
+            self.anomalies.append(rec)
+            self.log(f"[fleet] SLO budget anomaly: {rec['anomaly']} "
+                     f"(burn {rec['value']:.2f})")
+        return out
+
+    def _slo_exhausted(self) -> bool:
+        """Promotion gate: is any combined (multi-window) burn at or past the
+        tripwire threshold right now?"""
+        if self.slo is None or self.anomaly_detector is None:
+            return False
+        thr = self.anomaly_detector.cfg.slo_burn_threshold
+        return any(v >= thr for v in self.slo.burn_signals().values())
 
     # ---------------------------------------------------------------- health
 
@@ -601,6 +690,14 @@ class EngineFleet:
             time.sleep(self.rollout_cfg.synthetic_interval_s)
         verdict = controller.wait(timeout_s=0.0)
 
+        if verdict == PROMOTE and self._slo_exhausted():
+            # an exhausted error budget vetoes promotion even when the canary
+            # itself gated clean: never widen a rollout into a burning fleet
+            self.telemetry.count("rollout_slo_gated")
+            self.log(f"[fleet] push gen {generation}: SLO error budget "
+                     "exhausted — promotion vetoed")
+            verdict = None
+
         summary = controller.summary()
         report["comparisons"] = summary["comparisons"]
         report["mismatches"] = (summary["parity_mismatches"]
@@ -741,6 +838,15 @@ class EngineFleet:
                          for r in self.replicas},
         }
 
+    def aggregator(self) -> TelemetryAggregator:
+        """Read-side merge over the per-replica registries (plus the fleet's
+        own counters) — the source for ``/metrics`` and fleet-wide
+        percentiles."""
+        agg = TelemetryAggregator()
+        for r in self.replicas:
+            agg.add_source(str(r.rid), r.engine.telemetry)
+        return agg
+
     def fleet_record(self) -> Dict[str, float]:
         """Flat metrics.jsonl fragment: the ``fleet_``/``rollout_`` families
         (`scripts/check_metrics_schema.py` REQUIRED_FLEET contract) plus
@@ -779,6 +885,13 @@ class EngineFleet:
             record[f"{prefix}_degraded_ok"] = rc.get("serving_degraded_ok", 0.0)
             record[f"{prefix}_degraded_failed"] = rc.get(
                 "serving_degraded_failed", 0.0)
+        # honest fleet-wide percentiles: merged per-replica sketches, never
+        # averaged per-replica quantiles
+        for name, sk in self.aggregator().merged_hists().items():
+            if sk.count:
+                record.update(sk.snapshot(name))
+        if self.slo is not None:
+            record.update(self.slo.gauges())
         return record
 
     def steady_state_recompiles(self) -> float:

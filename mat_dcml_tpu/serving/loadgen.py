@@ -165,8 +165,15 @@ def run_load(
         record["serving_goodput_qps"] = outcomes["good"] / max(elapsed, 1e-9)
     record.update(percentiles(latencies))
     tel = client.batcher.telemetry
-    # bucket-occupancy histogram + engine-side aggregates ride along
+    # bucket-occupancy histogram + engine-side aggregates ride along —
+    # including the server-side serving_queue_wait_ms/serving_decode_ms
+    # latency sketches, which complement the client-side percentiles above
     record.update(tel.flush())
+    # fleet mode: merged per-replica sketches (honest fleet-wide p50/p95/p99)
+    # plus live SLO burn gauges ride along through fleet_record
+    fleet_rec = getattr(client.batcher, "fleet_record", None)
+    if fleet_rec is not None:
+        record.update(fleet_rec())
     return record
 
 
@@ -206,6 +213,10 @@ def main(argv=None) -> None:
     p.add_argument("--max_batch_wait_ms", type=float, default=2.0)
     p.add_argument("--run_dir", default=None,
                    help="append the record to <run_dir>/metrics.jsonl")
+    p.add_argument("--trace_sample", type=float, default=0.0,
+                   help="trace this fraction of requests to "
+                        "<run_dir>/trace.jsonl (0 disables)")
+    p.add_argument("--trace_max_mb", type=float, default=64.0)
     args = p.parse_args(argv)
 
     engine = DecodeEngine.from_export(
@@ -213,8 +224,15 @@ def main(argv=None) -> None:
         EngineConfig(buckets=tuple(int(b) for b in args.buckets.split(","))),
     )
     engine.warmup()
+    tracer = None
+    if args.trace_sample > 0 and args.run_dir:
+        from mat_dcml_tpu.telemetry.tracing import Tracer
+
+        tracer = Tracer(args.run_dir, sample=args.trace_sample,
+                        max_mb=args.trace_max_mb)
     batcher = ContinuousBatcher(
-        engine, BatcherConfig(max_batch_wait_ms=args.max_batch_wait_ms)
+        engine, BatcherConfig(max_batch_wait_ms=args.max_batch_wait_ms),
+        tracer=tracer,
     )
     client = PolicyClient(batcher)
     record = run_load(

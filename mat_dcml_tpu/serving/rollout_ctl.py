@@ -98,6 +98,10 @@ class RolloutController:
             self.comparisons += 1
             if self.telemetry is not None:
                 self.telemetry.count("rollout_canary_comparisons")
+                # shadow-pair latency sketches: the gate's evidence becomes
+                # scrapeable (/metrics) instead of living only in the verdict
+                self.telemetry.hist("rollout_canary_ms", canary_ms)
+                self.telemetry.hist("rollout_incumbent_ms", incumbent_ms)
             parity_ok = np.array_equal(
                 np.asarray(inc_action), np.asarray(can_action))
             value_ok = bool(np.allclose(

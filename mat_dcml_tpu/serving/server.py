@@ -10,6 +10,9 @@ out-of-process callers; intentionally stdlib-only (no new dependencies):
 - ``GET /healthz``   liveness + warmup state
 - ``GET /stats``     telemetry counter/gauge snapshot taken under the batcher
   lock (queue depth, shed counts, bucket occupancy, recompiles)
+- ``GET /metrics``   Prometheus text exposition (counters, gauges, and the
+  server-side latency summaries — fleet-wide merged across replicas in fleet
+  mode) so a live soak run is scrapeable
 
 Typed rejections map onto HTTP: queue-full -> 429 with a ``Retry-After``
 header derived from queue depth x EMA service time, deadline -> 504, engine
@@ -27,6 +30,7 @@ from __future__ import annotations
 
 import json
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional, Tuple
 
@@ -40,13 +44,23 @@ from mat_dcml_tpu.serving.batcher import (
     ServingError,
 )
 from mat_dcml_tpu.serving.engine import DecodeEngine, EngineConfig
+from mat_dcml_tpu.telemetry.aggregate import TelemetryAggregator
+from mat_dcml_tpu.telemetry.anomaly import AnomalyConfig, AnomalyDetector
+from mat_dcml_tpu.telemetry.slo import SLOConfig, SLOMonitor
+from mat_dcml_tpu.telemetry.tracing import TraceContext, Tracer
 
 
 class PolicyClient:
-    """In-process client: one joint observation in, one joint action out."""
+    """In-process client: one joint observation in, one joint action out.
 
-    def __init__(self, batcher: ContinuousBatcher):
+    ``tracer`` makes this an ingress point: each ``act`` mints a sampled
+    trace that rides through routing/queueing/decode and is finished when the
+    result lands back in the caller's thread."""
+
+    def __init__(self, batcher: ContinuousBatcher,
+                 tracer: Optional[Tracer] = None):
         self.batcher = batcher
+        self.tracer = tracer
 
     def act(
         self,
@@ -54,15 +68,29 @@ class PolicyClient:
         obs,
         available_actions=None,
         timeout_s: Optional[float] = None,
+        trace: Optional[TraceContext] = None,
     ) -> Tuple[np.ndarray, np.ndarray]:
         """Blocking request -> ``(action, log_prob)``; raises the batcher's
         typed :class:`ServingError` subclasses on shed/deadline/failure."""
-        fut = self.batcher.submit(state, obs, available_actions, timeout_s)
-        # the batcher enforces the deadline; the client-side wait gets slack
-        # on top so the typed DeadlineExceededError (not a bare concurrent
-        # .futures timeout) is what surfaces
-        wait = None if timeout_s is None else timeout_s + 5.0
-        return fut.result(timeout=wait)
+        owns = False
+        if trace is None and self.tracer is not None:
+            trace = self.tracer.start_trace("serving")
+            owns = trace is not None
+        try:
+            fut = self.batcher.submit(state, obs, available_actions, timeout_s,
+                                      trace=trace)
+            # the batcher enforces the deadline; the client-side wait gets
+            # slack on top so the typed DeadlineExceededError (not a bare
+            # concurrent.futures timeout) is what surfaces
+            wait = None if timeout_s is None else timeout_s + 5.0
+            result = fut.result(timeout=wait)
+        except BaseException:
+            if owns:
+                trace.finish(status="error")
+            raise
+        if owns:
+            trace.finish(status="ok")
+        return result
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -81,9 +109,20 @@ class _Handler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(body)
 
+    def _reply_text(self, code: int, text: str, content_type: str) -> None:
+        body = text.encode()
+        self.send_response(code)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
     def do_GET(self):
         srv: "PolicyServer" = self.server.policy_server
-        if self.path == "/healthz":
+        if self.path == "/metrics":
+            self._reply_text(200, srv.metrics_text(),
+                             "text/plain; version=0.0.4; charset=utf-8")
+        elif self.path == "/healthz":
             payload = {"ok": True, "warm": srv.warm,
                        "buckets": list(srv.engine.engine_cfg.buckets)}
             if srv.fleet is not None:
@@ -125,22 +164,34 @@ class _Handler(BaseHTTPRequestHandler):
         except (KeyError, ValueError, json.JSONDecodeError) as e:
             self._reply(400, {"error": f"malformed request: {e!r}"})
             return
+        # ingress: mint the (sampled) trace and the SLO latency clock here so
+        # the root span covers parse-to-reply — the server-side end-to-end
+        trace = srv.tracer.start_trace("serving") if srv.tracer else None
+        t0 = time.monotonic()
         try:
-            action, log_prob = srv.client.act(state, obs, avail, timeout_s)
+            action, log_prob = srv.client.act(state, obs, avail, timeout_s,
+                                              trace=trace)
         except QueueFullError as e:
             # a shed client that retries immediately just gets shed again;
-            # the hint is queue depth x EMA service time at shed instant
+            # the hint is the server-side queue-wait EMA at shed instant
+            srv.observe_request(t0, ok=False, trace=trace, status="shed")
             self._reply(429, {"error": str(e), "kind": "queue_full",
                               "retry_after_s": getattr(e, "retry_after_s", 1)},
                         headers={"Retry-After":
                                  str(getattr(e, "retry_after_s", 1))})
         except DeadlineExceededError as e:
+            srv.observe_request(t0, ok=False, trace=trace, status="deadline")
             self._reply(504, {"error": str(e), "kind": "deadline_exceeded"})
         except ValueError as e:
+            # caller bug, not service health: finish the trace, spare the SLO
+            if trace is not None:
+                trace.finish(status="bad_shape")
             self._reply(400, {"error": str(e), "kind": "bad_shape"})
         except Exception as e:  # ServingError + engine failures
+            srv.observe_request(t0, ok=False, trace=trace, status="error")
             self._reply(500, {"error": repr(e), "kind": "engine_failure"})
         else:
+            srv.observe_request(t0, ok=True, trace=trace, status="ok")
             self._reply(200, {"action": action.tolist(),
                               "log_prob": log_prob.tolist()})
 
@@ -195,6 +246,9 @@ class PolicyServer:
         port: int = 8420,
         log_fn=print,
         fleet=None,
+        tracer: Optional[Tracer] = None,
+        slo_monitor: Optional[SLOMonitor] = None,
+        anomaly_cfg: AnomalyConfig = AnomalyConfig(),
     ):
         if (engine is None) == (fleet is None):
             raise ValueError("pass exactly one of engine= or fleet=")
@@ -202,9 +256,20 @@ class PolicyServer:
         if fleet is not None:
             self.engine = fleet.engine     # bucket/config introspection
             self.batcher = fleet           # router IS the batcher interface
+            # the fleet owns tracing/SLO accounting on its own ingress; the
+            # HTTP layer defers to it rather than double-counting
+            self.tracer = tracer if tracer is not None else fleet.tracer
+            self.slo = slo_monitor if slo_monitor is not None else fleet.slo
+            self._slo_detector = fleet.anomaly_detector
         else:
             self.engine = engine
             self.batcher = ContinuousBatcher(engine, batcher_cfg, log_fn=log_fn)
+            self.tracer = tracer
+            self.slo = slo_monitor
+            self._slo_detector = (
+                AnomalyDetector(anomaly_cfg) if slo_monitor is not None else None)
+        self.anomalies: list = []
+        self._slo_seen = 0
         self.client = PolicyClient(self.batcher)
         self.log_fn = log_fn
         self.warm = False
@@ -216,6 +281,42 @@ class PolicyServer:
     @property
     def port(self) -> int:
         return self._httpd.server_address[1]
+
+    # --------------------------------------------------------- observability
+
+    def metrics_text(self) -> str:
+        """Prometheus text for ``GET /metrics``: merged counters/gauges and
+        fleet-wide latency summaries, plus live SLO burn gauges."""
+        agg = TelemetryAggregator()
+        if self.fleet is not None:
+            agg.add_source("fleet", self.fleet.telemetry)
+            for r in self.fleet.replicas:
+                agg.add_source(str(r.rid), r.engine.telemetry)
+        else:
+            agg.add_source("0", self.batcher.telemetry)
+        extra = self.slo.gauges() if self.slo is not None else None
+        return agg.prometheus_text(extra_gauges=extra)
+
+    def observe_request(self, t0: float, ok: bool, trace=None,
+                        status: str = "ok") -> None:
+        """Terminal HTTP-path accounting: finish the ingress trace (idempotent
+        — the fleet may have finished it first) and feed the SLO monitor,
+        unless the fleet already fed this request at its own ingress."""
+        if trace is not None:
+            trace.finish(status=status)
+        if self.slo is None:
+            return
+        if self.fleet is not None and self.fleet.slo is self.slo:
+            return
+        self.slo.observe_request((time.monotonic() - t0) * 1e3, ok=ok)
+        self._slo_seen += 1
+        if self._slo_detector is not None and self._slo_seen % 16 == 0:
+            trips = self._slo_detector.observe(
+                self.slo.burn_signals(), episode=0,
+                total_steps=int(self.slo.total_requests))
+            for a in trips:
+                self.anomalies.append(a.to_record())
+                self.log_fn(f"[serving] SLO budget anomaly: {a.kind}")
 
     def warmup(self) -> None:
         if self.fleet is not None:
@@ -261,7 +362,20 @@ def main(argv=None) -> None:
     p.add_argument("--max_queue", type=int, default=256)
     p.add_argument("--decode_mode", default="scan", choices=("scan", "stride", "spec"))
     p.add_argument("--spec_block", type=int, default=8)
+    p.add_argument("--run_dir", default=None,
+                   help="observability output dir (enables trace.jsonl)")
+    p.add_argument("--trace_sample", type=float, default=0.01,
+                   help="fraction of requests traced (0 disables)")
+    p.add_argument("--trace_max_mb", type=float, default=64.0)
+    p.add_argument("--slo_p99_ms", type=float, default=0.0,
+                   help="latency SLO target for burn-rate tracking; 0 off")
     args = p.parse_args(argv)
+
+    tracer = (Tracer(args.run_dir, sample=args.trace_sample,
+                     max_mb=args.trace_max_mb)
+              if args.run_dir else None)
+    slo = (SLOMonitor(SLOConfig(latency_p99_ms=args.slo_p99_ms))
+           if args.slo_p99_ms > 0 else None)
 
     engine = DecodeEngine.from_export(
         args.policy_dir,
@@ -276,6 +390,7 @@ def main(argv=None) -> None:
         BatcherConfig(max_queue=args.max_queue,
                       max_batch_wait_ms=args.max_batch_wait_ms),
         host=args.host, port=args.port,
+        tracer=tracer, slo_monitor=slo,
     )
     server.start()
     try:

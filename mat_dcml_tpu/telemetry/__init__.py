@@ -8,6 +8,7 @@ Everything is dependency-free and jit-safe — host-side observation happens
 only at call boundaries and flush time, never inside a trace.
 """
 
+from mat_dcml_tpu.telemetry.aggregate import TelemetryAggregator
 from mat_dcml_tpu.telemetry.anomaly import (
     Anomaly,
     AnomalyConfig,
@@ -22,7 +23,7 @@ from mat_dcml_tpu.telemetry.flight_recorder import (
     unpack_tree,
 )
 from mat_dcml_tpu.telemetry.jit_instrument import InstrumentedJit, instrumented_jit
-from mat_dcml_tpu.telemetry.registry import Telemetry
+from mat_dcml_tpu.telemetry.registry import HistogramSketch, Telemetry
 from mat_dcml_tpu.telemetry.scopes import (
     ProbeSink,
     named_scope,
@@ -31,11 +32,13 @@ from mat_dcml_tpu.telemetry.scopes import (
     set_named_scopes,
     set_probe_sink,
 )
+from mat_dcml_tpu.telemetry.slo import SLOConfig, SLOMonitor
 from mat_dcml_tpu.telemetry.system import (
     device_memory_gauges,
     host_rss_bytes,
     replica_hbm_high_water_bytes,
 )
+from mat_dcml_tpu.telemetry.tracing import TraceContext, Tracer
 
 __all__ = [
     "Anomaly",
@@ -43,10 +46,16 @@ __all__ = [
     "AnomalyDetector",
     "DeferredFetch",
     "FlightRecorder",
+    "HistogramSketch",
     "InstrumentedJit",
     "ProbeSink",
     "ProfilerWindow",
+    "SLOConfig",
+    "SLOMonitor",
     "Telemetry",
+    "TelemetryAggregator",
+    "TraceContext",
+    "Tracer",
     "device_memory_gauges",
     "host_rss_bytes",
     "instrumented_jit",

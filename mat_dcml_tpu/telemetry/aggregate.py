@@ -1,0 +1,117 @@
+"""Fleet-wide telemetry aggregation + Prometheus text exposition.
+
+Per-replica :class:`~mat_dcml_tpu.telemetry.registry.Telemetry` registries are
+deliberately isolated (a replica's counters must survive its neighbour's
+crash).  :class:`TelemetryAggregator` is the read-side merge: counters and
+gauges sum across sources (fleet totals), histogram sketches merge exactly —
+so the exported ``serving_decode_ms_p99`` is the honest fleet-wide tail, not
+an average of per-replica p99s.
+
+:meth:`TelemetryAggregator.prometheus_text` renders the merged view in the
+Prometheus text exposition format (version 0.0.4): counters as ``counter``
+with per-replica ``{replica="<label>"}`` breakdowns, gauges as ``gauge``,
+histograms as ``summary`` with ``quantile`` labels.  ``PolicyServer`` serves
+it at ``GET /metrics`` so a live soak run is scrapeable.
+
+Read-only and lock-free: sources are sampled via dict copies, which is safe
+against the recording side's plain assignments.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, Optional, Tuple
+
+from .registry import HistogramSketch, Telemetry
+
+
+def _prom_escape(v: str) -> str:
+    return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+class TelemetryAggregator:
+    """Merges N labelled ``Telemetry`` registries into one fleet view."""
+
+    def __init__(self, sources: Optional[Iterable[Tuple[str, Telemetry]]] = None):
+        self._sources: List[Tuple[str, Telemetry]] = list(sources or [])
+
+    def add_source(self, label: str, tel: Telemetry) -> None:
+        self._sources = [(l, t) for l, t in self._sources if l != label]
+        self._sources.append((str(label), tel))
+
+    @property
+    def sources(self) -> List[Tuple[str, Telemetry]]:
+        return list(self._sources)
+
+    # --------------------------------------------------------------- merging
+
+    def merged_counters(self) -> Dict[str, float]:
+        out: Dict[str, float] = {}
+        for _, tel in self._sources:
+            for name, v in dict(tel.counters).items():
+                out[name] = out.get(name, 0.0) + v
+        return out
+
+    def merged_gauges(self) -> Dict[str, float]:
+        """Gauges sum across replicas — fleet totals (queue depths,
+        outstanding counts).  Non-additive gauges remain readable per-replica
+        in the labelled Prometheus lines."""
+        out: Dict[str, float] = {}
+        for _, tel in self._sources:
+            for name, v in dict(tel._gauges).items():
+                out[name] = out.get(name, 0.0) + v
+        return out
+
+    def merged_hists(self) -> Dict[str, HistogramSketch]:
+        out: Dict[str, HistogramSketch] = {}
+        for _, tel in self._sources:
+            for name, sk in dict(tel.hists).items():
+                agg = out.get(name)
+                if agg is None:
+                    agg = out[name] = HistogramSketch()
+                agg.merge(sk)
+        return out
+
+    def snapshot(self) -> Dict[str, float]:
+        """Flat fleet-wide record fragment: summed counters and gauges plus
+        ``_p50/_p95/_p99/_count/_mean`` for every merged histogram."""
+        rec = self.merged_counters()
+        rec.update(self.merged_gauges())
+        for name, sk in self.merged_hists().items():
+            if sk.count:
+                rec.update(sk.snapshot(name))
+        return rec
+
+    # ------------------------------------------------------------ prometheus
+
+    def prometheus_text(self, extra_gauges: Optional[Dict[str, float]] = None) -> str:
+        lines: List[str] = []
+        counters = self.merged_counters()
+        for name in sorted(counters):
+            lines.append(f"# TYPE {name} counter")
+            lines.append(f"{name} {counters[name]:.6g}")
+            for label, tel in self._sources:
+                v = tel.counters.get(name)
+                if v is not None and len(self._sources) > 1:
+                    lines.append(
+                        f'{name}{{replica="{_prom_escape(label)}"}} {v:.6g}')
+        gauges = self.merged_gauges()
+        for name in sorted(gauges):
+            lines.append(f"# TYPE {name} gauge")
+            lines.append(f"{name} {gauges[name]:.6g}")
+            for label, tel in self._sources:
+                v = tel._gauges.get(name)
+                if v is not None and len(self._sources) > 1:
+                    lines.append(
+                        f'{name}{{replica="{_prom_escape(label)}"}} {v:.6g}')
+        for name, sk in sorted(self.merged_hists().items()):
+            if not sk.count:
+                continue
+            lines.append(f"# TYPE {name} summary")
+            for q in (0.5, 0.95, 0.99):
+                lines.append(f'{name}{{quantile="{q}"}} {sk.quantile(q):.6g}')
+            lines.append(f"{name}_sum {sk.total:.6g}")
+            lines.append(f"{name}_count {sk.count}")
+        for name in sorted(extra_gauges or {}):
+            lines.append(f"# TYPE {name} gauge")
+            lines.append(f"{name} {float(extra_gauges[name]):.6g}")
+        return "\n".join(lines) + "\n"

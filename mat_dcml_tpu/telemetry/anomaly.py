@@ -16,7 +16,13 @@ baseline per signal and trips on:
   perf regression, e.g. a device falling off its fast path;
 - the ``steady_state_recompiles`` counter increasing — the recompile detector
   (jit_instrument.py) already logs the signature diff; the tripwire turns it
-  into a typed record and a captured repro bundle.
+  into a typed record and a captured repro bundle;
+- ``dispatch_fused_fallback`` reaching 1.0 — the fused runner silently
+  degrading to the classic loop is a one-way event and trips exactly once;
+- an SLO error-budget burn gauge (telemetry/slo.py) crossing
+  ``slo_burn_threshold`` — budget exhaustion becomes a typed
+  ``slo_<latency|error|goodput>_budget`` record the rollout controller can
+  gate promotion on.
 
 Trips become :class:`Anomaly` records written into the same metrics.jsonl
 stream (``scripts/check_metrics_schema.py`` has a dedicated ``anomaly``
@@ -35,6 +41,11 @@ from typing import Dict, List, Optional
 
 SPIKE_SIGNALS = ("grad_norm", "param_norm", "update_ratio")
 TIME_SIGNALS = ("step_time_dispatch", "step_time_train", "step_time_collect")
+
+# combined (multi-window) SLO burn gauges from telemetry/slo.py: thresholded,
+# never EMA-baselined — the budget IS the baseline.  A burn >= slo_burn_threshold
+# trips the matching "slo_<x>_budget" kind.
+SLO_SIGNALS = ("slo_latency_burn", "slo_error_burn", "slo_goodput_burn")
 
 # typed rollout anomaly kinds (serving/rollout_ctl.py): a canary or rollback
 # event becomes an Anomaly record in the same metrics.jsonl stream, with the
@@ -59,6 +70,7 @@ class AnomalyConfig:
                                 # trips of the same kind — one bad regime must
                                 # not flood the stream with identical records
     beta: float = 0.9           # EMA decay per observation
+    slo_burn_threshold: float = 1.0  # combined burn >= this exhausts budget
 
 
 @dataclasses.dataclass(frozen=True)
@@ -104,6 +116,7 @@ class AnomalyDetector:
         self._last_trip: Dict[str, int] = {}
         self._unit = 0
         self._recompiles_seen = 0.0
+        self._fallback_tripped = False
 
     # ------------------------------------------------------------- internals
 
@@ -157,14 +170,31 @@ class AnomalyDetector:
                        recompiles, self._recompiles_seen, episode, total_steps)
             self._recompiles_seen = recompiles
 
+        # silent-degradation tripwire: the fused runner falling back to the
+        # classic loop is a one-way event per run, so it trips exactly once
+        # (no cooldown-paced repeats for a gauge that stays pinned at 1.0).
+        fallback = signals.get("dispatch_fused_fallback", 0.0) or 0.0
+        if fallback >= 1.0 and not self._fallback_tripped:
+            self._fallback_tripped = True
+            self._trip(out, "dispatch_fused_fallback", "dispatch_fused_fallback",
+                       fallback, None, episode, total_steps)
+
         for name, value in signals.items():
-            if value is None or name in ("nonfinite_grads", "steady_state_recompiles"):
+            if value is None or name in ("nonfinite_grads",
+                                         "steady_state_recompiles",
+                                         "dispatch_fused_fallback"):
                 continue
             value = float(value)
             if not math.isfinite(value):
                 self._trip(out, "nonfinite_value", name, value, None,
                            episode, total_steps)
                 continue
+            if name in SLO_SIGNALS:
+                if value >= self.cfg.slo_burn_threshold:
+                    self._trip(out, name.replace("_burn", "_budget"), name,
+                               value, self.cfg.slo_burn_threshold,
+                               episode, total_steps)
+                continue  # burn gauges are thresholded, never baselined
             factor = None
             if name in SPIKE_SIGNALS:
                 factor = self.cfg.spike_factor

@@ -17,6 +17,10 @@ Semantics:
   mean is emitted under the bare name plus ``<name>_max`` and ``<name>_sum``,
   then the series resets.
 - **once** values appear in exactly one flush (``flops_per_step``).
+- **histograms** (:meth:`hist`) are cumulative log-spaced sketches emitting
+  ``<name>_p50/_p95/_p99/_count/_mean`` on every flush; sketches from
+  different replicas merge exactly, which is what makes fleet-wide
+  percentiles honest (see :class:`HistogramSketch`).
 
 Per-dispatch rate accounting (``--iters_per_dispatch K > 1``): the fused
 runner counts ``env_steps`` in bursts of ``K * T * E`` when a dispatch's
@@ -35,8 +39,83 @@ anywhere on the host, but never from inside a traced function.
 from __future__ import annotations
 
 import contextlib
+import math
 import time
 from typing import Dict, List, Optional
+
+
+class HistogramSketch:
+    """Mergeable log-spaced histogram for latency quantiles.
+
+    Buckets are geometric: bucket ``i`` covers ``[lo * base**i, lo * base**(i+1))``
+    with ``base ≈ 1.2`` (≤ ~10% relative quantile error), which is what makes
+    per-replica sketches *mergeable* into honest fleet-wide percentiles —
+    unlike averaging per-replica p99s.  Values are clamped into the tracked
+    range; exact observed min/max are kept so tail quantiles never report a
+    value outside what was actually seen.  Cumulative for the life of the run.
+    """
+
+    LO = 1e-3      # 1 microsecond, in ms units
+    BASE = 1.2
+    NBUCKETS = 126  # covers ~1e-3 .. ~8.8e6 ms
+
+    def __init__(self):
+        self.buckets: List[int] = [0] * self.NBUCKETS
+        self.count = 0
+        self.total = 0.0
+        self.vmin = math.inf
+        self.vmax = -math.inf
+
+    def _index(self, value: float) -> int:
+        if value <= self.LO:
+            return 0
+        i = int(math.log(value / self.LO) / math.log(self.BASE))
+        return min(max(i, 0), self.NBUCKETS - 1)
+
+    def add(self, value: float) -> None:
+        v = float(value)
+        if not math.isfinite(v):
+            return
+        self.buckets[self._index(v)] += 1
+        self.count += 1
+        self.total += v
+        self.vmin = min(self.vmin, v)
+        self.vmax = max(self.vmax, v)
+
+    def merge(self, other: "HistogramSketch") -> None:
+        for i, n in enumerate(other.buckets):
+            self.buckets[i] += n
+        self.count += other.count
+        self.total += other.total
+        self.vmin = min(self.vmin, other.vmin)
+        self.vmax = max(self.vmax, other.vmax)
+
+    @property
+    def mean(self) -> float:
+        return self.total / self.count if self.count else 0.0
+
+    def quantile(self, q: float) -> float:
+        if self.count == 0:
+            return 0.0
+        target = q * self.count
+        seen = 0
+        for i, n in enumerate(self.buckets):
+            seen += n
+            if seen >= target:
+                # geometric midpoint of the bucket, clamped to observed range
+                mid = self.LO * (self.BASE ** (i + 0.5))
+                return min(max(mid, self.vmin), self.vmax)
+        return self.vmax
+
+    def snapshot(self, name: str) -> Dict[str, float]:
+        """Flat record fragment: ``<name>_p50/_p95/_p99/_count/_mean``."""
+        return {
+            name + "_p50": self.quantile(0.50),
+            name + "_p95": self.quantile(0.95),
+            name + "_p99": self.quantile(0.99),
+            name + "_count": float(self.count),
+            name + "_mean": self.mean,
+        }
 
 
 class Telemetry:
@@ -47,6 +126,7 @@ class Telemetry:
         self._obs: Dict[str, List[float]] = {}
         self._once: Dict[str, float] = {}
         self._rates: Dict[str, str] = {}            # counter name -> rate name
+        self.hists: Dict[str, HistogramSketch] = {}
         self._last_flush: Optional[float] = None
         self._counters_at_flush: Dict[str, float] = {}
 
@@ -63,6 +143,15 @@ class Telemetry:
     def observe(self, name: str, value: float) -> None:
         if self.enabled:
             self._obs.setdefault(name, []).append(float(value))
+
+    def hist(self, name: str, value: float) -> None:
+        """Record into a mergeable log-spaced histogram (cumulative for the
+        run; flush emits ``<name>_p50/_p95/_p99/_count/_mean``)."""
+        if self.enabled:
+            sk = self.hists.get(name)
+            if sk is None:
+                sk = self.hists[name] = HistogramSketch()
+            sk.add(value)
 
     def once(self, name: str, value: float) -> None:
         """Record a value emitted in the next flush only."""
@@ -110,6 +199,9 @@ class Telemetry:
             rec[name] = sum(series) / len(series)
             rec[name + "_max"] = max(series)
             rec[name + "_sum"] = sum(series)
+        for name, sk in self.hists.items():
+            if sk.count:
+                rec.update(sk.snapshot(name))
         rec.update(self._once)
         self._obs.clear()
         self._once.clear()

@@ -1,0 +1,135 @@
+"""Declared SLOs tracked as multi-window error-budget burn rates.
+
+An SLO declares a budget: "≤1% of requests slower than 250 ms", "≤0.1%
+errors", "≥98% goodput" (ok *and* within the latency target).  The burn rate
+over a window is ``observed_violation_fraction / budget`` — burn 1.0 spends
+the budget exactly as fast as allowed, burn 10 exhausts a 30-day budget in
+3 days.  Following the classic multi-window alerting recipe, each SLO is
+tracked over a *fast* and a *slow* window and the alertable burn is
+``min(fast, slow)``: the slow window proves the regression is sustained, the
+fast window proves it is still happening — so a long-resolved incident or a
+single slow request cannot page.
+
+:class:`SLOMonitor` is fed per-request outcomes from the serving hot path
+(``EngineFleet._on_done`` / ``PolicyServer``), emits ``slo_*`` gauges into the
+metrics stream, and its burn gauges are wired into the existing
+:class:`~mat_dcml_tpu.telemetry.anomaly.AnomalyDetector`, which trips a typed
+``slo_*_budget`` anomaly when a combined burn crosses threshold — the same
+record shape and cooldown discipline as training tripwires, and the signal
+`RolloutController` promotion gates on.
+
+Plain host Python; the injectable ``clock`` keeps burn math deterministic in
+tests.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from collections import deque
+from typing import Callable, Deque, Dict, Optional, Tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class SLOConfig:
+    latency_p99_ms: float = 250.0   # latency SLO: target for the p99
+    latency_budget: float = 0.01    # allowed fraction above target (=> p99)
+    error_budget: float = 0.001     # allowed fraction of failed requests
+    goodput_floor: float = 0.98     # required fraction ok AND within target
+    fast_window_s: float = 60.0
+    slow_window_s: float = 600.0
+    min_requests: int = 20          # below this a window cannot burn
+
+
+class SLOMonitor:
+    """Sliding-window burn-rate accounting over per-request outcomes."""
+
+    def __init__(self, cfg: SLOConfig = SLOConfig(),
+                 clock: Callable[[], float] = time.monotonic):
+        self.cfg = cfg
+        self.clock = clock
+        # (t, latency_violation, error, not_goodput) per request
+        self._events: Deque[Tuple[float, bool, bool, bool]] = deque()
+        self._lock = threading.Lock()
+        self.total_requests = 0
+
+    # ------------------------------------------------------------- recording
+
+    def observe_request(self, latency_ms: float, ok: bool = True) -> None:
+        now = self.clock()
+        slow = float(latency_ms) > self.cfg.latency_p99_ms
+        err = not ok
+        with self._lock:
+            self._events.append((now, slow and ok, err, err or slow))
+            self.total_requests += 1
+            horizon = now - self.cfg.slow_window_s
+            while self._events and self._events[0][0] < horizon:
+                self._events.popleft()
+
+    # --------------------------------------------------------------- reading
+
+    def _window(self, window_s: float, now: float) -> Tuple[int, int, int, int]:
+        lo = now - window_s
+        n = slow = err = bad = 0
+        for t, s, e, b in self._events:
+            if t >= lo:
+                n += 1
+                slow += s
+                err += e
+                bad += b
+        return n, slow, err, bad
+
+    @staticmethod
+    def _burn(violations: int, n: int, budget: float, min_n: int) -> float:
+        if n < max(min_n, 1) or budget <= 0:
+            return 0.0
+        return (violations / n) / budget
+
+    def gauges(self, now: Optional[float] = None) -> Dict[str, float]:
+        """Flat ``slo_*`` gauge fragment for the metrics stream.  The bare
+        ``slo_<x>_burn`` is ``min(fast, slow)`` — the alertable value."""
+        if now is None:
+            now = self.clock()
+        with self._lock:
+            events = list(self._events)
+        cfg = self.cfg
+        out: Dict[str, float] = {}
+        per_window = {}
+        for tag, win in (("fast", cfg.fast_window_s), ("slow", cfg.slow_window_s)):
+            lo = now - win
+            n = slow = err = bad = 0
+            for t, s, e, b in events:
+                if t >= lo:
+                    n += 1
+                    slow += s
+                    err += e
+                    bad += b
+            per_window[tag] = dict(
+                latency=self._burn(slow, n, cfg.latency_budget, cfg.min_requests),
+                error=self._burn(err, n, cfg.error_budget, cfg.min_requests),
+                goodput=self._burn(bad, n, 1.0 - cfg.goodput_floor, cfg.min_requests),
+                n=n,
+            )
+        for slo in ("latency", "error", "goodput"):
+            fast = per_window["fast"][slo]
+            slow_ = per_window["slow"][slo]
+            out[f"slo_{slo}_burn_fast"] = fast
+            out[f"slo_{slo}_burn_slow"] = slow_
+            out[f"slo_{slo}_burn"] = min(fast, slow_)
+        out["slo_window_requests"] = float(per_window["slow"]["n"])
+        return out
+
+    def burn_signals(self, now: Optional[float] = None) -> Dict[str, float]:
+        """The combined-burn subset, shaped for ``AnomalyDetector.observe``."""
+        g = self.gauges(now)
+        return {k: v for k, v in g.items() if k.endswith("_burn")}
+
+    def export_into(self, telemetry, now: Optional[float] = None) -> Dict[str, float]:
+        """Push the current gauges into a ``Telemetry`` registry (so they ride
+        the next flush) and return them."""
+        g = self.gauges(now)
+        if telemetry is not None:
+            for name, v in g.items():
+                telemetry.gauge(name, v)
+        return g

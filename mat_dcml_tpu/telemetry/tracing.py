@@ -1,0 +1,188 @@
+"""Request-scoped tracing: sampled span trees to a bounded ``trace.jsonl``.
+
+A :class:`Tracer` mints a :class:`TraceContext` at ingress (the HTTP handler
+in ``serving/server.py`` or the dispatch boundary in ``training/base_runner``)
+and the context object is threaded through routing → queueing → decode.  Each
+component records *contiguous* child spans against the context — for serving:
+``queue_wait`` ``pad`` ``device_decode`` ``demux`` — so the children exactly
+tile the root ``request`` span and their durations sum to the server-side
+end-to-end latency (the tier-1 invariant pinned in ``tests/test_tracing.py``).
+Retry/failover hops in the fleet record extra ``attempt`` spans under the same
+trace id, so a failed-over request reads as one tree.
+
+Sampling is deterministic counter-based: with ``sample=s`` every
+``round(1/s)``-th started trace is kept, starting with the first, so tests
+and short runs always capture at least one tree and the overhead of a
+non-sampled request is one integer increment.  Records are flat jsonl lines::
+
+    {"trace": "ab12..", "span": "device_decode", "parent": "request",
+     "t_ms": 3.1, "dur_ms": 12.4, "kind": "serving", ...attrs}
+
+``t_ms`` is the offset from trace start.  The file is bounded: when it grows
+past ``max_mb`` it rotates once to ``trace.jsonl.1`` (same policy as
+``MetricsWriter`` rotation).  Encoding reuses the numpy-safe default from
+``utils.metrics`` so device scalars can ride along as span attributes.
+
+Nothing here touches jax; recording is plain host Python, safe from any
+thread, never from inside a traced function.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+import uuid
+from typing import Any, Dict, List, Optional
+
+from ..utils.metrics import _json_default
+
+
+class TraceContext:
+    """One sampled request/dispatch.  Thread-safe; spans may be recorded from
+    the ingress thread, the batcher dispatch thread, and fleet callbacks."""
+
+    def __init__(self, tracer: "Tracer", trace_id: str, kind: str,
+                 root: str = "request"):
+        self._tracer = tracer
+        self.trace_id = trace_id
+        self.kind = kind
+        self.root = root
+        self.t0 = time.perf_counter()
+        self._spans: List[Dict[str, Any]] = []
+        self._lock = threading.Lock()
+        self._finished = False
+
+    def add_span(self, name: str, start: float, end: float,
+                 parent: Optional[str] = None, **attrs: Any) -> None:
+        """Record a span with explicit ``time.perf_counter()`` boundaries.
+        ``parent`` defaults to the root span."""
+        rec = {
+            "trace": self.trace_id,
+            "span": name,
+            "parent": self.root if parent is None else parent,
+            "kind": self.kind,
+            "t_ms": max(0.0, (start - self.t0) * 1e3),
+            "dur_ms": max(0.0, (end - start) * 1e3),
+        }
+        rec.update(attrs)
+        with self._lock:
+            if not self._finished:
+                self._spans.append(rec)
+
+    def span(self, name: str, **attrs: Any):
+        """Context manager measuring a child span around a ``with`` block."""
+        return _SpanTimer(self, name, attrs)
+
+    def finish(self, end: Optional[float] = None, **attrs: Any) -> None:
+        """Close the root span and flush the tree.  Idempotent — error paths
+        and done-callbacks may race; the first finish wins."""
+        with self._lock:
+            if self._finished:
+                return
+            self._finished = True
+            t1 = time.perf_counter() if end is None else end
+            root = {
+                "trace": self.trace_id,
+                "span": self.root,
+                "parent": None,
+                "kind": self.kind,
+                "t_ms": 0.0,
+                "dur_ms": max(0.0, (t1 - self.t0) * 1e3),
+            }
+            root.update(attrs)
+            records = [root] + self._spans
+            self._spans = []
+        self._tracer._write(records)
+
+
+class _SpanTimer:
+    def __init__(self, ctx: TraceContext, name: str, attrs: Dict[str, Any]):
+        self._ctx, self._name, self._attrs = ctx, name, attrs
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self._ctx.add_span(self._name, self._t0, time.perf_counter(),
+                           **self._attrs)
+        return False
+
+
+class Tracer:
+    """Mints sampled trace contexts and owns the bounded ``trace.jsonl``.
+
+    ``sample=0`` disables tracing entirely (``start_trace`` returns ``None``
+    after one integer increment — the fast path the bench A/B measures).
+    """
+
+    def __init__(self, run_dir: Optional[str], sample: float = 0.0,
+                 max_mb: float = 64.0, filename: str = "trace.jsonl"):
+        self.sample = float(sample)
+        self.period = int(round(1.0 / self.sample)) if self.sample > 0 else 0
+        self.max_bytes = int(max_mb * 1024 * 1024) if max_mb else 0
+        self.path = os.path.join(run_dir, filename) if run_dir else None
+        self._n = 0
+        self._bytes = 0
+        self._fh = None
+        self._lock = threading.Lock()
+        self.traces_started = 0
+        self.spans_written = 0
+
+    # ------------------------------------------------------------- sampling
+
+    def start_trace(self, kind: str = "serving", root: str = "request",
+                    trace_id: Optional[str] = None) -> Optional[TraceContext]:
+        """Return a context for every ``period``-th call (first included),
+        ``None`` otherwise."""
+        if self.period <= 0 or self.path is None:
+            return None
+        with self._lock:
+            n = self._n
+            self._n += 1
+        if n % self.period != 0:
+            return None
+        self.traces_started += 1
+        tid = trace_id or uuid.uuid4().hex[:16]
+        return TraceContext(self, tid, kind, root=root)
+
+    # -------------------------------------------------------------- writing
+
+    def _write(self, records: List[Dict[str, Any]]) -> None:
+        if self.path is None:
+            return
+        lines = "".join(
+            json.dumps(r, default=_json_default) + "\n" for r in records
+        )
+        data = lines.encode("utf-8")
+        with self._lock:
+            if self._fh is None:
+                os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+                self._fh = open(self.path, "a")
+                try:
+                    self._bytes = os.path.getsize(self.path)
+                except OSError:
+                    self._bytes = 0
+            if self.max_bytes and self._bytes + len(data) > self.max_bytes:
+                self._rotate_locked()
+            self._fh.write(lines)
+            self._fh.flush()
+            self._bytes += len(data)
+            self.spans_written += len(records)
+
+    def _rotate_locked(self) -> None:
+        self._fh.close()
+        rotated = self.path + ".1"
+        if os.path.exists(rotated):
+            os.remove(rotated)
+        os.replace(self.path, rotated)
+        self._fh = open(self.path, "a")
+        self._bytes = 0
+
+    def close(self) -> None:
+        with self._lock:
+            if self._fh is not None:
+                self._fh.close()
+                self._fh = None

@@ -30,6 +30,7 @@ from mat_dcml_tpu.telemetry import (
     InstrumentedJit,
     ProfilerWindow,
     Telemetry,
+    Tracer,
     device_memory_gauges,
     host_rss_bytes,
     instrumented_jit,
@@ -272,7 +273,18 @@ class BaseRunner:
             use_wandb=run.use_wandb,
             wandb_project=run.wandb_project,
             run_name=f"{run.env_name}/{run.scenario}/{run.algorithm_name}/{run.experiment_name}",
+            max_mb=getattr(run, "metrics_max_mb", 0.0),
         )
+        # dispatch-granularity span traces (telemetry/tracing.py): the
+        # training counterpart of the serving request traces — root
+        # "dispatch", children collect/train/fetch/checkpoint — sampled into
+        # <run_dir>/trace.jsonl next to metrics.jsonl
+        self.tracer = (
+            Tracer(self.run_dir, sample=run.trace_sample,
+                   max_mb=getattr(run, "trace_max_mb", 64.0))
+            if getattr(run, "trace_sample", 0.0) > 0 else None
+        )
+        self._fused_fallback = 0.0
         self.start_episode = 0
 
     # ------------------------------------------------------------------ setup
@@ -449,10 +461,12 @@ class BaseRunner:
                 # 1.0 = fused dispatch was requested but fell back to the
                 # classic loop, 0.0 = the fused path actually ran
                 if not getattr(self.collector, "jittable", True):
+                    self._fused_fallback = 1.0
                     self.telemetry.gauge("dispatch_fused_fallback", 1.0)
                     self.log("[dispatch] collector is host-driven (jittable=False); "
                              "--iters_per_dispatch ignored")
                 elif not hasattr(self.trainer, "train_iteration"):
+                    self._fused_fallback = 1.0
                     self.telemetry.gauge("dispatch_fused_fallback", 1.0)
                     self.log(f"[dispatch] {type(self.trainer).__name__} has no "
                              f"train_iteration; --iters_per_dispatch ignored")
@@ -477,6 +491,8 @@ class BaseRunner:
             # a tripwire profiler window still open at exit — normal return OR
             # a crash mid-run — must stop its trace or the xplane.pb is corrupt
             self.profile_window.close()
+            if self.tracer is not None:
+                self.tracer.close()
             # saves are async (checkpoint.py): the loop's last scheduled save
             # must land before the run dir is read (resume, serving export) —
             # and so a clean shutdown never leaves a half-written step
@@ -525,14 +541,23 @@ class BaseRunner:
             # flight recorder: the iteration's inputs, including the pre-split
             # key, so a bundle replays this episode from here
             self.flight.snapshot(episode, train_state, rollout_state, key)
+            # sampled span trace for this episode (Tracer does its own
+            # deterministic sampling); a live trace forces the phase syncs so
+            # its collect/train spans measure real wall time, same cost as a
+            # sampled-telemetry episode
+            trace = (self.tracer.start_trace("training", root="dispatch")
+                     if self.tracer is not None else None)
             if profiling:
                 jax.profiler.start_trace(run.profile_dir)
             try:
                 t_collect = time.perf_counter()
                 rollout_state, traj = self._collect(train_state.params, rollout_state)
-                if profiling or sampled:
+                if profiling or sampled or trace is not None:
                     jax.block_until_ready(traj)
-                    t_collect = time.perf_counter() - t_collect
+                    t_end = time.perf_counter()
+                    if trace is not None:
+                        trace.add_span("collect", t_collect, t_end)
+                    t_collect = t_end - t_collect
                     if sampled:
                         tel.observe("step_time_collect", t_collect)
                 key, k_train = jax.random.split(key)
@@ -540,9 +565,12 @@ class BaseRunner:
                 train_state, metrics = self._train(
                     train_state, traj, self._bootstrap(rollout_state), k_train
                 )
-                if profiling or sampled:
+                if profiling or sampled or trace is not None:
                     jax.block_until_ready(train_state)
-                    t_train = time.perf_counter() - t_train
+                    t_end = time.perf_counter()
+                    if trace is not None:
+                        trace.add_span("train", t_train, t_end)
+                    t_train = t_end - t_train
                     if sampled:
                         tel.observe("step_time_train", t_train)
             finally:
@@ -567,12 +595,15 @@ class BaseRunner:
             if sampled:
                 # one small blocking fetch covers the NaN guard AND the
                 # tripwire signals
+                t_fetch = time.perf_counter()
                 health = jax.device_get({
                     "nonfinite_grads": getattr(metrics, "nonfinite_grads", 0.0),
                     "grad_norm": getattr(metrics, "grad_norm", 0.0),
                     "param_norm": getattr(metrics, "param_norm", 0.0),
                     "update_ratio": getattr(metrics, "update_ratio", 0.0),
                 })
+                if trace is not None:
+                    trace.add_span("fetch", t_fetch, time.perf_counter())
                 nf = float(np.sum(np.asarray(health["nonfinite_grads"])))
                 tel.count("nonfinite_grad_steps", nf)
                 if self.anomaly is not None:
@@ -583,6 +614,7 @@ class BaseRunner:
                         "update_ratio": float(np.max(np.asarray(health["update_ratio"]))),
                         "steady_state_recompiles":
                             tel.counters.get("steady_state_recompiles", 0.0),
+                        "dispatch_fused_fallback": self._fused_fallback,
                         "step_time_collect": t_collect,
                         "step_time_train": t_train,
                     }
@@ -695,7 +727,14 @@ class BaseRunner:
                 episode % run.save_interval == 0 or episode == episodes - 1
             )
             if should_save and self.run_cfg.algorithm_name != "random":
+                t_ckpt = time.perf_counter()
                 self.ckpt.save(episode, train_state)
+                if trace is not None:
+                    # saves are async — this span is the host-side schedule
+                    # cost, what the training loop actually pays
+                    trace.add_span("checkpoint", t_ckpt, time.perf_counter())
+            if trace is not None:
+                trace.finish(status="ok", episode=episode)
 
             if run.use_eval and episode % run.eval_interval == 0 and hasattr(self, "evaluate"):
                 # each runner's evaluate has protocol-appropriate defaults
@@ -742,7 +781,7 @@ class BaseRunner:
         tel.start_interval()
         start = time.time()
 
-        def process(d, ep_last, fetch, t_launch):
+        def process(d, ep_last, fetch, t_launch, trace):
             # blocks only on compute still in flight for THIS dispatch — the
             # next one is already enqueued, so the device never idles on the
             # host-side formatting below
@@ -754,8 +793,16 @@ class BaseRunner:
                 # count it, log it, and skip this dispatch's bookkeeping
                 tel.count("deferred_fetch_errors")
                 self.log(f"[telemetry] deferred fetch failed for dispatch {d}: {e!r}")
+                if trace is not None:
+                    trace.finish(status="error", episode=ep_last)
                 return
             t_done = time.perf_counter()
+            if trace is not None:
+                # fused collect+train is one program: "dispatch" spans
+                # launch -> results-landed; "fetch" is the host-block tail
+                trace.add_span("dispatch", t_launch, t_done, iters=K)
+                trace.add_span("fetch", t_get, t_done)
+                trace.finish(end=t_done, status="ok", episode=ep_last)
             timed = run.telemetry_interval > 0 and d % run.telemetry_interval == 0
             if timed:
                 # sync-free derived timer: get() returns when this dispatch's
@@ -783,6 +830,7 @@ class BaseRunner:
                         getattr(metrics, "update_ratio", 0.0)))),
                     "steady_state_recompiles":
                         tel.counters.get("steady_state_recompiles", 0.0),
+                    "dispatch_fused_fallback": self._fused_fallback,
                 }
                 if timed:
                     signals["step_time_dispatch"] = t_done - t_launch
@@ -857,7 +905,7 @@ class BaseRunner:
                 self.writer.write(eval_info, step=(ep_last + 1) * T * E)
                 self.log(f"eval ep {ep_last}: {eval_info}")
 
-        pending = None            # (d, ep_last, fetch, t_launch) in flight
+        pending = None       # (d, ep_last, fetch, t_launch, trace) in flight
         for d in range(n_disp):
             ep0 = first + d * K
             # graceful stop lands HERE: the carry is whole (outputs of
@@ -868,7 +916,14 @@ class BaseRunner:
             # checkpoint/eval for the previous dispatch boundary must run
             # BEFORE this dispatch donates (invalidates) train_state's buffers
             if d > 0:
+                t_ckpt = time.perf_counter()
                 boundary(ep0 - K, ep0 - 1, train_state, final=False)
+                if pending is not None and pending[4] is not None:
+                    # the boundary belongs to the PREVIOUS dispatch's episodes
+                    # — attach its span there (process() finishes that trace
+                    # a few lines below, after this dispatch launches)
+                    pending[4].add_span("checkpoint", t_ckpt,
+                                        time.perf_counter())
             # snapshot-before-donate: the dispatch about to launch invalidates
             # these buffers, and its metrics are only inspected one dispatch
             # later — the ring (depth >= 2) is what still holds this state
@@ -879,6 +934,8 @@ class BaseRunner:
             self.watchdog.arm(ep0, train_state, rollout_state, key)
             profiling = (run.profile_dir is not None and d == 1
                          and not self.profile_window.active)
+            trace = (self.tracer.start_trace("training", root="dispatch")
+                     if self.tracer is not None else None)
             if profiling:
                 jax.profiler.start_trace(run.profile_dir)
             try:
@@ -907,10 +964,13 @@ class BaseRunner:
                                        # one large fused warmup compile
             if pending is not None:
                 process(*pending)      # overlaps dispatch d running on device
-            pending = (d, ep0 + K - 1, fetch, t_launch)
+            pending = (d, ep0 + K - 1, fetch, t_launch, trace)
 
+        t_ckpt = time.perf_counter()
         boundary(first + (n_disp - 1) * K, first + n_disp * K - 1, train_state,
                  final=True)
+        if pending[4] is not None:
+            pending[4].add_span("checkpoint", t_ckpt, time.perf_counter())
         process(*pending)
         return train_state, rollout_state
 

@@ -6,11 +6,18 @@ machine-readable jsonl stream is primary (it is what the tests and benchmark
 tooling consume), with scalar mirrors to TensorBoard
 (``<run_dir>/logs``, via torch's bundled SummaryWriter) and/or wandb when
 requested — both degrade to a one-line warning if the backend is missing.
+
+``max_mb > 0`` bounds the jsonl: when the file would grow past the cap it
+rotates once to ``metrics.jsonl.1`` (replacing any previous rotation) and a
+fresh file is started, so a 24h soak keeps at most ~2x ``max_mb`` on disk.
+``scripts/check_metrics_schema.py`` validates rotated files alongside the
+live one.
 """
 
 from __future__ import annotations
 
 import json
+import os
 from pathlib import Path
 from typing import Optional
 
@@ -56,11 +63,15 @@ class MetricsWriter:
         wandb_project: str = "mat_dcml_tpu",
         run_name: Optional[str] = None,
         enabled: bool = True,
+        max_mb: float = 0.0,
     ):
-        """``enabled=False`` turns every sink off (non-primary hosts)."""
+        """``enabled=False`` turns every sink off (non-primary hosts).
+        ``max_mb > 0`` enables size-based rotation to ``<jsonl_name>.1``."""
         self.run_dir = Path(run_dir)
         self.jsonl_path = self.run_dir / jsonl_name
         self.enabled = enabled
+        self.max_bytes = int(max_mb * 1024 * 1024) if max_mb else 0
+        self._bytes = 0
         self._tb = None
         self._wandb = None
         self._file = None          # lazy persistent jsonl handle (one open)
@@ -89,8 +100,16 @@ class MetricsWriter:
         if self._file is None or self._file.closed:
             self.jsonl_path.parent.mkdir(parents=True, exist_ok=True)
             self._file = open(self.jsonl_path, "a")
-        self._file.write(json.dumps(record, default=_json_default) + "\n")
+            try:
+                self._bytes = os.path.getsize(self.jsonl_path)
+            except OSError:
+                self._bytes = 0
+        line = json.dumps(record, default=_json_default) + "\n"
+        if self.max_bytes and self._bytes + len(line) > self.max_bytes:
+            self._rotate()
+        self._file.write(line)
         self._file.flush()
+        self._bytes += len(line)
         step = step if step is not None else record.get("total_steps", record.get("episode"))
         if step is not None and not isinstance(step, int):
             step = int(step)
@@ -100,6 +119,17 @@ class MetricsWriter:
                 self._tb.add_scalar(k, v, global_step=step)
         if self._wandb is not None:
             self._wandb.log(scalars, step=step)
+
+    def _rotate(self) -> None:
+        """Close, move the full file to ``<name>.1`` (replacing any earlier
+        rotation), and reopen fresh — the stream keeps appending unchanged."""
+        self._file.close()
+        rotated = str(self.jsonl_path) + ".1"
+        if os.path.exists(rotated):
+            os.remove(rotated)
+        os.replace(self.jsonl_path, rotated)
+        self._file = open(self.jsonl_path, "a")
+        self._bytes = 0
 
     def close(self) -> None:
         if self._file is not None:

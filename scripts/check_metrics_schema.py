@@ -14,7 +14,20 @@ contract BENCH tooling and tests consume; this validator keeps it honest:
   growing unconsumed keys.
 
 Usage:
-    python scripts/check_metrics_schema.py <metrics.jsonl | run_dir>
+    python scripts/check_metrics_schema.py [--strict] <metrics.jsonl | run_dir>
+
+A directory argument validates every ``metrics.jsonl`` under it plus any
+rotated ``metrics.jsonl.1`` siblings (utils/metrics.py ``--metrics_max_mb``)
+and any ``trace.jsonl``/``trace.jsonl.1`` span streams (telemetry/tracing.py)
+— trace records are identified by their ``trace`` field and validated against
+the span schema, so the two streams may even share a file.
+
+``--strict`` additionally enforces the per-family suffix vocabularies: by
+default a key under a known prefix (``serving_``, ``fleet_``, ...) passes with
+ANY suffix, which catches a brand-new family but not a typo inside one
+(``serving_deadlnie_misses``).  Strict mode matches each family against the
+documented vocabulary regex and returns nonzero on anything else — bench legs
+run post-run validation in this mode.
 
 Exit 0 when valid; exit 1 with one line per violation otherwise.  Importable:
 ``validate_record`` / ``validate_file`` are used by tests/test_telemetry.py.
@@ -24,6 +37,7 @@ from __future__ import annotations
 
 import json
 import math
+import re
 import sys
 from pathlib import Path
 from typing import List
@@ -108,7 +122,48 @@ KNOWN_PREFIXES = (
     # _specialist_count/_generalist_gap).  NOT in the blanket non-negative
     # set: DCML per-scenario rewards are negative costs.
     "scenario_",
+    # SLO burn-rate gauges (telemetry/slo.py SLOMonitor.gauges): per-objective
+    # multi-window error-budget burn rates (slo_<obj>_burn/_burn_fast/
+    # _burn_slow for latency/error/goodput) plus the window request count
+    "slo_",
 )
+
+# registry suffixes a histogram sketch appends on flush (registry.py
+# HistogramSketch.snapshot); observations append _max/_sum
+_HIST_SUFFIXES = ("_p50", "_p95", "_p99", "_count", "_mean")
+
+# --strict: per-family suffix vocabularies.  A key under one of these
+# prefixes must match the family's regex; families without an entry
+# (eval_, step_time_, ... — genuinely open) stay prefix-only.
+STRICT_FAMILY_PATTERNS = {
+    "serving_": re.compile(
+        r"^serving_(qps|offered_qps|ok|wall_s|slo_ms|goodput_slo|goodput_qps"
+        r"|p50_ms|p95_ms|p99_ms|shed_rate|deadline_miss_rate|error_rate"
+        r"|buckets|weight_swaps|shed|requests|queue_depth|deadline_misses"
+        r"|degraded_ok|degraded_batches|degraded_failed|engine_failures"
+        r"|batches|bucket_\d+|batch_fill|engine_ms|latency_ms|queue_wait_ms"
+        r"|decode_ms)(_max|_sum|_p50|_p95|_p99|_count|_mean)?$"),
+    "fleet_": re.compile(
+        r"^fleet_(replicas|healthy|requests|retries|retries_exhausted"
+        r"|attempt_timeouts|shed|no_healthy|unhealthy_marks|readmissions"
+        r"|probe_failures|generation|stress"
+        r"|replica_\d+_(state|outstanding|generation|recompiles|served"
+        r"|degraded_ok|degraded_failed))$"),
+    "rollout_": re.compile(
+        r"^rollout_(pushes|rollbacks|slo_gated|canary_comparisons"
+        r"|canary_mismatches"
+        r"|(canary|incumbent)_ms(_p50|_p95|_p99|_count|_mean))$"),
+    "shard_": re.compile(
+        r"^shard_(count|data|seq|psum_count|hbm_high_water_bytes"
+        r"|bytes_per_[a-z_]+)$"),
+    "resilience_": re.compile(
+        r"^resilience_(snapshots|emergency_saves|quarantined_steps"
+        r"|deadline_overruns|dispatch_failures|dispatch_retries"
+        r"|stop_latency_s)$"),
+    "slo_": re.compile(
+        r"^slo_((latency|error|goodput)_burn(_fast|_slow)?"
+        r"|window_requests)$"),
+}
 
 # fields that must never go negative (counters, rates, timers, gauges)
 NON_NEGATIVE = (
@@ -180,14 +235,25 @@ REQUIRED_TELEMETRY_FUSED = (
 def _known(name: str) -> bool:
     if name in KNOWN_FIELDS:
         return True
+    # prefix families match the FULL name first: scenario_count / shard_count
+    # are family members whose tail happens to collide with a hist suffix
+    if any(name.startswith(p) for p in KNOWN_PREFIXES):
+        return True
     base = name
-    for suffix in ("_max", "_sum"):
+    for suffix in ("_max", "_sum") + _HIST_SUFFIXES:
         if base.endswith(suffix):
             base = base[: -len(suffix)]
             break
-    if base in KNOWN_FIELDS:
-        return True
-    return any(base.startswith(p) for p in KNOWN_PREFIXES)
+    return base in KNOWN_FIELDS
+
+
+def _strict_ok(name: str) -> bool:
+    """--strict: a key under a vocabulary-bearing family must match the
+    family's documented pattern (typos inside a known family fail here)."""
+    for prefix, pattern in STRICT_FAMILY_PATTERNS.items():
+        if name.startswith(prefix):
+            return pattern.match(name) is not None
+    return True
 
 
 # anomaly records (telemetry/anomaly.py Anomaly.to_record) are the one
@@ -233,6 +299,53 @@ def _validate_anomaly(record, where: str) -> List[str]:
     return errs
 
 
+# span records (telemetry/tracing.py TraceContext): one flat line per span,
+# identified by the "trace" id field.  Another sanctioned string-bearing
+# record: trace/span/kind/parent are strings, t_ms/dur_ms are the numeric
+# payload, and arbitrary attrs (status, replica, bucket, ok, ...) ride along
+# as strings, booleans, or finite numbers.
+TRACE_REQUIRED = ("trace", "span", "kind", "t_ms", "dur_ms")
+_TRACE_SPAN_RE = re.compile(r"^[a-z][a-z0-9_]*$")
+
+
+def _validate_trace(record, where: str) -> List[str]:
+    errs: List[str] = []
+    for k in TRACE_REQUIRED:
+        if k not in record:
+            errs.append(f"{where}: trace record missing {k!r}")
+    for k in ("trace", "span", "kind"):
+        v = record.get(k)
+        if v is not None and not isinstance(v, str):
+            errs.append(f"{where}: trace field {k!r} must be a string")
+    span = record.get("span")
+    if isinstance(span, str) and not _TRACE_SPAN_RE.match(span):
+        errs.append(f"{where}: trace span name {span!r} is not a "
+                    f"lower_snake_case identifier")
+    parent = record.get("parent")
+    if parent is not None and not isinstance(parent, str):
+        errs.append(f"{where}: trace field 'parent' must be a string or null "
+                    f"(null = the root span)")
+    for k in ("t_ms", "dur_ms"):
+        v = record.get(k)
+        if v is None:
+            continue
+        if isinstance(v, bool) or not isinstance(v, (int, float)):
+            errs.append(f"{where}: trace field {k!r} is not numeric")
+        elif not math.isfinite(v) or v < 0:
+            errs.append(f"{where}: trace field {k!r} must be finite and "
+                        f"non-negative, got {v}")
+    for k, v in record.items():
+        if k in TRACE_REQUIRED or k == "parent":
+            continue
+        if isinstance(v, str) or isinstance(v, bool):
+            continue  # span attrs may carry status strings / flags
+        if not isinstance(v, (int, float)):
+            errs.append(f"{where}: trace attr {k!r} is {type(v).__name__}")
+        elif not math.isfinite(v):
+            errs.append(f"{where}: trace attr {k!r} is non-finite ({v})")
+    return errs
+
+
 # emergency-checkpoint records (base_runner._graceful_stop_check /
 # _emergency_on_failure): like anomaly records, a typed exception to the
 # numbers-only rule — the marker field carries the stop reason as a string.
@@ -266,7 +379,8 @@ def _validate_emergency(record, where: str) -> List[str]:
     return errs
 
 
-def validate_record(record, index: int = 0, strict_names: bool = True) -> List[str]:
+def validate_record(record, index: int = 0, strict_names: bool = True,
+                    strict: bool = False) -> List[str]:
     """Errors for one parsed jsonl record (empty list = valid)."""
     errs: List[str] = []
     where = f"record {index}"
@@ -278,6 +392,9 @@ def validate_record(record, index: int = 0, strict_names: bool = True) -> List[s
     if "emergency_checkpoint" in record:
         # typed emergency-checkpoint record — ditto
         return _validate_emergency(record, where)
+    if "trace" in record:
+        # span record (trace.jsonl; may interleave in mixed fixtures) — ditto
+        return _validate_trace(record, where)
     for k, v in record.items():
         if isinstance(v, bool):
             errs.append(f"{where}: field {k!r} is a boolean (flags must not "
@@ -291,13 +408,16 @@ def validate_record(record, index: int = 0, strict_names: bool = True) -> List[s
             continue
         if (k in NON_NEGATIVE
                 or k.startswith(("serving_", "fleet_", "rollout_", "shard_",
-                                 "resilience_"))) and v < 0:
+                                 "resilience_", "slo_"))) and v < 0:
             errs.append(f"{where}: field {k!r} is negative ({v})")
         if k in UNIT_INTERVAL and not (0.0 <= v <= 1.0):
             errs.append(f"{where}: field {k!r} must be in [0, 1], got {v}")
         if strict_names and not _known(k):
             errs.append(f"{where}: unknown field {k!r} — document it in "
                         f"README.md and scripts/check_metrics_schema.py")
+        elif strict and not _strict_ok(k):
+            errs.append(f"{where}: field {k!r} is not in its family's "
+                        f"documented vocabulary (--strict)")
     if "serving_qps" in record:  # serving benchmark record
         for k in REQUIRED_SERVING:
             if k not in record:
@@ -322,8 +442,9 @@ def validate_record(record, index: int = 0, strict_names: bool = True) -> List[s
     return errs
 
 
-def validate_file(path, strict_names: bool = True) -> List[str]:
-    """Errors for a whole metrics.jsonl (empty list = valid)."""
+def validate_file(path, strict_names: bool = True,
+                  strict: bool = False) -> List[str]:
+    """Errors for a whole metrics.jsonl / trace.jsonl (empty list = valid)."""
     errs: List[str] = []
     n = 0
     with open(path) as f:
@@ -336,20 +457,36 @@ def validate_file(path, strict_names: bool = True) -> List[str]:
             except json.JSONDecodeError as e:
                 errs.append(f"record {i}: invalid JSON ({e})")
                 continue
-            errs.extend(validate_record(record, i, strict_names=strict_names))
+            errs.extend(validate_record(record, i, strict_names=strict_names,
+                                        strict=strict))
     if n == 0:
         errs.append(f"{path}: no records")
     return errs
 
 
+def discover(target: Path) -> List[Path]:
+    """Every validatable stream under a run directory: metrics.jsonl and
+    trace.jsonl plus their rotated ``.1`` predecessors."""
+    hits: List[Path] = []
+    for name in ("metrics.jsonl", "trace.jsonl"):
+        for p in sorted(target.rglob(name)):
+            rotated = p.with_name(p.name + ".1")
+            if rotated.exists():
+                hits.append(rotated)   # older records first
+            hits.append(p)
+    return hits
+
+
 def main(argv=None) -> int:
     argv = sys.argv[1:] if argv is None else argv
+    strict = "--strict" in argv
+    argv = [a for a in argv if a != "--strict"]
     if not argv:
         print(__doc__, file=sys.stderr)
         return 2
     target = Path(argv[0])
     if target.is_dir():
-        hits = sorted(target.rglob("metrics.jsonl"))
+        hits = discover(target)
         if not hits:
             print(f"no metrics.jsonl under {target}", file=sys.stderr)
             return 2
@@ -357,7 +494,7 @@ def main(argv=None) -> int:
         hits = [target]
     failed = False
     for path in hits:
-        errs = validate_file(path)
+        errs = validate_file(path, strict=strict)
         if errs:
             failed = True
             for e in errs:

@@ -1,0 +1,265 @@
+#!/usr/bin/env python
+"""Render one run's observability streams into a single text report.
+
+Consumes the two jsonl streams a run leaves behind — ``metrics.jsonl``
+(utils/metrics.py; training records, serving/fleet snapshots, anomaly and
+emergency records) and ``trace.jsonl`` (telemetry/tracing.py; sampled span
+trees) — plus their rotated ``.1`` predecessors, and prints three panels:
+
+1. **Latency waterfall by span**: per-span duration statistics (count / mean /
+   p50 / p95 / max) across every sampled trace, grouped by trace kind, plus an
+   ASCII waterfall of the slowest complete request tree so "where did the p99
+   go" is answerable without loading anything into a UI.
+2. **Fleet / SLO summary**: the last observed serving percentiles (merged
+   sketch snapshots), fleet routing and rollout counters, live SLO burn-rate
+   gauges, and every typed anomaly record grouped by kind.
+3. **Training health**: fps and step-timer trajectory, compile/recompile and
+   nonfinite-grad counters, dispatch mode, and emergency checkpoints.
+
+Usage:
+    python scripts/obs_report.py <run_dir>              # finds both streams
+    python scripts/obs_report.py --metrics m.jsonl --trace t.jsonl
+
+Everything is stdlib; the report goes to stdout (pipe it into a file to keep
+it next to the run).  Exit 2 when no records are found at all.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from collections import defaultdict
+from pathlib import Path
+from typing import Dict, List, Optional
+
+BAR_WIDTH = 40
+
+
+# --------------------------------------------------------------------- input
+
+
+def read_jsonl(paths: List[Path]) -> List[dict]:
+    records: List[dict] = []
+    for path in paths:
+        if path is None or not path.exists():
+            continue
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    continue   # a torn tail line on a live run is not fatal
+                if isinstance(rec, dict):
+                    records.append(rec)
+    return records
+
+
+def with_rotated(path: Optional[Path]) -> List[Path]:
+    """``[file.1, file]`` so rotated (older) records come first."""
+    if path is None:
+        return []
+    return [path.with_name(path.name + ".1"), path]
+
+
+def percentile(values: List[float], q: float) -> float:
+    if not values:
+        return 0.0
+    vals = sorted(values)
+    idx = min(len(vals) - 1, max(0, int(round(q * (len(vals) - 1)))))
+    return vals[idx]
+
+
+# ------------------------------------------------------------ span waterfall
+
+
+def span_panel(traces: List[dict]) -> List[str]:
+    lines = ["== latency waterfall by span =="]
+    if not traces:
+        return lines + ["  (no trace records)"]
+    # per-(kind, span) duration stats across all sampled trees
+    by_key: Dict[tuple, List[float]] = defaultdict(list)
+    roots: Dict[str, dict] = {}
+    children: Dict[str, List[dict]] = defaultdict(list)
+    for rec in traces:
+        span, kind = rec.get("span", "?"), rec.get("kind", "?")
+        dur = float(rec.get("dur_ms", 0.0))
+        by_key[(kind, span)].append(dur)
+        tid = rec.get("trace", "")
+        if rec.get("parent") is None:
+            roots[tid] = rec
+        else:
+            children[tid].append(rec)
+    header = f"  {'kind':<10} {'span':<16} {'count':>6} {'mean_ms':>9} " \
+             f"{'p50_ms':>9} {'p95_ms':>9} {'max_ms':>9}"
+    lines.append(header)
+    for (kind, span), durs in sorted(by_key.items()):
+        lines.append(
+            f"  {kind:<10} {span:<16} {len(durs):>6} "
+            f"{sum(durs) / len(durs):>9.2f} {percentile(durs, 0.50):>9.2f} "
+            f"{percentile(durs, 0.95):>9.2f} {max(durs):>9.2f}"
+        )
+    # waterfall of the slowest COMPLETE tree (root + at least one child)
+    slow = None
+    for tid, root in roots.items():
+        if children[tid] and (
+                slow is None or root["dur_ms"] > roots[slow]["dur_ms"]):
+            slow = tid
+    if slow is not None:
+        root = roots[slow]
+        total = max(float(root["dur_ms"]), 1e-9)
+        lines.append(f"  -- slowest sampled tree: trace {slow} "
+                     f"({root.get('kind', '?')}/{root.get('span', '?')}, "
+                     f"{total:.2f} ms, status={root.get('status', '?')}) --")
+        tree = [root] + sorted(children[slow], key=lambda r: r.get("t_ms", 0.0))
+        for rec in tree:
+            t0 = float(rec.get("t_ms", 0.0))
+            dur = float(rec.get("dur_ms", 0.0))
+            pad = int(BAR_WIDTH * min(t0 / total, 1.0))
+            bar = max(1, int(BAR_WIDTH * min(dur / total, 1.0)))
+            indent = "" if rec.get("parent") is None else "  "
+            lines.append(
+                f"  {indent}{rec.get('span', '?'):<14} "
+                f"|{' ' * pad}{'#' * bar:<{BAR_WIDTH - pad + 1}}| "
+                f"{dur:>8.2f} ms"
+            )
+        child_sum = sum(float(r.get("dur_ms", 0.0)) for r in tree[1:]
+                        if r.get("span") != "attempt")
+        lines.append(f"  span sum (ex attempt hops) {child_sum:.2f} ms "
+                     f"vs end-to-end {total:.2f} ms")
+    return lines
+
+
+# ------------------------------------------------------------- fleet + SLO
+
+
+def _last_with_prefix(metrics: List[dict], prefixes: tuple) -> Dict[str, float]:
+    out: Dict[str, float] = {}
+    for rec in metrics:
+        for k, v in rec.items():
+            if isinstance(v, (int, float)) and not isinstance(v, bool) \
+                    and k.startswith(prefixes):
+                out[k] = float(v)   # later records win
+    return out
+
+
+def fleet_panel(metrics: List[dict]) -> List[str]:
+    lines = ["== fleet / SLO summary =="]
+    latest = _last_with_prefix(
+        metrics, ("serving_", "fleet_", "rollout_", "slo_"))
+    if not latest:
+        lines.append("  (no serving/fleet records)")
+    lat = {k: v for k, v in latest.items()
+           if k.endswith(("_p50", "_p95", "_p99", "_ms"))
+           or "_ms_" in k or k.endswith("_qps")}
+    if lat:
+        lines.append("  latency / throughput (last observed):")
+        for k in sorted(lat):
+            lines.append(f"    {k:<34} {lat[k]:>12.3f}")
+    slo = {k: v for k, v in latest.items() if k.startswith("slo_")}
+    if slo:
+        lines.append("  SLO burn rates (>= 1.0 burns the error budget):")
+        for k in sorted(slo):
+            flag = "  <-- BUDGET BURNING" if (
+                k.endswith("_burn") and slo[k] >= 1.0) else ""
+            lines.append(f"    {k:<34} {slo[k]:>12.3f}{flag}")
+    ops = {k: v for k, v in latest.items()
+           if k.startswith(("fleet_", "rollout_")) and k not in lat}
+    if ops:
+        lines.append("  fleet / rollout counters (last observed):")
+        for k in sorted(ops):
+            lines.append(f"    {k:<34} {ops[k]:>12.1f}")
+    anomalies = [r for r in metrics if "anomaly" in r]
+    if anomalies:
+        by_kind: Dict[str, int] = defaultdict(int)
+        for a in anomalies:
+            by_kind[str(a.get("anomaly"))] += 1
+        lines.append("  anomalies:")
+        for kind, n in sorted(by_kind.items()):
+            lines.append(f"    {kind:<34} {n:>12}")
+    return lines
+
+
+# ---------------------------------------------------------- training health
+
+
+def training_panel(metrics: List[dict]) -> List[str]:
+    lines = ["== training health =="]
+    train = [r for r in metrics if "fps" in r]
+    if not train:
+        return lines + ["  (no training records)"]
+    last = train[-1]
+    fps = [float(r["fps"]) for r in train]
+    lines.append(f"  records {len(train)}  episodes {last.get('episode', '?')}"
+                 f"  total_steps {last.get('total_steps', '?')}")
+    lines.append(f"  fps last {fps[-1]:.0f}  mean {sum(fps) / len(fps):.0f}"
+                 f"  min {min(fps):.0f}")
+    fused = last.get("iters_per_dispatch", 1) > 1
+    timers = ("step_time_dispatch", "step_time_host_block") if fused else \
+             ("step_time_collect", "step_time_train")
+    for t in timers:
+        vals = [float(r[t]) for r in train if t in r]
+        if vals:
+            lines.append(f"  {t:<22} last {vals[-1]:.4f}s  "
+                         f"p95 {percentile(vals, 0.95):.4f}s")
+    for k in ("compile_count", "compile_seconds_total",
+              "steady_state_recompiles", "nonfinite_grad_steps",
+              "dispatch_fused_fallback"):
+        if k in last:
+            lines.append(f"  {k:<28} {float(last[k]):.2f}")
+    emergencies = [r for r in metrics if "emergency_checkpoint" in r]
+    for e in emergencies:
+        lines.append(f"  emergency checkpoint at episode {e.get('episode')}: "
+                     f"{e.get('emergency_checkpoint')}")
+    return lines
+
+
+# ----------------------------------------------------------------- assembly
+
+
+def build_report(metrics: List[dict], traces: List[dict]) -> str:
+    sections = [
+        span_panel(traces),
+        fleet_panel(metrics),
+        training_panel(metrics),
+    ]
+    return "\n".join("\n".join(s) for s in sections) + "\n"
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description="observability run report")
+    p.add_argument("run_dir", nargs="?", default=None,
+                   help="directory holding metrics.jsonl / trace.jsonl")
+    p.add_argument("--metrics", default=None)
+    p.add_argument("--trace", default=None)
+    args = p.parse_args(argv)
+
+    metrics_path = Path(args.metrics) if args.metrics else None
+    trace_path = Path(args.trace) if args.trace else None
+    if args.run_dir:
+        root = Path(args.run_dir)
+        if metrics_path is None:
+            found = sorted(root.rglob("metrics.jsonl"))
+            metrics_path = found[0] if found else None
+        if trace_path is None:
+            found = sorted(root.rglob("trace.jsonl"))
+            trace_path = found[0] if found else None
+
+    metrics = read_jsonl(with_rotated(metrics_path))
+    traces = read_jsonl(with_rotated(trace_path))
+    # trace records may interleave into metrics.jsonl-shaped fixtures; split
+    # them by shape rather than by file so mixed streams still report
+    traces += [r for r in metrics if "trace" in r]
+    metrics = [r for r in metrics if "trace" not in r]
+    if not metrics and not traces:
+        print("no records found", file=sys.stderr)
+        return 2
+    sys.stdout.write(build_report(metrics, traces))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -11,12 +11,18 @@ Usage:
   python scripts/serve_fleet.py --policy_dir exports/gen1 \
       [--replicas 2] [--port 8420] [--buckets 1,8,32,128] \
       [--watch_root exports] [--poll_interval_s 2.0] \
-      [--canary_comparisons 24] [--max_mismatch_frac 0.25]
+      [--canary_comparisons 24] [--max_mismatch_frac 0.25] \
+      [--run_dir results/fleet --trace_sample 0.01] [--slo_p99_ms 250]
 
 Manual pushes hit the running server:
   curl -X POST localhost:8420/v1/push -d '{"policy_dir": "exports/gen2"}'
   curl -X POST localhost:8420/v1/rollback
   curl localhost:8420/fleet
+  curl localhost:8420/metrics        # Prometheus text, fleet-merged
+
+``--run_dir`` + ``--trace_sample`` sample request span trees into
+``<run_dir>/trace.jsonl``; ``--slo_p99_ms`` arms the burn-rate monitor whose
+``slo_*`` gauges ride the /metrics scrape and gate canary promotion.
 """
 
 import argparse
@@ -56,8 +62,26 @@ def main(argv=None) -> int:
     p.add_argument("--canary_comparisons", type=int, default=24)
     p.add_argument("--max_mismatch_frac", type=float, default=0.25)
     p.add_argument("--canary_timeout_s", type=float, default=30.0)
+    p.add_argument("--run_dir", default=None,
+                   help="where trace.jsonl lands; required for tracing")
+    p.add_argument("--trace_sample", type=float, default=0.01,
+                   help="fraction of requests traced (0 disables)")
+    p.add_argument("--trace_max_mb", type=float, default=64.0)
+    p.add_argument("--slo_p99_ms", type=float, default=0.0,
+                   help="p99 latency SLO in ms; 0 disables the burn monitor")
     args = p.parse_args(argv)
 
+    tracer = None
+    if args.run_dir and args.trace_sample > 0:
+        from mat_dcml_tpu.telemetry.tracing import Tracer
+
+        tracer = Tracer(args.run_dir, sample=args.trace_sample,
+                        max_mb=args.trace_max_mb)
+    slo = None
+    if args.slo_p99_ms > 0:
+        from mat_dcml_tpu.telemetry.slo import SLOConfig, SLOMonitor
+
+        slo = SLOMonitor(SLOConfig(latency_p99_ms=args.slo_p99_ms))
     fleet = EngineFleet.from_export(
         args.policy_dir,
         fleet_cfg=FleetConfig(
@@ -74,6 +98,8 @@ def main(argv=None) -> int:
             max_mismatch_frac=args.max_mismatch_frac,
             canary_timeout_s=args.canary_timeout_s,
         ),
+        tracer=tracer,
+        slo_monitor=slo,
     )
     server = PolicyServer(fleet=fleet, host=args.host, port=args.port)
     server.start()

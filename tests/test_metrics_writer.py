@@ -32,3 +32,24 @@ def test_disabled_writer_is_silent(tmp_path):
     w.close()
     assert not (tmp_path / "metrics.jsonl").exists()
     assert not (tmp_path / "logs").exists()
+
+
+def test_jsonl_rotation_keeps_bounded_contiguous_tail(tmp_path):
+    """With ``max_mb`` set the live file rotates to ``.1`` at the cap: disk
+    stays bounded at ~2x the cap and the surviving records form one
+    contiguous tail of the stream (no holes, newest always live)."""
+    cap_bytes = 400
+    w = MetricsWriter(tmp_path, max_mb=cap_bytes / (1024 * 1024))
+    for i in range(20):
+        w.write({"episode": i, "total_steps": i * 10, "value_loss": 0.5})
+    w.close()
+
+    live = tmp_path / "metrics.jsonl"
+    rotated = tmp_path / "metrics.jsonl.1"
+    assert rotated.exists(), "cap never triggered a rotation"
+    assert live.stat().st_size <= cap_bytes
+    episodes = []
+    for path in (rotated, live):
+        episodes += [json.loads(l)["episode"]
+                     for l in path.read_text().splitlines()]
+    assert episodes == list(range(episodes[0], 20))

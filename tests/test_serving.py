@@ -482,3 +482,79 @@ def test_retry_after_scales_with_queue_depth(engine):
         assert hint == 5
     finally:
         b.close()
+
+
+# ------------------------------------------------------------- observability
+
+
+def test_metrics_endpoint_serves_prometheus_text(engine):
+    """GET /metrics speaks Prometheus 0.0.4 text: typed counter/gauge/summary
+    families from the live registry, honest quantiles from the merged sketch,
+    and the SLO burn gauges when a monitor is armed."""
+    from mat_dcml_tpu.telemetry.slo import SLOConfig, SLOMonitor
+
+    server = PolicyServer(
+        engine, BatcherConfig(max_batch_wait_ms=2.0), port=0,
+        log_fn=lambda *a: None,
+        slo_monitor=SLOMonitor(SLOConfig(latency_p99_ms=250.0)),
+    )
+    server.start()
+    try:
+        states, obs, avail = synth_requests(CFG, 1, seed=23)
+        server.client.act(states[0], obs[0], avail[0])
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{server.port}/metrics", timeout=10) as r:
+            ctype = r.headers["Content-Type"]
+            text = r.read().decode()
+        assert ctype.startswith("text/plain; version=0.0.4")
+        assert "# TYPE serving_requests counter" in text
+        assert "# TYPE serving_queue_wait_ms summary" in text
+        assert 'serving_queue_wait_ms{quantile="0.5"}' in text
+        assert "serving_queue_wait_ms_count" in text
+        # an armed SLO monitor rides the same scrape as gauges
+        assert "# TYPE slo_latency_burn gauge" in text
+        # single-replica server: no per-replica labels
+        assert 'serving_requests{replica=' not in text
+    finally:
+        server.stop()
+
+
+def test_http_429_retry_after_tracks_measured_queue_wait(engine, monkeypatch):
+    """The backoff hint prefers the EMA of MEASURED server-side queue wait
+    over the queue-depth product: 2500 ms of observed wait rounds up to a 3 s
+    hint, carried end to end through the typed shed error into the HTTP
+    Retry-After header."""
+    server = PolicyServer(
+        engine, BatcherConfig(max_queue=2, max_batch_wait_ms=1.0), port=0,
+        log_fn=lambda *a: None,
+    )
+    server.start()
+    b = server.batcher
+    busy = threading.Event()
+    monkeypatch.setattr(engine, "decode", _slow_decode(engine, busy, 0.6))
+    try:
+        with b._lock:
+            b._ema_queue_wait_ms = 2500.0      # 2.5 s measured -> ceil 3 s
+        assert b.retry_after_s() == 3
+        states, obs, avail = synth_requests(CFG, 4, seed=24)
+        futs = [b.submit(states[0], obs[0], avail[0])]
+        assert busy.wait(timeout=5), "dispatcher never picked up the request"
+        # dispatcher parked inside decode; fill the bounded queue (cap 2)
+        futs.append(b.submit(states[1], obs[1], avail[1]))
+        futs.append(b.submit(states[2], obs[2], avail[2]))
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{server.port}/v1/act",
+            data=json.dumps({"state": states[3].tolist(),
+                             "obs": obs[3].tolist(),
+                             "available_actions": avail[3].tolist()}).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(req, timeout=10)
+        assert exc.value.code == 429
+        assert exc.value.headers["Retry-After"] == "3"
+        assert json.loads(exc.value.read())["retry_after_s"] == 3
+        for f in futs:                          # admitted work still completes
+            f.result(timeout=30)
+    finally:
+        server.stop()

@@ -1,0 +1,278 @@
+"""SLO burn-rate monitor (fast tier): burn math, multi-window alerting,
+budget-exhaustion anomalies, and the fleet promotion gate.
+
+What the PR's acceptance hinges on:
+
+- **deterministic burn math**: with an injected clock, burn equals
+  ``violation_fraction / budget`` per window, and the alertable combined
+  burn is ``min(fast, slow)`` — a long-resolved incident cannot page.
+- **min_requests floor**: a near-empty window never burns.
+- **chaos**: an injected latency regression trips the typed
+  ``slo_latency_budget`` anomaly through the shared AnomalyDetector BEFORE
+  the run ends, and the record passes the schema validator's anomaly branch.
+- **promotion gate**: a clean canary verdict is vetoed when the error budget
+  is exhausted — the push rolls back and ``rollout_slo_gated`` counts it.
+"""
+
+import importlib.util
+from pathlib import Path
+
+import jax
+import pytest
+
+from mat_dcml_tpu.models.mat import MATConfig
+from mat_dcml_tpu.models.policy import TransformerPolicy
+from mat_dcml_tpu.serving.batcher import BatcherConfig
+from mat_dcml_tpu.serving.engine import EngineConfig
+from mat_dcml_tpu.serving.fleet import EngineFleet, FleetConfig
+from mat_dcml_tpu.serving.rollout_ctl import RolloutConfig
+from mat_dcml_tpu.telemetry import Telemetry
+from mat_dcml_tpu.telemetry.anomaly import AnomalyConfig, AnomalyDetector
+from mat_dcml_tpu.telemetry.slo import SLOConfig, SLOMonitor
+
+
+def _load_script(name):
+    path = Path(__file__).resolve().parent.parent / "scripts" / f"{name}.py"
+    spec = importlib.util.spec_from_file_location(name, path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+check_metrics_schema = _load_script("check_metrics_schema")
+
+
+class FakeClock:
+    def __init__(self):
+        self.now = 0.0
+
+    def __call__(self):
+        return self.now
+
+
+CFG_SLO = SLOConfig(latency_p99_ms=100.0, latency_budget=0.01,
+                    error_budget=0.001, goodput_floor=0.98,
+                    fast_window_s=60.0, slow_window_s=600.0, min_requests=10)
+
+
+# --------------------------------------------------------------- burn math
+
+
+def test_burn_rate_math_is_deterministic():
+    clock = FakeClock()
+    mon = SLOMonitor(CFG_SLO, clock=clock)
+    for i in range(100):
+        clock.now = i * 0.1
+        # 5% of requests above the 100ms target, zero errors
+        mon.observe_request(500.0 if i % 20 == 0 else 10.0, ok=True)
+    g = mon.gauges()
+    # 0.05 violation fraction / 0.01 budget = burn 5, in BOTH windows
+    assert g["slo_latency_burn_fast"] == pytest.approx(5.0)
+    assert g["slo_latency_burn_slow"] == pytest.approx(5.0)
+    assert g["slo_latency_burn"] == pytest.approx(5.0)
+    assert g["slo_error_burn"] == 0.0
+    # slow-or-errored fraction 0.05 / (1 - 0.98) goodput budget = 2.5
+    assert g["slo_goodput_burn"] == pytest.approx(2.5)
+    assert g["slo_window_requests"] == 100.0
+    # burn_signals is the combined subset the detector consumes
+    assert set(mon.burn_signals()) == {
+        "slo_latency_burn", "slo_error_burn", "slo_goodput_burn"}
+
+
+def test_error_burn_counts_failures():
+    clock = FakeClock()
+    mon = SLOMonitor(CFG_SLO, clock=clock)
+    for i in range(100):
+        mon.observe_request(10.0, ok=(i != 0))
+    g = mon.gauges()
+    # 1% errors / 0.1% budget = burn 10
+    assert g["slo_error_burn"] == pytest.approx(10.0)
+
+
+def test_min_requests_floor_blocks_empty_window_burns():
+    clock = FakeClock()
+    mon = SLOMonitor(CFG_SLO, clock=clock)
+    for _ in range(9):                     # min_requests=10: one short
+        mon.observe_request(1e6, ok=True)
+    g = mon.gauges()
+    assert g["slo_window_requests"] == 9.0
+    assert all(v == 0.0 for k, v in g.items() if k != "slo_window_requests")
+    mon.observe_request(1e6, ok=True)      # the 10th arms every window
+    assert mon.gauges()["slo_latency_burn"] > 0
+
+
+def test_resolved_incident_cannot_page():
+    """Multi-window AND: 50 violations five minutes ago saturate the slow
+    window, but the fast window has recovered — combined burn is zero."""
+    clock = FakeClock()
+    mon = SLOMonitor(CFG_SLO, clock=clock)
+    for _ in range(50):
+        mon.observe_request(500.0, ok=True)    # the incident
+    clock.now = 300.0
+    for _ in range(50):
+        mon.observe_request(10.0, ok=True)     # fully recovered
+    g = mon.gauges()
+    assert g["slo_latency_burn_slow"] == pytest.approx(50.0)  # 0.5/0.01
+    assert g["slo_latency_burn_fast"] == 0.0
+    assert g["slo_latency_burn"] == 0.0        # min(fast, slow): no page
+    # and symmetrically: a single fresh blip with no sustained history
+    clock2 = FakeClock()
+    mon2 = SLOMonitor(CFG_SLO, clock=clock2)
+    for _ in range(600):
+        mon2.observe_request(10.0, ok=True)
+    clock2.now = 590.0
+    for _ in range(12):
+        mon2.observe_request(500.0, ok=True)
+    g2 = mon2.gauges()
+    assert g2["slo_latency_burn_fast"] > g2["slo_latency_burn_slow"]
+    assert g2["slo_latency_burn"] == g2["slo_latency_burn_slow"]
+
+
+def test_events_outside_slow_window_are_evicted():
+    clock = FakeClock()
+    mon = SLOMonitor(CFG_SLO, clock=clock)
+    for _ in range(30):
+        mon.observe_request(500.0, ok=True)
+    clock.now = 601.0                      # everything ages out
+    mon.observe_request(10.0, ok=True)
+    assert mon.gauges()["slo_window_requests"] == 1.0
+    assert len(mon._events) == 1
+
+
+def test_export_into_registry_gauges():
+    clock = FakeClock()
+    mon = SLOMonitor(CFG_SLO, clock=clock)
+    for _ in range(20):
+        mon.observe_request(10.0)
+    tel = Telemetry()
+    g = mon.export_into(tel)
+    rec = tel.flush()
+    for name, v in g.items():
+        assert rec[name] == v
+    # the gauge names are exactly the documented strict vocabulary
+    for name in g:
+        assert check_metrics_schema._strict_ok(name), name
+
+
+# ---------------------------------------------------------------- tripwires
+
+
+def test_latency_regression_trips_budget_anomaly_before_run_end():
+    """The chaos scenario: a healthy service develops a latency regression
+    mid-run; the multi-window burn crosses threshold and the shared detector
+    emits the typed ``slo_latency_budget`` anomaly BEFORE the run ends."""
+    clock = FakeClock()
+    mon = SLOMonitor(CFG_SLO, clock=clock)
+    det = AnomalyDetector(AnomalyConfig(), telemetry=Telemetry())
+    trips, tripped_at = [], None
+    n_chunks = 20
+    for chunk in range(n_chunks):
+        clock.now = chunk * 10.0
+        regressed = chunk >= 8              # the injected regression
+        for _ in range(25):
+            mon.observe_request(400.0 if regressed else 10.0, ok=True)
+        found = det.observe(mon.burn_signals(), episode=chunk,
+                            total_steps=mon.total_requests)
+        if found and tripped_at is None:
+            tripped_at = chunk
+        trips.extend(found)
+    assert tripped_at is not None and tripped_at < n_chunks - 1, \
+        "regression never tripped before run end"
+    kinds = {t.kind for t in trips}
+    assert "slo_latency_budget" in kinds
+    for t in trips:
+        rec = t.to_record()
+        assert check_metrics_schema.validate_record(rec) == [], rec
+    # healthy traffic never trips: replay the clean prefix alone
+    clean_mon = SLOMonitor(CFG_SLO, clock=FakeClock())
+    clean_det = AnomalyDetector(AnomalyConfig())
+    for _ in range(200):
+        clean_mon.observe_request(10.0, ok=True)
+    assert clean_det.observe(clean_mon.burn_signals(), 0, 200) == []
+
+
+def test_burn_gauges_are_thresholded_not_baselined():
+    """A burn that sits at 8.0 for many observations must keep tripping at
+    cooldown cadence — the budget is the baseline; EMA must not absorb it."""
+    det = AnomalyDetector(AnomalyConfig(cooldown=2))
+    t1 = det.observe({"slo_error_burn": 8.0}, 0, 0)
+    assert [a.kind for a in t1] == ["slo_error_budget"]
+    assert det.observe({"slo_error_burn": 8.0}, 1, 0) == []   # cooldown
+    t2 = det.observe({"slo_error_burn": 8.0}, 2, 0)
+    assert [a.kind for a in t2] == ["slo_error_budget"]
+    # sub-threshold burns never trip, no matter how long they run
+    for i in range(20):
+        assert det.observe({"slo_latency_burn": 0.9}, 10 + i, 0) == []
+
+
+# ------------------------------------------------------------ promotion gate
+
+BUCKETS = (2, 4)
+
+CFG = MATConfig(
+    n_agent=3, obs_dim=4, state_dim=5, action_dim=3,
+    n_block=1, n_embd=16, n_head=2,
+)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return TransformerPolicy(CFG).init_params(jax.random.key(0))
+
+
+def make_fleet(params, slo_monitor):
+    fleet = EngineFleet(
+        params, CFG,
+        fleet_cfg=FleetConfig(n_replicas=2, probe_interval_s=0.05),
+        engine_cfg=EngineConfig(buckets=BUCKETS),
+        batcher_cfg=BatcherConfig(max_batch_wait_ms=2.0),
+        rollout_cfg=RolloutConfig(canary_comparisons=6, canary_timeout_s=60.0),
+        log_fn=lambda *a: None,
+        slo_monitor=slo_monitor,
+    )
+    fleet.warmup()
+    return fleet
+
+
+def test_exhausted_budget_vetoes_clean_promotion(params):
+    """Identical weights gate clean (PROMOTE verdict), but the exhausted
+    latency budget vetoes: the push rolls back and is counted."""
+    clock = FakeClock()
+    slo = SLOMonitor(SLOConfig(latency_p99_ms=1e-3, min_requests=5),
+                     clock=clock)
+    fleet = make_fleet(params, slo)
+    try:
+        for _ in range(50):                 # every request violates the SLO
+            slo.observe_request(10.0, ok=True)
+        # the burn also surfaces as a typed anomaly through the fleet's
+        # detector — the same record shape training tripwires emit
+        trips = fleet.check_slo()
+        assert any(t["anomaly"] == "slo_latency_budget" for t in trips)
+        assert fleet.anomalies
+
+        report = fleet.push(params)
+        assert report["status"] == "rolled_back"
+        assert fleet.telemetry.counters["rollout_slo_gated"] == 1.0
+        assert fleet.current_generation == 0       # nothing promoted
+        rec = fleet.fleet_record()
+        assert rec["slo_latency_burn"] >= 1.0      # gauges ride fleet_record
+        errs = check_metrics_schema.validate_record(rec, strict=True)
+        assert errs == [], errs
+    finally:
+        fleet.close()
+
+
+def test_healthy_budget_does_not_gate_promotion(params):
+    clock = FakeClock()
+    slo = SLOMonitor(SLOConfig(latency_p99_ms=1e9, min_requests=5),
+                     clock=clock)
+    fleet = make_fleet(params, slo)
+    try:
+        for _ in range(50):
+            slo.observe_request(10.0, ok=True)
+        assert fleet.check_slo() == []
+        report = fleet.push(params)
+        assert report["status"] == "promoted"
+        assert "rollout_slo_gated" not in fleet.telemetry.counters
+    finally:
+        fleet.close()

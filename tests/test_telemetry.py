@@ -367,3 +367,100 @@ def test_runner_skips_record_on_fetch_error(tmp_path, monkeypatch):
     # whatever DID land (if anything) still validates
     for i, rec in enumerate(recs):
         assert check_metrics_schema.validate_record(rec, i) == []
+
+
+# ------------------------------------------------------ golden schema fixture
+
+GOLDEN = Path(__file__).resolve().parent / "data" / "metrics_golden.jsonl"
+
+
+def _load_script(name):
+    path = Path(__file__).resolve().parent.parent / "scripts" / f"{name}.py"
+    spec = importlib.util.spec_from_file_location(name, path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_golden_fixture_validates_default_and_strict():
+    """The committed fixture is the schema's executable documentation: it must
+    stay valid under BOTH modes and through the CLI entrypoint, so any future
+    schema tightening has to update the fixture (and README) with it."""
+    assert check_metrics_schema.validate_file(GOLDEN) == []
+    assert check_metrics_schema.validate_file(GOLDEN, strict=True) == []
+    assert check_metrics_schema.main([str(GOLDEN), "--strict"]) == 0
+
+
+def test_golden_fixture_covers_every_record_family():
+    """One committed record per schema branch: training (episodic AND fused),
+    serving, fleet, scenario, anomaly, emergency, trace — plus keys in every
+    strict-vocabulary family, so each validator path is exercised by data."""
+    records = [json.loads(l) for l in GOLDEN.read_text().splitlines()]
+    for marker in ("fps", "serving_qps", "fleet_replicas", "scenario_spread",
+                   "anomaly", "emergency_checkpoint", "trace"):
+        assert any(marker in r for r in records), f"no {marker!r} record"
+    assert any(r.get("iters_per_dispatch", 1) > 1 for r in records), \
+        "no fused-dispatch training record"
+    for family in check_metrics_schema.STRICT_FAMILY_PATTERNS:
+        assert any(any(k.startswith(family) for k in r) for r in records), \
+            f"no {family!r} keys in the golden fixture"
+
+
+def test_strict_mode_rejects_family_typos(tmp_path):
+    """Default mode accepts any suffix under a known family (catches new
+    families); --strict pins each family to its documented vocabulary so a
+    typo inside one fails loudly."""
+    typo = {"serving_deadlnie_misses": 1.0}
+    assert check_metrics_schema.validate_record(typo) == []
+    errs = check_metrics_schema.validate_record(typo, strict=True)
+    assert errs and "vocabulary" in errs[0]
+
+    path = tmp_path / "metrics.jsonl"
+    path.write_text(json.dumps(typo) + "\n")
+    assert check_metrics_schema.main([str(path)]) == 0
+    assert check_metrics_schema.main([str(path), "--strict"]) == 1
+
+
+def test_schema_cli_discovers_rotated_and_trace_streams(tmp_path):
+    """A run-dir argument validates every stream: rotated metrics first (older
+    records), then the live file, then the trace stream — and a bad span
+    record fails the whole directory."""
+    (tmp_path / "metrics.jsonl.1").write_text(json.dumps(
+        {"episode": 0, "total_steps": 1, "value_loss": 0.1}) + "\n")
+    (tmp_path / "metrics.jsonl").write_text(json.dumps(
+        {"episode": 1, "total_steps": 2, "value_loss": 0.2}) + "\n")
+    (tmp_path / "trace.jsonl").write_text(json.dumps(
+        {"trace": "t0", "span": "request", "kind": "serving", "parent": None,
+         "t_ms": 0.0, "dur_ms": 1.0, "status": "ok"}) + "\n")
+    hits = check_metrics_schema.discover(tmp_path)
+    assert [p.name for p in hits] == [
+        "metrics.jsonl.1", "metrics.jsonl", "trace.jsonl"]
+    assert check_metrics_schema.main([str(tmp_path)]) == 0
+
+    (tmp_path / "trace.jsonl").write_text(json.dumps(
+        {"trace": "t0", "span": "BadSpan", "kind": "serving",
+         "t_ms": 0.0, "dur_ms": 1.0}) + "\n")
+    assert check_metrics_schema.main([str(tmp_path)]) == 1
+
+
+# ----------------------------------------------------------- obs_report CLI
+
+
+def test_obs_report_renders_all_three_panels(tmp_path, capsys):
+    """The report renders span waterfall + fleet/SLO + training panels from
+    one mixed stream (the golden fixture) and exits 0."""
+    obs_report = _load_script("obs_report")
+    (tmp_path / "metrics.jsonl").write_text(GOLDEN.read_text())
+    assert obs_report.main([str(tmp_path)]) == 0
+    out = capsys.readouterr().out
+    assert "latency waterfall by span" in out
+    assert "fleet / SLO summary" in out
+    assert "training health" in out
+    assert "slo_latency_burn" in out
+    assert "slowest sampled tree" in out      # the per-trace ASCII waterfall
+    assert "slo_latency_budget" in out        # anomaly rollup by kind
+
+
+def test_obs_report_empty_dir_exits_nonzero(tmp_path, capsys):
+    obs_report = _load_script("obs_report")
+    assert obs_report.main([str(tmp_path)]) == 2

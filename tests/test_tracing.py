@@ -1,0 +1,288 @@
+"""Request-scoped tracing (fast tier): sampling, rotation, span trees.
+
+What the PR's acceptance hinges on:
+
+- **deterministic sampling**: ``sample=s`` keeps every ``round(1/s)``-th
+  trace starting with the first, so short runs always capture at least one
+  tree and the non-sampled fast path is one integer increment.
+- **span tiling**: the batcher's child spans (``queue_wait`` ``pad``
+  ``device_decode`` ``demux``) contiguously tile the root ``request`` span —
+  their durations sum to the server-side end-to-end latency.
+- **one tree per request across failover**: a fleet retry records extra
+  ``attempt`` spans under the SAME trace id, so a failed-over request reads
+  as one tree ending in ``status=ok``.
+- **training granularity**: a traced run writes one ``dispatch`` root per
+  episode/dispatch with ``collect``/``train``/``fetch`` children.
+- **schema**: every emitted span record passes the trace branch of
+  scripts/check_metrics_schema.py.
+
+CFG/BUCKETS match tests/test_serving.py exactly so the persistent compile
+cache (tests/conftest.py) makes warmup a cache hit.
+"""
+
+import importlib.util
+import json
+from collections import defaultdict
+from pathlib import Path
+
+import jax
+import numpy as np
+import pytest
+
+from mat_dcml_tpu.config import RunConfig
+from mat_dcml_tpu.envs.dcml import DCMLEnv, DCMLEnvConfig
+from mat_dcml_tpu.envs.dcml.env import DCMLConsts
+from mat_dcml_tpu.models.mat import MATConfig
+from mat_dcml_tpu.models.policy import TransformerPolicy
+from mat_dcml_tpu.serving.batcher import BatcherConfig, ContinuousBatcher
+from mat_dcml_tpu.serving.engine import DecodeEngine, EngineConfig
+from mat_dcml_tpu.serving.fleet import EngineFleet, FleetConfig
+from mat_dcml_tpu.serving.loadgen import synth_requests
+from mat_dcml_tpu.serving.server import PolicyClient
+from mat_dcml_tpu.telemetry import Telemetry
+from mat_dcml_tpu.telemetry.tracing import Tracer
+from mat_dcml_tpu.training.ppo import PPOConfig
+from mat_dcml_tpu.training.runner import DCMLRunner
+
+
+def _load_script(name):
+    path = Path(__file__).resolve().parent.parent / "scripts" / f"{name}.py"
+    spec = importlib.util.spec_from_file_location(name, path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+check_metrics_schema = _load_script("check_metrics_schema")
+
+BUCKETS = (2, 4)
+
+CFG = MATConfig(
+    n_agent=3, obs_dim=4, state_dim=5, action_dim=3,
+    n_block=1, n_embd=16, n_head=2,
+)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return TransformerPolicy(CFG).init_params(jax.random.key(0))
+
+
+@pytest.fixture(scope="module")
+def engine(params):
+    eng = DecodeEngine(
+        params, CFG, EngineConfig(buckets=BUCKETS), log_fn=lambda *a: None
+    )
+    eng.warmup()
+    return eng
+
+
+def read_traces(path):
+    """Parse trace.jsonl (+ rotation) into {trace_id: [records]}; every
+    record must pass the validator's trace branch."""
+    by_id = defaultdict(list)
+    for p in (Path(str(path) + ".1"), Path(path)):
+        if not p.exists():
+            continue
+        for i, line in enumerate(p.read_text().splitlines()):
+            rec = json.loads(line)
+            errs = check_metrics_schema.validate_record(rec, i)
+            assert errs == [], errs
+            by_id[rec["trace"]].append(rec)
+    return by_id
+
+
+# ------------------------------------------------------------------ sampling
+
+
+def test_sampling_is_deterministic_counter_based(tmp_path):
+    tracer = Tracer(str(tmp_path), sample=0.5)
+    kept = [tracer.start_trace("serving") for _ in range(6)]
+    # period 2: every other trace kept, FIRST included
+    assert [t is not None for t in kept] == [True, False] * 3
+    assert tracer.traces_started == 3
+
+    everything = Tracer(str(tmp_path), sample=1.0)
+    assert all(everything.start_trace() is not None for _ in range(4))
+
+    disabled = Tracer(str(tmp_path), sample=0.0)
+    assert disabled.start_trace() is None          # the bench A/B fast path
+    nowhere = Tracer(None, sample=1.0)
+    assert nowhere.start_trace() is None
+
+
+def test_trace_file_rotation_is_bounded(tmp_path):
+    cap_bytes = 4096
+    tracer = Tracer(str(tmp_path), sample=1.0,
+                    max_mb=cap_bytes / (1024 * 1024))
+    for i in range(100):
+        trace = tracer.start_trace("serving")
+        with trace.span("queue_wait"):
+            pass
+        trace.finish(status="ok")
+    tracer.close()
+
+    live = tmp_path / "trace.jsonl"
+    rotated = tmp_path / "trace.jsonl.1"
+    assert rotated.exists(), "cap never triggered a rotation"
+    # one tree (root + child) may straddle the cap; allow that slack
+    slack = 512
+    assert live.stat().st_size <= cap_bytes + slack
+    assert rotated.stat().st_size <= cap_bytes + slack
+    # surviving records still parse and validate
+    assert read_traces(live)
+
+
+# ---------------------------------------------------------------- span trees
+
+
+def test_batcher_spans_tile_root_end_to_end(engine, tmp_path):
+    """The tier-1 tiling invariant: for a batcher-owned trace the four child
+    spans are contiguous and their durations sum to the root ``request``
+    span's end-to-end duration."""
+    tracer = Tracer(str(tmp_path), sample=1.0)
+    b = ContinuousBatcher(
+        engine, BatcherConfig(max_batch_wait_ms=100.0),
+        telemetry=Telemetry(), log_fn=lambda *a: None, tracer=tracer,
+    )
+    try:
+        states, obs, avail = synth_requests(CFG, 2, seed=31)
+        futs = [b.submit(states[i], obs[i], avail[i]) for i in range(2)]
+        for f in futs:
+            f.result(timeout=30)
+    finally:
+        b.close()
+        tracer.close()
+
+    trees = read_traces(tmp_path / "trace.jsonl")
+    assert len(trees) == 2                         # sample=1.0: both requests
+    for records in trees.values():
+        roots = [r for r in records if r["parent"] is None]
+        assert len(roots) == 1
+        root = roots[0]
+        assert root["span"] == "request" and root["status"] == "ok"
+        children = sorted((r for r in records if r["parent"] is not None),
+                          key=lambda r: r["t_ms"])
+        assert [c["span"] for c in children] == [
+            "queue_wait", "pad", "device_decode", "demux"]
+        # contiguous tiling: each child starts where the previous ended...
+        for prev, nxt in zip(children, children[1:]):
+            assert prev["t_ms"] + prev["dur_ms"] == pytest.approx(
+                nxt["t_ms"], abs=1e-3)
+        # ...so the child durations sum to the root end-to-end latency
+        child_sum = sum(c["dur_ms"] for c in children)
+        assert child_sum == pytest.approx(root["dur_ms"], abs=1e-3)
+        # queue_wait starts at trace start; demux ends at root end
+        assert children[0]["t_ms"] == pytest.approx(0.0, abs=1e-3)
+        assert children[2]["bucket"] == 2          # device_decode attrs ride
+
+
+def test_fleet_failover_keeps_one_trace_id(params, tmp_path):
+    """A request whose first replica dies reads as ONE tree: two ``attempt``
+    spans (failed then ok) under the same trace id, root status ok."""
+    tracer = Tracer(str(tmp_path), sample=1.0)
+    fleet = EngineFleet(
+        params, CFG,
+        fleet_cfg=FleetConfig(n_replicas=2, probe_interval_s=0.05),
+        engine_cfg=EngineConfig(buckets=BUCKETS),
+        batcher_cfg=BatcherConfig(max_batch_wait_ms=2.0),
+        log_fn=lambda *a: None,
+        tracer=tracer,
+    )
+    fleet.warmup()
+    try:
+        def dead(*a, **kw):
+            raise RuntimeError("replica 0 engine lost")
+
+        fleet.replicas[0].engine.decode = dead
+        client = PolicyClient(fleet)
+        states, obs, avail = synth_requests(CFG, 4, seed=32)
+        for i in range(4):
+            action, _ = client.act(states[i], obs[i], avail[i])
+            assert action.shape == (CFG.n_agent, 1)
+    finally:
+        fleet.close()
+        tracer.close()
+
+    trees = read_traces(tmp_path / "trace.jsonl")
+    failed_over = None
+    for records in trees.values():
+        attempts = sorted((r for r in records if r["span"] == "attempt"),
+                          key=lambda r: r["retry"])
+        if len(attempts) >= 2:
+            failed_over = (records, attempts)
+            break
+    assert failed_over is not None, "no request ever landed on the dead replica"
+    records, attempts = failed_over
+    root = next(r for r in records if r["parent"] is None)
+    assert root["status"] == "ok"                  # the CLIENT saw a success
+    assert attempts[0]["ok"] is False and attempts[-1]["ok"] is True
+    assert attempts[0]["replica"] != attempts[-1]["replica"]
+    # the successful hop carries the batcher tiling under the same id
+    assert {r["span"] for r in records} >= {
+        "request", "attempt", "queue_wait", "pad", "device_decode", "demux"}
+
+
+# ----------------------------------------------------------------- training
+
+W = 8
+
+
+def _dcml_env():
+    consts = DCMLConsts(worker_number_max=W, sob_dim=W + 2)
+    rng = np.random.default_rng(0)
+    workloads = rng.integers(
+        0, 5, size=(W, consts.local_workload_period)).astype(np.float32)
+    return DCMLEnv(DCMLEnvConfig(consts=consts), base_workloads=workloads)
+
+
+def test_training_run_traces_dispatches(tmp_path):
+    """A traced episodic run writes one ``dispatch`` root per episode with
+    collect/train children, and the stream passes the schema CLI."""
+    run = RunConfig(
+        algorithm_name="mat", n_rollout_threads=2, episode_length=8,
+        num_env_steps=2 * 8 * 2, log_interval=1, save_interval=0,
+        n_block=1, n_embd=16, n_head=1,
+        run_dir=str(tmp_path), trace_sample=1.0,
+    )
+    r = DCMLRunner(run, PPOConfig(ppo_epoch=2, num_mini_batch=2),
+                   env=_dcml_env(), log_fn=lambda s: None)
+    r.train_loop()
+    r.writer.close()
+
+    trees = read_traces(r.run_dir / "trace.jsonl")
+    assert len(trees) == 2                         # one tree per episode
+    for records in trees.values():
+        root = next(rec for rec in records if rec["parent"] is None)
+        assert root["span"] == "dispatch" and root["kind"] == "training"
+        assert root["status"] == "ok"
+        spans = {rec["span"] for rec in records}
+        assert {"collect", "train"} <= spans
+    # the run dir as a whole (metrics.jsonl + trace.jsonl) validates strict
+    assert check_metrics_schema.main(["--strict", str(r.run_dir)]) == 0
+
+
+def test_fused_training_run_traces_dispatches(tmp_path):
+    """Same contract under --iters_per_dispatch K>1: one root per fused
+    dispatch, with the async-launch span shape (dispatch + fetch tail)."""
+    run = RunConfig(
+        algorithm_name="mat", n_rollout_threads=2, episode_length=8,
+        num_env_steps=4 * 8 * 2, log_interval=2, save_interval=0,
+        n_block=1, n_embd=16, n_head=1, iters_per_dispatch=2,
+        run_dir=str(tmp_path), trace_sample=1.0,
+    )
+    r = DCMLRunner(run, PPOConfig(ppo_epoch=2, num_mini_batch=2),
+                   env=_dcml_env(), log_fn=lambda s: None)
+    r.train_loop()
+    r.writer.close()
+
+    trees = read_traces(r.run_dir / "trace.jsonl")
+    assert len(trees) == 2                         # 4 episodes as 2 dispatches
+    for records in trees.values():
+        root = next(rec for rec in records if rec["parent"] is None)
+        assert root["kind"] == "training" and root["status"] == "ok"
+        spans = {rec["span"] for rec in records}
+        assert {"dispatch", "fetch"} <= spans
+        launch = next(rec for rec in records
+                      if rec["span"] == "dispatch" and rec["parent"] is not None)
+        assert launch["iters"] == 2
