@@ -57,7 +57,7 @@ Knobs (environment variables):
                         Knobs: BENCH_SERVING_REQUESTS (256),
                         BENCH_SERVING_CONCURRENCY (16),
                         BENCH_SERVING_BUCKETS (1,4,16),
-                        BENCH_SERVING_DECODE_MODE (scan|stride|spec),
+                        BENCH_SERVING_DECODE_MODE (cached|scan|stride|spec),
                         BENCH_SERVING_SPEC_BLOCK (8),
                         BENCH_SERVING_RUN_DIR (append the serving records to
                         <dir>/metrics.jsonl)
@@ -70,6 +70,17 @@ Knobs (environment variables):
                         Knobs: BENCH_SPEC_E (256), BENCH_SPEC_K (8 — comma
                         list → one json line per K, record = best K),
                         BENCH_SPEC_ITERS (3), BENCH_SPEC_STOCHASTIC ("0")
+  BENCH_CACHED_DECODE   "1" → three-way decode A/B (scan vs spec vs cached)
+                        on the DCML preset, at the serving leg (per-dispatch
+                        p50 at the batched bucket + batch-1 QPS, one AOT
+                        engine per mode) AND the collect leg (stochastic
+                        serve_decode env-steps/s at E).  Best-of-N
+                        alternating trials; cached==scan bit-exactness
+                        asserted before timing.  Record value = cached
+                        serving p50, vs_baseline = scan/cached p50 speedup.
+                        Knobs: BENCH_CACHED_E (256), BENCH_CACHED_TRIALS (5),
+                        BENCH_CACHED_DISPATCHES (8), BENCH_CACHED_BUCKET (16),
+                        BENCH_CACHED_SPEC_BLOCK (8)
   BENCH_SHARD_SWEEP     "1" → sharded fused-dispatch leg (CPU proxy): env-
                         steps/s of the donated K-step scan vs --data_shards
                         over a forced virtual-device CPU topology, then an
@@ -1045,10 +1056,11 @@ def _measure_serving(jax) -> None:
     )
     run_dir = os.environ.get("BENCH_SERVING_RUN_DIR", "")
 
-    # BENCH_SERVING_DECODE_MODE=spec serves the speculative decode through
-    # the same ladder (AOT per bucket, recompile detector armed) so the
-    # serving p50/QPS surface of the spec-vs-scan A/B is one env var away
-    decode_mode = os.environ.get("BENCH_SERVING_DECODE_MODE", "scan")
+    # BENCH_SERVING_DECODE_MODE serves any decode mode through the same
+    # ladder (AOT per bucket, recompile detector armed) so the serving
+    # p50/QPS surface of the mode A/B is one env var away; "cached" is the
+    # engine default and scripts/decode_sweep.sh sweeps all three
+    decode_mode = os.environ.get("BENCH_SERVING_DECODE_MODE", "cached")
     spec_block = int(os.environ.get("BENCH_SERVING_SPEC_BLOCK", "8"))
 
     legs = {}
@@ -1198,6 +1210,143 @@ def _measure_spec_decode(jax) -> None:
     if len(ks) > 1:
         log(f"spec_decode: best K={best['spec_block']} at "
             f"{best['value']:.1f} joint actions/s ({best['vs_baseline']:.2f}x)")
+
+
+def _measure_cached_decode(jax) -> None:
+    """BENCH_CACHED_DECODE=1 leg: three-way decode A/B (scan vs spec vs
+    cached) on the production DCML policy shape (101 agents), at both the
+    serving and collect legs.
+
+    Serving: one AOT :class:`DecodeEngine` per mode (identical params, ladder,
+    resident key), measured as best-of-N *alternating* trials — every trial
+    round runs all three modes back-to-back so OS noise and cache state hit
+    them symmetrically — reporting per-dispatch p50 at the batched bucket and
+    batch-1 QPS at bucket 1.  Collect: the jitted ``serve_decode`` entry at
+    E=BENCH_CACHED_E, stochastic (the rollout collector's configuration),
+    same alternating best-of-N.  Cached-vs-scan bit-exactness (actions AND
+    log-probs) is asserted on real random inputs before any timing.
+
+    Knobs: BENCH_CACHED_E (256), BENCH_CACHED_TRIALS (5),
+    BENCH_CACHED_DISPATCHES (8 per trial), BENCH_CACHED_BUCKET (16),
+    BENCH_CACHED_SPEC_BLOCK (8)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from mat_dcml_tpu.config import RunConfig
+    from mat_dcml_tpu.envs.dcml import DCMLEnv, DCMLEnvConfig
+    from mat_dcml_tpu.models.decode import serve_decode
+    from mat_dcml_tpu.serving.engine import DecodeEngine, EngineConfig
+    from mat_dcml_tpu.training.runner import build_mat_policy
+
+    data_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)), "data")
+    env = DCMLEnv(DCMLEnvConfig(), data_dir=data_dir)
+    policy = build_mat_policy(RunConfig(), env)
+    cfg = policy.cfg
+    params = policy.init_params(jax.random.key(0))
+
+    E = int(os.environ.get("BENCH_CACHED_E", "256"))
+    trials = int(os.environ.get("BENCH_CACHED_TRIALS", "5"))
+    n_disp = int(os.environ.get("BENCH_CACHED_DISPATCHES", "8"))
+    bucket = int(os.environ.get("BENCH_CACHED_BUCKET", "16"))
+    spec_block = int(os.environ.get("BENCH_CACHED_SPEC_BLOCK", "8"))
+    modes = ("scan", "spec", "cached")
+
+    rng = np.random.default_rng(0)
+    dev = jax.devices()[0]
+
+    # ---- exactness gate: the A/B only counts if cached == scan bitwise
+    state = jnp.asarray(rng.normal(size=(E, cfg.n_agent, cfg.state_dim)), jnp.float32)
+    obs = jnp.asarray(rng.normal(size=(E, cfg.n_agent, cfg.obs_dim)), jnp.float32)
+    avail = jnp.ones((E, cfg.n_agent, cfg.action_dim), jnp.float32)
+    key = jax.random.key(7)
+    collect_fns = {
+        m: jax.jit(lambda p, k, _m=m: serve_decode(
+            cfg, p, k, state, obs, avail, deterministic=False, mode=_m,
+            spec_block=spec_block))
+        for m in modes
+    }
+    ref = jax.block_until_ready(collect_fns["scan"](params, key))
+    got = jax.block_until_ready(collect_fns["cached"](params, key))
+    assert np.array_equal(np.asarray(ref[1].action), np.asarray(got[1].action)), \
+        "cached decode diverged from scan (actions)"
+    assert np.array_equal(np.asarray(ref[1].log_prob), np.asarray(got[1].log_prob)), \
+        "cached decode diverged from scan (log-probs)"
+    log(f"cached_decode: cached == scan bit-exact at E={E} (stochastic)")
+
+    # ---- serving leg: engines warm first, then alternating timed trials
+    engines = {}
+    for m in modes:
+        eng = DecodeEngine(
+            params, cfg,
+            EngineConfig(buckets=(1, bucket), decode_mode=m,
+                         spec_block=spec_block),
+            log_fn=lambda *_: None,
+        )
+        eng.warmup()
+        engines[m] = eng
+    s_b = rng.normal(size=(bucket, cfg.n_agent, cfg.state_dim)).astype(np.float32)
+    o_b = rng.normal(size=(bucket, cfg.n_agent, cfg.obs_dim)).astype(np.float32)
+    a_b = np.ones((bucket, cfg.n_agent, cfg.action_dim), np.float32)
+    s_1, o_1, a_1 = s_b[:1], o_b[:1], a_b[:1]
+
+    p50_ms = {m: float("inf") for m in modes}    # best (lowest) trial median
+    qps1 = {m: 0.0 for m in modes}               # best (highest) trial QPS
+    for _ in range(trials):
+        for m in modes:
+            eng = engines[m]
+            times = []
+            for _ in range(n_disp):
+                t0 = time.perf_counter()
+                eng.decode(s_b, o_b, a_b)
+                times.append(time.perf_counter() - t0)
+            p50_ms[m] = min(p50_ms[m], float(np.median(times)) * 1e3)
+            t0 = time.perf_counter()
+            for _ in range(n_disp):
+                eng.decode(s_1, o_1, a_1)
+            qps1[m] = max(qps1[m], n_disp / (time.perf_counter() - t0))
+    recompiles = {m: engines[m].steady_state_recompiles() for m in modes}
+
+    # ---- collect leg: jitted serve_decode throughput at E (stochastic)
+    for m in modes:   # warm all before any timing so compiles don't alternate
+        jax.block_until_ready(collect_fns[m](params, key))
+    steps_s = {m: 0.0 for m in modes}
+    for _ in range(trials):
+        for m in modes:
+            t0 = time.perf_counter()
+            jax.block_until_ready(collect_fns[m](params, key))
+            steps_s[m] = max(steps_s[m], E / (time.perf_counter() - t0))
+
+    for m in modes:
+        log(f"cached_decode[{m}]: serving p50 {p50_ms[m]:.1f} ms @ bucket "
+            f"{bucket}, batch-1 {qps1[m]:.1f} QPS, collect {steps_s[m]:.0f} "
+            f"env-steps/s @ E={E}, recompiles {recompiles[m]:.0f}")
+    record = {
+        "metric": "dcml_mat_cached_decode_p50",
+        "value": round(p50_ms["cached"], 2),
+        "unit": "ms",
+        # the headline A/B: cached-over-scan serving p50 speedup
+        "vs_baseline": round(p50_ms["scan"] / max(p50_ms["cached"], 1e-9), 2),
+        "platform": dev.platform,
+        "device": dev.device_kind,
+        "provisional": dev.platform == "cpu",
+        "E": E,
+        "n_agent": cfg.n_agent,
+        "bucket": bucket,
+        "spec_block": spec_block,
+        "trials": trials,
+        "bit_exact": True,
+        "beats_scan": bool(p50_ms["cached"] < p50_ms["scan"]
+                           and qps1["cached"] > qps1["scan"]),
+        "beats_spec": bool(p50_ms["cached"] < p50_ms["spec"]
+                           and qps1["cached"] > qps1["spec"]),
+        "collect_ok": bool(steps_s["cached"] >= steps_s["scan"] * 0.98),
+        "steady_state_recompiles": sum(recompiles.values()),
+    }
+    for m in modes:
+        record[f"{m}_p50_ms"] = round(p50_ms[m], 2)
+        record[f"{m}_batch1_qps"] = round(qps1[m], 2)
+        record[f"{m}_collect_steps_s"] = round(steps_s[m], 1)
+    print(json.dumps(record), flush=True)
 
 
 def _measure_fleet(jax) -> None:
@@ -1720,6 +1869,13 @@ def main() -> None:
     if os.environ.get("BENCH_SPEC_DECODE", "0") == "1":
         jax, _ = _setup_jax()
         _measure_spec_decode(jax)
+        return
+
+    # Cached-decode three-way A/B: scan vs spec vs cached at the serving and
+    # collect legs, exactness-asserted, best-of-N alternating trials
+    if os.environ.get("BENCH_CACHED_DECODE", "0") == "1":
+        jax, _ = _setup_jax()
+        _measure_cached_decode(jax)
         return
 
     # Orchestrated (deadline-aware) unless the caller manages the chip

@@ -112,12 +112,14 @@ class RunConfig:
     # devices not consumed by --seq_shards); 1 = no data sharding.
     # n_rollout_threads must be divisible by the resulting shard count.
     data_shards: int = 1
-    # rollout decode: "scan" = sequential AR decode, "spec" = speculative
-    # draft-verify decode (models/decode.py:spec_decode) — bit-exact to scan
+    # rollout decode: "cached" (default) = O(1)-per-step decode against the
+    # packed head-split KV buffer (models/decode.py:cached_decode), bit-exact
+    # to "scan"; "scan" = sequential AR decode re-deriving per-step state;
+    # "spec" = speculative draft-verify decode (spec_decode) — also bit-exact
     # (actions AND log-probs, via gumbel/noise replay), ~n_agent/K̄ block
     # passes instead of n_agent sequential steps.  "stride" is reserved for
     # the deterministic benchmark-protocol path and is not valid here.
-    decode_mode: str = "scan"
+    decode_mode: str = "cached"
     # speculative window K: draft positions verified per block pass
     spec_block: int = 8
     # resume policy when a checkpoint source is configured (training/

@@ -95,7 +95,7 @@ def _action_std(model: MultiAgentTransformer, params) -> jax.Array:
 # Params-only serving entry (shared by training rollout and serving/engine)
 # ---------------------------------------------------------------------------
 
-DECODE_MODES = ("scan", "stride", "spec")
+DECODE_MODES = ("scan", "stride", "spec", "cached")
 
 
 def serve_decode(
@@ -128,8 +128,13 @@ def serve_decode(
     ``deterministic=False`` raises, there is no stochastic stride sampling
     path); ``"spec"`` = draft-verify speculative decode (:func:`spec_decode`),
     bit-exact to ``"scan"`` for both deterministic and stochastic decode with
-    ~A/K̄ decoder passes.  ``key`` is always taken (ignored by deterministic
-    paths) so all modes present the same call signature to AOT compilation.
+    ~A/K̄ decoder passes; ``"cached"`` = O(1)-per-step decode against the
+    packed head-split KV cache (:func:`cached_decode`), bit-exact to
+    ``"scan"`` including log-probs and the gumbel key chain (``dec_actor``
+    has no decoder trunk to cache and silently falls back to the scan path,
+    which is already step-minimal there).  ``key`` is always taken (ignored
+    by deterministic paths) so all modes present the same call signature to
+    AOT compilation.
 
     Returns ``(values, DecodeResult)``; with ``return_spec_stats=True``
     (``mode="spec"`` only) returns ``(values, DecodeResult, SpecStats)``.
@@ -159,6 +164,10 @@ def serve_decode(
         )
         if return_spec_stats:
             return v_loc, res, stats
+    elif mode == "cached" and not cfg.dec_actor:
+        res = cached_decode(
+            model, params, key, obs_rep, available_actions, deterministic
+        )
     else:
         res = ar_decode(
             model, params, key, obs_rep, obs, available_actions, deterministic
@@ -271,35 +280,9 @@ def ar_decode(
         key, k_d, k_c = jax.random.split(key, 3)
         logits, caches = decode_step(caches, shifted_in, i)
         ava_i = jax.lax.dynamic_slice_in_dim(available_actions, i, 1, axis=1)[:, 0]
-
-        if cfg.action_type == DISCRETE:
-            act, logp, nxt = _discrete_branch(logits, ava_i, k_d, deterministic, adim, in_dim)
-        elif cfg.action_type == SEMI_DISCRETE:
-            d_act, d_logp, d_nxt = _discrete_branch(logits, ava_i, k_d, deterministic, adim, in_dim)
-            c_act = logits if deterministic else D.normal_sample_from_noise(logits, std, noise_i)
-            c_logp = D.normal_log_prob(logits, std, c_act)
-            is_cont = i >= cfg.n_discrete_agents
-            act = jnp.where(is_cont, c_act[:, -1:], d_act)
-            logp = jnp.where(is_cont, c_logp[:, -1:], d_logp)
-            nxt = d_nxt  # the continuous agent is last; its feed is never used
-        elif cfg.action_type == CONTINUOUS:
-            act, logp = _continuous_branch(logits, std, k_c, deterministic)
-            nxt = act[:, None, :]
-        else:  # AVAILABLE_CONTINUOUS (transformer_act.py:244-283)
-            dd = cfg.discrete_dim
-            d_logits = D.mask_logits(logits[:, :dd], ava_i[:, :dd])
-            d_idx = (
-                D.categorical_mode(d_logits) if deterministic else D.categorical_sample(k_d, d_logits)
-            )
-            d_logp = D.categorical_log_prob(d_logits, d_idx)
-            d_onehot = jax.nn.one_hot(d_idx, dd, dtype=jnp.float32)
-            c_std = std[dd:]
-            c_mean = logits[:, dd:]
-            c_act = c_mean if deterministic else D.normal_sample(k_c, c_mean, c_std)
-            c_logp = D.normal_log_prob(c_mean, c_std, c_act)
-            act = jnp.concatenate([d_onehot, c_act], axis=-1)
-            logp = jnp.concatenate([d_logp[:, None], c_logp], axis=-1)
-            nxt = jnp.zeros((B, 1, in_dim), jnp.float32).at[:, 0, 1:].set(act)
+        act, logp, nxt = _sample_position(
+            cfg, logits, ava_i, i, noise_i, k_d, k_c, std, deterministic, B
+        )
         return (caches, nxt, key), (act, logp)
 
     with named_scope("mat/ar_decode"):
@@ -397,6 +380,149 @@ def _continuous_branch(mean, std, key, deterministic):
     act = mean if deterministic else D.normal_sample(key, mean, std)
     logp = D.normal_log_prob(mean, std, act)
     return act, logp
+
+
+def _sample_position(cfg, logits, ava_i, i, noise_i, k_d, k_c, std, deterministic, B):
+    """Per-position sampling shared by :func:`ar_decode` and
+    :func:`cached_decode` — one body, so the two modes' gumbel/gaussian
+    arithmetic cannot drift apart.  ``logits`` is ``(B, adim)`` for position
+    ``i``; returns ``(act, logp, nxt)`` with ``nxt`` the next step's
+    shifted-action feed ``(B, 1, action_input_dim)``.
+    """
+    adim, in_dim = cfg.action_dim, cfg.action_input_dim
+    if cfg.action_type == DISCRETE:
+        act, logp, nxt = _discrete_branch(logits, ava_i, k_d, deterministic, adim, in_dim)
+    elif cfg.action_type == SEMI_DISCRETE:
+        d_act, d_logp, d_nxt = _discrete_branch(logits, ava_i, k_d, deterministic, adim, in_dim)
+        c_act = logits if deterministic else D.normal_sample_from_noise(logits, std, noise_i)
+        c_logp = D.normal_log_prob(logits, std, c_act)
+        is_cont = i >= cfg.n_discrete_agents
+        act = jnp.where(is_cont, c_act[:, -1:], d_act)
+        logp = jnp.where(is_cont, c_logp[:, -1:], d_logp)
+        nxt = d_nxt  # the continuous agent is last; its feed is never used
+    elif cfg.action_type == CONTINUOUS:
+        act, logp = _continuous_branch(logits, std, k_c, deterministic)
+        nxt = act[:, None, :]
+    else:  # AVAILABLE_CONTINUOUS (transformer_act.py:244-283)
+        dd = cfg.discrete_dim
+        d_logits = D.mask_logits(logits[:, :dd], ava_i[:, :dd])
+        d_idx = (
+            D.categorical_mode(d_logits) if deterministic else D.categorical_sample(k_d, d_logits)
+        )
+        d_logp = D.categorical_log_prob(d_logits, d_idx)
+        d_onehot = jax.nn.one_hot(d_idx, dd, dtype=jnp.float32)
+        c_std = std[dd:]
+        c_mean = logits[:, dd:]
+        c_act = c_mean if deterministic else D.normal_sample(k_c, c_mean, c_std)
+        c_logp = D.normal_log_prob(c_mean, c_std, c_act)
+        act = jnp.concatenate([d_onehot, c_act], axis=-1)
+        logp = jnp.concatenate([d_logp[:, None], c_logp], axis=-1)
+        nxt = jnp.zeros((B, 1, in_dim), jnp.float32).at[:, 0, 1:].set(act)
+    return act, logp, nxt
+
+
+# ---------------------------------------------------------------------------
+# Cached decode (exact; O(1) new work per step against a packed KV buffer)
+# ---------------------------------------------------------------------------
+
+def cached_decode(
+    model: MultiAgentTransformer,
+    params,
+    key: jax.Array,
+    obs_rep: jax.Array,
+    available_actions: Optional[jax.Array],
+    deterministic: bool = False,
+) -> DecodeResult:
+    """O(1)-per-step autoregressive decode, bit-exact to :func:`ar_decode`.
+
+    The scan path re-derives per-step state the compiler cannot hoist: every
+    position re-projects its cross-attn query from ``obs_rep`` and the raw
+    ``(B, L, D)`` caches are head-split inside every attention.  This path
+    restructures the decode around a packed cache so each step's *new* work
+    is exactly one position:
+
+      - K/V live pre-split in two stacked ``(2 * n_block, B, H, A, Dh)``
+        buffers (``modules.init_packed_cache``) — plane ``2b`` is block b's
+        self-attn, plane ``2b + 1`` its cross-attn — written with one
+        ``dynamic_update_slice`` column per plane per step and attended with
+        a ``position <= i`` mask.
+      - Cross-attn queries for all A positions are hoisted out of the scan
+        into one batched projection per block (``decode_queries``), since
+        ``obs_rep`` is fully known before the loop starts.
+
+    Bit-exactness rests on three XLA identities pinned in
+    tests/test_cached_decode.py: batched-then-sliced dense == per-step dense
+    on the slice; attention over a pre-split cache == split of the raw cache;
+    and a head-split ``dynamic_update_slice`` == splitting the raw-updated
+    buffer.  Sampling reuses :func:`_sample_position` and the scan's own
+    ``key, k_d, k_c = split(key, 3)`` chain, so actions AND log-probs match
+    ``mode="scan"`` bitwise, deterministic or stochastic.
+
+    Raises for ``dec_actor`` (no decoder trunk to cache); ``serve_decode``
+    falls back to the scan path for that ablation.
+    """
+    cfg = model.cfg
+    if cfg.dec_actor:
+        raise ValueError("cached_decode does not support dec_actor (no "
+                         "decoder trunk to cache); use mode='scan'")
+    B = obs_rep.shape[0]
+    A, adim = cfg.n_agent, cfg.action_dim
+    in_dim = cfg.action_input_dim
+
+    if available_actions is None:
+        available_actions = jnp.ones((B, A, adim), jnp.float32)
+
+    has_cont = cfg.action_type != DISCRETE
+    std = _action_std(model, params) if has_cont else None
+
+    start_token = jnp.zeros((B, 1, in_dim), jnp.float32)
+    if cfg.action_type in (DISCRETE, SEMI_DISCRETE, AVAILABLE_CONTINUOUS):
+        start_token = start_token.at[:, 0, 0].set(1.0)  # transformer_act.py:33
+
+    # identical tail-noise precompute to ar_decode (same key chain)
+    tail_noise = jnp.zeros((A, B, adim), jnp.float32)
+    if cfg.action_type == SEMI_DISCRETE and not deterministic:
+        nd = cfg.n_discrete_agents
+        if A - nd > 0:
+            _, (_, kcs) = jax.lax.scan(
+                lambda k, _: (lambda ks: (ks[0], (ks[1], ks[2])))(jax.random.split(k, 3)),
+                key, None, length=A,
+            )
+            tail_noise = tail_noise.at[nd:].set(
+                jax.vmap(lambda k: jax.random.normal(k, (B, adim), jnp.float32))(kcs[nd:])
+            )
+
+    kv = model.fresh_packed_cache(B)
+    q2 = model.apply(params, obs_rep, method="decode_queries")  # (n_block,B,H,A,Dh)
+
+    # per-position inputs ride the scan xs (leading-axis slicing is free)
+    # instead of a dynamic_slice gather per step; transposes of identical
+    # values, so bit-exactness vs the scan path's slices is preserved
+    rep_x = jnp.swapaxes(obs_rep, 0, 1)[:, :, None, :]       # (A, B, 1, D)
+    q2_x = jnp.moveaxis(q2, 3, 0)[:, :, :, :, None, :]       # (A, nb, B, H, 1, Dh)
+    ava_x = jnp.swapaxes(available_actions, 0, 1)            # (A, B, adim)
+
+    def body(carry, xs):
+        i, noise_i, rep_i, q2_i, ava_i = xs
+        kv, shifted_in, key = carry
+        key, k_d, k_c = jax.random.split(key, 3)
+        logits, kv = model.apply(
+            params, shifted_in, rep_i, q2_i, kv, i, method="decode_step_cached"
+        )
+        act, logp, nxt = _sample_position(
+            cfg, logits[:, 0], ava_i, i, noise_i, k_d, k_c, std, deterministic, B
+        )
+        return (kv, nxt, key), (act, logp)
+
+    with named_scope("mat/cached_decode"):
+        (_, _, _), (acts, logps) = jax.lax.scan(
+            body, (kv, start_token, key),
+            (jnp.arange(A), tail_noise, rep_x, q2_x, ava_x),
+        )
+    action = jnp.swapaxes(acts, 0, 1)
+    log_prob = jnp.swapaxes(logps, 0, 1)
+    probe("mat/cached_decode", {"action": action, "log_prob": log_prob})
+    return DecodeResult(action, log_prob)
 
 
 # ---------------------------------------------------------------------------

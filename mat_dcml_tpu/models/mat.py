@@ -33,6 +33,7 @@ from mat_dcml_tpu.models.modules import (
     GAIN_ACT,
     dense,
     init_decode_cache,
+    init_packed_cache,
 )
 from mat_dcml_tpu.telemetry.scopes import named_scope, probe
 
@@ -243,6 +244,56 @@ class Decoder(nn.Module):
                 new_caches.append(cache)
             return self.head(x), new_caches
 
+    def decode_queries(self, obs_rep: jax.Array) -> jax.Array:
+        """Hoisted cross-attn query projections for the cached decode.
+
+        ``obs_rep`` is fully known before the decode loop starts, so every
+        block's attn2 query projection — ``query_p(rep_i)`` inside
+        ``decode_step`` — can be computed for all A positions in one batched
+        matmul per block.  Returns ``(n_block, B, H, A, Dh)``; slicing
+        position ``i`` reproduces the per-step projection bit-for-bit
+        (tests/test_cached_decode.py).  Not supported for ``dec_actor``.
+        """
+        if self.cfg.dec_actor:
+            raise ValueError("decode_queries does not support dec_actor")
+        return jnp.stack(
+            [blk.attn2.project_q_heads(obs_rep) for blk in self.blocks]
+        )
+
+    def decode_step_cached(self, shifted_action_i: jax.Array, rep_i: jax.Array,
+                           q2_i: jax.Array, kv, i):
+        """One autoregressive position against the packed head-split cache.
+
+        The O(1)-per-step twin of :meth:`decode_step`: K/V live pre-split in
+        two stacked ``(2 * n_block, B, H, A, Dh)`` buffers and the cross-attn
+        queries arrive pre-projected, so each step's new work is one column
+        write and one masked attention per plane.  Bit-exact to
+        :meth:`decode_step` (tests/test_cached_decode.py).
+
+        Args:
+          shifted_action_i: ``(B, 1, action_input_dim)`` previous agent's
+            (one-hot) action, or the start token at i = 0.
+          rep_i: ``(B, 1, n_embd)`` encoder rep at position i.
+          q2_i: ``(n_block, B, H, 1, Dh)`` pre-projected cross-attn queries
+            at position i (a slice of :meth:`decode_queries`).
+          kv: ``(k_buf, v_buf)`` packed cache pair.
+          i: scalar agent index.
+
+        Returns:
+          ``(B, 1, action_dim)`` logits and the updated ``(k_buf, v_buf)``.
+        """
+        with named_scope("mat/decoder_step_cached"):
+            if self.cfg.dec_actor:
+                raise ValueError("decode_step_cached does not support dec_actor")
+            x = self.ln(self._embed_action(shifted_action_i))
+            A = kv[0].shape[3]
+            valid = jnp.arange(A) <= i
+            for bi, blk in enumerate(self.blocks):
+                x, kv = blk.decode_step_packed(
+                    x, rep_i, q2_i[bi], kv, 2 * bi, i, valid
+                )
+            return self.head(x), kv
+
     def decode_block(self, shifted_action_w: jax.Array, rep_w: jax.Array, caches, start):
         """A window of ``K`` consecutive positions with KV caches (the
         speculative draft-verify pass).  Not supported for ``dec_actor`` —
@@ -306,9 +357,23 @@ class MultiAgentTransformer(nn.Module):
     def decode_block(self, shifted_action_w, rep_w, caches, start):
         return self.decoder.decode_block(shifted_action_w, rep_w, caches, start)
 
+    def decode_queries(self, obs_rep):
+        return self.decoder.decode_queries(obs_rep)
+
+    def decode_step_cached(self, shifted_action_i, rep_i, q2_i, kv, i):
+        return self.decoder.decode_step_cached(shifted_action_i, rep_i, q2_i, kv, i)
+
     def action_std(self):
         return self.decoder.std()
 
     def fresh_cache(self, batch: int, dtype=None):
         dtype = dtype if dtype is not None else self.cfg.np_dtype
         return init_decode_cache(self.cfg.n_block, batch, self.cfg.n_agent, self.cfg.n_embd, dtype)
+
+    def fresh_packed_cache(self, batch: int, dtype=None):
+        """Packed head-split K/V pair for :meth:`decode_step_cached`."""
+        dtype = dtype if dtype is not None else self.cfg.np_dtype
+        return init_packed_cache(
+            self.cfg.n_block, batch, self.cfg.n_agent, self.cfg.n_embd,
+            self.cfg.n_head, dtype,
+        )

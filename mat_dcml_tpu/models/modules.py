@@ -69,6 +69,42 @@ class SelfAttention(nn.Module):
         """Raw (pre-head-split) key/value projections for cache writes."""
         return self.key_p(x), self.value_p(x)
 
+    def project_q_heads(self, x: jax.Array) -> jax.Array:
+        """Head-split query projection ``(B, L, D) -> (B, H, L, Dh)``.
+
+        Position-independent, so the cached decode hoists it out of the scan:
+        one ``(B, A, D)`` matmul replaces A per-step ``(B, 1, D)`` matmuls.
+        Slicing a row of the batched result is bitwise-equal to projecting
+        that row alone (pinned in tests/test_cached_decode.py)."""
+        return split_heads(self.query_p(x), self.n_head)
+
+    def project_kv_heads(self, x: jax.Array):
+        """Head-split key/value projections for packed-cache writes.
+
+        ``split_heads`` is pure data movement, so storing the cache head-split
+        holds exactly the values :meth:`attend_cached` reconstructs per step —
+        minus the per-step whole-cache transpose."""
+        return (
+            split_heads(self.key_p(x), self.n_head),
+            split_heads(self.value_p(x), self.n_head),
+        )
+
+    def attend_heads(self, q_heads: jax.Array, k_heads: jax.Array,
+                     v_heads: jax.Array, kv_mask: jax.Array) -> jax.Array:
+        """Attention over an already-head-split query and cache.
+
+        Same einsum/softmax program as :meth:`attend_cached` — the operands
+        are value-identical (head-splitting commutes with the cache write),
+        so the cached decode path stays bit-exact to the scan path.
+
+        Args:
+          q_heads: ``(B, H, Lq, Dh)`` head-split projected queries.
+          k_heads / v_heads: ``(B, H, L, Dh)`` head-split cache planes.
+          kv_mask: ``(L,)`` validity mask.
+        """
+        y = multi_head_attention(q_heads, k_heads, v_heads, kv_mask=kv_mask)
+        return self.proj(merge_heads(y))
+
     def attend_cached(self, query: jax.Array, k_cache: jax.Array, v_cache: jax.Array, kv_mask: jax.Array) -> jax.Array:
         """Attention for a single query position over a static-length cache.
 
@@ -189,6 +225,48 @@ class DecodeBlock(nn.Module):
 
         return self.ln3(h2 + self.mlp(h2)), cache
 
+    def decode_step_packed(self, x: jax.Array, rep_i: jax.Array,
+                           q2_i: jax.Array, kv, layer: int, i: jax.Array,
+                           valid: jax.Array):
+        """Single-position decode against the packed head-split KV cache.
+
+        The O(1)-per-step layout: K/V live pre-head-split in two stacked
+        ``(n_layers, B, H, A, Dh)`` buffers (this block owns planes ``layer``
+        for attn1 and ``layer + 1`` for attn2), each step writes one
+        ``dynamic_update_slice`` column per plane and attends against the
+        buffer directly — no per-step whole-cache ``split_heads`` transpose,
+        and the cross-attn query ``q2_i`` arrives pre-projected (hoisted out
+        of the scan by ``Decoder.decode_queries``).  Bit-exact to
+        :meth:`decode_step` (tests/test_cached_decode.py).
+
+        Args:
+          x: ``(B, 1, D)`` this position's input embedding.
+          rep_i: ``(B, 1, D)`` encoder representation at position i.
+          q2_i: ``(B, H, 1, Dh)`` pre-projected cross-attn query at i.
+          kv: ``(k_buf, v_buf)`` each ``(n_layers, B, H, A, Dh)``.
+          layer: static plane index of this block's attn1 (attn2 = layer + 1).
+          i: scalar position index.
+          valid: ``(A,)`` mask, True at positions ``<= i``.
+
+        Returns:
+          ``(B, 1, D)`` block output and the updated ``(k_buf, v_buf)``.
+        """
+        k_buf, v_buf = kv
+        k1h, v1h = self.attn1.project_kv_heads(x)
+        k_buf = jax.lax.dynamic_update_slice(k_buf, k1h[None], (layer, 0, 0, i, 0))
+        v_buf = jax.lax.dynamic_update_slice(v_buf, v1h[None], (layer, 0, 0, i, 0))
+        q1 = self.attn1.project_q_heads(x)
+        y = self.attn1.attend_heads(q1, k_buf[layer], v_buf[layer], valid)
+        h = self.ln1(x + y)
+
+        k2h, v2h = self.attn2.project_kv_heads(h)
+        k_buf = jax.lax.dynamic_update_slice(k_buf, k2h[None], (layer + 1, 0, 0, i, 0))
+        v_buf = jax.lax.dynamic_update_slice(v_buf, v2h[None], (layer + 1, 0, 0, i, 0))
+        y2 = self.attn2.attend_heads(q2_i, k_buf[layer + 1], v_buf[layer + 1], valid)
+        h2 = self.ln2(rep_i + y2)
+
+        return self.ln3(h2 + self.mlp(h2)), (k_buf, v_buf)
+
     def decode_block(self, x: jax.Array, rep_w: jax.Array, cache: dict, start: jax.Array):
         """Windowed multi-position decode with KV caches (speculative decode).
 
@@ -245,3 +323,23 @@ def init_decode_cache(n_block: int, batch: int, length: int, n_embd: int, dtype=
     """Fresh per-block KV caches for autoregressive decoding."""
     blk = lambda: {k: jnp.zeros((batch, length, n_embd), dtype) for k in ("k1", "v1", "k2", "v2")}
     return [blk() for _ in range(n_block)]
+
+
+def init_packed_cache(n_block: int, batch: int, length: int, n_embd: int,
+                      n_head: int, dtype=jnp.float32):
+    """Fresh packed head-split KV cache for the O(1) cached decode.
+
+    One stacked ``(2 * n_block, B, H, A, Dh)`` buffer per K/V — two attention
+    planes per decoder block (attn1 self-attn, attn2 cross-attn).  Fixed shape
+    per batch bucket; each decode step writes one column per plane with
+    ``dynamic_update_slice``.
+    """
+    shape = (2 * n_block, batch, n_head, length, n_embd // n_head)
+    return jnp.zeros(shape, dtype), jnp.zeros(shape, dtype)
+
+
+def packed_cache_bytes(n_block: int, batch: int, length: int, n_embd: int,
+                       dtype=jnp.float32) -> int:
+    """Host-side size of one :func:`init_packed_cache` allocation (K + V)."""
+    itemsize = jnp.dtype(dtype).itemsize
+    return 2 * (2 * n_block) * batch * length * n_embd * itemsize

@@ -35,22 +35,35 @@ class EngineConfig:
     batcher pads each dispatch up to the smallest bucket that fits."""
 
     buckets: Tuple[int, ...] = (1, 8, 32, 128)
-    # "scan" (exact sequential) | "spec" (speculative draft-verify, bit-exact
-    # to scan — models/decode.py:spec_decode) | "stride" (block-commit
-    # approximation, benchmark-protocol parity only)
-    decode_mode: str = "scan"
+    # "cached" (O(1)-per-step packed-KV decode, bit-exact to scan —
+    # models/decode.py:cached_decode) | "scan" (exact sequential) | "spec"
+    # (speculative draft-verify, bit-exact to scan — spec_decode) | "stride"
+    # (block-commit approximation, benchmark-protocol parity only)
+    decode_mode: str = "cached"
     stride: int = 2
     spec_block: int = 8           # speculative window K
     deterministic: bool = True
+    # serving trunk precision: "f32" (exact — the training dtype) | "bf16"
+    # (params cast at install time, trunk matmuls + KV cache in bfloat16;
+    # heads/log_std/softmax stay f32).  A dtype flip is a *different
+    # compiled program* — it must ride an engine (re)construction, never the
+    # weight-swap path; the fleet gates a bf16 rollout behind the canary
+    # controller with value-tolerance (not bit-parity) comparison.
+    serve_dtype: str = "f32"
 
     def __post_init__(self):
         if not self.buckets:
             raise ValueError("EngineConfig.buckets must be non-empty")
         if list(self.buckets) != sorted(set(self.buckets)):
             raise ValueError(f"buckets must be strictly ascending, got {self.buckets}")
-        if self.decode_mode not in ("scan", "stride", "spec"):
+        if self.decode_mode not in ("scan", "stride", "spec", "cached"):
             raise ValueError(
-                f"decode_mode must be 'scan', 'stride' or 'spec', got {self.decode_mode!r}"
+                "decode_mode must be 'cached', 'scan', 'stride' or 'spec', "
+                f"got {self.decode_mode!r}"
+            )
+        if self.serve_dtype not in ("f32", "bf16"):
+            raise ValueError(
+                f"serve_dtype must be 'f32' or 'bf16', got {self.serve_dtype!r}"
             )
 
 
@@ -74,22 +87,31 @@ class DecodeEngine:
         # (params, key, request arrays) is placed there so the AOT executables
         # never see a cross-device argument
         self.device = device
-        self._params = self._put(params)   # resident once, shared by all buckets
+        # the dtype the decode programs are compiled against: bf16 runs the
+        # trunk (and KV cache) in bfloat16 while heads/log_std stay f32
+        self._bf16 = engine_cfg.serve_dtype == "bf16"
+        self._serve_cfg = (
+            dataclasses.replace(cfg, dtype="bfloat16") if self._bf16 else cfg
+        )
+        self._zero_batches = {}            # bucket -> resident zero inputs
+        self._params = self._prepare_params(params)  # resident once, all buckets
         ecfg = engine_cfg
+        serve_cfg = self._serve_cfg
 
         self._spec = ecfg.decode_mode == "spec"
+        self._cached = ecfg.decode_mode == "cached"
 
         def _decode(params, key, state, obs, avail):
             if ecfg.decode_mode == "spec":
                 _, res, stats = serve_decode(
-                    cfg, params, key, state, obs, avail,
+                    serve_cfg, params, key, state, obs, avail,
                     deterministic=ecfg.deterministic,
                     mode="spec", spec_block=ecfg.spec_block,
                     return_spec_stats=True,
                 )
                 return res.action, res.log_prob, stats
             _, res = serve_decode(
-                cfg, params, key, state, obs, avail,
+                serve_cfg, params, key, state, obs, avail,
                 deterministic=ecfg.deterministic,
                 mode=ecfg.decode_mode, stride=ecfg.stride,
             )
@@ -107,6 +129,28 @@ class DecodeEngine:
         if self.device is not None:
             return jax.device_put(tree, self.device)
         return jax.device_put(tree)
+
+    def _prepare_params(self, params):
+        """Device-place an artifact, casting the trunk to the serve dtype.
+
+        With ``serve_dtype="bf16"`` every float32 leaf is cast to bfloat16
+        EXCEPT head and ``log_std`` leaves: logits/values feed distributions
+        and the action std parameterization, which stay float32 by the Head
+        contract (models/mat.py).  f32 serving is a pure device_put — training
+        artifacts pass through bit-identically.
+        """
+        if not self._bf16:
+            return self._put(params)
+
+        def cast(path, leaf):
+            names = [getattr(p, "key", getattr(p, "name", "")) for p in path]
+            if "head" in names or "log_std" in names:
+                return leaf
+            if hasattr(leaf, "dtype") and leaf.dtype == jnp.float32:
+                return leaf.astype(jnp.bfloat16)
+            return leaf
+
+        return self._put(jax.tree_util.tree_map_with_path(cast, params))
 
     # ------------------------------------------------------------- lifecycle
 
@@ -139,14 +183,36 @@ class DecodeEngine:
                 f"[serving] bucket {b}: compiled in {time.perf_counter() - t0:.1f}s"
             )
         self._decode.mark_steady()
-        self.telemetry.gauge("serving_buckets", float(len(self.engine_cfg.buckets)))
+        tel = self.telemetry
+        tel.gauge("serving_buckets", float(len(self.engine_cfg.buckets)))
+        tel.gauge("serving_dtype_bits", 16.0 if self._bf16 else 32.0)
+        if self._cached:
+            # the packed-cache footprint is a static function of (bucket,
+            # model shape, serve dtype) — publish the whole ladder's
+            # arithmetic up front so capacity planning needs no live traffic
+            from mat_dcml_tpu.models.modules import packed_cache_bytes
+
+            cfg = self._serve_cfg
+            for b in self.engine_cfg.buckets:
+                tel.gauge(
+                    f"decode_cache_bytes_b{b}",
+                    float(packed_cache_bytes(
+                        cfg.n_block, b, cfg.n_agent, cfg.n_embd, cfg.np_dtype
+                    )),
+                )
 
     def _zero_batch(self, b: int):
-        cfg = self.cfg
-        state = self._put(jnp.zeros((b, cfg.n_agent, cfg.state_dim), jnp.float32))
-        obs = self._put(jnp.zeros((b, cfg.n_agent, cfg.obs_dim), jnp.float32))
-        avail = self._put(jnp.ones((b, cfg.n_agent, cfg.action_dim), jnp.float32))
-        return state, obs, avail
+        # memoized per bucket: install_params warms the whole ladder on every
+        # weight swap, and rebuilding the zero inputs each time paid a host
+        # alloc + H2D transfer per bucket per swap for arrays that never change
+        if b not in self._zero_batches:
+            cfg = self.cfg
+            self._zero_batches[b] = (
+                self._put(jnp.zeros((b, cfg.n_agent, cfg.state_dim), jnp.float32)),
+                self._put(jnp.zeros((b, cfg.n_agent, cfg.obs_dim), jnp.float32)),
+                self._put(jnp.ones((b, cfg.n_agent, cfg.action_dim), jnp.float32)),
+            )
+        return self._zero_batches[b]
 
     # ---------------------------------------------------------- weight swap
 
@@ -165,7 +231,7 @@ class DecodeEngine:
         (dtype/shape) and the caller should roll back before promoting.
         """
         before = self.compile_count()
-        new_params = self._put(params)
+        new_params = self._prepare_params(params)
         if warm:
             for b in self.engine_cfg.buckets:
                 out = self._decode(new_params, self._key, *self._zero_batch(b))
@@ -231,6 +297,15 @@ class DecodeEngine:
                       accepted / offered if offered > 0 else 1.0)
         else:
             action, log_prob = out
+            if self._cached:
+                # static per-program facts, re-asserted per dispatch so the
+                # gauge family tracks the bucket actually serving: each step
+                # attends i+1 positions of which i came from the cache, so
+                # the cache serves sum(i)/sum(i+1) = (A-1)/(A+1) of positions
+                A = self.cfg.n_agent
+                tel = self.telemetry
+                tel.gauge("decode_cache_steps", float(A))
+                tel.gauge("decode_cache_hit_fraction", (A - 1) / (A + 1))
         result = (np.asarray(action), np.asarray(log_prob))
         # server-side decode latency sketch, host-materialized (the dispatch
         # itself is async): every decode path lands here — batcher dispatch,

@@ -667,8 +667,13 @@ class EngineFleet:
             report["wall_s"] = time.perf_counter() - t_start
             return report
 
+        # a bf16 trunk is a healthy ~1e-2 relative off the f32 incumbent:
+        # the gate swaps to the widened value tolerances instead of reading
+        # opted-into precision as a corrupt artifact (bit-parity on greedy
+        # actions stays, budgeted by max_mismatch_frac as always)
         controller = RolloutController(
-            self.rollout_cfg, prior_generation, generation,
+            self.rollout_cfg.effective_for(self.engine_cfg.serve_dtype),
+            prior_generation, generation,
             telemetry=self.telemetry, log_fn=self.log)
         with self._lock:
             canary.state = CANARY_STATE

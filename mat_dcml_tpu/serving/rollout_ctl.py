@@ -52,11 +52,32 @@ class RolloutConfig:
                                      # or wrong-model artifact moves far more)
     value_rtol: float = 1e-4        # log-prob/value head tolerance vs incumbent
     value_atol: float = 1e-5
+    # widened value tolerances for a bf16 serving trunk (EngineConfig.
+    # serve_dtype="bf16"): bfloat16's 8-bit mantissa moves log-probs by
+    # ~1e-2 relative on a healthy artifact, which the f32 tolerances would
+    # read as a corrupt push.  The canary gate stays armed — a genuinely
+    # wrong artifact overshoots these too — it just stops punishing the
+    # precision the operator opted into.  Greedy-action comparison remains
+    # exact either way (argmax flips are already budgeted by
+    # max_mismatch_frac).
+    bf16_value_rtol: float = 2e-2
+    bf16_value_atol: float = 1e-3
     latency_factor: float = 4.0     # canary latency trip vs incumbent EMA
     latency_warmup: int = 8         # incumbent samples before the trip arms
     error_budget: int = 0           # canary request errors tolerated
     canary_timeout_s: float = 30.0  # give up (-> rollback) if comparisons stall
     synthetic_interval_s: float = 0.01  # pusher-driven shadow probe cadence
+
+    def effective_for(self, serve_dtype: str) -> "RolloutConfig":
+        """The config the gate should actually run with for an engine serving
+        at ``serve_dtype`` — swaps the value tolerances to the bf16 pair when
+        the trunk is lossy, identity otherwise."""
+        if serve_dtype != "bf16":
+            return self
+        return dataclasses.replace(
+            self, value_rtol=self.bf16_value_rtol,
+            value_atol=self.bf16_value_atol,
+        )
 
 
 class RolloutController:

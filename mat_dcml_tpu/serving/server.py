@@ -348,7 +348,8 @@ def main(argv=None) -> None:
 
     Usage: python -m mat_dcml_tpu.serving.server --policy_dir <export>
            [--port 8420] [--buckets 1,8,32,128] [--max_batch_wait_ms 2.0]
-           [--max_queue 256] [--decode_mode scan|stride|spec] [--spec_block 8]
+           [--max_queue 256] [--decode_mode cached|scan|stride|spec]
+           [--spec_block 8] [--serve_dtype f32|bf16]
     """
     import argparse
 
@@ -360,8 +361,13 @@ def main(argv=None) -> None:
     p.add_argument("--buckets", default="1,8,32,128")
     p.add_argument("--max_batch_wait_ms", type=float, default=2.0)
     p.add_argument("--max_queue", type=int, default=256)
-    p.add_argument("--decode_mode", default="scan", choices=("scan", "stride", "spec"))
+    p.add_argument("--decode_mode", default="cached",
+                   choices=("cached", "scan", "stride", "spec"))
     p.add_argument("--spec_block", type=int, default=8)
+    p.add_argument("--serve_dtype", default="f32", choices=("f32", "bf16"),
+                   help="serving trunk precision; bf16 casts params at "
+                        "install time and is gated by value-tolerance (not "
+                        "bit-parity) canary comparison in fleet mode")
     p.add_argument("--run_dir", default=None,
                    help="observability output dir (enables trace.jsonl)")
     p.add_argument("--trace_sample", type=float, default=0.01,
@@ -383,6 +389,7 @@ def main(argv=None) -> None:
             buckets=tuple(int(b) for b in args.buckets.split(",")),
             decode_mode=args.decode_mode,
             spec_block=args.spec_block,
+            serve_dtype=args.serve_dtype,
         ),
     )
     server = PolicyServer(

@@ -102,7 +102,7 @@ def build_mat_policy(run: RunConfig, env: DCMLEnv) -> TransformerPolicy:
         # stride= arg); it cannot sample, so it cannot collect rollouts.
         raise ValueError(
             "decode_mode='stride' is eval-only (see DCMLRunner.evaluate); "
-            "training collect needs 'scan' or 'spec'"
+            "training collect needs 'cached', 'scan', or 'spec'"
         )
     return TransformerPolicy(cfg, decode_mode=run.decode_mode, spec_block=run.spec_block)
 

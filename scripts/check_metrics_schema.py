@@ -126,6 +126,12 @@ KNOWN_PREFIXES = (
     # multi-window error-budget burn rates (slo_<obj>_burn/_burn_fast/
     # _burn_slow for latency/error/goodput) plus the window request count
     "slo_",
+    # cached-decode gauges (serving/engine.py, decode_mode="cached"): packed
+    # KV footprint per bucket (decode_cache_bytes_b<B> — a static function of
+    # bucket × model shape × serve dtype, published at warmup), scan length
+    # (decode_cache_steps = n_agent), and the fraction of attended positions
+    # served from the cache (decode_cache_hit_fraction = (A-1)/(A+1))
+    "decode_cache_",
 )
 
 # registry suffixes a histogram sketch appends on flush (registry.py
@@ -142,7 +148,9 @@ STRICT_FAMILY_PATTERNS = {
         r"|buckets|weight_swaps|shed|requests|queue_depth|deadline_misses"
         r"|degraded_ok|degraded_batches|degraded_failed|engine_failures"
         r"|batches|bucket_\d+|batch_fill|engine_ms|latency_ms|queue_wait_ms"
-        r"|decode_ms)(_max|_sum|_p50|_p95|_p99|_count|_mean)?$"),
+        r"|decode_ms|dtype_bits)(_max|_sum|_p50|_p95|_p99|_count|_mean)?$"),
+    "decode_cache_": re.compile(
+        r"^decode_cache_(bytes_b\d+|steps|hit_fraction)$"),
     "fleet_": re.compile(
         r"^fleet_(replicas|healthy|requests|retries|retries_exhausted"
         r"|attempt_timeouts|shed|no_healthy|unhealthy_marks|readmissions"
@@ -184,8 +192,10 @@ NON_NEGATIVE = (
     "scenario_count", "scenario_spread", "scenario_specialist_count",
 )
 
-# rates that must stay within [0, 1] (acceptance is accepted/offered)
-UNIT_INTERVAL = ("decode_spec_accept_rate", "dispatch_fused_fallback")
+# rates that must stay within [0, 1] (acceptance is accepted/offered; the
+# cache hit fraction is cached/attended positions)
+UNIT_INTERVAL = ("decode_spec_accept_rate", "dispatch_fused_fallback",
+                 "decode_cache_hit_fraction")
 
 # a serving record (identified by serving_qps) must carry the benchmark
 # contract BENCHLOG consumes: throughput, latency percentiles, shed rate
@@ -408,7 +418,8 @@ def validate_record(record, index: int = 0, strict_names: bool = True,
             continue
         if (k in NON_NEGATIVE
                 or k.startswith(("serving_", "fleet_", "rollout_", "shard_",
-                                 "resilience_", "slo_"))) and v < 0:
+                                 "resilience_", "slo_",
+                                 "decode_cache_"))) and v < 0:
             errs.append(f"{where}: field {k!r} is negative ({v})")
         if k in UNIT_INTERVAL and not (0.0 <= v <= 1.0):
             errs.append(f"{where}: field {k!r} must be in [0, 1], got {v}")

@@ -1,12 +1,11 @@
 #!/bin/sh
-# Sweep the speculative-decode window K: BENCH_SPEC_DECODE drives bench.py's
-# spec-vs-scan A/B (models/decode.py:spec_decode, bit-exactness asserted
-# before timing) once per K and emits one json record per K plus the best.
-# The interesting trade: larger K means fewer draft-verify passes when
-# acceptance is high but more wasted window compute per rejection.  Default
-# E is the production DCML rollout batch; on CPU the numbers are protocol
-# checks, not the TPU speedup of record — export JAX_PLATFORMS/BENCH_SPEC_E
-# on a chip session for the real curve.
+# DEPRECATED: superseded by scripts/decode_sweep.sh, which sweeps all three
+# decode modes (scan | spec | cached) through the serving bucket ladder with
+# one comparison table.  This shim keeps the historical spec-K sweep working
+# for existing automation: BENCH_SPEC_DECODE drives bench.py's spec-vs-scan
+# A/B (models/decode.py:spec_decode, bit-exactness asserted before timing)
+# once per K and emits one json record per K plus the best.
+echo "spec_decode_sweep.sh is deprecated; use scripts/decode_sweep.sh" >&2
 cd "$(dirname "$0")/.."
 exec env \
   JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \

@@ -1,0 +1,459 @@
+"""O(1) KV-cached decode (models/decode.py:cached_decode) contract tests.
+
+The load-bearing claim: ``mode="cached"`` — the default — is BIT-EXACT to
+``mode="scan"``, actions AND log-probs, deterministic and stochastic, while
+replacing the scan path's per-step whole-cache head-split and per-step
+cross-attn query projection with a packed pre-split cache and one hoisted
+batched projection.
+
+Exactness rests on three XLA identities, each pinned standalone here:
+
+1. a batched dense then a row slice == the dense applied to the slice
+   (``project_q_heads`` hoisting);
+2. attention over a pre-head-split cache == attention that splits the raw
+   cache per step (``attend_heads`` vs ``attend_cached``);
+3. a head-split ``dynamic_update_slice`` column write == head-splitting the
+   raw-updated buffer (the packed cache write).
+
+Also pinned: the serving engine's cached bucket-ladder programs (padding
+included, zero steady-state recompiles, weight-only swaps reuse the compiled
+executables in f32 AND bf16), parity under the fused K>1 training dispatch
+and at N>1 multi-scenario, the bf16 serving trunk's distance from f32 on the
+production DCML preset, and the canary gate's bf16 tolerance swap +
+auto-rollback.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mat_dcml_tpu.models import decode as decode_lib
+from mat_dcml_tpu.models.decode import cached_decode, serve_decode
+from mat_dcml_tpu.models.mat import (
+    AVAILABLE_CONTINUOUS,
+    CONTINUOUS,
+    DISCRETE,
+    SEMI_DISCRETE,
+    MATConfig,
+)
+from mat_dcml_tpu.models.modules import (
+    DecodeBlock,
+    init_packed_cache,
+    packed_cache_bytes,
+    split_heads,
+)
+from mat_dcml_tpu.models.policy import TransformerPolicy
+from mat_dcml_tpu.serving.engine import DecodeEngine, EngineConfig
+from mat_dcml_tpu.serving.rollout_ctl import RolloutConfig
+from tests.test_decode import make_policy, rollout_inputs
+
+
+def _serve(cfg, params, state, obs, ava, deterministic, mode):
+    return serve_decode(
+        cfg, params, jax.random.key(42), state, obs, ava,
+        deterministic=deterministic, mode=mode,
+    )
+
+
+# ------------------------------------------------------------ scan bit-parity
+
+
+@pytest.mark.parametrize(
+    "action_type", [DISCRETE, SEMI_DISCRETE, CONTINUOUS, AVAILABLE_CONTINUOUS]
+)
+@pytest.mark.parametrize("deterministic", [True, False])
+def test_cached_bit_exact_vs_scan(action_type, deterministic):
+    """Actions, log-probs and values identical bit-for-bit for every action
+    family, sampled and greedy (the stochastic case exercises the shared
+    ``key, k_d, k_c`` chain + SEMI_DISCRETE tail-noise precompute)."""
+    kw = {}
+    if action_type == SEMI_DISCRETE:
+        kw["semi_index"] = -1
+    if action_type == AVAILABLE_CONTINUOUS:
+        kw["discrete_dim"] = 2
+    pol, params = make_policy(action_type, **kw)
+    cfg = pol.cfg
+    state, obs, ava = rollout_inputs(cfg)
+    if action_type == CONTINUOUS:
+        ava = None
+    v1, r1 = _serve(cfg, params, state, obs, ava, deterministic, "scan")
+    v2, r2 = _serve(cfg, params, state, obs, ava, deterministic, "cached")
+    assert np.array_equal(np.asarray(r1.action), np.asarray(r2.action))
+    assert np.array_equal(np.asarray(r1.log_prob), np.asarray(r2.log_prob))
+    assert np.array_equal(np.asarray(v1), np.asarray(v2))
+
+
+def test_cached_available_actions_none():
+    """``available_actions=None`` synthesizes the all-ones mask identically."""
+    pol, params = make_policy(DISCRETE)
+    cfg = pol.cfg
+    state, obs, _ = rollout_inputs(cfg)
+    v1, r1 = _serve(cfg, params, state, obs, None, False, "scan")
+    v2, r2 = _serve(cfg, params, state, obs, None, False, "cached")
+    assert np.array_equal(np.asarray(r1.action), np.asarray(r2.action))
+    assert np.array_equal(np.asarray(r1.log_prob), np.asarray(r2.log_prob))
+    assert np.array_equal(np.asarray(v1), np.asarray(v2))
+
+
+def test_cached_dec_actor_raises_and_serve_falls_back():
+    """No decoder trunk to cache under dec_actor: the low-level entry raises
+    a typed error; serve_decode silently serves the scan path instead."""
+    pol, params = make_policy(DISCRETE, dec_actor=True, share_actor=True)
+    cfg = pol.cfg
+    state, obs, ava = rollout_inputs(cfg)
+    obs_rep = jnp.zeros((4, cfg.n_agent, cfg.n_embd))
+    with pytest.raises(ValueError, match="dec_actor"):
+        cached_decode(pol.model, params, jax.random.key(0), obs_rep, ava)
+    v1, r1 = _serve(cfg, params, state, obs, ava, True, "scan")
+    v2, r2 = _serve(cfg, params, state, obs, ava, True, "cached")
+    assert np.array_equal(np.asarray(r1.action), np.asarray(r2.action))
+    assert np.array_equal(np.asarray(v1), np.asarray(v2))
+
+
+# ------------------------------------------------------- the three identities
+
+
+def test_identity_batched_dense_slice():
+    """Identity 1: projecting all A positions then slicing row i is bitwise
+    equal to projecting row i alone — what lets decode_queries hoist the
+    cross-attn query projection out of the scan."""
+    B, A, D, H = 4, 7, 16, 2
+    blk = DecodeBlock(D, H)
+    x = jax.random.normal(jax.random.key(0), (B, A, D))
+    params = blk.init(jax.random.key(1), x, x)
+
+    q_all = blk.apply(params, x, method=lambda m, v: m.attn2.project_q_heads(v))
+    for i in range(A):
+        q_one = blk.apply(
+            params, x[:, i : i + 1], method=lambda m, v: m.attn2.project_q_heads(v)
+        )
+        assert np.array_equal(np.asarray(q_all[:, :, i : i + 1]), np.asarray(q_one))
+
+
+def test_identity_presplit_attention():
+    """Identity 2: attend_heads over a pre-split cache == attend_cached
+    splitting the raw cache, for every causal frontier."""
+    B, A, D, H = 4, 7, 16, 2
+    blk = DecodeBlock(D, H)
+    x = jax.random.normal(jax.random.key(0), (B, A, D))
+    params = blk.init(jax.random.key(1), x, x)
+
+    def raw(m, v):
+        return m.attn1.project_kv(v)
+
+    def heads(m, v):
+        return m.attn1.project_kv_heads(v)
+
+    k_raw, v_raw = blk.apply(params, x, method=raw)
+    k_h, v_h = blk.apply(params, x, method=heads)
+    assert np.array_equal(np.asarray(split_heads(k_raw, H)), np.asarray(k_h))
+    for i in range(A):
+        valid = jnp.arange(A) <= i
+        xq = x[:, i : i + 1]
+        a = blk.apply(
+            params, xq, k_raw, v_raw, valid,
+            method=lambda m, q, k, v, mask: m.attn1.attend_cached(q, k, v, mask),
+        )
+        b = blk.apply(
+            params, xq, k_h, v_h, valid,
+            method=lambda m, q, k, v, mask: m.attn1.attend_heads(
+                m.attn1.project_q_heads(q), k, v, mask
+            ),
+        )
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_identity_headsplit_dus():
+    """Identity 3: writing a head-split column into the packed buffer ==
+    head-splitting the raw buffer after the raw column write."""
+    B, L, D, H = 3, 5, 8, 2
+    raw = jax.random.normal(jax.random.key(0), (B, L, D))
+    col = jax.random.normal(jax.random.key(1), (B, 1, D))
+    for i in range(L):
+        raw_updated = jax.lax.dynamic_update_slice(raw, col, (0, i, 0))
+        packed_updated = jax.lax.dynamic_update_slice(
+            split_heads(raw, H), split_heads(col, H), (0, 0, i, 0)
+        )
+        assert np.array_equal(
+            np.asarray(split_heads(raw_updated, H)), np.asarray(packed_updated)
+        )
+
+
+def test_decode_step_packed_equals_decode_step():
+    """The block-level composition of identities 1-3: decode_step (raw dict
+    cache) and decode_step_packed (packed pre-split planes) produce bitwise
+    equal outputs at every position of a full decode."""
+    B, A, D, H = 4, 7, 16, 2
+    blk = DecodeBlock(D, H)
+    rep = jax.random.normal(jax.random.key(0), (B, A, D))
+    params = blk.init(jax.random.key(1), rep, rep)
+
+    cache = {k: jnp.zeros((B, A, D)) for k in ("k1", "v1", "k2", "v2")}
+    kv = init_packed_cache(1, B, A, D, H)
+    q2 = blk.apply(params, rep, method=lambda m, v: m.attn2.project_q_heads(v))
+    xs = jax.random.normal(jax.random.key(2), (A, B, 1, D))
+    for i in range(A):
+        rep_i = rep[:, i : i + 1]
+        y1, cache = blk.apply(params, xs[i], rep_i, cache, jnp.asarray(i),
+                              method=DecodeBlock.decode_step)
+        y2, kv = blk.apply(
+            params, xs[i], rep_i, q2[:, :, i : i + 1], kv, 0, jnp.asarray(i),
+            jnp.arange(A) <= i, method=DecodeBlock.decode_step_packed,
+        )
+        assert np.array_equal(np.asarray(y1), np.asarray(y2)), f"position {i}"
+
+
+def test_packed_cache_shapes_and_bytes():
+    """fresh_packed_cache allocates (2*n_block, B, H, A, Dh) K and V buffers
+    and packed_cache_bytes is their exact byte count (the decode_cache_bytes
+    gauge the engine emits per bucket)."""
+    pol, params = make_policy(DISCRETE)
+    cfg = pol.cfg
+    k_buf, v_buf = pol.model.fresh_packed_cache(4)
+    shape = (2 * cfg.n_block, 4, cfg.n_head, cfg.n_agent,
+             cfg.n_embd // cfg.n_head)
+    assert k_buf.shape == shape and v_buf.shape == shape
+    assert packed_cache_bytes(cfg.n_block, 4, cfg.n_agent, cfg.n_embd,
+                              jnp.float32) == 2 * k_buf.size * 4
+
+
+# -------------------------------------------------- engine ladder + recompiles
+
+BUCKETS = (1, 8, 32, 128)
+
+CFG = MATConfig(
+    n_agent=3, obs_dim=4, state_dim=5, action_dim=3,
+    n_block=1, n_embd=16, n_head=2,
+)
+
+
+def _padded_batch(b, seed=0):
+    rng = np.random.default_rng(seed)
+    # pad slots replicate the last real request (the batcher's padding rule):
+    # 3 real rows, the rest copies
+    real = min(b, 3)
+    state = rng.normal(size=(real, CFG.n_agent, CFG.state_dim)).astype(np.float32)
+    obs = rng.normal(size=(real, CFG.n_agent, CFG.obs_dim)).astype(np.float32)
+    avail = np.ones((real, CFG.n_agent, CFG.action_dim), np.float32)
+    reps = [b - real + 1 if i == real - 1 else 1 for i in range(real)]
+    return (np.repeat(state, reps, 0), np.repeat(obs, reps, 0),
+            np.repeat(avail, reps, 0))
+
+
+def test_cached_engine_bucket_ladder_bit_exact_zero_recompiles():
+    """Every bucket program (1/8/32/128, padding included) of a cached-mode
+    engine is bit-exact to the scan-mode engine's program on the same padded
+    batch — the actual serving A/B, both AOT-compiled — and the whole ladder
+    sweep triggers zero steady-state recompiles.  (An eager serve_decode
+    reference is NOT bit-usable here: XLA specializes kernels per batch, and
+    at some buckets even the scan engine differs from the un-jitted scan by
+    1 ULP — compilation noise, not algorithm drift.)"""
+    params = TransformerPolicy(CFG).init_params(jax.random.key(0))
+    eng = DecodeEngine(params, CFG, EngineConfig(buckets=BUCKETS),
+                       log_fn=lambda *a: None)
+    assert eng.engine_cfg.decode_mode == "cached"   # the default mode
+    ref_eng = DecodeEngine(
+        params, CFG, EngineConfig(buckets=BUCKETS, decode_mode="scan"),
+        log_fn=lambda *a: None,
+    )
+    eng.warmup()
+    ref_eng.warmup()
+    assert eng.compile_count() == len(BUCKETS)
+    for b in BUCKETS:
+        state, obs, avail = _padded_batch(b, seed=b)
+        action, log_prob = eng.decode(state, obs, avail)
+        ref_action, ref_log_prob = ref_eng.decode(state, obs, avail)
+        assert np.array_equal(action, ref_action), f"bucket {b}"
+        assert np.array_equal(log_prob, ref_log_prob), f"bucket {b}"
+    assert eng.compile_count() == len(BUCKETS)
+    assert eng.steady_state_recompiles() == 0
+    assert ref_eng.steady_state_recompiles() == 0
+
+
+@pytest.mark.parametrize("serve_dtype", ["f32", "bf16"])
+def test_weight_only_swap_reuses_executables(serve_dtype):
+    """Satellite fix: install_params on a warm engine must not re-lower any
+    bucket — weight-only swaps reuse the compiled executables (cached mode
+    and the bf16 trunk included) and the per-bucket zero-batch warm inputs
+    are allocated once, not per swap."""
+    pol = TransformerPolicy(CFG)
+    params = pol.init_params(jax.random.key(0))
+    eng = DecodeEngine(
+        params, CFG,
+        EngineConfig(buckets=(2, 4), serve_dtype=serve_dtype),
+        log_fn=lambda *a: None,
+    )
+    eng.warmup()
+    before = eng.compile_count()
+    zb = eng._zero_batch(2)
+    recompiles = eng.install_params(pol.init_params(jax.random.key(1)))
+    assert recompiles == 0
+    assert eng.compile_count() == before
+    assert eng.steady_state_recompiles() == 0
+    assert eng._zero_batch(2) is zb                 # memoized, not re-alloced
+    state, obs, avail = _padded_batch(2)
+    action, _ = eng.decode(state, obs, avail)
+    assert action.shape[0] == 2
+    assert eng.compile_count() == before
+
+
+# ----------------------------------------------- fused dispatch + N>1 parity
+
+
+def _dcml_components(decode_mode, scenario_names=None):
+    from mat_dcml_tpu.config import RunConfig
+    from mat_dcml_tpu.envs.dcml import DCMLEnv, DCMLEnvConfig
+    from mat_dcml_tpu.envs.dcml.env import DCMLConsts
+    from mat_dcml_tpu.training.multi_scenario import build_dcml_scenario_env
+    from mat_dcml_tpu.training.rollout import RolloutCollector
+    from mat_dcml_tpu.training.runner import build_mat_policy
+
+    W = 8
+    consts = DCMLConsts(worker_number_max=W, sob_dim=W + 2)
+    rng = np.random.default_rng(0)
+    workloads = rng.integers(0, 5, size=(W, consts.local_workload_period)).astype(
+        np.float32)
+    env = DCMLEnv(DCMLEnvConfig(consts=consts), base_workloads=workloads)
+    if scenario_names:
+        env = build_dcml_scenario_env(env, list(scenario_names))
+    run = RunConfig(algorithm_name="mat", n_rollout_threads=2, episode_length=8,
+                    n_block=1, n_embd=16, n_head=1, decode_mode=decode_mode)
+    policy = build_mat_policy(run, env)
+    collector = RolloutCollector(env, policy, 8)
+    return policy, collector
+
+
+def _collect_traj(decode_mode, scenario_names=None):
+    policy, collector = _dcml_components(decode_mode, scenario_names)
+    params = policy.init_params(jax.random.key(0))
+    rs = collector.init_state(jax.random.key(1), 2)
+    collect = jax.jit(collector.collect)
+    for _ in range(2):                      # across an episode boundary
+        rs, traj = collect(params, rs)
+    return jax.device_get(traj)
+
+
+@pytest.mark.slow  # ~7s of collect compiles; the fast tier keeps the decode
+# parity matrix + engine ladder, this pins the full training-collect program
+def test_cached_under_fused_collect_bit_exact():
+    """The training collect path (the program the fused K>1 dispatch scans)
+    with decode_mode="cached" reproduces the scan-mode trajectory bit for
+    bit: actions, log-probs, rewards, everything."""
+    t_scan = _collect_traj("scan")
+    t_cached = _collect_traj("cached")
+    for name in t_scan._fields:
+        np.testing.assert_array_equal(
+            np.asarray(getattr(t_scan, name)),
+            np.asarray(getattr(t_cached, name)),
+            err_msg=f"Trajectory.{name}",
+        )
+
+
+@pytest.mark.slow
+def test_cached_multi_scenario_bit_exact():
+    """N>1 scenario-as-data collect: cached == scan bitwise with the scenario
+    id mixed into the per-slot rollout carry."""
+    names = ("nominal", "fleet_stress")
+    t_scan = _collect_traj("scan", names)
+    t_cached = _collect_traj("cached", names)
+    for name in t_scan._fields:
+        np.testing.assert_array_equal(
+            np.asarray(getattr(t_scan, name)),
+            np.asarray(getattr(t_cached, name)),
+            err_msg=f"Trajectory.{name}",
+        )
+
+
+# --------------------------------------------------------------- bf16 trunk
+
+
+@pytest.mark.slow  # two 101-agent engine warmups; the bf16 numerics contract
+# itself stays fast-tier via test_effective_for / the fleet canary test
+def test_bf16_engine_close_to_f32_on_dcml_preset():
+    """The bf16 serving trunk on the production DCML preset shape (101
+    agents, semi-discrete) stays within the documented canary tolerances of
+    the f32 engine: log-probs allclose at rtol=2e-2/atol=1e-3 and greedy
+    actions agree on >= 75% of slots (the 0.25 mismatch budget)."""
+    import os
+
+    from mat_dcml_tpu.config import RunConfig
+    from mat_dcml_tpu.envs.dcml import DCMLEnv, DCMLEnvConfig
+    from mat_dcml_tpu.training.runner import build_mat_policy
+
+    data_dir = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "data")
+    env = DCMLEnv(DCMLEnvConfig(), data_dir=data_dir)
+    policy = build_mat_policy(RunConfig(), env)
+    cfg = policy.cfg
+    assert cfg.n_agent == 101                       # the production preset
+    params = policy.init_params(jax.random.key(0))
+    rng = np.random.default_rng(5)
+    B = 2
+    state = rng.normal(size=(B, cfg.n_agent, cfg.state_dim)).astype(np.float32)
+    obs = rng.normal(size=(B, cfg.n_agent, cfg.obs_dim)).astype(np.float32)
+    avail = np.ones((B, cfg.n_agent, cfg.action_dim), np.float32)
+
+    outs = {}
+    for sd in ("f32", "bf16"):
+        eng = DecodeEngine(params, cfg,
+                           EngineConfig(buckets=(B,), serve_dtype=sd),
+                           log_fn=lambda *a: None)
+        eng.warmup()
+        outs[sd] = eng.decode(state, obs, avail)
+        assert eng.steady_state_recompiles() == 0
+    a32, lp32 = outs["f32"]
+    a16, lp16 = outs["bf16"]
+    rc = RolloutConfig().effective_for("bf16")
+    np.testing.assert_allclose(lp16, lp32, rtol=rc.value_rtol, atol=rc.value_atol)
+    match = float((a16 == a32).mean())
+    assert match >= 1.0 - RolloutConfig().max_mismatch_frac
+
+
+def test_effective_for_swaps_value_tolerances():
+    """f32 keeps bit-tight tolerances; bf16 swaps in the documented wider
+    value gate while the greedy-action mismatch budget stays unchanged."""
+    rc = RolloutConfig()
+    assert rc.effective_for("f32") is rc
+    eff = rc.effective_for("bf16")
+    assert eff.value_rtol == rc.bf16_value_rtol
+    assert eff.value_atol == rc.bf16_value_atol
+    assert eff.max_mismatch_frac == rc.max_mismatch_frac
+
+
+def test_bf16_fleet_canary_promote_and_rollback():
+    """The bf16 rollout rides the canary machinery: identical weights promote
+    under the tolerance gate, while an artifact whose values exceed even the
+    widened bf16 tolerance rolls back automatically (generation unchanged)."""
+    from mat_dcml_tpu.serving.batcher import BatcherConfig
+    from mat_dcml_tpu.serving.fleet import EngineFleet, FleetConfig
+
+    pol = TransformerPolicy(CFG)
+    params = pol.init_params(jax.random.key(0))
+
+    def make(rollout_cfg):
+        fleet = EngineFleet(
+            params, CFG,
+            fleet_cfg=FleetConfig(n_replicas=2, probe_interval_s=0.05),
+            engine_cfg=EngineConfig(buckets=(2, 4), serve_dtype="bf16"),
+            batcher_cfg=BatcherConfig(max_batch_wait_ms=2.0),
+            rollout_cfg=rollout_cfg,
+            log_fn=lambda *a: None,
+        )
+        fleet.warmup()
+        return fleet
+
+    fleet = make(RolloutConfig(canary_comparisons=6, canary_timeout_s=60.0))
+    try:
+        report = fleet.push(params)     # identical weights: must promote
+        assert report["status"] == "promoted"
+        assert fleet.current_generation == 1
+
+        report = fleet.push(pol.init_params(jax.random.key(1)))
+        assert report["status"] == "rolled_back"
+        assert fleet.current_generation == 1        # generation unchanged
+        assert fleet.telemetry.counters["rollout_rollbacks"] == 1.0
+    finally:
+        fleet.close()
