@@ -126,6 +126,20 @@ Knobs (environment variables):
                         legs assert one compile + zero steady recompiles.
                         Knobs: BENCH_MS_E (64), BENCH_MS_K (2),
                         BENCH_MS_ITERS (3)
+  BENCH_ASYNC           "1" → async actor-learner overlap A/B (CPU proxy):
+                        --async_actors (half/half submesh split) vs the
+                        classic synchronous loop sharded over all forced
+                        virtual devices, both through the real runner
+                        (base_runner.train_loop), best-of-N alternating
+                        trials (ab_trials).  Reports sync/async env-steps/s,
+                        the measured overlap fraction min(collect, train) /
+                        (collect + train) from the sync leg's phase timers,
+                        staleness p95 / queue drops from the async leg's own
+                        telemetry, and a convergence-parity sub-leg at equal
+                        env-steps.  Knobs: BENCH_ASYNC_E (256),
+                        BENCH_ASYNC_T (8), BENCH_ASYNC_EPISODES (4),
+                        BENCH_ASYNC_TRIALS (3), BENCH_ASYNC_DEVICES (8),
+                        BENCH_ASYNC_PARITY_EPISODES (30; 0 disables)
 
 On device OOM the bench walks a backoff ladder before shrinking the batch:
 remat on -> accumulation x2 (up to 8) -> halve E — big batches get memory
@@ -1028,6 +1042,168 @@ def _measure_multi_scenario() -> None:
     print(json.dumps(record), flush=True)
 
 
+def _measure_async() -> None:
+    """BENCH_ASYNC=1 leg: async actor-learner overlap A/B (CPU proxy).
+
+    Same model, same env, same per-episode env-step budget, both legs through
+    the real runner (``base_runner.train_loop``): ``--async_actors`` with a
+    half/half submesh split vs the classic synchronous loop data-sharded over
+    ALL forced virtual devices.  Best-of-N alternating trials (``ab_trials``)
+    score each leg by its last record's interval ``env_steps_per_sec``.
+
+    The honest yardstick: the async win is bounded by the overlap fraction
+    ``min(collect, train) / (collect + train)`` measured from the SYNC leg's
+    own phase timers — perfect overlap hides the smaller phase behind the
+    larger one.  The record reports that fraction, the speedup target
+    ``1 + 0.8 * fraction`` the acceptance criterion pins, the async leg's
+    staleness p95 / queue drops / recompiles from its own telemetry, and an
+    optional convergence-parity sub-leg at equal env-steps.  On a shared-CPU
+    host the virtual submeshes compete for the same cores, so this is a
+    structure proxy — chip re-measure is a ROADMAP follow-up."""
+    n_dev = int(os.environ.get("BENCH_ASYNC_DEVICES", "8"))
+    # the forced topology must exist BEFORE jax initializes
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n_dev}"
+        ).strip()
+    jax, _ = _setup_jax()
+
+    import tempfile
+
+    import numpy as np
+
+    from mat_dcml_tpu.config import RunConfig
+    from mat_dcml_tpu.envs.dcml import DCMLEnv, DCMLEnvConfig
+    from mat_dcml_tpu.envs.dcml.env import DCMLConsts
+    from mat_dcml_tpu.training.ppo import PPOConfig
+    from mat_dcml_tpu.training.runner import DCMLRunner
+
+    E = int(os.environ.get("BENCH_ASYNC_E", "256"))
+    T = int(os.environ.get("BENCH_ASYNC_T", "8"))
+    episodes = int(os.environ.get("BENCH_ASYNC_EPISODES", "4"))
+    trials = int(os.environ.get("BENCH_ASYNC_TRIALS", "3"))
+    parity_eps = int(os.environ.get("BENCH_ASYNC_PARITY_EPISODES", "30"))
+    n_act = n_dev // 2
+
+    W = 8
+    consts = DCMLConsts(worker_number_max=W, sob_dim=W + 2)
+    rng = np.random.default_rng(0)
+    workloads = rng.integers(
+        0, 5, size=(W, consts.local_workload_period)).astype(np.float32)
+
+    def make_env():
+        return DCMLEnv(DCMLEnvConfig(consts=consts), base_workloads=workloads)
+
+    def leg(mode, n_episodes, E_leg):
+        tmp = tempfile.mkdtemp(prefix=f"bench_async_{mode}_")
+        kwargs = dict(
+            algorithm_name="mat", experiment_name=f"bench_async_{mode}",
+            seed=1, n_rollout_threads=E_leg, episode_length=T,
+            n_block=1, n_embd=32, n_head=2,
+            log_interval=1, telemetry_interval=1, save_interval=0,
+            run_dir=tmp, anomaly_tripwires=False, graceful_stop=False,
+        )
+        if mode == "async":
+            kwargs.update(async_actors=True, actor_devices=n_act,
+                          learner_devices=n_dev - n_act)
+        else:
+            kwargs.update(data_shards=n_dev)
+        runner = DCMLRunner(RunConfig(**kwargs),
+                            PPOConfig(ppo_epoch=2, num_mini_batch=2),
+                            env=make_env(), log_fn=lambda *a: None)
+        ts, rs = runner.setup()
+        runner.train_loop(num_episodes=n_episodes, train_state=ts,
+                          rollout_state=rs)
+        with open(runner.metrics_path) as f:
+            recs = [json.loads(ln) for ln in f if ln.strip()]
+        recs = [r for r in recs if "fps" in r]
+        sps = float(recs[-1].get("env_steps_per_sec", 0.0))
+        log(f"{mode} E={E_leg} x{n_episodes}ep: {sps:.1f} env-steps/s")
+        return recs
+
+    def throughput(recs):
+        return float(recs[-1].get("env_steps_per_sec", 0.0))
+
+    log(f"async overlap A/B: E={E} T={T} episodes={episodes} trials={trials} "
+        f"devices={n_dev} (sync data_shards={n_dev}, "
+        f"async split {n_act}+{n_dev - n_act})")
+    best, _ = ab_trials(
+        {"sync": lambda: leg("sync", episodes, E),
+         "async": lambda: leg("async", episodes, E)},
+        trials, score=throughput)
+    sync_last = best["sync"][-1]
+    async_last = best["async"][-1]
+    sync_sps = float(sync_last["env_steps_per_sec"])
+    async_sps = float(async_last["env_steps_per_sec"])
+
+    # the ceiling the overlap can buy, from the sync leg's own phase split
+    c = float(sync_last.get("step_time_collect", 0.0))
+    t = float(sync_last.get("step_time_train", 0.0))
+    frac = min(c, t) / max(c + t, 1e-9)
+    target = 1.0 + 0.8 * frac
+    ratio = async_sps / max(sync_sps, 1e-9)
+    recompiles = int(
+        sync_last.get("steady_state_recompiles", 0)
+        + async_last.get("steady_state_recompiles", 0)
+        + async_last.get("async_actor_steady_state_recompiles", 0))
+    log(f"sync {sync_sps:.1f} vs async {async_sps:.1f} env-steps/s "
+        f"(ratio {ratio:.3f}, overlap fraction {frac:.3f}, "
+        f"target {target:.3f}, steady recompiles {recompiles})")
+
+    parity = {}
+    if parity_eps > 0:
+        E_par = int(os.environ.get("BENCH_ASYNC_PARITY_E", "32"))
+        tail_n = max(3, parity_eps // 5)
+        log(f"convergence parity: {parity_eps} episodes at E={E_par} "
+            f"(equal env-steps, tail mean over {tail_n} records)")
+
+        def tail_reward(recs):
+            return float(np.mean(
+                [r["average_step_rewards"] for r in recs[-tail_n:]]))
+
+        r_sync = tail_reward(leg("sync", parity_eps, E_par))
+        r_async = tail_reward(leg("async", parity_eps, E_par))
+        tol = max(0.15 * abs(r_sync), 0.05)
+        parity = {
+            "parity_episodes": parity_eps, "parity_E": E_par,
+            "parity_tail_records": tail_n,
+            "sync_final_reward": round(r_sync, 5),
+            "async_final_reward": round(r_async, 5),
+            "parity_tolerance": round(tol, 5),
+            "parity_ok": bool(abs(r_async - r_sync) <= tol),
+        }
+        log(f"parity: sync {r_sync:.4f} vs async {r_async:.4f} "
+            f"(tol {tol:.4f}) -> {'OK' if parity['parity_ok'] else 'FAIL'}")
+
+    dev = jax.devices()[0]
+    record = {
+        "metric": "dcml_mat_async_overlap_env_steps_per_sec",
+        "value": round(async_sps, 2),
+        "unit": "env_steps/s",
+        "platform": dev.platform,
+        "device": dev.device_kind,
+        "provisional": dev.platform != "tpu",
+        "proxy": "cpu-virtual-devices",  # submeshes share one socket: this
+        # measures program structure and overlap, not parallel speedup
+        "E": E, "T": T, "episodes": episodes, "trials": trials,
+        "devices": n_dev, "actor_devices": n_act,
+        "learner_devices": n_dev - n_act,
+        "sync_steps_per_sec": round(sync_sps, 2),
+        "vs_baseline": round(ratio, 4),
+        "overlap_fraction": round(frac, 4),
+        "speedup_target": round(target, 4),
+        "beats_target": bool(ratio >= target),
+        "staleness_p95": float(
+            async_last.get("staleness_learner_steps_p95", 0.0)),
+        "queue_drops": int(async_last.get("async_queue_drops", 0)),
+        "steady_state_recompiles": recompiles,
+    }
+    record.update(parity)
+    print(json.dumps(record), flush=True)
+
+
 def _measure_serving(jax) -> None:
     """BENCH_SERVING=1 leg: serving throughput A/B on the production DCML
     policy shape (101 agents).  Leg A runs the continuous batcher over the
@@ -1289,32 +1465,39 @@ def _measure_cached_decode(jax) -> None:
     a_b = np.ones((bucket, cfg.n_agent, cfg.action_dim), np.float32)
     s_1, o_1, a_1 = s_b[:1], o_b[:1], a_b[:1]
 
-    p50_ms = {m: float("inf") for m in modes}    # best (lowest) trial median
-    qps1 = {m: 0.0 for m in modes}               # best (highest) trial QPS
-    for _ in range(trials):
-        for m in modes:
-            eng = engines[m]
-            times = []
-            for _ in range(n_disp):
-                t0 = time.perf_counter()
-                eng.decode(s_b, o_b, a_b)
-                times.append(time.perf_counter() - t0)
-            p50_ms[m] = min(p50_ms[m], float(np.median(times)) * 1e3)
+    def _serving_trial(m):
+        eng = engines[m]
+        times = []
+        for _ in range(n_disp):
             t0 = time.perf_counter()
-            for _ in range(n_disp):
-                eng.decode(s_1, o_1, a_1)
-            qps1[m] = max(qps1[m], n_disp / (time.perf_counter() - t0))
+            eng.decode(s_b, o_b, a_b)
+            times.append(time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        for _ in range(n_disp):
+            eng.decode(s_1, o_1, a_1)
+        return {"p50_ms": float(np.median(times)) * 1e3,
+                "qps1": n_disp / (time.perf_counter() - t0)}
+
+    # per-metric reduction (lowest median, highest QPS) over the rounds —
+    # ab_trials supplies the alternating schedule, not a single "best"
+    _, serving_rounds = ab_trials(
+        {m: (lambda _m=m: _serving_trial(_m)) for m in modes}, trials)
+    p50_ms = {m: min(r["p50_ms"] for r in serving_rounds[m]) for m in modes}
+    qps1 = {m: max(r["qps1"] for r in serving_rounds[m]) for m in modes}
     recompiles = {m: engines[m].steady_state_recompiles() for m in modes}
 
     # ---- collect leg: jitted serve_decode throughput at E (stochastic)
     for m in modes:   # warm all before any timing so compiles don't alternate
         jax.block_until_ready(collect_fns[m](params, key))
-    steps_s = {m: 0.0 for m in modes}
-    for _ in range(trials):
-        for m in modes:
-            t0 = time.perf_counter()
-            jax.block_until_ready(collect_fns[m](params, key))
-            steps_s[m] = max(steps_s[m], E / (time.perf_counter() - t0))
+
+    def _collect_trial(m):
+        t0 = time.perf_counter()
+        jax.block_until_ready(collect_fns[m](params, key))
+        return E / (time.perf_counter() - t0)
+
+    _, collect_rounds = ab_trials(
+        {m: (lambda _m=m: _collect_trial(_m)) for m in modes}, trials)
+    steps_s = {m: max(collect_rounds[m]) for m in modes}
 
     for m in modes:
         log(f"cached_decode[{m}]: serving p50 {p50_ms[m]:.1f} ms @ bucket "
@@ -1508,6 +1691,28 @@ def _validate_run_dir(run_dir: str) -> bool:
     return ok
 
 
+def ab_trials(legs: dict, trials: int, score=None) -> tuple:
+    """Best-of-N alternating-trial A/B runner — the pattern the OBS,
+    CACHED_DECODE, and ASYNC legs share.  Runs every leg callable once per
+    trial round, REVERSING the leg order on odd rounds so neither side
+    systematically inherits a cold cache or a neighbour's transient load.
+    On a shared-CPU container contention only ever *slows* a leg, so
+    best-of-N per side is the honest estimate of each configuration's
+    capability.  Returns ``(best, results)``: ``results[name]`` is the list
+    of per-round returns in run order; ``best[name]`` is the score-maximal
+    one (``None`` when no ``score`` is given — callers reducing per-metric,
+    like the decode leg's min-p50/max-QPS, use ``results`` directly)."""
+    results = {name: [] for name in legs}
+    names = list(legs)
+    for trial in range(max(trials, 1)):
+        order = names if trial % 2 == 0 else list(reversed(names))
+        for name in order:
+            results[name].append(legs[name]())
+    best = (None if score is None
+            else {name: max(recs, key=score) for name, recs in results.items()})
+    return best, results
+
+
 def _measure_obs(jax) -> None:
     """BENCH_OBS=1 leg: observability-plane overhead A/B.
 
@@ -1601,15 +1806,10 @@ def _measure_obs(jax) -> None:
             f"p99 {rec['serving_p99_ms']:.1f} ms")
         return rec
 
-    legs = {"observed": [], "plain": []}
-    for trial in range(max(trials, 1)):
-        # alternate leg order so neither side systematically inherits a
-        # cold cache or a neighbour's transient load
-        order = ("observed", "plain") if trial % 2 == 0 else ("plain", "observed")
-        for name in order:
-            legs[name].append(_run_leg(name))
-    best = {name: max(recs, key=lambda r: r["serving_qps"])
-            for name, recs in legs.items()}
+    best, legs = ab_trials(
+        {"observed": lambda: _run_leg("observed"),
+         "plain": lambda: _run_leg("plain")},
+        trials, score=lambda r: r["serving_qps"])
     if run_dir:
         for rec in best.values():
             write_serving_record(
@@ -1843,6 +2043,11 @@ def main() -> None:
     # Multi-scenario overhead A/B: scenario-as-data family vs plain env
     if os.environ.get("BENCH_MULTI_SCENARIO", "0") == "1":
         _measure_multi_scenario()
+        return
+
+    # Async actor-learner overlap A/B: pins its own CPU topology pre-init
+    if os.environ.get("BENCH_ASYNC", "0") == "1":
+        _measure_async()
         return
 
     # Serving A/B leg: self-contained, no orchestration (the caller pins the

@@ -146,6 +146,24 @@ class RunConfig:
     # blocking device->host deep copy.  0 disables (graceful stop still
     # works — it packs boundary state directly); raise to amortize
     emergency_snapshot_interval: int = 1
+    # Podracer-style async actor-learner overlap (training/async_loop.py):
+    # split the devices into disjoint actor/learner submeshes and run the
+    # jitted collector continuously in an actor thread while the learner
+    # consumes trajectory blocks from a bounded queue (1-step-lagged PPO;
+    # see README "Async actor-learner").  Single-process, >= 2 devices,
+    # incompatible with --iters_per_dispatch > 1 and --data_shards/
+    # --seq_shards > 1 (the submeshes replace the run mesh).
+    async_actors: bool = False
+    # device split for --async_actors; 0 = auto (half/half, actors take the
+    # extra device on odd counts)
+    actor_devices: int = 0
+    learner_devices: int = 0
+    # bounded trajectory-queue capacity (device-buffer ring slots).  Deeper
+    # queues buy transient actor/learner jitter tolerance at the cost of
+    # learner HBM; steady-state param staleness stays <= 1 learner step
+    # regardless (the actor throttles to one block per published version
+    # whenever a completed block is already queued — async_loop.ActorWorker).
+    async_queue_depth: int = 2
 
     @property
     def episodes(self) -> int:

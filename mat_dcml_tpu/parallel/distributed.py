@@ -131,6 +131,38 @@ def put_replicated(tree, mesh):
     return jax.tree.map(lambda x: jax.device_put(x, repl), tree)
 
 
+def put_time_major(tree, mesh, data_axis: str = "data"):
+    """Place a time-major trajectory pytree (leaves ``(T, E, ...)``) on
+    ``mesh``: every ndim>=2 leaf shards its env axis (axis 1) over
+    ``data_axis``; scalars (chunk_stats) replicate.
+
+    This is the device-to-device half of the async actor->learner handoff
+    (training/async_loop.py): the actor submesh produced the block, the
+    learner submesh consumes it, and ``device_put`` with a target
+    NamedSharding moves the buffers without staging a full host copy.  The
+    same env-batch divisibility contract as :func:`global_init_state`
+    applies, just one axis over.
+    """
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    n_data = dict(mesh.shape).get(data_axis, 1)
+    shard = NamedSharding(mesh, P(None, data_axis))
+    repl = NamedSharding(mesh, P())
+
+    def place(x):
+        if getattr(x, "ndim", 0) >= 2:
+            if x.shape[1] % n_data:
+                raise ValueError(
+                    f"trajectory env axis ({x.shape[1]}) must be divisible by "
+                    f"the mesh's {data_axis!r} axis ({n_data} shards)"
+                )
+            return jax.device_put(x, shard)
+        return jax.device_put(x, repl)
+
+    return jax.tree.map(place, tree)
+
+
 def put_sharded_state(tree, mesh, data_axis: str = "data"):
     """Place a host-local rollout-state pytree on ``mesh`` under the same
     contract :func:`global_init_state` builds with: every ndim>=1 leaf

@@ -120,6 +120,62 @@ def build_run_mesh(
     return make_data_seq_mesh(seq_shards, devices[:n_total])
 
 
+def build_actor_learner_meshes(
+    actor_devices: int = 0,
+    learner_devices: int = 0,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> tuple[Mesh, Mesh]:
+    """Disjoint ``(data, seq=1)`` submeshes for ``--async_actors``
+    (Podracer/sebulba): actors own a leading device slice and run the rollout
+    collector continuously; the learner owns the rest and consumes trajectory
+    blocks.  Both submeshes expose the same ``data`` axis the rest of the
+    sharding machinery (``global_init_state``, ``put_sharded_state``) already
+    speaks, so state placement code is shared with the synchronous path.
+
+    ``actor_devices`` / ``learner_devices`` of 0 mean auto: the unspecified
+    side takes every device the other did not claim; with both auto the split
+    is half/half (actors get the extra device on odd counts — collect is the
+    wider program).  Single-process only: the two programs overlap as host
+    threads, which a multi-process SPMD launch cannot express.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    if jax.process_count() > 1:
+        raise ValueError(
+            "--async_actors overlaps actor/learner as host threads and is "
+            "single-process only; multi-process runs use the fused dispatch"
+        )
+    if n < 2:
+        raise ValueError(
+            f"--async_actors needs at least 2 devices (one per submesh), "
+            f"have {n}"
+        )
+    if actor_devices < 0 or learner_devices < 0:
+        raise ValueError(
+            f"--actor_devices/--learner_devices must be >= 0 (0 = auto), got "
+            f"{actor_devices}/{learner_devices}"
+        )
+    if actor_devices == 0 and learner_devices == 0:
+        n_learner = max(1, n // 2)
+        n_actor = n - n_learner
+    elif actor_devices == 0:
+        n_learner = learner_devices
+        n_actor = n - n_learner
+    elif learner_devices == 0:
+        n_actor = actor_devices
+        n_learner = n - n_actor
+    else:
+        n_actor, n_learner = actor_devices, learner_devices
+    if n_actor < 1 or n_learner < 1 or n_actor + n_learner > n:
+        raise ValueError(
+            f"--actor_devices {n_actor} + --learner_devices {n_learner} must "
+            f"both be >= 1 and fit the {n} available devices"
+        )
+    actor_mesh = make_data_seq_mesh(1, devices[:n_actor])
+    learner_mesh = make_data_seq_mesh(1, devices[n_actor:n_actor + n_learner])
+    return actor_mesh, learner_mesh
+
+
 def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 

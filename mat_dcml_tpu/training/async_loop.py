@@ -1,0 +1,289 @@
+"""Podracer-style async actor–learner overlap (sebulba, arXiv:2104.06272).
+
+The fused dispatch (base_runner.make_dispatch_fn) time-slices ONE device set:
+the learner idles while envs step and vice versa.  This module overlaps two
+programs on disjoint submeshes (parallel/mesh.build_actor_learner_meshes):
+
+- an **actor thread** runs the existing jitted rollout collector continuously
+  on the actor submesh, stamping each trajectory block with the param version
+  it collected under and pushing it into a bounded queue;
+- the **learner** (the main thread, where signal handlers and checkpointing
+  live) consumes blocks with the existing streamed PPO update on the learner
+  submesh and publishes fresh params device-to-device after every step.
+
+The queue is a host-coordinated ring of DEVICE buffers: blocks are placed
+onto the learner submesh at enqueue time (``put_time_major`` /
+``put_sharded_state`` device-to-device copies, overlapping the learner's
+compute), so the host holds only references and ``capacity`` bounds learner
+HBM.  Backpressure blocks the producer — a full queue means the learner is
+the bottleneck and more rollouts would only go stale; nothing is ever
+dropped (``drops`` is pinned at 0 by tests/test_async_loop.py).
+
+Staleness semantics: the learner accepts 1-step-lagged PPO (bit-exactness
+with the synchronous loop is explicitly NOT a goal — convergence parity on
+the DCML preset is pinned in BENCHLOG instead).  ``ParamPublisher`` versions
+every publish; the lag ``publisher.version - block.param_version`` observed
+at consume time feeds the ``staleness_`` gauge family.  A double-buffering
+throttle in :class:`ActorWorker` (one new block per published version while
+one is already queued) pins steady-state lag at <= 1 even when the actor is
+the fast side; the importance-correction hook
+(:data:`IMPORTANCE_CORRECTION_DOC`) is the designated seam for off-policy
+corrections should transient lag > 1 ever need more than ratio clipping.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, NamedTuple, Optional
+
+from mat_dcml_tpu.telemetry import Telemetry
+
+
+class TrajectoryBlock(NamedTuple):
+    """One collected episode chunk in flight from actors to learner."""
+
+    traj: Any                 # Trajectory, placed on the LEARNER submesh
+    rollout_state: Any        # post-collect bootstrap state, learner submesh
+    param_version: int        # publisher version the actor collected under
+    actor_iter: int           # 1-based actor iteration (FIFO assertable)
+    t_start: float            # perf_counter at collect launch (actor thread)
+    t_end: float              # perf_counter when the block was ready
+
+
+# The importance-correction hook contract: ``hook(traj, lag) -> traj`` is
+# applied by the learner BEFORE the PPO update whenever the consumed block's
+# param-version lag is > 0.  The default (None) is the identity — PPO's ratio
+# clipping already absorbs the 1-step lag the bounded queue produces in
+# steady state (staleness_learner_steps_p95 <= 1, pinned in tests).  A real
+# correction (e.g. V-trace-style truncated importance weights over
+# ``traj.log_probs``) plugs in here without touching the loop.
+ImportanceCorrection = Callable[[Any, int], Any]
+IMPORTANCE_CORRECTION_DOC = ImportanceCorrection
+
+
+class TrajectoryQueue:
+    """Bounded FIFO ring of trajectory blocks with blocking backpressure.
+
+    ``put`` blocks while the queue is at capacity (the actor stalls rather
+    than dropping or overwriting data — ``drops`` exists only to pin that
+    claim in tests); ``get`` blocks while it is empty.  ``close`` wakes every
+    waiter; a closed queue rejects puts (``False``) and serves remaining
+    blocks until ``drain`` clears them.  Plain host Python — the blocks'
+    arrays live on device, the ring only coordinates.
+    """
+
+    def __init__(self, capacity: int):
+        if capacity < 1:
+            raise ValueError(f"queue capacity must be >= 1, got {capacity}")
+        self.capacity = int(capacity)
+        self._slots: deque = deque()
+        self._cv = threading.Condition()
+        self._closed = False
+        self.puts = 0
+        self.gets = 0
+        self.drops = 0          # never incremented: backpressure, not loss
+        self.max_depth = 0
+
+    @property
+    def depth(self) -> int:
+        return len(self._slots)
+
+    @property
+    def closed(self) -> bool:
+        return self._closed
+
+    def put(self, block, timeout: Optional[float] = None) -> bool:
+        """Enqueue, blocking while full.  ``False`` = closed or timed out
+        (the block was NOT enqueued; a stopping producer discards it — that
+        is shutdown drain, not a drop)."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._cv:
+            while len(self._slots) >= self.capacity and not self._closed:
+                remaining = (None if deadline is None
+                             else deadline - time.monotonic())
+                if remaining is not None and remaining <= 0:
+                    return False
+                self._cv.wait(remaining)
+            if self._closed:
+                return False
+            self._slots.append(block)
+            self.puts += 1
+            self.max_depth = max(self.max_depth, len(self._slots))
+            self._cv.notify_all()
+            return True
+
+    def get(self, timeout: Optional[float] = None):
+        """Dequeue FIFO, blocking while empty.  ``None`` = closed-and-empty
+        or timed out."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._cv:
+            while not self._slots and not self._closed:
+                remaining = (None if deadline is None
+                             else deadline - time.monotonic())
+                if remaining is not None and remaining <= 0:
+                    return None
+                self._cv.wait(remaining)
+            if not self._slots:
+                return None          # closed and fully drained
+            block = self._slots.popleft()
+            self.gets += 1
+            self._cv.notify_all()
+            return block
+
+    def close(self) -> None:
+        with self._cv:
+            self._closed = True
+            self._cv.notify_all()
+
+    def drain(self) -> list:
+        """Close and return every still-queued block in FIFO order (the
+        graceful-stop path: in-flight blocks are coherently discarded and the
+        carry resumes from the last CONSUMED episode)."""
+        with self._cv:
+            self._closed = True
+            left = list(self._slots)
+            self._slots.clear()
+            self._cv.notify_all()
+            return left
+
+
+class ParamPublisher:
+    """Versioned device-to-device param broadcast, learner -> actor submesh.
+
+    ``publish`` places the fresh params replicated on the actor submesh
+    (one ``device_put`` = direct device-to-device copy, no host staging) and
+    bumps the version; ``snapshot`` hands the actor the latest (params,
+    version) pair.  The publish blocks until the copy lands so the learner's
+    next (donating) update can never invalidate buffers a copy still reads.
+    """
+
+    def __init__(self, actor_mesh=None):
+        if actor_mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            self._sharding = NamedSharding(actor_mesh, P())
+        else:
+            self._sharding = None    # single-device / test use: no placement
+        self._lock = threading.Lock()
+        self._params = None
+        self._version = 0
+
+    @property
+    def version(self) -> int:
+        with self._lock:
+            return self._version
+
+    def publish(self, params) -> int:
+        import jax
+
+        if self._sharding is not None:
+            placed = jax.device_put(params, self._sharding)
+            jax.block_until_ready(placed)
+        else:
+            placed = params
+        with self._lock:
+            self._version += 1
+            self._params = placed
+            return self._version
+
+    def snapshot(self):
+        """Latest ``(params, version)`` — what the next actor iteration
+        collects under."""
+        with self._lock:
+            return self._params, self._version
+
+
+class ActorWorker(threading.Thread):
+    """The actor program: collect continuously, stamp, place, enqueue.
+
+    Owns a PRIVATE :class:`Telemetry` registry (jit instrumentation is not
+    thread-safe against the learner's flushes) guarded by ``tel_lock``; the
+    learner merges it into the metrics record under the ``async_actor_``
+    prefix.  ``latest_rollout_state`` always references the newest completed
+    carry — what a graceful stop packs after :meth:`request_stop` joins the
+    thread at an iteration boundary.
+    """
+
+    def __init__(self, collect_fn, publisher: ParamPublisher,
+                 queue: TrajectoryQueue, rollout_state, learner_mesh,
+                 telemetry: Optional[Telemetry] = None, log=print):
+        super().__init__(name="async-actor", daemon=True)
+        self.collect_fn = collect_fn
+        self.publisher = publisher
+        self.queue = queue
+        self.learner_mesh = learner_mesh
+        self.telemetry = telemetry if telemetry is not None else Telemetry()
+        self.tel_lock = threading.Lock()
+        self.log = log
+        self.latest_rollout_state = rollout_state
+        self.iterations = 0
+        self.error: Optional[BaseException] = None
+        # NOT named _stop: threading.Thread has an internal _stop()
+        # method that the interpreter calls on thread teardown
+        self._stop_requested = threading.Event()
+
+    def request_stop(self) -> None:
+        """Ask the actor to exit at its next iteration boundary (the enqueue
+        retry loop polls this, so a stop never deadlocks on a full queue)."""
+        self._stop_requested.set()
+
+    def run(self) -> None:
+        import jax
+
+        from mat_dcml_tpu.parallel.distributed import (
+            put_sharded_state,
+            put_time_major,
+        )
+
+        rs = self.latest_rollout_state
+        last_version = -1
+        try:
+            while not self._stop_requested.is_set():
+                # double-buffering throttle: once a completed block is already
+                # waiting, collect at most ONE more per published version.  A
+                # fast actor otherwise laps the learner and its queued blocks
+                # go >1 version stale; with the throttle each block is
+                # consumed at its own version or the next one (steady-state
+                # staleness <= 1 learner step, pinned in tests), while a slow
+                # actor never hits the gate and overlap is unchanged.
+                while (not self._stop_requested.is_set()
+                       and self.queue.depth > 0
+                       and self.publisher.version <= last_version):
+                    time.sleep(0.001)
+                if self._stop_requested.is_set():
+                    break
+                params, version = self.publisher.snapshot()
+                last_version = version
+                t0 = time.perf_counter()
+                with self.tel_lock:
+                    rs, traj = self.collect_fn(params, rs)
+                # block for honest iteration wall time (the bounded queue
+                # keeps at most `capacity` blocks in flight anyway, so this
+                # costs pipelining only at queue depth 0 — learner-bound)
+                jax.block_until_ready(traj)
+                t1 = time.perf_counter()
+                self.latest_rollout_state = rs
+                self.iterations += 1
+                if self.iterations == 1 and hasattr(self.collect_fn,
+                                                    "mark_steady"):
+                    with self.tel_lock:
+                        self.collect_fn.mark_steady()
+                # place onto the learner submesh HERE so the d2d copy
+                # overlaps the learner's current update
+                block = TrajectoryBlock(
+                    traj=put_time_major(traj, self.learner_mesh),
+                    rollout_state=put_sharded_state(rs, self.learner_mesh),
+                    param_version=version,
+                    actor_iter=self.iterations,
+                    t_start=t0,
+                    t_end=t1,
+                )
+                placed = False
+                while not placed and not self._stop_requested.is_set():
+                    placed = self.queue.put(block, timeout=0.05)
+        except BaseException as e:      # surface to the learner, don't die
+            self.error = e
+            self.log(f"[async] actor thread failed: {e!r}")
+            self.queue.close()

@@ -80,6 +80,16 @@ def apply_mesh(run: RunConfig, policy):
                 "--seq_shards: MAT-Dec's per-agent MLPs are indexed by global "
                 "agent id; context-sharding applies to the transformer path"
             )
+    if getattr(run, "async_actors", False):
+        if int(getattr(run, "data_shards", 1)) > 1 or seq > 1:
+            raise ValueError(
+                "--async_actors builds its own disjoint actor/learner "
+                "submeshes; size them with --actor_devices/--learner_devices, "
+                "not --data_shards/--seq_shards"
+            )
+        # no run mesh: _train_loop_async builds the submeshes itself (state
+        # starts host-local, exactly like the unsharded single-process path)
+        return None
     from mat_dcml_tpu.parallel.mesh import build_run_mesh
 
     mesh = build_run_mesh(int(getattr(run, "data_shards", 1)), seq)
@@ -454,7 +464,32 @@ class BaseRunner:
         if self.stop is not None:
             self.stop.install()
         K = max(1, int(getattr(run, "iters_per_dispatch", 1)))
+        use_async = bool(getattr(run, "async_actors", False))
+        if use_async and K > 1:
+            raise ValueError(
+                "--async_actors and --iters_per_dispatch > 1 are alternative "
+                "overlap strategies (two submesh programs vs one fused "
+                "program); pick one"
+            )
+        if use_async:
+            # same fallback-visibility contract as the fused path: when the
+            # overlap cannot run, say so in a gauge, then take the classic loop
+            if not getattr(self.collector, "jittable", True):
+                use_async = False
+                self.telemetry.gauge("async_fallback", 1.0)
+                self.log("[async] collector is host-driven (jittable=False); "
+                         "--async_actors ignored")
+            elif jax.device_count() < 2 or jax.process_count() > 1:
+                use_async = False
+                self.telemetry.gauge("async_fallback", 1.0)
+                self.log(f"[async] needs a single process with >= 2 devices "
+                         f"(have {jax.device_count()} devices, "
+                         f"{jax.process_count()} processes); --async_actors "
+                         f"ignored")
         try:
+            if use_async:
+                self.telemetry.gauge("async_fallback", 0.0)
+                return self._train_loop_async(episodes, train_state, rollout_state, key)
             if K > 1:
                 # the fallback gauge makes the silently-taken path visible to
                 # metrics.jsonl consumers (BENCHLOG legs, schema checker):
@@ -974,18 +1009,310 @@ class BaseRunner:
         process(*pending)
         return train_state, rollout_state
 
+    # ---------------------------------------------------- async actor-learner
+
+    def _train_loop_async(self, episodes, train_state, rollout_state, key):
+        """--async_actors: overlap collect and train on disjoint submeshes
+        (training/async_loop.py; Podracer sebulba).  The actor THREAD runs the
+        jitted collector continuously on the actor submesh and enqueues
+        trajectory blocks; this method IS the learner program and stays on the
+        main thread (signal handlers, checkpoint writes).  One consumed block
+        = one episode, so episode accounting, cadences, and resume counters
+        match the synchronous loops.
+
+        Not bit-exact with the synchronous loop (1-step-lagged PPO, separate
+        actor/learner PRNG consumption); the graceful-stop carry is coherent —
+        learner state at a step boundary + the actor's last completed rollout
+        state — but a resumed run replays any unconsumed actor work.
+        """
+        run = self.run_cfg
+        tel = self.telemetry
+        E = run.n_rollout_threads
+        T = run.episode_length
+        env = getattr(self, "env", None) or getattr(self.collector, "env", None)
+        n_agents = int(getattr(env, "n_agents", 1) or 1)
+        self.flight.iters_per_dispatch = 1
+
+        from mat_dcml_tpu.parallel.distributed import (
+            put_replicated,
+            put_sharded_state,
+        )
+        from mat_dcml_tpu.parallel.mesh import build_actor_learner_meshes
+        from mat_dcml_tpu.training.async_loop import (
+            ActorWorker,
+            ParamPublisher,
+            TrajectoryQueue,
+        )
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        actor_mesh, learner_mesh = build_actor_learner_meshes(
+            int(getattr(run, "actor_devices", 0)),
+            int(getattr(run, "learner_devices", 0)),
+        )
+        for side, m in (("actor", actor_mesh), ("learner", learner_mesh)):
+            n_data = dict(m.shape)["data"]
+            if E % n_data:
+                raise ValueError(
+                    f"--n_rollout_threads {E} must be divisible by the "
+                    f"{side} submesh's data axis ({n_data} devices); adjust "
+                    f"--actor_devices/--learner_devices"
+                )
+        # the learner owns train_state + PRNG chain; actors own the env state
+        train_state = put_replicated(train_state, learner_mesh)
+        key = jax.device_put(key, NamedSharding(learner_mesh, P()))
+        rollout_state = put_sharded_state(rollout_state, actor_mesh)
+
+        # the actor program gets a PRIVATE telemetry registry (the shared one
+        # is not thread-safe); merged into records as async_actor_* below
+        actor_tel = Telemetry()
+        collect_jit = instrumented_jit(
+            self.collector.collect, "collect", actor_tel, self.log
+        )
+        # donation is safe against the publisher: publish() blocks until the
+        # params copy lands on the actor submesh, so the next donating update
+        # can never invalidate buffers a device-to-device copy still reads
+        train_jit = instrumented_jit(
+            self.trainer.train, "train", tel, self.log, donate_argnums=(0,),
+            count_collectives=dict(learner_mesh.shape)["data"] > 1,
+        )
+        publisher = ParamPublisher(actor_mesh)
+        publisher.publish(train_state.params)
+        queue = TrajectoryQueue(max(1, int(getattr(run, "async_queue_depth", 2))))
+        worker = ActorWorker(collect_jit, publisher, queue, rollout_state,
+                             learner_mesh, telemetry=actor_tel, log=self.log)
+        # importance-correction hook stub (async_loop.ImportanceCorrection):
+        # runners/tests may set self.importance_correction = hook; identity
+        # (None) accepts the steady-state 1-step lag as-is
+        correction = getattr(self, "importance_correction", None)
+        tel.gauge("async_actor_devices", float(actor_mesh.size))
+        tel.gauge("async_learner_devices", float(learner_mesh.size))
+        self.log(f"[async] actor submesh {actor_mesh.size}d / learner submesh "
+                 f"{learner_mesh.size}d, queue depth {queue.capacity}")
+
+        def quiesce():
+            """Graceful-stop half of the async contract: stop the actor at an
+            iteration boundary, discard in-flight blocks (a resumed run
+            replays them), hand back the last COMPLETED rollout state."""
+            worker.request_stop()
+            queue.close()
+            worker.join(timeout=60.0)
+            discarded = len(queue.drain())
+            self.log(f"[async] stop: actor joined after {worker.iterations} "
+                     f"iteration(s); {discarded} queued block(s) discarded")
+            return worker.latest_rollout_state
+
+        first = self.start_episode
+        agg_done = agg_rew = agg_delay = agg_pay = 0.0
+        has_info = False
+        tel.start_interval()
+        start = time.time()
+        worker.start()
+        try:
+            for episode in range(first, episodes):
+                self._graceful_stop_check(episode, train_state,
+                                          worker.latest_rollout_state, key,
+                                          before_pack=quiesce)
+                # crash-path snapshot: learner-boundary train_state/key + the
+                # actor's newest completed carry (rebind-safe: the actor swaps
+                # the reference, never mutates a published tree)
+                self.watchdog.arm(episode, train_state,
+                                  worker.latest_rollout_state, key)
+                self.profile_window.tick()
+                sampled = run.telemetry_interval > 0 and (
+                    (episode - first) % run.telemetry_interval == 0
+                )
+                trace = (self.tracer.start_trace("training", root="learner_step")
+                         if self.tracer is not None else None)
+                t_wait = time.perf_counter()
+                block = queue.get(timeout=0.25)
+                while block is None:
+                    if worker.error is not None:
+                        raise DispatchFailedError(
+                            f"actor program failed: {worker.error!r}"
+                        ) from worker.error
+                    self._graceful_stop_check(episode, train_state,
+                                              worker.latest_rollout_state,
+                                              key, before_pack=quiesce)
+                    block = queue.get(timeout=0.25)
+                t_got = time.perf_counter()
+                # staleness: learner steps published since this block's params
+                lag = publisher.version - block.param_version
+                tel.hist("staleness_learner_steps", float(lag))
+                tel.gauge("staleness_param_version", float(publisher.version))
+                tel.hist("async_queue_wait_ms", (t_got - t_wait) * 1e3)
+                tel.gauge("async_queue_depth", float(queue.depth))
+                traj = block.traj
+                if correction is not None and lag > 0:
+                    traj = correction(traj, lag)
+                key, k_train = jax.random.split(key)
+                t_train = time.perf_counter()
+                train_state, metrics = train_jit(
+                    train_state, traj, self._bootstrap(block.rollout_state),
+                    k_train,
+                )
+                # the learner's next act (publish) needs the params anyway;
+                # blocking here costs nothing — the actor submesh keeps
+                # collecting while this thread waits
+                jax.block_until_ready(train_state)
+                t_end = time.perf_counter()
+                publisher.publish(train_state.params)
+                if trace is not None:
+                    trace.add_span("actor_iter", block.t_start, block.t_end,
+                                   actor_iter=block.actor_iter,
+                                   param_version=block.param_version)
+                    trace.add_span("queue_wait", t_wait, t_got)
+                    trace.add_span("train", t_train, t_end)
+                if sampled:
+                    tel.observe("step_time_collect", block.t_end - block.t_start)
+                    tel.observe("step_time_train", t_end - t_train)
+                tel.count("env_steps", T * E)
+                tel.count("agent_steps", T * E * n_agents)
+                tel.count("async_learner_steps")
+                total_steps = (episode + 1) * T * E
+                if episode == first:
+                    # learner warmup done (the actor marks its own collect jit
+                    # steady after its first iteration)
+                    if isinstance(train_jit, InstrumentedJit):
+                        train_jit.mark_steady()
+                        if train_jit.bytes_per_call is not None:
+                            tel.gauge("bytes_per_update",
+                                      float(train_jit.bytes_per_call))
+                    n_compiles = int(tel.counters.get("compile_count", 0))
+                    secs = tel.counters.get("compile_seconds_total", 0.0)
+                    self.log(f"[telemetry] learner warmup done: {n_compiles} "
+                             f"compiles in {secs:.1f}s")
+                    tel.start_interval()
+                if sampled:
+                    health = jax.device_get({
+                        "nonfinite_grads": getattr(metrics, "nonfinite_grads", 0.0),
+                        "grad_norm": getattr(metrics, "grad_norm", 0.0),
+                        "param_norm": getattr(metrics, "param_norm", 0.0),
+                        "update_ratio": getattr(metrics, "update_ratio", 0.0),
+                    })
+                    nf = float(np.sum(np.asarray(health["nonfinite_grads"])))
+                    tel.count("nonfinite_grad_steps", nf)
+                    if self.anomaly is not None:
+                        signals = {
+                            "nonfinite_grads": nf,
+                            "grad_norm": float(np.max(np.asarray(health["grad_norm"]))),
+                            "param_norm": float(np.max(np.asarray(health["param_norm"]))),
+                            "update_ratio": float(np.max(np.asarray(health["update_ratio"]))),
+                            "steady_state_recompiles":
+                                tel.counters.get("steady_state_recompiles", 0.0),
+                            "step_time_collect": block.t_end - block.t_start,
+                            "step_time_train": t_end - t_train,
+                        }
+                        trips = self.anomaly.observe(signals, episode, total_steps)
+                        if trips:
+                            reference = self._metrics_reference(metrics)
+                            self._handle_anomalies(trips, episode, total_steps,
+                                                   reference)
+
+                stats = getattr(traj, "chunk_stats", None)
+                if stats is not None:
+                    stats = {k: float(v) for k, v in jax.device_get(stats).items()}
+                    agg_done += stats["n_done"]
+                    agg_rew += stats["done_reward_sum"]
+                    has_info = "done_delay_sum" in stats
+                    agg_delay += stats.get("done_delay_sum", 0.0)
+                    agg_pay += stats.get("done_payment_sum", 0.0)
+
+                if episode % run.log_interval == 0 or episode == first:
+                    elapsed = time.time() - start
+                    steps_here = (episode + 1 - first) * T * E
+                    fps = steps_here / max(elapsed, 1e-9)
+                    record = {
+                        "episode": episode,
+                        "total_steps": total_steps,
+                        "fps": fps,
+                        "average_step_rewards": (
+                            stats["step_reward_mean"] if stats is not None
+                            else float(np.asarray(traj.rewards).sum(-1).mean())
+                        ),
+                        "value_loss": float(np.mean(metrics.value_loss)),
+                        "policy_loss": float(np.mean(metrics.policy_loss)),
+                        "dist_entropy": float(np.mean(metrics.dist_entropy)),
+                        "grad_norm": float(np.mean(getattr(metrics, "grad_norm", 0.0))),
+                        "param_norm": float(np.mean(getattr(metrics, "param_norm", 0.0))),
+                        "update_ratio": float(np.mean(getattr(metrics, "update_ratio", 0.0))),
+                        "ratio": float(np.mean(getattr(metrics, "ratio", 1.0))),
+                    }
+                    if stats is not None:
+                        for k, v in stats.items():
+                            if k.startswith("step_objective_"):
+                                i = k.split("_")[2]
+                                record[f"average_step_objective_{i}"] = v
+                        if agg_done > 0:
+                            record["aver_episode_rewards"] = agg_rew / agg_done
+                            if has_info:
+                                record["aver_episode_delays"] = agg_delay / agg_done
+                                record["aver_episode_payments"] = agg_pay / agg_done
+                            agg_done = agg_rew = agg_delay = agg_pay = 0.0
+                    for k, v in device_memory_gauges().items():
+                        tel.gauge(k, v)
+                    tel.gauge("host_rss_bytes", host_rss_bytes())
+                    tel.gauge("async_queue_drops", float(queue.drops))
+                    tel.gauge("async_queue_max_depth", float(queue.max_depth))
+                    tel.gauge("async_actor_iters", float(worker.iterations))
+                    record.update(tel.flush())
+                    with worker.tel_lock:
+                        actor_rec = worker.telemetry.flush()
+                    record.update({f"async_actor_{k}": v
+                                   for k, v in actor_rec.items()})
+                    self._extra_metrics(record)
+                    self._log_record(record)
+
+                should_save = run.save_interval > 0 and (
+                    episode % run.save_interval == 0 or episode == episodes - 1
+                )
+                if should_save and run.algorithm_name != "random":
+                    t_ckpt = time.perf_counter()
+                    self.ckpt.save(episode, train_state)
+                    if trace is not None:
+                        trace.add_span("checkpoint", t_ckpt, time.perf_counter())
+                if trace is not None:
+                    trace.finish(status="ok", episode=episode, staleness=lag)
+
+                if run.use_eval and episode % run.eval_interval == 0 and hasattr(self, "evaluate"):
+                    eval_info = self.evaluate(train_state)
+                    eval_info.update(episode=episode, total_steps=total_steps)
+                    self.writer.write(eval_info, step=total_steps)
+                    self.log(f"eval ep {episode}: {eval_info}")
+        finally:
+            # every exit path — normal, preempted, crash — must stop the actor
+            # thread and release queue waiters before the interpreter tears
+            # down jit machinery under the daemon thread
+            worker.request_stop()
+            queue.close()
+            worker.join(timeout=60.0)
+            leftover = len(queue.drain())
+            if leftover:
+                self.log(f"[async] run end: {leftover} unconsumed block(s) "
+                         f"discarded")
+        return train_state, worker.latest_rollout_state
+
     # ------------------------------------------------------------ resilience
 
     def _graceful_stop_check(self, episode: int, train_state, rollout_state,
-                             key) -> None:
+                             key, before_pack=None) -> None:
         """Honor a pending SIGTERM/SIGINT at a dispatch boundary: blocking
         emergency checkpoint of the full carry, then :class:`PreemptedExit`
         (process exit 75 — the supervisor relaunches with ``--resume auto``
-        and the run continues bit-exact)."""
+        and the run continues bit-exact).
+
+        ``before_pack``: async-overlap hook — runs only once a stop is
+        actually pending, must quiesce concurrent producers (stop the actor
+        thread, drain/discard in-flight queue blocks) and may return a
+        replacement rollout state (the actor's last completed carry), so the
+        packed snapshot is coherent at a learner-step boundary."""
         if self.stop is None or not self.stop.stop_requested:
             return
         run = self.run_cfg
         reason = self.stop.reason or "signal"
+        if before_pack is not None:
+            replaced = before_pack()
+            if replaced is not None:
+                rollout_state = replaced
         if jax.process_count() > 1 or not getattr(self.collector, "jittable",
                                                   True):
             # the packed carry needs fully-addressable arrays (and an

@@ -132,6 +132,16 @@ KNOWN_PREFIXES = (
     # (decode_cache_steps = n_agent), and the fraction of attended positions
     # served from the cache (decode_cache_hit_fraction = (A-1)/(A+1))
     "decode_cache_",
+    # async actor-learner overlap (--async_actors, base_runner.
+    # _train_loop_async + training/async_loop.py): queue health (depth,
+    # wait-time histogram, the drop counter pinned at 0), actor/learner
+    # program counters, the submesh split, the fallback gauge, and the
+    # actor program's private telemetry merged under async_actor_<field>
+    "async_",
+    # param-version staleness of consumed trajectory blocks (1-step-lagged
+    # PPO): per-block lag histogram (staleness_learner_steps_*) and the
+    # learner's current published version (staleness_param_version)
+    "staleness_",
 )
 
 # registry suffixes a histogram sketch appends on flush (registry.py
@@ -171,6 +181,17 @@ STRICT_FAMILY_PATTERNS = {
     "slo_": re.compile(
         r"^slo_((latency|error|goodput)_burn(_fast|_slow)?"
         r"|window_requests)$"),
+    # async_actor_<field> mirrors the actor program's whole merged telemetry
+    # registry (compile counters, step timers, ...) and is deliberately an
+    # open sub-namespace
+    "async_": re.compile(
+        r"^async_(fallback|queue_depth|queue_drops|queue_max_depth"
+        r"|learner_steps|learner_devices"
+        r"|queue_wait_ms(_p50|_p95|_p99|_count|_mean)"
+        r"|actor_[a-z0-9_]+)$"),
+    "staleness_": re.compile(
+        r"^staleness_(param_version"
+        r"|learner_steps(_p50|_p95|_p99|_count|_mean))$"),
 }
 
 # fields that must never go negative (counters, rates, timers, gauges)
@@ -419,7 +440,8 @@ def validate_record(record, index: int = 0, strict_names: bool = True,
         if (k in NON_NEGATIVE
                 or k.startswith(("serving_", "fleet_", "rollout_", "shard_",
                                  "resilience_", "slo_",
-                                 "decode_cache_"))) and v < 0:
+                                 "decode_cache_", "async_",
+                                 "staleness_"))) and v < 0:
             errs.append(f"{where}: field {k!r} is negative ({v})")
         if k in UNIT_INTERVAL and not (0.0 <= v <= 1.0):
             errs.append(f"{where}: field {k!r} must be in [0, 1], got {v}")

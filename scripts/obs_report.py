@@ -4,7 +4,7 @@
 Consumes the two jsonl streams a run leaves behind — ``metrics.jsonl``
 (utils/metrics.py; training records, serving/fleet snapshots, anomaly and
 emergency records) and ``trace.jsonl`` (telemetry/tracing.py; sampled span
-trees) — plus their rotated ``.1`` predecessors, and prints three panels:
+trees) — plus their rotated ``.1`` predecessors, and prints four panels:
 
 1. **Latency waterfall by span**: per-span duration statistics (count / mean /
    p50 / p95 / max) across every sampled trace, grouped by trace kind, plus an
@@ -13,7 +13,10 @@ trees) — plus their rotated ``.1`` predecessors, and prints three panels:
 2. **Fleet / SLO summary**: the last observed serving percentiles (merged
    sketch snapshots), fleet routing and rollout counters, live SLO burn-rate
    gauges, and every typed anomaly record grouped by kind.
-3. **Training health**: fps and step-timer trajectory, compile/recompile and
+3. **Actor/learner overlap** (``--async_actors`` runs): submesh split, queue
+   depth / queue-wait p95 / drop counter, actor-vs-learner progress, and the
+   param-staleness histogram.
+4. **Training health**: fps and step-timer trajectory, compile/recompile and
    nonfinite-grad counters, dispatch mode, and emergency checkpoints.
 
 Usage:
@@ -217,6 +220,52 @@ def training_panel(metrics: List[dict]) -> List[str]:
     return lines
 
 
+# ------------------------------------------------------ actor/learner overlap
+
+
+def async_panel(metrics: List[dict]) -> List[str]:
+    """Overlap health for ``--async_actors`` runs: submesh split, queue
+    depth/wait, the drop counter (contractually 0 — backpressure, not loss),
+    actor-vs-learner progress, and the param-staleness histogram."""
+    lines = ["== actor/learner overlap =="]
+    train = [r for r in metrics if "async_learner_steps" in r]
+    if not train:
+        return lines + ["  (no async actor-learner records)"]
+    last = train[-1]
+    lines.append(
+        f"  submesh split: {last.get('async_actor_devices', '?'):.0f} actor / "
+        f"{last.get('async_learner_devices', '?'):.0f} learner devices"
+        if "async_actor_devices" in last else "  submesh split: ?")
+    lines.append(f"  learner steps {last.get('async_learner_steps', 0):.0f}  "
+                 f"actor iters {last.get('async_actor_iters', 0):.0f}")
+    depths = [float(r["async_queue_depth"]) for r in train
+              if "async_queue_depth" in r]
+    if depths:
+        lines.append(f"  queue depth last {depths[-1]:.0f}  "
+                     f"p95 {percentile(depths, 0.95):.0f}  "
+                     f"max {last.get('async_queue_max_depth', 0):.0f}  "
+                     f"drops {last.get('async_queue_drops', 0):.0f}")
+    if "async_queue_wait_ms_p95" in last:
+        lines.append(f"  queue wait p50 {last.get('async_queue_wait_ms_p50', 0):.2f} ms  "
+                     f"p95 {last['async_queue_wait_ms_p95']:.2f} ms  "
+                     f"(n={last.get('async_queue_wait_ms_count', 0):.0f})")
+    if "staleness_learner_steps_p95" in last:
+        lines.append(f"  staleness (learner steps) p50 "
+                     f"{last.get('staleness_learner_steps_p50', 0):.1f}  "
+                     f"p95 {last['staleness_learner_steps_p95']:.1f}  "
+                     f"mean {last.get('staleness_learner_steps_mean', 0):.2f}  "
+                     f"(n={last.get('staleness_learner_steps_count', 0):.0f})")
+    if "staleness_param_version" in last:
+        lines.append(f"  published param version "
+                     f"{last['staleness_param_version']:.0f}")
+    for k in ("async_actor_steady_state_recompiles", "steady_state_recompiles"):
+        if k in last:
+            side = "actor" if k.startswith("async_actor_") else "learner"
+            lines.append(f"  {side} steady-state recompiles "
+                         f"{float(last[k]):.0f}")
+    return lines
+
+
 # ----------------------------------------------------------------- assembly
 
 
@@ -224,6 +273,7 @@ def build_report(metrics: List[dict], traces: List[dict]) -> str:
     sections = [
         span_panel(traces),
         fleet_panel(metrics),
+        async_panel(metrics),
         training_panel(metrics),
     ]
     return "\n".join("\n".join(s) for s in sections) + "\n"

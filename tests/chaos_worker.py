@@ -10,6 +10,11 @@ time its kill against; PreemptedExit propagates so a honored SIGTERM exits
 Usage:
     python tests/chaos_worker.py --run_dir DIR --episodes N
         [--seed 1] [--save_interval 2] [--data_shards 1] [--devices 1]
+        [--async_actors 0]
+
+``--async_actors 1`` switches to the overlapped actor-learner loop
+(--iters_per_dispatch drops to 1 — the two overlap strategies are mutually
+exclusive); pass ``--devices 2`` or more so the submesh split has devices.
 """
 
 import argparse
@@ -72,13 +77,16 @@ def main() -> None:
     parser.add_argument("--save_interval", type=int, default=2)
     parser.add_argument("--data_shards", type=int, default=1)
     parser.add_argument("--devices", type=int, default=1)
+    parser.add_argument("--async_actors", type=int, default=0)
     args = parser.parse_args()
 
     run = RunConfig(
         algorithm_name="mat", experiment_name="chaos", seed=args.seed,
         n_rollout_threads=E, episode_length=T,
         n_block=1, n_embd=16, n_head=2,
-        iters_per_dispatch=2, log_interval=1, telemetry_interval=1,
+        iters_per_dispatch=1 if args.async_actors else 2,
+        async_actors=bool(args.async_actors),
+        log_interval=1, telemetry_interval=1,
         save_interval=args.save_interval, run_dir=args.run_dir,
         anomaly_tripwires=False, resume="auto", graceful_stop=True,
         emergency_snapshot_interval=1, data_shards=args.data_shards,

@@ -772,3 +772,47 @@ def test_unhandled_dispatch_failure_writes_emergency_and_exits_76(tmp_path):
     assert found is not None
     assert found["manifest"]["reason"].startswith("failure:")
     assert found["manifest"]["next_episode"] == 0
+
+
+# ===================================================================
+# async actor-learner overlap: SIGTERM during overlap
+# ===================================================================
+
+@pytest.mark.slow
+def test_sigterm_during_async_overlap_drains_and_resumes(tmp_path):
+    """SIGTERM while the actor and learner programs overlap: the graceful-stop
+    path must stop the actor thread, drain (discard) in-flight trajectory
+    blocks, and save a coherent carry — learner state at the step boundary +
+    the actor's last completed rollout state — then exit 75.  A relaunch with
+    --resume auto replays the unconsumed actor work and finishes.  Coherent,
+    NOT bit-exact: 1-step-lagged PPO makes no bit-exactness promise across a
+    preemption (ISSUE accepts this; the sync fused path keeps its golden-run
+    bit-equality test above)."""
+    run_dir = tmp_path / "async_interrupted"
+    async_args = ("--devices", "2", "--async_actors", "1")
+
+    proc = _spawn_worker(run_dir, episodes=500, extra=async_args)
+    lines, _ = _tail_lines(proc)
+    try:
+        _wait_until(lambda: sum("ep " in ln for ln in lines) >= 2,
+                    timeout=240, what="2 overlapped episode log lines")
+        proc.send_signal(signal.SIGTERM)
+        rc = proc.wait(timeout=120)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+    out = "".join(lines)
+    assert rc == EXIT_PREEMPTED, out
+    assert "graceful stop" in out
+
+    manifest_path = _models_dir(run_dir) / "emergency" / "manifest.json"
+    assert manifest_path.exists(), out
+    manifest = json.loads(manifest_path.read_text())
+    assert manifest["format"] == EMERGENCY_FORMAT
+    resume_ep = manifest["next_episode"]
+    assert resume_ep >= 1   # learner-step boundary (K=1 under --async_actors)
+
+    rc2, out2 = _run_worker(run_dir, episodes=resume_ep + 3, extra=async_args)
+    assert rc2 == 0, out2
+    assert "restored emergency checkpoint" in out2
+    assert "DONE" in out2

@@ -446,9 +446,9 @@ def test_schema_cli_discovers_rotated_and_trace_streams(tmp_path):
 # ----------------------------------------------------------- obs_report CLI
 
 
-def test_obs_report_renders_all_three_panels(tmp_path, capsys):
-    """The report renders span waterfall + fleet/SLO + training panels from
-    one mixed stream (the golden fixture) and exits 0."""
+def test_obs_report_renders_all_panels(tmp_path, capsys):
+    """The report renders span waterfall + fleet/SLO + overlap + training
+    panels from one mixed stream (the golden fixture) and exits 0."""
     obs_report = _load_script("obs_report")
     (tmp_path / "metrics.jsonl").write_text(GOLDEN.read_text())
     assert obs_report.main([str(tmp_path)]) == 0
@@ -459,6 +459,9 @@ def test_obs_report_renders_all_three_panels(tmp_path, capsys):
     assert "slo_latency_burn" in out
     assert "slowest sampled tree" in out      # the per-trace ASCII waterfall
     assert "slo_latency_budget" in out        # anomaly rollup by kind
+    assert "actor/learner overlap" in out     # async overlap panel
+    assert "staleness (learner steps)" in out
+    assert "drops 0" in out                   # the no-drop contract, surfaced
 
 
 def test_obs_report_empty_dir_exits_nonzero(tmp_path, capsys):
