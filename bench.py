@@ -95,6 +95,20 @@ Knobs (environment variables):
                         (64), BENCH_SHARD_ELADDER (512,2048), BENCH_SHARD_K
                         (2), BENCH_SHARD_ITERS (2), plus BENCH_PPO_EPOCH /
                         BENCH_MINI_BATCH (2,2 here)
+  BENCH_FSDP            "1" → rule-based param-sharding A/B (CPU proxy):
+                        replicated (data=2) vs fsdp=2 vs tp=2 at identical
+                        E/T/K on forced virtual CPU devices, through the
+                        spec layer (parallel/sharding.py) end to end.  Each
+                        leg records the shard_param_ byte gauges (schema-
+                        validated) and the per-kind collective census of the
+                        compiled dispatch, checked against a hand-derived
+                        expectation table (which kinds each layout must /
+                        must not emit).  Writes MULTICHIP_r07.json.  The
+                        bytes split and program structure are the result;
+                        speeds are NOT chip numbers (virtual devices share
+                        one socket).  Knobs: BENCH_FSDP_E (64),
+                        BENCH_FSDP_K (2), BENCH_FSDP_ITERS (2),
+                        BENCH_FSDP_EMBD (64)
   BENCH_FLEET           "1" → replicated-fleet leg: closed-loop QPS at each
                         replica count in BENCH_FLEET_REPLICAS (1,2,4), then a
                         live canary-gated weight push under open-loop load on
@@ -933,6 +947,207 @@ def _measure_shard_sweep() -> None:
     }
     out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                        "MULTICHIP_r06.json")
+    with open(out, "w") as f:
+        json.dump(record, f, indent=2)
+    log(f"wrote {out}")
+    print(json.dumps(record), flush=True)
+
+
+def _measure_fsdp() -> None:
+    """BENCH_FSDP=1 leg: rule-based fsdp x tp param-sharding A/B (CPU proxy).
+
+    Three legs at identical E/T/K on a forced virtual-device CPU topology,
+    every one through the spec layer end to end (born-sharded init with
+    ``resolve_state_specs`` + jit ``out_shardings``, the donated fused K-step
+    dispatch on top): replicated params under pure data-parallel (data=2),
+    fsdp=2 (params + optimizer moments split over the fsdp axis), and tp=2
+    (Megatron-style column/row split).  Each leg records the
+    ``shard_param_`` byte gauges and the per-kind collective census of the
+    compiled dispatch, then checks the census against a hand-derived
+    expectation table: the replicated leg must emit NO param-movement
+    collectives (all-gather/reduce-scatter) — its only collective is the
+    grad psum — while the sharded legs must emit at least one param-movement
+    or activation-reduction kind.  The per-device byte split is exact
+    arithmetic (sizes, not timings) and therefore portable; throughput on
+    virtual CPU devices is NOT a chip number and is reported only as a
+    liveness figure."""
+    # the forced topology must exist BEFORE jax initializes
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+    jax, _ = _setup_jax()
+    # sharding-invariant RNG across all three legs (the PR 8 finding: default
+    # threefry draws different bits on meshes with nontrivial extra axes)
+    jax.config.update("jax_threefry_partitionable", True)
+
+    import numpy as np
+
+    from mat_dcml_tpu.config import RunConfig
+    from mat_dcml_tpu.envs.dcml import DCMLEnv, DCMLEnvConfig
+    from mat_dcml_tpu.envs.dcml.env import DCMLConsts
+    from mat_dcml_tpu.parallel.distributed import global_init_state
+    from mat_dcml_tpu.parallel.mesh import build_run_mesh
+    from mat_dcml_tpu.parallel.sharding import (
+        named_shardings,
+        param_byte_stats,
+        resolve_state_specs,
+    )
+    from mat_dcml_tpu.telemetry import Telemetry, instrumented_jit
+    from mat_dcml_tpu.training.base_runner import make_dispatch_fn
+    from mat_dcml_tpu.training.ppo import MATTrainer, PPOConfig
+    from mat_dcml_tpu.training.rollout import RolloutCollector
+    from mat_dcml_tpu.training.runner import build_mat_policy
+
+    E = int(os.environ.get("BENCH_FSDP_E", "64"))
+    K = int(os.environ.get("BENCH_FSDP_K", "2"))
+    iters = int(os.environ.get("BENCH_FSDP_ITERS", "2"))
+    n_embd = int(os.environ.get("BENCH_FSDP_EMBD", "64"))
+    T = 8
+
+    W = 8
+    consts = DCMLConsts(worker_number_max=W, sob_dim=W + 2)
+    rng = np.random.default_rng(0)
+    workloads = rng.integers(
+        0, 5, size=(W, consts.local_workload_period)).astype(np.float32)
+    env = DCMLEnv(DCMLEnvConfig(consts=consts), base_workloads=workloads)
+
+    # (leg name, data, fsdp, tp) — same device count (2) per leg so the
+    # byte comparison is apples-to-apples
+    LEGS = (("replicated", 2, 1, 1), ("fsdp2", 1, 2, 1), ("tp2", 1, 1, 2))
+
+    # hand-derived expectation table: which collective kinds each layout
+    # MUST (+kind) / MUST NOT (-kind) emit in the compiled dispatch.
+    #   replicated: the grad psum is an all-reduce; nothing is sharded, so
+    #     any all-gather/reduce-scatter would mean params moved needlessly.
+    #   fsdp2: the batch axis is NOT over fsdp here, so XLA either gathers
+    #     the split params before use (all-gather) or keeps activations
+    #     sharded and reduces (all-reduce / reduce-scatter) — at least one
+    #     param-movement kind must appear.
+    #   tp2: row-parallel proj/fc2 contract over the tp-sharded dim, whose
+    #     partial sums MUST all-reduce (the Megatron f/g identity).
+    EXPECT = {
+        "replicated": {"+": ["all_reduce"],
+                       "-": ["all_gather", "reduce_scatter"]},
+        "fsdp2": {"+": ["all_gather|reduce_scatter|all_reduce"], "-": []},
+        "tp2": {"+": ["all_reduce"], "-": []},
+    }
+
+    def leg(name: str, data: int, fsdp: int, tp: int):
+        run = RunConfig(n_rollout_threads=E, episode_length=T,
+                        n_block=1, n_embd=n_embd, n_head=2)
+        policy = build_mat_policy(run, env)
+        trainer = MATTrainer(policy, PPOConfig(
+            ppo_epoch=int(os.environ.get("BENCH_PPO_EPOCH", "2")),
+            num_mini_batch=int(os.environ.get("BENCH_MINI_BATCH", "2"))))
+        collector = RolloutCollector(env, policy, T)
+        n_dev = data * fsdp * tp
+        mesh = build_run_mesh(data, 1, fsdp, tp, devices=jax.devices()[:n_dev])
+        with mesh:
+            p_probe = jax.eval_shape(policy.init_params, jax.random.key(0))
+            p_specs = resolve_state_specs(p_probe, mesh)
+            params = jax.jit(policy.init_params,
+                             out_shardings=named_shardings(p_specs, mesh))(
+                jax.random.key(0))
+            s_probe = jax.eval_shape(trainer.init_state, p_probe)
+            s_specs = resolve_state_specs(s_probe, mesh)
+            state_shardings = named_shardings(s_specs, mesh)
+            ts = jax.jit(trainer.init_state,
+                         out_shardings=state_shardings)(params)
+            rs = global_init_state(collector, jax.random.key(1), E, mesh)
+        tel = Telemetry()
+        dispatch = instrumented_jit(
+            make_dispatch_fn(trainer, collector, K,
+                             state_shardings=state_shardings),
+            "dispatch", tel, log,
+            donate_argnums=(0, 1), count_collectives=True)
+        with mesh:
+            key = jax.random.key(2)
+            ts, rs, key, _ = dispatch(ts, rs, key)      # warmup (compile)
+            jax.block_until_ready(ts)
+            dispatch.mark_steady()
+            start = time.perf_counter()
+            for _ in range(iters):
+                ts, rs, key, _ = dispatch(ts, rs, key)
+            jax.block_until_ready(ts)
+            elapsed = time.perf_counter() - start
+        p_stats = param_byte_stats(p_probe, p_specs, mesh)
+        s_stats = param_byte_stats(s_probe, s_specs, mesh)
+        kinds = dict(dispatch.collective_kinds_per_call or {})
+        ok, misses = True, []
+        for want in EXPECT[name]["+"]:
+            if not any(kinds.get(k, 0) > 0 for k in want.split("|")):
+                ok, _ = False, misses.append(f"missing {want}")
+        for ban in EXPECT[name]["-"]:
+            if kinds.get(ban, 0) > 0:
+                ok, _ = False, misses.append(f"unexpected {ban}={kinds[ban]}")
+        # CPU has no HBM; devices report no memory stats -> honest 0
+        mem = jax.local_devices()[0].memory_stats() or {}
+        row = {
+            "leg": name, "data": data, "fsdp": fsdp, "tp": tp,
+            "steps_per_sec": round(iters * K * E * T / elapsed, 2),
+            "shard_param_bytes_total": p_stats["bytes_total"],
+            "shard_param_bytes_fsdp": p_stats["bytes_fsdp"],
+            "shard_param_bytes_tp": p_stats["bytes_tp"],
+            "shard_param_bytes_replicated": p_stats["bytes_replicated"],
+            "shard_param_max_device_bytes": p_stats["max_device_bytes"],
+            "shard_param_opt_max_device_bytes": s_stats["max_device_bytes"],
+            "shard_hbm_high_water_bytes": int(mem.get("peak_bytes_in_use", 0)),
+            "collective_kinds": kinds,
+            "expectation_ok": ok,
+            "expectation_misses": misses,
+            "compile_count": dispatch.compile_count,
+            "steady_state_recompiles": int(
+                tel.counters.get("steady_state_recompiles", 0)),
+        }
+        log(f"{name}: max_device_param_bytes={p_stats['max_device_bytes']} "
+            f"(total {p_stats['bytes_total']}), opt+param max/device="
+            f"{s_stats['max_device_bytes']}, kinds={kinds}, "
+            f"expectation_ok={ok}")
+        print(json.dumps(row), flush=True)
+        return row
+
+    rows = [leg(*cfg) for cfg in LEGS]
+    by = {r["leg"]: r for r in rows}
+
+    # schema check: emit the gauge family exactly as base_runner would
+    gauges = {f"shard_param_{k.split('shard_param_')[1]}": float(v)
+              for k, v in by["fsdp2"].items()
+              if k.startswith("shard_param_")}
+    for kind, n in by["fsdp2"]["collective_kinds"].items():
+        gauges[f"shard_param_collectives_{kind}"] = float(n)
+    try:
+        sys.path.insert(0, os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "scripts"))
+        from check_metrics_schema import validate_record
+
+        schema_errors = validate_record(gauges)
+    except Exception as e:  # pragma: no cover - import environment drift
+        schema_errors = [f"validator unavailable: {e!r}"]
+    for err in schema_errors:
+        log(f"schema: {err}")
+
+    dev = jax.devices()[0]
+    repl, f2 = by["replicated"], by["fsdp2"]
+    record = {
+        "metric": "dcml_mat_fsdp_param_bytes_per_device_ratio",
+        # the headline: per-device param+opt bytes at fsdp=2 vs replicated
+        # (exact size arithmetic — the one portable number in a CPU proxy)
+        "value": round(f2["shard_param_opt_max_device_bytes"]
+                       / repl["shard_param_opt_max_device_bytes"], 4),
+        "unit": "ratio",
+        "platform": dev.platform,
+        "device": dev.device_kind,
+        "provisional": dev.platform != "tpu",
+        "proxy": "cpu-virtual-devices",  # bytes are exact; speeds are not
+        "E": E, "T": T, "K": K, "n_embd": n_embd,
+        "legs": rows,
+        "expectations_ok": all(r["expectation_ok"] for r in rows),
+        "schema_ok": not schema_errors,
+    }
+    out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "MULTICHIP_r07.json")
     with open(out, "w") as f:
         json.dump(record, f, indent=2)
     log(f"wrote {out}")
@@ -2038,6 +2253,12 @@ def main() -> None:
     # Sharded fused-dispatch leg: pins its own CPU topology before jax init
     if os.environ.get("BENCH_SHARD_SWEEP", "0") == "1":
         _measure_shard_sweep()
+        return
+
+    # Param-sharding A/B: replicated vs fsdp=2 vs tp=2 through the spec
+    # layer; pins its own CPU topology before jax init
+    if os.environ.get("BENCH_FSDP", "0") == "1":
+        _measure_fsdp()
         return
 
     # Multi-scenario overhead A/B: scenario-as-data family vs plain env

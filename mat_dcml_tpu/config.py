@@ -112,6 +112,17 @@ class RunConfig:
     # devices not consumed by --seq_shards); 1 = no data sharding.
     # n_rollout_threads must be divisible by the resulting shard count.
     data_shards: int = 1
+    # parameter sharding (parallel/sharding.py): shard every rule-matched
+    # param (and its optimizer moments) over the mesh's fsdp/tp axes so the
+    # trunk is no longer capped by one device's HBM.  Specs come from regex
+    # rules over flattened param names (first match wins; unmatched params
+    # are a typed error, never silent replication).  1/1 = replicated, the
+    # classic path, bit-exact.  n_embd must divide fsdp_shards*tp_shards.
+    fsdp_shards: int = 1
+    tp_shards: int = 1
+    # optional JSON rules file overriding the built-in MAT rule set; format
+    # in README "Scaling" (list of [regex, spec-list] pairs)
+    sharding_rules: Optional[str] = None
     # rollout decode: "cached" (default) = O(1)-per-step decode against the
     # packed head-split KV buffer (models/decode.py:cached_decode), bit-exact
     # to "scan"; "scan" = sequential AR decode re-deriving per-step state;

@@ -123,12 +123,13 @@ def put_replicated(tree, mesh):
     """Place a host-local pytree (e.g. a restored checkpoint) as replicated
     global arrays on ``mesh``.  Fully-replicated shardings are the one
     multi-host-safe ``device_put`` — every process holds the complete value,
-    so no cross-host data movement is implied."""
-    import jax
-    from jax.sharding import NamedSharding, PartitionSpec as P
+    so no cross-host data movement is implied.  Delegates to the one
+    spec-aware placement seam (``parallel.sharding.place_params``) with no
+    specs — spec-carrying callers pass their specs to ``place_params``
+    directly."""
+    from mat_dcml_tpu.parallel.sharding import place_params
 
-    repl = NamedSharding(mesh, P())
-    return jax.tree.map(lambda x: jax.device_put(x, repl), tree)
+    return place_params(tree, mesh, specs=None)
 
 
 def put_time_major(tree, mesh, data_axis: str = "data"):

@@ -75,33 +75,79 @@ def make_data_seq_mesh(n_seq: int, devices: Optional[Sequence[jax.Device]] = Non
     return Mesh(np.array(devices).reshape(-1, n_seq), ("data", "seq"))
 
 
+def make_run_mesh(
+    n_seq: int,
+    n_fsdp: int = 1,
+    n_tp: int = 1,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """(data, seq, fsdp, tp) mesh, data MAJOR and tp MOST-MINOR.
+
+    Generalizes :func:`make_data_seq_mesh`'s placement invariant: the
+    collective-heavy axes (tp every layer, fsdp every param touch, seq every
+    ring step) sit innermost so each ``seq x fsdp x tp`` block is a run of
+    consecutive devices — ``jax.devices()`` orders by process, so requiring
+    each block inside one process keeps those collectives on ICI, never DCN.
+    Only the ``data`` axis (grad psum once per step) may span processes.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    for name, n in (("seq", n_seq), ("fsdp", n_fsdp), ("tp", n_tp)):
+        if n <= 0:
+            raise ValueError(f"n_{name} must be >= 1, got {n}")
+    block = n_seq * n_fsdp * n_tp
+    if len(devices) % block:
+        raise ValueError(
+            f"seq x fsdp x tp block ({n_seq}x{n_fsdp}x{n_tp}={block}) must "
+            f"divide the device count {len(devices)}"
+        )
+    for start in range(0, len(devices), block):
+        procs = {d.process_index for d in devices[start:start + block]}
+        if len(procs) > 1:
+            raise ValueError(
+                f"seq/fsdp/tp block {start // block} spans processes "
+                f"{sorted(procs)} (ICI -> DCN); pick shard counts whose "
+                f"product divides the per-process device count"
+            )
+    arr = np.array(devices).reshape(-1, n_seq, n_fsdp, n_tp)
+    return Mesh(arr, ("data", "seq", "fsdp", "tp"))
+
+
 def build_run_mesh(
     data_shards: int,
     seq_shards: int = 1,
+    fsdp_shards: int = 1,
+    tp_shards: int = 1,
     devices: Optional[Sequence[jax.Device]] = None,
 ) -> Optional[Mesh]:
-    """The runner-facing ``(data, seq)`` mesh for ``--data_shards`` x
-    ``--seq_shards``.
+    """The runner-facing ``(data, seq, fsdp, tp)`` mesh for ``--data_shards``
+    x ``--seq_shards`` x ``--fsdp_shards`` x ``--tp_shards``.
 
-    ``data_shards=0`` means auto: every available device not consumed by
-    ``seq_shards`` becomes a data shard (global device count // seq_shards —
+    ``data_shards=0`` means auto: every available device not consumed by the
+    other axes becomes a data shard (global device count // (seq*fsdp*tp) —
     under multi-process this counts GLOBAL devices, so every process runs the
     same SPMD program over one global mesh).  Returns ``None`` when no mesh
-    is needed (1x1 single-process) — the runner then keeps host-local state.
+    is needed (1x1x1x1 single-process) — the runner then keeps host-local
+    state.
 
-    Always built through :func:`make_data_seq_mesh` so the seq-minor ICI-ring
+    Always built through :func:`make_run_mesh` so the minor-axis ICI-block
     placement invariant holds at every composition site.
     """
     devices = list(devices if devices is not None else jax.devices())
     if seq_shards <= 0:
         raise ValueError(f"seq_shards must be >= 1, got {seq_shards}")
+    if fsdp_shards <= 0:
+        raise ValueError(f"fsdp_shards must be >= 1, got {fsdp_shards}")
+    if tp_shards <= 0:
+        raise ValueError(f"tp_shards must be >= 1, got {tp_shards}")
     if data_shards < 0:
         raise ValueError(f"data_shards must be >= 0 (0 = auto), got {data_shards}")
-    n_data = data_shards if data_shards else max(1, len(devices) // seq_shards)
-    n_total = n_data * seq_shards
+    block = seq_shards * fsdp_shards * tp_shards
+    n_data = data_shards if data_shards else max(1, len(devices) // block)
+    n_total = n_data * block
     if n_total > len(devices):
         raise ValueError(
-            f"--data_shards {n_data} x --seq_shards {seq_shards} needs "
+            f"--data_shards {n_data} x --seq_shards {seq_shards} x "
+            f"--fsdp_shards {fsdp_shards} x --tp_shards {tp_shards} needs "
             f"{n_total} devices, have {len(devices)}"
         )
     import jax as _jax
@@ -112,12 +158,13 @@ def build_run_mesh(
         # on non-addressable inputs.  Require full coverage (or auto).
         raise ValueError(
             f"multi-process meshes must cover all {len(devices)} global "
-            f"devices; --data_shards {n_data} x --seq_shards {seq_shards} "
-            f"covers {n_total} (use --data_shards 0 for auto)"
+            f"devices; --data_shards {n_data} x --seq_shards {seq_shards} x "
+            f"--fsdp_shards {fsdp_shards} x --tp_shards {tp_shards} covers "
+            f"{n_total} (use --data_shards 0 for auto)"
         )
     if n_total == 1 and _jax.process_count() == 1:
         return None
-    return make_data_seq_mesh(seq_shards, devices[:n_total])
+    return make_run_mesh(seq_shards, fsdp_shards, tp_shards, devices[:n_total])
 
 
 def build_actor_learner_meshes(

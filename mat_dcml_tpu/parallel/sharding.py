@@ -1,0 +1,375 @@
+"""Rule-based parameter PartitionSpecs: the fsdp x tp layer.
+
+The (data, seq) mesh shards envs and sequence, but every parameter pytree was
+replicated (``P()``), capping the MAT trunk at one device's HBM.  This module
+is the single place parameter shardings are decided and applied:
+
+- :class:`SpecLayout` names the per-layer specs (embedding / qkv / proj /
+  ffn / head) in terms of the ``fsdp`` and ``tp`` mesh axes — a Megatron-ish
+  layout where qkv and ffn-up are column-parallel ``P(fsdp, tp)`` and proj
+  and ffn-down are row-parallel ``P(tp, fsdp)``;
+- :func:`match_partition_rules` maps ordered ``(regex, spec)`` rules over
+  "/"-joined flattened tree paths, first match wins.  An unmatched model
+  parameter raises :class:`UnmatchedParamError` — rules can NEVER silently
+  fall back to replication, because a silently-replicated tensor is exactly
+  the HBM leak this layer exists to prevent;
+- :func:`resolve_state_specs` applies the rules to a whole TrainState probe.
+  Optimizer moments inherit the param specs for free: optax's ``mu``/``nu``
+  hold the same ``params/...`` dict subtree, so the same regexes match
+  through ``opt_state/1/0/mu/params/...``;
+- :func:`place_params` is THE placement seam for params at rest: every
+  restore / emergency / elastic / publish path places through it (specs=None
+  means replicated, the pre-fsdp behavior);
+- :func:`gather_replicated` undoes the sharding for consumers that need full
+  values (the serving engine's AOT bucket programs) — through the spec
+  layer, not ad-hoc ``put_replicated``.
+
+Scalars and size-1 leaves always replicate; leaves outside a ``params/``
+subtree (ValueNorm moments, step counters) always replicate.  Rules engage
+only when the mesh actually has fsdp or tp extent — at fsdp=tp=1 the resolved
+specs are all ``P()`` and the program is bit-identical to the replicated
+path (pinned in tests/test_param_sharding.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import re
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.tree_util as jtu
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+PARAM_MARKER = "params/"
+
+#: axis-name tuple the run mesh exposes for parameter sharding
+PARAM_AXES = ("fsdp", "tp")
+
+
+class UnmatchedParamError(ValueError):
+    """A model parameter matched no partition rule.
+
+    Raised instead of silently replicating: an unmatched tensor is a silent
+    per-device HBM regression, the exact failure mode this layer prevents.
+    """
+
+
+class ShardMismatchError(ValueError):
+    """A resolved spec does not divide its parameter's shape (or names more
+    dims than the parameter has)."""
+
+
+@dataclasses.dataclass(frozen=True)
+class SpecLayout:
+    """Named per-layer PartitionSpecs over the (fsdp, tp) axes.
+
+    One frozen instance describes the whole layout; :func:`default_mat_rules`
+    binds it to MAT's encoder-decoder param names.
+    """
+
+    fsdp_axis: str = "fsdp"
+    tp_axis: str = "tp"
+
+    def embedding(self) -> P:
+        # (env_dim, n_embd): the input dim is env-determined (obs/state/
+        # action width, e.g. DCML's 7) and not generally divisible —
+        # shard the n_embd columns over both param axes.
+        return P(None, (self.fsdp_axis, self.tp_axis))
+
+    def qkv_projection(self) -> P:
+        # (n_embd, n_embd) column-parallel
+        return P(self.fsdp_axis, self.tp_axis)
+
+    def attn_output(self) -> P:
+        # (n_embd, n_embd) row-parallel: consumes the tp-split activations
+        return P(self.tp_axis, self.fsdp_axis)
+
+    def ffn_up(self) -> P:
+        return P(self.fsdp_axis, self.tp_axis)
+
+    def ffn_down(self) -> P:
+        return P(self.tp_axis, self.fsdp_axis)
+
+    def head_hidden(self) -> P:
+        # head Dense_0 (n_embd, n_embd)
+        return P(self.fsdp_axis, self.tp_axis)
+
+    def head_out(self) -> P:
+        # head Dense_1 (n_embd, out_dim) with tiny out_dim (1 or action_dim):
+        # shard only the input rows
+        return P(self.fsdp_axis, None)
+
+    def replicated(self) -> P:
+        return P()
+
+
+def default_mat_rules(layout: Optional[SpecLayout] = None) -> Tuple[Tuple[str, P], ...]:
+    """The default rule set for the MAT encoder-decoder trunk (all
+    ``mat_variants`` included).  First match wins; order goes from the
+    cheap always-replicated tails to layer kernels by specificity."""
+    L = layout or SpecLayout()
+    return (
+        # norms, biases, gains: 1-D tails, replicated (sharding them saves
+        # ~n_embd bytes per tensor and costs a collective per use)
+        (r"(bias|scale)$", P()),
+        (r"log_std$", P()),
+        # env-facing encoders: input dim arbitrary, shard n_embd columns
+        (r"(obs_encoder|state_encoder)/Dense_\d+/kernel$", L.embedding()),
+        (r"action_encoder\w*/kernel$", L.embedding()),
+        # attention (encoder attn, decoder attn1/attn2): qkv column-parallel,
+        # proj row-parallel
+        (r"attn\d*/(query_p|key_p|value_p)/kernel$", L.qkv_projection()),
+        (r"attn\d*/proj/kernel$", L.attn_output()),
+        # dec_actor per-agent MLP head: kernels carry a leading n_agent axis
+        # (share_actor drops it); explicitly replicated, NOT an omission —
+        # the per-agent stack is tiny and agent-indexed
+        (r"decoder/mlp/Dense_\d+/kernel$", P()),
+        # transformer block MLP
+        (r"mlp/Dense_0/kernel$", L.ffn_up()),
+        (r"mlp/Dense_1/kernel$", L.ffn_down()),
+        # GRU variant recurrence cells: square (n_embd, n_embd) kernels
+        (r"cells_\d+/\w+/kernel$", L.qkv_projection()),
+        # value / action heads
+        (r"(head|act_head)/Dense_0/kernel$", L.head_hidden()),
+        (r"(head|act_head)/Dense_\d+/kernel$", L.head_out()),
+    )
+
+
+def _path_str(path) -> str:
+    parts = []
+    for k in path:
+        if isinstance(k, jtu.DictKey):
+            parts.append(str(k.key))
+        elif isinstance(k, jtu.GetAttrKey):
+            parts.append(k.name)
+        elif isinstance(k, jtu.SequenceKey):
+            parts.append(str(k.idx))
+        elif isinstance(k, jtu.FlattenedIndexKey):
+            parts.append(str(k.key))
+        else:  # pragma: no cover - future key types
+            parts.append(str(k))
+    return "/".join(parts)
+
+
+def _leaf_size(leaf) -> int:
+    shape = getattr(leaf, "shape", ())
+    return int(np.prod(shape)) if shape else 1
+
+
+def match_partition_rules(rules: Sequence[Tuple[str, P]], tree, *, param_marker: str = PARAM_MARKER):
+    """Resolve a PartitionSpec per leaf of ``tree`` (params or a whole
+    TrainState probe) by first-match-wins regex over the "/"-joined path.
+
+    Scalars/size-1 leaves and leaves outside a ``params/`` subtree replicate
+    unconditionally.  A model parameter that matches no rule raises
+    :class:`UnmatchedParamError`.
+    """
+    leaves, treedef = jtu.tree_flatten_with_path(tree)
+    specs = []
+    for path, leaf in leaves:
+        name = _path_str(path)
+        if _leaf_size(leaf) <= 1 or param_marker not in name + "/":
+            specs.append(P())
+            continue
+        for pattern, spec in rules:
+            if re.search(pattern, name):
+                specs.append(spec)
+                break
+        else:
+            raise UnmatchedParamError(
+                f"partition rule not found for param {name!r} "
+                f"(shape {tuple(getattr(leaf, 'shape', ()))}); add a rule — "
+                f"unmatched params never silently replicate"
+            )
+    return jtu.tree_unflatten(treedef, specs)
+
+
+def _axis_shards(mesh: Mesh, entry) -> int:
+    axes = entry if isinstance(entry, tuple) else (entry,)
+    shape = dict(mesh.shape)
+    n = 1
+    for a in axes:
+        if a is not None:
+            n *= int(shape.get(a, 1))
+    return n
+
+
+def validate_specs(specs, tree, mesh: Mesh) -> None:
+    """Typed shape/divisibility check of resolved specs against a tree.
+
+    Raises :class:`ShardMismatchError` naming the first offending param, its
+    dim, and the shard count — catching e.g. ``n_embd % tp != 0`` at config
+    time instead of as an opaque XLA error mid-init.
+    """
+    spec_leaves = jtu.tree_leaves(specs, is_leaf=lambda x: isinstance(x, P))
+    tree_leaves = jtu.tree_flatten_with_path(tree)[0]
+    for (path, leaf), spec in zip(tree_leaves, spec_leaves):
+        shape = tuple(getattr(leaf, "shape", ()))
+        if len(spec) > len(shape):
+            raise ShardMismatchError(
+                f"spec {spec} names {len(spec)} dims but param "
+                f"{_path_str(path)!r} has shape {shape}"
+            )
+        for dim, entry in enumerate(spec):
+            n = _axis_shards(mesh, entry)
+            if n > 1 and shape[dim] % n:
+                raise ShardMismatchError(
+                    f"param {_path_str(path)!r} dim {dim} ({shape[dim]}) is "
+                    f"not divisible by {entry!r} ({n} shards); pick n_embd a "
+                    f"multiple of fsdp_shards*tp_shards or adjust the rules"
+                )
+
+
+def has_param_axes(mesh: Optional[Mesh]) -> bool:
+    """True iff ``mesh`` carries real fsdp/tp extent (>1 on either axis)."""
+    if mesh is None:
+        return False
+    shape = dict(mesh.shape)
+    return any(int(shape.get(a, 1)) > 1 for a in PARAM_AXES)
+
+
+def resolve_state_specs(tree, mesh: Optional[Mesh], rules: Optional[Sequence[Tuple[str, P]]] = None):
+    """Specs for a params-or-TrainState tree under ``mesh``.
+
+    Fast path: without real fsdp/tp extent every leaf replicates (``P()``)
+    WITHOUT consulting rules, so non-MAT policies keep working under pure
+    data/seq sharding and the fsdp=tp=1 program stays bit-identical to the
+    replicated path.  With extent, rules are mandatory and validated.
+    """
+    if not has_param_axes(mesh):
+        return jax.tree.map(lambda _: P(), tree)
+    rules = rules if rules is not None else default_mat_rules()
+    specs = match_partition_rules(rules, tree)
+    validate_specs(specs, tree, mesh)
+    return specs
+
+
+def named_shardings(specs, mesh: Mesh):
+    """Tree of NamedShardings from a tree of PartitionSpecs."""
+    return jax.tree.map(
+        lambda s: NamedSharding(mesh, s), specs, is_leaf=lambda x: isinstance(x, P)
+    )
+
+
+def place_params(tree, mesh: Optional[Mesh], specs=None):
+    """THE placement seam for params at rest.
+
+    Every path that moves a host-local (or differently-placed) param tree
+    onto a mesh — checkpoint restore, emergency resume, elastic re-placement
+    across mesh shapes, async publish — goes through here so resumed state
+    can't silently drop its shardings.  ``specs=None`` (or no mesh) means
+    replicated, the pre-fsdp behavior.  Re-placement across param-axis
+    changes (fsdp=2 -> 4 and back) is just this function with the new mesh's
+    resolved specs: ``device_put`` against a NamedSharding reshards.
+    """
+    if mesh is None:
+        return tree
+    if specs is None:
+        repl = NamedSharding(mesh, P())
+        return jax.tree.map(lambda x: jax.device_put(x, repl), tree)
+    shardings = named_shardings(specs, mesh)
+    return jax.tree.map(lambda x, s: jax.device_put(x, s), tree, shardings)
+
+
+def gather_replicated(tree):
+    """Gather cross-device-sharded leaves back to fully-replicated arrays on
+    their own mesh (host-local and already-replicated leaves pass through).
+
+    The spec-layer inverse of :func:`place_params` — serving's AOT bucket
+    programs install through this instead of assuming inbound weights are
+    already full."""
+
+    def gather(x):
+        if not isinstance(x, jax.Array):
+            return x
+        sharding = getattr(x, "sharding", None)
+        if sharding is None or x.is_fully_replicated or len(x.sharding.device_set) == 1:
+            return x
+        mesh = getattr(sharding, "mesh", None)
+        if mesh is None:  # positional sharding: fall back via host
+            return np.asarray(x)
+        return jax.device_put(x, NamedSharding(mesh, P()))
+
+    return jax.tree.map(gather, tree)
+
+
+def param_byte_stats(tree, specs, mesh: Optional[Mesh]) -> dict:
+    """Byte accounting for the ``shard_param_`` gauge family.
+
+    Returns global param bytes split by which axis shards them, plus the
+    max-per-device footprint (global bytes / shard count per leaf) — the
+    number that proves the HBM win.  Works on eval_shape probes or concrete
+    trees."""
+    stats = {
+        "bytes_total": 0,
+        "bytes_fsdp": 0,
+        "bytes_tp": 0,
+        "bytes_replicated": 0,
+        "max_device_bytes": 0,
+    }
+    spec_leaves = jtu.tree_leaves(specs, is_leaf=lambda x: isinstance(x, P))
+    tree_leaves = jtu.tree_leaves(tree)
+    for leaf, spec in zip(tree_leaves, spec_leaves):
+        nbytes = _leaf_size(leaf) * np.dtype(getattr(leaf, "dtype", np.float32)).itemsize
+        axes = set()
+        shards = 1
+        for entry in spec:
+            for a in (entry if isinstance(entry, tuple) else (entry,)):
+                if a is not None:
+                    axes.add(a)
+            if mesh is not None:
+                shards *= _axis_shards(mesh, entry)
+        stats["bytes_total"] += nbytes
+        if "fsdp" in axes:
+            stats["bytes_fsdp"] += nbytes
+        if "tp" in axes:
+            stats["bytes_tp"] += nbytes
+        if not axes:
+            stats["bytes_replicated"] += nbytes
+        stats["max_device_bytes"] += nbytes // max(1, shards)
+    return stats
+
+
+def load_rules(path: str, layout: Optional[SpecLayout] = None) -> Tuple[Tuple[str, P], ...]:
+    """Load a rules file: a JSON list of ``[regex, spec]`` pairs, where spec
+    is a list of entries — ``null`` (dim unsharded), an axis name, or a list
+    of axis names (a dim sharded over multiple axes).  Example::
+
+        [["attn\\\\d*/(query_p|key_p|value_p)/kernel$", ["fsdp", "tp"]],
+         ["(bias|scale)$", []]]
+
+    The README "Scaling" section documents the format with the full default
+    MAT rule set.  ``layout`` is accepted for symmetry with
+    :func:`default_mat_rules` but JSON rules name axes directly."""
+    del layout
+    with open(path) as f:
+        raw = json.load(f)
+    if not isinstance(raw, list):
+        raise ValueError(f"rules file {path}: expected a JSON list, got {type(raw).__name__}")
+    rules = []
+    for i, item in enumerate(raw):
+        if not (isinstance(item, list) and len(item) == 2 and isinstance(item[0], str)):
+            raise ValueError(f"rules file {path}: entry {i} must be [regex, spec-list]")
+        pattern, spec = item
+        try:
+            re.compile(pattern)
+        except re.error as e:
+            raise ValueError(f"rules file {path}: entry {i} bad regex: {e}") from e
+        if not isinstance(spec, list):
+            raise ValueError(f"rules file {path}: entry {i} spec must be a list")
+        entries = []
+        for entry in spec:
+            if entry is None or isinstance(entry, str):
+                entries.append(entry)
+            elif isinstance(entry, list) and all(isinstance(a, str) for a in entry):
+                entries.append(tuple(entry))
+            else:
+                raise ValueError(
+                    f"rules file {path}: entry {i} spec entries must be "
+                    f"null, an axis name, or a list of axis names"
+                )
+        rules.append((pattern, P(*entries)))
+    return tuple(rules)

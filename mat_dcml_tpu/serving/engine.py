@@ -133,12 +133,20 @@ class DecodeEngine:
     def _prepare_params(self, params):
         """Device-place an artifact, casting the trunk to the serve dtype.
 
-        With ``serve_dtype="bf16"`` every float32 leaf is cast to bfloat16
+        Inbound params may arrive fsdp/tp-sharded from a training mesh (the
+        live-push path); the AOT bucket programs run single-device, so such
+        leaves gather to full values first — through the spec layer
+        (``parallel.sharding.gather_replicated``), the inverse of
+        ``place_params``, not an ad-hoc ``put_replicated``.  With
+        ``serve_dtype="bf16"`` every float32 leaf is cast to bfloat16
         EXCEPT head and ``log_std`` leaves: logits/values feed distributions
         and the action std parameterization, which stay float32 by the Head
         contract (models/mat.py).  f32 serving is a pure device_put — training
         artifacts pass through bit-identically.
         """
+        from mat_dcml_tpu.parallel.sharding import gather_replicated
+
+        params = gather_replicated(params)
         if not self._bf16:
             return self._put(params)
 

@@ -65,6 +65,37 @@ def _collective_count(compiled) -> Optional[int]:
         return None
 
 
+#: HLO op names per collective kind, as they appear in optimized HLO text.
+#: fsdp/tp param sharding turns matmuls into all-gather / reduce-scatter and
+#: seq rings into collective-permute, so the all-reduce-only census above
+#: under-describes a 4-axis program; this per-kind census feeds the
+#: ``shard_param_collectives_<kind>`` gauges and the BENCH_FSDP expectation
+#: table.
+_COLLECTIVE_KINDS = {
+    "all_reduce": ("all-reduce(", "all-reduce-start("),
+    "all_gather": ("all-gather(", "all-gather-start("),
+    "reduce_scatter": ("reduce-scatter(",),
+    "collective_permute": ("collective-permute(", "collective-permute-start("),
+    "all_to_all": ("all-to-all(",),
+}
+
+
+def _collective_kind_counts(compiled) -> Optional[dict]:
+    """Per-kind census of collective ops in a compiled executable's optimized
+    HLO text (``{kind: count}``, zero-count kinds omitted).  Best effort —
+    returns None rather than raise."""
+    try:
+        text = compiled.as_text()
+    except Exception:
+        return None
+    counts = {}
+    for kind, needles in _COLLECTIVE_KINDS.items():
+        n = sum(text.count(needle) for needle in needles)
+        if n:
+            counts[kind] = n
+    return counts
+
+
 def _abstract_signature(args, kwargs):
     """Hashable key matching jit's cache granularity for array-only calls:
     pytree structure + (shape, dtype, weak_type) per array leaf; python
@@ -110,6 +141,9 @@ class InstrumentedJit:
         # until a compile lands or when counting is off)
         self._count_collectives = bool(count_collectives)
         self.collectives_per_call: Optional[int] = None
+        # per-kind collective census ({kind: count}, e.g. "all_gather") of
+        # the same executable; None until a counted compile lands
+        self.collective_kinds_per_call: Optional[dict] = None
 
     def mark_steady(self) -> None:
         """Warmup is over: any compile from now on is unexpected."""
@@ -146,6 +180,9 @@ class InstrumentedJit:
                 n = _collective_count(compiled)
                 if n is not None:
                     self.collectives_per_call = n
+                kinds = _collective_kind_counts(compiled)
+                if kinds is not None:
+                    self.collective_kinds_per_call = kinds
             self._maybe_dump_hlo(compiled)
         self._compiled[key] = compiled
         return compiled

@@ -152,20 +152,19 @@ class TrajectoryQueue:
 class ParamPublisher:
     """Versioned device-to-device param broadcast, learner -> actor submesh.
 
-    ``publish`` places the fresh params replicated on the actor submesh
-    (one ``device_put`` = direct device-to-device copy, no host staging) and
-    bumps the version; ``snapshot`` hands the actor the latest (params,
-    version) pair.  The publish blocks until the copy lands so the learner's
-    next (donating) update can never invalidate buffers a copy still reads.
+    ``publish`` places the fresh params on the actor submesh through the
+    spec layer (``parallel.sharding.place_params`` — one ``device_put`` per
+    leaf = direct device-to-device copy, no host staging; ``param_specs``
+    default to None = replicated, and learner-side fsdp/tp-sharded inbound
+    leaves reshard on the way) and bumps the version; ``snapshot`` hands the
+    actor the latest (params, version) pair.  The publish blocks until the
+    copy lands so the learner's next (donating) update can never invalidate
+    buffers a copy still reads.
     """
 
-    def __init__(self, actor_mesh=None):
-        if actor_mesh is not None:
-            from jax.sharding import NamedSharding, PartitionSpec as P
-
-            self._sharding = NamedSharding(actor_mesh, P())
-        else:
-            self._sharding = None    # single-device / test use: no placement
+    def __init__(self, actor_mesh=None, param_specs=None):
+        self._mesh = actor_mesh      # None: single-device / test use
+        self._specs = param_specs
         self._lock = threading.Lock()
         self._params = None
         self._version = 0
@@ -178,8 +177,10 @@ class ParamPublisher:
     def publish(self, params) -> int:
         import jax
 
-        if self._sharding is not None:
-            placed = jax.device_put(params, self._sharding)
+        if self._mesh is not None:
+            from mat_dcml_tpu.parallel.sharding import place_params
+
+            placed = place_params(params, self._mesh, self._specs)
             jax.block_until_ready(placed)
         else:
             placed = params

@@ -80,19 +80,31 @@ def apply_mesh(run: RunConfig, policy):
                 "--seq_shards: MAT-Dec's per-agent MLPs are indexed by global "
                 "agent id; context-sharding applies to the transformer path"
             )
+    fsdp = max(1, int(getattr(run, "fsdp_shards", 1)))
+    tp = max(1, int(getattr(run, "tp_shards", 1)))
     if getattr(run, "async_actors", False):
-        if int(getattr(run, "data_shards", 1)) > 1 or seq > 1:
+        if int(getattr(run, "data_shards", 1)) > 1 or seq > 1 or fsdp > 1 or tp > 1:
             raise ValueError(
                 "--async_actors builds its own disjoint actor/learner "
                 "submeshes; size them with --actor_devices/--learner_devices, "
-                "not --data_shards/--seq_shards"
+                "not --data_shards/--seq_shards/--fsdp_shards/--tp_shards"
             )
         # no run mesh: _train_loop_async builds the submeshes itself (state
         # starts host-local, exactly like the unsharded single-process path)
         return None
+    if fsdp > 1 or tp > 1:
+        n_embd = int(getattr(getattr(policy, "cfg", None), "n_embd", 0))
+        if n_embd and n_embd % (fsdp * tp):
+            # the rules layer would catch this per-param at init; catching it
+            # here names the flags instead of a flattened param path
+            raise ValueError(
+                f"--fsdp_shards {fsdp} x --tp_shards {tp} must divide n_embd "
+                f"({n_embd}): every column-parallel kernel splits n_embd over "
+                f"both param axes"
+            )
     from mat_dcml_tpu.parallel.mesh import build_run_mesh
 
-    mesh = build_run_mesh(int(getattr(run, "data_shards", 1)), seq)
+    mesh = build_run_mesh(int(getattr(run, "data_shards", 1)), seq, fsdp, tp)
     if mesh is None:
         return None
     n_data = dict(mesh.shape)["data"]
@@ -103,6 +115,13 @@ def apply_mesh(run: RunConfig, policy):
         )
     if seq > 1:
         policy.seq_mesh = mesh
+    if fsdp > 1 or tp > 1:
+        # same sharding-invariance hazard as the composed (data x seq) case
+        # below: params under P(fsdp, tp) make every sampling site a
+        # multi-axis program with replicated inputs — enable partitionable
+        # threefry before the first trace so rollout bits match the
+        # replicated topology
+        jax.config.update("jax_threefry_partitionable", True)
     if seq > 1 and n_data > 1:
         # Composed (data x seq) mesh: jax 0.4.x default threefry is NOT
         # sharding-invariant on a multi-axis mesh with a replicated axis —
@@ -124,7 +143,7 @@ def apply_seq_shards(run: RunConfig, policy) -> None:
     apply_mesh(run, policy)
 
 
-def make_dispatch_fn(trainer, collector, iters: int):
+def make_dispatch_fn(trainer, collector, iters: int, state_shardings=None):
     """Build the fused multi-episode dispatch: ONE jittable function that
     ``lax.scan``-s ``iters`` collect+train iterations, so a single host
     dispatch advances ``iters`` episodes (the Podracer anakin pattern).
@@ -137,6 +156,15 @@ def make_dispatch_fn(trainer, collector, iters: int):
     chunk_stats come back stacked ``(iters, ...)``; jit this with
     ``donate_argnums=(0, 1)`` so the carried train/rollout state reuses its
     own buffers instead of being copied every call.
+
+    ``state_shardings`` (a TrainState-shaped tree of NamedShardings, built
+    from the rule-resolved specs) pins the carried train state's layout
+    inside the scan body.  Without it GSPMD is free to re-shard outputs it
+    considers cheap to move (observed: replicated biases coming back
+    fsdp-sharded), which breaks the dispatch's steady-state contract — the
+    next call's input shardings no longer match the compiled executable, so
+    the call either recompiles or (donating) dies.  Param-sharded runners
+    MUST pass this; replicated/data-only runs don't need it.
     """
 
     def dispatch(train_state, rollout_state, key):
@@ -144,11 +172,19 @@ def make_dispatch_fn(trainer, collector, iters: int):
             ts, rs, k = carry
             k, k_train = jax.random.split(k)
             ts, rs, metrics, stats = trainer.train_iteration(collector, ts, rs, k_train)
+            if state_shardings is not None:
+                ts = jax.lax.with_sharding_constraint(ts, state_shardings)
             return (ts, rs, k), (metrics, stats)
 
         (train_state, rollout_state, key), stacked = jax.lax.scan(
             body, (train_state, rollout_state, key), None, length=iters
         )
+        if state_shardings is not None:
+            # pin the ROOT output too: GSPMD propagation may still reshard
+            # the loop result on the way out (the body pin alone is not
+            # enough when neighboring ops prefer a different layout)
+            train_state = jax.lax.with_sharding_constraint(
+                train_state, state_shardings)
         return train_state, rollout_state, key, stacked
 
     return dispatch
@@ -208,6 +244,11 @@ class BaseRunner:
         # runners that shard set self.mesh (= apply_mesh(...)) before calling
         # finalize; everything downstream branches on "is there a mesh"
         self.mesh = getattr(self, "mesh", None)
+        # rule-resolved TrainState PartitionSpecs (parallel/sharding.py),
+        # filled in by setup(); None until then (and forever at fsdp=tp=1,
+        # where every placement site falls back to replicated)
+        self.state_specs = None
+        self.param_specs = None
         set_named_scopes(run.trace_named_scopes)
         self.telemetry = Telemetry()
         self.telemetry.rate("env_steps", "env_steps_per_sec")
@@ -221,8 +262,19 @@ class BaseRunner:
             )
         else:
             self._collect = self.collector.collect
+        # the train step pins its output train-state layout to the rule-
+        # resolved shardings (traced AFTER setup() fills state_specs): GSPMD
+        # otherwise re-shards cheap outputs (e.g. replicated biases ->
+        # fsdp-sharded), drifting the steady-state input signature
+        def _train_pinned(ts, *args, **kwargs):
+            ts, metrics = self.trainer.train(ts, *args, **kwargs)
+            sh = self._state_shardings()
+            if sh is not None:
+                ts = jax.lax.with_sharding_constraint(ts, sh)
+            return ts, metrics
+
         self._train = instrumented_jit(
-            self.trainer.train, "train", self.telemetry, log_fn,
+            _train_pinned, "train", self.telemetry, log_fn,
             count_collectives=self.mesh is not None,
         )
         # fused multi-episode dispatch (built lazily by _train_loop_fused when
@@ -302,6 +354,20 @@ class BaseRunner:
     def _bootstrap(self, rs):
         return bootstrap_input(self.is_mat, self.collector, rs)
 
+    def _state_shardings(self):
+        """TrainState-shaped NamedShardings from the rule-resolved specs, or
+        None when no param axis is in play (replicated/data-only runs keep
+        their seed-identical programs).  Used to pin train-step / fused-
+        dispatch output layouts — without the pin GSPMD may re-shard cheap
+        outputs and drift the steady-state input signature."""
+        if self.state_specs is None or self.mesh is None:
+            return None
+        from mat_dcml_tpu.parallel.sharding import has_param_axes, named_shardings
+
+        if not has_param_axes(self.mesh):
+            return None
+        return named_shardings(self.state_specs, self.mesh)
+
     def setup(self, seed: Optional[int] = None):
         seed = self.run_cfg.seed if seed is None else seed
         key = jax.random.key(seed)
@@ -309,18 +375,39 @@ class BaseRunner:
         init_p = (self.trainer.init_params if hasattr(self.trainer, "init_params")
                   else self.policy.init_params)  # stacked per-agent vs shared
         if self.mesh is not None:
-            # sharded run: build state as GLOBAL arrays.  Params/optimizer are
-            # replicated (every process initializes identically inside jit
-            # with out_shardings, so no host-side full-size transfer);
-            # the rollout state's env-batch axis shards over "data".  The grad
-            # psum and batch-statistic reductions then fall out of jit.
-            from jax.sharding import NamedSharding, PartitionSpec as P
-
+            # sharded run: build state as GLOBAL arrays, born with their
+            # rule-resolved PartitionSpecs (parallel/sharding.py) — params and
+            # optimizer moments never exist replicated when fsdp/tp shard
+            # them (every process initializes inside jit with out_shardings,
+            # so no host-side full-size transfer).  At fsdp=tp=1 the specs
+            # resolve to all-P() and this is exactly the old replicated init.
+            # The rollout state's env-batch axis shards over "data"; grad
+            # psums and batch-statistic reductions fall out of jit.
             from mat_dcml_tpu.parallel.distributed import global_init_state
+            from mat_dcml_tpu.parallel.sharding import (
+                load_rules, named_shardings, param_byte_stats, resolve_state_specs,
+            )
 
-            repl = NamedSharding(self.mesh, P())
-            params = jax.jit(init_p, out_shardings=repl)(k_model)
-            train_state = jax.jit(self.trainer.init_state, out_shardings=repl)(params)
+            rules_path = getattr(self.run_cfg, "sharding_rules", None)
+            rules = load_rules(rules_path) if rules_path else None
+            p_probe = jax.eval_shape(init_p, k_model)
+            self.param_specs = resolve_state_specs(p_probe, self.mesh, rules)
+            params = jax.jit(
+                init_p, out_shardings=named_shardings(self.param_specs, self.mesh)
+            )(k_model)
+            s_probe = jax.eval_shape(self.trainer.init_state, p_probe)
+            self.state_specs = resolve_state_specs(s_probe, self.mesh, rules)
+            train_state = jax.jit(
+                self.trainer.init_state,
+                out_shardings=named_shardings(self.state_specs, self.mesh),
+            )(params)
+            self.watchdog.state_specs = self.state_specs
+            for k, v in param_byte_stats(p_probe, self.param_specs, self.mesh).items():
+                self.telemetry.gauge(f"shard_param_{k}", float(v))
+            state_stats = param_byte_stats(s_probe, self.state_specs, self.mesh)
+            self.telemetry.gauge(
+                "shard_param_opt_max_device_bytes", float(state_stats["max_device_bytes"])
+            )
         else:
             params = init_p(k_model)
             train_state = self.trainer.init_state(params)
@@ -401,12 +488,14 @@ class BaseRunner:
         if params_only:
             restored = train_state._replace(params=restored.params)
         if self.mesh is not None:
-            # checkpoints restore as host-local arrays; re-place them as
-            # replicated global arrays so donation/sharding layouts match the
-            # jit-initialized cold-start state
-            from mat_dcml_tpu.parallel.distributed import put_replicated
+            # checkpoints restore as host-local arrays; re-place them under
+            # this run's resolved specs (replicated when fsdp=tp=1) so
+            # donation/sharding layouts match the jit-initialized cold-start
+            # state.  A checkpoint saved at fsdp=2 restores onto fsdp=4 (or
+            # back) here: the host arrays are full, place_params reshards.
+            from mat_dcml_tpu.parallel.sharding import place_params
 
-            restored = put_replicated(restored, self.mesh)
+            restored = place_params(restored, self.mesh, self.state_specs)
         return restored
 
     def _load_emergency(self, directory: Path):
@@ -421,7 +510,7 @@ class BaseRunner:
         """Place a packed emergency carry for this run's topology, with typed
         errors when it cannot fit."""
         try:
-            ts, rs, k = place_carry(snap, self.mesh)
+            ts, rs, k = place_carry(snap, self.mesh, state_specs=self.state_specs)
         except ElasticResumeError:
             raise
         if (jax.tree.structure(ts) != jax.tree.structure(template)):
@@ -799,7 +888,8 @@ class BaseRunner:
         self.flight.iters_per_dispatch = K
 
         self._dispatch = instrumented_jit(
-            make_dispatch_fn(self.trainer, self.collector, K),
+            make_dispatch_fn(self.trainer, self.collector, K,
+                             state_shardings=self._state_shardings()),
             "dispatch", tel, self.log, donate_argnums=(0, 1),
             count_collectives=self.mesh is not None,
         )
@@ -809,6 +899,12 @@ class BaseRunner:
 
         first = self.start_episode
         n_disp = -(-(episodes - first) // K)
+        if n_disp <= 0:
+            # resumed past the requested budget: nothing to run, and the
+            # trailing boundary/process below assume >= 1 dispatch happened
+            self.log(f"[dispatch] resume at episode {first} >= requested "
+                     f"{episodes} episodes; nothing to train")
+            return train_state, rollout_state
         if first + n_disp * K != episodes:
             self.log(f"[dispatch] {episodes - first} episodes round up to "
                      f"{n_disp} dispatches of {K}")
@@ -1425,6 +1521,8 @@ class BaseRunner:
             tel.gauge("shard_count", float(self.mesh.size))
             tel.gauge("shard_data", float(shape.get("data", 1)))
             tel.gauge("shard_seq", float(shape.get("seq", 1)))
+            tel.gauge("shard_fsdp", float(shape.get("fsdp", 1)))
+            tel.gauge("shard_tp", float(shape.get("tp", 1)))
             for name, j in jits.items():
                 if j.bytes_per_call is not None:
                     tel.gauge(f"shard_bytes_per_{name}", float(j.bytes_per_call))
@@ -1432,6 +1530,14 @@ class BaseRunner:
             if any(c is not None for c in n_coll):
                 tel.gauge("shard_psum_count",
                           float(sum(c for c in n_coll if c is not None)))
+            # per-kind collective census of the steady executables — the
+            # number the BENCH_FSDP expectation table checks against
+            kinds: dict = {}
+            for j in jits.values():
+                for kind, n in (j.collective_kinds_per_call or {}).items():
+                    kinds[kind] = kinds.get(kind, 0) + n
+            for kind, n in kinds.items():
+                tel.gauge(f"shard_param_collectives_{kind}", float(n))
             hbm = replica_hbm_high_water_bytes()
             if hbm is not None:
                 tel.gauge("shard_hbm_high_water_bytes", float(hbm))

@@ -24,10 +24,12 @@ module is the runner-side half of that property:
   :class:`DispatchFailedError`, which the runner converts into an emergency
   checkpoint plus a nonzero exit.
 - :func:`place_carry` is the elastic-resume seam: a packed carry re-places
-  onto *whatever* mesh the relaunch got — replicated leaves via
-  ``put_replicated``, env-batch leaves re-sharded over the new ``data`` axis
-  via ``put_sharded_state`` — with :class:`ElasticResumeError` when the env
-  batch no longer divides the shard count.
+  onto *whatever* mesh the relaunch got — train-state leaves under the run's
+  resolved PartitionSpecs via the spec-aware ``parallel.sharding
+  .place_params`` (replicated when no specs, i.e. fsdp=tp=1), env-batch
+  leaves re-sharded over the new ``data`` axis via ``put_sharded_state`` —
+  with :class:`ElasticResumeError` when the env batch no longer divides the
+  shard count or the specs cannot fit the new topology.
 
 Exit codes: ``EXIT_PREEMPTED`` (75, BSD EX_TEMPFAIL — "try again") tells
 ``scripts/train_supervisor.py`` the stop was a clean preemption (relaunch
@@ -93,27 +95,34 @@ def pack_carry(episode: int, train_state, rollout_state, key) -> Dict[str, Any]:
     }
 
 
-def place_carry(snap: Dict[str, Any], mesh=None):
+def place_carry(snap: Dict[str, Any], mesh=None, state_specs=None):
     """Rebuild ``(train_state, rollout_state, key)`` from a packed carry and
     place it on ``mesh`` (None = host-local single-process placement).
 
-    The mesh does NOT have to match the one the carry was packed on: params/
-    optimizer/key leaves are replicated, and rollout leaves re-shard over the
-    new mesh's ``data`` axis by the same shape contract ``global_init_state``
-    uses (leading env-batch axis on every ndim>=1 leaf).  Divisibility
-    failures surface as :class:`ElasticResumeError`.
+    The mesh does NOT have to match the one the carry was packed on — not in
+    ``data`` extent and not in ``fsdp``/``tp`` extent: the packed carry holds
+    full host arrays, train-state leaves re-place under ``state_specs``
+    through the one spec-aware seam (``parallel.sharding.place_params``;
+    None = replicated, the pre-fsdp behavior), and rollout leaves re-shard
+    over the new mesh's ``data`` axis by the same shape contract
+    ``global_init_state`` uses (leading env-batch axis on every ndim>=1
+    leaf).  A carry packed at fsdp=2 resumes at fsdp=4 (and back) this way.
+    Divisibility failures surface as :class:`ElasticResumeError`.
     """
     train_state = unpack_tree(snap["train_state"])
     rollout_state = unpack_tree(snap["rollout_state"])
     key = unpack_tree(snap["key"])
     if mesh is not None:
-        from mat_dcml_tpu.parallel.distributed import (
-            put_replicated,
-            put_sharded_state,
-        )
+        from mat_dcml_tpu.parallel.distributed import put_sharded_state
+        from mat_dcml_tpu.parallel.sharding import ShardMismatchError, place_params
 
-        train_state = put_replicated(train_state, mesh)
-        key = put_replicated(key, mesh)
+        try:
+            train_state = place_params(train_state, mesh, state_specs)
+        except (ValueError, ShardMismatchError) as e:
+            raise ElasticResumeError(
+                f"cannot re-place the checkpointed train state on this mesh: {e}"
+            ) from e
+        key = place_params(key, mesh)
         try:
             rollout_state = put_sharded_state(rollout_state, mesh)
         except ValueError as e:
@@ -336,6 +345,10 @@ class DispatchWatchdog:
         self._snap: Optional[Dict[str, Any]] = None
         self._snap_is_current = False
         self._calls = 0
+        # rule-resolved TrainState PartitionSpecs; the runner's setup()
+        # assigns them once resolved so retry re-placement keeps fsdp/tp
+        # shardings (None = replicated)
+        self.state_specs = None
 
     @property
     def last_snapshot(self) -> Optional[Dict[str, Any]]:
@@ -413,4 +426,6 @@ class DispatchWatchdog:
                      f"({err!r}); retrying from the episode "
                      f"{self._snap['episode']} snapshot in {delay * 1e3:.0f}ms")
             self._sleep(delay)
-            train_state, rollout_state, key = place_carry(self._snap, self.mesh)
+            train_state, rollout_state, key = place_carry(
+                self._snap, self.mesh, state_specs=self.state_specs
+            )

@@ -105,11 +105,13 @@ KNOWN_PREFIXES = (
     # counters, canary comparison/mismatch totals
     "rollout_",
     # sharded-run gauges (base_runner._mark_steady under a --data_shards/
-    # --seq_shards mesh): mesh shape (shard_count/shard_data/shard_seq),
-    # per-shard cost_analysis bytes (shard_bytes_per_<fn> — per-DEVICE, the
-    # SPMD executable's numbers), per-replica HBM high-water
-    # (shard_hbm_high_water_bytes, absent on CPU), and the compiled psum
-    # count (shard_psum_count)
+    # --seq_shards/--fsdp_shards/--tp_shards mesh): mesh shape (shard_count/
+    # shard_data/shard_seq/shard_fsdp/shard_tp), per-shard cost_analysis
+    # bytes (shard_bytes_per_<fn> — per-DEVICE, the SPMD executable's
+    # numbers), per-replica HBM high-water (shard_hbm_high_water_bytes,
+    # absent on CPU), the compiled psum count (shard_psum_count), and the
+    # shard_param_ parameter-sharding sub-family (bytes per axis, max
+    # per-device param/opt footprint, per-kind collective census)
     "shard_",
     # preemption-safety gauges (training/resilience.py + base_runner):
     # snapshot/retry/failure/emergency-save/quarantine counters,
@@ -172,8 +174,15 @@ STRICT_FAMILY_PATTERNS = {
         r"|canary_mismatches"
         r"|(canary|incumbent)_ms(_p50|_p95|_p99|_count|_mean))$"),
     "shard_": re.compile(
-        r"^shard_(count|data|seq|psum_count|hbm_high_water_bytes"
-        r"|bytes_per_[a-z_]+)$"),
+        r"^shard_(count|data|seq|fsdp|tp|psum_count|hbm_high_water_bytes"
+        r"|bytes_per_[a-z_]+"
+        # shard_param_: the fsdp/tp parameter-sharding family
+        # (parallel/sharding.py): global param bytes split by sharding axis,
+        # max per-device param(+opt) footprint, per-kind collective census
+        r"|param_bytes_(total|fsdp|tp|replicated)"
+        r"|param_(max_device_bytes|opt_max_device_bytes)"
+        r"|param_collectives_(all_reduce|all_gather|reduce_scatter"
+        r"|collective_permute|all_to_all))$"),
     "resilience_": re.compile(
         r"^resilience_(snapshots|emergency_saves|quarantined_steps"
         r"|deadline_overruns|dispatch_failures|dispatch_retries"

@@ -179,7 +179,7 @@ def test_build_run_mesh_validation(forced8_cpu):
     assert build_run_mesh(1, 1, devices=forced8_cpu) is None
     # auto: everything not consumed by seq becomes data
     mesh = build_run_mesh(0, 2, devices=forced8_cpu)
-    assert dict(mesh.shape) == {"data": 4, "seq": 2}
+    assert dict(mesh.shape) == {"data": 4, "seq": 2, "fsdp": 1, "tp": 1}
 
 
 def test_apply_mesh_divisibility(forced8_cpu):
@@ -224,7 +224,7 @@ def test_composed_mesh_sampling_invariant(forced8_cpu):
         policy = TransformerPolicy(cfg)
         run = RunConfig(n_rollout_threads=8, data_shards=4, seq_shards=2)
         mesh = apply_mesh(run, policy)
-        assert dict(mesh.shape) == {"data": 4, "seq": 2}
+        assert dict(mesh.shape) == {"data": 4, "seq": 2, "fsdp": 1, "tp": 1}
         assert jax.config.jax_threefry_partitionable  # composed => flipped
 
         params = policy.init_params(jax.random.key(0))

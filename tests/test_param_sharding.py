@@ -1,0 +1,667 @@
+"""Rule-based fsdp x tp parameter sharding (parallel/sharding.py).
+
+Covers the contract tiers:
+
+- the rules layer itself: first-match-wins precedence, the unmatched-param
+  typed error (never silent replication), every DCML-preset MAT trunk param
+  matched by a NON-default rule, and spec stability across ``mat_variants``
+  toggles;
+- mesh construction: the 4-axis ``(data, seq, fsdp, tp)`` run mesh with the
+  existing oversize / indivisibility / 0=auto semantics, plus the typed
+  ``n_embd % (fsdp*tp)`` errors at both the flag seam (``apply_mesh``) and
+  the per-param seam (``validate_specs``);
+- placement: params born sharded via jit-with-out_shardings with the real
+  ~1/N per-device byte split, the ``place_params`` / ``gather_replicated``
+  round trip, and elastic re-placement across param-axis changes
+  (fsdp=2 -> 4 and back, bit-exact — placement is pure data movement);
+- the program: a 4-axis mesh with TRIVIAL fsdp/tp axes must stay bit-exact
+  with the (data, seq)-era behavior (same psum-tolerance contract as
+  tests/test_sharded_dispatch.py), and a dispatch with genuinely sharded
+  params must keep donation + zero steady recompiles while its executable
+  grows the all-gather/reduce-scatter collectives the ``shard_param_``
+  census reports.
+
+Cross-topology runs compare under the psum tolerances test_multihost.py
+established; key chains and placement round trips are bit-exact.
+"""
+
+import dataclasses
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from mat_dcml_tpu.envs.spaces import Discrete
+from mat_dcml_tpu.envs.toy import MatchingEnv, MatchingEnvConfig
+from mat_dcml_tpu.models.actor_critic import ACConfig, ActorCriticPolicy
+from mat_dcml_tpu.models.mat import DISCRETE, MATConfig
+from mat_dcml_tpu.models.policy import TransformerPolicy
+from mat_dcml_tpu.parallel.mesh import build_run_mesh, make_run_mesh
+from mat_dcml_tpu.parallel.sharding import (
+    ShardMismatchError,
+    SpecLayout,
+    UnmatchedParamError,
+    default_mat_rules,
+    gather_replicated,
+    load_rules,
+    match_partition_rules,
+    named_shardings,
+    param_byte_stats,
+    place_params,
+    resolve_state_specs,
+    validate_specs,
+)
+from mat_dcml_tpu.telemetry import Telemetry, instrumented_jit
+from mat_dcml_tpu.training.ac_rollout import ACRolloutCollector
+from mat_dcml_tpu.training.base_runner import make_dispatch_fn
+from mat_dcml_tpu.training.mappo import MAPPOConfig, MAPPOTrainer
+from mat_dcml_tpu.training.ppo import MATTrainer, PPOConfig
+from mat_dcml_tpu.training.rollout import RolloutCollector
+
+K = 4
+E = 8
+
+
+@pytest.fixture
+def partitionable_threefry():
+    """Cross-topology RNG invariance needs partitionable threefry (the PR 8
+    finding); both sides of every A/B here run under it."""
+    prev = jax.config.jax_threefry_partitionable
+    jax.config.update("jax_threefry_partitionable", True)
+    yield
+    jax.config.update("jax_threefry_partitionable", prev)
+
+
+def _flat(tree):
+    return {
+        "/".join(str(getattr(k, "key", getattr(k, "name", getattr(k, "idx", k))))
+                 for k in path): leaf
+        for path, leaf in jax.tree_util.tree_leaves_with_path(tree)
+    }
+
+
+def _mat_probe(**cfg_kw):
+    cfg = MATConfig(**{**dict(n_agent=3, obs_dim=7, state_dim=9, action_dim=4,
+                              n_block=2, n_embd=16, n_head=2,
+                              action_type=DISCRETE), **cfg_kw})
+    pol = TransformerPolicy(cfg)
+    return pol, jax.eval_shape(pol.init_params, jax.random.key(0))
+
+
+# ------------------------------------------------------------------ the rules
+
+def test_first_match_wins():
+    _, probe = _mat_probe()
+    grabby = ((r"kernel$", P("tp", None)),) + default_mat_rules()
+    specs = _flat(match_partition_rules(grabby, probe))
+    # every kernel fell to the FIRST rule even though later rules also match
+    assert specs["params/encoder/blocks_0/attn/key_p/kernel"] == P("tp", None)
+    assert specs["params/encoder/blocks_0/mlp/Dense_0/kernel"] == P("tp", None)
+    # order flipped: the layout rules win instead
+    specs2 = _flat(match_partition_rules(default_mat_rules() + grabby[:1], probe))
+    assert specs2["params/encoder/blocks_0/attn/key_p/kernel"] == P("fsdp", "tp")
+
+
+def test_unmatched_param_is_typed_error():
+    _, probe = _mat_probe()
+    rules = ((r"(bias|scale)$", P()), (r"log_std$", P()))  # kernels uncovered
+    with pytest.raises(UnmatchedParamError, match=r"kernel.*never silently replicate"):
+        match_partition_rules(rules, probe)
+    # and it is a ValueError, so generic config-error handling catches it
+    assert issubclass(UnmatchedParamError, ValueError)
+
+
+def test_scalars_and_non_param_leaves_replicate():
+    pol, probe = _mat_probe()
+    trainer = MATTrainer(pol, PPOConfig())
+    state = jax.eval_shape(trainer.init_state, probe)
+    specs = _flat(match_partition_rules(default_mat_rules(), state))
+    assert specs["update_step"] == P()
+    assert specs["value_norm/running_mean"] == P()
+    assert specs["opt_state/1/0/count"] == P()
+
+
+def test_optimizer_moments_inherit_param_specs():
+    pol, probe = _mat_probe()
+    trainer = MATTrainer(pol, PPOConfig())
+    state = jax.eval_shape(trainer.init_state, probe)
+    specs = _flat(match_partition_rules(default_mat_rules(), state))
+    tail = "params/decoder/blocks_0/attn1/proj/kernel"
+    assert specs[f"params/{tail}"] == P("tp", "fsdp")
+    assert specs[f"opt_state/1/0/mu/{tail}"] == specs[f"params/{tail}"]
+    assert specs[f"opt_state/1/0/nu/{tail}"] == specs[f"params/{tail}"]
+
+
+def test_dcml_preset_trunk_fully_matched_by_non_default_rules():
+    """Every DCML-preset trunk param resolves, and every kernel resolves to a
+    real (non-P()) spec — nothing rides the replicated default."""
+    # the DCML preset: RunConfig defaults n_block=2 n_embd=64 n_head=2 over
+    # the DCML obs/state/action widths (envs/dcml), SEMI_DISCRETE tail
+    from mat_dcml_tpu.models.mat import SEMI_DISCRETE
+
+    pol, probe = _mat_probe(n_agent=101, obs_dim=7, state_dim=103,
+                            action_dim=11, n_block=2, n_embd=64,
+                            action_type=SEMI_DISCRETE, semi_index=10)
+    specs = _flat(match_partition_rules(default_mat_rules(), probe))
+    for name, spec in specs.items():
+        if name.endswith("kernel"):
+            assert spec != P(), f"{name} silently replicated"
+    # the full TrainState resolves too (moments, counters, norms)
+    trainer = MATTrainer(pol, PPOConfig())
+    state = jax.eval_shape(trainer.init_state, probe)
+    match_partition_rules(default_mat_rules(), state)
+
+
+def test_specs_stable_under_mat_variants():
+    """Every mat_variants toggle resolves without error, and shared layer
+    names keep the same specs across toggles."""
+    import mat_dcml_tpu.models.mat_variants as V
+
+    base_specs = _flat(match_partition_rules(default_mat_rules(), _mat_probe()[1]))
+    for kw in (dict(encode_state=True), dict(dec_actor=True),
+               dict(dec_actor=True, share_actor=True)):
+        specs = _flat(match_partition_rules(default_mat_rules(), _mat_probe(**kw)[1]))
+        for name, spec in specs.items():
+            if name in base_specs:
+                assert spec == base_specs[name], (name, kw)
+    cfg = MATConfig(n_agent=3, obs_dim=7, state_dim=9, action_dim=4,
+                    n_block=1, n_embd=16, n_head=2, action_type=DISCRETE)
+    for cls in (V.EncoderPolicy, V.DecoderPolicy, V.GRUPolicy):
+        probe = jax.eval_shape(cls(cfg).init_params, jax.random.key(0))
+        specs = _flat(match_partition_rules(default_mat_rules(), probe))
+        for name, spec in specs.items():
+            if name.endswith("kernel"):
+                assert spec != P(), f"{cls.__name__}: {name} replicated"
+
+
+def test_spec_layout_and_rules_file(tmp_path):
+    layout = SpecLayout()
+    assert layout.qkv_projection() == P("fsdp", "tp")
+    assert layout.attn_output() == P("tp", "fsdp")
+    assert layout.embedding() == P(None, ("fsdp", "tp"))
+    path = tmp_path / "rules.json"
+    path.write_text(json.dumps([
+        [r"kernel$", [None, ["fsdp", "tp"]]],
+        [r"(bias|scale|log_std)$", []],
+    ]))
+    rules = load_rules(str(path))
+    assert rules[0][1] == P(None, ("fsdp", "tp"))
+    assert rules[1][1] == P()
+    _, probe = _mat_probe()
+    specs = _flat(match_partition_rules(rules, probe))
+    assert specs["params/encoder/blocks_0/attn/proj/kernel"] == P(None, ("fsdp", "tp"))
+    for bad in ('{"not": "a list"}', '[["unbalanced(", []]]', '[["ok$", "fsdp"]]'):
+        path.write_text(bad)
+        with pytest.raises(ValueError):
+            load_rules(str(path))
+
+
+# ------------------------------------------------------------------- the mesh
+
+def test_build_run_mesh_four_axes(forced8_cpu):
+    mesh = build_run_mesh(1, 1, 4, 2, devices=forced8_cpu)
+    assert dict(mesh.shape) == {"data": 1, "seq": 1, "fsdp": 4, "tp": 2}
+    # 0=auto for data composes with the param axes
+    mesh = build_run_mesh(0, 1, 2, 2, devices=forced8_cpu)
+    assert dict(mesh.shape) == {"data": 2, "seq": 1, "fsdp": 2, "tp": 2}
+    # trivial param axes keep the old behaviour (incl. the None fast path)
+    assert build_run_mesh(1, 1, 1, 1, devices=forced8_cpu) is None
+    mesh = build_run_mesh(4, 2, 1, 1, devices=forced8_cpu)
+    assert dict(mesh.shape) == {"data": 4, "seq": 2, "fsdp": 1, "tp": 1}
+
+
+def test_build_run_mesh_param_axis_errors(forced8_cpu):
+    with pytest.raises(ValueError, match="fsdp_shards"):
+        build_run_mesh(1, 1, 0, 1, devices=forced8_cpu)
+    with pytest.raises(ValueError, match="tp_shards"):
+        build_run_mesh(1, 1, 1, -1, devices=forced8_cpu)
+    # oversized: fsdp > device count
+    with pytest.raises(ValueError, match="devices"):
+        build_run_mesh(1, 1, 16, 1, devices=forced8_cpu)
+    with pytest.raises(ValueError, match="devices"):
+        build_run_mesh(2, 2, 2, 2, devices=forced8_cpu)
+    # block must divide the device count
+    with pytest.raises(ValueError, match="divide"):
+        make_run_mesh(1, 3, 1, devices=forced8_cpu)
+
+
+def test_run_mesh_block_spanning_processes_raises():
+    class _FakeDev:  # hashable, unlike SimpleNamespace (Mesh interns devices)
+        def __init__(self, process_index):
+            self.process_index = process_index
+
+    fakes = [_FakeDev(i // 2) for i in range(8)]
+    with pytest.raises(ValueError, match="spans processes"):
+        make_run_mesh(1, 4, 1, devices=fakes)
+
+
+def test_apply_mesh_rejects_indivisible_n_embd():
+    from mat_dcml_tpu.config import RunConfig
+    from mat_dcml_tpu.training.base_runner import apply_mesh
+
+    pol, _ = _mat_probe(n_embd=16)
+    run = RunConfig(n_rollout_threads=8, fsdp_shards=3)
+    with pytest.raises(ValueError, match="n_embd"):
+        apply_mesh(run, pol)
+    run = RunConfig(n_rollout_threads=8, tp_shards=5)
+    with pytest.raises(ValueError, match="n_embd"):
+        apply_mesh(run, pol)
+
+
+def test_apply_mesh_async_actors_excludes_param_axes():
+    from mat_dcml_tpu.config import RunConfig
+    from mat_dcml_tpu.training.base_runner import apply_mesh
+
+    pol, _ = _mat_probe()
+    run = RunConfig(n_rollout_threads=8, async_actors=True, fsdp_shards=2)
+    with pytest.raises(ValueError, match="async_actors"):
+        apply_mesh(run, pol)
+
+
+def test_validate_specs_indivisible_param(forced8_cpu):
+    """The per-param seam: a trunk whose n_embd doesn't divide the shard
+    product fails with a typed error naming the param."""
+    _, probe = _mat_probe(n_embd=12, n_head=2)
+    mesh = build_run_mesh(1, 1, 8, 1, devices=forced8_cpu)
+    specs = match_partition_rules(default_mat_rules(), probe)
+    with pytest.raises(ShardMismatchError, match="not divisible"):
+        validate_specs(specs, probe, mesh)
+    with pytest.raises(ShardMismatchError, match="not divisible"):
+        resolve_state_specs(probe, mesh)
+
+
+def test_resolve_specs_fast_path_without_param_axes(forced8_cpu):
+    """No fsdp/tp extent -> all-P() WITHOUT consulting rules, so non-MAT
+    param trees (which no rule matches) still work under data-only meshes."""
+    mesh = build_run_mesh(4, 1, 1, 1, devices=forced8_cpu)
+    weird = {"params": {"totally_unmatched_tensor": jax.ShapeDtypeStruct((4, 4), jnp.float32)}}
+    specs = resolve_state_specs(weird, mesh)
+    assert jax.tree.leaves(specs, is_leaf=lambda x: isinstance(x, P)) == [P()]
+    assert resolve_state_specs(weird, None) is not None  # mesh-less: same
+
+
+# -------------------------------------------------------------- the placement
+
+def test_born_sharded_init_byte_split(forced8_cpu):
+    """jit-with-out_shardings init: params materialize sharded (~1/4 of the
+    global bytes per device at fsdp=4), and the gauge math agrees with the
+    actual buffers."""
+    mesh = build_run_mesh(1, 1, 4, 1, devices=forced8_cpu[:4])
+    pol, probe = _mat_probe(n_embd=64, n_block=2)
+    specs = resolve_state_specs(probe, mesh)
+    params = jax.jit(pol.init_params,
+                     out_shardings=named_shardings(specs, mesh))(jax.random.key(0))
+    stats = param_byte_stats(probe, specs, mesh)
+    assert stats["bytes_fsdp"] > 0 and stats["bytes_replicated"] > 0
+    assert stats["bytes_total"] > stats["max_device_bytes"]
+    # ~1/4 split: per-device <= 1/4 of total + the replicated remainder
+    assert stats["max_device_bytes"] <= (
+        stats["bytes_total"] // 4 + stats["bytes_replicated"])
+    k = params["params"]["encoder"]["blocks_0"]["attn"]["key_p"]["kernel"]
+    assert k.sharding.spec == P("fsdp", "tp")
+    # the physical shard really is a quarter of the kernel
+    assert k.addressable_shards[0].data.nbytes * 4 == k.nbytes
+    # eval_shape math == concrete math
+    assert param_byte_stats(params, specs, mesh) == stats
+
+
+def test_place_gather_roundtrip_and_elastic_replace(forced8_cpu):
+    """fsdp=2 -> gather -> fsdp=4 -> back: placement is pure data movement,
+    so every hop is bit-exact."""
+    pol, probe = _mat_probe(n_embd=64)
+    host = jax.tree.map(np.asarray, pol.init_params(jax.random.key(0)))
+    mesh2 = build_run_mesh(1, 1, 2, 1, devices=forced8_cpu[:2])
+    mesh4 = build_run_mesh(1, 1, 4, 1, devices=forced8_cpu[:4])
+    specs = resolve_state_specs(probe, mesh2)
+    placed2 = place_params(host, mesh2, specs)
+    k2 = placed2["params"]["encoder"]["blocks_0"]["attn"]["key_p"]["kernel"]
+    assert len(k2.sharding.device_set) == 2
+    # elastic re-place 2 -> 4: full values move under the new mesh's specs
+    placed4 = place_params(jax.tree.map(np.asarray, gather_replicated(placed2)),
+                           mesh4, resolve_state_specs(probe, mesh4))
+    k4 = placed4["params"]["encoder"]["blocks_0"]["attn"]["key_p"]["kernel"]
+    assert len(k4.sharding.device_set) == 4
+    # ... and back to 2, bit-exact vs the original host tree
+    back = place_params(jax.tree.map(np.asarray, gather_replicated(placed4)),
+                        mesh2, specs)
+    for a, b in zip(jax.tree.leaves(host), jax.tree.leaves(jax.device_get(back))):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # specs=None is the replicated fast path (pre-fsdp behaviour)
+    repl = place_params(host, mesh2)
+    assert all(x.is_fully_replicated for x in jax.tree.leaves(repl))
+    # mesh=None passes through untouched
+    assert place_params(host, None) is host
+
+
+def test_place_carry_applies_state_specs(forced8_cpu):
+    from mat_dcml_tpu.training.resilience import (
+        ElasticResumeError, pack_carry, place_carry,
+    )
+
+    env = MatchingEnv(MatchingEnvConfig(n_agents=3, n_actions=4, horizon=5))
+    cfg = MATConfig(n_agent=env.n_agents, obs_dim=env.obs_dim,
+                    state_dim=env.share_obs_dim, action_dim=env.action_dim,
+                    n_block=1, n_embd=16, n_head=2, action_type=DISCRETE)
+    pol = TransformerPolicy(cfg)
+    trainer = MATTrainer(pol, PPOConfig())
+    collector = RolloutCollector(env, pol, 5)
+    ts = trainer.init_state(pol.init_params(jax.random.key(0)))
+    rs = collector.init_state(jax.random.key(1), E)
+    snap = pack_carry(3, ts, rs, jax.random.key(2))
+
+    mesh = build_run_mesh(1, 1, 2, 1, devices=forced8_cpu[:2])
+    specs = resolve_state_specs(jax.eval_shape(lambda: ts), mesh)
+    ts2, rs2, key2 = place_carry(snap, mesh, state_specs=specs)
+    k = ts2.params["params"]["encoder"]["blocks_0"]["attn"]["key_p"]["kernel"]
+    assert k.sharding.spec == P("fsdp", "tp")
+    # a structurally wrong spec tree surfaces as the elastic typed error
+    with pytest.raises(ElasticResumeError):
+        place_carry(snap, mesh, state_specs={"nope": P()})
+
+
+def test_gather_replicated_passes_host_leaves():
+    tree = {"a": np.ones((2, 2)), "b": 3}
+    out = gather_replicated(tree)
+    assert out["a"] is tree["a"] and out["b"] == 3
+
+
+# ------------------------------------------------------------------ the program
+
+def _mappo_components():
+    env = MatchingEnv(MatchingEnvConfig(n_agents=2, n_actions=3, horizon=5))
+    pol = ActorCriticPolicy(
+        ACConfig(hidden_size=16), obs_dim=env.obs_dim,
+        cent_obs_dim=env.share_obs_dim, space=Discrete(env.action_dim),
+    )
+    trainer = MAPPOTrainer(pol, MAPPOConfig(lr=3e-3, critic_lr=3e-3,
+                                            ppo_epoch=2, num_mini_batch=2))
+    return pol, trainer, ACRolloutCollector(env, pol, 5)
+
+
+# the AC policy's params carry no MAT names; sharding them exercises the
+# custom-rules path (README "Scaling" rules-file semantics, inline)
+_AC_RULES = (
+    (r"(bias|scale|log_std)$", P()),
+    (r"(action_head|v_out)/kernel$", P()),  # tiny output dims: replicate
+    (r"kernel$", P(None, "fsdp")),   # (in, hidden): shard the hidden columns
+)
+
+
+def _sequential_reference(policy, trainer, collector, seed=42):
+    params = policy.init_params(jax.random.key(0))
+    ts = trainer.init_state(params)
+    rs = collector.init_state(jax.random.key(1), E)
+    key = jax.random.key(seed)
+    step = jax.jit(lambda ts, rs, k: trainer.train_iteration(collector, ts, rs, k))
+    for _ in range(K):
+        key, k_train = jax.random.split(key)
+        ts, rs, metrics, _ = step(ts, rs, k_train)
+    return ts, key, metrics
+
+
+def _sharded_init(policy, trainer, collector, mesh, rules=None):
+    """BaseRunner.setup's sharded path: eval_shape -> specs -> born sharded."""
+    from mat_dcml_tpu.parallel.distributed import global_init_state
+
+    p_probe = jax.eval_shape(policy.init_params, jax.random.key(0))
+    p_specs = resolve_state_specs(p_probe, mesh, rules)
+    params = jax.jit(policy.init_params,
+                     out_shardings=named_shardings(p_specs, mesh))(jax.random.key(0))
+    s_probe = jax.eval_shape(trainer.init_state, p_probe)
+    s_specs = resolve_state_specs(s_probe, mesh, rules)
+    ts = jax.jit(trainer.init_state,
+                 out_shardings=named_shardings(s_specs, mesh))(params)
+    rs = global_init_state(collector, jax.random.key(1), E, mesh)
+    return ts, rs, s_specs
+
+
+def _assert_close(a, b, what, rtol=1e-4, atol=1e-6):
+    la, lb = jax.tree.leaves(jax.device_get(a)), jax.tree.leaves(jax.device_get(b))
+    assert len(la) == len(lb), what
+    for x, y in zip(la, lb):
+        np.testing.assert_allclose(np.asarray(x, np.float64),
+                                   np.asarray(y, np.float64),
+                                   rtol=rtol, atol=atol, err_msg=what)
+
+
+def test_trivial_param_axes_bitexact(forced8_cpu):
+    """The 4-axis mesh with fsdp=tp=1 must reproduce the (data, seq)-era
+    sharded dispatch: same psum-tolerance params/losses, bit-exact key chain,
+    donation intact, one compile, zero steady recompiles."""
+    policy, trainer, collector = _mappo_components()
+    ts_ref, key_ref, _ = _sequential_reference(policy, trainer, collector)
+
+    mesh = build_run_mesh(4, 1, 1, 1, devices=forced8_cpu[:4])
+    assert dict(mesh.shape) == {"data": 4, "seq": 1, "fsdp": 1, "tp": 1}
+    tel = Telemetry()
+    dispatch = instrumented_jit(
+        make_dispatch_fn(trainer, collector, K), "dispatch", tel,
+        donate_argnums=(0, 1), count_collectives=True,
+    )
+    with mesh:
+        ts0, rs0, s_specs = _sharded_init(policy, trainer, collector, mesh)
+        # fast path: no param axes -> every state spec resolves to P()
+        assert all(s == P() for s in
+                   jax.tree.leaves(s_specs, is_leaf=lambda x: isinstance(x, P)))
+        donated = jax.tree.leaves(ts0.params)[0]
+        ts_f, rs_f, key_f, _ = dispatch(ts0, rs0, jax.random.key(42))
+        jax.block_until_ready(ts_f)
+        key_f_data = np.asarray(jax.random.key_data(key_f))
+        # deep-copy: on CPU device_get returns views of the device
+        # buffers, which the donating feed-back call below reuses
+        params_f = jax.tree.map(lambda x: np.array(x, copy=True),
+                                jax.device_get(ts_f.params))
+        # steady state = feeding the outputs back, like the runner does
+        dispatch.mark_steady()
+        jax.block_until_ready(dispatch(ts_f, rs_f, key_f)[0])
+    assert donated.is_deleted()
+    assert dispatch.compile_count == 1
+    assert tel.counters.get("steady_state_recompiles", 0) == 0
+    np.testing.assert_array_equal(np.asarray(jax.random.key_data(key_ref)),
+                                  key_f_data, err_msg="key chain")
+    _assert_close(ts_ref.params, params_f, "params (psum tolerance)")
+
+
+def test_fsdp_dispatch_equals_sequential(forced8_cpu, partitionable_threefry):
+    """Genuinely sharded params (custom rules, fsdp=2): the fused donated
+    dispatch still reproduces the unsharded sequential run, stays on one
+    compile, and its executable gained param-movement collectives."""
+    policy, trainer, collector = _mappo_components()
+    ts_ref, key_ref, _ = _sequential_reference(policy, trainer, collector)
+
+    mesh = build_run_mesh(2, 1, 2, 1, devices=forced8_cpu[:4])
+    with mesh:
+        ts0, rs0, s_specs = _sharded_init(policy, trainer, collector, mesh,
+                                          rules=_AC_RULES)
+    tel = Telemetry()
+    dispatch = instrumented_jit(
+        make_dispatch_fn(trainer, collector, K,
+                         state_shardings=named_shardings(s_specs, mesh)),
+        "dispatch", tel, donate_argnums=(0, 1), count_collectives=True,
+    )
+    with mesh:
+        sharded = [x for x in jax.tree.leaves(ts0.params)
+                   if getattr(x, "ndim", 0) == 2]
+        assert any(not x.is_fully_replicated for x in sharded), \
+            "no param actually sharded"
+        donated = jax.tree.leaves(ts0.params)[0]
+        ts_f, rs_f, key_f, _ = dispatch(ts0, rs0, jax.random.key(42))
+        jax.block_until_ready(ts_f)
+        key_f_data = np.asarray(jax.random.key_data(key_f))
+        params_f = jax.tree.map(lambda x: np.array(x, copy=True),
+                                jax.device_get(ts_f.params))
+        still_sharded = any(not x.is_fully_replicated
+                            for x in jax.tree.leaves(ts_f.params)
+                            if getattr(x, "ndim", 0) == 2)
+        # the REAL steady-state contract: feed the outputs back (what the
+        # runner does every dispatch) — the pinned output shardings must
+        # match the compiled input signature, donation intact, no recompile
+        dispatch.mark_steady()
+        jax.block_until_ready(dispatch(ts_f, rs_f, key_f)[0])
+    assert donated.is_deleted(), "donation lost under param sharding"
+    assert dispatch.compile_count == 1
+    assert tel.counters.get("steady_state_recompiles", 0) == 0
+    kinds = dispatch.collective_kinds_per_call or {}
+    assert sum(kinds.values()) > 0, "sharded executable shows no collectives"
+    np.testing.assert_array_equal(np.asarray(jax.random.key_data(key_ref)),
+                                  key_f_data, err_msg="key chain")
+    _assert_close(ts_ref.params, params_f, "params (psum tolerance)")
+    # the updated params were still sharded (specs survive the update)
+    assert still_sharded
+
+
+def _mat_components():
+    env = MatchingEnv(MatchingEnvConfig(n_agents=3, n_actions=4, horizon=5))
+    cfg = MATConfig(n_agent=env.n_agents, obs_dim=env.obs_dim,
+                    state_dim=env.share_obs_dim, action_dim=env.action_dim,
+                    n_block=1, n_embd=16, n_head=2, action_type=DISCRETE)
+    policy = TransformerPolicy(cfg)
+    trainer = MATTrainer(policy, PPOConfig(ppo_epoch=2, num_mini_batch=2))
+    return policy, trainer, RolloutCollector(env, policy, 5)
+
+
+@pytest.mark.slow  # MAT compiles dominate; the MAPPO twin guards the fast tier
+def test_mat_fsdp_dispatch_equals_sequential(forced8_cpu, partitionable_threefry):
+    """The default MAT rules through the real fused dispatch at fsdp=2 x
+    tp=2."""
+    policy, trainer, collector = _mat_components()
+    ts_ref, key_ref, _ = _sequential_reference(policy, trainer, collector)
+    mesh = build_run_mesh(1, 1, 2, 2, devices=forced8_cpu[:4])
+    with mesh:
+        ts0, rs0, s_specs = _sharded_init(policy, trainer, collector, mesh)
+    tel = Telemetry()
+    dispatch = instrumented_jit(
+        make_dispatch_fn(trainer, collector, K,
+                         state_shardings=named_shardings(s_specs, mesh)),
+        "dispatch", tel, donate_argnums=(0, 1), count_collectives=True,
+    )
+    with mesh:
+        k = ts0.params["params"]["encoder"]["blocks_0"]["attn"]["key_p"]["kernel"]
+        assert k.sharding.spec == P("fsdp", "tp")
+        ts_f, _, key_f, _ = dispatch(ts0, rs0, jax.random.key(42))
+        jax.block_until_ready(ts_f)
+    np.testing.assert_array_equal(np.asarray(jax.random.key_data(key_ref)),
+                                  np.asarray(jax.random.key_data(key_f)),
+                                  err_msg="key chain")
+    _assert_close(ts_ref.params, ts_f.params, "params (psum tolerance)")
+
+
+@pytest.mark.slow
+def test_elastic_resume_fsdp2_to_fsdp4(forced8_cpu, partitionable_threefry):
+    """Train at fsdp=2, pack the carry, re-place onto fsdp=4, continue — vs
+    the uninterrupted fsdp=2 run.  Key chain bit-exact; params under the
+    cross-topology psum tolerance; the 4 -> 2 placement round trip of the
+    packed carry itself is bit-exact."""
+    from mat_dcml_tpu.training.resilience import pack_carry, place_carry
+
+    policy, trainer, collector = _mappo_components()
+    mesh2 = build_run_mesh(1, 1, 2, 1, devices=forced8_cpu[:2])
+    mesh4 = build_run_mesh(1, 1, 4, 1, devices=forced8_cpu[:4])
+
+    def run_k(mesh, ts, rs, key, k):
+        with mesh:
+            dispatch = jax.jit(make_dispatch_fn(trainer, collector, k),
+                               donate_argnums=(0, 1))
+            ts, rs, key, _ = dispatch(ts, rs, key)
+            jax.block_until_ready(ts)
+        return ts, rs, key
+
+    with mesh2:
+        ts0, rs0, specs2 = _sharded_init(policy, trainer, collector, mesh2,
+                                         rules=_AC_RULES)
+    ts_a, rs_a, key_a = run_k(mesh2, ts0, rs0, jax.random.key(7), 2)
+    snap = pack_carry(2, ts_a, rs_a, key_a)
+
+    # uninterrupted: 2 more dispatched iterations at fsdp=2
+    ts_b, rs_b, key_b = place_carry(snap, mesh2, state_specs=specs2)
+    ts_ref, _, key_ref = run_k(mesh2, ts_b, rs_b, key_b, 2)
+
+    # elastic: the same carry re-placed at fsdp=4, 2 more iterations
+    s_probe = jax.eval_shape(lambda: ts_a)
+    specs4 = resolve_state_specs(s_probe, mesh4, _AC_RULES)
+    ts_c, rs_c, key_c = place_carry(snap, mesh4, state_specs=specs4)
+    sharded = [x for x in jax.tree.leaves(ts_c.params)
+               if getattr(x, "ndim", 0) == 2]
+    assert any(len(x.sharding.device_set) == 4 for x in sharded)
+    ts_el, _, key_el = run_k(mesh4, ts_c, rs_c, key_c, 2)
+
+    np.testing.assert_array_equal(np.asarray(jax.random.key_data(key_ref)),
+                                  np.asarray(jax.random.key_data(key_el)),
+                                  err_msg="key chain across fsdp 2->4")
+    _assert_close(ts_ref.params, ts_el.params,
+                  "params after elastic fsdp 2->4 (psum tolerance)")
+
+    # ... and back: 4 -> 2 placement of a packed carry is pure movement
+    ts_c2, rs_c2, key_c2 = place_carry(snap, mesh4, state_specs=specs4)
+    snap4 = pack_carry(2, ts_c2, rs_c2, key_c2)
+    ts_back, _, _ = place_carry(snap4, mesh2, state_specs=specs2)
+    for a, b in zip(jax.tree.leaves(jax.device_get(ts_a.params)),
+                    jax.tree.leaves(jax.device_get(ts_back.params))):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+@pytest.mark.slow
+def test_dcml_runner_trains_at_fsdp4(forced8_cpu, tmp_path):
+    """The full DCMLRunner at --fsdp_shards 4: params born sharded through
+    setup's spec path, the run completes, and the metrics stream carries the
+    shard_param_ gauge family (~1/4 per-device split) plus the per-kind
+    collective census — and the whole run dir validates --strict."""
+    import importlib.util
+    from pathlib import Path
+
+    from mat_dcml_tpu.config import RunConfig
+    from mat_dcml_tpu.envs.dcml import DCMLEnv, DCMLEnvConfig
+    from mat_dcml_tpu.envs.dcml.env import DCMLConsts
+    from mat_dcml_tpu.training.runner import DCMLRunner
+
+    W = 8
+    consts = DCMLConsts(worker_number_max=W, sob_dim=W + 2)
+    rng = np.random.default_rng(0)
+    workloads = rng.integers(
+        0, 5, size=(W, consts.local_workload_period)).astype(np.float32)
+    env = DCMLEnv(DCMLEnvConfig(consts=consts), base_workloads=workloads)
+
+    run = RunConfig(
+        algorithm_name="mat", n_rollout_threads=2, episode_length=8,
+        num_env_steps=2 * 8 * 2, log_interval=1, save_interval=0,
+        n_block=1, n_embd=64, n_head=2, fsdp_shards=4,
+        run_dir=str(tmp_path),
+    )
+    r = DCMLRunner(run, PPOConfig(ppo_epoch=2, num_mini_batch=2),
+                   env=env, log_fn=lambda s: None)
+    assert dict(r.mesh.shape)["fsdp"] == 4
+    ts, rs = r.setup()
+    # the live params really are born sharded 4 ways
+    k = ts.params["params"]["encoder"]["blocks_0"]["attn"]["key_p"]["kernel"]
+    assert k.sharding.spec == P("fsdp", "tp")
+    assert k.addressable_shards[0].data.nbytes * 4 == k.nbytes
+    r.train_loop(train_state=ts, rollout_state=rs)
+    r.writer.close()
+
+    records = [json.loads(line) for line in
+               (Path(run.run_dir) and (r.run_dir / "metrics.jsonl")).read_text().splitlines()]
+    merged = {}
+    for rec in records:
+        merged.update(rec)
+    assert merged["shard_fsdp"] == 4 and merged["shard_tp"] == 1
+    assert merged["shard_param_bytes_fsdp"] > 0
+    # ~1/4 split: the replicated remainder is all that exceeds total/4
+    assert merged["shard_param_max_device_bytes"] <= (
+        merged["shard_param_bytes_total"] / 4
+        + merged["shard_param_bytes_replicated"])
+    assert merged["shard_param_opt_max_device_bytes"] > \
+        merged["shard_param_max_device_bytes"]
+    # the census saw the param-movement collectives the sharded step needs
+    census = {k2: v for k2, v in merged.items()
+              if k2.startswith("shard_param_collectives_")}
+    assert census and sum(census.values()) > 0
+
+    spec = importlib.util.spec_from_file_location(
+        "check_metrics_schema",
+        Path(__file__).resolve().parent.parent / "scripts" / "check_metrics_schema.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    assert mod.main(["--strict", str(r.run_dir)]) == 0
