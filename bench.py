@@ -133,6 +133,16 @@ Knobs (environment variables):
                         (1,4,16), BENCH_OBS_SAMPLE (0.01),
                         BENCH_OBS_RUN_DIR (append records + trace.jsonl,
                         then strict-validate the run dir)
+  BENCH_CHAOS           "1" → chaos-seam overhead A/B: the injector DISARMED
+                        (production default — every seam is one module-
+                        attribute read + ``is None`` branch) vs ARMED with an
+                        empty fault plan (armed-but-idle soak worst case) on
+                        the identical single-replica fleet.  Record value =
+                        armed QPS, vs_baseline = armed/disarmed QPS ratio
+                        (contract: >= 0.98 — the seams stay within noise).
+                        Knobs: BENCH_CHAOS_REQUESTS (512),
+                        BENCH_CHAOS_CONCURRENCY (16), BENCH_CHAOS_BUCKETS
+                        (1,4,16), BENCH_CHAOS_TRIALS (5)
   BENCH_MULTI_SCENARIO  "1" → scenario-as-data overhead A/B: a 4-scenario
                         DCML family (nominal + fleet_stress + straggler
                         mixes, envs/scenario.py) vs the plain single-scenario
@@ -1928,6 +1938,101 @@ def ab_trials(legs: dict, trials: int, score=None) -> tuple:
     return best, results
 
 
+def _measure_chaos(jax) -> None:
+    """BENCH_CHAOS=1 leg: chaos-seam overhead A/B.
+
+    Both legs serve the identical single-replica fleet under the same
+    closed-loop load.  Leg A (``disarmed``) is the production default: the
+    injector global is None, so every seam costs one module-attribute read
+    and an ``is None`` branch.  Leg B (``armed_idle``) arms a FaultInjector
+    with an EMPTY plan — seams call into the injector, which takes its lock
+    and scans zero armed events per hook: the worst case for an armed soak
+    with no fault currently scheduled.  ``vs_baseline`` is the
+    armed/disarmed QPS ratio of best-of-N alternating trials (contract:
+    >= 0.98 — arming chaos must not tax the serving path beyond noise)."""
+    from mat_dcml_tpu.chaos import FaultInjector, FaultPlan, arm, disarm
+    from mat_dcml_tpu.config import RunConfig
+    from mat_dcml_tpu.envs.dcml import DCMLEnv, DCMLEnvConfig
+    from mat_dcml_tpu.serving.batcher import BatcherConfig
+    from mat_dcml_tpu.serving.engine import EngineConfig
+    from mat_dcml_tpu.serving.fleet import EngineFleet, FleetConfig
+    from mat_dcml_tpu.serving.loadgen import run_load
+    from mat_dcml_tpu.serving.server import PolicyClient
+    from mat_dcml_tpu.training.runner import build_mat_policy
+
+    data_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)), "data")
+    env = DCMLEnv(DCMLEnvConfig(), data_dir=data_dir)
+    policy = build_mat_policy(RunConfig(), env)
+    params = policy.init_params(jax.random.key(0))
+
+    n_req = int(os.environ.get("BENCH_CHAOS_REQUESTS", "512"))
+    conc = int(os.environ.get("BENCH_CHAOS_CONCURRENCY", "16"))
+    buckets = tuple(
+        int(b)
+        for b in os.environ.get("BENCH_CHAOS_BUCKETS", "1,4,16").split(",")
+    )
+    trials = int(os.environ.get("BENCH_CHAOS_TRIALS", "5"))
+
+    def _run_leg(name: str) -> dict:
+        injector = None
+        if name == "armed_idle":
+            injector = arm(FaultInjector(FaultPlan(name="empty"),
+                                         log=lambda *a: None))
+            injector.start()
+        fleet = EngineFleet(
+            params, policy.cfg,
+            fleet_cfg=FleetConfig(n_replicas=1),
+            engine_cfg=EngineConfig(buckets=buckets),
+            batcher_cfg=BatcherConfig(max_batch_wait_ms=2.0),
+            log_fn=lambda *a: None,
+        )
+        try:
+            fleet.warmup()
+            rec = run_load(PolicyClient(fleet), n_requests=n_req,
+                           concurrency=conc)
+            rec["steady_state_recompiles"] = fleet.steady_state_recompiles()
+        finally:
+            fleet.close()
+            if injector is not None:
+                disarm()
+        log(f"chaos[{name}]: {rec['serving_qps']:.1f} req/s, "
+            f"p50 {rec['serving_p50_ms']:.1f} ms, "
+            f"p99 {rec['serving_p99_ms']:.1f} ms")
+        return rec
+
+    best, legs = ab_trials(
+        {"armed_idle": lambda: _run_leg("armed_idle"),
+         "disarmed": lambda: _run_leg("disarmed")},
+        trials, score=lambda r: r["serving_qps"])
+
+    dev = jax.devices()[0]
+    armed_qps = best["armed_idle"]["serving_qps"]
+    plain_qps = best["disarmed"]["serving_qps"]
+    record = {
+        "metric": "dcml_mat_chaos_seam_overhead_qps",
+        "value": round(armed_qps, 2),
+        "unit": "req/s",
+        # armed-idle/disarmed ratio of best-of-N trials: the chaos-seam tax
+        # (contract >= 0.98)
+        "vs_baseline": round(armed_qps / max(plain_qps, 1e-9), 4),
+        "platform": dev.platform,
+        "device": dev.device_kind,
+        "provisional": False,
+        "buckets": ",".join(str(b) for b in buckets),
+        "requests": n_req,
+        "concurrency": conc,
+        "trials": max(trials, 1),
+        "disarmed_qps": round(plain_qps, 2),
+        "armed_qps_all": [round(r["serving_qps"], 1)
+                          for r in legs["armed_idle"]],
+        "disarmed_qps_all": [round(r["serving_qps"], 1)
+                             for r in legs["disarmed"]],
+        "armed_p99_ms": round(best["armed_idle"]["serving_p99_ms"], 2),
+        "disarmed_p99_ms": round(best["disarmed"]["serving_p99_ms"], 2),
+    }
+    print(json.dumps(record), flush=True)
+
+
 def _measure_obs(jax) -> None:
     """BENCH_OBS=1 leg: observability-plane overhead A/B.
 
@@ -2289,6 +2394,12 @@ def main() -> None:
     if os.environ.get("BENCH_OBS", "0") == "1":
         jax, _ = _setup_jax()
         _measure_obs(jax)
+        return
+
+    # Chaos-seam overhead A/B: disarmed seams vs an armed-but-idle injector
+    if os.environ.get("BENCH_CHAOS", "0") == "1":
+        jax, _ = _setup_jax()
+        _measure_chaos(jax)
         return
 
     # Speculative-decode A/B: exactness-asserted spec-vs-scan decode timing

@@ -1,0 +1,38 @@
+"""Deterministic, seeded fault injection for the serving/training stack.
+
+``chaos/plan.py`` declares *what* goes wrong and when (a JSON-loadable
+:class:`FaultPlan` of typed fault events, seed-reproducibly expanded into a
+concrete schedule); ``chaos/inject.py`` is *how* — a :class:`FaultInjector`
+armed at existing seams (engine decode, batcher dequeue, checkpoint IO,
+trajectory queue, param publisher, dispatch launch).  Disarmed seams are a
+single ``is None`` check, so production paths pay nothing.
+``chaos/invariants.py`` turns a soak's metrics stream into pass/fail
+contracts, and ``scripts/chaos_soak.py`` drives the whole thing.
+"""
+
+from mat_dcml_tpu.chaos.inject import (
+    ActorThreadDeath,
+    FaultInjector,
+    InjectedFault,
+    InjectedIOError,
+    arm,
+    disarm,
+    is_silent_death,
+)
+from mat_dcml_tpu.chaos.invariants import InvariantResult, check_invariants
+from mat_dcml_tpu.chaos.plan import FAULT_KINDS, FaultEvent, FaultPlan
+
+__all__ = [
+    "FAULT_KINDS",
+    "ActorThreadDeath",
+    "FaultEvent",
+    "FaultInjector",
+    "FaultPlan",
+    "InjectedFault",
+    "InjectedIOError",
+    "InvariantResult",
+    "arm",
+    "check_invariants",
+    "disarm",
+    "is_silent_death",
+]

@@ -1,0 +1,445 @@
+"""Fault injector: arms a :class:`FaultPlan` at the stack's existing seams.
+
+Seam sites (engine decode, batcher dequeue, checkpoint IO, trajectory queue
+put/get, param publish, dispatch launch, anomaly signals) all follow one
+pattern::
+
+    from mat_dcml_tpu.chaos import inject as _chaos
+    ...
+    if _chaos.ACTIVE is not None:
+        _chaos.ACTIVE.on_decode(replica_id)
+
+Disarmed (the production default) that is a module-attribute read and an
+``is None`` branch — no allocation, no lock, no call.  Armed, each hook
+checks the plan under a lock and either returns, sleeps (latency faults), or
+raises a typed :class:`InjectedFault` (crash faults).  Every fired event
+emits a ``{"chaos": "fired", ...}`` record through ``record_sink`` plus
+``chaos_*`` counters through telemetry, and :meth:`suppression_for` lets the
+anomaly paths correlate trips with the injected fault that explains them —
+expected faults are suppressed (counted + recorded) instead of paging.
+
+The injector is process-local: the soak driver arms serving-plane events in
+its own process and each trainer subprocess arms its own plane's sub-plan.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from pathlib import Path
+from typing import Any, Callable, Dict, List, Optional
+
+from mat_dcml_tpu.chaos.plan import FaultEvent, FaultPlan
+
+# The armed injector, or None.  Seam sites read this attribute directly.
+ACTIVE: Optional["FaultInjector"] = None
+
+
+class InjectedFault(RuntimeError):
+    """A fault raised on purpose by the chaos injector."""
+
+    def __init__(self, msg: str, event_id: str = ""):
+        super().__init__(msg)
+        self.event_id = event_id
+
+
+class InjectedIOError(InjectedFault, OSError):
+    """Injected transient IO failure — an ``OSError`` so retry paths treat it
+    exactly like a real filesystem hiccup."""
+
+
+class ActorThreadDeath(InjectedFault):
+    """Kills the actor thread *silently*: ``ActorWorker.run`` recognizes it
+    via :func:`is_silent_death` and returns without recording an error or
+    closing the queue — reproducing the pathological dead-thread mode the
+    learner liveness check exists for."""
+
+
+def is_silent_death(exc: BaseException) -> bool:
+    return isinstance(exc, ActorThreadDeath)
+
+
+# Which anomaly kinds an injected fault is *expected* to trip (prefix match).
+# A trip whose kind matches an active/just-cleared event's entry here is
+# suppressed: counted + recorded, but no flight-recorder bundle, no profiler
+# trigger, no page.
+_SUPPRESSES: Dict[str, tuple] = {
+    "replica_crash": ("slo_",),
+    "replica_hang": ("slo_",),
+    "decode_error": ("slo_",),
+    "queue_stall": ("slo_",),
+    "load_spike": ("slo_",),
+    "checkpoint_io_error": ("step_time",),
+    "checkpoint_corrupt": ("step_time",),
+    "nan_grad": ("nonfinite",),
+    "actor_thread_death": ("step_time", "staleness"),
+    "param_publish_delay": ("staleness", "step_time"),
+    "trainer_kill": (),
+}
+
+# Kinds gated by call count (fire on the Nth matching hook call) rather than
+# by wall-clock window alone — training timing is compile-dominated, so call
+# counts are the deterministic clock there.
+_COUNT_GATED = frozenset({
+    "decode_error", "checkpoint_io_error", "checkpoint_corrupt",
+    "nan_grad", "actor_thread_death",
+})
+
+
+class _EventState:
+    __slots__ = ("event", "fired", "cleared", "skips_left", "budget_left",
+                 "last_fire_s")
+
+    def __init__(self, event: FaultEvent):
+        self.event = event
+        self.fired = False
+        self.cleared = False
+        self.skips_left = int(event.params.get("skip_calls", 0))
+        self.budget_left = (int(event.params.get("fail_calls", 1))
+                            if event.kind in _COUNT_GATED else None)
+        self.last_fire_s = -1.0
+
+
+def jsonl_sink(path: str | Path) -> Callable[[dict], None]:
+    """Append-per-record jsonl sink (opens with ``'a'`` per write so it can
+    share a file with :class:`MetricsWriter` safely on POSIX)."""
+    path = Path(path)
+    lock = threading.Lock()
+
+    def sink(record: dict) -> None:
+        with lock:
+            path.parent.mkdir(parents=True, exist_ok=True)
+            with open(path, "a") as f:
+                f.write(json.dumps(record) + "\n")
+
+    return sink
+
+
+class FaultInjector:
+    """Executes an expanded :class:`FaultPlan` against the seam hooks.
+
+    ``time_fn`` is injectable for tests; the schedule clock starts at
+    :meth:`start` (call it after warmup so ``at_s`` means "seconds into the
+    steady run").  Hooks called before ``start`` are no-ops.
+    """
+
+    def __init__(self, plan: FaultPlan, telemetry=None,
+                 record_sink: Optional[Callable[[dict], None]] = None,
+                 time_fn: Callable[[], float] = time.monotonic,
+                 suppression_grace_s: float = 30.0,
+                 log=print):
+        self.plan = plan.expand()
+        self.telemetry = telemetry
+        self.record_sink = record_sink
+        self.time_fn = time_fn
+        self.suppression_grace_s = float(suppression_grace_s)
+        self.log = log
+        self._t0: Optional[float] = None
+        self._lock = threading.Lock()
+        self._states = [_EventState(ev) for ev in self.plan.events]
+        self._records: List[dict] = []
+
+    # ---------------------------------------------------------------- admin
+
+    def start(self) -> None:
+        """Start the schedule clock (idempotent)."""
+        with self._lock:
+            if self._t0 is None:
+                self._t0 = self.time_fn()
+
+    def now(self) -> Optional[float]:
+        return None if self._t0 is None else self.time_fn() - self._t0
+
+    def records(self) -> List[dict]:
+        with self._lock:
+            return list(self._records)
+
+    def fired_sequence(self) -> List[str]:
+        """Event ids in firing order — the reproducibility artifact's view of
+        what actually happened (vs. the schedule's view of what should)."""
+        return [r["event_id"] for r in self.records()
+                if r.get("chaos") == "fired"]
+
+    def poll(self) -> None:
+        """Emit ``cleared`` records for fired events whose window has passed
+        (the soak driver calls this periodically)."""
+        t = self.now()
+        if t is None:
+            return
+        with self._lock:
+            for st in self._states:
+                if (st.fired and not st.cleared
+                        and not self._active_locked(st, t)
+                        and t >= st.event.end_s):
+                    self._clear_locked(st, t)
+
+    def finish(self) -> None:
+        """Clear everything still open and drop the active gauge."""
+        t = self.now()
+        with self._lock:
+            if t is not None:
+                for st in self._states:
+                    if st.fired and not st.cleared:
+                        self._clear_locked(st, t)
+        self._gauge("chaos_active", 0.0)
+
+    # ------------------------------------------------------------ internals
+
+    def _count(self, name: str, n: int = 1) -> None:
+        if self.telemetry is not None:
+            self.telemetry.count(name, n)
+
+    def _gauge(self, name: str, value: float) -> None:
+        if self.telemetry is not None:
+            self.telemetry.gauge(name, value)
+
+    def _emit_locked(self, record: dict) -> None:
+        self._records.append(record)
+        if self.record_sink is not None:
+            self.record_sink(record)
+
+    def _active_locked(self, st: _EventState, t: float) -> bool:
+        """Is the event's schedule window open at plan-time ``t``?"""
+        ev = st.event
+        if t < ev.at_s:
+            return False
+        if ev.kind in _COUNT_GATED:
+            return st.budget_left is None or st.budget_left > 0
+        return ev.duration_s <= 0 or t < ev.end_s
+
+    def _clear_locked(self, st: _EventState, t: float) -> None:
+        st.cleared = True
+        self._emit_locked({
+            "chaos": "cleared", "event_id": st.event.event_id,
+            "kind": st.event.kind, "t_s": round(t, 3),
+            "duration_s": float(st.event.duration_s),
+        })
+        self.log(f"[chaos] cleared {st.event.event_id} at t={t:.2f}s")
+
+    def _matches_target(self, ev: FaultEvent, target: Optional[str]) -> bool:
+        if ev.target is None:
+            return True
+        return str(ev.target) == str(target)
+
+    def _fire(self, st: _EventState, t: float) -> str:
+        """Record one injected occurrence; returns the event id.  Caller
+        holds the lock."""
+        ev = st.event
+        if st.budget_left is not None:
+            st.budget_left -= 1
+        st.last_fire_s = t
+        self._count("chaos_injected_faults")
+        if not st.fired:
+            st.fired = True
+            self._count("chaos_events_fired")
+            rec = {"chaos": "fired", "event_id": ev.event_id,
+                   "kind": ev.kind, "at_s": float(ev.at_s),
+                   "t_s": round(t, 3)}
+            if ev.target is not None:
+                rec["target"] = str(ev.target)
+            self._emit_locked(rec)
+            self.log(f"[chaos] fired {ev.event_id} at t={t:.2f}s "
+                     f"(target={ev.target})")
+        return ev.event_id
+
+    def _claim(self, kind: str, target: Optional[str] = None,
+               call_index: Optional[int] = None):
+        """Find a matching armed event and consume one firing from it.
+
+        Returns ``(event, plan_time)`` or ``None``.  Sleeping/raising happens
+        in the hook, outside the lock.
+        """
+        t = self.now()
+        if t is None:
+            return None
+        with self._lock:
+            for st in self._states:
+                ev = st.event
+                if ev.kind != kind or not self._matches_target(ev, target):
+                    continue
+                at_iter = ev.params.get("at_iteration")
+                if at_iter is not None:
+                    if call_index is None or call_index < int(at_iter):
+                        continue
+                elif not self._active_locked(st, t):
+                    continue
+                if at_iter is not None and not self._active_locked(st, t):
+                    continue        # budget exhausted / before at_s
+                if st.skips_left > 0:
+                    st.skips_left -= 1
+                    continue
+                self._fire(st, t)
+                return ev, t
+        return None
+
+    # ----------------------------------------------------------- seam hooks
+
+    def on_decode(self, replica_id=None) -> None:
+        """DecodeEngine.decode: crash, hang, or transient decode error."""
+        rid = None if replica_id is None else f"r{replica_id}"
+        hit = self._claim("replica_crash", rid)
+        if hit is not None:
+            raise InjectedFault(
+                f"injected replica crash ({hit[0].event_id})",
+                event_id=hit[0].event_id)
+        hit = self._claim("decode_error", rid)
+        if hit is not None:
+            raise InjectedFault(
+                f"injected decode error ({hit[0].event_id})",
+                event_id=hit[0].event_id)
+        hit = self._claim("replica_hang", rid)
+        if hit is not None:
+            time.sleep(float(hit[0].params.get("sleep_s", 0.25)))
+
+    def on_dequeue(self) -> None:
+        """ContinuousBatcher dispatch loop: stall before collecting a batch
+        so the queue grows and shed/429 behavior is exercised honestly."""
+        hit = self._claim("queue_stall", "batcher")
+        if hit is None:
+            hit = self._claim("queue_stall", None)
+        if hit is not None:
+            time.sleep(float(hit[0].params.get("sleep_s", 0.2)))
+
+    def on_checkpoint_io(self, op: str) -> None:
+        """CheckpointManager save/restore/flush IO attempts.  ``target``
+        selects the op (``save``/``restore``/``flush``); None hits all."""
+        hit = self._claim("checkpoint_io_error", op)
+        if hit is not None:
+            raise InjectedIOError(
+                f"injected checkpoint {op} IO error ({hit[0].event_id})",
+                event_id=hit[0].event_id)
+
+    def on_checkpoint_saved(self, step_dir) -> None:
+        """After a checkpoint's integrity manifest lands: corrupt the largest
+        file so CRC verification (and quarantine fallback) is exercised."""
+        hit = self._claim("checkpoint_corrupt")
+        if hit is not None:
+            corrupted = corrupt_step_dir(step_dir)
+            self.log(f"[chaos] corrupted {corrupted} ({hit[0].event_id})")
+
+    def on_queue_put(self) -> None:
+        hit = self._claim("queue_stall", "trajectory")
+        if hit is not None:
+            time.sleep(float(hit[0].params.get("sleep_s", 0.1)))
+
+    def on_queue_get(self) -> None:
+        hit = self._claim("queue_stall", "trajectory_get")
+        if hit is not None:
+            time.sleep(float(hit[0].params.get("sleep_s", 0.1)))
+
+    def on_param_publish(self) -> None:
+        hit = self._claim("param_publish_delay")
+        if hit is not None:
+            time.sleep(float(hit[0].params.get("sleep_s", 0.1)))
+
+    def on_dispatch(self) -> None:
+        """base_runner dispatch launch: latency injection via queue_stall
+        events targeted at ``dispatch``."""
+        hit = self._claim("queue_stall", "dispatch")
+        if hit is not None:
+            time.sleep(float(hit[0].params.get("sleep_s", 0.1)))
+
+    def on_actor_iteration(self, iteration: int) -> None:
+        """Top of the actor thread loop; ``params.at_iteration`` is the
+        deterministic trigger."""
+        hit = self._claim("actor_thread_death", call_index=iteration)
+        if hit is not None:
+            raise ActorThreadDeath(
+                f"injected silent actor death ({hit[0].event_id})",
+                event_id=hit[0].event_id)
+
+    def on_anomaly_signals(self, signals: Dict[str, float],
+                           call_index: Optional[int] = None,
+                           ) -> Dict[str, float]:
+        """Mutate the anomaly-signal dict before the detector observes it —
+        nan_grad injects the *signal*, never the training math, so the run
+        stays bit-exact while the paging path is exercised end to end."""
+        hit = self._claim("nan_grad", call_index=call_index)
+        if hit is not None:
+            signals = dict(signals)
+            signals["nonfinite_grads"] = max(
+                1.0, float(signals.get("nonfinite_grads", 0.0)))
+        return signals
+
+    def load_multiplier(self) -> float:
+        """Offered-load multiplier for the load generator (product of active
+        load_spike factors; 1.0 when none)."""
+        t = self.now()
+        if t is None:
+            return 1.0
+        mult = 1.0
+        with self._lock:
+            for st in self._states:
+                if (st.event.kind == "load_spike"
+                        and self._active_locked(st, t)):
+                    if not st.fired:        # one fired record per spike, not
+                        self._fire(st, t)   # one per load-loop poll
+                    st.last_fire_s = t
+                    mult *= float(st.event.params.get("factor", 2.0))
+        return mult
+
+    # ---------------------------------------------------------- suppression
+
+    def suppression_for(self, anomaly_kind: str) -> Optional[str]:
+        """If an active (or recently-cleared, within the grace window) event
+        is expected to trip this anomaly kind, consume the trip: bump the
+        suppression counter, emit a ``suppressed`` record, and return the
+        chaos event id.  Returns None when the anomaly is *not* explained by
+        the plan and should page normally."""
+        t = self.now()
+        if t is None:
+            return None
+        with self._lock:
+            for st in self._states:
+                ev = st.event
+                prefixes = _SUPPRESSES.get(ev.kind, ())
+                if not any(anomaly_kind.startswith(p) for p in prefixes):
+                    continue
+                open_until = max(ev.end_s, st.last_fire_s) \
+                    + self.suppression_grace_s
+                if not (ev.at_s <= t <= open_until):
+                    continue
+                self._count("chaos_suppressed_anomalies")
+                self._emit_locked({
+                    "chaos": "suppressed", "event_id": ev.event_id,
+                    "kind": ev.kind, "suppressed_kind": anomaly_kind,
+                    "t_s": round(t, 3),
+                })
+                return ev.event_id
+        return None
+
+
+def corrupt_step_dir(step_dir) -> str:
+    """Flip one byte in the middle of the largest file under ``step_dir`` —
+    the canonical bit-rot injection the CRC manifests exist to catch."""
+    step_dir = Path(step_dir)
+    files = [p for p in step_dir.rglob("*") if p.is_file()
+             and p.stat().st_size > 0]
+    if not files:
+        raise FileNotFoundError(f"nothing to corrupt under {step_dir}")
+    victim = max(files, key=lambda p: p.stat().st_size)
+    with open(victim, "r+b") as f:
+        f.seek(victim.stat().st_size // 2)
+        byte = f.read(1)
+        f.seek(-1, 1)
+        f.write(bytes([byte[0] ^ 0xFF]))
+    return str(victim)
+
+
+def arm(injector: FaultInjector) -> FaultInjector:
+    """Install ``injector`` as the process-wide active injector."""
+    global ACTIVE
+    ACTIVE = injector
+    if injector.telemetry is not None:
+        injector.telemetry.count("chaos_events_armed",
+                                 len(injector.plan.events))
+        injector.telemetry.gauge("chaos_active", 1.0)
+    return injector
+
+
+def disarm() -> None:
+    global ACTIVE
+    if ACTIVE is not None:
+        ACTIVE.finish()
+    ACTIVE = None

@@ -1,0 +1,154 @@
+"""Soak invariants: the contracts the repo pins piecemeal, checked centrally.
+
+Consumes the merged metrics records of a chaos soak (serving + trainer
+planes) plus a ``facts`` dict of driver-side observations that aren't in the
+metrics stream (bit-exact resume verdict, which planes actually ran), and
+returns one :class:`InvariantResult` per contract:
+
+* ``zero_dropped_requests`` — graceful degradation means clients see 429
+  sheds and retries, never errors: every serving slice has
+  ``serving_error_rate == 0`` (and zero deadline misses), the fleet never
+  exhausted retries, and the async trajectory queue dropped nothing.
+* ``zero_steady_recompiles`` — every ``*steady_state_recompiles`` gauge in
+  every record is 0: faults must not knock compiled programs off their
+  signatures.
+* ``staleness_p95_le_1`` — the async overlap's double-buffering throttle
+  holds under injected delays (last ``staleness_learner_steps_p95`` ≤ 1).
+* ``bit_exact_resume`` — the kill-and-relaunch trainer converges to the
+  byte-identical final state of an uninterrupted twin (driver-computed).
+* ``slo_burn_recovery`` — after the last fault clears, every ``slo_*_burn``
+  gauge in the final fleet record is back under 1.0 (budget no longer
+  burning).
+
+An invariant whose plane didn't run reports ``ok`` with a "skipped" detail —
+absence of data is only a failure when the plan said the plane would run.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional
+
+
+@dataclasses.dataclass
+class InvariantResult:
+    name: str
+    ok: bool
+    detail: str
+    skipped: bool = False
+
+    def to_dict(self) -> Dict[str, object]:
+        return dataclasses.asdict(self)
+
+
+def _skip(name: str, why: str) -> InvariantResult:
+    return InvariantResult(name, True, f"skipped: {why}", skipped=True)
+
+
+def _num(record: dict, key: str) -> Optional[float]:
+    v = record.get(key)
+    return float(v) if isinstance(v, (int, float)) and not isinstance(v, bool) else None
+
+
+def check_invariants(records: List[dict],
+                     facts: Optional[Dict[str, object]] = None,
+                     ) -> List[InvariantResult]:
+    facts = dict(facts or {})
+    out: List[InvariantResult] = []
+    metrics = [r for r in records
+               if "chaos" not in r and "anomaly" not in r
+               and "trace" not in r and "emergency_checkpoint" not in r]
+
+    # --- zero dropped requests -------------------------------------------
+    bad: List[str] = []
+    serving = [r for r in metrics if "serving_error_rate" in r]
+    for r in serving:
+        for key in ("serving_error_rate", "serving_deadline_miss_rate"):
+            v = _num(r, key)
+            if v:
+                bad.append(f"{key}={v:.4f}")
+    exhausted = max((_num(r, "fleet_retries_exhausted") or 0.0)
+                    for r in metrics) if metrics else 0.0
+    if exhausted:
+        bad.append(f"fleet_retries_exhausted={exhausted:g}")
+    drops = max((_num(r, "async_queue_drops") or 0.0)
+                for r in metrics) if metrics else 0.0
+    if drops:
+        bad.append(f"async_queue_drops={drops:g}")
+    if not serving and not facts.get("expect_serving", True):
+        out.append(_skip("zero_dropped_requests", "no serving records"))
+    else:
+        out.append(InvariantResult(
+            "zero_dropped_requests", not bad,
+            "; ".join(bad) if bad
+            else f"clean across {len(serving)} serving slices "
+                 f"(sheds/429s are graceful, not drops)"))
+
+    # --- zero steady-state recompiles ------------------------------------
+    recompiled: List[str] = []
+    for r in metrics:
+        for key, v in r.items():
+            if key.endswith("steady_state_recompiles") \
+                    and isinstance(v, (int, float)) and v:
+                recompiled.append(f"{key}={v:g}")
+    out.append(InvariantResult(
+        "zero_steady_recompiles", not recompiled,
+        "; ".join(sorted(set(recompiled))) if recompiled
+        else "every *steady_state_recompiles gauge is 0"))
+
+    # --- async staleness --------------------------------------------------
+    stale = [r for r in metrics if "staleness_learner_steps_p95" in r]
+    if not stale:
+        if facts.get("expect_async", False):
+            out.append(InvariantResult(
+                "staleness_p95_le_1", False,
+                "async plane expected but emitted no staleness gauges"))
+        else:
+            out.append(_skip("staleness_p95_le_1", "no async records"))
+    else:
+        p95 = _num(stale[-1], "staleness_learner_steps_p95") or 0.0
+        out.append(InvariantResult(
+            "staleness_p95_le_1", p95 <= 1.0,
+            f"staleness_learner_steps_p95={p95:g} (last async record)"))
+
+    # --- bit-exact resume -------------------------------------------------
+    verdict = facts.get("bit_exact_resume")
+    if verdict is None:
+        if facts.get("expect_kill", False):
+            out.append(InvariantResult(
+                "bit_exact_resume", False,
+                "trainer_kill scheduled but no resume verdict recorded"))
+        else:
+            out.append(_skip("bit_exact_resume", "no kill event in plan"))
+    else:
+        out.append(InvariantResult(
+            "bit_exact_resume", bool(verdict),
+            "killed+resumed run matches uninterrupted twin bit-for-bit"
+            if verdict else
+            "resumed final state differs from uninterrupted twin"))
+
+    # --- SLO burn recovery ------------------------------------------------
+    burns = [r for r in metrics
+             if any(k.endswith("_burn") for k in r)]
+    if not burns:
+        if facts.get("expect_serving", True):
+            out.append(InvariantResult(
+                "slo_burn_recovery", False,
+                "serving plane expected but emitted no slo_*_burn gauges"))
+        else:
+            out.append(_skip("slo_burn_recovery", "no SLO records"))
+    else:
+        last = burns[-1]
+        hot = {k: v for k, v in last.items()
+               if k.endswith("_burn") and isinstance(v, (int, float))
+               and v >= 1.0}
+        out.append(InvariantResult(
+            "slo_burn_recovery", not hot,
+            "; ".join(f"{k}={v:g}" for k, v in sorted(hot.items())) if hot
+            else "all slo_*_burn < 1.0 in the final fleet record"))
+
+    return out
+
+
+def all_green(results: List[InvariantResult]) -> bool:
+    return all(r.ok for r in results)

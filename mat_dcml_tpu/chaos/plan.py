@@ -1,0 +1,192 @@
+"""Fault plans: a JSON-loadable, seed-reproducible schedule of fault events.
+
+A plan is declarative — *what* goes wrong, *when*, and *where* — and carries
+no injection machinery (that's :mod:`mat_dcml_tpu.chaos.inject`).  Schedule
+fields may be randomized in the JSON (``at_s``/``duration_s`` as a
+``[lo, hi]`` range, ``target`` as a list of choices); :meth:`FaultPlan.expand`
+resolves them with ``random.Random(seed)`` into a concrete schedule, so the
+expansion is a pure function of (plan JSON, seed) and re-running the same
+pair reproduces the same injection sequence exactly.
+
+Plan JSON::
+
+    {
+      "name": "smoke",
+      "events": [
+        {"kind": "replica_hang", "at_s": 2.0, "duration_s": 1.5,
+         "target": "r0", "params": {"sleep_s": 0.05}},
+        {"kind": "load_spike", "at_s": [4.0, 5.0], "duration_s": 3.0,
+         "params": {"factor": 3.0}}
+      ]
+    }
+
+Count-gated kinds (checkpoint_io_error, decode_error, checkpoint_corrupt,
+actor_thread_death, nan_grad) fire on the Nth hook call inside their window
+via ``params`` (``fail_calls``, ``skip_calls``, ``at_iteration``) rather than
+wall-clock alone — training-plane timing is compile-dominated, so call counts
+are the deterministic clock there.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import random
+from pathlib import Path
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+# Every fault kind the injector understands, and the plane whose process arms
+# it (the soak driver partitions a plan by plane — serving faults arm in the
+# driver process, training faults in the trainer subprocess they target).
+FAULT_KINDS: Dict[str, str] = {
+    "replica_crash": "serving",        # decode raises for the whole window
+    "replica_hang": "serving",         # decode sleeps (latency injection)
+    "decode_error": "serving",         # N transient decode failures
+    "queue_stall": "serving",          # batcher dispatch loop sleeps
+    "load_spike": "serving",           # loadgen offered-QPS multiplier
+    "checkpoint_io_error": "train_sync",   # save/restore raises transient IO
+    "checkpoint_corrupt": "train_sync",    # byte-flip a finished checkpoint
+    "nan_grad": "train_sync",          # nonfinite_grads anomaly signal
+    "trainer_kill": "train_sync",      # orchestrator-level SIGTERM
+    "actor_thread_death": "train_async",   # actor thread dies silently
+    "param_publish_delay": "train_async",  # publisher sleeps per publish
+}
+
+
+def _resolve(value: Any, rng: random.Random) -> Any:
+    """``[lo, hi]`` numeric pair -> uniform draw; list -> choice; else as-is."""
+    if isinstance(value, (list, tuple)):
+        if (len(value) == 2
+                and all(isinstance(v, (int, float)) for v in value)):
+            lo, hi = float(value[0]), float(value[1])
+            return rng.uniform(lo, hi)
+        return rng.choice(list(value))
+    return value
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultEvent:
+    """One scheduled fault.  ``at_s``/``duration_s`` are seconds relative to
+    injector start (post-warmup); ``duration_s == 0`` means the event has no
+    window and is gated purely by its count params.  ``event_id`` is assigned
+    at expansion (``<kind>:<index>``) and keys suppression + metrics."""
+
+    kind: str
+    at_s: float = 0.0
+    duration_s: float = 0.0
+    target: Optional[str] = None
+    params: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    event_id: str = ""
+
+    def __post_init__(self):
+        if self.kind not in FAULT_KINDS:
+            raise ValueError(
+                f"unknown fault kind {self.kind!r}; "
+                f"known: {sorted(FAULT_KINDS)}")
+
+    @property
+    def end_s(self) -> float:
+        return float(self.at_s) + float(self.duration_s)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "kind": self.kind,
+            "at_s": self.at_s,
+            "duration_s": self.duration_s,
+            "target": self.target,
+            "params": dict(self.params),
+            "event_id": self.event_id,
+        }
+
+
+@dataclasses.dataclass
+class FaultPlan:
+    """A named list of fault events plus the seed that concretizes them."""
+
+    name: str = "plan"
+    seed: int = 0
+    events: List[FaultEvent] = dataclasses.field(default_factory=list)
+    expanded: bool = False
+
+    @classmethod
+    def from_dict(cls, data: Dict[str, Any]) -> "FaultPlan":
+        events = []
+        for raw in data.get("events", []):
+            raw = dict(raw)
+            kind = raw.pop("kind")
+            events.append(FaultEvent(
+                kind=kind,
+                at_s=raw.pop("at_s", 0.0),
+                duration_s=raw.pop("duration_s", 0.0),
+                target=raw.pop("target", None),
+                params=dict(raw.pop("params", {}) or {}),
+                event_id=raw.pop("event_id", ""),
+            ))
+            if raw:
+                raise ValueError(f"unknown event fields: {sorted(raw)}")
+        return cls(name=data.get("name", "plan"),
+                   seed=int(data.get("seed", 0)),
+                   events=events,
+                   expanded=bool(data.get("expanded", False)))
+
+    @classmethod
+    def from_json(cls, path: str | Path) -> "FaultPlan":
+        with open(path) as f:
+            return cls.from_dict(json.load(f))
+
+    def expand(self, seed: Optional[int] = None) -> "FaultPlan":
+        """Resolve randomized fields and assign event ids.
+
+        Deterministic: one ``random.Random(seed)`` consumed in event order
+        with a fixed draw pattern, so the same (plan, seed) always yields a
+        deep-equal schedule.  Expanding an already-expanded plan is the
+        identity (ids and values are kept).
+        """
+        if self.expanded:
+            return self
+        seed = self.seed if seed is None else int(seed)
+        rng = random.Random(seed)
+        out = []
+        for i, ev in enumerate(self.events):
+            at_s = float(_resolve(ev.at_s, rng))
+            duration_s = float(_resolve(ev.duration_s, rng))
+            target = _resolve(ev.target, rng)
+            params = {k: _resolve(v, rng) for k, v in sorted(ev.params.items())}
+            out.append(dataclasses.replace(
+                ev, at_s=at_s, duration_s=duration_s, target=target,
+                params=params, event_id=ev.event_id or f"{ev.kind}:{i:03d}"))
+        return FaultPlan(name=self.name, seed=seed, events=out, expanded=True)
+
+    def filter(self, planes: Sequence[str] = (),
+               kinds: Sequence[str] = ()) -> "FaultPlan":
+        """Sub-plan keeping only events on the given planes/kinds (event ids
+        are preserved — filter after :meth:`expand`)."""
+        keep = [ev for ev in self.events
+                if (not planes or FAULT_KINDS[ev.kind] in planes)
+                and (not kinds or ev.kind in kinds)]
+        return FaultPlan(name=self.name, seed=self.seed, events=keep,
+                         expanded=self.expanded)
+
+    def planes(self) -> Tuple[str, ...]:
+        return tuple(sorted({FAULT_KINDS[ev.kind] for ev in self.events}))
+
+    def kinds(self) -> Tuple[str, ...]:
+        return tuple(sorted({ev.kind for ev in self.events}))
+
+    def horizon_s(self) -> float:
+        """Latest event end — the minimum soak length that covers the plan."""
+        return max([ev.end_s for ev in self.events], default=0.0)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "name": self.name,
+            "seed": self.seed,
+            "expanded": self.expanded,
+            "events": [ev.to_dict() for ev in self.events],
+        }
+
+    def save(self, path: str | Path) -> None:
+        Path(path).parent.mkdir(parents=True, exist_ok=True)
+        with open(path, "w") as f:
+            json.dump(self.to_dict(), f, indent=2, sort_keys=True)
+            f.write("\n")

@@ -175,6 +175,10 @@ class RunConfig:
     # regardless (the actor throttles to one block per published version
     # whenever a completed block is already queued — async_loop.ActorWorker).
     async_queue_depth: int = 2
+    # learner-side liveness budget: how many times a silently-dead actor
+    # thread (no recorded error, queue left open) is restarted from the last
+    # published params before the run raises ActorDeadError
+    async_actor_max_restarts: int = 2
 
     @property
     def episodes(self) -> int:

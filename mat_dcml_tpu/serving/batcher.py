@@ -35,6 +35,7 @@ from typing import Optional
 
 import numpy as np
 
+from mat_dcml_tpu.chaos import inject as _chaos
 from mat_dcml_tpu.serving.engine import DecodeEngine
 from mat_dcml_tpu.telemetry import Telemetry
 from mat_dcml_tpu.telemetry.tracing import TraceContext, Tracer
@@ -247,6 +248,11 @@ class ContinuousBatcher:
 
     def _dispatch_loop(self):
         while True:
+            # chaos seam: a queue_stall fault sleeps HERE, outside the queue
+            # lock, so arrivals keep queueing and shed/429 behavior under a
+            # stalled dispatcher is exercised honestly
+            if _chaos.ACTIVE is not None:
+                _chaos.ACTIVE.on_dequeue()
             batch = self._collect_batch()
             if batch is None:
                 return

@@ -24,6 +24,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from mat_dcml_tpu.chaos import inject as _chaos
 from mat_dcml_tpu.models.decode import serve_decode
 from mat_dcml_tpu.models.mat import MATConfig
 from mat_dcml_tpu.telemetry import Telemetry, instrumented_jit
@@ -278,6 +279,11 @@ class DecodeEngine:
             raise ValueError(
                 f"batch {b} is not a compiled bucket {self.engine_cfg.buckets}"
             )
+        # chaos seam (after bucket validation — a malformed request is a
+        # caller bug, never an injected fault): crash / hang / decode_error
+        # faults targeted at this replica fire here
+        if _chaos.ACTIVE is not None:
+            _chaos.ACTIVE.on_decode(getattr(self, "replica_id", None))
         t0 = time.perf_counter()
         # capture the resident params ONCE: install_params swaps the attribute
         # atomically, so one dispatch is entirely old or entirely new weights

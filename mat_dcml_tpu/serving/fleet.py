@@ -37,6 +37,7 @@ the report carries the measured ``push_dropped`` delta.
 from __future__ import annotations
 
 import dataclasses
+import math
 import random
 import threading
 import time
@@ -46,6 +47,7 @@ from typing import Dict, List, Optional, Tuple
 import jax
 import numpy as np
 
+from mat_dcml_tpu.chaos import inject as _chaos
 from mat_dcml_tpu.models.mat import MATConfig
 from mat_dcml_tpu.serving.batcher import (
     BatcherConfig,
@@ -105,6 +107,9 @@ class Replica:
                  batcher_cfg: BatcherConfig, log_fn):
         self.rid = rid
         self.engine = engine
+        # replica identity on the engine itself: the chaos injector's decode
+        # seam targets faults at specific replicas through this attribute
+        engine.replica_id = rid
         self.batcher = ContinuousBatcher(
             engine, batcher_cfg, telemetry=engine.telemetry, log_fn=log_fn)
         self.state = HEALTHY
@@ -324,8 +329,19 @@ class EngineFleet:
                         "all replica queues at capacity",
                         retry_after_s=min(sheds))
                 else:
+                    # total outage: brownout with an honest Retry-After
+                    # (one full probe-readmission cycle) instead of an
+                    # EngineFailureError/FleetUnavailableError storm — clients
+                    # back off and retry; requests arriving after the outage
+                    # clears succeed normally
                     self.telemetry.count("fleet_no_healthy")
-                    exc = FleetUnavailableError("no healthy replicas")
+                    self.telemetry.count("fleet_brownout")
+                    retry_after = max(1, math.ceil(
+                        self.fleet_cfg.probe_interval_s
+                        * max(1, self.fleet_cfg.probe_successes)))
+                    exc = QueueFullError(
+                        "fleet brownout: no healthy replicas (probes will "
+                        "readmit)", retry_after_s=retry_after)
                 if first:
                     raise exc    # keep the batcher's synchronous-shed contract
                 self._observe_outcome(ctx, ok=False, status="unplaceable")
@@ -460,11 +476,21 @@ class EngineFleet:
             {k: v for k, v in signals.items() if k.endswith("_burn")},
             episode=int(self.current_generation),
             total_steps=int(self.slo.total_requests))
-        out = [a.to_record() for a in trips]
-        for rec in out:
+        out = []
+        for a in trips:
+            if _chaos.ACTIVE is not None:
+                event_id = _chaos.ACTIVE.suppression_for(a.kind)
+                if event_id is not None:
+                    # expected under the armed fault plan: correlated +
+                    # counted by the injector, but it doesn't page
+                    self.log(f"[fleet] SLO anomaly {a.kind} suppressed — "
+                             f"expected under chaos event {event_id}")
+                    continue
+            rec = a.to_record()
             self.anomalies.append(rec)
             self.log(f"[fleet] SLO budget anomaly: {rec['anomaly']} "
                      f"(burn {rec['value']:.2f})")
+            out.append(rec)
         return out
 
     def _slo_exhausted(self) -> bool:
@@ -869,6 +895,7 @@ class EngineFleet:
             "fleet_attempt_timeouts": c.get("fleet_attempt_timeouts", 0.0),
             "fleet_shed": c.get("fleet_shed", 0.0),
             "fleet_no_healthy": c.get("fleet_no_healthy", 0.0),
+            "fleet_brownout": c.get("fleet_brownout", 0.0),
             "fleet_unhealthy_marks": c.get("fleet_unhealthy_marks", 0.0),
             "fleet_readmissions": c.get("fleet_readmissions", 0.0),
             "fleet_probe_failures": c.get("fleet_probe_failures", 0.0),

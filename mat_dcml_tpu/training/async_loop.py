@@ -38,7 +38,15 @@ import time
 from collections import deque
 from typing import Any, Callable, NamedTuple, Optional
 
+from mat_dcml_tpu.chaos import inject as _chaos
 from mat_dcml_tpu.telemetry import Telemetry
+
+
+class ActorDeadError(RuntimeError):
+    """The actor thread is dead (no recorded error, queue still open — the
+    silent mode a crashed C extension or injected chaos produces) and the
+    restart budget is spent.  Raised by the learner's liveness check instead
+    of blocking forever on ``TrajectoryQueue.get``."""
 
 
 class TrajectoryBlock(NamedTuple):
@@ -98,6 +106,8 @@ class TrajectoryQueue:
         """Enqueue, blocking while full.  ``False`` = closed or timed out
         (the block was NOT enqueued; a stopping producer discards it — that
         is shutdown drain, not a drop)."""
+        if _chaos.ACTIVE is not None:
+            _chaos.ACTIVE.on_queue_put()
         deadline = None if timeout is None else time.monotonic() + timeout
         with self._cv:
             while len(self._slots) >= self.capacity and not self._closed:
@@ -117,6 +127,8 @@ class TrajectoryQueue:
     def get(self, timeout: Optional[float] = None):
         """Dequeue FIFO, blocking while empty.  ``None`` = closed-and-empty
         or timed out."""
+        if _chaos.ACTIVE is not None:
+            _chaos.ACTIVE.on_queue_get()
         deadline = None if timeout is None else time.monotonic() + timeout
         with self._cv:
             while not self._slots and not self._closed:
@@ -177,6 +189,8 @@ class ParamPublisher:
     def publish(self, params) -> int:
         import jax
 
+        if _chaos.ACTIVE is not None:
+            _chaos.ACTIVE.on_param_publish()
         if self._mesh is not None:
             from mat_dcml_tpu.parallel.sharding import place_params
 
@@ -242,6 +256,8 @@ class ActorWorker(threading.Thread):
         last_version = -1
         try:
             while not self._stop_requested.is_set():
+                if _chaos.ACTIVE is not None:
+                    _chaos.ACTIVE.on_actor_iteration(self.iterations + 1)
                 # double-buffering throttle: once a completed block is already
                 # waiting, collect at most ONE more per published version.  A
                 # fast actor otherwise laps the learner and its queued blocks
@@ -285,6 +301,12 @@ class ActorWorker(threading.Thread):
                 while not placed and not self._stop_requested.is_set():
                     placed = self.queue.put(block, timeout=0.05)
         except BaseException as e:      # surface to the learner, don't die
+            if _chaos.is_silent_death(e):
+                # injected pathological mode: die WITHOUT recording the error
+                # or closing the queue — the learner's liveness check (not
+                # this handler) must notice and restart us
+                self.log(f"[async] actor thread dying silently ({e!r})")
+                return
             self.error = e
             self.log(f"[async] actor thread failed: {e!r}")
             self.queue.close()

@@ -22,6 +22,7 @@ from typing import Optional
 import jax
 import numpy as np
 
+from mat_dcml_tpu.chaos import inject as _chaos
 from mat_dcml_tpu.config import RunConfig
 from mat_dcml_tpu.telemetry import (
     AnomalyDetector,
@@ -742,7 +743,8 @@ class BaseRunner:
                         "step_time_collect": t_collect,
                         "step_time_train": t_train,
                     }
-                    trips = self.anomaly.observe(signals, episode, total_steps)
+                    trips = self.anomaly.observe(self._chaos_signals(signals),
+                                                 episode, total_steps)
                     if trips:
                         reference = self._metrics_reference(metrics)
                         self._handle_anomalies(trips, episode, total_steps, reference)
@@ -965,7 +967,8 @@ class BaseRunner:
                 }
                 if timed:
                     signals["step_time_dispatch"] = t_done - t_launch
-                trips = self.anomaly.observe(signals, ep_last, (ep_last + 1) * T * E)
+                trips = self.anomaly.observe(self._chaos_signals(signals),
+                                             ep_last, (ep_last + 1) * T * E)
                 if trips:
                     reference = self._metrics_reference(metrics, stats)
                     # the bundle targets the FIRST episode of this dispatch —
@@ -1070,6 +1073,8 @@ class BaseRunner:
             if profiling:
                 jax.profiler.start_trace(run.profile_dir)
             try:
+                if _chaos.ACTIVE is not None:
+                    _chaos.ACTIVE.on_dispatch()
                 t_launch = time.perf_counter()
                 train_state, rollout_state, key, stacked = self.watchdog.run(
                     self._dispatch, train_state, rollout_state, key
@@ -1135,6 +1140,7 @@ class BaseRunner:
         )
         from mat_dcml_tpu.parallel.mesh import build_actor_learner_meshes
         from mat_dcml_tpu.training.async_loop import (
+            ActorDeadError,
             ActorWorker,
             ParamPublisher,
             TrajectoryQueue,
@@ -1200,6 +1206,8 @@ class BaseRunner:
         first = self.start_episode
         agg_done = agg_rew = agg_delay = agg_pay = 0.0
         has_info = False
+        actor_restarts = 0
+        max_restarts = max(0, int(getattr(run, "async_actor_max_restarts", 2)))
         tel.start_interval()
         start = time.time()
         worker.start()
@@ -1226,6 +1234,30 @@ class BaseRunner:
                         raise DispatchFailedError(
                             f"actor program failed: {worker.error!r}"
                         ) from worker.error
+                    if not worker.is_alive():
+                        # liveness check: a thread that died WITHOUT recording
+                        # an error (crashed C extension, injected chaos) would
+                        # otherwise leave this loop polling an open, forever-
+                        # empty queue.  Restart from the last published params
+                        # + the dead worker's last completed rollout state, up
+                        # to the configured budget.
+                        actor_restarts += 1
+                        if actor_restarts > max_restarts:
+                            raise ActorDeadError(
+                                f"actor thread died silently "
+                                f"{actor_restarts} time(s) — restart budget "
+                                f"({max_restarts}) spent; last completed "
+                                f"iteration {worker.iterations}")
+                        self.log(f"[async] actor thread dead with no recorded "
+                                 f"error after iteration {worker.iterations}; "
+                                 f"restarting from last published params "
+                                 f"({actor_restarts}/{max_restarts})")
+                        tel.count("async_actor_restarts")
+                        worker = ActorWorker(
+                            collect_jit, publisher, queue,
+                            worker.latest_rollout_state, learner_mesh,
+                            telemetry=actor_tel, log=self.log)
+                        worker.start()
                     self._graceful_stop_check(episode, train_state,
                                               worker.latest_rollout_state,
                                               key, before_pack=quiesce)
@@ -1298,7 +1330,8 @@ class BaseRunner:
                             "step_time_collect": block.t_end - block.t_start,
                             "step_time_train": t_end - t_train,
                         }
-                        trips = self.anomaly.observe(signals, episode, total_steps)
+                        trips = self.anomaly.observe(
+                            self._chaos_signals(signals), episode, total_steps)
                         if trips:
                             reference = self._metrics_reference(metrics)
                             self._handle_anomalies(trips, episode, total_steps,
@@ -1474,10 +1507,35 @@ class BaseRunner:
                             for k, v in jax.device_get(stats).items()}
         return ref or None
 
+    def _chaos_signals(self, signals):
+        """Chaos seam: an armed injector may mutate the anomaly-signal dict
+        (nan_grad injects the *signal*, never the training math) before the
+        detector observes it."""
+        if _chaos.ACTIVE is not None:
+            return _chaos.ACTIVE.on_anomaly_signals(signals)
+        return signals
+
     def _handle_anomalies(self, anomalies, target_episode: int,
                           total_steps: int, reference=None) -> None:
         """A tripwire fired: emit the typed records, dump a repro bundle for
-        the offending dispatch, and open the bounded profiler window."""
+        the offending dispatch, and open the bounded profiler window.
+
+        Under an armed chaos injector, trips the active fault plan *expects*
+        are suppressed — counted and correlated to their chaos event id via a
+        ``suppressed`` record, but no bundle dump and no profiler trigger, so
+        injected faults don't page."""
+        if _chaos.ACTIVE is not None:
+            kept = []
+            for a in anomalies:
+                event_id = _chaos.ACTIVE.suppression_for(a.kind)
+                if event_id is not None:
+                    self.log(f"[anomaly] {a.kind} suppressed — expected "
+                             f"under chaos event {event_id}")
+                    continue
+                kept.append(a)
+            anomalies = kept
+            if not anomalies:
+                return
         for a in anomalies:
             self.log(f"[anomaly] {a.kind}: {a.signal}={a.value!r} "
                      f"baseline={a.baseline} at episode {a.episode}")

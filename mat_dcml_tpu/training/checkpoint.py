@@ -35,17 +35,20 @@ from __future__ import annotations
 
 import dataclasses
 import json
+import random
 import shutil
 import time
 import zlib
 from pathlib import Path
-from typing import Any, Dict, Optional, Tuple
+from typing import Any, Callable, Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 import orbax.checkpoint as ocp
 
+from mat_dcml_tpu.chaos import inject as _chaos
 from mat_dcml_tpu.models.mat import MATConfig
+from mat_dcml_tpu.training.resilience import backoff_delay
 
 POLICY_MANIFEST = "policy_manifest.json"
 _PARAMS_SUBDIR = "params"
@@ -76,16 +79,59 @@ def _crc32_file(path: Path, chunk: int = 1 << 20) -> int:
             crc = zlib.crc32(block, crc)
 
 
+class CheckpointIOError(RuntimeError):
+    """Checkpoint IO kept failing after the retry budget — the *persistent*
+    failure the crash path is for.  Transient hiccups (NFS blips, preempted
+    filers) are retried with jittered backoff and never surface."""
+
+
 class CheckpointManager:
     def __init__(self, directory: str | Path, max_to_keep: int = 5,
-                 telemetry=None, log=print):
+                 telemetry=None, log=print, io_retries: int = 3,
+                 io_backoff_base_ms: float = 50.0,
+                 sleep: Callable[[float], None] = time.sleep,
+                 rand: Callable[[], float] = random.random):
         self.directory = Path(directory).absolute()
         self.directory.mkdir(parents=True, exist_ok=True)
         self.telemetry = telemetry
         self.log = log
+        self.io_retries = int(io_retries)
+        self.io_backoff_base_ms = float(io_backoff_base_ms)
+        self._sleep = sleep
+        self._rand = rand
         self._pending_integrity: list[int] = []
         self.manager = self._make_manager(max_to_keep)
         self._max_to_keep = max_to_keep
+
+    def _io_retry(self, op_name: str, fn: Callable[[], Any]) -> Any:
+        """Run one checkpoint IO op under the shared jittered-backoff policy.
+
+        ``OSError`` (the transient class: NFS blips, EIO, injected chaos) is
+        retried ``io_retries`` times; exhaustion raises the typed
+        :class:`CheckpointIOError` so callers see "storage is actually down",
+        not a stack of socket errors.  Anything non-OSError propagates
+        untouched — programming errors must not burn the retry budget."""
+        attempt = 0
+        while True:
+            try:
+                if _chaos.ACTIVE is not None:
+                    _chaos.ACTIVE.on_checkpoint_io(op_name)
+                return fn()
+            except OSError as e:
+                attempt += 1
+                if attempt > self.io_retries:
+                    if self.telemetry is not None:
+                        self.telemetry.count("resilience_checkpoint_io_failures")
+                    raise CheckpointIOError(
+                        f"checkpoint {op_name} failed {attempt} times "
+                        f"(last: {e!r})") from e
+                if self.telemetry is not None:
+                    self.telemetry.count("resilience_checkpoint_io_retries")
+                delay = backoff_delay(attempt, self.io_backoff_base_ms,
+                                      rand=self._rand)
+                self.log(f"[checkpoint] {op_name} attempt {attempt} failed "
+                         f"({e!r}); retrying in {delay * 1e3:.0f}ms")
+                self._sleep(delay)
 
     def _make_manager(self, max_to_keep: int) -> ocp.CheckpointManager:
         return ocp.CheckpointManager(
@@ -103,7 +149,8 @@ class CheckpointManager:
         restores the old synchronous behavior (used right before reads).
         """
         self._finish_and_flush()             # finalize any in-flight save
-        self.manager.save(step, args=ocp.args.StandardSave(train_state))
+        self._io_retry("save", lambda: self.manager.save(
+            step, args=ocp.args.StandardSave(train_state)))
         self._pending_integrity.append(int(step))
         if blocking:
             self._finish_and_flush()
@@ -116,8 +163,8 @@ class CheckpointManager:
         # args= always: a bare manager.restore(step) raises KeyError("default")
         # under orbax's registry dispatch when the save went through
         # StandardSave; an empty StandardRestore means "no template"
-        restored = self.manager.restore(
-            step, args=ocp.args.StandardRestore(template))
+        restored = self._io_retry("restore", lambda: self.manager.restore(
+            step, args=ocp.args.StandardRestore(template)))
         return _commit_to_device(restored)
 
     def latest_step(self) -> Optional[int]:
@@ -141,9 +188,13 @@ class CheckpointManager:
         step that just became durable.  The manifest MUST trail the orbax
         finalize — hashing a step that's still being written would bless
         torn bytes."""
-        self.manager.wait_until_finished()
+        self._io_retry("flush", self.manager.wait_until_finished)
         for step in self._pending_integrity:
             self._write_integrity(step)
+            # chaos seam: a finished-and-attested step is what bit-rot
+            # injection targets (CRC verification must catch it on restore)
+            if _chaos.ACTIVE is not None:
+                _chaos.ACTIVE.on_checkpoint_saved(self._step_dir(step))
         self._pending_integrity.clear()
 
     def _step_dir(self, step: int) -> Path:
@@ -240,9 +291,11 @@ class CheckpointManager:
                 self.log(f"[checkpoint] step {step} has no integrity manifest "
                          f"({reason}); restoring unverified")
             try:
-                # args= always — see restore()
-                state = self.manager.restore(
-                    step, args=ocp.args.StandardRestore(template))
+                # args= always — see restore(); transient IO retries first,
+                # so only persistent/corrupt steps reach quarantine
+                state = self._io_retry(
+                    "restore", lambda: self.manager.restore(
+                        step, args=ocp.args.StandardRestore(template)))
             except Exception as e:
                 self.quarantine_step(step, f"unreadable: {e!r}")
                 continue

@@ -61,6 +61,15 @@ _MANIFEST = "manifest.json"
 _STATE = "state.pkl"
 
 
+def backoff_delay(attempt: int, base_ms: float,
+                  rand: Callable[[], float] = random.random) -> float:
+    """Bounded jittered exponential backoff, in seconds: the one policy the
+    watchdog, fleet retries, checkpoint IO, and the relaunch supervisor all
+    share — ``base * 2^(attempt-1) * (0.5 + rand())``, attempt counting
+    from 1."""
+    return (base_ms / 1e3) * (2 ** (attempt - 1)) * (0.5 + rand())
+
+
 class PreemptedExit(SystemExit):
     """Raised by the runner after a graceful-stop emergency checkpoint; the
     process exits ``EXIT_PREEMPTED`` so supervisors can tell preemption from
@@ -420,8 +429,8 @@ class DispatchWatchdog:
                     f"dispatch failed {attempt} times (last: {err!r})"
                 ) from err
             self._count("resilience_dispatch_retries")
-            base = self.cfg.backoff_base_ms / 1e3
-            delay = base * (2 ** (attempt - 1)) * (0.5 + self._rand())
+            delay = backoff_delay(attempt, self.cfg.backoff_base_ms,
+                                  rand=self._rand)
             self.log(f"[resilience] dispatch attempt {attempt} failed "
                      f"({err!r}); retrying from the episode "
                      f"{self._snap['episode']} snapshot in {delay * 1e3:.0f}ms")

@@ -1,0 +1,508 @@
+#!/usr/bin/env python
+"""One-command chaos soak: run a FaultPlan against the whole stack, check
+invariants, emit a pass/fail ``chaos_report.json``.
+
+Boots up to three legs, partitioned by the plan's fault planes:
+
+* **serving** — an in-process 2-replica ``EngineFleet`` (tiny MAT config, the
+  test-suite buckets so the persistent compile cache hits) under paced
+  ``loadgen`` slices, with the serving-plane events armed in this process.
+  ``load_spike`` events multiply the offered load; after the last fault
+  clears the leg keeps serving until every ``slo_*_burn`` gauge is back
+  under 1.0.
+* **train_sync** — a real trainer subprocess (``tests/chaos_worker.py``) with
+  the sync-plane events armed inside it.  ``trainer_kill`` events are
+  delivered by THIS process as genuine SIGTERMs after the scheduled number
+  of episode lines; the worker must exit 75, relaunch with ``--resume auto``,
+  and finish.  A disarmed, uninterrupted golden twin runs the same seed and
+  the two final checkpoints must match bit-for-bit.
+* **train_async** — the overlapped actor-learner loop on 2 host devices with
+  the async-plane events (silent actor death, publish delays) armed inside
+  it; the learner's liveness check must restart the actor and complete.
+
+The expanded schedule is saved to ``<out>/chaos_events.json`` — it is both
+the reproducibility artifact (a pure function of plan JSON + seed) and the
+plan file the trainer subprocesses arm.  ``--repro-check`` (default on)
+additionally replays the injection decision engine twice against a scripted
+deterministic hook stream and requires the two event logs to be deep-equal.
+
+Usage:
+    python scripts/chaos_soak.py --plan tests/data/plans/smoke.json \
+        --out results/chaos_smoke --duration 30
+
+Exit 0 iff every invariant is green, every leg met its exit-code contract,
+the metrics streams validate against scripts/check_metrics_schema.py, and
+the reproducibility check holds.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+from pathlib import Path
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+sys.path.insert(0, str(REPO / "scripts"))
+
+from mat_dcml_tpu.chaos import (  # noqa: E402
+    FaultInjector,
+    FaultPlan,
+    arm,
+    check_invariants,
+    disarm,
+)
+from mat_dcml_tpu.chaos.inject import jsonl_sink  # noqa: E402
+from mat_dcml_tpu.chaos.invariants import all_green  # noqa: E402
+
+_WORKER = REPO / "tests" / "chaos_worker.py"
+
+
+def log(*a):
+    print(*a, flush=True)
+
+
+# ------------------------------------------------------------- repro check
+
+
+def _replay_records(plan: FaultPlan) -> list:
+    """Drive the injection decision engine with a scripted, fake-clock hook
+    stream (every event's own kind/target claimed at 10 Hz, plus the load
+    loop) and return the full record log.  No sleeps, no raises — the claims
+    themselves exercise windows, budgets, skips, and suppression, so two
+    replays of the same expanded plan must produce deep-equal logs."""
+    clock = {"t": 0.0}
+    inj = FaultInjector(plan, time_fn=lambda: clock["t"],
+                        log=lambda *a: None)
+    inj.start()
+    steps = int(plan.horizon_s() * 10) + 40
+    for i in range(steps):
+        clock["t"] = i * 0.1
+        inj.poll()
+        inj.load_multiplier()
+        for ev in plan.events:
+            if ev.kind == "load_spike":
+                continue
+            inj._claim(ev.kind, ev.target, call_index=i)
+        for kind in ("slo_latency_budget", "nonfinite_grads",
+                     "staleness_budget", "step_time_collect"):
+            inj.suppression_for(kind)
+    inj.finish()
+    return inj.records()
+
+
+def repro_check(plan_path: Path, seed) -> dict:
+    """(plan JSON, seed) -> schedule and injection log must be reproducible:
+    two independent expansions deep-equal, two scripted replays deep-equal."""
+    a = FaultPlan.from_json(plan_path).expand(seed)
+    b = FaultPlan.from_json(plan_path).expand(seed)
+    expanded_equal = a.to_dict() == b.to_dict()
+    replay_a, replay_b = _replay_records(a), _replay_records(b)
+    return {
+        "expanded_equal": expanded_equal,
+        "replay_equal": replay_a == replay_b,
+        "replay_events": len(replay_a),
+        "ok": expanded_equal and replay_a == replay_b,
+    }
+
+
+# ------------------------------------------------------------- serving leg
+
+
+def run_serving_leg(plan: FaultPlan, out: Path, duration_s: float) -> dict:
+    import jax
+
+    from mat_dcml_tpu.models.mat import MATConfig
+    from mat_dcml_tpu.models.policy import TransformerPolicy
+    from mat_dcml_tpu.serving.batcher import BatcherConfig
+    from mat_dcml_tpu.serving.engine import EngineConfig
+    from mat_dcml_tpu.serving.fleet import EngineFleet, FleetConfig
+    from mat_dcml_tpu.serving.loadgen import run_load
+    from mat_dcml_tpu.serving.rollout_ctl import RolloutConfig
+    from mat_dcml_tpu.serving.server import PolicyClient
+    from mat_dcml_tpu.telemetry.slo import SLOConfig, SLOMonitor
+    from mat_dcml_tpu.utils.metrics import MetricsWriter
+
+    cfg = MATConfig(n_agent=3, obs_dim=4, state_dim=5, action_dim=3,
+                    n_block=1, n_embd=16, n_head=2)
+    params = TransformerPolicy(cfg).init_params(jax.random.key(0))
+    fleet = EngineFleet(
+        params, cfg,
+        fleet_cfg=FleetConfig(n_replicas=2, probe_interval_s=0.1),
+        engine_cfg=EngineConfig(buckets=(2, 4)),
+        batcher_cfg=BatcherConfig(max_batch_wait_ms=2.0),
+        rollout_cfg=RolloutConfig(canary_comparisons=6, canary_timeout_s=60.0),
+        slo_monitor=SLOMonitor(SLOConfig(latency_p99_ms=250.0)),
+        log_fn=lambda *a: None,
+    )
+    log("[soak] warming 2-replica fleet ...")
+    fleet.warmup()
+    sub = plan.filter(planes=("serving",))
+    injector = FaultInjector(sub, telemetry=fleet.telemetry,
+                             record_sink=jsonl_sink(out / "metrics.jsonl"),
+                             log=log)
+    writer = MetricsWriter(out)
+    client = PolicyClient(fleet)
+    leg = {"slices": 0, "errors": []}
+    slices = []
+
+    def slice_record(i: int, n: int) -> dict:
+        rec = run_load(client, n_requests=n, concurrency=4,
+                       seed=100 + i, slo_ms=250.0)
+        fleet.check_slo()
+        rec.update(fleet.fleet_record())
+        rec["steady_state_recompiles"] = fleet.steady_state_recompiles()
+        rec.update({k: v for k, v in fleet.telemetry.counters.items()
+                    if k.startswith("chaos_")})
+        writer.write(rec)
+        slices.append(rec)
+        return rec
+
+    try:
+        arm(injector)
+        injector.start()
+        horizon = max(float(duration_s), sub.horizon_s() + 1.0)
+        log(f"[soak] serving leg: {len(sub.events)} event(s) over "
+            f"{horizon:.0f}s")
+        t_end = time.monotonic() + horizon
+        i = 0
+        while time.monotonic() < t_end:
+            injector.poll()
+            n = max(8, int(round(16 * injector.load_multiplier())))
+            slice_record(i, n)
+            i += 1
+        injector.poll()
+        # recovery tail: all faults cleared; serve until burns are cold
+        deadline = time.monotonic() + 60.0
+        while time.monotonic() < deadline:
+            rec = slice_record(i, 16)
+            i += 1
+            burns = {k: v for k, v in rec.items() if k.endswith("_burn")}
+            if burns and all(v < 1.0 for v in burns.values()):
+                break
+        else:
+            leg["errors"].append("slo burn never recovered below 1.0")
+    except Exception as e:  # noqa: BLE001 — leg failure goes in the report
+        leg["errors"].append(f"serving leg crashed: {e!r}")
+    finally:
+        disarm()
+        writer.close()
+        fleet.close()
+    leg["slices"] = len(slices)
+    leg["fired"] = injector.fired_sequence()
+    leg["ok"] = not leg["errors"]
+    return {"leg": leg, "records": slices + injector.records()}
+
+
+# ------------------------------------------------------------ trainer legs
+
+
+def _worker_cmd(run_dir: Path, episodes: int, plan_path: Path, planes: str,
+                extra=()) -> list:
+    return [sys.executable, str(_WORKER), "--run_dir", str(run_dir),
+            "--episodes", str(episodes), "--save_interval", "1",
+            "--tripwires", "1", "--chaos_plan", str(plan_path),
+            "--chaos_planes", planes, *map(str, extra)]
+
+
+def _worker_env() -> dict:
+    env = dict(os.environ)
+    env.setdefault("MAT_DCML_TPU_TEST_CACHE",
+                   str(REPO / "tests" / ".jax_cache"))
+    return env
+
+
+def _run_to_completion(cmd: list, timeout: float = 900.0):
+    proc = subprocess.run(cmd, stdout=subprocess.PIPE,
+                          stderr=subprocess.STDOUT, text=True,
+                          cwd=str(REPO), env=_worker_env(), timeout=timeout)
+    return proc.returncode, proc.stdout
+
+
+def _kill_after_episodes(cmd: list, after: int, timeout: float = 900.0):
+    """Run ``cmd``, SIGTERM it once ``after`` episode lines have printed, and
+    return (rc, output) — the graceful-preemption injection."""
+    proc = subprocess.Popen(cmd, stdout=subprocess.PIPE,
+                            stderr=subprocess.STDOUT, text=True,
+                            cwd=str(REPO), env=_worker_env())
+    lines: list = []
+
+    def pump():
+        for line in proc.stdout:
+            lines.append(line)
+
+    t = threading.Thread(target=pump, daemon=True)
+    t.start()
+    deadline = time.time() + timeout
+    try:
+        while time.time() < deadline:
+            # strict episode-line match: telemetry lines like
+            # "flops/env-step 9.2e+04" also contain "ep "
+            if sum(ln.startswith("ep ") for ln in lines) >= after:
+                break
+            if proc.poll() is not None:
+                break
+            time.sleep(0.05)
+        else:
+            proc.kill()
+            return -9, "".join(lines) + "\n[soak] kill-wait timed out"
+        if proc.poll() is None:
+            proc.send_signal(signal.SIGTERM)
+        rc = proc.wait(timeout=120)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+    t.join(timeout=5)
+    return rc, "".join(lines)
+
+
+def _final_states_equal(dir_a: Path, dir_b: Path):
+    import jax
+    import numpy as np
+
+    from mat_dcml_tpu.training.checkpoint import CheckpointManager
+
+    def models(d):
+        hits = sorted(Path(d).rglob("models"))
+        return hits[0] if hits else None
+
+    ma, mb = models(dir_a), models(dir_b)
+    if ma is None or mb is None:
+        return False, "missing models dir"
+    step_a, state_a = CheckpointManager(
+        ma, log=lambda *a: None).restore_latest_valid()
+    step_b, state_b = CheckpointManager(
+        mb, log=lambda *a: None).restore_latest_valid()
+    if step_a is None or step_a != step_b:
+        return False, f"final steps differ: {step_a} vs {step_b}"
+    la, lb = jax.tree.leaves(state_a), jax.tree.leaves(state_b)
+    if len(la) != len(lb):
+        return False, "leaf count differs"
+    for x, y in zip(la, lb):
+        if not np.array_equal(np.asarray(x), np.asarray(y)):
+            return False, f"leaf mismatch at step {step_a}"
+    return True, f"bit-exact at step {step_a}"
+
+
+def run_sync_leg(plan: FaultPlan, events_path: Path, out: Path,
+                 episodes: int) -> dict:
+    kills = [ev for ev in plan.events if ev.kind == "trainer_kill"]
+    wdir, gdir = out / "train_sync", out / "train_sync_golden"
+    if kills:
+        # the loop polls the stop flag only at dispatch boundaries (K=2
+        # episodes each): leave >= 2 boundaries after the kill point or a
+        # SIGTERM past the last check runs to completion instead of exit 75
+        after = int(kills[0].params.get("after_episodes", 2))
+        episodes = max(episodes, 2 * after + 6)
+    cmd = _worker_cmd(wdir, episodes, events_path, "train_sync")
+    leg = {"kill": bool(kills), "errors": []}
+    try:
+        if kills:
+            log(f"[soak] sync leg: SIGTERM after {after} episode lines, "
+                f"then resume to {episodes}")
+            rc, outp = _kill_after_episodes(cmd, after)
+            leg["kill_rc"] = rc
+            if rc != 75:
+                leg["errors"].append(
+                    f"expected exit 75 after SIGTERM, got {rc}:\n{outp[-2000:]}")
+            # fault budgets are per-process: the relaunch must not re-fire
+            # checkpoint_corrupt, or it corrupts the final save — the very
+            # artifact the bit-exact invariant compares (the first launch
+            # already exercised corrupt + quarantine)
+            rc2, outp2 = _run_to_completion(
+                cmd + ["--chaos_skip_kinds", "checkpoint_corrupt"])
+            leg["resume_rc"] = rc2
+            if rc2 != 0 or "DONE" not in outp2:
+                leg["errors"].append(
+                    f"resume run failed (rc={rc2}):\n{outp2[-2000:]}")
+        else:
+            log(f"[soak] sync leg: {episodes} episodes under armed faults")
+            rc, outp = _run_to_completion(cmd)
+            leg["rc"] = rc
+            if rc != 0 or "DONE" not in outp:
+                leg["errors"].append(
+                    f"armed run failed (rc={rc}):\n{outp[-2000:]}")
+        # uninterrupted, disarmed golden twin — same seed, same episodes
+        log("[soak] sync leg: running disarmed golden twin")
+        rcg, outg = _run_to_completion(
+            [sys.executable, str(_WORKER), "--run_dir", str(gdir),
+             "--episodes", str(episodes), "--save_interval", "1"])
+        if rcg != 0:
+            leg["errors"].append(f"golden twin failed (rc={rcg}):"
+                                 f"\n{outg[-2000:]}")
+            leg["bit_exact_resume"] = False
+        else:
+            ok, detail = _final_states_equal(wdir, gdir)
+            leg["bit_exact_resume"] = ok
+            leg["bit_exact_detail"] = detail
+            if not ok:
+                leg["errors"].append(f"bit-exact compare failed: {detail}")
+    except Exception as e:  # noqa: BLE001
+        leg["errors"].append(f"sync leg crashed: {e!r}")
+        leg.setdefault("bit_exact_resume", False)
+    leg["ok"] = not leg["errors"]
+    return {"leg": leg, "run_dir": wdir}
+
+
+def run_async_leg(events_path: Path, out: Path, episodes: int) -> dict:
+    wdir = out / "train_async"
+    cmd = _worker_cmd(wdir, episodes, events_path, "train_async",
+                      extra=("--async_actors", 1, "--devices", 2))
+    leg = {"errors": []}
+    try:
+        log(f"[soak] async leg: {episodes} episodes, 2 devices, armed faults")
+        rc, outp = _run_to_completion(cmd)
+        leg["rc"] = rc
+        if rc != 0 or "DONE" not in outp:
+            leg["errors"].append(f"async run failed (rc={rc}):"
+                                 f"\n{outp[-2000:]}")
+    except Exception as e:  # noqa: BLE001
+        leg["errors"].append(f"async leg crashed: {e!r}")
+    leg["ok"] = not leg["errors"]
+    return {"leg": leg, "run_dir": wdir}
+
+
+# ---------------------------------------------------------------- assembly
+
+
+def _read_run_records(run_dir: Path) -> list:
+    from obs_report import read_jsonl, with_rotated
+
+    records = []
+    for name in ("metrics.jsonl", "chaos_records.jsonl"):
+        for path in sorted(Path(run_dir).rglob(name)):
+            records += read_jsonl(with_rotated(path))
+    return records
+
+
+def _validate_streams(out: Path, run_dirs: list) -> list:
+    from check_metrics_schema import validate_file
+
+    errs = []
+    seen = set()
+    for root in [out, *run_dirs]:
+        for name in ("metrics.jsonl", "chaos_records.jsonl"):
+            for path in sorted(Path(root).rglob(name)):
+                if path in seen:
+                    continue
+                seen.add(path)
+                errs += [f"{path.name}: {e}" for e in validate_file(path)]
+    return errs
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    p.add_argument("--plan", required=True, help="fault plan JSON")
+    p.add_argument("--out", default="results/chaos_soak")
+    p.add_argument("--seed", type=int, default=None,
+                   help="override the plan's seed")
+    p.add_argument("--duration", type=float, default=30.0,
+                   help="serving-leg length, seconds (extended to cover the "
+                        "plan horizon)")
+    p.add_argument("--train-episodes", type=int, default=6)
+    p.add_argument("--async-episodes", type=int, default=4)
+    p.add_argument("--only", default=None,
+                   help="csv of planes to run (default: every plane the plan "
+                        "names)")
+    p.add_argument("--no-repro-check", action="store_true")
+    args = p.parse_args(argv)
+
+    out = Path(args.out)
+    out.mkdir(parents=True, exist_ok=True)
+    plan_path = Path(args.plan)
+    plan = FaultPlan.from_json(plan_path).expand(args.seed)
+    planes = set(plan.planes())
+    if args.only:
+        planes &= set(args.only.split(","))
+    events_path = out / "chaos_events.json"
+    plan.save(events_path)
+    log(f"[soak] plan '{plan.name}' seed={plan.seed}: "
+        f"{len(plan.events)} event(s), kinds={', '.join(plan.kinds())}, "
+        f"planes={', '.join(sorted(planes))}")
+
+    repro = {"ok": True, "skipped": True}
+    if not args.no_repro_check:
+        repro = repro_check(plan_path, args.seed)
+        log(f"[soak] repro check: expanded_equal="
+            f"{repro['expanded_equal']} replay_equal={repro['replay_equal']} "
+            f"({repro['replay_events']} replay events)")
+
+    legs: dict = {}
+    records: list = []
+    run_dirs: list = []
+    facts = {
+        "expect_serving": "serving" in planes,
+        "expect_async": "train_async" in planes,
+        "expect_kill": ("train_sync" in planes
+                        and "trainer_kill" in plan.kinds()),
+    }
+
+    if "train_sync" in planes:
+        res = run_sync_leg(plan, events_path, out, args.train_episodes)
+        legs["train_sync"] = res["leg"]
+        facts["bit_exact_resume"] = res["leg"].get("bit_exact_resume")
+        records += _read_run_records(res["run_dir"])
+        run_dirs.append(res["run_dir"])
+    if "train_async" in planes:
+        res = run_async_leg(events_path, out, args.async_episodes)
+        legs["train_async"] = res["leg"]
+        records += _read_run_records(res["run_dir"])
+        run_dirs.append(res["run_dir"])
+    if "serving" in planes:
+        res = run_serving_leg(plan, out, args.duration)
+        legs["serving"] = res["leg"]
+        records += res["records"]
+
+    invariants = check_invariants(records, facts)
+    for r in invariants:
+        log(f"[soak] invariant {r.name:<24} "
+            f"{'SKIP' if r.skipped else 'ok' if r.ok else 'FAIL'}  {r.detail}")
+
+    schema_errors = _validate_streams(out, run_dirs)
+    for e in schema_errors[:20]:
+        log(f"[soak] schema: {e}")
+
+    legs_ok = all(leg.get("ok") for leg in legs.values()) if legs else False
+    passed = (all_green(invariants) and legs_ok and not schema_errors
+              and repro["ok"])
+    report = {
+        "plan": plan.name,
+        "seed": plan.seed,
+        "planes": sorted(planes),
+        "kinds": list(plan.kinds()),
+        "events": [ev.to_dict() for ev in plan.events],
+        "legs": legs,
+        "invariants": [r.to_dict() for r in invariants],
+        "all_green": all_green(invariants),
+        "schema_errors": schema_errors,
+        "repro": repro,
+        "pass": passed,
+    }
+    with open(out / "chaos_report.json", "w") as f:
+        json.dump(report, f, indent=2, sort_keys=True)
+        f.write("\n")
+
+    # human-readable panels over the merged streams, next to the verdict
+    from obs_report import build_report
+
+    traces = [r for r in records if "trace" in r]
+    metrics = [r for r in records if "trace" not in r]
+    text = build_report(metrics, traces)
+    (out / "report.txt").write_text(text)
+    log(text)
+    log(f"[soak] {'PASS' if passed else 'FAIL'} -> {out / 'chaos_report.json'}")
+    return 0 if passed else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

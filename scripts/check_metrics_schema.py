@@ -144,6 +144,10 @@ KNOWN_PREFIXES = (
     # PPO): per-block lag histogram (staleness_learner_steps_*) and the
     # learner's current published version (staleness_param_version)
     "staleness_",
+    # chaos fault injection (mat_dcml_tpu/chaos/): armed/fired/injected event
+    # counters, the expected-anomaly suppression counter, and the armed flag
+    # gauge — plus the typed {"chaos": ...} event records validated separately
+    "chaos_",
 )
 
 # registry suffixes a histogram sketch appends on flush (registry.py
@@ -166,7 +170,7 @@ STRICT_FAMILY_PATTERNS = {
     "fleet_": re.compile(
         r"^fleet_(replicas|healthy|requests|retries|retries_exhausted"
         r"|attempt_timeouts|shed|no_healthy|unhealthy_marks|readmissions"
-        r"|probe_failures|generation|stress"
+        r"|probe_failures|generation|stress|brownout"
         r"|replica_\d+_(state|outstanding|generation|recompiles|served"
         r"|degraded_ok|degraded_failed))$"),
     "rollout_": re.compile(
@@ -186,7 +190,8 @@ STRICT_FAMILY_PATTERNS = {
     "resilience_": re.compile(
         r"^resilience_(snapshots|emergency_saves|quarantined_steps"
         r"|deadline_overruns|dispatch_failures|dispatch_retries"
-        r"|stop_latency_s)$"),
+        r"|stop_latency_s|checkpoint_io_retries|checkpoint_io_failures"
+        r"|supervisor_exit_76|supervisor_launches|supervisor_last_exit)$"),
     "slo_": re.compile(
         r"^slo_((latency|error|goodput)_burn(_fast|_slow)?"
         r"|window_requests)$"),
@@ -201,6 +206,9 @@ STRICT_FAMILY_PATTERNS = {
     "staleness_": re.compile(
         r"^staleness_(param_version"
         r"|learner_steps(_p50|_p95|_p99|_count|_mean))$"),
+    "chaos_": re.compile(
+        r"^chaos_(events_armed|events_fired|injected_faults"
+        r"|suppressed_anomalies|active)$"),
 }
 
 # fields that must never go negative (counters, rates, timers, gauges)
@@ -419,6 +427,44 @@ def _validate_emergency(record, where: str) -> List[str]:
     return errs
 
 
+# chaos fault-injection event records (mat_dcml_tpu/chaos/inject.py): the
+# "chaos" marker field carries the lifecycle stage (fired / suppressed /
+# cleared) as a string; event_id / kind / target / suppressed_kind are
+# strings, at_s / t_s / duration_s the numeric payload.
+CHAOS_FIELDS = ("chaos", "event_id", "kind", "target", "at_s", "t_s",
+                "duration_s", "suppressed_kind")
+_CHAOS_REQUIRED = ("chaos", "event_id", "kind")
+_CHAOS_STAGES = ("fired", "suppressed", "cleared")
+
+
+def _validate_chaos(record, where: str) -> List[str]:
+    errs: List[str] = []
+    for k in _CHAOS_REQUIRED:
+        if k not in record:
+            errs.append(f"{where}: chaos record missing {k!r}")
+    v = record.get("chaos")
+    if v is not None and v not in _CHAOS_STAGES:
+        errs.append(f"{where}: chaos field 'chaos' must be one of "
+                    f"{_CHAOS_STAGES}, got {v!r}")
+    for k in ("event_id", "kind", "target", "suppressed_kind"):
+        v = record.get(k)
+        if v is not None and not isinstance(v, str):
+            errs.append(f"{where}: chaos field {k!r} must be a string")
+    for k in ("at_s", "t_s", "duration_s"):
+        v = record.get(k)
+        if v is None:
+            continue
+        if isinstance(v, bool) or not isinstance(v, (int, float)):
+            errs.append(f"{where}: chaos field {k!r} is not numeric")
+        elif not math.isfinite(v) or v < 0:
+            errs.append(f"{where}: chaos field {k!r} must be finite and "
+                        f"non-negative, got {v}")
+    for k in record:
+        if k not in CHAOS_FIELDS:
+            errs.append(f"{where}: unexpected field {k!r} in chaos record")
+    return errs
+
+
 def validate_record(record, index: int = 0, strict_names: bool = True,
                     strict: bool = False) -> List[str]:
     """Errors for one parsed jsonl record (empty list = valid)."""
@@ -435,6 +481,9 @@ def validate_record(record, index: int = 0, strict_names: bool = True,
     if "trace" in record:
         # span record (trace.jsonl; may interleave in mixed fixtures) — ditto
         return _validate_trace(record, where)
+    if "chaos" in record:
+        # chaos fault-injection event record — ditto
+        return _validate_chaos(record, where)
     for k, v in record.items():
         if isinstance(v, bool):
             errs.append(f"{where}: field {k!r} is a boolean (flags must not "
@@ -450,7 +499,7 @@ def validate_record(record, index: int = 0, strict_names: bool = True,
                 or k.startswith(("serving_", "fleet_", "rollout_", "shard_",
                                  "resilience_", "slo_",
                                  "decode_cache_", "async_",
-                                 "staleness_"))) and v < 0:
+                                 "staleness_", "chaos_"))) and v < 0:
             errs.append(f"{where}: field {k!r} is negative ({v})")
         if k in UNIT_INTERVAL and not (0.0 <= v <= 1.0):
             errs.append(f"{where}: field {k!r} must be in [0, 1], got {v}")

@@ -15,11 +15,19 @@ Semantics (driven by the training side's exit codes, training/resilience.py):
                  child relaunches after ``--preempt-delay`` seconds.  With
                  ``--resume auto`` the relaunch restores the emergency carry
                  and continues bit-exact.
+- exit 76     -> the dispatch watchdog exhausted its retries (an emergency
+                 checkpoint was written on the way out).  Tracked on its OWN
+                 budget (``--max-watchdog-relaunches``) and counter
+                 (``resilience_supervisor_exit_76``): watchdog exhaustion
+                 usually means a sick device/filer that a relaunch onto fresh
+                 state often clears, but it must not silently consume the
+                 generic crash budget — the two failure modes page
+                 differently.
 - anything else -> a crash.  Relaunch with jittered exponential backoff
                  (base * 2^(crashes-1), capped at ``--backoff-max``) up to
                  ``--max-relaunches`` consecutive crashes, then give up and
                  exit with the child's last code.  A clean preemption or a
-                 normal exit resets the counter.
+                 normal exit resets both counters.
 
 SIGTERM/SIGINT to the supervisor forward to the child (which takes its
 emergency checkpoint) and the supervisor exits with the child's code — so
@@ -38,7 +46,11 @@ from pathlib import Path
 
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
-from mat_dcml_tpu.training.resilience import EXIT_PREEMPTED  # noqa: E402
+from mat_dcml_tpu.training.resilience import (  # noqa: E402
+    EXIT_PREEMPTED,
+    EXIT_WATCHDOG,
+    backoff_delay,
+)
 
 
 def main(argv=None) -> int:
@@ -52,8 +64,15 @@ def main(argv=None) -> int:
                         help="crash backoff base, seconds")
     parser.add_argument("--backoff-max", type=float, default=300.0,
                         help="crash backoff ceiling, seconds")
+    parser.add_argument("--max-watchdog-relaunches", type=int, default=3,
+                        help="consecutive watchdog-exhaustion (exit 76) "
+                             "relaunches before giving up — a separate budget "
+                             "from generic crashes")
     parser.add_argument("--preempt-delay", type=float, default=1.0,
                         help="relaunch delay after a clean preemption, seconds")
+    parser.add_argument("--metrics-file", default=None,
+                        help="append supervisor counters as a jsonl record "
+                             "here on exit (schema family resilience_)")
     parser.add_argument("cmd", nargs=argparse.REMAINDER,
                         help="training command line (prefix with --)")
     args = parser.parse_args(argv)
@@ -76,7 +95,27 @@ def main(argv=None) -> int:
     signal.signal(signal.SIGINT, forward)
 
     crashes = 0
+    watchdog_exits = 0
+    watchdog_exits_total = 0
     launches = 0
+
+    def write_metrics(last_rc: int) -> None:
+        if args.metrics_file is None:
+            return
+        import json
+
+        path = Path(args.metrics_file)
+        path.parent.mkdir(parents=True, exist_ok=True)
+        with open(path, "a") as f:
+            f.write(json.dumps({
+                "resilience_supervisor_exit_76": watchdog_exits_total,
+                "resilience_supervisor_launches": launches,
+                # signal deaths (wait() returns -N) encode shell-style as 128+N
+                # so the resilience_ family stays non-negative
+                "resilience_supervisor_last_exit":
+                    last_rc if last_rc >= 0 else 128 - last_rc,
+            }) + "\n")
+
     while True:
         launches += 1
         print(f"[supervisor] launch {launches}: {' '.join(cmd)}", flush=True)
@@ -85,20 +124,44 @@ def main(argv=None) -> int:
         if forwarded["sig"] is not None:
             # our own stop was forwarded; the child already checkpointed
             print(f"[supervisor] stop forwarded; child exited {rc}", flush=True)
+            write_metrics(rc)
             return rc
         if rc == 0:
             print("[supervisor] run complete", flush=True)
+            write_metrics(rc)
             return 0
         if rc == EXIT_PREEMPTED:
             crashes = 0
+            watchdog_exits = 0
             print(f"[supervisor] child preempted (exit {rc}); relaunching in "
                   f"{args.preempt_delay:.1f}s", flush=True)
             time.sleep(args.preempt_delay)
+            continue
+        if rc == EXIT_WATCHDOG:
+            # watchdog exhaustion: its own consecutive budget + counter, NOT
+            # a generic crash (it already emergency-checkpointed; a relaunch
+            # resumes and retries on fresh program state)
+            watchdog_exits += 1
+            watchdog_exits_total += 1
+            print(f"[supervisor] resilience_supervisor_exit_76="
+                  f"{watchdog_exits_total}", flush=True)
+            if watchdog_exits > args.max_watchdog_relaunches:
+                print(f"[supervisor] {watchdog_exits} consecutive watchdog "
+                      f"exhaustions (exit {rc}); giving up", flush=True)
+                write_metrics(rc)
+                return rc
+            delay = min(args.backoff_max,
+                        backoff_delay(watchdog_exits, args.backoff_base * 1e3))
+            print(f"[supervisor] child hit watchdog exhaustion (exit {rc}, "
+                  f"{watchdog_exits}/{args.max_watchdog_relaunches}); "
+                  f"relaunching in {delay:.1f}s", flush=True)
+            time.sleep(delay)
             continue
         crashes += 1
         if crashes > args.max_relaunches:
             print(f"[supervisor] {crashes} consecutive crashes (last exit "
                   f"{rc}); giving up", flush=True)
+            write_metrics(rc)
             return rc
         delay = min(args.backoff_max,
                     args.backoff_base * (2 ** (crashes - 1))) * (0.5 + random.random())

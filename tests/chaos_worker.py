@@ -10,11 +10,18 @@ time its kill against; PreemptedExit propagates so a honored SIGTERM exits
 Usage:
     python tests/chaos_worker.py --run_dir DIR --episodes N
         [--seed 1] [--save_interval 2] [--data_shards 1] [--devices 1]
-        [--async_actors 0]
+        [--async_actors 0] [--chaos_plan PLAN.json] [--chaos_planes CSV]
+        [--chaos_skip_kinds CSV] [--tripwires 0]
 
 ``--async_actors 1`` switches to the overlapped actor-learner loop
 (--iters_per_dispatch drops to 1 — the two overlap strategies are mutually
 exclusive); pass ``--devices 2`` or more so the submesh split has devices.
+
+``--chaos_plan`` arms a mat_dcml_tpu.chaos FaultInjector for this process
+from the given plan JSON, filtered to ``--chaos_planes`` (csv; default both
+training planes).  ``trainer_kill`` events are always dropped here — the
+orchestrator (scripts/chaos_soak.py) delivers those as real SIGTERMs.
+Injected chaos records land in ``<run_dir>/chaos_records.jsonl``.
 """
 
 import argparse
@@ -78,7 +85,35 @@ def main() -> None:
     parser.add_argument("--data_shards", type=int, default=1)
     parser.add_argument("--devices", type=int, default=1)
     parser.add_argument("--async_actors", type=int, default=0)
+    parser.add_argument("--chaos_plan", default=None)
+    parser.add_argument("--chaos_planes", default="train_sync,train_async")
+    parser.add_argument("--chaos_skip_kinds", default="")
+    parser.add_argument("--tripwires", type=int, default=0)
     args = parser.parse_args()
+
+    injector = None
+    if args.chaos_plan:
+        from mat_dcml_tpu.chaos import FaultInjector, FaultPlan, arm, disarm
+        from mat_dcml_tpu.chaos.inject import jsonl_sink
+
+        plan = FaultPlan.from_json(args.chaos_plan).expand()
+        plan = plan.filter(planes=tuple(args.chaos_planes.split(",")))
+        # count-gated fault budgets are per-process: relaunches pass
+        # --chaos_skip_kinds for events that must fire once per soak, not
+        # once per launch (e.g. checkpoint_corrupt)
+        skip = {"trainer_kill"} | set(filter(None,
+                                             args.chaos_skip_kinds.split(",")))
+        plan = plan.filter(kinds=tuple(
+            k for k in plan.kinds() if k not in skip))
+        injector = FaultInjector(
+            plan,
+            record_sink=jsonl_sink(
+                os.path.join(args.run_dir, "chaos_records.jsonl")),
+            log=log)
+        arm(injector)
+        injector.start()
+        log(f"[chaos] armed {len(plan.events)} event(s): "
+            f"{', '.join(ev.event_id for ev in plan.events)}")
 
     run = RunConfig(
         algorithm_name="mat", experiment_name="chaos", seed=args.seed,
@@ -88,12 +123,17 @@ def main() -> None:
         async_actors=bool(args.async_actors),
         log_interval=1, telemetry_interval=1,
         save_interval=args.save_interval, run_dir=args.run_dir,
-        anomaly_tripwires=False, resume="auto", graceful_stop=True,
+        anomaly_tripwires=bool(args.tripwires),
+        resume="auto", graceful_stop=True,
         emergency_snapshot_interval=1, data_shards=args.data_shards,
     )
     runner = DCMLRunner(run, PPOConfig(ppo_epoch=2, num_mini_batch=1),
                         env=tiny_env(), log_fn=log)
-    runner.train_loop(num_episodes=args.episodes)
+    try:
+        runner.train_loop(num_episodes=args.episodes)
+    finally:
+        if injector is not None:
+            disarm()
     log("DONE")
 
 

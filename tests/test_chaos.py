@@ -1,0 +1,346 @@
+"""Tests for the deterministic chaos orchestrator (mat_dcml_tpu/chaos/).
+
+Three layers, mirroring the package:
+
+- plan.py: expansion is a pure function of (plan JSON, seed) — deep-equal
+  across re-runs, identity on re-expansion, ids preserved through filters.
+- inject.py: each seam hook honors windows / call-count budgets / targets
+  under an injected fake clock; expected-anomaly suppression consumes trips;
+  every emitted record passes the strict metrics schema.
+- invariants.py + scripts/chaos_soak.py: the one-command soak driver runs the
+  committed smoke plan end to end (serving plane, CPU) and its report says
+  pass — with the reproducibility double-run baked into the driver itself.
+"""
+
+import json
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+from mat_dcml_tpu.chaos import FaultInjector, FaultPlan, arm, disarm
+from mat_dcml_tpu.chaos import inject as chaos_inject
+from mat_dcml_tpu.chaos.inject import (
+    ActorThreadDeath,
+    InjectedFault,
+    InjectedIOError,
+    is_silent_death,
+)
+from mat_dcml_tpu.chaos.invariants import all_green, check_invariants
+from mat_dcml_tpu.chaos.plan import FAULT_KINDS, FaultEvent
+from mat_dcml_tpu.telemetry import Telemetry
+
+from test_anomaly import _load_script
+
+check_metrics_schema = _load_script("check_metrics_schema")
+
+_REPO = Path(__file__).resolve().parent.parent
+_PLANS = Path(__file__).resolve().parent / "data" / "plans"
+
+
+# ===================================================================
+# plan expansion
+# ===================================================================
+
+def test_plan_expand_is_deterministic():
+    plan = FaultPlan.from_json(_PLANS / "smoke.json")
+    a = plan.expand().to_dict()
+    b = FaultPlan.from_json(_PLANS / "smoke.json").expand().to_dict()
+    assert a == b
+    # randomized fields resolved into the declared ranges
+    crash = next(e for e in a["events"] if e["kind"] == "replica_crash")
+    assert 0.5 <= crash["at_s"] <= 1.5
+    assert crash["event_id"] == "replica_crash:001"
+
+
+def test_plan_expand_seed_changes_schedule():
+    plan = FaultPlan.from_json(_PLANS / "smoke.json")
+    a = plan.expand(seed=1).to_dict()
+    b = FaultPlan.from_json(_PLANS / "smoke.json").expand(seed=2).to_dict()
+    assert a != b      # ranges draw differently
+    assert [e["event_id"] for e in a["events"]] == \
+        [e["event_id"] for e in b["events"]]     # but ids are positional
+
+
+def test_plan_expand_of_expanded_is_identity(tmp_path):
+    expanded = FaultPlan.from_json(_PLANS / "full.json").expand()
+    assert expanded.expand().to_dict() == expanded.to_dict()
+    # the saved expansion round-trips — out/chaos_events.json doubles as a
+    # worker input
+    expanded.save(tmp_path / "events.json")
+    reloaded = FaultPlan.from_json(tmp_path / "events.json").expand()
+    assert reloaded.to_dict() == expanded.to_dict()
+
+
+def test_plan_rejects_unknown_kind_and_fields():
+    with pytest.raises(ValueError, match="unknown fault kind"):
+        FaultEvent(kind="cosmic_ray")
+    with pytest.raises(ValueError, match="unknown event fields"):
+        FaultPlan.from_dict(
+            {"events": [{"kind": "load_spike", "when": 3}]})
+
+
+def test_plan_filter_preserves_ids():
+    plan = FaultPlan.from_json(_PLANS / "full.json").expand()
+    sub = plan.filter(planes=("train_sync",))
+    assert set(sub.kinds()) == {"checkpoint_io_error", "checkpoint_corrupt",
+                                "nan_grad", "trainer_kill"}
+    full_ids = {e.event_id for e in plan.events}
+    assert all(e.event_id in full_ids for e in sub.events)
+    assert plan.filter(kinds=("load_spike",)).kinds() == ("load_spike",)
+    assert set(FAULT_KINDS.values()) == {"serving", "train_sync",
+                                         "train_async"}
+
+
+# ===================================================================
+# injector hooks (fake clock)
+# ===================================================================
+
+def _injector(events, **kw):
+    clock = {"t": 0.0}
+    inj = FaultInjector(FaultPlan(name="t", seed=0, events=events),
+                        telemetry=Telemetry(),
+                        time_fn=lambda: clock["t"],
+                        log=lambda *a: None, **kw)
+    return inj, clock
+
+
+def test_hooks_are_noops_before_start():
+    inj, _ = _injector([FaultEvent(kind="replica_crash", target="r0")])
+    inj.on_decode(0)                      # would raise if the clock ran
+    assert inj.load_multiplier() == 1.0
+    assert inj.suppression_for("slo_latency_budget") is None
+    assert inj.records() == []
+
+
+def test_replica_crash_window_and_target():
+    inj, clock = _injector([
+        FaultEvent(kind="replica_crash", target="r0", at_s=1.0,
+                   duration_s=1.0)])
+    inj.start()
+    clock["t"] = 0.5
+    inj.on_decode(0)                      # before the window: no-op
+    clock["t"] = 1.2
+    inj.on_decode(1)                      # wrong target: no-op
+    with pytest.raises(InjectedFault, match="replica_crash:000"):
+        inj.on_decode(0)
+    clock["t"] = 2.5
+    inj.on_decode(0)                      # window closed: healthy again
+    inj.poll()
+    stages = [r["chaos"] for r in inj.records()]
+    assert stages == ["fired", "cleared"]
+    assert inj.fired_sequence() == ["replica_crash:000"]
+
+
+def test_count_gated_budget_and_skips():
+    inj, clock = _injector([
+        FaultEvent(kind="decode_error", target="r1",
+                   params={"fail_calls": 2, "skip_calls": 1})])
+    inj.start()
+    clock["t"] = 0.1
+    inj.on_decode(1)                      # skip_calls swallows the first
+    for _ in range(2):                    # then the budget burns down
+        with pytest.raises(InjectedFault):
+            inj.on_decode(1)
+    inj.on_decode(1)                      # exhausted: healthy
+    assert inj.telemetry.counters["chaos_injected_faults"] == 2.0
+    assert inj.telemetry.counters["chaos_events_fired"] == 1.0
+
+
+def test_checkpoint_io_error_is_oserror():
+    inj, clock = _injector([
+        FaultEvent(kind="checkpoint_io_error", target="save",
+                   params={"fail_calls": 1})])
+    inj.start()
+    clock["t"] = 0.1
+    inj.on_checkpoint_io("restore")       # op mismatch: no-op
+    with pytest.raises(OSError):          # retry paths see a real OSError
+        inj.on_checkpoint_io("save")
+    inj.on_checkpoint_io("save")
+
+
+def test_actor_thread_death_is_silent_and_iteration_gated():
+    inj, clock = _injector([
+        FaultEvent(kind="actor_thread_death",
+                   params={"fail_calls": 1, "at_iteration": 2})])
+    inj.start()
+    clock["t"] = 0.1
+    inj.on_actor_iteration(0)
+    inj.on_actor_iteration(1)
+    with pytest.raises(ActorThreadDeath) as err:
+        inj.on_actor_iteration(2)
+    assert is_silent_death(err.value)
+
+
+def test_nan_grad_mutates_signals_copy_only():
+    inj, clock = _injector([
+        FaultEvent(kind="nan_grad", params={"fail_calls": 1})])
+    inj.start()
+    clock["t"] = 0.5
+    original = {"nonfinite_grads": 0.0, "step_time_train": 0.1}
+    injected = inj.on_anomaly_signals(original)
+    assert injected["nonfinite_grads"] == 1.0
+    assert original["nonfinite_grads"] == 0.0     # training math untouched
+    # the trip the injected signal causes is expected -> suppressed
+    assert inj.suppression_for("nonfinite_grads") == "nan_grad:000"
+    assert inj.suppression_for("slo_latency_budget") is None
+    kinds = [r["chaos"] for r in inj.records()]
+    assert kinds == ["fired", "suppressed"]
+
+
+def test_load_multiplier_fires_once_per_spike():
+    inj, clock = _injector([
+        FaultEvent(kind="load_spike", at_s=1.0, duration_s=2.0,
+                   params={"factor": 3.0})])
+    inj.start()
+    assert inj.load_multiplier() == 1.0
+    clock["t"] = 1.5
+    for _ in range(5):                    # polled per load-gen slice
+        assert inj.load_multiplier() == 3.0
+    clock["t"] = 4.0
+    assert inj.load_multiplier() == 1.0
+    assert inj.fired_sequence() == ["load_spike:000"]
+
+
+def test_arm_disarm_set_global_and_gauge():
+    inj, _ = _injector([FaultEvent(kind="load_spike")])
+    try:
+        assert chaos_inject.ACTIVE is None
+        arm(inj)
+        assert chaos_inject.ACTIVE is inj
+        assert inj.telemetry.counters["chaos_events_armed"] == 1.0
+        assert inj.telemetry._gauges["chaos_active"] == 1.0
+    finally:
+        disarm()
+    assert chaos_inject.ACTIVE is None
+    assert inj.telemetry._gauges["chaos_active"] == 0.0
+
+
+def test_chaos_records_pass_strict_schema():
+    inj, clock = _injector([
+        FaultEvent(kind="replica_crash", target="r0", duration_s=0.5),
+        FaultEvent(kind="nan_grad", params={"fail_calls": 1})])
+    inj.start()
+    clock["t"] = 0.1
+    with pytest.raises(InjectedFault):
+        inj.on_decode(0)
+    inj.on_anomaly_signals({"nonfinite_grads": 0.0})
+    inj.suppression_for("nonfinite_grads")
+    clock["t"] = 2.0
+    inj.finish()
+    records = inj.records()
+    assert {r["chaos"] for r in records} == {"fired", "suppressed", "cleared"}
+    for i, rec in enumerate(records):
+        assert check_metrics_schema.validate_record(rec, f"rec:{i}") == []
+
+
+# ===================================================================
+# invariants
+# ===================================================================
+
+def _green_records():
+    return [
+        {"serving_error_rate": 0.0, "serving_deadline_miss_rate": 0.0,
+         "fleet_retries_exhausted": 0.0, "engine_steady_state_recompiles": 0},
+        {"staleness_learner_steps_p95": 0.8, "async_queue_drops": 0.0},
+        {"slo_latency_budget_burn": 0.2, "slo_error_budget_burn": 0.0},
+    ]
+
+
+def test_invariants_all_green():
+    results = check_invariants(
+        _green_records(),
+        facts={"expect_async": True, "expect_kill": True,
+               "bit_exact_resume": True})
+    assert all_green(results)
+    assert [r.name for r in results] == [
+        "zero_dropped_requests", "zero_steady_recompiles",
+        "staleness_p95_le_1", "bit_exact_resume", "slo_burn_recovery"]
+    assert not any(r.skipped for r in results)
+
+
+@pytest.mark.parametrize("mutation, failing", [
+    ({"serving_error_rate": 0.1}, "zero_dropped_requests"),
+    ({"fleet_retries_exhausted": 2.0}, "zero_dropped_requests"),
+    ({"engine_steady_state_recompiles": 1}, "zero_steady_recompiles"),
+    ({"staleness_learner_steps_p95": 1.7}, "staleness_p95_le_1"),
+    ({"slo_latency_budget_burn": 1.4}, "slo_burn_recovery"),
+])
+def test_invariants_catch_violations(mutation, failing):
+    records = _green_records()
+    for r in records:
+        for k in mutation:
+            if k in r:
+                r.update(mutation)
+    results = check_invariants(records, facts={"bit_exact_resume": True})
+    verdicts = {r.name: r.ok for r in results}
+    assert not verdicts[failing]
+
+
+def test_invariants_skip_vs_expected_planes():
+    results = check_invariants(_green_records()[:1], facts={})
+    verdicts = {r.name: r for r in results}
+    assert verdicts["staleness_p95_le_1"].skipped          # async didn't run
+    assert verdicts["bit_exact_resume"].skipped            # no kill scheduled
+    assert not verdicts["slo_burn_recovery"].ok            # serving expected
+    # a scheduled kill with no verdict is a failure, not a skip
+    results = check_invariants(_green_records(), facts={"expect_kill": True})
+    assert not {r.name: r for r in results}["bit_exact_resume"].ok
+
+
+# ===================================================================
+# one-command soak driver (end to end, CPU)
+# ===================================================================
+
+def _soak_env():
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.setdefault("MAT_DCML_TPU_TEST_CACHE",
+                   str(_REPO / "tests" / ".jax_cache"))
+    return env
+
+
+def _run_soak(plan: Path, out: Path, duration: float, timeout: float = 600.0):
+    proc = subprocess.run(
+        [sys.executable, str(_REPO / "scripts" / "chaos_soak.py"),
+         "--plan", str(plan), "--out", str(out),
+         "--duration", str(duration)],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        cwd=str(_REPO), env=_soak_env(), timeout=timeout)
+    report = out / "chaos_report.json"
+    assert proc.returncode == 0, proc.stdout[-4000:]
+    assert report.exists(), proc.stdout[-4000:]
+    return json.loads(report.read_text())
+
+
+def test_chaos_soak_smoke_plan_passes(tmp_path):
+    """The committed serving-plane smoke plan runs green end to end, and the
+    driver's built-in double-expansion/replay reproducibility check holds."""
+    out = tmp_path / "soak"
+    report = _run_soak(_PLANS / "smoke.json", out, duration=6.0)
+    assert report["pass"] is True
+    assert report["all_green"] is True
+    assert report["schema_errors"] == []
+    assert report["repro"]["ok"] is True
+    assert report["legs"]["serving"]["fired"] == [
+        "decode_error:000", "replica_crash:001", "load_spike:002"]
+    # the persisted expansion is exactly what a fresh expand produces
+    events = json.loads((out / "chaos_events.json").read_text())
+    assert events == FaultPlan.from_json(_PLANS / "smoke.json") \
+        .expand().to_dict()
+
+
+@pytest.mark.slow
+def test_chaos_soak_full_plan_passes(tmp_path):
+    """All 11 fault kinds across serving + train_sync + train_async,
+    including the SIGTERM/resume bit-exact leg — the PR's acceptance soak."""
+    report = _run_soak(_PLANS / "full.json", tmp_path / "soak",
+                       duration=10.0, timeout=900.0)
+    assert report["pass"] is True, report
+    assert len(report["kinds"]) == len(FAULT_KINDS)
+    assert report["legs"]["train_sync"]["kill_rc"] == 75
+    assert report["legs"]["train_sync"]["bit_exact_resume"] is True
+    assert {r.get("name"): r for r in report["invariants"]}[
+        "bit_exact_resume"]["skipped"] is False

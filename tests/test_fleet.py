@@ -205,6 +205,57 @@ def test_all_queues_full_sheds_with_min_retry_after(params, monkeypatch):
         fleet.close()
 
 
+def test_total_outage_brownout_429_and_recovery(params):
+    """All replicas UNHEALTHY is a brownout, not an error storm: every
+    submit raises QueueFullError (the HTTP layer maps it to 429) with an
+    honest Retry-After covering one probe-readmission cycle — never an
+    EngineFailureError/FleetUnavailableError — and once the fault clears
+    the probers readmit both replicas and requests succeed again with zero
+    retries exhausted."""
+    fleet = make_fleet(params)
+    reals = [r.engine.decode for r in fleet.replicas]
+    try:
+        def dead(*a, **kw):
+            raise RuntimeError("injected total outage")
+
+        for r in fleet.replicas:
+            r.engine.decode = dead
+        states, obs, avail = synth_requests(CFG, 4, seed=21)
+        # the first request rides failover to replica exhaustion, then
+        # resolves with the typed brownout shed
+        fut = fleet.submit(states[0], obs[0], avail[0])
+        with pytest.raises(QueueFullError) as exc:
+            fut.result(timeout=30)
+        assert exc.value.retry_after_s >= 1
+        assert "brownout" in str(exc.value)
+        assert all(r.state == UNHEALTHY for r in fleet.replicas)
+        # subsequent requests shed synchronously — same typed 429, no storm
+        for i in range(1, 4):
+            with pytest.raises(QueueFullError) as exc:
+                fleet.submit(states[i], obs[i], avail[i])
+            assert exc.value.retry_after_s >= 1
+        c = fleet.telemetry.counters
+        assert c["fleet_no_healthy"] >= 3.0
+        assert c["fleet_brownout"] >= 3.0
+        assert c.get("fleet_retries_exhausted", 0.0) == 0.0
+
+        # outage clears -> consecutive clean probes readmit the whole fleet
+        for r, real in zip(fleet.replicas, reals):
+            r.engine.decode = real
+        deadline = time.monotonic() + 20.0
+        while (any(r.state != HEALTHY for r in fleet.replicas)
+               and time.monotonic() < deadline):
+            time.sleep(0.05)
+        assert all(r.state == HEALTHY for r in fleet.replicas)
+        action, _ = PolicyClient(fleet).act(states[0], obs[0], avail[0])
+        assert action.shape == (CFG.n_agent, 1)
+        assert c.get("fleet_retries_exhausted", 0.0) == 0.0
+    finally:
+        for r, real in zip(fleet.replicas, reals):
+            r.engine.decode = real
+        fleet.close()
+
+
 # ----------------------------------------------------------- hot weight push
 
 

@@ -45,7 +45,7 @@ from mat_dcml_tpu.parallel.mesh import build_run_mesh, replicated
 from mat_dcml_tpu.parallel.distributed import global_init_state
 from mat_dcml_tpu.telemetry import Telemetry
 from mat_dcml_tpu.training.base_runner import make_dispatch_fn
-from mat_dcml_tpu.training.checkpoint import CheckpointManager
+from mat_dcml_tpu.training.checkpoint import CheckpointIOError, CheckpointManager
 from mat_dcml_tpu.training.ppo import MATTrainer, PPOConfig
 from mat_dcml_tpu.training.resilience import (
     EMERGENCY_FORMAT,
@@ -293,6 +293,142 @@ def test_supervisor_gives_up_after_max_crashes(tmp_path):
     )
     assert proc.returncode == 3
     assert "giving up" in proc.stdout
+
+
+def test_supervisor_watchdog_budget_separate_from_crashes(tmp_path):
+    """Exit 76 (watchdog exhaustion) relaunches on its OWN budget: a child
+    that exits 76 twice then finishes succeeds even with --max-relaunches 0,
+    the counter line prints, and the metrics record lands."""
+    marker = tmp_path / "launches.txt"
+    metrics = tmp_path / "supervisor.jsonl"
+    child = (
+        "import pathlib, sys; p = pathlib.Path(r'%s'); "
+        "n = int(p.read_text() or 0) if p.exists() else 0; "
+        "p.write_text(str(n + 1)); "
+        "sys.exit(76 if n < 2 else 0)" % marker
+    )
+    proc = subprocess.run(
+        [sys.executable, str(_REPO / "scripts" / "train_supervisor.py"),
+         "--max-relaunches", "0", "--max-watchdog-relaunches", "3",
+         "--backoff-base", "0.01", "--metrics-file", str(metrics), "--",
+         sys.executable, "-c", child],
+        capture_output=True, text=True, timeout=120, cwd=str(_REPO),
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert marker.read_text() == "3"   # two watchdog exits + one clean finish
+    assert "resilience_supervisor_exit_76=2" in proc.stdout
+    assert "watchdog exhaustion" in proc.stdout
+    rec = json.loads(metrics.read_text().splitlines()[-1])
+    assert rec["resilience_supervisor_exit_76"] == 2
+    assert rec["resilience_supervisor_launches"] == 3
+    assert rec["resilience_supervisor_last_exit"] == 0
+    # the record must pass the strict metrics schema like any other
+    assert check_metrics_schema.validate_record(rec, "supervisor.jsonl:1") == []
+
+
+def test_supervisor_watchdog_gives_up_on_its_own_budget(tmp_path):
+    """A persistently-sick dispatch (every launch exits 76) exhausts
+    --max-watchdog-relaunches and surfaces the child's code."""
+    proc = subprocess.run(
+        [sys.executable, str(_REPO / "scripts" / "train_supervisor.py"),
+         "--max-watchdog-relaunches", "1", "--backoff-base", "0.01", "--",
+         sys.executable, "-c", "import sys; sys.exit(76)"],
+        capture_output=True, text=True, timeout=120, cwd=str(_REPO),
+    )
+    assert proc.returncode == 76
+    assert "giving up" in proc.stdout
+    assert "resilience_supervisor_exit_76=2" in proc.stdout
+
+
+# ===================================================================
+# checkpoint IO retry (transient vs persistent storage failures)
+# ===================================================================
+
+def _retry_manager(tmp_path, **kw):
+    """CheckpointManager with captured sleeps and pinned jitter."""
+    sleeps: list = []
+    kw.setdefault("io_backoff_base_ms", 100.0)
+    mgr = CheckpointManager(tmp_path / "models", log=lambda *a: None,
+                            telemetry=Telemetry(), sleep=sleeps.append,
+                            rand=lambda: 0.5, **kw)
+    return mgr, sleeps
+
+
+def test_checkpoint_io_transient_blips_are_retried(tmp_path):
+    """Two NFS-style blips then success: the op lands, the retry counter
+    ticks, and the injected rand pins the jittered exponential backoff."""
+    mgr, sleeps = _retry_manager(tmp_path)
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] <= 2:
+            raise OSError("injected NFS blip")
+        return "landed"
+
+    try:
+        assert mgr._io_retry("save", flaky) == "landed"
+        assert calls["n"] == 3
+        tel = mgr.telemetry
+        assert tel.counters["resilience_checkpoint_io_retries"] == 2.0
+        assert "resilience_checkpoint_io_failures" not in tel.counters
+        # backoff_delay(attempt, 100ms, rand=0.5) = 0.1 * 2^(attempt-1) * 1.0
+        assert sleeps == pytest.approx([0.1, 0.2])
+    finally:
+        mgr.close()
+
+
+def test_checkpoint_io_exhaustion_raises_typed_error(tmp_path):
+    mgr, sleeps = _retry_manager(tmp_path, io_retries=2)
+
+    def down():
+        raise OSError("filer down")
+
+    def bug():
+        raise ValueError("caller bug")
+
+    try:
+        with pytest.raises(CheckpointIOError, match="save failed 3 times"):
+            mgr._io_retry("save", down)
+        tel = mgr.telemetry
+        assert tel.counters["resilience_checkpoint_io_failures"] == 1.0
+        assert tel.counters["resilience_checkpoint_io_retries"] == 2.0
+        assert len(sleeps) == 2
+        # non-OSError propagates untouched without burning the retry budget
+        with pytest.raises(ValueError, match="caller bug"):
+            mgr._io_retry("restore", bug)
+        assert len(sleeps) == 2
+    finally:
+        mgr.close()
+
+
+def test_checkpoint_save_survives_transient_io_and_restores(tmp_path):
+    """The public save() path retries a failing orbax save and the resulting
+    checkpoint verifies + restores bit-exact."""
+    mgr, _ = _retry_manager(tmp_path)
+    _, _, policy, trainer, _ = tiny_components()
+    state = trainer.init_state(policy.init_params(jax.random.key(11)))
+    real_save, fails = mgr.manager.save, {"n": 1}
+
+    def flaky_save(*a, **kw):
+        if fails["n"] > 0:
+            fails["n"] -= 1
+            raise OSError("injected save blip")
+        return real_save(*a, **kw)
+
+    mgr.manager.save = flaky_save
+    try:
+        mgr.save(3, state, blocking=True)
+        assert mgr.telemetry.counters["resilience_checkpoint_io_retries"] == 1.0
+        assert mgr.verify_step(3)[0] == "ok"
+        template = jax.eval_shape(
+            lambda: trainer.init_state(policy.init_params(jax.random.key(11))))
+        step, restored = mgr.restore_latest_valid(template=template)
+        assert step == 3
+        assert tree_bit_equal(state, restored)
+    finally:
+        mgr.manager.save = real_save
+        mgr.close()
 
 
 # ===================================================================
