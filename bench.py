@@ -133,6 +133,22 @@ Knobs (environment variables):
                         (1,4,16), BENCH_OBS_SAMPLE (0.01),
                         BENCH_OBS_RUN_DIR (append records + trace.jsonl,
                         then strict-validate the run dir)
+  BENCH_OBS_FED         "1" → federation overhead A/B: the cross-process
+                        observe plane ON (client-minted traces crossing the
+                        HTTP hop as ``traceparent`` headers + a background
+                        RemoteScraper polling ``GET /telemetry.json`` and
+                        exact-merging the snapshots every 100 ms) vs the
+                        identical single-replica fleet served over the SAME
+                        real HTTP server with the plane OFF.  Record value =
+                        federated QPS, vs_baseline = median per-round
+                        (matched-pair) on/off QPS ratio (contract: >= 0.98 —
+                        propagation + remote scraping stay within the
+                        observability budget).  Knobs:
+                        BENCH_OBS_FED_REQUESTS (512),
+                        BENCH_OBS_FED_CONCURRENCY (16), BENCH_OBS_FED_BUCKETS
+                        (1,4,16), BENCH_OBS_FED_SAMPLE (0.01),
+                        BENCH_OBS_FED_TRIALS (5), BENCH_OBS_FED_RUN_DIR
+                        (append records + trace.jsonl, then strict-validate)
   BENCH_CHAOS           "1" → chaos-seam overhead A/B: the injector DISARMED
                         (production default — every seam is one module-
                         attribute read + ``is None`` branch) vs ARMED with an
@@ -1938,6 +1954,169 @@ def ab_trials(legs: dict, trials: int, score=None) -> tuple:
     return best, results
 
 
+def _measure_obs_fed(jax) -> None:
+    """BENCH_OBS_FED=1 leg: cross-process federation overhead A/B.
+
+    Both legs drive the identical single-replica fleet through a REAL
+    ``PolicyServer`` + ``HttpPolicyClient`` loopback-HTTP pair (same AOT
+    engine, same params, same closed-loop load), so the baseline already
+    pays JSON + socket costs and the ratio isolates the *federation* tax.
+    Leg A arms the cross-process plane end to end: the client mints root
+    spans at the default 1% sample and injects ``traceparent`` on every
+    sampled POST, the server continues those traces through the batcher,
+    and a background :class:`RemoteScraper` polls ``GET /telemetry.json``
+    every 100 ms and exact-merges the snapshots (far hotter than a real
+    collector's 1-15 s cadence).  Leg B serves the same HTTP load with no
+    tracer on either side and no scraper.
+
+    ``vs_baseline`` is the MEDIAN of per-round federated/plain QPS ratios
+    (contract: >= 0.98).  Each ``ab_trials`` round runs both legs
+    back-to-back, so a round is a matched pair under the same transient
+    container load and its ratio cancels the drift; the median then sheds
+    the one-sided outlier rounds.  The HTTP stack's per-trial QPS on this
+    box swings ±10-25% with neighbors (far wider than the in-process
+    BENCH_OBS leg), which makes a best-of-N-per-side comparison a coin
+    flip on single lucky draws — both sides' bests are still reported."""
+    import tempfile
+    import threading as _threading
+
+    from mat_dcml_tpu.config import RunConfig
+    from mat_dcml_tpu.envs.dcml import DCMLEnv, DCMLEnvConfig
+    from mat_dcml_tpu.serving.batcher import BatcherConfig
+    from mat_dcml_tpu.serving.engine import EngineConfig
+    from mat_dcml_tpu.serving.fleet import EngineFleet, FleetConfig
+    from mat_dcml_tpu.serving.loadgen import run_load, write_serving_record
+    from mat_dcml_tpu.serving.server import HttpPolicyClient, PolicyServer
+    from mat_dcml_tpu.telemetry.remote import RemoteScraper
+    from mat_dcml_tpu.telemetry.tracing import Tracer
+    from mat_dcml_tpu.training.runner import build_mat_policy
+
+    data_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)), "data")
+    env = DCMLEnv(DCMLEnvConfig(), data_dir=data_dir)
+    policy = build_mat_policy(RunConfig(), env)
+    params = policy.init_params(jax.random.key(0))
+
+    n_req = int(os.environ.get("BENCH_OBS_FED_REQUESTS", "512"))
+    conc = int(os.environ.get("BENCH_OBS_FED_CONCURRENCY", "16"))
+    buckets = tuple(
+        int(b)
+        for b in os.environ.get("BENCH_OBS_FED_BUCKETS", "1,4,16").split(",")
+    )
+    sample = float(os.environ.get("BENCH_OBS_FED_SAMPLE", "0.01"))
+    trials = int(os.environ.get("BENCH_OBS_FED_TRIALS", "5"))
+    run_dir = os.environ.get("BENCH_OBS_FED_RUN_DIR", "")
+    # the federated leg must pay real trace I/O on BOTH sides of the hop
+    trace_root = run_dir or tempfile.mkdtemp(prefix="bench_obs_fed_")
+
+    def _run_leg(name: str) -> dict:
+        fed = name == "federated"
+        srv_tracer = (Tracer(os.path.join(trace_root, "srv"), sample=sample)
+                      if fed else None)
+        cli_tracer = (Tracer(os.path.join(trace_root, "cli"), sample=sample)
+                      if fed else None)
+        fleet = EngineFleet(
+            params, policy.cfg,
+            fleet_cfg=FleetConfig(n_replicas=1),
+            engine_cfg=EngineConfig(buckets=buckets),
+            batcher_cfg=BatcherConfig(max_batch_wait_ms=2.0),
+            log_fn=lambda *a: None,
+            tracer=srv_tracer,
+        )
+        fleet.warmup()
+        server = PolicyServer(fleet=fleet, port=0, log_fn=lambda *a: None)
+        server.warm = True
+        server.start()
+        client = HttpPolicyClient(f"http://127.0.0.1:{server.port}",
+                                  cfg=policy.cfg, tracer=cli_tracer)
+        scrape_stop = _threading.Event()
+        scrapes = [0]
+
+        def _scrape_loop(stop=scrape_stop, counter=scrapes,
+                         port=server.port):
+            scraper = RemoteScraper(
+                [("serving", f"http://127.0.0.1:{port}")],
+                timeout_s=2.0, log_fn=lambda *a: None)
+            while not stop.is_set():
+                scraper.poll()
+                scraper.merged_record()     # the full exact merge, per poll
+                counter[0] += 1
+                stop.wait(timeout=0.1)
+
+        scraper_thread = None
+        if fed:
+            scraper_thread = _threading.Thread(target=_scrape_loop,
+                                               daemon=True)
+            scraper_thread.start()
+        rec = run_load(client, n_requests=n_req, concurrency=conc)
+        if scraper_thread is not None:
+            scrape_stop.set()
+            scraper_thread.join(timeout=2.0)
+            rec["obs_scrape_polls"] = scrapes[0]
+            rec["obs_traces_sampled"] = cli_tracer.traces_started
+        rec["steady_state_recompiles"] = fleet.steady_state_recompiles()
+        server.stop()
+        fleet.close()
+        for tr in (srv_tracer, cli_tracer):
+            if tr is not None:
+                tr.close()
+        log(f"obs_fed[{name}]: {rec['serving_qps']:.1f} req/s, "
+            f"p50 {rec['serving_p50_ms']:.1f} ms, "
+            f"p99 {rec['serving_p99_ms']:.1f} ms")
+        return rec
+
+    best, legs = ab_trials(
+        {"federated": lambda: _run_leg("federated"),
+         "plain": lambda: _run_leg("plain")},
+        trials, score=lambda r: r["serving_qps"])
+    if run_dir:
+        for rec in best.values():
+            write_serving_record(
+                run_dir,
+                {k: v for k, v in rec.items() if not k.startswith("obs_")})
+
+    dev = jax.devices()[0]
+    fed_qps = best["federated"]["serving_qps"]
+    plain_qps = best["plain"]["serving_qps"]
+    # per-round matched-pair ratios: round i's legs ran back-to-back under
+    # the same transient load, so the ratio cancels it; median sheds outliers
+    ratios = sorted(
+        f["serving_qps"] / max(p["serving_qps"], 1e-9)
+        for f, p in zip(legs["federated"], legs["plain"]))
+    median_ratio = (ratios[len(ratios) // 2] if len(ratios) % 2
+                    else (ratios[len(ratios) // 2 - 1]
+                          + ratios[len(ratios) // 2]) / 2.0)
+    record = {
+        "metric": "dcml_mat_obs_fed_overhead_qps",
+        "value": round(fed_qps, 2),
+        "unit": "req/s",
+        # the federation tax over an already-HTTP baseline (contract >= 0.98)
+        "vs_baseline": round(median_ratio, 4),
+        "paired_ratios": [round(r, 3) for r in ratios],
+        "platform": dev.platform,
+        "device": dev.device_kind,
+        "provisional": False,
+        "buckets": ",".join(str(b) for b in buckets),
+        "requests": n_req,
+        "concurrency": conc,
+        "trials": max(trials, 1),
+        "trace_sample": sample,
+        "plain_qps": round(plain_qps, 2),
+        "federated_qps_all": [round(r["serving_qps"], 1)
+                              for r in legs["federated"]],
+        "plain_qps_all": [round(r["serving_qps"], 1) for r in legs["plain"]],
+        "federated_p50_ms": round(best["federated"]["serving_p50_ms"], 2),
+        "plain_p50_ms": round(best["plain"]["serving_p50_ms"], 2),
+        "federated_p99_ms": round(best["federated"]["serving_p99_ms"], 2),
+        "plain_p99_ms": round(best["plain"]["serving_p99_ms"], 2),
+        "scrape_polls": best["federated"].get("obs_scrape_polls", 0),
+        "traces_sampled": best["federated"].get("obs_traces_sampled", 0),
+        "client_overhead_ms_p50": round(
+            best["federated"].get("serving_client_overhead_ms_p50", 0.0), 3),
+        "schema_strict_ok": _validate_run_dir(run_dir),
+    }
+    print(json.dumps(record), flush=True)
+
+
 def _measure_chaos(jax) -> None:
     """BENCH_CHAOS=1 leg: chaos-seam overhead A/B.
 
@@ -2394,6 +2573,13 @@ def main() -> None:
     if os.environ.get("BENCH_OBS", "0") == "1":
         jax, _ = _setup_jax()
         _measure_obs(jax)
+        return
+
+    # Federation overhead A/B: traceparent propagation + remote scraping
+    # over a real HTTP hop, on vs off against the same-HTTP baseline
+    if os.environ.get("BENCH_OBS_FED", "0") == "1":
+        jax, _ = _setup_jax()
+        _measure_obs_fed(jax)
         return
 
     # Chaos-seam overhead A/B: disarmed seams vs an armed-but-idle injector

@@ -56,6 +56,12 @@ class RunConfig:
     trace_sample: float = 0.0
     # rotate trace.jsonl at this size (MB), same scheme as metrics_max_mb
     trace_max_mb: float = 64.0
+    # observability federation (telemetry/remote.py): serve this process's
+    # telemetry registry at http://127.0.0.1:<port>/telemetry.json on a
+    # stdlib sidecar thread so training joins the same scrape plane as the
+    # serving fleet (scripts/obs_collector.py).  0 disables (default);
+    # -1 binds an ephemeral port (announced on the OBS_PORT log line).
+    obs_port: int = 0
     # fused multi-episode dispatch: lax.scan K collect+train iterations inside
     # ONE jitted call with donated train/rollout state, so the host re-enters
     # once per K episodes instead of twice per episode (Podracer-style).  1 =

@@ -75,8 +75,15 @@ def run_load(
     ``serving_goodput_slo`` (fraction of *offered* load) and
     ``serving_goodput_qps`` only if it succeeded AND finished inside the SLO
     — sheds, errors, and slow successes all count against goodput alike.
+
+    ``client`` may also be an :class:`~mat_dcml_tpu.serving.server
+    .HttpPolicyClient` (no batcher — the engine lives in another process):
+    request shapes come from ``client.cfg`` and the flushed registry is the
+    client's own, which carries the ``serving_client_overhead_ms`` histogram
+    (client root span minus server-side ``request`` span).
     """
-    cfg = client.batcher.engine.cfg
+    batcher = getattr(client, "batcher", None)
+    cfg = batcher.engine.cfg if batcher is not None else client.cfg
     states, obs, avail = synth_requests(cfg, n_requests, seed)
     latencies: List[float] = []
     outcomes = {"ok": 0, "shed": 0, "deadline": 0, "error": 0, "good": 0}
@@ -164,14 +171,16 @@ def run_load(
         record["serving_goodput_slo"] = outcomes["good"] / max(n_requests, 1)
         record["serving_goodput_qps"] = outcomes["good"] / max(elapsed, 1e-9)
     record.update(percentiles(latencies))
-    tel = client.batcher.telemetry
+    tel = batcher.telemetry if batcher is not None else client.telemetry
     # bucket-occupancy histogram + engine-side aggregates ride along —
     # including the server-side serving_queue_wait_ms/serving_decode_ms
     # latency sketches, which complement the client-side percentiles above
+    # (HTTP mode flushes the client registry instead: the client-overhead
+    # histogram and client-side error counters)
     record.update(tel.flush())
     # fleet mode: merged per-replica sketches (honest fleet-wide p50/p95/p99)
     # plus live SLO burn gauges ride along through fleet_record
-    fleet_rec = getattr(client.batcher, "fleet_record", None)
+    fleet_rec = getattr(batcher, "fleet_record", None)
     if fleet_rec is not None:
         record.update(fleet_rec())
     return record
@@ -187,12 +196,26 @@ def write_serving_record(run_dir, record: Dict[str, float]) -> None:
     writer.close()
 
 
+class _ShapeCfg:
+    """Request-shape stub for HTTP mode (``synth_requests`` needs only the
+    four dims; the model itself lives in the server process)."""
+
+    def __init__(self, n_agent, obs_dim, state_dim, action_dim):
+        self.n_agent, self.obs_dim = n_agent, obs_dim
+        self.state_dim, self.action_dim = state_dim, action_dim
+
+
 def main(argv=None) -> None:
-    """CLI: load-test a policy export end to end (engine in-process).
+    """CLI: load-test a policy export end to end — engine in-process, or a
+    remote :class:`PolicyServer` over HTTP with trace propagation.
 
     Usage: python -m mat_dcml_tpu.serving.loadgen --policy_dir <export>
            [--requests 2000] [--concurrency 16] [--qps 0 = closed-loop]
            [--buckets 1,8,32,128] [--run_dir results/serving]
+
+    HTTP mode (no local engine; ``--policy_dir`` not needed):
+           --server_url http://host:port --shape N_AGENT,OBS,STATE,ACT
+           [--obs_port 9100]   # join the scrape plane (telemetry/remote.py)
     """
     import argparse
 
@@ -200,7 +223,21 @@ def main(argv=None) -> None:
     from mat_dcml_tpu.serving.engine import DecodeEngine, EngineConfig
 
     p = argparse.ArgumentParser(description="MAT serving load generator")
-    p.add_argument("--policy_dir", required=True)
+    p.add_argument("--policy_dir", default=None)
+    p.add_argument("--server_url", default=None,
+                   help="drive a remote PolicyServer over HTTP instead of an "
+                        "in-process engine (traceparent propagation on)")
+    p.add_argument("--shape", default=None,
+                   help="HTTP mode request shape: n_agent,obs_dim,state_dim,"
+                        "action_dim")
+    p.add_argument("--obs_port", type=int, default=0,
+                   help="serve this process's telemetry at "
+                        "http://127.0.0.1:<port>/telemetry.json "
+                        "(0 = off, -1 = ephemeral; bound port printed as "
+                        "'OBS_PORT <n>')")
+    p.add_argument("--linger_s", type=float, default=0.0,
+                   help="keep the obs sidecar up this long after the load "
+                        "finishes (lets a collector take a final scrape)")
     p.add_argument("--requests", type=int, default=2000)
     p.add_argument("--concurrency", type=int, default=16)
     p.add_argument("--qps", type=float, default=0.0, help="0 = closed loop")
@@ -219,22 +256,47 @@ def main(argv=None) -> None:
     p.add_argument("--trace_max_mb", type=float, default=64.0)
     args = p.parse_args(argv)
 
-    engine = DecodeEngine.from_export(
-        args.policy_dir,
-        EngineConfig(buckets=tuple(int(b) for b in args.buckets.split(","))),
-    )
-    engine.warmup()
     tracer = None
     if args.trace_sample > 0 and args.run_dir:
         from mat_dcml_tpu.telemetry.tracing import Tracer
 
         tracer = Tracer(args.run_dir, sample=args.trace_sample,
                         max_mb=args.trace_max_mb)
-    batcher = ContinuousBatcher(
-        engine, BatcherConfig(max_batch_wait_ms=args.max_batch_wait_ms),
-        tracer=tracer,
-    )
-    client = PolicyClient(batcher)
+    engine = batcher = None
+    if args.server_url:
+        # HTTP mode: the engine lives in the server process; this process is
+        # a pure client minting root spans + injecting traceparent headers
+        from mat_dcml_tpu.serving.server import HttpPolicyClient
+
+        if not args.shape:
+            p.error("--server_url needs --shape n_agent,obs,state,action")
+        dims = [int(x) for x in args.shape.split(",")]
+        if len(dims) != 4:
+            p.error("--shape takes exactly four comma-separated ints")
+        client = HttpPolicyClient(args.server_url, cfg=_ShapeCfg(*dims),
+                                  tracer=tracer)
+    else:
+        if not args.policy_dir:
+            p.error("--policy_dir is required without --server_url")
+        engine = DecodeEngine.from_export(
+            args.policy_dir,
+            EngineConfig(buckets=tuple(int(b) for b in args.buckets.split(","))),
+        )
+        engine.warmup()
+        batcher = ContinuousBatcher(
+            engine, BatcherConfig(max_batch_wait_ms=args.max_batch_wait_ms),
+            tracer=tracer,
+        )
+        client = PolicyClient(batcher)
+    sidecar = None
+    if args.obs_port:
+        from mat_dcml_tpu.telemetry.remote import TelemetrySidecar
+
+        tel = batcher.telemetry if batcher is not None else client.telemetry
+        sidecar = TelemetrySidecar(tel, port=max(0, args.obs_port),
+                                   label="loadgen")
+        sidecar.start()
+        print(f"OBS_PORT {sidecar.port}", flush=True)
     record = run_load(
         client,
         n_requests=args.requests,
@@ -244,14 +306,19 @@ def main(argv=None) -> None:
         slo_ms=args.slo_ms or None,
         n_clients=args.clients,
     )
-    recompiles = engine.steady_state_recompiles()
-    record["steady_state_recompiles"] = recompiles
+    if engine is not None:
+        record["steady_state_recompiles"] = engine.steady_state_recompiles()
     import json as _json
 
     print(_json.dumps(record))
     if args.run_dir:
         write_serving_record(args.run_dir, record)
-    batcher.close()
+    if sidecar is not None:
+        if args.linger_s > 0:
+            time.sleep(args.linger_s)
+        sidecar.stop()
+    if batcher is not None:
+        batcher.close()
 
 
 if __name__ == "__main__":

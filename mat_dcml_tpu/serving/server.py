@@ -13,10 +13,22 @@ out-of-process callers; intentionally stdlib-only (no new dependencies):
 - ``GET /metrics``   Prometheus text exposition (counters, gauges, and the
   server-side latency summaries — fleet-wide merged across replicas in fleet
   mode) so a live soak run is scrapeable
+- ``GET /telemetry.json``  the structured federation snapshot
+  (telemetry/remote.py): per-source counters/gauges plus EXACT histogram
+  sketch state under a monotonic ``seq``, so a remote scraper merges
+  fleet-wide percentiles bit-for-bit instead of re-parsing rounded
+  Prometheus text
 
 Typed rejections map onto HTTP: queue-full -> 429 with a ``Retry-After``
 header derived from queue depth x EMA service time, deadline -> 504, engine
 failure -> 500, malformed request -> 400.
+
+Cross-process tracing: a ``traceparent`` request header on ``POST /v1/act``
+(telemetry/propagate.py; injected by :class:`HttpPolicyClient` / loadgen)
+continues the client-minted trace id through routing → queue → replica, so
+one trace spans client → network → server; successful replies carry
+``server_ms`` (the server-side end-to-end) so the client can histogram the
+network+client-queue gap as ``serving_client_overhead_ms``.
 
 Fleet mode (``PolicyServer(fleet=...)`` or ``scripts/serve_fleet.py``) serves
 the same ``/v1/act`` through the fleet router and adds:
@@ -40,12 +52,17 @@ from mat_dcml_tpu.serving.batcher import (
     BatcherConfig,
     ContinuousBatcher,
     DeadlineExceededError,
+    EngineFailureError,
     QueueFullError,
     ServingError,
 )
 from mat_dcml_tpu.serving.engine import DecodeEngine, EngineConfig
 from mat_dcml_tpu.telemetry.aggregate import TelemetryAggregator
 from mat_dcml_tpu.telemetry.anomaly import AnomalyConfig, AnomalyDetector
+from mat_dcml_tpu.telemetry.propagate import extract as extract_traceparent
+from mat_dcml_tpu.telemetry.propagate import inject as inject_traceparent
+from mat_dcml_tpu.telemetry.registry import Telemetry
+from mat_dcml_tpu.telemetry.remote import SNAPSHOT_PATH, build_snapshot
 from mat_dcml_tpu.telemetry.slo import SLOConfig, SLOMonitor
 from mat_dcml_tpu.telemetry.tracing import TraceContext, Tracer
 
@@ -93,6 +110,98 @@ class PolicyClient:
         return result
 
 
+class HttpPolicyClient:
+    """``PolicyClient`` twin that crosses the process boundary: POSTs
+    ``/v1/act`` to a remote :class:`PolicyServer`, mints a client-side root
+    span per request, and injects the ``traceparent`` header so the server
+    continues the SAME trace id (telemetry/propagate.py).
+
+    Duck-types what ``loadgen.run_load`` needs — ``act`` with the typed
+    :class:`ServingError` mapping (429 -> queue-full, 504 -> deadline,
+    others -> engine failure) plus a local ``telemetry``/``cfg`` instead of a
+    batcher.  Successful replies carry ``server_ms`` (the server-side
+    end-to-end span); the difference against the client root span lands in
+    the ``serving_client_overhead_ms`` histogram — the measurable
+    network + client-queue gap."""
+
+    def __init__(self, base_url: str, cfg=None,
+                 tracer: Optional[Tracer] = None,
+                 telemetry: Optional[Telemetry] = None,
+                 timeout_s: float = 60.0):
+        import urllib.request
+
+        self._urllib = urllib.request
+        self.base_url = base_url.rstrip("/")
+        self.cfg = cfg
+        self.tracer = tracer
+        self.telemetry = telemetry if telemetry is not None else Telemetry()
+        self.timeout_s = float(timeout_s)
+
+    def act(
+        self,
+        state,
+        obs,
+        available_actions=None,
+        timeout_s: Optional[float] = None,
+        trace: Optional[TraceContext] = None,
+    ) -> Tuple[np.ndarray, np.ndarray]:
+        import urllib.error
+
+        owns = False
+        if trace is None and self.tracer is not None:
+            trace = self.tracer.start_trace("client", root="client_request")
+            owns = trace is not None
+        payload = {"state": np.asarray(state).tolist(),
+                   "obs": np.asarray(obs).tolist()}
+        if available_actions is not None:
+            payload["available_actions"] = np.asarray(available_actions).tolist()
+        if timeout_s is not None:
+            payload["timeout_s"] = float(timeout_s)
+        headers = {"Content-Type": "application/json"}
+        inject_traceparent(headers, trace)
+        req = self._urllib.Request(self.base_url + "/v1/act",
+                                   data=json.dumps(payload).encode(),
+                                   headers=headers, method="POST")
+        t0 = time.perf_counter()
+        wait = self.timeout_s if timeout_s is None else timeout_s + 5.0
+        try:
+            with self._urllib.urlopen(req, timeout=wait) as resp:
+                body = json.loads(resp.read())
+        except urllib.error.HTTPError as e:
+            try:
+                err = json.loads(e.read() or b"{}")
+            except (ValueError, json.JSONDecodeError):
+                err = {}
+            detail = err.get("error", f"HTTP {e.code}")
+            self.telemetry.count("serving_client_errors")
+            if owns:
+                trace.finish(status=err.get("kind", "error"))
+            if e.code == 429:
+                exc = QueueFullError(detail)
+                exc.retry_after_s = err.get("retry_after_s", 1)
+                raise exc from None
+            if e.code == 504:
+                raise DeadlineExceededError(detail) from None
+            if e.code == 400:
+                raise ValueError(detail) from None
+            raise EngineFailureError(detail) from None
+        except BaseException:
+            self.telemetry.count("serving_client_errors")
+            if owns:
+                trace.finish(status="error")
+            raise
+        client_ms = (time.perf_counter() - t0) * 1e3
+        server_ms = body.get("server_ms")
+        if server_ms is not None:
+            self.telemetry.hist("serving_client_overhead_ms",
+                                max(0.0, client_ms - float(server_ms)))
+        if owns:
+            trace.finish(status="ok",
+                         server_ms=0.0 if server_ms is None else server_ms)
+        return (np.asarray(body["action"], np.float32),
+                np.asarray(body["log_prob"], np.float32))
+
+
 class _Handler(BaseHTTPRequestHandler):
     server_version = "mat-dcml-serving/1"
 
@@ -122,6 +231,8 @@ class _Handler(BaseHTTPRequestHandler):
         if self.path == "/metrics":
             self._reply_text(200, srv.metrics_text(),
                              "text/plain; version=0.0.4; charset=utf-8")
+        elif self.path == SNAPSHOT_PATH:
+            self._reply(200, srv.telemetry_snapshot())
         elif self.path == "/healthz":
             payload = {"ok": True, "warm": srv.warm,
                        "buckets": list(srv.engine.engine_cfg.buckets)}
@@ -165,8 +276,15 @@ class _Handler(BaseHTTPRequestHandler):
             self._reply(400, {"error": f"malformed request: {e!r}"})
             return
         # ingress: mint the (sampled) trace and the SLO latency clock here so
-        # the root span covers parse-to-reply — the server-side end-to-end
-        trace = srv.tracer.start_trace("serving") if srv.tracer else None
+        # the root span covers parse-to-reply — the server-side end-to-end.
+        # A traceparent header continues the client-minted trace id instead
+        # (the client already made the sampling decision), so one trace spans
+        # client -> network -> queue -> replica across the process boundary.
+        trace = None
+        if srv.tracer is not None:
+            remote_id = extract_traceparent(self.headers)
+            trace = (srv.tracer.continue_trace(remote_id, "serving")
+                     if remote_id else srv.tracer.start_trace("serving"))
         t0 = time.monotonic()
         try:
             action, log_prob = srv.client.act(state, obs, avail, timeout_s,
@@ -191,9 +309,13 @@ class _Handler(BaseHTTPRequestHandler):
             srv.observe_request(t0, ok=False, trace=trace, status="error")
             self._reply(500, {"error": repr(e), "kind": "engine_failure"})
         else:
+            server_ms = (time.monotonic() - t0) * 1e3
             srv.observe_request(t0, ok=True, trace=trace, status="ok")
+            # server_ms = the server-side end-to-end; the client subtracts it
+            # from its own root span to histogram the network/client gap
             self._reply(200, {"action": action.tolist(),
-                              "log_prob": log_prob.tolist()})
+                              "log_prob": log_prob.tolist(),
+                              "server_ms": server_ms})
 
     def _do_push(self, srv: "PolicyServer") -> None:
         if srv.fleet is None:
@@ -270,6 +392,8 @@ class PolicyServer:
                 AnomalyDetector(anomaly_cfg) if slo_monitor is not None else None)
         self.anomalies: list = []
         self._slo_seen = 0
+        self._snapshot_seq = 0
+        self._snapshot_lock = threading.Lock()
         self.client = PolicyClient(self.batcher)
         self.log_fn = log_fn
         self.warm = False
@@ -284,18 +408,35 @@ class PolicyServer:
 
     # --------------------------------------------------------- observability
 
+    def _obs_sources(self):
+        """The labelled registries this process exposes: fleet router +
+        per-replica engines in fleet mode, the lone batcher otherwise."""
+        if self.fleet is not None:
+            sources = [("fleet", self.fleet.telemetry)]
+            sources += [(str(r.rid), r.engine.telemetry)
+                        for r in self.fleet.replicas]
+            return sources
+        return [("0", self.batcher.telemetry)]
+
     def metrics_text(self) -> str:
         """Prometheus text for ``GET /metrics``: merged counters/gauges and
         fleet-wide latency summaries, plus live SLO burn gauges."""
-        agg = TelemetryAggregator()
-        if self.fleet is not None:
-            agg.add_source("fleet", self.fleet.telemetry)
-            for r in self.fleet.replicas:
-                agg.add_source(str(r.rid), r.engine.telemetry)
-        else:
-            agg.add_source("0", self.batcher.telemetry)
+        agg = TelemetryAggregator(self._obs_sources())
         extra = self.slo.gauges() if self.slo is not None else None
         return agg.prometheus_text(extra_gauges=extra)
+
+    def telemetry_snapshot(self) -> dict:
+        """``GET /telemetry.json`` payload (telemetry/remote.py wire format):
+        exact per-source sketch state under a process-monotonic ``seq``, so a
+        remote scraper's merge is bit-identical to an in-process merge."""
+        with self._snapshot_lock:
+            self._snapshot_seq += 1
+            seq = self._snapshot_seq
+        sources = self._obs_sources()
+        sources[0][1].count("obs_snapshot_requests")
+        extra = self.slo.gauges() if self.slo is not None else None
+        return build_snapshot(f"serving:{self.port}", sources, seq,
+                              extra_gauges=extra)
 
     def observe_request(self, t0: float, ok: bool, trace=None,
                         status: str = "ok") -> None:

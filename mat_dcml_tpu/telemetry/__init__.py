@@ -23,7 +23,22 @@ from mat_dcml_tpu.telemetry.flight_recorder import (
     unpack_tree,
 )
 from mat_dcml_tpu.telemetry.jit_instrument import InstrumentedJit, instrumented_jit
+from mat_dcml_tpu.telemetry.propagate import (
+    TRACEPARENT_HEADER,
+    extract as extract_traceparent,
+    format_traceparent,
+    inject as inject_traceparent,
+    parse_traceparent,
+)
 from mat_dcml_tpu.telemetry.registry import HistogramSketch, Telemetry
+from mat_dcml_tpu.telemetry.remote import (
+    RemoteScraper,
+    TelemetrySidecar,
+    build_snapshot,
+    deserialize_telemetry,
+    serialize_telemetry,
+    snapshot_aggregator,
+)
 from mat_dcml_tpu.telemetry.scopes import (
     ProbeSink,
     named_scope,
@@ -50,22 +65,33 @@ __all__ = [
     "InstrumentedJit",
     "ProbeSink",
     "ProfilerWindow",
+    "RemoteScraper",
     "SLOConfig",
     "SLOMonitor",
+    "TRACEPARENT_HEADER",
     "Telemetry",
     "TelemetryAggregator",
+    "TelemetrySidecar",
     "TraceContext",
     "Tracer",
+    "build_snapshot",
+    "deserialize_telemetry",
     "device_memory_gauges",
+    "extract_traceparent",
+    "format_traceparent",
     "host_rss_bytes",
+    "inject_traceparent",
     "instrumented_jit",
     "load_bundle",
     "named_scope",
     "named_scopes_enabled",
     "pack_tree",
+    "parse_traceparent",
     "probe",
     "replica_hbm_high_water_bytes",
+    "serialize_telemetry",
     "set_named_scopes",
     "set_probe_sink",
+    "snapshot_aggregator",
     "unpack_tree",
 ]

@@ -1,0 +1,100 @@
+"""Cross-process trace propagation: a W3C-traceparent-style header codec.
+
+A trace minted client-side (``HttpPolicyClient`` in ``serving/server.py`` or
+a loadgen dispatcher) crosses the HTTP boundary as one request header::
+
+    traceparent: 00-<trace-id: 32 hex>-<parent-id: 16 hex>-<flags: 2 hex>
+
+mirroring the W3C Trace Context wire format so any off-the-shelf proxy or
+collector that understands ``traceparent`` interoperates.  The server side
+(``PolicyServer._Handler.do_POST``) extracts the trace id and continues the
+SAME trace through routing → queueing → decode via
+``Tracer.continue_trace`` — the client's root span and the server's
+``request`` span then share one trace id across two ``trace.jsonl`` files,
+and ``scripts/obs_report.py`` stitches them back into one tree.
+
+Internal trace ids are 16 lowercase hex chars (``uuid4().hex[:16]``); on the
+wire they are left-padded to the 32-hex W3C width and stripped back on
+extraction, so locally-minted and externally-minted (full-width) ids both
+round-trip losslessly.
+
+Sampling semantics: only sampled requests carry the header (an unsampled
+request has no client trace to continue), so the ``sampled`` flag is ``01``
+on everything we emit; extraction honors an explicit ``00`` by reporting no
+trace — the upstream decided not to record.
+
+Stdlib-only, no I/O: pure string codec plus dict/Message header helpers.
+"""
+
+from __future__ import annotations
+
+import re
+import uuid
+from typing import Mapping, NamedTuple, Optional
+
+TRACEPARENT_HEADER = "traceparent"
+
+_VERSION = "00"
+_HEX = re.compile(r"^[0-9a-f]+$")
+_TRACEPARENT = re.compile(
+    r"^([0-9a-f]{2})-([0-9a-f]{32})-([0-9a-f]{16})-([0-9a-f]{2})$")
+
+
+class TraceParent(NamedTuple):
+    """Decoded header: ``trace_id`` in the repo's internal width (16 hex when
+    the padded upper half is zero, the full 32 otherwise)."""
+
+    trace_id: str
+    parent_id: str
+    sampled: bool
+
+
+def format_traceparent(trace_id: str, parent_id: Optional[str] = None,
+                       sampled: bool = True) -> str:
+    """Render the header value for ``trace_id``.  ``parent_id`` identifies the
+    client-side root span (minted fresh when omitted)."""
+    tid = str(trace_id).lower()
+    if not _HEX.match(tid) or len(tid) > 32:
+        raise ValueError(f"trace id must be <=32 hex chars, got {trace_id!r}")
+    pid = (parent_id or uuid.uuid4().hex[:16]).lower()
+    if not _HEX.match(pid) or len(pid) > 16:
+        raise ValueError(f"parent id must be <=16 hex chars, got {parent_id!r}")
+    return (f"{_VERSION}-{tid.rjust(32, '0')}-{pid.rjust(16, '0')}-"
+            f"{'01' if sampled else '00'}")
+
+
+def parse_traceparent(value: Optional[str]) -> Optional[TraceParent]:
+    """Decode a header value; ``None`` on anything malformed (a bad header
+    must degrade to 'no trace', never to a 4xx/5xx)."""
+    if not value:
+        return None
+    m = _TRACEPARENT.match(value.strip().lower())
+    if m is None:
+        return None
+    version, tid32, pid, flags = m.groups()
+    if version == "ff" or tid32 == "0" * 32 or pid == "0" * 16:
+        return None
+    # strip the pad back to the internal 16-hex width when the upper half is
+    # zero; a genuinely 32-hex external id passes through whole
+    tid = tid32[16:] if tid32[:16] == "0" * 16 else tid32
+    return TraceParent(tid, pid, flags != "00")
+
+
+def inject(headers: dict, trace) -> dict:
+    """Add the traceparent header for ``trace`` (a ``TraceContext`` or a bare
+    trace-id string) to a mutable header dict; no-op on ``None`` (unsampled
+    request).  Returns ``headers`` for chaining."""
+    trace_id = getattr(trace, "trace_id", trace)
+    if trace_id:
+        headers[TRACEPARENT_HEADER] = format_traceparent(str(trace_id))
+    return headers
+
+
+def extract(headers: Mapping[str, str]) -> Optional[str]:
+    """Trace id from a request's headers (``http.server`` Message objects and
+    plain dicts both expose ``.get``), or ``None`` when absent, malformed, or
+    explicitly unsampled."""
+    parsed = parse_traceparent(headers.get(TRACEPARENT_HEADER))
+    if parsed is None or not parsed.sampled:
+        return None
+    return parsed.trace_id

@@ -117,6 +117,41 @@ class HistogramSketch:
             name + "_mean": self.mean,
         }
 
+    # ------------------------------------------------------- wire round-trip
+
+    def to_dict(self) -> Dict:
+        """Exact JSON-safe state: the five fields ``merge``/``quantile`` read.
+        Python floats survive a JSON round-trip bit-for-bit (repr round-trip),
+        so a sketch merged after ``to_dict``/``from_dict`` yields the SAME
+        quantiles as merging the live objects — the property the remote scrape
+        plane depends on.  The empty-sketch sentinels (``vmin=inf``,
+        ``vmax=-inf``) encode as ``null`` since strict JSON has no Inf."""
+        return {
+            "buckets": list(self.buckets),
+            "count": self.count,
+            "total": self.total,
+            "vmin": None if self.count == 0 else self.vmin,
+            "vmax": None if self.count == 0 else self.vmax,
+        }
+
+    @classmethod
+    def from_dict(cls, d: Dict) -> "HistogramSketch":
+        sk = cls()
+        buckets = [int(n) for n in d.get("buckets", [])]
+        # pad/clip so sketches from a build with a different NBUCKETS merge
+        # instead of raising; extra tail buckets collapse into the last one
+        if len(buckets) > cls.NBUCKETS:
+            head, tail = buckets[: cls.NBUCKETS], buckets[cls.NBUCKETS:]
+            head[-1] += sum(tail)
+            buckets = head
+        sk.buckets = buckets + [0] * (cls.NBUCKETS - len(buckets))
+        sk.count = int(d.get("count", 0))
+        sk.total = float(d.get("total", 0.0))
+        vmin, vmax = d.get("vmin"), d.get("vmax")
+        sk.vmin = math.inf if vmin is None else float(vmin)
+        sk.vmax = -math.inf if vmax is None else float(vmax)
+        return sk
+
 
 class Telemetry:
     def __init__(self, enabled: bool = True):

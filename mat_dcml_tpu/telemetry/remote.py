@@ -1,0 +1,326 @@
+"""Remote telemetry federation: exact snapshot wire format, sidecar, scraper.
+
+The in-process :class:`~mat_dcml_tpu.telemetry.aggregate.TelemetryAggregator`
+merges live ``Telemetry`` references; this module extends the same exact-merge
+semantics across process boundaries:
+
+- :func:`serialize_telemetry` / :func:`deserialize_telemetry` round-trip a
+  registry's counters, gauges, and :class:`HistogramSketch` state through
+  JSON **losslessly** (the sketch's five merge-relevant fields travel as-is,
+  so a remotely merged p50/p95/p99 is bit-for-bit identical to merging the
+  live objects — NOT a re-parse of Prometheus text, which rounds to 6
+  significant digits).
+- :func:`build_snapshot` shapes the ``GET /telemetry.json`` payload: labelled
+  per-source registries, a **monotonic** per-process ``seq``, a wall-clock
+  stamp, and the supervisor's ``run_id``/``incarnation`` lineage when the
+  process runs under ``scripts/train_supervisor.py``.
+- :class:`TelemetrySidecar` is the opt-in stdlib HTTP thread
+  (``--obs_port`` in training, built into ``PolicyServer`` for serving) that
+  exposes that payload, so every process in a soak joins one scrape plane.
+- :class:`RemoteScraper` polls N endpoints, keeps the **latest snapshot per
+  source label** (a restart replaces the entry — seq going backwards is the
+  restart signal — so cumulative counters are never double-counted), marks
+  dead sources stale instead of zeroing them, and exposes the merged view
+  through a plain ``TelemetryAggregator``.
+
+Everything is stdlib (urllib + http.server); nothing touches jax.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Dict, Iterable, List, Optional, Tuple
+
+from .aggregate import TelemetryAggregator
+from .registry import HistogramSketch, Telemetry
+
+SNAPSHOT_PATH = "/telemetry.json"
+
+# supervisor-minted lineage (scripts/train_supervisor.py exports these into
+# every child so relaunches of one logical run are queryable as one run)
+RUN_ID_ENV = "MAT_DCML_RUN_ID"
+INCARNATION_ENV = "MAT_DCML_INCARNATION"
+
+
+def run_identity() -> Dict[str, object]:
+    """``{"run_id": ..., "incarnation": ...}`` from the supervisor env vars,
+    empty when not running under the supervisor."""
+    out: Dict[str, object] = {}
+    rid = os.environ.get(RUN_ID_ENV)
+    if rid:
+        out["run_id"] = rid
+    inc = os.environ.get(INCARNATION_ENV)
+    if inc is not None and inc.isdigit():
+        out["incarnation"] = int(inc)
+    return out
+
+
+# ------------------------------------------------------------ wire round-trip
+
+
+def serialize_telemetry(tel: Telemetry) -> Dict:
+    """One registry as exact JSON: counters/gauges verbatim, sketches via
+    :meth:`HistogramSketch.to_dict`.  Dict copies make this safe against the
+    recording side's plain assignments (same policy as the aggregator)."""
+    return {
+        "counters": dict(tel.counters),
+        "gauges": dict(tel._gauges),
+        "hists": {name: sk.to_dict() for name, sk in dict(tel.hists).items()},
+    }
+
+
+def deserialize_telemetry(data: Dict) -> Telemetry:
+    """Rebuild a ``Telemetry`` holder an aggregator can consume as a source.
+    The holder is read-side only — flushing it would restart interval state —
+    but counters/gauges/hists carry the exact remote values."""
+    tel = Telemetry()
+    tel.counters = {str(k): float(v)
+                    for k, v in (data.get("counters") or {}).items()}
+    tel._gauges = {str(k): float(v)
+                   for k, v in (data.get("gauges") or {}).items()}
+    tel.hists = {str(k): HistogramSketch.from_dict(v)
+                 for k, v in (data.get("hists") or {}).items()}
+    return tel
+
+
+def build_snapshot(source: str, sources: Iterable[Tuple[str, Telemetry]],
+                   seq: int, extra_gauges: Optional[Dict[str, float]] = None,
+                   ) -> Dict:
+    """The ``GET /telemetry.json`` payload: every labelled registry of this
+    process serialized exactly, under a monotonic ``seq`` (scrape-side restart
+    detection) and the supervisor lineage."""
+    snap: Dict = {
+        "source": str(source),
+        "seq": int(seq),
+        "time_s": time.time(),
+        "sources": {label: serialize_telemetry(tel)
+                    for label, tel in sources},
+    }
+    if extra_gauges:
+        snap["extra_gauges"] = {k: float(v) for k, v in extra_gauges.items()}
+    snap.update(run_identity())
+    return snap
+
+
+def snapshot_aggregator(snapshots: Iterable[Dict]) -> TelemetryAggregator:
+    """Aggregator over deserialized snapshots, each sub-source labelled
+    ``<snapshot source>/<sub label>`` so two processes' batcher registries
+    stay distinct.  This is the in-process reference merge the collector's
+    remote merge is tested bit-for-bit against."""
+    agg = TelemetryAggregator()
+    for snap in snapshots:
+        src = str(snap.get("source", "?"))
+        for label, data in (snap.get("sources") or {}).items():
+            agg.add_source(f"{src}/{label}", deserialize_telemetry(data))
+    return agg
+
+
+# ------------------------------------------------------------------- sidecar
+
+
+class _SidecarHandler(BaseHTTPRequestHandler):
+    server_version = "mat-dcml-obs/1"
+
+    def log_message(self, fmt, *args):
+        self.server.log_fn("[obs] " + fmt % args)
+
+    def do_GET(self):
+        sidecar: "TelemetrySidecar" = self.server.sidecar
+        if self.path == SNAPSHOT_PATH:
+            body = json.dumps(sidecar.snapshot()).encode()
+        elif self.path == "/healthz":
+            body = json.dumps({"ok": True, "source": sidecar.label}).encode()
+        else:
+            body = json.dumps({"error": f"no route {self.path}"}).encode()
+            self.send_response(404)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+            return
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+
+class TelemetrySidecar:
+    """Opt-in stdlib HTTP thread exposing a process's registries at
+    ``/telemetry.json`` so training/loadgen processes join the scrape plane
+    (``PolicyServer`` serves the same payload natively).
+
+    ``sources`` may be a single ``Telemetry``, a ``{label: Telemetry}`` dict,
+    or a zero-arg callable returning ``[(label, tel), ...]`` for processes
+    whose source set changes (a fleet gaining replicas).  Each served
+    snapshot bumps ``obs_snapshot_requests`` on the first registry and a
+    process-monotonic ``seq``."""
+
+    def __init__(self, sources, port: int = 0, host: str = "127.0.0.1",
+                 label: str = "trainer",
+                 extra_gauges_fn: Optional[Callable[[], Dict]] = None,
+                 log_fn=print):
+        if isinstance(sources, Telemetry):
+            sources = {label: sources}
+        if isinstance(sources, dict):
+            fixed = [(str(k), v) for k, v in sources.items()]
+            self._sources_fn = lambda: fixed
+        else:
+            self._sources_fn = sources
+        self.label = label
+        self.extra_gauges_fn = extra_gauges_fn
+        self._seq = 0
+        self._seq_lock = threading.Lock()
+        self._httpd = ThreadingHTTPServer((host, port), _SidecarHandler)
+        self._httpd.sidecar = self
+        self._httpd.log_fn = log_fn
+        self._thread: Optional[threading.Thread] = None
+        self.log_fn = log_fn
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    def snapshot(self) -> Dict:
+        with self._seq_lock:
+            self._seq += 1
+            seq = self._seq
+        sources = list(self._sources_fn())
+        if sources:
+            sources[0][1].count("obs_snapshot_requests")
+        extra = self.extra_gauges_fn() if self.extra_gauges_fn else None
+        return build_snapshot(self.label, sources, seq, extra_gauges=extra)
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="obs-sidecar", daemon=True)
+        self._thread.start()
+        self.log_fn(f"[obs] telemetry sidecar on "
+                    f"http://{self._httpd.server_address[0]}:{self.port}"
+                    f"{SNAPSHOT_PATH} (source={self.label})")
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+
+
+# ------------------------------------------------------------------- scraper
+
+
+class _Source:
+    """Scrape-side state for one endpoint: the latest accepted snapshot plus
+    liveness bookkeeping."""
+
+    def __init__(self, label: str, url: str):
+        self.label = label
+        self.url = url
+        self.snapshot: Optional[Dict] = None
+        self.seq: Optional[int] = None
+        self.last_ok_s: Optional[float] = None
+        self.stale = True            # never scraped = stale, not zero
+        self.errors = 0
+        self.restarts = 0
+
+
+class RemoteScraper:
+    """Polls N ``/telemetry.json`` endpoints and maintains the merged view.
+
+    Degradation contract: a dead source keeps its **last accepted snapshot**
+    and is marked stale (``mark stale, never zero`` — its cumulative counters
+    are still the truest known value), so the merged report keeps serving
+    from the remaining sources.  Recovery is seq-guarded: a snapshot whose
+    ``seq`` went backwards means the process restarted (fresh counters); the
+    stored entry is REPLACED, never summed with its predecessor, so restarts
+    cannot double-count counters.
+    """
+
+    def __init__(self, endpoints: Iterable[Tuple[str, str]],
+                 timeout_s: float = 2.0, stale_after_s: float = 10.0,
+                 log_fn=print):
+        self.sources: Dict[str, _Source] = {}
+        for label, url in endpoints:
+            url = url.rstrip("/")
+            if not url.endswith(SNAPSHOT_PATH):
+                url += SNAPSHOT_PATH
+            self.sources[str(label)] = _Source(str(label), url)
+        self.timeout_s = float(timeout_s)
+        self.stale_after_s = float(stale_after_s)
+        self.log_fn = log_fn
+        self.polls = 0
+
+    # ------------------------------------------------------------- polling
+
+    def _fetch(self, src: _Source) -> Optional[Dict]:
+        with urllib.request.urlopen(src.url, timeout=self.timeout_s) as resp:
+            return json.loads(resp.read())
+
+    def poll(self) -> Dict[str, float]:
+        """Scrape every endpoint once; returns the ``scrape_*`` health
+        fragment.  Network/parse failures count and mark stale but never
+        raise — the collector must outlive its sources."""
+        self.polls += 1
+        now = time.monotonic()
+        for src in self.sources.values():
+            try:
+                snap = self._fetch(src)
+                seq = int(snap.get("seq", 0))
+            except (urllib.error.URLError, OSError, ValueError,
+                    json.JSONDecodeError) as e:
+                src.errors += 1
+                if src.last_ok_s is None or \
+                        now - src.last_ok_s > self.stale_after_s:
+                    if not src.stale and src.snapshot is not None:
+                        self.log_fn(f"[scrape] source {src.label} stale "
+                                    f"({e.__class__.__name__}); keeping last "
+                                    f"snapshot seq={src.seq}")
+                    src.stale = True
+                continue
+            if src.seq is not None and seq < src.seq:
+                # seq went backwards: the process restarted with fresh
+                # counters — replace the entry (never sum old + new)
+                src.restarts += 1
+                self.log_fn(f"[scrape] source {src.label} restarted "
+                            f"(seq {src.seq} -> {seq}); replacing snapshot")
+            src.snapshot = snap
+            src.seq = seq
+            src.last_ok_s = now
+            src.stale = False
+        return self.scrape_record()
+
+    # ------------------------------------------------------------- reading
+
+    def snapshots(self) -> List[Dict]:
+        """Latest accepted snapshot per source (stale ones included — their
+        counters remain the best known value)."""
+        return [s.snapshot for s in self.sources.values()
+                if s.snapshot is not None]
+
+    def aggregator(self) -> TelemetryAggregator:
+        return snapshot_aggregator(self.snapshots())
+
+    def scrape_record(self) -> Dict[str, float]:
+        return {
+            "scrape_sources": float(sum(
+                1 for s in self.sources.values() if s.snapshot is not None)),
+            "scrape_stale": float(sum(
+                1 for s in self.sources.values() if s.stale)),
+            "scrape_errors": float(sum(
+                s.errors for s in self.sources.values())),
+            "scrape_restarts": float(sum(
+                s.restarts for s in self.sources.values())),
+            "scrape_polls": float(self.polls),
+        }
+
+    def merged_record(self) -> Dict[str, float]:
+        """One flat record: the exact-merged fleet view plus scrape health."""
+        rec = self.aggregator().snapshot()
+        rec.update(self.scrape_record())
+        return rec

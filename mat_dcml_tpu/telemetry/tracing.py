@@ -129,6 +129,7 @@ class Tracer:
         self._fh = None
         self._lock = threading.Lock()
         self.traces_started = 0
+        self.traces_continued = 0
         self.spans_written = 0
 
     # ------------------------------------------------------------- sampling
@@ -147,6 +148,20 @@ class Tracer:
         self.traces_started += 1
         tid = trace_id or uuid.uuid4().hex[:16]
         return TraceContext(self, tid, kind, root=root)
+
+    def continue_trace(self, trace_id: str, kind: str = "serving",
+                       root: str = "request") -> Optional[TraceContext]:
+        """Continue a trace minted in ANOTHER process (telemetry/propagate.py
+        header extraction at HTTP ingress).  The remote client already made
+        the sampling decision — only propagated (= sampled) requests carry the
+        header — so the local counter is bypassed: dropping the continuation
+        here would orphan the client's root span.  Returns ``None`` only when
+        this tracer has nowhere to write."""
+        if self.path is None or not trace_id:
+            return None
+        self.traces_started += 1
+        self.traces_continued += 1
+        return TraceContext(self, trace_id, kind, root=root)
 
     # -------------------------------------------------------------- writing
 

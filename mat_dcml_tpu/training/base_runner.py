@@ -347,6 +347,21 @@ class BaseRunner:
                    max_mb=getattr(run, "trace_max_mb", 64.0))
             if getattr(run, "trace_sample", 0.0) > 0 else None
         )
+        # observability federation (telemetry/remote.py): --obs_port exposes
+        # this process's registry at /telemetry.json on a daemon sidecar
+        # thread, so a supervisor-relaunched trainer is scrapeable by
+        # scripts/obs_collector.py alongside the serving fleet
+        # (-1 binds an ephemeral port — harness-friendly; the bound port is
+        # announced on the OBS_PORT log line either way)
+        self.obs_sidecar = None
+        if int(getattr(run, "obs_port", 0) or 0) != 0:
+            from mat_dcml_tpu.telemetry.remote import TelemetrySidecar
+
+            self.obs_sidecar = TelemetrySidecar(
+                self.telemetry, port=max(0, int(run.obs_port)),
+                label="trainer", log_fn=log_fn)
+            self.obs_sidecar.start()
+            log_fn(f"OBS_PORT {self.obs_sidecar.port}")
         self._fused_fallback = 0.0
         self.start_episode = 0
 
@@ -616,6 +631,8 @@ class BaseRunner:
             # a tripwire profiler window still open at exit — normal return OR
             # a crash mid-run — must stop its trace or the xplane.pb is corrupt
             self.profile_window.close()
+            if self.obs_sidecar is not None:
+                self.obs_sidecar.stop()
             if self.tracer is not None:
                 self.tracer.close()
             # saves are async (checkpoint.py): the loop's last scheduled save

@@ -12,6 +12,13 @@ rotates once to ``metrics.jsonl.1`` (replacing any previous rotation) and a
 fresh file is started, so a 24h soak keeps at most ~2x ``max_mb`` on disk.
 ``scripts/check_metrics_schema.py`` validates rotated files alongside the
 live one.
+
+Run lineage: when ``scripts/train_supervisor.py`` launched this process it
+exports ``MAT_DCML_RUN_ID`` (stable across relaunches) and
+``MAT_DCML_INCARNATION`` (bumped per launch); every record written here gets
+both stamped in, so relaunches of one logical run federate into one
+queryable stream (the ``run_id``/``incarnation`` riders the schema CLI
+knows).
 """
 
 from __future__ import annotations
@@ -70,6 +77,9 @@ class MetricsWriter:
         self.run_dir = Path(run_dir)
         self.jsonl_path = self.run_dir / jsonl_name
         self.enabled = enabled
+        from mat_dcml_tpu.telemetry.remote import run_identity
+
+        self._stamp = run_identity()   # supervisor lineage riders (if any)
         self.max_bytes = int(max_mb * 1024 * 1024) if max_mb else 0
         self._bytes = 0
         self._tb = None
@@ -104,6 +114,8 @@ class MetricsWriter:
                 self._bytes = os.path.getsize(self.jsonl_path)
             except OSError:
                 self._bytes = 0
+        if self._stamp:
+            record = {**record, **self._stamp}
         line = json.dumps(record, default=_json_default) + "\n"
         if self.max_bytes and self._bytes + len(line) > self.max_bytes:
             self._rotate()

@@ -11,7 +11,11 @@ contract BENCH tooling and tests consume; this validator keeps it honest:
 - counters/rates/timers are non-negative;
 - every field name is known — either an exact name or one of the documented
   prefix/suffix families — so schema drift fails loudly instead of silently
-  growing unconsumed keys.
+  growing unconsumed keys;
+- the supervisor lineage riders (``run_id``: hex string, ``incarnation``:
+  non-negative int; stamped onto every record by utils/metrics.py when the
+  process runs under scripts/train_supervisor.py) are validated up front and
+  excepted from the numbers-only rule on any record shape.
 
 Usage:
     python scripts/check_metrics_schema.py [--strict] <metrics.jsonl | run_dir>
@@ -148,6 +152,13 @@ KNOWN_PREFIXES = (
     # counters, the expected-anomaly suppression counter, and the armed flag
     # gauge — plus the typed {"chaos": ...} event records validated separately
     "chaos_",
+    # federated scrape health (telemetry/remote.py RemoteScraper +
+    # scripts/obs_collector.py): live/stale source counts, scrape errors,
+    # seq-guarded restart detections, poll counter
+    "scrape_",
+    # observability-plane self-metering: /telemetry.json serve counter
+    # (TelemetrySidecar / PolicyServer) and the collector's own counters
+    "obs_",
 )
 
 # registry suffixes a histogram sketch appends on flush (registry.py
@@ -164,7 +175,11 @@ STRICT_FAMILY_PATTERNS = {
         r"|buckets|weight_swaps|shed|requests|queue_depth|deadline_misses"
         r"|degraded_ok|degraded_batches|degraded_failed|engine_failures"
         r"|batches|bucket_\d+|batch_fill|engine_ms|latency_ms|queue_wait_ms"
-        r"|decode_ms|dtype_bits)(_max|_sum|_p50|_p95|_p99|_count|_mean)?$"),
+        r"|decode_ms|dtype_bits"
+        # HTTP client-side (serving/server.py HttpPolicyClient): client wall
+        # minus the server-reported server_ms, and transport/HTTP failures
+        r"|client_overhead_ms|client_errors"
+        r")(_max|_sum|_p50|_p95|_p99|_count|_mean)?$"),
     "decode_cache_": re.compile(
         r"^decode_cache_(bytes_b\d+|steps|hit_fraction)$"),
     "fleet_": re.compile(
@@ -209,6 +224,11 @@ STRICT_FAMILY_PATTERNS = {
     "chaos_": re.compile(
         r"^chaos_(events_armed|events_fired|injected_faults"
         r"|suppressed_anomalies|active)$"),
+    "scrape_": re.compile(
+        r"^scrape_(sources|stale|errors|restarts|polls)$"),
+    "obs_": re.compile(
+        r"^obs_(snapshot_requests|collector_polls"
+        r"|collector_merged_records)$"),
 }
 
 # fields that must never go negative (counters, rates, timers, gauges)
@@ -465,6 +485,28 @@ def _validate_chaos(record, where: str) -> List[str]:
     return errs
 
 
+# supervisor lineage riders (utils/metrics.py stamps these onto EVERY record
+# written under scripts/train_supervisor.py — training, anomaly, emergency,
+# collector records alike): run_id is the stable hex id of the logical run,
+# incarnation the 1-based launch count.
+_RUN_ID_RE = re.compile(r"^[0-9a-f]{8,32}$")
+
+
+def _validate_riders(record, where: str) -> List[str]:
+    errs: List[str] = []
+    rid = record.get("run_id")
+    if rid is not None and (
+            not isinstance(rid, str) or not _RUN_ID_RE.match(rid)):
+        errs.append(f"{where}: rider 'run_id' must be an 8-32 char lowercase "
+                    f"hex string, got {rid!r}")
+    inc = record.get("incarnation")
+    if inc is not None and (
+            isinstance(inc, bool) or not isinstance(inc, int) or inc < 0):
+        errs.append(f"{where}: rider 'incarnation' must be a non-negative "
+                    f"integer, got {inc!r}")
+    return errs
+
+
 def validate_record(record, index: int = 0, strict_names: bool = True,
                     strict: bool = False) -> List[str]:
     """Errors for one parsed jsonl record (empty list = valid)."""
@@ -472,18 +514,24 @@ def validate_record(record, index: int = 0, strict_names: bool = True,
     where = f"record {index}"
     if not isinstance(record, dict):
         return [f"{where}: not a JSON object"]
+    if "run_id" in record or "incarnation" in record:
+        # lineage riders are validated here then stripped, so the typed
+        # record schemas and the numbers-only rule below never see them
+        errs.extend(_validate_riders(record, where))
+        record = {k: v for k, v in record.items()
+                  if k not in ("run_id", "incarnation")}
     if "anomaly" in record:
         # typed tripwire record — its own schema, BEFORE the numbers-only rule
-        return _validate_anomaly(record, where)
+        return errs + _validate_anomaly(record, where)
     if "emergency_checkpoint" in record:
         # typed emergency-checkpoint record — ditto
-        return _validate_emergency(record, where)
+        return errs + _validate_emergency(record, where)
     if "trace" in record:
         # span record (trace.jsonl; may interleave in mixed fixtures) — ditto
-        return _validate_trace(record, where)
+        return errs + _validate_trace(record, where)
     if "chaos" in record:
         # chaos fault-injection event record — ditto
-        return _validate_chaos(record, where)
+        return errs + _validate_chaos(record, where)
     for k, v in record.items():
         if isinstance(v, bool):
             errs.append(f"{where}: field {k!r} is a boolean (flags must not "
@@ -499,7 +547,8 @@ def validate_record(record, index: int = 0, strict_names: bool = True,
                 or k.startswith(("serving_", "fleet_", "rollout_", "shard_",
                                  "resilience_", "slo_",
                                  "decode_cache_", "async_",
-                                 "staleness_", "chaos_"))) and v < 0:
+                                 "staleness_", "chaos_",
+                                 "scrape_", "obs_"))) and v < 0:
             errs.append(f"{where}: field {k!r} is negative ({v})")
         if k in UNIT_INTERVAL and not (0.0 <= v <= 1.0):
             errs.append(f"{where}: field {k!r} must be in [0, 1], got {v}")
@@ -509,6 +558,11 @@ def validate_record(record, index: int = 0, strict_names: bool = True,
         elif strict and not _strict_ok(k):
             errs.append(f"{where}: field {k!r} is not in its family's "
                         f"documented vocabulary (--strict)")
+    if "scrape_sources" in record:
+        # federated merged record (obs_collector): a cross-process union of
+        # raw registry states — the per-subsystem flush contracts below are
+        # about single-process flush records and do not apply to it
+        return errs
     if "serving_qps" in record:  # serving benchmark record
         for k in REQUIRED_SERVING:
             if k not in record:

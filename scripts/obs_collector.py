@@ -1,0 +1,127 @@
+#!/usr/bin/env python
+"""Federated telemetry collector: scrape a whole service into one stream.
+
+Polls every ``--endpoint`` (serving fleet ``PolicyServer``s, ``--obs_port``
+trainers, loadgen sidecars — anything serving ``GET /telemetry.json``) on one
+interval and appends, per poll:
+
+- ``<out>/metrics.jsonl``  — one merged flat record: the exact cross-process
+  histogram/counter merge (``telemetry/remote.py``; bit-for-bit identical to
+  merging the live registries, NOT a Prometheus-text re-parse) plus the
+  ``scrape_*`` health fragment and ``obs_collector_*`` counters.  Validated
+  by ``scripts/check_metrics_schema.py``; rendered by
+  ``scripts/obs_report.py``.
+- ``<out>/snapshots.jsonl`` — the raw per-source snapshots behind that merge
+  (one line per poll), so any merged record can be re-derived and audited
+  offline.
+
+Degradation contract (inherited from ``RemoteScraper``): a dead source keeps
+its last accepted snapshot and is marked stale — never zeroed; a source whose
+``seq`` goes backwards restarted and REPLACES its entry — never summed — so
+counters are never double-counted across relaunches.
+
+Usage:
+    python scripts/obs_collector.py --out runs/obs \\
+        --endpoint fleet=http://127.0.0.1:8300 \\
+        --endpoint trainer=http://127.0.0.1:8401 \\
+        --endpoint loadgen=http://127.0.0.1:8402 \\
+        --interval 1.0 [--iterations N | --duration S]
+
+With neither ``--iterations`` nor ``--duration`` the collector runs until
+SIGTERM/SIGINT, flushing its files on the way out (soak-friendly).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import signal
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from mat_dcml_tpu.telemetry.remote import RemoteScraper  # noqa: E402
+from mat_dcml_tpu.utils.metrics import MetricsWriter  # noqa: E402
+
+
+def parse_endpoint(spec: str):
+    label, sep, url = spec.partition("=")
+    if not sep or not label or not url:
+        raise argparse.ArgumentTypeError(
+            f"--endpoint wants label=url, got {spec!r}")
+    return label, url
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        description=__doc__, formatter_class=argparse.RawDescriptionHelpFormatter
+    )
+    parser.add_argument("--endpoint", action="append", type=parse_endpoint,
+                        required=True, metavar="LABEL=URL",
+                        help="telemetry endpoint (repeatable); /telemetry.json "
+                             "is appended when missing")
+    parser.add_argument("--out", required=True,
+                        help="output dir for metrics.jsonl + snapshots.jsonl")
+    parser.add_argument("--interval", type=float, default=1.0,
+                        help="seconds between polls")
+    parser.add_argument("--iterations", type=int, default=0,
+                        help="stop after N polls (0 = no count limit)")
+    parser.add_argument("--duration", type=float, default=0.0,
+                        help="stop after S seconds (0 = no time limit)")
+    parser.add_argument("--stale_after", type=float, default=10.0,
+                        help="seconds without a successful scrape before a "
+                             "source is marked stale")
+    parser.add_argument("--timeout", type=float, default=2.0,
+                        help="per-request scrape timeout, seconds")
+    args = parser.parse_args(argv)
+
+    out = Path(args.out)
+    out.mkdir(parents=True, exist_ok=True)
+    scraper = RemoteScraper(args.endpoint, timeout_s=args.timeout,
+                            stale_after_s=args.stale_after)
+    writer = MetricsWriter(out)
+    stopping = {"sig": None}
+
+    def request_stop(signum, frame):
+        stopping["sig"] = signum
+
+    signal.signal(signal.SIGTERM, request_stop)
+    signal.signal(signal.SIGINT, request_stop)
+
+    print(f"[collector] scraping {len(scraper.sources)} endpoint(s) every "
+          f"{args.interval:.2f}s -> {out}", flush=True)
+    merged_records = 0
+    t_start = time.monotonic()
+    try:
+        with open(out / "snapshots.jsonl", "a") as raw:
+            while stopping["sig"] is None:
+                scraper.poll()
+                snaps = scraper.snapshots()
+                raw.write(json.dumps(
+                    {"poll": scraper.polls, "snapshots": snaps}) + "\n")
+                raw.flush()
+                rec = scraper.merged_record()
+                merged_records += 1
+                rec["obs_collector_polls"] = float(scraper.polls)
+                rec["obs_collector_merged_records"] = float(merged_records)
+                writer.write(rec)
+                if args.iterations and scraper.polls >= args.iterations:
+                    break
+                if args.duration and \
+                        time.monotonic() - t_start >= args.duration:
+                    break
+                time.sleep(args.interval)
+    finally:
+        writer.close()
+    health = scraper.scrape_record()
+    print("[collector] done: " + " ".join(
+        f"{k}={v:.0f}" for k, v in sorted(health.items())), flush=True)
+    # partial coverage is degraded, not failed — exit 0 as long as at least
+    # one source was ever scraped (the merged stream has content)
+    return 0 if health["scrape_sources"] > 0 else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -19,9 +19,26 @@ trees) — plus their rotated ``.1`` predecessors, and prints four panels:
 4. **Training health**: fps and step-timer trajectory, compile/recompile and
    nonfinite-grad counters, dispatch mode, and emergency checkpoints.
 
+**Multi-source (federation) mode** — repeated ``--source label=dir`` renders
+one coherent report across a whole service (serving fleet + trainer + loadgen
++ the ``scripts/obs_collector.py`` output dir):
+
+- a federation header with the collector's ``scrape_*`` / ``obs_*`` health
+  (stale sources are flagged, never silently dropped),
+- a **cross-process trace stitching** panel: span records grouped by trace id
+  across sources, counting traces that crossed a process boundary and showing
+  the client-root minus server-root overhead plus the slowest stitched
+  request (client wall, server wall, failover ``attempt`` hops),
+- a **chaos-vs-SLO timeline**: every chaos record correlated, in stream
+  order, with the nearest SLO burn / latency-tail observation before and
+  after it,
+- then the four per-source panels for each source in turn.
+
 Usage:
     python scripts/obs_report.py <run_dir>              # finds both streams
     python scripts/obs_report.py --metrics m.jsonl --trace t.jsonl
+    python scripts/obs_report.py --source fleet=runs/serve \\
+        --source trainer=runs/train --source collector=runs/obs
 
 Everything is stdlib; the report goes to stdout (pipe it into a file to keep
 it next to the run).  Exit 2 when no records are found at all.
@@ -266,7 +283,153 @@ def async_panel(metrics: List[dict]) -> List[str]:
     return lines
 
 
+# ------------------------------------------------------- federation panels
+
+
+def federation_panel(metrics: List[dict]) -> List[str]:
+    """Scrape-plane health from the collector's merged stream: source and
+    staleness counts, scrape errors, seq-guarded restarts."""
+    lines = ["== federation / scrape health =="]
+    latest = _last_with_prefix(metrics, ("scrape_", "obs_"))
+    if not latest:
+        return lines + ["  (no collector records)"]
+    for k in sorted(latest):
+        flag = "  <-- STALE SOURCES" if (
+            k == "scrape_stale" and latest[k] > 0) else ""
+        lines.append(f"  {k:<34} {latest[k]:>12.1f}{flag}")
+    riders = [r for r in metrics if "run_id" in r]
+    if riders:
+        last = riders[-1]
+        lines.append(f"  run lineage: run_id={last['run_id']} "
+                     f"incarnation={last.get('incarnation', '?')}")
+    return lines
+
+
+def stitch_panel(source_traces: Dict[str, List[dict]]) -> List[str]:
+    """Group span records by trace id ACROSS sources.  A trace id seen in
+    more than one source crossed a process boundary (W3C traceparent over
+    ``POST /v1/act``); for those, the client root minus the server root is
+    the network + client-stack overhead, and ``attempt`` spans under the same
+    id show failover hops."""
+    lines = ["== cross-process trace stitching =="]
+    by_trace: Dict[str, List[tuple]] = defaultdict(list)
+    for src, traces in source_traces.items():
+        for rec in traces:
+            tid = rec.get("trace")
+            if tid:
+                by_trace[str(tid)].append((src, rec))
+    multi = {tid: recs for tid, recs in by_trace.items()
+             if len({src for src, _ in recs}) > 1}
+    lines.append(f"  trace ids {len(by_trace)}  "
+                 f"stitched across processes {len(multi)}")
+    if not multi:
+        return lines + ["  (no trace id observed in more than one process)"]
+    overheads: List[float] = []
+    worst = None
+    for tid, recs in multi.items():
+        client = server = None
+        for src, r in recs:
+            if r.get("parent") is not None:
+                continue
+            if r.get("kind") == "client":
+                client = (src, r)
+            else:
+                server = (src, r)
+        if client is None or server is None:
+            continue
+        overheads.append(max(0.0, float(client[1].get("dur_ms", 0.0))
+                             - float(server[1].get("dur_ms", 0.0))))
+        if worst is None or float(client[1].get("dur_ms", 0.0)) > \
+                float(worst[1][1].get("dur_ms", 0.0)):
+            worst = (tid, client, server, recs)
+    if overheads:
+        lines.append(
+            f"  client-minus-server overhead: n={len(overheads)}  "
+            f"mean {sum(overheads) / len(overheads):.2f} ms  "
+            f"p95 {percentile(overheads, 0.95):.2f} ms  "
+            f"max {max(overheads):.2f} ms")
+    if worst is not None:
+        tid, (csrc, croot), (ssrc, sroot), recs = worst
+        lines.append(f"  -- slowest stitched trace {tid} --")
+        lines.append(f"    {csrc + '/' + str(croot.get('span', '?')):<36} "
+                     f"{float(croot.get('dur_ms', 0.0)):>9.2f} ms  "
+                     f"status={croot.get('status', '?')}")
+        lines.append(f"    {ssrc + '/' + str(sroot.get('span', '?')):<36} "
+                     f"{float(sroot.get('dur_ms', 0.0)):>9.2f} ms  "
+                     f"status={sroot.get('status', '?')}")
+        hops = sorted((r for _, r in recs if r.get("span") == "attempt"),
+                      key=lambda r: float(r.get("t_ms", 0.0)))
+        for hop in hops:
+            lines.append(f"      attempt replica={hop.get('replica', '?')} "
+                         f"ok={hop.get('ok', '?')} "
+                         f"{float(hop.get('dur_ms', 0.0)):.2f} ms")
+    return lines
+
+
+# keys worth correlating a chaos event against (tail latency + SLO burn)
+_CHAOS_WATCH_SUFFIXES = ("_ms_p99", "_ms_p95", "_burn")
+
+
+def _nearest_watch(metrics: List[dict], idx: int, step: int) -> Dict[str, float]:
+    """Walk the stream from ``idx`` in ``step`` direction to the first record
+    carrying any watched key; stream order is the honest alignment — these
+    files have no shared wall clock."""
+    i = idx + step
+    while 0 <= i < len(metrics):
+        found = {k: float(v) for k, v in metrics[i].items()
+                 if isinstance(v, (int, float)) and not isinstance(v, bool)
+                 and k.endswith(_CHAOS_WATCH_SUFFIXES)}
+        if found:
+            return found
+        i += step
+    return {}
+
+
+def chaos_timeline_panel(source_metrics: Dict[str, List[dict]]) -> List[str]:
+    lines = ["== chaos vs SLO / latency timeline =="]
+    any_event = False
+    for src in sorted(source_metrics):
+        metrics = source_metrics[src]
+        for idx, rec in enumerate(metrics):
+            if "chaos" not in rec:
+                continue
+            any_event = True
+            lines.append(f"  [{src}] {rec.get('chaos', '?')} "
+                         f"{rec.get('event_id', '?')}"
+                         + (f" t={float(rec['t_s']):.2f}s"
+                            if isinstance(rec.get("t_s"), (int, float)) else ""))
+            before = _nearest_watch(metrics, idx, -1)
+            after = _nearest_watch(metrics, idx, +1)
+            for k in sorted(set(before) & set(after)):
+                delta = after[k] - before[k]
+                lines.append(f"      {k:<32} {before[k]:>10.3f} -> "
+                             f"{after[k]:>10.3f}  ({delta:+.3f})")
+            for k in sorted(set(after) - set(before)):
+                lines.append(f"      {k:<32} {'-':>10} -> {after[k]:>10.3f}")
+    if not any_event:
+        lines.append("  (no chaos records)")
+    return lines
+
+
 # ----------------------------------------------------------------- assembly
+
+
+def build_multi_report(sources: "Dict[str, tuple]") -> str:
+    """``sources`` maps label -> (metrics, traces).  Federation panels first
+    (computed across the union), then the per-source panels."""
+    out: List[str] = [
+        f"==== federation report: {len(sources)} source(s): "
+        f"{', '.join(sorted(sources))} ===="
+    ]
+    all_metrics = [r for _, (m, _) in sorted(sources.items()) for r in m]
+    out += federation_panel(all_metrics)
+    out += stitch_panel({s: t for s, (_, t) in sources.items()})
+    out += chaos_timeline_panel({s: m for s, (m, _) in sources.items()})
+    for src in sorted(sources):
+        metrics, traces = sources[src]
+        out.append(f"\n==== source: {src} ====")
+        out.append(build_report(metrics, traces).rstrip("\n"))
+    return "\n".join(out) + "\n"
 
 
 def build_report(metrics: List[dict], traces: List[dict]) -> str:
@@ -279,31 +442,56 @@ def build_report(metrics: List[dict], traces: List[dict]) -> str:
     return "\n".join("\n".join(s) for s in sections) + "\n"
 
 
-def main(argv=None) -> int:
-    p = argparse.ArgumentParser(description="observability run report")
-    p.add_argument("run_dir", nargs="?", default=None,
-                   help="directory holding metrics.jsonl / trace.jsonl")
-    p.add_argument("--metrics", default=None)
-    p.add_argument("--trace", default=None)
-    args = p.parse_args(argv)
-
-    metrics_path = Path(args.metrics) if args.metrics else None
-    trace_path = Path(args.trace) if args.trace else None
-    if args.run_dir:
-        root = Path(args.run_dir)
+def load_streams(root: Optional[Path], metrics_path: Optional[Path] = None,
+                 trace_path: Optional[Path] = None):
+    """(metrics, traces) for one run dir, rotated files included and
+    trace-shaped records split out of mixed streams."""
+    if root is not None:
         if metrics_path is None:
             found = sorted(root.rglob("metrics.jsonl"))
             metrics_path = found[0] if found else None
         if trace_path is None:
             found = sorted(root.rglob("trace.jsonl"))
             trace_path = found[0] if found else None
-
     metrics = read_jsonl(with_rotated(metrics_path))
     traces = read_jsonl(with_rotated(trace_path))
     # trace records may interleave into metrics.jsonl-shaped fixtures; split
     # them by shape rather than by file so mixed streams still report
     traces += [r for r in metrics if "trace" in r]
     metrics = [r for r in metrics if "trace" not in r]
+    return metrics, traces
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description="observability run report")
+    p.add_argument("run_dir", nargs="?", default=None,
+                   help="directory holding metrics.jsonl / trace.jsonl")
+    p.add_argument("--metrics", default=None)
+    p.add_argument("--trace", default=None)
+    p.add_argument("--source", action="append", default=None,
+                   metavar="LABEL=DIR",
+                   help="federation mode (repeatable): render one report "
+                        "across several run dirs — fleet, trainer, loadgen, "
+                        "obs_collector output")
+    args = p.parse_args(argv)
+
+    if args.source:
+        sources: Dict[str, tuple] = {}
+        for spec in args.source:
+            label, sep, d = spec.partition("=")
+            if not sep or not label or not d:
+                p.error(f"--source wants label=dir, got {spec!r}")
+            sources[label] = load_streams(Path(d))
+        if not any(m or t for m, t in sources.values()):
+            print("no records found", file=sys.stderr)
+            return 2
+        sys.stdout.write(build_multi_report(sources))
+        return 0
+
+    metrics, traces = load_streams(
+        Path(args.run_dir) if args.run_dir else None,
+        Path(args.metrics) if args.metrics else None,
+        Path(args.trace) if args.trace else None)
     if not metrics and not traces:
         print("no records found", file=sys.stderr)
         return 2

@@ -32,20 +32,33 @@ Semantics (driven by the training side's exit codes, training/resilience.py):
 SIGTERM/SIGINT to the supervisor forward to the child (which takes its
 emergency checkpoint) and the supervisor exits with the child's code — so
 killing the supervisor IS the graceful-stop path, one level up.
+
+Relaunch lineage: the supervisor mints one stable ``run_id`` (or inherits
+``MAT_DCML_RUN_ID`` from an outer orchestrator) and exports it plus a
+per-launch ``MAT_DCML_INCARNATION`` into every child, so every metrics
+record, telemetry snapshot, and the supervisor's own exit record carry
+queryable ``run_id``/``incarnation`` riders — relaunches of one logical run
+federate into one stream (utils/metrics.py, telemetry/remote.py).
 """
 
 from __future__ import annotations
 
 import argparse
+import os
 import random
 import signal
 import subprocess
 import sys
 import time
+import uuid
 from pathlib import Path
 
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
+from mat_dcml_tpu.telemetry.remote import (  # noqa: E402
+    INCARNATION_ENV,
+    RUN_ID_ENV,
+)
 from mat_dcml_tpu.training.resilience import (  # noqa: E402
     EXIT_PREEMPTED,
     EXIT_WATCHDOG,
@@ -98,6 +111,9 @@ def main(argv=None) -> int:
     watchdog_exits = 0
     watchdog_exits_total = 0
     launches = 0
+    # one stable id per logical run, inherited if an outer orchestrator
+    # already minted one; each launch below bumps the incarnation
+    run_id = os.environ.get(RUN_ID_ENV) or uuid.uuid4().hex[:16]
 
     def write_metrics(last_rc: int) -> None:
         if args.metrics_file is None:
@@ -114,12 +130,20 @@ def main(argv=None) -> int:
                 # so the resilience_ family stays non-negative
                 "resilience_supervisor_last_exit":
                     last_rc if last_rc >= 0 else 128 - last_rc,
+                "run_id": run_id,
+                "incarnation": launches,
             }) + "\n")
 
     while True:
         launches += 1
-        print(f"[supervisor] launch {launches}: {' '.join(cmd)}", flush=True)
-        child = subprocess.Popen(cmd)
+        print(f"[supervisor] launch {launches} run_id={run_id}: "
+              f"{' '.join(cmd)}", flush=True)
+        child = subprocess.Popen(
+            cmd,
+            env={**os.environ,
+                 RUN_ID_ENV: run_id,
+                 INCARNATION_ENV: str(launches)},
+        )
         rc = child.wait()
         if forwarded["sig"] is not None:
             # our own stop was forwarded; the child already checkpointed

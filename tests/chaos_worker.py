@@ -11,7 +11,7 @@ Usage:
     python tests/chaos_worker.py --run_dir DIR --episodes N
         [--seed 1] [--save_interval 2] [--data_shards 1] [--devices 1]
         [--async_actors 0] [--chaos_plan PLAN.json] [--chaos_planes CSV]
-        [--chaos_skip_kinds CSV] [--tripwires 0]
+        [--chaos_skip_kinds CSV] [--tripwires 0] [--obs_port 0|-1|N]
 
 ``--async_actors 1`` switches to the overlapped actor-learner loop
 (--iters_per_dispatch drops to 1 — the two overlap strategies are mutually
@@ -89,6 +89,9 @@ def main() -> None:
     parser.add_argument("--chaos_planes", default="train_sync,train_async")
     parser.add_argument("--chaos_skip_kinds", default="")
     parser.add_argument("--tripwires", type=int, default=0)
+    parser.add_argument("--obs_port", type=int, default=0,
+                        help="serve /telemetry.json on this port (0 = off); "
+                             "the federation tests scrape it remotely")
     args = parser.parse_args()
 
     injector = None
@@ -124,6 +127,7 @@ def main() -> None:
         log_interval=1, telemetry_interval=1,
         save_interval=args.save_interval, run_dir=args.run_dir,
         anomaly_tripwires=bool(args.tripwires),
+        obs_port=args.obs_port,
         resume="auto", graceful_stop=True,
         emergency_snapshot_interval=1, data_shards=args.data_shards,
     )

@@ -1,0 +1,110 @@
+"""Serving-fleet worker for the federation tests (run via subprocess).
+
+A real 2-replica ``EngineFleet`` behind a ``PolicyServer`` in its own
+process: the cross-process tracing test POSTs ``/v1/act`` with a
+``traceparent`` header at it and asserts one trace id spans the client's
+root span, the HTTP hop, fleet routing, and a replica-failover retry; the
+collector test scrapes its ``GET /telemetry.json``.
+
+``--kill_replica N`` replaces replica N's ``engine.decode`` with an
+injected failure after warmup (probing is slowed to a crawl so the victim
+is never readmitted) — every request that routes to it fails over to the
+sibling, recording ``attempt`` spans under the propagated trace id.
+
+Prints ``PORT <n>`` once serving, then lingers until ``--linger_s`` expires
+or SIGTERM.  CFG/BUCKETS match tests/test_fleet.py so warmup hits the
+persistent compile cache (tests/conftest.py).
+
+Usage:
+    python tests/obs_worker.py --run_dir DIR [--kill_replica -1]
+        [--linger_s 60] [--trace_sample 1.0]
+"""
+
+import argparse
+import os
+import sys
+import time
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, repo_root)
+
+_cache_dir = os.environ.get(
+    "MAT_DCML_TPU_TEST_CACHE",
+    os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache"),
+)
+jax.config.update("jax_compilation_cache_dir", _cache_dir)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.1)
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+
+from mat_dcml_tpu.models.mat import MATConfig  # noqa: E402
+from mat_dcml_tpu.models.policy import TransformerPolicy  # noqa: E402
+from mat_dcml_tpu.serving.batcher import BatcherConfig  # noqa: E402
+from mat_dcml_tpu.serving.engine import EngineConfig  # noqa: E402
+from mat_dcml_tpu.serving.fleet import EngineFleet, FleetConfig  # noqa: E402
+from mat_dcml_tpu.serving.server import PolicyServer  # noqa: E402
+from mat_dcml_tpu.telemetry.tracing import Tracer  # noqa: E402
+
+BUCKETS = (2, 4)
+
+CFG = MATConfig(
+    n_agent=3, obs_dim=4, state_dim=5, action_dim=3,
+    n_block=1, n_embd=16, n_head=2,
+)
+
+
+def log(*a):
+    print(*a, flush=True)
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--run_dir", required=True)
+    parser.add_argument("--kill_replica", type=int, default=-1)
+    parser.add_argument("--linger_s", type=float, default=60.0)
+    parser.add_argument("--trace_sample", type=float, default=1.0)
+    args = parser.parse_args()
+
+    params = TransformerPolicy(CFG).init_params(jax.random.key(0))
+    tracer = Tracer(args.run_dir, sample=args.trace_sample)
+    fleet = EngineFleet(
+        params, CFG,
+        # probe interval >> linger: an injected-dead replica stays dead (no
+        # readmission racing the failover assertions)
+        fleet_cfg=FleetConfig(n_replicas=2, probe_interval_s=600.0),
+        engine_cfg=EngineConfig(buckets=BUCKETS),
+        batcher_cfg=BatcherConfig(max_batch_wait_ms=2.0),
+        tracer=tracer, log_fn=log,
+    )
+    fleet.warmup()
+    if args.kill_replica >= 0:
+        victim = fleet.replicas[args.kill_replica]
+
+        def dead(*a, **kw):
+            raise RuntimeError("injected device loss")
+
+        victim.engine.decode = dead
+        log(f"[obs_worker] killed replica {args.kill_replica}'s engine")
+
+    server = PolicyServer(fleet=fleet, port=0, log_fn=log)
+    server.warm = True        # fleet already warm; don't re-warm on start
+    server.start()
+    log(f"PORT {server.port}")
+    try:
+        time.sleep(args.linger_s)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        server.stop()
+        fleet.close()
+        tracer.close()
+    log("DONE")
+
+
+if __name__ == "__main__":
+    main()

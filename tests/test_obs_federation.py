@@ -1,0 +1,643 @@
+"""Cross-process observability federation (fast tier + subprocess legs).
+
+What the PR's acceptance hinges on:
+
+- **traceparent codec**: W3C ``traceparent`` format/parse round-trips the
+  tracer's 16-hex ids (padded to 32 on the wire), rejects malformed headers
+  by degrading to None — never an error — and honors the sampled flag.
+- **exact wire round-trip**: a ``HistogramSketch`` serialized to JSON,
+  deserialized in another process, and merged is **bit-for-bit** identical
+  to merging the live objects — counters, totals, and every quantile.
+- **graceful degradation**: a scraped source that dies mid-collection is
+  marked stale with its last snapshot retained (never zeroed); a source
+  whose ``seq`` goes backwards restarted and its entry is REPLACED, so
+  counters are never double-counted across relaunches.
+- **lineage riders**: ``scripts/train_supervisor.py`` exports one stable
+  ``run_id`` + a per-launch ``incarnation`` into every child; every metrics
+  record carries both and the schema CLI validates them on any record shape.
+- **one trace id across a real process boundary**: a loadgen-side
+  ``HttpPolicyClient`` root span and the serving fleet's ``request`` tree —
+  including a replica-failover retry — share one trace id end to end
+  (tests/obs_worker.py subprocess).
+- **federated collection**: ``scripts/obs_collector.py`` scraping three live
+  processes (fleet + trainer + loadgen) writes merged records whose
+  histogram quantiles are bit-identical to an in-process merge of the very
+  snapshots it persisted, validates against the schema, and renders through
+  ``scripts/obs_report.py --source`` multi-source mode.
+
+CFG/BUCKETS match tests/test_serving.py exactly so the persistent compile
+cache (tests/conftest.py) makes warmups cache hits.
+"""
+
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+from pathlib import Path
+
+import jax
+import numpy as np
+import pytest
+
+from mat_dcml_tpu.models.mat import MATConfig
+from mat_dcml_tpu.models.policy import TransformerPolicy
+from mat_dcml_tpu.serving.engine import DecodeEngine, EngineConfig
+from mat_dcml_tpu.serving.loadgen import run_load, synth_requests
+from mat_dcml_tpu.serving.server import HttpPolicyClient, PolicyServer
+from mat_dcml_tpu.telemetry.propagate import (
+    TRACEPARENT_HEADER,
+    extract,
+    format_traceparent,
+    inject,
+    parse_traceparent,
+)
+from mat_dcml_tpu.telemetry.registry import HistogramSketch, Telemetry
+from mat_dcml_tpu.telemetry.remote import (
+    INCARNATION_ENV,
+    RUN_ID_ENV,
+    RemoteScraper,
+    TelemetrySidecar,
+    build_snapshot,
+    deserialize_telemetry,
+    serialize_telemetry,
+    snapshot_aggregator,
+)
+from mat_dcml_tpu.telemetry.tracing import Tracer
+from mat_dcml_tpu.utils.metrics import MetricsWriter
+
+_REPO = Path(__file__).resolve().parent.parent
+
+
+def _load_script(name):
+    path = _REPO / "scripts" / f"{name}.py"
+    spec = importlib.util.spec_from_file_location(name, path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+check_metrics_schema = _load_script("check_metrics_schema")
+
+BUCKETS = (2, 4)
+
+CFG = MATConfig(
+    n_agent=3, obs_dim=4, state_dim=5, action_dim=3,
+    n_block=1, n_embd=16, n_head=2,
+)
+
+QUIET = lambda *a: None  # noqa: E731
+
+
+@pytest.fixture(scope="module")
+def params():
+    return TransformerPolicy(CFG).init_params(jax.random.key(0))
+
+
+@pytest.fixture(scope="module")
+def engine(params):
+    eng = DecodeEngine(
+        params, CFG, EngineConfig(buckets=BUCKETS), log_fn=QUIET
+    )
+    eng.warmup()
+    return eng
+
+
+def read_traces(path):
+    """{trace_id: [records]} from trace.jsonl; every record must validate."""
+    by_id = {}
+    for p in (Path(str(path) + ".1"), Path(path)):
+        if not p.exists():
+            continue
+        for i, line in enumerate(p.read_text().splitlines()):
+            rec = json.loads(line)
+            errs = check_metrics_schema.validate_record(rec, i)
+            assert errs == [], errs
+            by_id.setdefault(rec["trace"], []).append(rec)
+    return by_id
+
+
+# ============================================================ traceparent
+
+
+def test_traceparent_roundtrip_pads_and_strips_internal_ids():
+    # the tracer mints 16-hex ids; the wire wants 32 — pad out, strip back
+    header = format_traceparent("a" * 16, parent_id="b" * 16)
+    assert header == f"00-{'0' * 16}{'a' * 16}-{'b' * 16}-01"
+    parsed = parse_traceparent(header)
+    assert parsed.trace_id == "a" * 16          # pad stripped on extract
+    assert parsed.parent_id == "b" * 16
+    assert parsed.sampled is True
+    # a full-width foreign id passes through untouched
+    full = parse_traceparent(format_traceparent("c" * 32))
+    assert full.trace_id == "c" * 32
+
+
+def test_traceparent_malformed_degrades_to_none_never_raises():
+    bad = [
+        "",
+        "garbage",
+        "00-zz-bb-01",                                   # non-hex
+        f"ff-{'a' * 32}-{'b' * 16}-01",                  # version ff reserved
+        f"00-{'0' * 32}-{'b' * 16}-01",                  # all-zero trace id
+        f"00-{'a' * 32}-{'0' * 16}-01",                  # all-zero parent id
+        f"00-{'a' * 31}-{'b' * 16}-01",                  # short trace id
+        f"00-{'a' * 32}-{'b' * 16}",                     # missing flags
+    ]
+    for value in bad:
+        assert parse_traceparent(value) is None, value
+    with pytest.raises(ValueError):
+        format_traceparent("not hex!")
+
+
+def test_inject_extract_headers_and_sampled_flag():
+    headers = {}
+    inject(headers, "d" * 16)
+    assert TRACEPARENT_HEADER in headers
+    assert extract(headers) == "d" * 16
+    # unsampled upstream decision -> no server-side trace
+    unsampled = {TRACEPARENT_HEADER: format_traceparent("d" * 16,
+                                                        sampled=False)}
+    assert extract(unsampled) is None
+    # no header / None trace are silent no-ops
+    assert extract({}) is None
+    empty = {}
+    inject(empty, None)
+    assert empty == {}
+
+
+# ======================================================== exact wire merge
+
+
+def _filled_sketch(seed, n=500, scale=10.0):
+    rng = np.random.default_rng(seed)
+    sk = HistogramSketch()
+    for v in rng.gamma(2.0, scale, size=n):
+        sk.add(float(v))
+    return sk
+
+
+def test_sketch_json_roundtrip_is_bit_for_bit():
+    sk = _filled_sketch(3)
+    back = HistogramSketch.from_dict(
+        json.loads(json.dumps(sk.to_dict())))        # through real JSON text
+    assert back.buckets == sk.buckets
+    assert back.count == sk.count
+    assert back.total == sk.total                    # float repr round-trip
+    assert back.vmin == sk.vmin and back.vmax == sk.vmax
+    for q in (0.5, 0.95, 0.99, 0.999):
+        assert back.quantile(q) == sk.quantile(q)    # exact, not approx
+    # empty sketch: inf sentinels survive the null encoding
+    empty = HistogramSketch.from_dict(
+        json.loads(json.dumps(HistogramSketch().to_dict())))
+    assert empty.count == 0 and empty.vmin == float("inf")
+
+
+def test_remote_merge_bit_identical_to_live_merge():
+    """Merging deserialized snapshots must equal merging the live registries
+    — the property that makes /telemetry.json federation exact where
+    Prometheus-text re-parsing (6 sig digits) is not."""
+    a, b = Telemetry(), Telemetry()
+    for i, tel in enumerate((a, b)):
+        sk = _filled_sketch(11 + i, scale=5.0 * (i + 1))
+        tel.hists["serving_decode_ms"] = sk
+        tel.counters["serving_requests"] = 13.0 + i
+        tel._gauges["serving_queue_depth"] = 2.0 * i
+    # live merge (the in-process TelemetryAggregator path)
+    from mat_dcml_tpu.telemetry.aggregate import TelemetryAggregator
+
+    live = TelemetryAggregator([("a", a), ("b", b)]).snapshot()
+    # remote merge: serialize -> JSON text -> deserialize -> merge
+    snaps = [json.loads(json.dumps(build_snapshot(
+        lbl, [("0", tel)], seq=1))) for lbl, tel in (("a", a), ("b", b))]
+    remote = snapshot_aggregator(snaps).snapshot()
+    remote.pop("obs_snapshot_requests", None)
+    for k, v in live.items():
+        assert remote[k] == v, (k, remote[k], v)     # bit-for-bit
+    assert set(remote) == set(live)
+
+
+# ================================================== sidecar + scraper
+
+
+def test_sidecar_serves_monotonic_seq_and_run_identity(monkeypatch):
+    monkeypatch.setenv(RUN_ID_ENV, "feedc0de12345678")
+    monkeypatch.setenv(INCARNATION_ENV, "4")
+    tel = Telemetry()
+    tel.count("env_steps")
+    sidecar = TelemetrySidecar(tel, label="trainer", log_fn=QUIET)
+    sidecar.start()
+    try:
+        url = f"http://127.0.0.1:{sidecar.port}/telemetry.json"
+        snaps = []
+        for _ in range(3):
+            with urllib.request.urlopen(url, timeout=5) as r:
+                snaps.append(json.loads(r.read()))
+        assert [s["seq"] for s in snaps] == sorted(s["seq"] for s in snaps)
+        assert snaps[0]["seq"] < snaps[-1]["seq"]
+        assert snaps[0]["source"] == "trainer"
+        assert snaps[0]["run_id"] == "feedc0de12345678"
+        assert snaps[0]["incarnation"] == 4
+        assert snaps[-1]["sources"]["trainer"]["counters"]["env_steps"] == 1.0
+        # serving the snapshot meters itself
+        assert tel.counters["obs_snapshot_requests"] >= 3.0
+    finally:
+        sidecar.stop()
+
+
+def test_scraper_marks_dead_source_stale_keeps_last_snapshot():
+    """Kill one of two sources mid-collection: the merged view keeps the dead
+    source's last counters (stale, never zeroed) and polling never raises."""
+    a, b = Telemetry(), Telemetry()
+    a.counters["serving_requests"] = 10.0
+    b.counters["serving_requests"] = 32.0
+    sa = TelemetrySidecar(a, label="a", log_fn=QUIET)
+    sb = TelemetrySidecar(b, label="b", log_fn=QUIET)
+    sa.start(), sb.start()
+    scraper = RemoteScraper(
+        [("a", f"http://127.0.0.1:{sa.port}"),
+         ("b", f"http://127.0.0.1:{sb.port}")],
+        timeout_s=2.0, stale_after_s=0.0, log_fn=QUIET)
+    try:
+        rec = scraper.poll()
+        assert rec["scrape_sources"] == 2.0 and rec["scrape_stale"] == 0.0
+        sb.stop()                                   # source dies mid-run
+        rec = scraper.poll()                        # must NOT raise
+        assert rec["scrape_sources"] == 2.0         # last snapshot retained
+        assert rec["scrape_stale"] == 1.0
+        assert rec["scrape_errors"] >= 1.0
+        merged = scraper.merged_record()
+        assert merged["serving_requests"] == 42.0   # dead counters still in
+        assert merged["scrape_stale"] == 1.0
+    finally:
+        sa.stop()
+
+
+def test_scraper_seq_guard_replaces_restarted_source_no_double_count():
+    old = Telemetry()
+    old.counters["serving_requests"] = 5.0
+    sidecar = TelemetrySidecar(old, label="fleet", log_fn=QUIET)
+    sidecar.start()
+    port = sidecar.port
+    scraper = RemoteScraper([("fleet", f"http://127.0.0.1:{port}")],
+                            stale_after_s=0.0, log_fn=QUIET)
+    scraper.poll()
+    scraper.poll()                                   # seq advances to 2
+    assert scraper.sources["fleet"].seq >= 2
+    sidecar.stop()
+    # process "relaunches" on the same port with FRESH counters
+    fresh = Telemetry()
+    fresh.counters["serving_requests"] = 2.0
+    sidecar = TelemetrySidecar(fresh, port=port, label="fleet", log_fn=QUIET)
+    sidecar.start()
+    try:
+        rec = scraper.poll()                         # seq went backwards
+        assert rec["scrape_restarts"] == 1.0
+        merged = scraper.merged_record()
+        # REPLACED, never summed: 2.0, not 5.0 + 2.0
+        assert merged["serving_requests"] == 2.0
+        assert merged["scrape_stale"] == 0.0         # recovered source is live
+    finally:
+        sidecar.stop()
+
+
+# ======================================================== lineage riders
+
+
+def test_metrics_writer_stamps_lineage_riders(tmp_path, monkeypatch):
+    monkeypatch.setenv(RUN_ID_ENV, "abcd1234abcd1234")
+    monkeypatch.setenv(INCARNATION_ENV, "3")
+    writer = MetricsWriter(tmp_path)
+    writer.write({"env_steps": 7})
+    writer.write({"anomaly": "fps_collapse", "signal": "fps", "value": 1.0,
+                  "baseline": 100.0, "episode": 1, "total_steps": 8})
+    writer.close()
+    recs = [json.loads(l)
+            for l in (tmp_path / "metrics.jsonl").read_text().splitlines()]
+    assert all(r["run_id"] == "abcd1234abcd1234" for r in recs)
+    assert all(r["incarnation"] == 3 for r in recs)
+    # riders validate on plain AND typed records, default and strict
+    for i, rec in enumerate(recs):
+        assert check_metrics_schema.validate_record(rec, i) == []
+        assert check_metrics_schema.validate_record(rec, i, strict=True) == []
+    # and malformed riders fail loudly
+    assert check_metrics_schema.validate_record(
+        {"env_steps": 1, "run_id": "NOT HEX"}) != []
+    assert check_metrics_schema.validate_record(
+        {"env_steps": 1, "incarnation": -2}) != []
+    assert check_metrics_schema.validate_record(
+        {"env_steps": 1, "incarnation": True}) != []
+
+
+def test_supervisor_exports_stable_run_id_and_bumps_incarnation(tmp_path):
+    """One crash-relaunch under the supervisor: both launches see the SAME
+    run_id, incarnations 1 then 2, and the supervisor's own exit record
+    carries the riders."""
+    child = tmp_path / "child.py"
+    child.write_text(
+        "import json, os, sys\n"
+        "out, marker = sys.argv[1], sys.argv[2]\n"
+        "with open(out, 'a') as f:\n"
+        "    f.write(json.dumps({'run_id': os.environ.get('MAT_DCML_RUN_ID'),"
+        " 'inc': os.environ.get('MAT_DCML_INCARNATION')}) + '\\n')\n"
+        "if not os.path.exists(marker):\n"
+        "    open(marker, 'w').write('x')\n"
+        "    sys.exit(1)\n"                          # first launch crashes
+        "sys.exit(0)\n")
+    seen = tmp_path / "seen.jsonl"
+    metrics = tmp_path / "supervisor.jsonl"
+    env = {k: v for k, v in os.environ.items() if k != RUN_ID_ENV}
+    proc = subprocess.run(
+        [sys.executable, str(_REPO / "scripts" / "train_supervisor.py"),
+         "--max-relaunches", "3", "--backoff-base", "0.01",
+         "--backoff-max", "0.05", "--metrics-file", str(metrics), "--",
+         sys.executable, str(child), str(seen), str(tmp_path / "marker")],
+        capture_output=True, text=True, env=env, timeout=120)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    launches = [json.loads(l) for l in seen.read_text().splitlines()]
+    assert len(launches) == 2
+    assert launches[0]["run_id"] == launches[1]["run_id"]
+    assert len(launches[0]["run_id"]) == 16
+    assert [l["inc"] for l in launches] == ["1", "2"]
+    rec = json.loads(metrics.read_text().splitlines()[-1])
+    assert rec["run_id"] == launches[0]["run_id"]
+    assert rec["incarnation"] == 2
+    assert check_metrics_schema.validate_record(rec, strict=True) == []
+
+
+# =================================== HTTP propagation (in-process server)
+
+
+def test_http_trace_propagation_overhead_histogram_and_tiling(
+        engine, tmp_path):
+    """HttpPolicyClient -> PolicyServer over real HTTP: the server CONTINUES
+    the client-minted trace id (no new sampling decision), the batcher's four
+    child spans still tile contiguously inside the propagated root, and the
+    client histograms its wall minus the reported server_ms."""
+    srv_dir, cli_dir = tmp_path / "srv", tmp_path / "cli"
+    srv_tracer = Tracer(str(srv_dir), sample=1.0)
+    server = PolicyServer(engine=engine, port=0, tracer=srv_tracer,
+                          log_fn=QUIET)
+    server.warm = True
+    server.start()
+    cli_tracer = Tracer(str(cli_dir), sample=1.0)
+    client = HttpPolicyClient(f"http://127.0.0.1:{server.port}", cfg=CFG,
+                              tracer=cli_tracer)
+    n = 4
+    try:
+        states, obs, avail = synth_requests(CFG, n, seed=21)
+        for i in range(n):
+            action, log_prob = client.act(states[i], obs[i], avail[i])
+            assert action.shape == (CFG.n_agent, 1)
+        # every server-side trace was a continuation, none locally minted
+        assert srv_tracer.traces_continued == n
+        # client overhead histogram: one sample per ok request, all finite
+        sk = client.telemetry.hists["serving_client_overhead_ms"]
+        assert sk.count == n
+        assert sk.vmin >= 0.0
+        # /telemetry.json exposes the batcher registry with a monotonic seq
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{server.port}/telemetry.json",
+                timeout=10) as r:
+            snap = json.loads(r.read())
+        assert snap["source"] == f"serving:{server.port}"
+        remote = deserialize_telemetry(snap["sources"]["0"])
+        live = server.batcher.telemetry
+        assert remote.counters["serving_requests"] == \
+            live.counters["serving_requests"]
+        assert remote.hists["serving_decode_ms"].quantile(0.99) == \
+            live.hists["serving_decode_ms"].quantile(0.99)   # exact
+    finally:
+        server.stop()
+        srv_tracer.close()
+        cli_tracer.close()
+
+    client_trees = read_traces(cli_dir / "trace.jsonl")
+    server_trees = read_traces(srv_dir / "trace.jsonl")
+    stitched = set(client_trees) & set(server_trees)
+    assert len(stitched) == n                       # one shared id per request
+    for tid in stitched:
+        c_root = [r for r in client_trees[tid] if r["parent"] is None][0]
+        assert c_root["span"] == "client_request" and c_root["kind"] == "client"
+        assert c_root["status"] == "ok"
+        s_recs = server_trees[tid]
+        s_root = [r for r in s_recs if r["parent"] is None][0]
+        assert s_root["span"] == "request" and s_root["kind"] == "serving"
+        # post-propagation tiling: the four batcher spans stay contiguous
+        children = sorted((r for r in s_recs if r["parent"] is not None),
+                          key=lambda r: r["t_ms"])
+        assert [c["span"] for c in children] == [
+            "queue_wait", "pad", "device_decode", "demux"]
+        for prev, nxt in zip(children, children[1:]):
+            assert prev["t_ms"] + prev["dur_ms"] == pytest.approx(
+                nxt["t_ms"], abs=1e-3)
+        child_sum = sum(c["dur_ms"] for c in children)
+        # the root also covers HTTP parse + reply serialization around the
+        # batcher window, so it bounds the tiled spans from above
+        assert child_sum <= s_root["dur_ms"] + 1e-3
+        assert children[-1]["t_ms"] + children[-1]["dur_ms"] <= \
+            s_root["dur_ms"] + 1e-3
+        # the client root wall covers the server-reported end-to-end
+        assert c_root["dur_ms"] + 1e-3 >= c_root["server_ms"]
+
+
+def test_run_load_http_mode_flushes_client_registry(engine, tmp_path):
+    """loadgen drives an HttpPolicyClient: the serving record carries the
+    client-overhead histogram fields and validates strictly."""
+    server = PolicyServer(engine=engine, port=0, log_fn=QUIET)
+    server.warm = True
+    server.start()
+    try:
+        client = HttpPolicyClient(f"http://127.0.0.1:{server.port}", cfg=CFG)
+        record = run_load(client, n_requests=6, concurrency=2, seed=5)
+        assert record["serving_ok"] == 6.0
+        assert record["serving_client_overhead_ms_count"] == 6.0
+        assert record["serving_client_overhead_ms_p50"] >= 0.0
+        writer = MetricsWriter(tmp_path)
+        writer.write(record)
+        writer.close()
+        assert check_metrics_schema.validate_file(
+            tmp_path / "metrics.jsonl", strict=True) == []
+    finally:
+        server.stop()
+
+
+# ====================================================== subprocess legs
+
+
+def _env():
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.setdefault("MAT_DCML_TPU_TEST_CACHE",
+                   str(_REPO / "tests" / ".jax_cache"))
+    env.pop(RUN_ID_ENV, None)
+    env.pop(INCARNATION_ENV, None)
+    return env
+
+
+def _spawn(cmd):
+    proc = subprocess.Popen(
+        cmd, stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        cwd=str(_REPO), env=_env())
+    lines = []
+
+    def pump():
+        for line in proc.stdout:
+            lines.append(line.rstrip("\n"))
+
+    threading.Thread(target=pump, daemon=True).start()
+    return proc, lines
+
+
+def _wait_token(proc, lines, prefix, timeout=300.0):
+    """Value of the first ``<prefix> <value>`` stdout line."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        for ln in list(lines):
+            if ln.startswith(prefix):
+                return ln.split()[1]
+        if proc.poll() is not None:
+            raise AssertionError(
+                f"process exited rc={proc.returncode} before {prefix!r}:\n"
+                + "\n".join(lines[-50:]))
+        time.sleep(0.05)
+    raise AssertionError(f"timeout waiting for {prefix!r}:\n"
+                         + "\n".join(lines[-50:]))
+
+
+def _stop(proc):
+    if proc.poll() is None:
+        proc.terminate()
+        try:
+            proc.wait(timeout=30)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait(timeout=10)
+
+
+def test_one_trace_id_spans_processes_and_failover(tmp_path):
+    """The acceptance trace: a client root span in THIS process and the
+    serving fleet's request tree in ANOTHER process share one trace id, and
+    at least one stitched tree records a replica-failover retry (failed
+    ``attempt`` then ok) because replica 0's engine is dead."""
+    srv_dir = tmp_path / "srv"
+    cli_dir = tmp_path / "cli"
+    worker, lines = _spawn(
+        [sys.executable, str(_REPO / "tests" / "obs_worker.py"),
+         "--run_dir", str(srv_dir), "--kill_replica", "0",
+         "--linger_s", "300"])
+    try:
+        port = _wait_token(worker, lines, "PORT")
+        tracer = Tracer(str(cli_dir), sample=1.0)
+        client = HttpPolicyClient(f"http://127.0.0.1:{port}", cfg=CFG,
+                                  tracer=tracer)
+        states, obs, avail = synth_requests(CFG, 8, seed=33)
+        for i in range(8):
+            action, _ = client.act(states[i], obs[i], avail[i])
+            assert action.shape == (CFG.n_agent, 1)   # failover: all succeed
+        tracer.close()
+    finally:
+        _stop(worker)
+
+    client_trees = read_traces(cli_dir / "trace.jsonl")
+    server_trees = read_traces(srv_dir / "trace.jsonl")
+    stitched = set(client_trees) & set(server_trees)
+    assert len(stitched) == 8, (sorted(client_trees), sorted(server_trees))
+    failed_over = 0
+    for tid in stitched:
+        c_root = [r for r in client_trees[tid] if r["parent"] is None][0]
+        assert c_root["kind"] == "client" and c_root["status"] == "ok"
+        attempts = [r for r in server_trees[tid] if r["span"] == "attempt"]
+        assert attempts, "fleet recorded no attempt spans"
+        assert attempts[-1]["ok"] is True
+        if any(a["ok"] is False for a in attempts):
+            failed_over += 1
+    assert failed_over >= 1, "no stitched trace crossed a failover retry"
+
+
+def test_collector_scrapes_three_live_processes_bit_identical(tmp_path):
+    """fleet + trainer + loadgen in three live processes; the collector's
+    merged records must be bit-identical to an in-process merge of the very
+    snapshots it persisted, validate strictly, and render through the
+    multi-source report."""
+    srv_dir = tmp_path / "srv"
+    train_dir = tmp_path / "train"
+    lg_dir = tmp_path / "lg"
+    obs_dir = tmp_path / "obs"
+    procs = []
+    try:
+        fleet, fl = _spawn(
+            [sys.executable, str(_REPO / "tests" / "obs_worker.py"),
+             "--run_dir", str(srv_dir), "--linger_s", "300"])
+        procs.append(fleet)
+        trainer, tl = _spawn(
+            [sys.executable, str(_REPO / "tests" / "chaos_worker.py"),
+             "--run_dir", str(train_dir), "--episodes", "500",
+             "--obs_port", "-1"])
+        procs.append(trainer)
+        fleet_port = _wait_token(fleet, fl, "PORT")
+        trainer_port = _wait_token(trainer, tl, "OBS_PORT")
+        loadgen, ll = _spawn(
+            [sys.executable, "-m", "mat_dcml_tpu.serving.loadgen",
+             "--server_url", f"http://127.0.0.1:{fleet_port}",
+             "--shape", "3,4,5,3", "--requests", "12", "--concurrency", "2",
+             "--obs_port", "-1", "--linger_s", "300",
+             "--run_dir", str(lg_dir), "--trace_sample", "1.0"])
+        procs.append(loadgen)
+        loadgen_port = _wait_token(loadgen, ll, "OBS_PORT")
+
+        collector = subprocess.run(
+            [sys.executable, str(_REPO / "scripts" / "obs_collector.py"),
+             "--out", str(obs_dir),
+             "--endpoint", f"fleet=http://127.0.0.1:{fleet_port}",
+             "--endpoint", f"trainer=http://127.0.0.1:{trainer_port}",
+             "--endpoint", f"loadgen=http://127.0.0.1:{loadgen_port}",
+             "--interval", "0.4", "--iterations", "5"],
+            capture_output=True, text=True, env=_env(), cwd=str(_REPO),
+            timeout=300)
+        assert collector.returncode == 0, collector.stdout + collector.stderr
+    finally:
+        for p in procs:
+            _stop(p)
+
+    merged = [json.loads(l) for l in
+              (obs_dir / "metrics.jsonl").read_text().splitlines()]
+    raw_polls = [json.loads(l) for l in
+                 (obs_dir / "snapshots.jsonl").read_text().splitlines()]
+    assert len(merged) == 5 and len(raw_polls) == 5
+    final = merged[-1]
+    assert final["scrape_sources"] == 3.0        # all three processes live
+    assert final["scrape_stale"] == 0.0
+    assert final["obs_collector_polls"] == 5.0
+
+    # THE federation invariant: for every poll, the collector's merged
+    # record equals the in-process merge of the snapshots it persisted —
+    # every counter, gauge, and histogram quantile, bit for bit.
+    for rec, poll in zip(merged, raw_polls):
+        assert rec["obs_collector_polls"] == float(poll["poll"])
+        reference = snapshot_aggregator(poll["snapshots"]).snapshot()
+        for k, v in reference.items():
+            assert rec[k] == v, (k, rec[k], v)
+
+    # the merged stream honors the metrics schema, strictly
+    errs = check_metrics_schema.validate_file(
+        obs_dir / "metrics.jsonl", strict=True)
+    assert errs == [], errs[:20]
+
+    # and the multi-source report stitches the whole service together
+    report = subprocess.run(
+        [sys.executable, str(_REPO / "scripts" / "obs_report.py"),
+         "--source", f"fleet={srv_dir}", "--source", f"trainer={train_dir}",
+         "--source", f"loadgen={lg_dir}", "--source", f"collector={obs_dir}"],
+        capture_output=True, text=True, env=_env(), cwd=str(_REPO),
+        timeout=120)
+    assert report.returncode == 0, report.stdout + report.stderr
+    out = report.stdout
+    assert "federation report: 4 source(s)" in out
+    assert "scrape_sources" in out
+    m = [l for l in out.splitlines() if "stitched across processes" in l]
+    assert m and int(m[0].rsplit(None, 1)[-1]) >= 12, m
+    assert "client-minus-server overhead" in out
