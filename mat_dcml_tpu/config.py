@@ -68,6 +68,11 @@ class RunConfig:
     # the classic two-dispatch loop.  Log/save/eval cadences snap UP to
     # dispatch boundaries; see README "Observability" for when not to raise it.
     iters_per_dispatch: int = 1
+    # tuned-config artifact from scripts/autotune.py: fills every perf knob
+    # the command line left at its default (explicit CLI flags always win;
+    # tuning/__init__.py:apply_tuned_cli).  A fingerprint mismatch — wrong
+    # backend/device count/model shape — warns and continues on defaults.
+    tuned_config: Optional[str] = None
     # annotate model/trainer phases with jax.named_scope so xplane traces and
     # scripts/trace_report.py group op time semantically; trace-time only
     trace_named_scopes: bool = True
@@ -248,4 +253,9 @@ def parse_cli_with_extras(
     ns = parser.parse_args(argv)  # strict: unknown flags raise
     run_kwargs = {f.name: getattr(ns, f.name) for f in dataclasses.fields(RunConfig)}
     ppo_kwargs = {f.name: getattr(ns, f.name) for f in dataclasses.fields(PPOConfig)}
-    return RunConfig(**run_kwargs), PPOConfig(**ppo_kwargs), ns
+    run, ppo = RunConfig(**run_kwargs), PPOConfig(**ppo_kwargs)
+    if ns.tuned_config:
+        from mat_dcml_tpu.tuning import apply_tuned_cli
+
+        run, ppo = apply_tuned_cli(ns.tuned_config, run, ppo, argv=argv)
+    return run, ppo, ns

@@ -254,6 +254,16 @@ class BaseRunner:
         self.telemetry = Telemetry()
         self.telemetry.rate("env_steps", "env_steps_per_sec")
         self.telemetry.rate("agent_steps", "agent_steps_per_sec")
+        # tuned-config application record (--tuned_config, applied by
+        # config.parse_cli_with_extras before the runner exists): publish the
+        # tune_ gauge family so metrics.jsonl shows which knobs this run
+        # actually trained with and what the search measured for them
+        from mat_dcml_tpu.tuning import last_application
+
+        tuned = last_application()
+        if getattr(run, "tuned_config", None) and tuned is not None:
+            for name, value in tuned.gauges().items():
+                self.telemetry.gauge(name, value)
         # host-loop collectors (vec-env bridge) drive jitted policy calls
         # internally and cannot themselves be traced
         if getattr(self.collector, "jittable", True):

@@ -1,0 +1,196 @@
+"""Perf-flag autotuning: probes, flag space, staged search, tuned artifacts.
+
+- :mod:`~mat_dcml_tpu.tuning.probe` — matched-pair A/B machinery
+  (``ab_trials`` + paired-ratio medians), shared with ``bench.py``.
+- :mod:`~mat_dcml_tpu.tuning.space` — declarative knob domains with typed
+  validity pruning, hardware fingerprints, the ``tuned_config.json``
+  artifact, and :class:`TunedConfigMismatchError`.
+- :mod:`~mat_dcml_tpu.tuning.search` — staged coordinate descent under a
+  wall-clock budget.
+- this module — the *load seams*: :func:`apply_tuned_cli` (training,
+  called from ``config.parse_cli_with_extras``; explicit CLI flags always
+  win) and :func:`apply_tuned_engine` (serving, ``scripts/serve_fleet.py``),
+  both recording a :class:`TunedApplication` whose :meth:`gauges` feed the
+  ``tune_`` telemetry family.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import sys
+from typing import Any, Dict, Optional
+
+from mat_dcml_tpu.tuning.probe import (  # noqa: F401
+    ProbeResult, ab_trials, median, median_of_ratios, paired_ratios,
+    probe_candidates,
+)
+from mat_dcml_tpu.tuning.search import SearchResult, staged_search  # noqa: F401
+from mat_dcml_tpu.tuning.space import (  # noqa: F401
+    ARTIFACT_VERSION, GROUP_ORDER, Fingerprint, FlagSpace, Knob, TunedConfig,
+    TunedConfigMismatchError, default_space,
+)
+
+
+@dataclasses.dataclass
+class TunedApplication:
+    """What happened when a tuned-config artifact met a run: which knobs
+    applied, which were beaten by explicit CLI flags, which target the other
+    plane, and whether the fingerprint matched at all."""
+
+    path: str
+    applied: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    overridden: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    skipped: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    provenance: Dict[str, dict] = dataclasses.field(default_factory=dict)
+    search: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    mismatch: bool = False
+
+    def gauges(self) -> Dict[str, float]:
+        """The ``tune_`` gauge family (schema:
+        ``scripts/check_metrics_schema.py``): applied/overridden knob counts,
+        the mismatch flag, search accounting, and per-knob measured ratios."""
+        g = {
+            "tune_applied": float(len(self.applied)),
+            "tune_overridden": float(len(self.overridden)),
+            "tune_mismatch": 1.0 if self.mismatch else 0.0,
+        }
+        for src, dst in (("wall_s", "tune_search_wall_s"),
+                         ("probes_run", "tune_probes"),
+                         ("probes_pruned", "tune_probes_pruned")):
+            v = self.search.get(src)
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                g[dst] = float(v)
+        for name in self.applied:
+            ratio = (self.provenance.get(name) or {}).get("ratio_vs_default")
+            if isinstance(ratio, (int, float)) and not isinstance(ratio, bool):
+                g[f"tune_ratio_{name}"] = float(ratio)
+        return g
+
+
+# the most recent application in this process; the training runner reads it
+# in finalize() to publish tune_ gauges into its telemetry registry
+_LAST: Optional[TunedApplication] = None
+
+
+def record_application(app: TunedApplication) -> None:
+    global _LAST
+    _LAST = app
+
+
+def last_application() -> Optional[TunedApplication]:
+    return _LAST
+
+
+def explicit_cli_flags(argv=None) -> set:
+    """Flag names the user spelled out (``--name`` / ``--name=value``) —
+    these always beat tuned values."""
+    if argv is None:
+        argv = sys.argv[1:]
+    names = set()
+    for a in argv:
+        if isinstance(a, str) and a.startswith("--"):
+            names.add(a[2:].split("=", 1)[0])
+    return names
+
+
+def apply_tuned_cli(path: str, run, ppo, argv=None, log=print):
+    """Training load seam (``config.parse_cli_with_extras``): fill every
+    RunConfig/PPOConfig knob the command line left at its default from the
+    artifact.  Fingerprint mismatch -> warn, record ``tune_mismatch``, and
+    return the configs unchanged (the run continues on defaults).
+    Serving-only knobs (``serve_``-prefixed) ride the artifact untouched."""
+    tc = TunedConfig.load(path)
+    app = TunedApplication(path=str(path), provenance=tc.provenance,
+                           search=tc.search)
+    current = Fingerprint.current(
+        preset=f"{run.env_name}:{run.scenario}",
+        n_block=run.n_block, n_embd=run.n_embd, n_head=run.n_head,
+    )
+    try:
+        tc.check(current)
+    except TunedConfigMismatchError as e:
+        app.mismatch = True
+        record_application(app)
+        log(f"[tune] IGNORING {path} ({e}); continuing on defaults")
+        return run, ppo
+
+    explicit = explicit_cli_flags(argv)
+    run_fields = {f.name for f in dataclasses.fields(run)}
+    ppo_fields = {f.name for f in dataclasses.fields(ppo)}
+    run_up: Dict[str, Any] = {}
+    ppo_up: Dict[str, Any] = {}
+    for name, value in tc.knobs.items():
+        if name in explicit:
+            app.overridden[name] = value
+        elif name in run_fields:
+            run_up[name] = value
+            app.applied[name] = value
+        elif name in ppo_fields:
+            ppo_up[name] = value
+            app.applied[name] = value
+        else:
+            app.skipped[name] = value
+    record_application(app)
+    if run_up:
+        run = dataclasses.replace(run, **run_up)
+    if ppo_up:
+        ppo = dataclasses.replace(ppo, **ppo_up)
+    if app.applied or app.overridden:
+        msg = f"[tune] applied {sorted(app.applied)} from {path}"
+        if app.overridden:
+            msg += f"; explicit CLI kept {sorted(app.overridden)}"
+        log(msg)
+    return run, ppo
+
+
+def apply_tuned_engine(path: str, engine_cfg, model_cfg=None,
+                       explicit=(), log=print):
+    """Serving load seam (``scripts/serve_fleet.py``): fill EngineConfig
+    fields the caller left unset from the artifact's ``serve_``/decode knobs.
+    ``model_cfg`` (a MATConfig, when available) tightens the fingerprint to
+    the model shape; the env preset is unknown at serve time and ignored.
+    Returns the (possibly replaced) EngineConfig; the application record is
+    available via :func:`last_application`."""
+    tc = TunedConfig.load(path)
+    app = TunedApplication(path=str(path), provenance=tc.provenance,
+                           search=tc.search)
+    ignore = ["preset"]
+    shape = dict(n_block=tc.fingerprint.n_block, n_embd=tc.fingerprint.n_embd,
+                 n_head=tc.fingerprint.n_head)
+    if model_cfg is not None:
+        shape = dict(n_block=model_cfg.n_block, n_embd=model_cfg.n_embd,
+                     n_head=model_cfg.n_head)
+    else:
+        ignore += ["n_block", "n_embd", "n_head"]
+    current = Fingerprint.current(preset=tc.fingerprint.preset, **shape)
+    try:
+        tc.check(current, ignore=tuple(ignore))
+    except TunedConfigMismatchError as e:
+        app.mismatch = True
+        record_application(app)
+        log(f"[tune] IGNORING {path} ({e}); serving on defaults")
+        return engine_cfg
+
+    # artifact knob name -> EngineConfig field (JSON lists become tuples)
+    mapping = {
+        "serve_buckets": ("buckets", lambda v: tuple(int(b) for b in v)),
+        "serve_dtype": ("serve_dtype", str),
+        "decode_mode": ("decode_mode", str),
+        "spec_block": ("spec_block", int),
+    }
+    updates: Dict[str, Any] = {}
+    for name, value in tc.knobs.items():
+        if name not in mapping:
+            app.skipped[name] = value
+            continue
+        field, conv = mapping[name]
+        if name in explicit or field in explicit:
+            app.overridden[name] = value
+        else:
+            updates[field] = conv(value)
+            app.applied[name] = value
+    record_application(app)
+    if updates:
+        engine_cfg = dataclasses.replace(engine_cfg, **updates)
+        log(f"[tune] serving applied {sorted(app.applied)} from {path}")
+    return engine_cfg

@@ -1,0 +1,118 @@
+"""Matched-pair probe machinery: alternating A/B trials + paired-ratio stats.
+
+One implementation of the measurement discipline every bench leg and the
+autotuner share, extracted from ``bench.py`` (the OBS / CHAOS / OBS_FED /
+cached-decode legs each re-derived pieces of it):
+
+- :func:`ab_trials` — best-of-N *alternating* trials: every leg runs once per
+  round, order reversed on odd rounds, so neither side systematically
+  inherits a cold cache or a neighbour's transient load.
+- :func:`paired_ratios` / :func:`median_of_ratios` — the matched-pair
+  estimator: round *i*'s legs ran back-to-back under the same transient
+  container load, so the per-round ratio cancels the drift and the median
+  sheds one-sided outlier rounds.  On a noisy shared-CPU box this is the
+  honest overhead/speedup estimate (the OBS_FED leg's contract metric).
+- :class:`ProbeResult` — per-candidate score series with best-of-N and a
+  relative-noise figure the tuned-config artifact records as provenance.
+
+No jax import here: probes receive callables; the timing/compile discipline
+(warmup excluded, zero steady-state recompiles asserted) lives with the
+caller that builds the leg — ``bench.py`` legs and
+``scripts/autotune.py``'s :class:`ProbeHarness`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+
+def ab_trials(legs: dict, trials: int, score=None) -> tuple:
+    """Best-of-N alternating-trial A/B runner — the pattern the OBS,
+    CACHED_DECODE, and ASYNC legs share.  Runs every leg callable once per
+    trial round, REVERSING the leg order on odd rounds so neither side
+    systematically inherits a cold cache or a neighbour's transient load.
+    On a shared-CPU container contention only ever *slows* a leg, so
+    best-of-N per side is the honest estimate of each configuration's
+    capability.  Returns ``(best, results)``: ``results[name]`` is the list
+    of per-round returns in run order; ``best[name]`` is the score-maximal
+    one (``None`` when no ``score`` is given — callers reducing per-metric,
+    like the decode leg's min-p50/max-QPS, use ``results`` directly)."""
+    results = {name: [] for name in legs}
+    names = list(legs)
+    for trial in range(max(trials, 1)):
+        order = names if trial % 2 == 0 else list(reversed(names))
+        for name in order:
+            results[name].append(legs[name]())
+    best = (None if score is None
+            else {name: max(recs, key=score) for name, recs in results.items()})
+    return best, results
+
+
+def median(values: Sequence[float]) -> float:
+    """Median of a non-empty sequence (mean-of-two on even lengths)."""
+    vals = sorted(values)
+    if not vals:
+        raise ValueError("median of an empty sequence")
+    mid = len(vals) // 2
+    return vals[mid] if len(vals) % 2 else (vals[mid - 1] + vals[mid]) / 2.0
+
+
+def paired_ratios(
+    results: Dict[str, list],
+    num: str,
+    den: str,
+    value: Callable = lambda r: r,
+) -> List[float]:
+    """Sorted per-round ``num/den`` ratios from an :func:`ab_trials` result.
+
+    Round *i*'s legs ran back-to-back under the same transient load, so each
+    ratio is a matched pair that cancels the drift; ``value`` extracts the
+    scalar from a per-round record (identity for plain-float legs)."""
+    return sorted(
+        value(a) / max(value(b), 1e-9)
+        for a, b in zip(results[num], results[den])
+    )
+
+
+def median_of_ratios(
+    results: Dict[str, list],
+    num: str,
+    den: str,
+    value: Callable = lambda r: r,
+) -> float:
+    """Matched-pair median ratio — the contract estimator on noisy boxes."""
+    return median(paired_ratios(results, num, den, value))
+
+
+@dataclasses.dataclass
+class ProbeResult:
+    """One candidate's score series across alternating rounds (higher =
+    better).  ``noise`` is the relative spread the artifact records so a
+    downstream verify gate knows how much margin a ratio deserves."""
+
+    name: str
+    scores: List[float]
+
+    @property
+    def best(self) -> float:
+        return max(self.scores)
+
+    @property
+    def noise(self) -> float:
+        if not self.scores:
+            return 0.0
+        top = max(self.scores)
+        return (top - min(self.scores)) / max(abs(top), 1e-12)
+
+
+def probe_candidates(
+    legs: Dict[str, Callable[[], float]], trials: int
+) -> Dict[str, ProbeResult]:
+    """Run scalar-scored candidate legs through :func:`ab_trials` and wrap
+    each side's rounds as a :class:`ProbeResult`."""
+    _, results = ab_trials(legs, trials)
+    return {
+        name: ProbeResult(name, [float(s) for s in scores])
+        for name, scores in results.items()
+    }

@@ -1,0 +1,163 @@
+"""Staged coordinate descent over knob groups under a wall-clock budget.
+
+One knob at a time, in :data:`~mat_dcml_tpu.tuning.space.GROUP_ORDER`
+(dispatch K -> update streaming/layout -> decode mode/bucket ladder -> shard
+axes): the knob's candidates run as *alternating matched rounds* through
+:func:`~mat_dcml_tpu.tuning.probe.ab_trials` — every candidate once per
+round, order reversed on odd rounds — and the winner is decided by the
+*median of per-round ratios vs the default* (the same estimator the
+matched-pair bench legs use), not best-of-N: under shared transient load a
+lucky single round must not pick a value that a later verify re-measure
+rejects.  A non-default value only wins if its median ratio clears
+``1 + switch_margin``; otherwise the default is kept.  The winning value is
+frozen into the point before the next knob is probed.
+
+Pruning happens before any probe is paid: validity predicates (typed
+mesh/divisibility/engine errors) first, then an optional static-bytes
+prescreen (``bytes_of``) that cuts candidates whose compiled bytes-accessed
+exceed ``bytes_cut``x the cheapest candidate — a bytes-dominated point loses
+on memory traffic before it is worth timing.
+
+Everything nondeterministic is injected (``evaluate``, ``bytes_of``,
+``clock``), so the search is exactly reproducible under a fake timer in
+tests.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Callable, Dict, Optional
+
+from mat_dcml_tpu.tuning.probe import ab_trials, median_of_ratios
+from mat_dcml_tpu.tuning.space import FlagSpace, Knob
+
+
+@dataclasses.dataclass
+class SearchResult:
+    point: Dict[str, Any]            # winning value per knob (defaults where
+                                     # pruned/budget-truncated)
+    provenance: Dict[str, dict]      # per-knob ratio/trials/noise/candidates
+    wall_s: float
+    probes_run: int                  # timed evaluations actually paid
+    probes_pruned: int               # candidates cut before any timing
+    truncated: bool                  # budget ran out before the space did
+
+
+def staged_search(
+    space: FlagSpace,
+    evaluate: Callable[[dict, Knob], float],
+    *,
+    budget_s: float = 600.0,
+    trials: int = 3,
+    clock: Callable[[], float] = time.monotonic,
+    log: Callable[[str], None] = lambda m: None,
+    bytes_of: Optional[Callable[[dict, Knob], Optional[float]]] = None,
+    bytes_cut: float = 2.0,
+    switch_margin: float = 0.05,
+    context: Optional[dict] = None,
+) -> SearchResult:
+    """Coordinate-descend ``space`` and return the winning point.
+
+    ``evaluate(point, knob) -> score`` (higher = better) times one candidate
+    point; ``bytes_of(point, knob)`` optionally returns a static
+    bytes-accessed figure for the prescreen (None = no opinion).  The
+    default value is exempt from the bytes cut — it anchors every ratio.
+    """
+    context = dict(context or {})
+    point = space.defaults()
+    provenance: Dict[str, dict] = {}
+    probes_run = 0
+    probes_pruned = 0
+    truncated = False
+    t0 = clock()
+
+    for group, knobs in space.by_group():
+        for knob in knobs:
+            if clock() - t0 >= budget_s:
+                truncated = True
+                log(f"[search] budget {budget_s:.0f}s exhausted before "
+                    f"{knob.name}; keeping defaults for the rest")
+                break
+
+            # 1) validity pruning — typed errors, before any compile
+            candidates = []
+            for v in knob.domain:
+                cand = dict(point)
+                cand[knob.name] = v
+                reason = knob.prune_reason(cand, context)
+                if reason is not None:
+                    probes_pruned += 1
+                    log(f"[search] prune {knob.name}={v!r}: {reason}")
+                else:
+                    candidates.append(v)
+
+            # 2) bytes prescreen — cut bytes-dominated points without timing
+            if bytes_of is not None and len(candidates) > 1:
+                sizes = {}
+                for v in candidates:
+                    b = bytes_of({**point, knob.name: v}, knob)
+                    if b is not None:
+                        sizes[v] = float(b)
+                if sizes:
+                    floor = min(sizes.values())
+                    for v, b in list(sizes.items()):
+                        if v != knob.default and b > bytes_cut * floor:
+                            candidates.remove(v)
+                            probes_pruned += 1
+                            log(f"[search] bytes-cut {knob.name}={v!r}: "
+                                f"{b:.3g}B > {bytes_cut:g}x {floor:.3g}B")
+
+            if len(candidates) <= 1:
+                provenance[knob.name] = {
+                    "value": point[knob.name], "default": knob.default,
+                    "ratio_vs_default": 1.0, "trials": 0, "noise": 0.0,
+                    "note": "all alternatives pruned",
+                }
+                continue
+
+            # 3) matched alternating rounds over the surviving candidates
+            legs = {
+                repr(v): (lambda v=v: float(
+                    evaluate({**point, knob.name: v}, knob)))
+                for v in candidates
+            }
+            rounds = max(trials, 1)
+            _, results = ab_trials(legs, rounds)
+            probes_run += rounds * len(candidates)
+            scores = {v: max(results[repr(v)]) for v in candidates}
+            if knob.default in candidates:
+                # Matched-pair median ratio vs the default from the same
+                # rounds: robust to the lucky round that best-of-N rewards.
+                ratios = {
+                    v: median_of_ratios(results, repr(v), repr(knob.default))
+                    for v in candidates
+                }
+                winner = max(candidates, key=lambda v: ratios[v])
+                if ratios[winner] < 1.0 + switch_margin:
+                    winner = knob.default
+                ratio = ratios[winner]
+            else:
+                winner = max(candidates, key=lambda v: scores[v])
+                ratio = 1.0
+            win_rounds = results[repr(winner)]
+            noise = ((max(win_rounds) - min(win_rounds))
+                     / max(abs(max(win_rounds)), 1e-12))
+            point[knob.name] = winner
+            provenance[knob.name] = {
+                "value": winner, "default": knob.default,
+                "ratio_vs_default": round(ratio, 4),
+                "trials": rounds, "noise": round(noise, 4),
+                "candidates": {repr(v): round(scores[v], 4)
+                               for v in candidates},
+            }
+            log(f"[search] {knob.name} -> {winner!r} "
+                f"({ratio:.3f}x default, noise {noise:.1%})")
+        if truncated:
+            break
+
+    return SearchResult(
+        point=point, provenance=provenance, wall_s=clock() - t0,
+        probes_run=probes_run, probes_pruned=probes_pruned,
+        truncated=truncated,
+    )

@@ -1,0 +1,318 @@
+"""Declarative perf-flag space + fingerprinted tuned-config artifacts.
+
+The tuning surface (``--iters_per_dispatch``, update streaming/layout, decode
+mode/spec-K, the serving bucket ladder, serve dtype, shard axes) is declared
+here as :class:`Knob` entries with per-knob domains and validity predicates.
+Validity reuses the stack's existing typed errors — a shard point is pruned
+by the very ``ValueError`` ``parallel.mesh.build_run_mesh`` would raise at
+startup, an engine point by ``EngineConfig.__post_init__`` — so invalid
+points are rejected *before* any compile is paid, with the same message a
+user would have seen.
+
+A tuned-config artifact (:class:`TunedConfig`, ``tuned_config.json``) carries
+a :class:`Fingerprint` — backend + device count/kind + model shape + env
+preset — so an artifact never silently applies to the wrong hardware:
+loading checks the fingerprint and a mismatch is the typed
+:class:`TunedConfigMismatchError` (the config seam catches it, warns, and
+continues on defaults).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+ARTIFACT_VERSION = 1
+
+# staged coordinate-descent order: dispatch overhead first (it scales every
+# later timing), then update-phase streaming/layout, then decode/serving
+# programs, then shard axes (which need the most devices to matter)
+GROUP_ORDER = ("dispatch", "update", "decode", "shards")
+
+
+class TunedConfigMismatchError(ValueError):
+    """Artifact fingerprint does not match the current hardware/shape."""
+
+    def __init__(self, mismatches: List[str]):
+        self.mismatches = list(mismatches)
+        super().__init__(
+            "tuned-config fingerprint mismatch: " + "; ".join(self.mismatches)
+        )
+
+
+@dataclasses.dataclass(frozen=True)
+class Fingerprint:
+    """What a tuned artifact was measured on.  ``preset`` is the env preset
+    (``"<env_name>:<scenario>"``); model shape is the transformer trunk the
+    probes compiled.  Serving-side loads may not know the env preset, so
+    :meth:`mismatches` takes an ``ignore`` list."""
+
+    backend: str
+    device_count: int
+    device_kind: str
+    n_block: int
+    n_embd: int
+    n_head: int
+    preset: str
+
+    @classmethod
+    def current(cls, preset: str, n_block: int, n_embd: int,
+                n_head: int) -> "Fingerprint":
+        import jax
+
+        dev = jax.devices()[0]
+        return cls(
+            backend=jax.default_backend(),
+            device_count=len(jax.devices()),
+            device_kind=dev.device_kind,
+            n_block=int(n_block), n_embd=int(n_embd), n_head=int(n_head),
+            preset=preset,
+        )
+
+    def mismatches(self, other: "Fingerprint",
+                   ignore: Tuple[str, ...] = ()) -> List[str]:
+        out = []
+        for f in dataclasses.fields(self):
+            if f.name in ignore:
+                continue
+            mine, theirs = getattr(self, f.name), getattr(other, f.name)
+            if mine != theirs:
+                out.append(f"{f.name}: artifact {theirs!r} vs here {mine!r}")
+        return out
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "Fingerprint":
+        names = {f.name for f in dataclasses.fields(cls)}
+        return cls(**{k: v for k, v in d.items() if k in names})
+
+
+@dataclasses.dataclass(frozen=True)
+class Knob:
+    """One tunable flag: candidate ``domain`` (must contain ``default``), its
+    coordinate-descent ``group``, which plane it targets (``train`` /
+    ``serve`` / ``both`` — load seams skip knobs for the other plane), and an
+    optional validity predicate ``(candidate_point, context) -> reason|None``
+    that prunes a candidate before any compile is paid."""
+
+    name: str
+    domain: Tuple[Any, ...]
+    default: Any
+    group: str
+    target: str = "train"
+    validity: Optional[Callable[[dict, dict], Optional[str]]] = None
+
+    def __post_init__(self):
+        if self.group not in GROUP_ORDER:
+            raise ValueError(f"unknown knob group {self.group!r} "
+                             f"(expected one of {GROUP_ORDER})")
+        if self.target not in ("train", "serve", "both"):
+            raise ValueError(f"knob target must be train/serve/both, "
+                             f"got {self.target!r}")
+        if self.default not in self.domain:
+            raise ValueError(
+                f"knob {self.name!r}: default {self.default!r} "
+                f"not in domain {self.domain!r}")
+
+    def prune_reason(self, candidate_point: dict,
+                     context: dict) -> Optional[str]:
+        if self.validity is None:
+            return None
+        return self.validity(candidate_point, context)
+
+
+@dataclasses.dataclass(frozen=True)
+class FlagSpace:
+    knobs: Tuple[Knob, ...]
+
+    def __post_init__(self):
+        names = [k.name for k in self.knobs]
+        if len(set(names)) != len(names):
+            raise ValueError(f"duplicate knob names in space: {names}")
+
+    def defaults(self) -> Dict[str, Any]:
+        return {k.name: k.default for k in self.knobs}
+
+    def knob(self, name: str) -> Knob:
+        for k in self.knobs:
+            if k.name == name:
+                return k
+        raise KeyError(name)
+
+    def by_group(self) -> List[Tuple[str, List[Knob]]]:
+        """Knobs grouped in staged-descent order (empty groups omitted)."""
+        out = []
+        for g in GROUP_ORDER:
+            members = [k for k in self.knobs if k.group == g]
+            if members:
+                out.append((g, members))
+        return out
+
+    def subset(self, names) -> "FlagSpace":
+        names = list(names)
+        missing = [n for n in names if n not in {k.name for k in self.knobs}]
+        if missing:
+            raise KeyError(f"unknown knobs {missing}")
+        return FlagSpace(tuple(k for k in self.knobs if k.name in names))
+
+    def group(self, group: str) -> "FlagSpace":
+        if group not in GROUP_ORDER:
+            raise KeyError(f"unknown group {group!r} (one of {GROUP_ORDER})")
+        return FlagSpace(tuple(k for k in self.knobs if k.group == group))
+
+
+# ------------------------------------------------------------------ validity
+#
+# Predicates receive the FULL candidate point (the knob's value already
+# merged) plus a context dict: devices (or device_count), n_rollout_threads,
+# n_embd, and harness capability flags.  They return a human-readable prune
+# reason or None — and they get that reason from the stack's own typed
+# errors wherever one exists.
+
+def mesh_validity(point: dict, context: dict) -> Optional[str]:
+    """Prune shard points exactly the way the runner would reject them:
+    ``parallel.mesh.build_run_mesh`` raises the typed ValueError, and its
+    message IS the prune reason.  Divisibility of the env batch and the
+    embedding dim ride along (base_runner's own startup checks)."""
+    data = int(point.get("data_shards", 1))
+    seq = int(point.get("seq_shards", 1))
+    fsdp = int(point.get("fsdp_shards", 1))
+    tp = int(point.get("tp_shards", 1))
+    try:
+        from mat_dcml_tpu.parallel.mesh import build_run_mesh
+
+        build_run_mesh(data, seq, fsdp, tp, devices=context.get("devices"))
+    except ValueError as e:
+        return str(e)
+    E = context.get("n_rollout_threads")
+    if E and data > 1 and E % data:
+        return (f"n_rollout_threads {E} must be divisible by "
+                f"data_shards {data}")
+    n_embd = context.get("n_embd")
+    if n_embd and n_embd % (fsdp * tp):
+        return (f"n_embd {n_embd} must be divisible by "
+                f"fsdp_shards*tp_shards = {fsdp * tp}")
+    if (fsdp > 1 or tp > 1) and not context.get("param_shard_probe", False):
+        # honest scope note, not a hardware error: the probe harness times the
+        # plain fused dispatch; fsdp/tp probes need the sharded-runner harness
+        # of bench.py's BENCH_FSDP leg (a chip-session item)
+        return "fsdp/tp probes need the sharded-runner harness (chip session)"
+    return None
+
+
+def engine_validity(point: dict, context: dict) -> Optional[str]:
+    """Prune serving points with ``EngineConfig.__post_init__``'s own typed
+    errors (non-ascending bucket ladders, unknown modes/dtypes)."""
+    try:
+        from mat_dcml_tpu.serving.engine import EngineConfig
+
+        EngineConfig(
+            buckets=tuple(point.get("serve_buckets", (1, 8, 32, 128))),
+            decode_mode=point.get("decode_mode", "cached"),
+            spec_block=int(point.get("spec_block", 8)),
+            serve_dtype=point.get("serve_dtype", "f32"),
+        )
+    except ValueError as e:
+        return str(e)
+    return None
+
+
+def spec_block_validity(point: dict, context: dict) -> Optional[str]:
+    """spec_block is inert unless the (already decided) decode_mode is
+    ``spec`` — probing other values would time identical programs."""
+    if (point.get("decode_mode", "cached") != "spec"
+            and point.get("spec_block", 8) != 8):
+        return "spec_block is inert unless decode_mode=spec"
+    return engine_validity(point, context)
+
+
+def bf16_validity(point: dict, context: dict) -> Optional[str]:
+    if point.get("serve_dtype") == "bf16" and not context.get(
+            "allow_bf16", True):
+        return "bf16 serving disabled by context (value-tolerance plane)"
+    return engine_validity(point, context)
+
+
+def default_space() -> FlagSpace:
+    """The shipped tuning surface.  Training-side knob names are RunConfig /
+    PPOConfig field names (the load seam applies them by name); serving-only
+    knobs are ``serve_``-prefixed and map onto ``EngineConfig``."""
+    return FlagSpace((
+        # --- dispatch: host re-entry amortization (fused K-episode scan)
+        Knob("iters_per_dispatch", (1, 2, 4, 8), 1, "dispatch"),
+        # --- update: PPO epoch-buffer streaming + minibatch gather layout
+        Knob("update_stream_chunks", (0, 2, 4, 8), 4, "update"),
+        Knob("minibatch_layout", ("gather", "contiguous"), "gather", "update"),
+        # --- decode: rollout/serving decode program + serving ladder/dtype
+        Knob("decode_mode", ("cached", "scan", "spec"), "cached", "decode",
+             target="both", validity=engine_validity),
+        Knob("spec_block", (4, 8, 16), 8, "decode",
+             target="both", validity=spec_block_validity),
+        Knob("serve_buckets", ((1,), (1, 4, 16), (1, 8, 32, 128)),
+             (1, 8, 32, 128), "decode", target="serve",
+             validity=engine_validity),
+        Knob("serve_dtype", ("f32", "bf16"), "f32", "decode", target="serve",
+             validity=bf16_validity),
+        # --- shards: mesh axes (typed mesh errors prune what can't build)
+        Knob("data_shards", (1, 2, 4, 8), 1, "shards",
+             validity=mesh_validity),
+        Knob("fsdp_shards", (1, 2), 1, "shards", validity=mesh_validity),
+        Knob("tp_shards", (1, 2), 1, "shards", validity=mesh_validity),
+    ))
+
+
+# ------------------------------------------------------------------ artifact
+
+@dataclasses.dataclass
+class TunedConfig:
+    """Versioned tuned-config artifact: the winning point plus per-knob
+    provenance (measured ratio vs default, trials, noise) and search
+    accounting (wall time, probes run/pruned, budget, probe preset)."""
+
+    fingerprint: Fingerprint
+    knobs: Dict[str, Any]
+    provenance: Dict[str, dict] = dataclasses.field(default_factory=dict)
+    search: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    version: int = ARTIFACT_VERSION
+
+    def to_dict(self) -> dict:
+        return {
+            "version": self.version,
+            "fingerprint": self.fingerprint.to_dict(),
+            "knobs": dict(self.knobs),
+            "provenance": dict(self.provenance),
+            "search": dict(self.search),
+        }
+
+    def save(self, path) -> None:
+        with open(path, "w") as f:
+            json.dump(self.to_dict(), f, indent=2, sort_keys=True)
+            f.write("\n")
+
+    @classmethod
+    def load(cls, path) -> "TunedConfig":
+        with open(path) as f:
+            d = json.load(f)
+        version = int(d.get("version", -1))
+        if version != ARTIFACT_VERSION:
+            raise ValueError(
+                f"{path}: tuned-config version {version} != "
+                f"{ARTIFACT_VERSION} (regenerate with scripts/autotune.py)")
+        return cls(
+            fingerprint=Fingerprint.from_dict(d["fingerprint"]),
+            knobs=dict(d.get("knobs", {})),
+            provenance=dict(d.get("provenance", {})),
+            search=dict(d.get("search", {})),
+            version=version,
+        )
+
+    def check(self, current: Fingerprint,
+              ignore: Tuple[str, ...] = ()) -> None:
+        """Raise :class:`TunedConfigMismatchError` unless this artifact was
+        measured on hardware/shape matching ``current``."""
+        bad = current.mismatches(self.fingerprint, ignore=ignore)
+        if bad:
+            raise TunedConfigMismatchError(bad)

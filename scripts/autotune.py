@@ -1,0 +1,574 @@
+#!/usr/bin/env python
+"""Measured perf-flag search -> tuned-config artifact -> regression gate.
+
+Searches the declared flag space (``mat_dcml_tpu/tuning/space.py``) with
+short matched-pair probes — real fused collect+train dispatches and real AOT
+decode engines, warmup excluded, zero steady-state recompiles asserted per
+probe — and emits a fingerprinted ``tuned_config.json`` that training
+(``--tuned_config`` on any ``train_*.py``) and serving
+(``scripts/serve_fleet.py --tuned_config``) load at startup.
+
+Usage:
+  python scripts/autotune.py [--preset cpu_small] [--out tuned_config.json]
+      [--budget_s 600] [--trials 3] [--knobs a,b] [--bytes_cut 2.0]
+  python scripts/autotune.py --only dispatch --k_list 1,4,16   # K sweep table
+  python scripts/autotune.py --only decode --modes scan,spec,cached
+  python scripts/autotune.py verify --tuned tuned_config.json [--margin 0.05]
+
+``verify`` re-measures tuned vs all-defaults on the fingerprinted hardware
+(matched-pair median-of-ratios) and exits nonzero unless tuned >= 1.0x
+within ``--margin``: 1 = tuned lost, 3 = fingerprint mismatch (wrong
+hardware — nothing to verify here).  With ``MAT_DCML_TPU_TUNED_REGEN=1`` a
+``cpu_small`` search also refreshes the committed regression fixture
+``tests/data/tuned_cpu_small.json`` (the update-bytes-budget pattern).
+
+Progress goes to stderr; tables and the summary/verify json records to
+stdout, so the sweep wrappers (``scripts/k_sweep_bench.sh``,
+``scripts/decode_sweep.sh``) stay pipeline-friendly.
+"""
+
+import argparse
+import dataclasses
+import json
+import os
+import sys
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if ROOT not in sys.path:
+    sys.path.insert(0, ROOT)
+
+from mat_dcml_tpu.utils.platform import apply_platform_override  # noqa: E402
+
+apply_platform_override()
+
+EXIT_OK, EXIT_FAIL, EXIT_SKIPPED = 0, 1, 3
+FIXTURE_PATH = os.path.join(ROOT, "tests", "data", "tuned_cpu_small.json")
+REGEN_ENV = "MAT_DCML_TPU_TUNED_REGEN"
+
+PRESETS = {
+    # the full DCML env at tiny E/T with the tiny trunk: same program
+    # structure as the recipe, minutes on a CPU dev box
+    # decode_requests=128: 32-request probes flip the cached/scan winner
+    # between runs on a noisy box; 128 keeps the serve plane inside the
+    # verify margin
+    "cpu_small": dict(E=8, T=4, n_block=1, n_embd=32, n_head=2,
+                      ppo_epoch=2, num_mini_batch=2, iters=2,
+                      decode_requests=128),
+    # the shipped DCML-AS recipe shapes (chip sessions)
+    "recipe": dict(E=256, T=50, n_block=2, n_embd=64, n_head=2,
+                   ppo_epoch=15, num_mini_batch=4, iters=2,
+                   decode_requests=128),
+}
+
+
+def log(msg: str) -> None:
+    print(f"[autotune] {msg}", file=sys.stderr, flush=True)
+
+
+class ProbeHarness:
+    """Real probes for one preset: a fused collect+train dispatch scored in
+    env-steps/s (dispatch/update/shards groups) and an AOT decode engine
+    scored in decode-requests/s (decode group).  Programs are cached per
+    point signature, so matched rounds after the first pay timing only —
+    warmup/compile never enters a score, and every probe asserts zero
+    steady-state recompiles."""
+
+    def __init__(self, preset: str, overrides=None, log_fn=log):
+        import jax
+
+        from mat_dcml_tpu.config import RunConfig
+        from mat_dcml_tpu.envs.dcml import DCMLEnv, DCMLEnvConfig
+        from mat_dcml_tpu.training.runner import build_mat_policy
+
+        self.jax = jax
+        p = dict(PRESETS[preset])
+        p.update(overrides or {})
+        self.preset_name = preset
+        self.p = p
+        self.log = log_fn
+        self.run = RunConfig(
+            n_rollout_threads=p["E"], episode_length=p["T"],
+            n_block=p["n_block"], n_embd=p["n_embd"], n_head=p["n_head"],
+        )
+        self.env = DCMLEnv(DCMLEnvConfig(),
+                           data_dir=os.path.join(ROOT, "data"))
+        self.policy = build_mat_policy(self.run, self.env)
+        self.params = self.policy.init_params(jax.random.key(0))
+        self._train_cache = {}
+        self._serve_cache = {}
+        self._bytes_cache = {}
+        self.serve_details = {}
+
+    # ------------------------------------------------------------- identity
+
+    def fingerprint(self):
+        from mat_dcml_tpu.tuning.space import Fingerprint
+
+        return Fingerprint.current(
+            preset=f"{self.run.env_name}:{self.run.scenario}",
+            n_block=self.run.n_block, n_embd=self.run.n_embd,
+            n_head=self.run.n_head,
+        )
+
+    def context(self) -> dict:
+        return {
+            "devices": list(self.jax.devices()),
+            "n_rollout_threads": self.run.n_rollout_threads,
+            "n_embd": self.run.n_embd,
+            # fsdp/tp probing needs the sharded-runner harness (bench.py
+            # BENCH_FSDP); the space prunes those values with that reason
+            "param_shard_probe": False,
+        }
+
+    # ------------------------------------------------------------ evaluate
+
+    def evaluate(self, point: dict, knob) -> float:
+        if knob.group == "decode":
+            return self.serve_score(point)
+        return self.train_score(point)
+
+    def bytes_of(self, point: dict, knob):
+        """Static bytes-accessed prescreen — update-group knobs only (the
+        epoch-buffer streaming knobs are exactly the memory-traffic ones)."""
+        if knob.group != "update":
+            return None
+        return self.update_bytes(point)
+
+    def _ppo(self, point: dict):
+        from mat_dcml_tpu.training.ppo import PPOConfig
+
+        kw = dict(ppo_epoch=self.p["ppo_epoch"],
+                  num_mini_batch=self.p["num_mini_batch"])
+        for k in ("update_stream_chunks", "minibatch_layout"):
+            if k in point:
+                kw[k] = point[k]
+        return PPOConfig(**kw)
+
+    def _train_key(self, point: dict) -> tuple:
+        return (int(point.get("iters_per_dispatch", 1)),
+                int(point.get("update_stream_chunks", 4)),
+                str(point.get("minibatch_layout", "gather")))
+
+    def _fresh_params(self):
+        # each dispatch donates its train state, whose buffers would
+        # otherwise be the shared self.params — every entry gets a copy
+        import jax.numpy as jnp
+
+        return self.jax.tree_util.tree_map(jnp.array, self.params)
+
+    def _train_entry(self, point: dict) -> dict:
+        jax = self.jax
+        key = self._train_key(point)
+        entry = self._train_cache.get(key)
+        if entry is not None:
+            return entry
+
+        from mat_dcml_tpu.telemetry import Telemetry, instrumented_jit
+        from mat_dcml_tpu.training.base_runner import make_dispatch_fn
+        from mat_dcml_tpu.training.ppo import MATTrainer
+        from mat_dcml_tpu.training.rollout import RolloutCollector
+
+        K = key[0]
+        trainer = MATTrainer(self.policy, self._ppo(point))
+        collector = RolloutCollector(self.env, self.policy,
+                                     self.run.episode_length)
+        tel = Telemetry()
+        dispatch = instrumented_jit(
+            make_dispatch_fn(trainer, collector, K),
+            f"probe_dispatch_k{K}", tel, lambda *a: None,
+            donate_argnums=(0, 1),
+        )
+        train_state = trainer.init_state(self._fresh_params())
+        rollout_state = collector.init_state(
+            jax.random.key(1), self.run.n_rollout_threads)
+        rng = jax.random.key(2)
+        t0 = time.perf_counter()
+        for _ in range(2):  # compile + the weak-type recompile
+            train_state, rollout_state, rng, _ = dispatch(
+                train_state, rollout_state, rng)
+            jax.block_until_ready(train_state)
+        dispatch.mark_steady()
+        self.log(f"probe {key}: warm in {time.perf_counter() - t0:.1f}s")
+        entry = {"dispatch": dispatch, "tel": tel,
+                 "carry": (train_state, rollout_state, rng)}
+        self._train_cache[key] = entry
+        return entry
+
+    def train_score(self, point: dict) -> float:
+        """env-steps/s over ``iters`` steady fused dispatches (DeferredFetch
+        overlap, warmup excluded, zero steady recompiles asserted)."""
+        jax = self.jax
+        from mat_dcml_tpu.telemetry import DeferredFetch
+
+        entry = self._train_entry(point)
+        dispatch = entry["dispatch"]
+        train_state, rollout_state, rng = entry["carry"]
+        iters = int(self.p["iters"])
+        K = self._train_key(point)[0]
+        pending = None
+        start = time.perf_counter()
+        for _ in range(iters):
+            train_state, rollout_state, rng, stacked = dispatch(
+                train_state, rollout_state, rng)
+            fetch = DeferredFetch(stacked)
+            if pending is not None:
+                pending.get()
+            pending = fetch
+        pending.get()
+        jax.block_until_ready(train_state)
+        elapsed = time.perf_counter() - start
+        entry["carry"] = (train_state, rollout_state, rng)
+        recompiles = entry["tel"].counters.get("steady_state_recompiles", 0.0)
+        if recompiles:
+            raise AssertionError(
+                f"probe {self._train_key(point)} recompiled in steady state "
+                f"({recompiles:.0f}x) — the measurement is invalid")
+        steps = iters * K * self.run.n_rollout_threads * self.run.episode_length
+        return steps / max(elapsed, 1e-9)
+
+    def _serve_key(self, point: dict) -> tuple:
+        return (str(point.get("decode_mode", "cached")),
+                int(point.get("spec_block", 8)),
+                tuple(int(b) for b in point.get("serve_buckets",
+                                                (1, 8, 32, 128))),
+                str(point.get("serve_dtype", "f32")))
+
+    def serve_score(self, point: dict) -> float:
+        """Decode-requests/s through a warmed AOT engine at the point's
+        serving knobs (smallest bucket — the latency-critical program)."""
+        import numpy as np
+
+        from mat_dcml_tpu.serving.engine import DecodeEngine, EngineConfig
+        from mat_dcml_tpu.tuning.probe import median as _median
+
+        key = self._serve_key(point)
+        eng = self._serve_cache.get(key)
+        if eng is None:
+            t0 = time.perf_counter()
+            eng = DecodeEngine(
+                self.params, self.policy.cfg,
+                EngineConfig(buckets=key[2], decode_mode=key[0],
+                             spec_block=key[1], serve_dtype=key[3]),
+                log_fn=lambda *a: None,
+            )
+            eng.warmup()
+            self._serve_cache[key] = eng
+            self.log(f"probe {key}: engine warm in "
+                     f"{time.perf_counter() - t0:.1f}s")
+        cfg = self.policy.cfg
+        b = eng.min_bucket
+        state = np.zeros((b, cfg.n_agent, cfg.state_dim), np.float32)
+        obs = np.zeros((b, cfg.n_agent, cfg.obs_dim), np.float32)
+        avail = np.ones((b, cfg.n_agent, cfg.action_dim), np.float32)
+        n = int(self.p["decode_requests"])
+        times = []
+        start = time.perf_counter()
+        for _ in range(n):
+            t0 = time.perf_counter()
+            eng.decode(state, obs, avail)
+            times.append((time.perf_counter() - t0) * 1e3)
+        elapsed = time.perf_counter() - start
+        recompiles = eng.steady_state_recompiles()
+        if recompiles:
+            raise AssertionError(
+                f"serve probe {key} recompiled in steady state "
+                f"({recompiles:.0f}x) — the measurement is invalid")
+        qps = (n * b) / max(elapsed, 1e-9)
+        self.serve_details[key] = {
+            "qps": qps, "p50_ms": _median(times), "bucket": b,
+            "recompiles": recompiles,
+        }
+        return qps
+
+    def update_bytes(self, point: dict):
+        """Static bytes-accessed of the compiled PPO update at this point
+        (cost_analysis; shapes via eval_shape — no rollout compile paid)."""
+        jax = self.jax
+        from mat_dcml_tpu.training.ppo import MATTrainer
+        from mat_dcml_tpu.training.rollout import RolloutCollector
+        from mat_dcml_tpu.utils.profiling import compiled_bytes
+
+        key = (int(point.get("update_stream_chunks", 4)),
+               str(point.get("minibatch_layout", "gather")))
+        if key in self._bytes_cache:
+            return self._bytes_cache[key]
+        trainer = MATTrainer(self.policy, self._ppo(point))
+        collector = RolloutCollector(self.env, self.policy,
+                                     self.run.episode_length)
+        rs = collector.init_state(jax.random.key(1),
+                                  self.run.n_rollout_threads)
+        rs2_shape, traj_shape = jax.eval_shape(
+            collector.collect, self.params, rs)
+        state = trainer.init_state(self.params)
+        compiled = jax.jit(trainer.train).lower(
+            state, traj_shape, rs2_shape, jax.random.key(2)).compile()
+        val = compiled_bytes(compiled)
+        self._bytes_cache[key] = val
+        return val
+
+
+# ------------------------------------------------------------------ helpers
+
+def _overrides(args) -> dict:
+    ov = {}
+    for name in ("E", "T", "iters", "ppo_epoch", "mini_batch",
+                 "decode_requests"):
+        v = getattr(args, name, None)
+        if v is not None:
+            ov["num_mini_batch" if name == "mini_batch" else name] = v
+    return ov
+
+
+def _replace_knob(space, name, **changes):
+    from mat_dcml_tpu.tuning.space import FlagSpace
+
+    try:
+        space.knob(name)
+    except KeyError:
+        return space
+    return FlagSpace(tuple(
+        dataclasses.replace(k, **changes) if k.name == name else k
+        for k in space.knobs))
+
+
+def build_space(args):
+    from mat_dcml_tpu.tuning.space import default_space
+
+    space = default_space()
+    if args.knobs:
+        space = space.subset(
+            [k.strip() for k in args.knobs.split(",") if k.strip()])
+    if args.only:
+        space = space.group(args.only)
+    if args.k_list:
+        ks = tuple(int(x) for x in args.k_list.split(","))
+        space = _replace_knob(space, "iters_per_dispatch", domain=ks,
+                              default=1 if 1 in ks else ks[0])
+    if args.modes:
+        modes = tuple(m.strip() for m in args.modes.split(","))
+        space = _replace_knob(
+            space, "decode_mode", domain=modes,
+            default="cached" if "cached" in modes else modes[0])
+    if args.buckets:
+        ladder = tuple(int(b) for b in args.buckets.split(","))
+        space = _replace_knob(space, "serve_buckets", domain=(ladder,),
+                              default=ladder)
+    if args.spec_block_default:
+        sb = int(args.spec_block_default)
+        knob = None
+        try:
+            knob = space.knob("spec_block")
+        except KeyError:
+            pass
+        if knob is not None:
+            dom = tuple(sorted(set(knob.domain) | {sb}))
+            space = _replace_knob(space, "spec_block", domain=dom, default=sb)
+    return space
+
+
+def print_group_table(group: str, result, harness) -> None:
+    dev = harness.jax.devices()[0]
+    if group == "dispatch":
+        prov = result.provenance.get("iters_per_dispatch") or {}
+        cands = prov.get("candidates") or {}
+        rows = sorted(((int(v), s) for v, s in cands.items()))
+        for K, s in rows:
+            print(json.dumps({"K": K, "steps_per_sec": round(s, 2)}),
+                  flush=True)
+        if rows:
+            best_k, best_s = max(rows, key=lambda r: r[1])
+            record = {
+                "metric": "dcml_mat_fused_dispatch_env_steps_per_sec",
+                "value": round(best_s, 2), "unit": "env_steps/s",
+                "platform": dev.platform, "device": dev.device_kind,
+                "provisional": False, "E": harness.run.n_rollout_threads,
+                "best_K": best_k,
+            }
+            for K, s in rows:
+                record[f"k{K}_steps_per_sec"] = round(s, 2)
+            print(json.dumps(record), flush=True)
+        return
+    if group == "decode":
+        hdr = ("mode", "spec", "buckets", "dtype", "qps", "p50_ms",
+               "recompiles")
+        print()
+        print("decode mode x serving ladder (autotune probes, "
+              f"bucket-1 dispatches, {dev.platform})")
+        print("  ".join(f"{h:>12}" for h in hdr))
+        for key, d in sorted(harness.serve_details.items()):
+            mode, spec, buckets, dtype = key
+            print("  ".join(f"{v:>12}" for v in (
+                mode, spec, ",".join(str(b) for b in buckets), dtype,
+                round(d["qps"], 2), round(d["p50_ms"], 2),
+                int(d["recompiles"]))))
+        print()
+        return
+    # generic: one json line per probed knob with its candidate scores
+    for name, prov in result.provenance.items():
+        print(json.dumps({"knob": name, **prov}), flush=True)
+
+
+# --------------------------------------------------------------------- modes
+
+def do_search(args) -> int:
+    from mat_dcml_tpu.tuning.search import staged_search
+    from mat_dcml_tpu.tuning.space import TunedConfig
+
+    harness = ProbeHarness(args.preset, _overrides(args))
+    space = build_space(args)
+    bytes_of = harness.bytes_of if args.bytes_cut > 0 else None
+    result = staged_search(
+        space, harness.evaluate, budget_s=args.budget_s, trials=args.trials,
+        log=log, bytes_of=bytes_of, bytes_cut=args.bytes_cut,
+        switch_margin=args.switch_margin, context=harness.context(),
+    )
+    tc = TunedConfig(
+        fingerprint=harness.fingerprint(),
+        knobs=dict(result.point),
+        provenance=result.provenance,
+        search={"wall_s": round(result.wall_s, 3),
+                "probes_run": result.probes_run,
+                "probes_pruned": result.probes_pruned,
+                "budget_s": args.budget_s,
+                "truncated": int(result.truncated),
+                "preset": args.preset},
+    )
+    if args.only:
+        print_group_table(args.only, result, harness)
+    out = args.out
+    if out is None:
+        # group sweeps print tables; a partial-space artifact would
+        # silently shadow a full one, so writing is opt-in there
+        out = "" if args.only else "tuned_config.json"
+    if out:
+        tc.save(out)
+        log(f"wrote {out}")
+    if os.environ.get(REGEN_ENV) and args.preset == "cpu_small":
+        tc.save(FIXTURE_PATH)
+        log(f"regenerated {FIXTURE_PATH}")
+    dev = harness.jax.devices()[0]
+    record = {
+        "metric": "dcml_mat_autotune_search",
+        "value": round(result.wall_s, 2), "unit": "s",
+        "platform": dev.platform, "device": dev.device_kind,
+        "provisional": False, "preset": args.preset,
+        "probes_run": result.probes_run,
+        "probes_pruned": result.probes_pruned,
+        "truncated": int(result.truncated),
+        "knobs": {k: (list(v) if isinstance(v, tuple) else v)
+                  for k, v in result.point.items()},
+    }
+    print(json.dumps(record), flush=True)
+    return EXIT_OK
+
+
+_SERVE_KNOBS = ("decode_mode", "spec_block", "serve_buckets", "serve_dtype")
+
+
+def do_verify(args) -> int:
+    from mat_dcml_tpu.tuning.probe import ab_trials, median_of_ratios
+    from mat_dcml_tpu.tuning.space import (
+        TunedConfig, TunedConfigMismatchError, default_space)
+
+    if not args.tuned:
+        log("verify needs --tuned PATH")
+        return 2
+    tc = TunedConfig.load(args.tuned)
+    preset = tc.search.get("preset", args.preset)
+    if preset not in PRESETS:
+        preset = args.preset
+    ov = _overrides(args)
+    # rebuild exactly the tuned shape — the artifact's fingerprint, not the
+    # preset table, is the source of truth for the model
+    ov.update(n_block=tc.fingerprint.n_block, n_embd=tc.fingerprint.n_embd,
+              n_head=tc.fingerprint.n_head)
+    harness = ProbeHarness(preset, ov)
+    try:
+        tc.check(harness.fingerprint())
+    except TunedConfigMismatchError as e:
+        log(f"verify SKIPPED (wrong hardware): {e}")
+        return EXIT_SKIPPED
+
+    defaults = default_space().defaults()
+    tuned = dict(defaults)
+    tuned.update(tc.knobs)
+    trials = max(args.trials, 1)
+    _, tr = ab_trials(
+        {"tuned": lambda: harness.train_score(tuned),
+         "default": lambda: harness.train_score(defaults)},
+        trials)
+    ratios = {"train": median_of_ratios(tr, "tuned", "default")}
+    if any(tuple(tuned[k]) != tuple(defaults[k])
+           if isinstance(defaults[k], tuple) else tuned[k] != defaults[k]
+           for k in _SERVE_KNOBS if k in tuned):
+        _, sr = ab_trials(
+            {"tuned": lambda: harness.serve_score(tuned),
+             "default": lambda: harness.serve_score(defaults)},
+            trials)
+        ratios["serve"] = median_of_ratios(sr, "tuned", "default")
+
+    ok = all(r >= 1.0 - args.margin for r in ratios.values())
+    dev = harness.jax.devices()[0]
+    record = {
+        "metric": "dcml_mat_autotune_verify",
+        "value": round(min(ratios.values()), 4), "unit": "x_default",
+        "platform": dev.platform, "device": dev.device_kind,
+        "provisional": False, "tuned": str(args.tuned),
+        "margin": args.margin, "trials": trials,
+        "verify_pass": int(ok),
+    }
+    for name, r in ratios.items():
+        record[f"{name}_ratio"] = round(r, 4)
+    print(json.dumps(record), flush=True)
+    log(f"verify {'PASS' if ok else 'FAIL'}: " + ", ".join(
+        f"{n} {r:.4f}x" for n, r in ratios.items())
+        + f" (margin {args.margin:g})")
+    return EXIT_OK if ok else EXIT_FAIL
+
+
+def main(argv=None) -> int:
+    from mat_dcml_tpu.tuning.space import GROUP_ORDER
+
+    p = argparse.ArgumentParser(
+        description="perf-flag autotuner", allow_abbrev=False)
+    p.add_argument("mode", nargs="?", default="search",
+                   choices=["search", "verify"])
+    p.add_argument("--preset", default="cpu_small", choices=sorted(PRESETS))
+    p.add_argument("--out", default=None,
+                   help="artifact path (default tuned_config.json; "
+                        "no artifact for --only sweeps)")
+    p.add_argument("--budget_s", type=float, default=600.0)
+    p.add_argument("--trials", type=int, default=3)
+    p.add_argument("--only", default=None, choices=list(GROUP_ORDER),
+                   help="sweep one knob group and print its table")
+    p.add_argument("--knobs", default=None,
+                   help="comma list restricting the space to these knobs")
+    p.add_argument("--bytes_cut", type=float, default=2.0,
+                   help="bytes-accessed prescreen factor (0 disables)")
+    p.add_argument("--switch_margin", type=float, default=0.05,
+                   help="median ratio a non-default value must clear "
+                        "to win its knob")
+    p.add_argument("--tuned", default=None, help="verify: artifact path")
+    p.add_argument("--margin", type=float, default=0.05,
+                   help="verify: allowed noise below 1.0x")
+    # preset overrides (the sweep wrappers map their env knobs here)
+    p.add_argument("--E", type=int, default=None)
+    p.add_argument("--T", type=int, default=None)
+    p.add_argument("--iters", type=int, default=None)
+    p.add_argument("--ppo_epoch", type=int, default=None)
+    p.add_argument("--mini_batch", type=int, default=None)
+    p.add_argument("--decode_requests", type=int, default=None)
+    # domain overrides
+    p.add_argument("--k_list", default=None)
+    p.add_argument("--modes", default=None)
+    p.add_argument("--buckets", default=None)
+    p.add_argument("--spec_block_default", type=int, default=None)
+    args = p.parse_args(argv)
+    if args.mode == "verify":
+        return do_verify(args)
+    return do_search(args)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv[1:]))

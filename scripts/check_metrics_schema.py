@@ -159,6 +159,10 @@ KNOWN_PREFIXES = (
     # observability-plane self-metering: /telemetry.json serve counter
     # (TelemetrySidecar / PolicyServer) and the collector's own counters
     "obs_",
+    # tuned-config application (mat_dcml_tpu/tuning/ + scripts/autotune.py):
+    # applied/overridden knob counts, the fingerprint-mismatch flag, search
+    # accounting, per-knob measured ratios, and the verify-gate re-measure
+    "tune_",
 )
 
 # registry suffixes a histogram sketch appends on flush (registry.py
@@ -229,6 +233,9 @@ STRICT_FAMILY_PATTERNS = {
     "obs_": re.compile(
         r"^obs_(snapshot_requests|collector_polls"
         r"|collector_merged_records)$"),
+    "tune_": re.compile(
+        r"^tune_(applied|overridden|mismatch|search_wall_s|probes"
+        r"|probes_pruned|verify_ratio|ratio_[a-z0-9_]+)$"),
 }
 
 # fields that must never go negative (counters, rates, timers, gauges)
@@ -548,7 +555,7 @@ def validate_record(record, index: int = 0, strict_names: bool = True,
                                  "resilience_", "slo_",
                                  "decode_cache_", "async_",
                                  "staleness_", "chaos_",
-                                 "scrape_", "obs_"))) and v < 0:
+                                 "scrape_", "obs_", "tune_"))) and v < 0:
             errs.append(f"{where}: field {k!r} is negative ({v})")
         if k in UNIT_INTERVAL and not (0.0 <= v <= 1.0):
             errs.append(f"{where}: field {k!r} must be in [0, 1], got {v}")

@@ -1,82 +1,18 @@
 #!/bin/sh
-# Sweep the decode modes (scan | spec | cached) through the serving bucket
-# ladder and print one comparison table.  Each mode runs bench.py's
-# BENCH_SERVING leg — continuous batcher over the AOT bucket ladder plus the
-# unbatched single-dispatch baseline, recompile detector armed — so every
-# cell of the table is the same protocol with only the decode program
-# swapped.  Finishes with the BENCH_CACHED_DECODE three-way A/B (bit-exact
-# assert + alternating best-of-5 serving/collect trials) unless
-# DECODE_SWEEP_AB=0.
-#
-# Knobs (all pass through to bench.py):
-#   DECODE_SWEEP_MODES         comma list, default scan,spec,cached
-#   BENCH_SERVING_BUCKETS      default 1,4,16
-#   BENCH_SERVING_REQUESTS     default 256
-#   BENCH_SERVING_CONCURRENCY  default 16
-#   BENCH_SERVING_SPEC_BLOCK   default 8
-#
-# On CPU the numbers are protocol checks, not the TPU speedup of record —
-# run on a chip session for the real curve.
+# SUPERSEDED: the decode-mode sweep is now the `decode` knob group of the
+# perf-flag autotuner — this wrapper is `scripts/autotune.py --only decode`
+# and prints one mode-by-ladder comparison table from the same protocol
+# (warm AOT engine per mode, alternating best-of-N batch-1 dispatches,
+# recompile detector armed).  The old env knobs still work and map onto
+# autotune flags; new callers should invoke autotune.py directly (run
+# without --only it also emits the tuned_config.json artifact).  The
+# bit-exactness three-way A/B stays where it was: BENCH_CACHED_DECODE=1
+# python bench.py.
 cd "$(dirname "$0")/.."
-set -e
-
-MODES="${DECODE_SWEEP_MODES:-scan,spec,cached}"
-BUCKETS="${BENCH_SERVING_BUCKETS:-1,4,16}"
-OUT="$(mktemp)"
-trap 'rm -f "$OUT"' EXIT
-
-for mode in $(printf '%s' "$MODES" | tr ',' ' '); do
-  echo "== decode_sweep: mode=$mode buckets=$BUCKETS ==" >&2
-  env \
-    JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
-    BENCH_SERVING=1 \
-    BENCH_SERVING_DECODE_MODE="$mode" \
-    BENCH_SERVING_BUCKETS="$BUCKETS" \
-    python bench.py | tail -1 >> "$OUT"
-done
-
-if [ "${DECODE_SWEEP_AB:-1}" = "1" ]; then
-  echo "== decode_sweep: three-way A/B (BENCH_CACHED_DECODE) ==" >&2
-  env \
-    JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
-    BENCH_CACHED_DECODE=1 \
-    python bench.py | tail -1 >> "$OUT"
-fi
-
-python - "$OUT" <<'EOF'
-import json, sys
-
-rows, ab = [], None
-with open(sys.argv[1]) as f:
-    for line in f:
-        rec = json.loads(line)
-        if rec.get("metric") == "dcml_mat_cached_decode_p50":
-            ab = rec
-        else:
-            rows.append(rec)
-
-hdr = ("mode", "buckets", "qps", "single_qps", "p50_ms", "p99_ms",
-       "shed", "recompiles")
-print()
-print("decode mode x serving bucket ladder")
-print("  ".join(f"{h:>11}" for h in hdr))
-for r in rows:
-    print("  ".join(f"{v:>11}" for v in (
-        r["decode_mode"], r["buckets"], r["value"], r["single_qps"],
-        r["p50_ms"], r["p99_ms"], r["shed_rate"],
-        int(r["steady_state_recompiles"]))))
-
-if ab is not None:
-    print()
-    print(f"three-way A/B (E={ab['E']}, bucket={ab['bucket']}, "
-          f"best-of-{ab['trials']}, bit_exact={ab['bit_exact']})")
-    cols = ("mode", "serve_p50_ms", "batch1_qps", "collect_steps_s")
-    print("  ".join(f"{c:>15}" for c in cols))
-    for m in ("scan", "spec", "cached"):
-        print("  ".join(f"{v:>15}" for v in (
-            m, ab[f"{m}_p50_ms"], ab[f"{m}_batch1_qps"],
-            ab[f"{m}_collect_steps_s"])))
-    print(f"beats_scan={ab['beats_scan']} beats_spec={ab['beats_spec']} "
-          f"collect_ok={ab['collect_ok']} "
-          f"recompiles={int(ab['steady_state_recompiles'])}")
-EOF
+exec env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python scripts/autotune.py \
+  --only decode \
+  --modes "${DECODE_SWEEP_MODES:-scan,spec,cached}" \
+  --buckets "${BENCH_SERVING_BUCKETS:-1,4,16}" \
+  --decode_requests "${BENCH_SERVING_REQUESTS:-256}" \
+  --spec_block_default "${BENCH_SERVING_SPEC_BLOCK:-8}" \
+  --trials "${BENCH_TRIALS:-2}"

@@ -1,18 +1,18 @@
 #!/bin/sh
-# A/B the fused multi-episode dispatch (--iters_per_dispatch) against the
-# classic two-dispatch loop: BENCH_K_SWEEP drives bench.py's fused leg
-# (base_runner.make_dispatch_fn with donated buffers + DeferredFetch metric
-# transfer) at several K values and reports env-steps/s per K.  Small E/T by
-# default so the sweep finishes on CPU in minutes; on a chip session export
-# BENCH_N_ENVS/BENCH_EPISODE_LENGTH back up to production sizes.
+# SUPERSEDED: the K sweep is now a knob group of the perf-flag autotuner —
+# this wrapper is `scripts/autotune.py --only dispatch` and prints the same
+# per-K json lines + best-K record the old BENCH_K_SWEEP bench leg did
+# (best-of-N alternating trials instead of one pass, so the numbers are the
+# autotuner's).  The old env knobs still work and map onto autotune flags;
+# new callers should invoke autotune.py directly (run without --only it also
+# emits the tuned_config.json artifact).
 cd "$(dirname "$0")/.."
-exec env \
-  JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
-  BENCH_DIRECT=1 \
-  BENCH_K_SWEEP="${BENCH_K_SWEEP:-1,4,16}" \
-  BENCH_N_ENVS="${BENCH_N_ENVS:-8}" \
-  BENCH_EPISODE_LENGTH="${BENCH_EPISODE_LENGTH:-4}" \
-  BENCH_ITERS="${BENCH_ITERS:-4}" \
-  BENCH_PPO_EPOCH="${BENCH_PPO_EPOCH:-2}" \
-  BENCH_MINI_BATCH="${BENCH_MINI_BATCH:-2}" \
-  python bench.py
+exec env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python scripts/autotune.py \
+  --only dispatch \
+  --k_list "${BENCH_K_SWEEP:-1,4,16}" \
+  --E "${BENCH_N_ENVS:-8}" \
+  --T "${BENCH_EPISODE_LENGTH:-4}" \
+  --iters "${BENCH_ITERS:-4}" \
+  --ppo_epoch "${BENCH_PPO_EPOCH:-2}" \
+  --mini_batch "${BENCH_MINI_BATCH:-2}" \
+  --trials "${BENCH_TRIALS:-2}"

@@ -51,6 +51,14 @@ def main(argv=None) -> int:
     p.add_argument("--host", default="127.0.0.1")
     p.add_argument("--port", type=int, default=8420)
     p.add_argument("--buckets", default="1,8,32,128")
+    p.add_argument("--decode_mode", default="cached",
+                   choices=["cached", "scan", "spec", "stride"])
+    p.add_argument("--serve_dtype", default="f32", choices=["f32", "bf16"])
+    p.add_argument("--spec_block", type=int, default=8)
+    p.add_argument("--tuned_config", default=None,
+                   help="tuned_config.json from scripts/autotune.py; fills "
+                        "every serving knob not given explicitly above "
+                        "(fingerprint mismatch -> warn, serve on defaults)")
     p.add_argument("--max_batch_wait_ms", type=float, default=2.0)
     p.add_argument("--max_queue", type=int, default=256)
     p.add_argument("--max_retries", type=int, default=2)
@@ -82,6 +90,23 @@ def main(argv=None) -> int:
         from mat_dcml_tpu.telemetry.slo import SLOConfig, SLOMonitor
 
         slo = SLOMonitor(SLOConfig(latency_p99_ms=args.slo_p99_ms))
+    engine_cfg = EngineConfig(
+        buckets=tuple(int(b) for b in args.buckets.split(",")),
+        decode_mode=args.decode_mode,
+        spec_block=args.spec_block,
+        serve_dtype=args.serve_dtype,
+    )
+    tuned_app = None
+    if args.tuned_config:
+        from mat_dcml_tpu.tuning import (apply_tuned_engine,
+                                         explicit_cli_flags,
+                                         last_application)
+
+        # flags the user actually typed beat the artifact, field by field
+        engine_cfg = apply_tuned_engine(
+            args.tuned_config, engine_cfg,
+            explicit=explicit_cli_flags(argv))
+        tuned_app = last_application()
     fleet = EngineFleet.from_export(
         args.policy_dir,
         fleet_cfg=FleetConfig(
@@ -89,8 +114,7 @@ def main(argv=None) -> int:
             max_retries=args.max_retries,
             request_timeout_s=args.request_timeout_s or None,
         ),
-        engine_cfg=EngineConfig(
-            buckets=tuple(int(b) for b in args.buckets.split(","))),
+        engine_cfg=engine_cfg,
         batcher_cfg=BatcherConfig(max_queue=args.max_queue,
                                   max_batch_wait_ms=args.max_batch_wait_ms),
         rollout_cfg=RolloutConfig(
@@ -101,6 +125,11 @@ def main(argv=None) -> int:
         tracer=tracer,
         slo_monitor=slo,
     )
+    if tuned_app is not None:
+        # the tune_ gauge family rides the fleet-merged /metrics scrape,
+        # mirroring what the training runner publishes from the same artifact
+        for name, value in tuned_app.gauges().items():
+            fleet.telemetry.gauge(name, value)
     server = PolicyServer(fleet=fleet, host=args.host, port=args.port)
     server.start()
 
