@@ -149,6 +149,23 @@ Knobs (environment variables):
                         (1,4,16), BENCH_OBS_FED_SAMPLE (0.01),
                         BENCH_OBS_FED_TRIALS (5), BENCH_OBS_FED_RUN_DIR
                         (append records + trace.jsonl, then strict-validate)
+  BENCH_OBS_ROLLUP      "1" → long-run rollup-plane overhead A/B: the armed
+                        leg runs the identical single-replica fleet while a
+                        background loop every 100 ms folds the merged
+                        registry snapshot into a RollupStore (tiered rings +
+                        exact sketch deltas), drains its ts_ records, AND
+                        feeds them through a live IncidentCorrelator — the
+                        full unattended-soak verdict plane, far hotter than
+                        a real 1-15 s cadence.  Plain leg: same fleet, no
+                        rollup, no correlator.  Record value = armed QPS,
+                        vs_baseline = median per-round (matched-pair) on/off
+                        QPS ratio (contract: >= 0.98).  Knobs:
+                        BENCH_OBS_ROLLUP_REQUESTS (512),
+                        BENCH_OBS_ROLLUP_CONCURRENCY (16),
+                        BENCH_OBS_ROLLUP_BUCKETS (1,4,16),
+                        BENCH_OBS_ROLLUP_TRIALS (5), BENCH_OBS_ROLLUP_RUN_DIR
+                        (append records + timeseries.jsonl, then
+                        strict-validate)
   BENCH_CHAOS           "1" → chaos-seam overhead A/B: the injector DISARMED
                         (production default — every seam is one module-
                         attribute read + ``is None`` branch) vs ARMED with an
@@ -2380,6 +2397,152 @@ def _measure_obs(jax) -> None:
     print(json.dumps(record), flush=True)
 
 
+def _measure_obs_rollup(jax) -> None:
+    """BENCH_OBS_ROLLUP=1 leg: rollup-plane + incident-correlator overhead A/B.
+
+    Both legs run the identical single-replica fleet under the same
+    closed-loop load.  The armed leg runs the full unattended-soak verdict
+    plane beside it: every 100 ms (far hotter than a real collector's 1-15 s
+    cadence) a background loop takes the exact-merged registry snapshot,
+    folds it into a :class:`RollupStore` (tiered rings, per-window sketch
+    deltas), drains the closed windows' ``ts_`` records, and feeds snapshot
+    plus drained records through a live :class:`IncidentCorrelator`.  The
+    plain leg serves the same load with none of that.
+
+    ``vs_baseline`` is the MEDIAN of per-round armed/plain QPS ratios
+    (matched pairs, same rationale as the BENCH_OBS_FED leg: each round runs
+    both legs back-to-back under the same transient container load, so the
+    ratio cancels the drift).  Contract: >= 0.98."""
+    import tempfile
+    import threading as _threading
+
+    from mat_dcml_tpu.config import RunConfig
+    from mat_dcml_tpu.envs.dcml import DCMLEnv, DCMLEnvConfig
+    from mat_dcml_tpu.serving.batcher import BatcherConfig
+    from mat_dcml_tpu.serving.engine import EngineConfig
+    from mat_dcml_tpu.serving.fleet import EngineFleet, FleetConfig
+    from mat_dcml_tpu.serving.loadgen import run_load, write_serving_record
+    from mat_dcml_tpu.serving.server import PolicyClient
+    from mat_dcml_tpu.telemetry.incidents import IncidentCorrelator
+    from mat_dcml_tpu.telemetry.timeseries import RollupStore
+    from mat_dcml_tpu.training.runner import build_mat_policy
+    from mat_dcml_tpu.utils.metrics import MetricsWriter
+
+    data_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)), "data")
+    env = DCMLEnv(DCMLEnvConfig(), data_dir=data_dir)
+    policy = build_mat_policy(RunConfig(), env)
+    params = policy.init_params(jax.random.key(0))
+
+    n_req = int(os.environ.get("BENCH_OBS_ROLLUP_REQUESTS", "512"))
+    conc = int(os.environ.get("BENCH_OBS_ROLLUP_CONCURRENCY", "16"))
+    buckets = tuple(
+        int(b)
+        for b in os.environ.get("BENCH_OBS_ROLLUP_BUCKETS", "1,4,16").split(",")
+    )
+    trials = int(os.environ.get("BENCH_OBS_ROLLUP_TRIALS", "5"))
+    run_dir = os.environ.get("BENCH_OBS_ROLLUP_RUN_DIR", "")
+    # the armed leg pays real jsonl I/O for its drained ts_ records, same as
+    # a soak would — scratch dir when the caller doesn't keep artifacts
+    ts_dir = run_dir or tempfile.mkdtemp(prefix="bench_obs_rollup_")
+
+    def _run_leg(name: str) -> dict:
+        armed = name == "armed"
+        fleet = EngineFleet(
+            params, policy.cfg,
+            fleet_cfg=FleetConfig(n_replicas=1),
+            engine_cfg=EngineConfig(buckets=buckets),
+            batcher_cfg=BatcherConfig(max_batch_wait_ms=2.0),
+            log_fn=lambda *a: None,
+        )
+        fleet.warmup()
+        stop = _threading.Event()
+        stats = {"folds": 0, "ts_records": 0, "incidents": 0.0}
+
+        def _rollup_loop(fl=fleet, st=stats):
+            store = RollupStore()
+            corr = IncidentCorrelator()
+            writer = MetricsWriter(ts_dir, jsonl_name="timeseries.jsonl")
+            try:
+                while not stop.is_set():
+                    snap = fl.aggregator().snapshot()
+                    store.observe_record(snap)
+                    corr.ingest(snap)
+                    for rec in store.drain_records():
+                        corr.ingest(rec)
+                        writer.write(rec)
+                        st["ts_records"] += 1
+                    st["folds"] += 1
+                    stop.wait(timeout=0.1)
+            finally:
+                corr.finalize()
+                st["incidents"] = corr.summary()["incident_total"]
+                writer.close()
+
+        roller = None
+        if armed:
+            roller = _threading.Thread(target=_rollup_loop, daemon=True)
+            roller.start()
+        rec = run_load(PolicyClient(fleet), n_requests=n_req, concurrency=conc)
+        if roller is not None:
+            stop.set()
+            roller.join(timeout=5.0)
+            rec["obs_rollup_folds"] = stats["folds"]
+            rec["obs_ts_records"] = stats["ts_records"]
+            rec["obs_incidents"] = stats["incidents"]
+        rec["steady_state_recompiles"] = fleet.steady_state_recompiles()
+        fleet.close()
+        log(f"obs_rollup[{name}]: {rec['serving_qps']:.1f} req/s, "
+            f"p50 {rec['serving_p50_ms']:.1f} ms, "
+            f"p99 {rec['serving_p99_ms']:.1f} ms")
+        return rec
+
+    best, legs = ab_trials(
+        {"armed": lambda: _run_leg("armed"),
+         "plain": lambda: _run_leg("plain")},
+        trials, score=lambda r: r["serving_qps"])
+    if run_dir:
+        for rec in best.values():
+            write_serving_record(
+                run_dir,
+                {k: v for k, v in rec.items() if not k.startswith("obs_")})
+
+    dev = jax.devices()[0]
+    armed_qps = best["armed"]["serving_qps"]
+    plain_qps = best["plain"]["serving_qps"]
+    ratios = paired_ratios(legs, "armed", "plain",
+                           value=lambda r: r["serving_qps"])
+    median_ratio = median_of_ratios(legs, "armed", "plain",
+                                    value=lambda r: r["serving_qps"])
+    record = {
+        "metric": "dcml_mat_obs_rollup_overhead_qps",
+        "value": round(armed_qps, 2),
+        "unit": "req/s",
+        # rollup + correlator tax at a 10x-hot cadence (contract >= 0.98)
+        "vs_baseline": round(median_ratio, 4),
+        "paired_ratios": [round(r, 3) for r in ratios],
+        "platform": dev.platform,
+        "device": dev.device_kind,
+        "provisional": False,
+        "buckets": ",".join(str(b) for b in buckets),
+        "requests": n_req,
+        "concurrency": conc,
+        "trials": max(trials, 1),
+        "plain_qps": round(plain_qps, 2),
+        "armed_qps_all": [round(r["serving_qps"], 1) for r in legs["armed"]],
+        "plain_qps_all": [round(r["serving_qps"], 1) for r in legs["plain"]],
+        "armed_p50_ms": round(best["armed"]["serving_p50_ms"], 2),
+        "plain_p50_ms": round(best["plain"]["serving_p50_ms"], 2),
+        "armed_p99_ms": round(best["armed"]["serving_p99_ms"], 2),
+        "plain_p99_ms": round(best["plain"]["serving_p99_ms"], 2),
+        "rollup_folds": best["armed"].get("obs_rollup_folds", 0),
+        "ts_records": best["armed"].get("obs_ts_records", 0),
+        # a healthy bench run must stay incident-silent
+        "incidents": best["armed"].get("obs_incidents", 0.0),
+        "schema_strict_ok": _validate_run_dir(run_dir),
+    }
+    print(json.dumps(record), flush=True)
+
+
 def _is_oom(e: Exception) -> bool:
     s = f"{type(e).__name__}: {e}"
     return "RESOURCE_EXHAUSTED" in s or "Out of memory" in s or "out of memory" in s
@@ -2611,6 +2774,13 @@ def main() -> None:
     if os.environ.get("BENCH_OBS_FED", "0") == "1":
         jax, _ = _setup_jax()
         _measure_obs_fed(jax)
+        return
+
+    # Rollup-plane overhead A/B: tiered rollups + incident correlator armed
+    # at a 10x-hot cadence vs the identical fleet with the plane off
+    if os.environ.get("BENCH_OBS_ROLLUP", "0") == "1":
+        jax, _ = _setup_jax()
+        _measure_obs_rollup(jax)
         return
 
     # Chaos-seam overhead A/B: disarmed seams vs an armed-but-idle injector

@@ -16,6 +16,10 @@ returns one :class:`InvariantResult` per contract:
   holds under injected delays (last ``staleness_learner_steps_p95`` ≤ 1).
 * ``bit_exact_resume`` — the kill-and-relaunch trainer converges to the
   byte-identical final state of an uninterrupted twin (driver-computed).
+* ``incident_attribution`` — the correlator's verdict
+  (telemetry/incidents.py): an armed soak yields incidents for the injected
+  faults with 100% attribution and zero unexplained open incidents; a
+  disarmed soak yields zero incidents.
 * ``slo_burn_recovery`` — after the last fault clears, every ``slo_*_burn``
   gauge in the final fleet record is back under 1.0 (budget no longer
   burning).
@@ -57,7 +61,8 @@ def check_invariants(records: List[dict],
     out: List[InvariantResult] = []
     metrics = [r for r in records
                if "chaos" not in r and "anomaly" not in r
-               and "trace" not in r and "emergency_checkpoint" not in r]
+               and "trace" not in r and "emergency_checkpoint" not in r
+               and "incident" not in r and "ts" not in r]
 
     # --- zero dropped requests -------------------------------------------
     bad: List[str] = []
@@ -126,6 +131,48 @@ def check_invariants(records: List[dict],
             "killed+resumed run matches uninterrupted twin bit-for-bit"
             if verdict else
             "resumed final state differs from uninterrupted twin"))
+
+    # --- incident attribution ---------------------------------------------
+    # The correlator's verdict (telemetry/incidents.py): every incident of an
+    # armed soak must be attributed to an injected fault, and zero
+    # unexplained incidents may remain open — an unattributed incident means
+    # something broke that nobody injected, which fails the soak.  A clean
+    # (disarmed) soak must produce zero incidents at all.
+    incident_summary = facts.get("incident_summary")
+    if incident_summary is None:
+        if facts.get("expect_incidents", False):
+            out.append(InvariantResult(
+                "incident_attribution", False,
+                "faults fired but the correlator recorded no verdict"))
+        else:
+            out.append(_skip("incident_attribution", "correlator did not run"))
+    else:
+        total = float(incident_summary.get("incident_total", 0.0))
+        unexplained = float(incident_summary.get("incident_unexplained", 0.0))
+        opened = float(incident_summary.get("incident_open", 0.0))
+        if facts.get("expect_incidents", False):
+            ok = total > 0 and unexplained == 0 and opened == 0
+            detail = (f"{total:g} incidents, 100% attributed, none left open"
+                      if ok else
+                      f"total={total:g} unexplained={unexplained:g} "
+                      f"open={opened:g} (armed soak demands incidents for "
+                      f"injected faults, all attributed, none open)")
+        else:
+            ok = total == 0
+            detail = ("clean soak: zero incidents" if ok else
+                      f"{total:g} incidents on a disarmed soak "
+                      f"({unexplained:g} unexplained)")
+        out.append(InvariantResult("incident_attribution", ok, detail))
+
+    # a disarmed golden twin ran alongside: it must be incident-quiet —
+    # symptoms on a run with no faults armed mean the stack itself is sick
+    clean = facts.get("clean_incident_summary")
+    if clean is not None:
+        total = float(clean.get("incident_total", 0.0))
+        out.append(InvariantResult(
+            "disarmed_twin_quiet", total == 0,
+            "disarmed golden twin produced zero incidents" if total == 0
+            else f"{total:g} incident(s) on the disarmed golden twin"))
 
     # --- SLO burn recovery ------------------------------------------------
     burns = [r for r in metrics

@@ -62,6 +62,12 @@ class RunConfig:
     # serving fleet (scripts/obs_collector.py).  0 disables (default);
     # -1 binds an ephemeral port (announced on the OBS_PORT log line).
     obs_port: int = 0
+    # bounded trend rollups (telemetry/timeseries.py): diff the registry into
+    # tiered time windows at every metrics flush and stream closed raw
+    # windows as typed ts_ records into <run_dir>/timeseries.jsonl (rotating;
+    # hard memory cap independent of run length).  Served at /timeseries.json
+    # when --obs_port is set.
+    timeseries: bool = True
     # fused multi-episode dispatch: lax.scan K collect+train iterations inside
     # ONE jitted call with donated train/rollout state, so the host re-enters
     # once per K episodes instead of twice per episode (Podracer-style).  1 =

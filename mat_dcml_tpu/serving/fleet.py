@@ -193,7 +193,8 @@ class EngineFleet:
         # exhaustion becomes a typed slo_*_budget anomaly with cooldown, and
         # a tripped budget gates weight-push promotion.
         self.anomaly_detector = (
-            AnomalyDetector(anomaly_cfg, telemetry=self.telemetry)
+            AnomalyDetector(anomaly_cfg, telemetry=self.telemetry,
+                            exemplar_fn=self._trace_exemplar)
             if slo_monitor is not None else None)
         self.anomalies: List[dict] = []
         self._slo_seen = 0
@@ -463,6 +464,11 @@ class EngineFleet:
         self._slo_seen += 1
         if self._slo_seen % self._slo_check_every == 0:
             self.check_slo()
+
+    def _trace_exemplar(self) -> Optional[str]:
+        """Most recent sampled trace id — pinned on anomaly trips so an
+        incident links to one concrete request tree."""
+        return self.tracer.last_trace_id if self.tracer is not None else None
 
     def check_slo(self) -> List[dict]:
         """Run the SLO burn gauges through the anomaly detector; returns (and

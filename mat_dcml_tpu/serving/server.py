@@ -63,6 +63,8 @@ from mat_dcml_tpu.telemetry.propagate import extract as extract_traceparent
 from mat_dcml_tpu.telemetry.propagate import inject as inject_traceparent
 from mat_dcml_tpu.telemetry.registry import Telemetry
 from mat_dcml_tpu.telemetry.remote import SNAPSHOT_PATH, build_snapshot
+from mat_dcml_tpu.telemetry.remote import run_identity
+from mat_dcml_tpu.telemetry.timeseries import TIMESERIES_PATH, RollupStore
 from mat_dcml_tpu.telemetry.slo import SLOConfig, SLOMonitor
 from mat_dcml_tpu.telemetry.tracing import TraceContext, Tracer
 
@@ -233,6 +235,8 @@ class _Handler(BaseHTTPRequestHandler):
                              "text/plain; version=0.0.4; charset=utf-8")
         elif self.path == SNAPSHOT_PATH:
             self._reply(200, srv.telemetry_snapshot())
+        elif self.path == TIMESERIES_PATH:
+            self._reply(200, srv.timeseries_snapshot())
         elif self.path == "/healthz":
             payload = {"ok": True, "warm": srv.warm,
                        "buckets": list(srv.engine.engine_cfg.buckets)}
@@ -389,10 +393,16 @@ class PolicyServer:
             self.tracer = tracer
             self.slo = slo_monitor
             self._slo_detector = (
-                AnomalyDetector(anomaly_cfg) if slo_monitor is not None else None)
+                AnomalyDetector(
+                    anomaly_cfg,
+                    exemplar_fn=lambda: (self.tracer.last_trace_id
+                                         if self.tracer is not None else None))
+                if slo_monitor is not None else None)
         self.anomalies: list = []
         self._slo_seen = 0
         self._snapshot_seq = 0
+        self._ts_seq = 0
+        self.rollup = RollupStore()
         self._snapshot_lock = threading.Lock()
         self.client = PolicyClient(self.batcher)
         self.log_fn = log_fn
@@ -437,6 +447,29 @@ class PolicyServer:
         extra = self.slo.gauges() if self.slo is not None else None
         return build_snapshot(f"serving:{self.port}", sources, seq,
                               extra_gauges=extra)
+
+    def timeseries_snapshot(self) -> dict:
+        """``GET /timeseries.json`` payload: scrape-driven sampling — each
+        request diffs every labelled registry (and the live SLO burn gauges)
+        into the rollup store, then serves its canonical wire under a
+        monotonic ``seq``."""
+        with self._snapshot_lock:
+            self._ts_seq += 1
+            seq = self._ts_seq
+            t = time.time()
+            for label, tel in self._obs_sources():
+                self.rollup.observe_telemetry(tel, t=t, source=label)
+            if self.slo is not None:
+                self.rollup.observe_record(self.slo.gauges(), t=t)
+            wire = self.rollup.to_wire()
+        snap = {
+            "source": f"serving:{self.port}",
+            "seq": seq,
+            "time_s": t,
+            "rollup": wire,
+        }
+        snap.update(run_identity())
+        return snap
 
     def observe_request(self, t0: float, ok: bool, trace=None,
                         status: str = "ok") -> None:

@@ -30,7 +30,19 @@ from mat_dcml_tpu.telemetry.propagate import (
     inject as inject_traceparent,
     parse_traceparent,
 )
+from mat_dcml_tpu.telemetry.incidents import (
+    Incident,
+    IncidentConfig,
+    IncidentCorrelator,
+    correlate,
+)
 from mat_dcml_tpu.telemetry.registry import HistogramSketch, Telemetry
+from mat_dcml_tpu.telemetry.timeseries import (
+    TIMESERIES_PATH,
+    RollupConfig,
+    RollupStore,
+    merge_wires,
+)
 from mat_dcml_tpu.telemetry.remote import (
     RemoteScraper,
     TelemetrySidecar,
@@ -62,12 +74,18 @@ __all__ = [
     "DeferredFetch",
     "FlightRecorder",
     "HistogramSketch",
+    "Incident",
+    "IncidentConfig",
+    "IncidentCorrelator",
     "InstrumentedJit",
     "ProbeSink",
     "ProfilerWindow",
     "RemoteScraper",
+    "RollupConfig",
+    "RollupStore",
     "SLOConfig",
     "SLOMonitor",
+    "TIMESERIES_PATH",
     "TRACEPARENT_HEADER",
     "Telemetry",
     "TelemetryAggregator",
@@ -75,6 +93,7 @@ __all__ = [
     "TraceContext",
     "Tracer",
     "build_snapshot",
+    "correlate",
     "deserialize_telemetry",
     "device_memory_gauges",
     "extract_traceparent",
@@ -83,6 +102,7 @@ __all__ = [
     "inject_traceparent",
     "instrumented_jit",
     "load_bundle",
+    "merge_wires",
     "named_scope",
     "named_scopes_enabled",
     "pack_tree",

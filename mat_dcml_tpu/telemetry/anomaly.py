@@ -81,6 +81,9 @@ class Anomaly:
     baseline: Optional[float]
     episode: int
     total_steps: int
+    # most recent sampled trace id at trip time (tracing.Tracer.last_trace_id)
+    # — the exemplar that links an incident to one concrete span tree
+    trace_exemplar: Optional[str] = None
 
     def to_record(self) -> dict:
         """Jsonl-safe record: the ``anomaly`` key routes validators to the
@@ -94,7 +97,7 @@ class Anomaly:
                 return "nan"
             return "inf" if v > 0 else "-inf"
 
-        return {
+        rec = {
             "anomaly": self.kind,
             "signal": self.signal,
             "value": enc(self.value),
@@ -102,15 +105,23 @@ class Anomaly:
             "episode": self.episode,
             "total_steps": self.total_steps,
         }
+        if self.trace_exemplar is not None:
+            rec["trace_exemplar"] = self.trace_exemplar
+        return rec
 
 
 class AnomalyDetector:
     """Feed ``observe`` a flat ``{signal: float}`` dict once per unit
     (episode or fused dispatch); it returns the anomalies that tripped."""
 
-    def __init__(self, cfg: AnomalyConfig = AnomalyConfig(), telemetry=None):
+    def __init__(self, cfg: AnomalyConfig = AnomalyConfig(), telemetry=None,
+                 exemplar_fn=None):
         self.cfg = cfg
         self.telemetry = telemetry
+        # zero-arg callable returning the most recent sampled trace id (or
+        # None) — typically ``lambda: tracer.last_trace_id``; every trip
+        # carries it so incidents link to a concrete trace tree
+        self.exemplar_fn = exemplar_fn
         self._ema: Dict[str, float] = {}
         self._n: Dict[str, int] = {}
         self._last_trip: Dict[str, int] = {}
@@ -129,7 +140,15 @@ class AnomalyDetector:
         if not self._cooled(kind):
             return
         self._last_trip[kind] = self._unit
-        out.append(Anomaly(kind, signal, float(value), baseline, episode, total_steps))
+        exemplar = None
+        if self.exemplar_fn is not None:
+            try:
+                exemplar = self.exemplar_fn()
+            except Exception:
+                exemplar = None
+        out.append(Anomaly(kind, signal, float(value), baseline, episode,
+                           total_steps,
+                           trace_exemplar=str(exemplar) if exemplar else None))
         if self.telemetry is not None:
             self.telemetry.count("anomalies_total")
             self.telemetry.count(f"anomalies_{kind}")

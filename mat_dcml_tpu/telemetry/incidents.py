@@ -1,0 +1,410 @@
+"""Incident correlation: typed record streams → attributed incident objects.
+
+The soak verdict layer.  :class:`IncidentCorrelator` consumes the repo's
+typed observability records — anomaly trips (incl. ``slo_*`` burn budgets),
+``chaos`` fired/suppressed/cleared, emergency checkpoints, supervisor relaunch
+lineage, scrape-health transitions, fleet replica health — and groups them
+into incidents via time proximity plus causal keys: chaos event ids (PR 15's
+suppression keys), trace exemplars, ``run_id``/``incarnation``.
+
+Lifecycle: ``open`` → ``mitigated`` (the attributed fault cleared) →
+``resolved`` (quiet after mitigation / at finalize).  An incident **cannot
+resolve without attribution** — an unexplained incident stays open by design,
+which is exactly what lets ``chaos_soak.py``'s invariant fail a soak on a
+symptom nobody injected.  Dedup folds repeat symptoms of the same kind into
+one incident; flap suppression stops a bouncing signal from minting an
+open/mitigate storm.
+
+State transitions emit typed ``{"incident": <stage>}`` records with a closed
+field set (validated by ``check_metrics_schema.py``); :meth:`summary` exports
+the ``incident_`` gauge family.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence
+
+
+def suppression_map() -> Dict[str, tuple]:
+    """PR 15's chaos-kind → anomaly-kind-prefix suppression keys, reused as
+    the attribution table (lazy import: chaos ↛ telemetry layering)."""
+    try:
+        from mat_dcml_tpu.chaos.inject import _SUPPRESSES
+        return dict(_SUPPRESSES)
+    except Exception:
+        return {}
+
+
+# symptom kinds the correlator derives itself (not anomaly-detector kinds)
+KILL_KINDS = ("trainer_kill",)
+CRITICAL_KINDS = ("nonfinite", "supervisor_kill", "supervisor_relaunch",
+                  "fleet_no_healthy")
+
+# causal keys for correlator-derived symptoms: which injected fault kinds
+# explain them (the anomaly-kind prefixes come from the chaos suppression
+# table; this covers the health transitions the correlator itself derives)
+SYMPTOM_FAULTS: Dict[str, tuple] = {
+    "fleet_unhealthy": ("replica_crash", "replica_hang", "trainer_kill"),
+    "fleet_no_healthy": ("replica_crash", "replica_hang"),
+    "scrape_degraded": ("trainer_kill", "replica_crash", "replica_hang"),
+    "supervisor_kill": KILL_KINDS,
+    "supervisor_relaunch": KILL_KINDS,
+}
+
+LIFECYCLE = ("open", "mitigated", "resolved", "annotated")
+SEVERITIES = ("warning", "critical")
+
+
+@dataclasses.dataclass
+class IncidentConfig:
+    # a symptom within this many seconds of a fault's active window (fired →
+    # cleared + grace) attributes to it by time proximity
+    proximity_s: float = 45.0
+    # same-kind symptom within this window folds into the existing incident
+    flap_window_s: float = 120.0
+    # reopen storms beyond this many flaps stop emitting records
+    max_flaps: int = 8
+
+
+@dataclasses.dataclass
+class Incident:
+    incident_id: str
+    kind: str
+    severity: str
+    state: str                      # open | mitigated | resolved
+    opened_t: float
+    last_symptom_t: float
+    attributed_to: Optional[str] = None   # chaos event id (causal key)
+    trace_exemplar: Optional[str] = None
+    run_id: Optional[str] = None
+    incarnation: Optional[int] = None
+    events: int = 1
+    flaps: int = 0
+    mitigated_t: Optional[float] = None
+    resolved_t: Optional[float] = None
+
+    def record(self, stage: str, t: float) -> Dict:
+        rec: Dict = {
+            "incident": stage,
+            "incident_id": self.incident_id,
+            "kind": self.kind,
+            "severity": self.severity,
+            "t_s": round(float(t), 6),
+            "events": self.events,
+            "flaps": self.flaps,
+        }
+        if self.attributed_to is not None:
+            rec["attributed_to"] = self.attributed_to
+        if self.trace_exemplar is not None:
+            rec["trace_exemplar"] = self.trace_exemplar
+        if stage == "resolved":
+            rec["duration_s"] = round(float(t) - self.opened_t, 6)
+        return rec
+
+
+class _Fault:
+    __slots__ = ("event_id", "kind", "fired_t", "cleared_t")
+
+    def __init__(self, event_id: str, kind: str, fired_t: float):
+        self.event_id = event_id
+        self.kind = kind
+        self.fired_t = fired_t
+        self.cleared_t: Optional[float] = None
+
+    def active_at(self, t: float, grace: float) -> bool:
+        if t < self.fired_t - 1e-9:
+            return False
+        end = self.cleared_t if self.cleared_t is not None else t
+        return t <= end + grace
+
+
+class IncidentCorrelator:
+    """Feed records in stream order via :meth:`ingest`; call :meth:`finalize`
+    at end-of-run.  Emitted transition records accumulate in
+    :meth:`records`; live objects in :meth:`incidents`."""
+
+    def __init__(self, cfg: IncidentConfig = IncidentConfig()):
+        self.cfg = cfg
+        self._suppresses = suppression_map()
+        self._faults: Dict[str, _Fault] = {}
+        self._incidents: List[Incident] = []
+        self._by_kind: Dict[str, Incident] = {}
+        self._records: List[Dict] = []
+        self._t = 0.0
+        self.flaps_suppressed = 0
+        # scrape / fleet transition state
+        self._last_scrape: Dict[str, float] = {}
+        self._last_fleet_healthy: Optional[float] = None
+
+    # ------------------------------------------------------------ fault plane
+
+    def register_fault(self, event_id: str, kind: str, t: float,
+                       cleared_t: Optional[float] = None) -> None:
+        """Register an injected fault as an attribution target.  The soak uses
+        this for faults it delivers itself (e.g. the SIGTERM kill)."""
+        f = self._faults.get(event_id)
+        if f is None:
+            f = self._faults[event_id] = _Fault(event_id, kind, float(t))
+        if cleared_t is not None:
+            f.cleared_t = float(cleared_t)
+
+    def _clear_fault(self, event_id: str, t: float) -> None:
+        f = self._faults.get(event_id)
+        if f is not None and f.cleared_t is None:
+            f.cleared_t = t
+        for inc in self._incidents:
+            if inc.attributed_to == event_id and inc.state == "open":
+                self._transition(inc, "mitigated", t)
+
+    def _kind_match(self, symptom_kind: str, fault_kind: str) -> bool:
+        prefixes = self._suppresses.get(fault_kind, ())
+        if any(symptom_kind.startswith(p) for p in prefixes):
+            return True
+        if fault_kind in SYMPTOM_FAULTS.get(symptom_kind, ()):
+            return True
+        return (symptom_kind in CRITICAL_KINDS or
+                symptom_kind.startswith("supervisor")) and \
+            fault_kind in KILL_KINDS
+
+    def _attribute(self, symptom_kind: str, t: float) -> Optional[str]:
+        """Causal-key attribution.  A fault whose kind explains the symptom
+        (suppression prefixes, the SYMPTOM_FAULTS table, or kill-family
+        matching) and whose active window covers ``t`` wins outright.  A
+        kind-matching fault *outside* the window still attributes — soak
+        streams concatenate sources whose monotonic clocks are incomparable,
+        so the causal key outranks time proximity; nearest ``fired_t`` breaks
+        ties.  With no kind match at all, the single active fault attributes
+        only when the injection plan leaves no ambiguity."""
+        matched: List[_Fault] = []
+        active_only: List[_Fault] = []
+        for f in self._faults.values():
+            match = self._kind_match(symptom_kind, f.kind)
+            active = f.active_at(t, self.cfg.proximity_s)
+            if match and active:
+                return f.event_id
+            if match:
+                matched.append(f)
+            elif active:
+                active_only.append(f)
+        if matched:
+            return min(matched, key=lambda f: abs(f.fired_t - t)).event_id
+        if len(active_only) == 1:
+            return active_only[0].event_id
+        return None
+
+    # -------------------------------------------------------------- ingestion
+
+    def ingest(self, record: Dict, t: Optional[float] = None) -> None:
+        """Dispatch one typed record.  Chaos records advance the stream clock
+        from their ``t_s``; other records ride the latest clock (or an
+        explicit ``t``)."""
+        if t is not None:
+            self._t = max(self._t, float(t))
+        if "chaos" in record:
+            self._ingest_chaos(record)
+        elif "anomaly" in record:
+            self._symptom(
+                str(record["anomaly"]), self._t,
+                trace=record.get("trace_exemplar"),
+                run_id=record.get("run_id"),
+                incarnation=record.get("incarnation"),
+            )
+        elif "emergency_checkpoint" in record:
+            self._symptom(
+                "supervisor_kill", self._t, severity="critical",
+                run_id=record.get("run_id"),
+                incarnation=record.get("incarnation"),
+            )
+        elif "resilience_supervisor_relaunch" in record:
+            self._ingest_relaunch(record)
+        elif "incident" in record or "ts" in record or "trace" in record:
+            pass
+        else:
+            self._ingest_metrics(record)
+
+    def _ingest_chaos(self, record: Dict) -> None:
+        t = float(record.get("t_s", self._t))
+        self._t = max(self._t, t)
+        stage = record["chaos"]
+        event_id = str(record.get("event_id", ""))
+        kind = str(record.get("kind", ""))
+        if stage == "fired":
+            self.register_fault(event_id, kind, t)
+        elif stage == "cleared":
+            self._clear_fault(event_id, t)
+        elif stage == "suppressed":
+            # explicit causal key: the injector already matched this anomaly
+            # kind to the fault that explains it
+            self._symptom(str(record.get("suppressed_kind", kind)), t,
+                          attributed=event_id)
+
+    def _ingest_relaunch(self, record: Dict) -> None:
+        t = self._t
+        run_id = record.get("run_id")
+        incarnation = record.get("incarnation")
+        # annotate the matching kill incident (same run lineage) rather than
+        # opening a second one — the relaunch is the mitigation, not a new
+        # failure
+        for inc in reversed(self._incidents):
+            if inc.kind in ("supervisor_kill", "supervisor_relaunch") and \
+                    inc.state != "resolved" and \
+                    (run_id is None or inc.run_id in (None, run_id)):
+                inc.events += 1
+                inc.last_symptom_t = t
+                if incarnation is not None:
+                    inc.incarnation = int(incarnation)
+                if run_id is not None:
+                    inc.run_id = str(run_id)
+                rec = inc.record("annotated", t)
+                if inc.incarnation is not None:
+                    rec["incarnation"] = inc.incarnation
+                self._records.append(rec)
+                if inc.state == "open" and inc.attributed_to is not None:
+                    self._transition(inc, "mitigated", t)
+                return
+        self._symptom("supervisor_relaunch", t, severity="critical",
+                      run_id=run_id, incarnation=incarnation)
+
+    def _ingest_metrics(self, record: Dict) -> None:
+        t = self._t
+        # scrape-health transitions: errors/stale/restarts increasing
+        for name in ("scrape_stale", "scrape_errors", "scrape_restarts"):
+            v = record.get(name)
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                prev = self._last_scrape.get(name, 0.0)
+                if float(v) > prev:
+                    self._symptom("scrape_degraded", t)
+                self._last_scrape[name] = float(v)
+        # fleet replica health drops
+        healthy = record.get("fleet_healthy")
+        replicas = record.get("fleet_replicas")
+        if isinstance(healthy, (int, float)) and \
+                isinstance(replicas, (int, float)):
+            prev = self._last_fleet_healthy
+            if healthy < replicas and (prev is None or healthy < prev):
+                kind = ("fleet_no_healthy" if healthy == 0
+                        else "fleet_unhealthy")
+                self._symptom(kind, t)
+            self._last_fleet_healthy = float(healthy)
+
+    # ---------------------------------------------------------- incident core
+
+    def _symptom(self, kind: str, t: float, attributed: Optional[str] = None,
+                 severity: Optional[str] = None,
+                 trace: Optional[str] = None,
+                 run_id: Optional[str] = None,
+                 incarnation=None) -> None:
+        self._t = max(self._t, t)
+        if severity is None:
+            severity = ("critical"
+                        if any(kind.startswith(c) for c in CRITICAL_KINDS)
+                        else "warning")
+        inc = self._by_kind.get(kind)
+        if inc is not None and inc.state != "resolved" and \
+                (t - inc.last_symptom_t) <= self.cfg.flap_window_s:
+            inc.events += 1
+            inc.last_symptom_t = t
+            if severity == "critical":
+                inc.severity = "critical"
+            if inc.trace_exemplar is None and trace:
+                inc.trace_exemplar = str(trace)
+            if run_id is not None:
+                inc.run_id = str(run_id)
+            if incarnation is not None:
+                inc.incarnation = int(incarnation)
+            newly = attributed or self._attribute(kind, t)
+            if inc.attributed_to is None and newly is not None:
+                inc.attributed_to = newly
+                self._records.append(inc.record("annotated", t))
+            if inc.state == "mitigated":
+                inc.flaps += 1
+                inc.state = "open"
+                inc.mitigated_t = None
+                if inc.flaps <= self.cfg.max_flaps:
+                    self._records.append(inc.record("open", t))
+                else:
+                    self.flaps_suppressed += 1
+            return
+        inc = Incident(
+            incident_id=f"inc:{len(self._incidents):03d}",
+            kind=kind,
+            severity=severity,
+            state="open",
+            opened_t=t,
+            last_symptom_t=t,
+            attributed_to=attributed or self._attribute(kind, t),
+            trace_exemplar=str(trace) if trace else None,
+            run_id=str(run_id) if run_id is not None else None,
+            incarnation=int(incarnation) if incarnation is not None else None,
+        )
+        self._incidents.append(inc)
+        self._by_kind[kind] = inc
+        self._records.append(inc.record("open", t))
+
+    def _transition(self, inc: Incident, state: str, t: float) -> None:
+        inc.state = state
+        if state == "mitigated":
+            inc.mitigated_t = t
+        elif state == "resolved":
+            inc.resolved_t = t
+        self._records.append(inc.record(state, t))
+
+    def finalize(self, t: Optional[float] = None) -> None:
+        """End-of-run sweep: attributed incidents whose fault cleared resolve
+        (via mitigated); unattributed incidents STAY OPEN — they are the
+        unexplained residue the soak invariant exists to catch."""
+        t = self._t if t is None else max(self._t, float(t))
+        for inc in self._incidents:
+            if inc.attributed_to is None:
+                continue
+            fault = self._faults.get(inc.attributed_to)
+            cleared = fault is None or fault.cleared_t is not None
+            if not cleared:
+                continue
+            if inc.state == "open":
+                self._transition(inc, "mitigated", t)
+            if inc.state == "mitigated":
+                self._transition(inc, "resolved", t)
+
+    # -------------------------------------------------------------- reporting
+
+    def incidents(self) -> List[Incident]:
+        return list(self._incidents)
+
+    def records(self) -> List[Dict]:
+        return list(self._records)
+
+    def summary(self) -> Dict[str, float]:
+        incs = self._incidents
+        return {
+            "incident_total": float(len(incs)),
+            "incident_open": float(sum(1 for i in incs if i.state == "open")),
+            "incident_mitigated": float(
+                sum(1 for i in incs if i.state == "mitigated")),
+            "incident_resolved": float(
+                sum(1 for i in incs if i.state == "resolved")),
+            "incident_attributed": float(
+                sum(1 for i in incs if i.attributed_to is not None)),
+            "incident_unexplained": float(
+                sum(1 for i in incs if i.attributed_to is None)),
+            "incident_critical": float(
+                sum(1 for i in incs if i.severity == "critical")),
+            "incident_flaps_suppressed": float(self.flaps_suppressed),
+        }
+
+
+def correlate(records: Sequence[Dict],
+              cfg: IncidentConfig = IncidentConfig(),
+              synthetic_faults: Sequence[Dict] = ()) -> IncidentCorrelator:
+    """Offline convenience: ingest a full record stream in order, register
+    any soak-delivered synthetic faults (``{"event_id","kind","t","cleared_t"}``),
+    finalize, return the correlator."""
+    corr = IncidentCorrelator(cfg)
+    for f in synthetic_faults:
+        corr.register_fault(f["event_id"], f["kind"], f.get("t", 0.0),
+                            cleared_t=f.get("cleared_t"))
+    for rec in records:
+        corr.ingest(rec)
+    corr.finalize()
+    return corr

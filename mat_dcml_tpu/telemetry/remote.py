@@ -39,6 +39,7 @@ from typing import Callable, Dict, Iterable, List, Optional, Tuple
 
 from .aggregate import TelemetryAggregator
 from .registry import HistogramSketch, Telemetry
+from .timeseries import TIMESERIES_PATH, RollupStore, merge_wires
 
 SNAPSHOT_PATH = "/telemetry.json"
 
@@ -134,6 +135,8 @@ class _SidecarHandler(BaseHTTPRequestHandler):
         sidecar: "TelemetrySidecar" = self.server.sidecar
         if self.path == SNAPSHOT_PATH:
             body = json.dumps(sidecar.snapshot()).encode()
+        elif self.path == TIMESERIES_PATH and sidecar.rollup is not None:
+            body = json.dumps(sidecar.timeseries_snapshot()).encode()
         elif self.path == "/healthz":
             body = json.dumps({"ok": True, "source": sidecar.label}).encode()
         else:
@@ -165,6 +168,7 @@ class TelemetrySidecar:
     def __init__(self, sources, port: int = 0, host: str = "127.0.0.1",
                  label: str = "trainer",
                  extra_gauges_fn: Optional[Callable[[], Dict]] = None,
+                 rollup: Optional[RollupStore] = None,
                  log_fn=print):
         if isinstance(sources, Telemetry):
             sources = {label: sources}
@@ -175,7 +179,9 @@ class TelemetrySidecar:
             self._sources_fn = sources
         self.label = label
         self.extra_gauges_fn = extra_gauges_fn
+        self.rollup = rollup
         self._seq = 0
+        self._ts_seq = 0
         self._seq_lock = threading.Lock()
         self._httpd = ThreadingHTTPServer((host, port), _SidecarHandler)
         self._httpd.sidecar = self
@@ -196,6 +202,26 @@ class TelemetrySidecar:
             sources[0][1].count("obs_snapshot_requests")
         extra = self.extra_gauges_fn() if self.extra_gauges_fn else None
         return build_snapshot(self.label, sources, seq, extra_gauges=extra)
+
+    def timeseries_snapshot(self) -> Dict:
+        """The ``GET /timeseries.json`` payload: scrape-driven sampling —
+        each request diffs the live registries into the rollup store, then
+        serves its canonical wire under a monotonic ``seq`` (same restart
+        detection as the telemetry snapshot)."""
+        with self._seq_lock:
+            self._ts_seq += 1
+            seq = self._ts_seq
+            for label, tel in self._sources_fn():
+                self.rollup.observe_telemetry(tel, source=label)
+            wire = self.rollup.to_wire()
+        snap: Dict = {
+            "source": self.label,
+            "seq": seq,
+            "time_s": time.time(),
+            "rollup": wire,
+        }
+        snap.update(run_identity())
+        return snap
 
     def start(self) -> None:
         self._thread = threading.Thread(
@@ -228,6 +254,11 @@ class _Source:
         self.stale = True            # never scraped = stale, not zero
         self.errors = 0
         self.restarts = 0
+        # /timeseries.json federation rides the same stale-never-zero /
+        # seq-guard state, with its own last-accepted wire + seq
+        self.ts_snapshot: Optional[Dict] = None
+        self.ts_seq: Optional[int] = None
+        self.last_duration_ms: Optional[float] = None
 
 
 class RemoteScraper:
@@ -244,7 +275,7 @@ class RemoteScraper:
 
     def __init__(self, endpoints: Iterable[Tuple[str, str]],
                  timeout_s: float = 2.0, stale_after_s: float = 10.0,
-                 log_fn=print):
+                 fetch_timeseries: bool = False, log_fn=print):
         self.sources: Dict[str, _Source] = {}
         for label, url in endpoints:
             url = url.rstrip("/")
@@ -253,6 +284,7 @@ class RemoteScraper:
             self.sources[str(label)] = _Source(str(label), url)
         self.timeout_s = float(timeout_s)
         self.stale_after_s = float(stale_after_s)
+        self.fetch_timeseries = bool(fetch_timeseries)
         self.log_fn = log_fn
         self.polls = 0
 
@@ -269,6 +301,7 @@ class RemoteScraper:
         self.polls += 1
         now = time.monotonic()
         for src in self.sources.values():
+            t0 = time.perf_counter()
             try:
                 snap = self._fetch(src)
                 seq = int(snap.get("seq", 0))
@@ -293,7 +326,30 @@ class RemoteScraper:
             src.seq = seq
             src.last_ok_s = now
             src.stale = False
+            if self.fetch_timeseries:
+                self._poll_timeseries(src)
+            src.last_duration_ms = (time.perf_counter() - t0) * 1e3
         return self.scrape_record()
+
+    def _poll_timeseries(self, src: _Source) -> None:
+        """Fetch the source's rollup wire under the same degradation
+        contract: failure keeps the last accepted wire (stale, never zero);
+        a backwards seq REPLACES the entry."""
+        url = src.url[: -len(SNAPSHOT_PATH)] + TIMESERIES_PATH
+        try:
+            with urllib.request.urlopen(url, timeout=self.timeout_s) as resp:
+                snap = json.loads(resp.read())
+            seq = int(snap.get("seq", 0))
+        except (urllib.error.URLError, OSError, ValueError,
+                json.JSONDecodeError):
+            src.errors += 1
+            return
+        if src.ts_seq is not None and seq < src.ts_seq:
+            src.restarts += 1
+            self.log_fn(f"[scrape] source {src.label} timeseries restarted "
+                        f"(seq {src.ts_seq} -> {seq}); replacing rollup")
+        src.ts_snapshot = snap
+        src.ts_seq = seq
 
     # ------------------------------------------------------------- reading
 
@@ -305,6 +361,30 @@ class RemoteScraper:
 
     def aggregator(self) -> TelemetryAggregator:
         return snapshot_aggregator(self.snapshots())
+
+    def timeseries_snapshots(self) -> List[Dict]:
+        """Latest accepted ``/timeseries.json`` payload per source (stale
+        included), in endpoint order — the deterministic merge order."""
+        return [s.ts_snapshot for s in self.sources.values()
+                if s.ts_snapshot is not None]
+
+    def merged_timeseries(self) -> Dict:
+        """Canonical merged rollup wire across sources — bit-identical to
+        :func:`mat_dcml_tpu.telemetry.timeseries.merge_wires` over the same
+        wires in process."""
+        return merge_wires(
+            [s.get("rollup") for s in self.timeseries_snapshots()])
+
+    def durations_ms(self) -> List[float]:
+        """Per-source last scrape duration (collector self-observability)."""
+        return [s.last_duration_ms for s in self.sources.values()
+                if s.last_duration_ms is not None]
+
+    def staleness_s(self, now: Optional[float] = None) -> List[float]:
+        """Per-source seconds since last successful scrape."""
+        now = time.monotonic() if now is None else now
+        return [now - s.last_ok_s for s in self.sources.values()
+                if s.last_ok_s is not None]
 
     def scrape_record(self) -> Dict[str, float]:
         return {

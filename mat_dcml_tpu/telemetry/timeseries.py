@@ -1,0 +1,441 @@
+"""Bounded streaming time-series rollups: tiered rings of fixed-width windows.
+
+A 24h unattended soak cannot ship its whole metrics.jsonl to a human — the
+rollup store keeps a *bounded* trend view no matter how long the run lives:
+fixed-width time windows in tiered rings (10s raw → 5min → 1h by default),
+per-metric ``count/sum/min/max/last`` plus an **exact** per-window
+:class:`~mat_dcml_tpu.telemetry.registry.HistogramSketch` delta for histogram
+families.  Memory is capped by construction — ``slots`` windows per tier times
+``max_series`` metrics — independent of run length.
+
+Exactness contract (the property the federation tests pin):
+
+- Cumulative counters and sketches are **diffed** against the last-seen state,
+  so each window holds the *increment* that landed inside it.  Window delta
+  sketches carry the cumulative ``vmin``/``vmax`` at window close; since those
+  are monotone, merging every window of the run reproduces the cumulative
+  sketch **bit-for-bit** (buckets/count/total add exactly; min/max of the
+  monotone series equals the final value).
+- Compaction *moves* data between tiers (a raw window evicted from its ring is
+  merged into the covering coarse window and dropped from the fine tier), so
+  any whole-store merge counts every observation exactly once.
+- The wire form (:meth:`RollupStore.to_wire`) is canonical — sorted window
+  starts, sorted metric names, sketches via ``HistogramSketch.to_dict`` — so
+  a scrape → JSON → :func:`merge_wires` round trip is bit-identical to merging
+  the live stores in process.
+
+Closed raw windows drain as schema-typed ``ts_`` records (markers
+``{"ts": "window"}`` / ``{"ts": "hist"}``) into a rotating
+``timeseries.jsonl`` via the existing ``MetricsWriter``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from mat_dcml_tpu.telemetry.registry import HistogramSketch, Telemetry
+
+# GET path served by TelemetrySidecar / PolicyServer, federated by
+# obs_collector.py with the same stale-never-zero / seq-guard semantics as
+# /telemetry.json.
+TIMESERIES_PATH = "/timeseries.json"
+
+# (window width seconds, ring slots): 10s raw for 5 min, 5 min for 2 h,
+# 1 h for a day — the whole store covers a 24h soak in ~72 windows.
+DEFAULT_TIERS: Tuple[Tuple[float, int], ...] = (
+    (10.0, 30),
+    (300.0, 24),
+    (3600.0, 24),
+)
+
+
+class _Agg:
+    """Per-metric per-window aggregate; wire form is the 5-list
+    ``[count, sum, min, max, last]``."""
+
+    __slots__ = ("count", "sum", "min", "max", "last")
+
+    def __init__(self):
+        self.count = 0
+        self.sum = 0.0
+        self.min = float("inf")
+        self.max = float("-inf")
+        self.last = 0.0
+
+    def update(self, value: float, last: Optional[float] = None) -> None:
+        v = float(value)
+        self.count += 1
+        self.sum += v
+        self.min = min(self.min, v)
+        self.max = max(self.max, v)
+        self.last = v if last is None else float(last)
+
+    def merge(self, other: "_Agg", cross_source: bool = False) -> None:
+        if other.count == 0:
+            return
+        self.count += other.count
+        self.sum += other.sum
+        self.min = min(self.min, other.min)
+        self.max = max(self.max, other.max)
+        # time-ordered merges (tier compaction, oldest-first) keep the newer
+        # window's last; cross-source merges sum, mirroring the aggregator's
+        # gauge semantics
+        self.last = self.last + other.last if cross_source else other.last
+
+    def to_list(self) -> List[float]:
+        return [self.count, self.sum, self.min, self.max, self.last]
+
+    @classmethod
+    def from_list(cls, vals: Sequence[float]) -> "_Agg":
+        a = cls()
+        a.count = int(vals[0])
+        a.sum = float(vals[1])
+        a.min = float(vals[2])
+        a.max = float(vals[3])
+        a.last = float(vals[4])
+        return a
+
+
+class _Window:
+    __slots__ = ("start", "metrics", "hists")
+
+    def __init__(self, start: float):
+        self.start = start
+        self.metrics: Dict[str, _Agg] = {}
+        self.hists: Dict[str, HistogramSketch] = {}
+
+    def merge(self, other: "_Window", cross_source: bool = False) -> None:
+        for name, agg in other.metrics.items():
+            mine = self.metrics.get(name)
+            if mine is None:
+                mine = self.metrics[name] = _Agg.from_list(agg.to_list())
+            else:
+                mine.merge(agg, cross_source=cross_source)
+        for name, sk in other.hists.items():
+            mine_sk = self.hists.get(name)
+            if mine_sk is None:
+                self.hists[name] = HistogramSketch.from_dict(sk.to_dict())
+            else:
+                mine_sk.merge(sk)
+
+
+@dataclasses.dataclass(frozen=True)
+class RollupConfig:
+    tiers: Tuple[Tuple[float, int], ...] = DEFAULT_TIERS
+    max_series: int = 192        # distinct scalar metric names tracked
+    max_hist_series: int = 32    # distinct histogram families tracked
+
+    def cap_bytes(self) -> int:
+        """Analytic hard memory cap the store promises to stay under,
+        independent of run length: every tier ring full, every window dense."""
+        slots = sum(n for _, n in self.tiers)
+        agg_bytes = 640                                   # dict entry + _Agg
+        sketch_bytes = HistogramSketch.NBUCKETS * 40 + 1024
+        per_window = (self.max_series * agg_bytes
+                      + self.max_hist_series * sketch_bytes)
+        # diff state: one float per scalar series + one bucket list per hist
+        diff = self.max_series * 256 + self.max_hist_series * sketch_bytes
+        return slots * per_window + diff + 65536
+
+
+class RollupStore:
+    """Tiered-ring rollup store with a hard memory cap.
+
+    ``observe_telemetry`` diffs a cumulative :class:`Telemetry` registry into
+    the current raw window; ``observe_record`` folds an already-flat metrics
+    record in gauge-style.  Pass a fake ``time_fn`` (or explicit ``t``) to
+    drive multi-hour streams deterministically in tests.
+    """
+
+    def __init__(self, cfg: RollupConfig = RollupConfig(),
+                 time_fn: Callable[[], float] = time.time):
+        self.cfg = cfg
+        self._time_fn = time_fn
+        # the training loop flushes while the sidecar's HTTP thread serves
+        # scrape-driven samples of the same store
+        self._lock = threading.RLock()
+        # per tier: insertion-ordered {aligned_start: _Window}, oldest first
+        self._tiers: List[Dict[float, _Window]] = [
+            {} for _ in cfg.tiers
+        ]
+        self._last_counters: Dict[Tuple[str, str], float] = {}
+        self._last_hists: Dict[Tuple[str, str], Dict] = {}
+        self._pending: List[Dict] = []
+        self.series_dropped = 0
+        self.windows_closed = 0
+        self.windows_expired = 0
+        self.compactions = 0
+        self._series: set = set()
+        self._hist_series: set = set()
+
+    # ------------------------------------------------------------- ingestion
+
+    def observe_telemetry(self, tel: Telemetry, t: Optional[float] = None,
+                          source: str = "") -> None:
+        """Diff a cumulative registry into the window covering ``t``:
+        counters/hists contribute their increment since the previous call for
+        the same ``source``; gauges contribute their current value."""
+        t = self._time_fn() if t is None else float(t)
+        with self._lock:
+            w = self._window_for(t)
+            for name, v in dict(tel.counters).items():
+                key = (source, name)
+                delta = float(v) - self._last_counters.get(key, 0.0)
+                self._last_counters[key] = float(v)
+                self._update(w, name, delta, last=float(v))
+            for name, v in dict(tel._gauges).items():
+                self._update(w, name, float(v))
+            for name, sk in dict(tel.hists).items():
+                if not self._admit_hist(name):
+                    continue
+                key = (source, name)
+                prev = self._last_hists.get(key)
+                dsk = HistogramSketch()
+                if prev is None:
+                    dsk.buckets = list(sk.buckets)
+                    dsk.count = sk.count
+                    dsk.total = sk.total
+                else:
+                    dsk.buckets = [c - p
+                                   for c, p in zip(sk.buckets, prev["buckets"])]
+                    dsk.count = sk.count - prev["count"]
+                    dsk.total = sk.total - prev["total"]
+                # cumulative min/max at window close: monotone, so whole-run
+                # merge of window deltas reproduces the cumulative sketch
+                # exactly
+                dsk.vmin = sk.vmin
+                dsk.vmax = sk.vmax
+                self._last_hists[key] = {
+                    "buckets": list(sk.buckets), "count": sk.count,
+                    "total": sk.total,
+                }
+                if dsk.count > 0:
+                    mine = w.hists.get(name)
+                    if mine is None:
+                        w.hists[name] = dsk
+                    else:
+                        mine.merge(dsk)
+
+    def observe_record(self, record: Dict, t: Optional[float] = None) -> None:
+        """Fold a flat metrics record in gauge-style (no diffing): each finite
+        numeric field updates the covering raw window."""
+        t = self._time_fn() if t is None else float(t)
+        with self._lock:
+            w = self._window_for(t)
+            for name, v in record.items():
+                if isinstance(v, bool) or not isinstance(v, (int, float)):
+                    continue
+                self._update(w, name, float(v))
+
+    def _admit(self, name: str) -> bool:
+        if name in self._series:
+            return True
+        if len(self._series) >= self.cfg.max_series:
+            self.series_dropped += 1
+            return False
+        self._series.add(name)
+        return True
+
+    def _admit_hist(self, name: str) -> bool:
+        if name in self._hist_series:
+            return True
+        if len(self._hist_series) >= self.cfg.max_hist_series:
+            self.series_dropped += 1
+            return False
+        self._hist_series.add(name)
+        return True
+
+    def _update(self, w: _Window, name: str, value: float,
+                last: Optional[float] = None) -> None:
+        if not self._admit(name):
+            return
+        agg = w.metrics.get(name)
+        if agg is None:
+            agg = w.metrics[name] = _Agg()
+        agg.update(value, last=last)
+
+    # ----------------------------------------------------- windows and tiers
+
+    def _align(self, t: float, tier: int) -> float:
+        width = self.cfg.tiers[tier][0]
+        return float(int(t // width) * width)
+
+    def _window_for(self, t: float) -> _Window:
+        ring = self._tiers[0]
+        start = self._align(t, 0)
+        w = ring.get(start)
+        if w is not None:
+            return w
+        if ring:
+            newest = next(reversed(ring))
+            if start < newest:
+                # late record: fold into the oldest retained window — never
+                # reopen a closed one (its ts_ records already drained)
+                return ring[next(iter(ring))]
+            self._close_raw(ring[newest])
+        w = ring[start] = _Window(start)
+        self._evict()
+        return w
+
+    def _close_raw(self, w: _Window) -> None:
+        """Queue schema-typed ``ts_`` records for a finished raw window."""
+        self.windows_closed += 1
+        width = self.cfg.tiers[0][0]
+        for name in sorted(w.metrics):
+            a = w.metrics[name]
+            self._pending.append({
+                "ts": "window", "tier": 0, "width_s": width,
+                "start_s": w.start, "metric": name,
+                "ts_count": a.count, "ts_sum": a.sum, "ts_min": a.min,
+                "ts_max": a.max, "ts_last": a.last,
+            })
+        for name in sorted(w.hists):
+            self._pending.append({
+                "ts": "hist", "tier": 0, "width_s": width,
+                "start_s": w.start, "metric": name,
+                "ts_sketch": w.hists[name].to_dict(),
+            })
+
+    def _evict(self) -> None:
+        for i, (_, slots) in enumerate(self.cfg.tiers):
+            ring = self._tiers[i]
+            while len(ring) > slots:
+                oldest_start = next(iter(ring))
+                w = ring.pop(oldest_start)
+                if i + 1 < len(self.cfg.tiers):
+                    # MOVE into the covering coarse window — never copy, so
+                    # a whole-store merge counts each observation once
+                    cstart = self._align(oldest_start, i + 1)
+                    coarse = self._tiers[i + 1].get(cstart)
+                    if coarse is None:
+                        coarse = self._tiers[i + 1][cstart] = _Window(cstart)
+                    coarse.merge(w)
+                    self.compactions += 1
+                else:
+                    self.windows_expired += 1
+
+    def drain_records(self) -> List[Dict]:
+        """Typed ``ts_`` records for raw windows closed since the last drain."""
+        with self._lock:
+            out, self._pending = self._pending, []
+        return out
+
+    # ------------------------------------------------------------ accounting
+
+    def gauges(self) -> Dict[str, float]:
+        return {
+            "ts_series": float(len(self._series) + len(self._hist_series)),
+            "ts_series_dropped": float(self.series_dropped),
+            "ts_windows_open": float(sum(len(r) for r in self._tiers)),
+            "ts_windows_closed": float(self.windows_closed),
+            "ts_windows_expired": float(self.windows_expired),
+            "ts_compactions": float(self.compactions),
+        }
+
+    def estimate_bytes(self) -> int:
+        """Actual retained-state footprint (recursive getsizeof over windows,
+        aggregates, sketches, and diff state)."""
+        import sys
+        n = 0
+        for ring in self._tiers:
+            n += sys.getsizeof(ring)
+            for w in ring.values():
+                n += sys.getsizeof(w) + sys.getsizeof(w.metrics)
+                for name, a in w.metrics.items():
+                    n += sys.getsizeof(name) + sys.getsizeof(a) + 5 * 32
+                n += sys.getsizeof(w.hists)
+                for name, sk in w.hists.items():
+                    n += sys.getsizeof(name) + sys.getsizeof(sk)
+                    n += sys.getsizeof(sk.buckets) + len(sk.buckets) * 32
+        for key, v in self._last_counters.items():
+            n += sys.getsizeof(key) + sys.getsizeof(v)
+        for key, st in self._last_hists.items():
+            n += sys.getsizeof(key) + len(st["buckets"]) * 32 + 256
+        return n
+
+    # ------------------------------------------------------------- wire form
+
+    def to_wire(self) -> Dict:
+        """Canonical JSON-safe snapshot: sorted starts, sorted metric names,
+        exact sketch dicts.  ``from_wire``/``merge_wires`` round-trip this
+        bit-for-bit (floats survive JSON by repr round-trip)."""
+        with self._lock:
+            return self._to_wire_locked()
+
+    def _to_wire_locked(self) -> Dict:
+        tiers = []
+        for i, (width, slots) in enumerate(self.cfg.tiers):
+            windows = []
+            for start in sorted(self._tiers[i]):
+                w = self._tiers[i][start]
+                windows.append({
+                    "start_s": start,
+                    "metrics": {name: w.metrics[name].to_list()
+                                for name in sorted(w.metrics)},
+                    "hists": {name: w.hists[name].to_dict()
+                              for name in sorted(w.hists)},
+                })
+            tiers.append({"width_s": width, "slots": slots,
+                          "windows": windows})
+        return {"tiers": tiers, "series_dropped": self.series_dropped}
+
+    @classmethod
+    def from_wire(cls, wire: Dict,
+                  time_fn: Callable[[], float] = time.time) -> "RollupStore":
+        tiers = tuple((float(t["width_s"]), int(t["slots"]))
+                      for t in wire.get("tiers", ())) or DEFAULT_TIERS
+        store = cls(RollupConfig(tiers=tiers), time_fn=time_fn)
+        store.series_dropped = int(wire.get("series_dropped", 0))
+        for i, t in enumerate(wire.get("tiers", ())):
+            for wd in t.get("windows", ()):
+                w = _Window(float(wd["start_s"]))
+                for name, vals in wd.get("metrics", {}).items():
+                    w.metrics[name] = _Agg.from_list(vals)
+                    store._series.add(name)
+                for name, d in wd.get("hists", {}).items():
+                    w.hists[name] = HistogramSketch.from_dict(d)
+                    store._hist_series.add(name)
+                store._tiers[i][w.start] = w
+        return store
+
+    def merged_window(self) -> _Window:
+        """Every retained observation merged into one window (whole-run view;
+        exact because compaction moves rather than copies).  Coarse tiers
+        hold strictly older windows than fine ones, so merging coarse-first
+        keeps the time-ordered ``last`` semantics of :meth:`_Agg.merge`."""
+        total = _Window(0.0)
+        for ring in reversed(self._tiers):
+            for w in ring.values():
+                total.merge(w)
+        return total
+
+
+def merge_wires(wires: Sequence[Dict]) -> Dict:
+    """Merge rollup wire snapshots from several sources into one canonical
+    wire, aligning windows by (tier width, start).  Deterministic in the
+    given order; applying it to scraped JSON is bit-identical to merging the
+    live stores in process (the federation contract)."""
+    wires = [w for w in wires if w]
+    if not wires:
+        return {"tiers": [], "series_dropped": 0}
+    base = RollupStore.from_wire(wires[0])
+    for other_wire in wires[1:]:
+        other = RollupStore.from_wire(other_wire)
+        for i, ring in enumerate(other._tiers):
+            if i >= len(base._tiers):
+                break
+            for start, w in ring.items():
+                mine = base._tiers[i].get(start)
+                if mine is None:
+                    base._tiers[i][start] = w
+                else:
+                    mine.merge(w, cross_source=True)
+            # keep ring ordering canonical after out-of-order inserts
+            base._tiers[i] = {
+                s: base._tiers[i][s] for s in sorted(base._tiers[i])
+            }
+        base.series_dropped += other.series_dropped
+    return base.to_wire()

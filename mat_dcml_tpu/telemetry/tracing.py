@@ -131,6 +131,9 @@ class Tracer:
         self.traces_started = 0
         self.traces_continued = 0
         self.spans_written = 0
+        # most recent SAMPLED trace id — the exemplar an anomaly/SLO-burn
+        # record pins at trip time so incidents link to one concrete tree
+        self.last_trace_id: Optional[str] = None
 
     # ------------------------------------------------------------- sampling
 
@@ -147,6 +150,7 @@ class Tracer:
             return None
         self.traces_started += 1
         tid = trace_id or uuid.uuid4().hex[:16]
+        self.last_trace_id = tid
         return TraceContext(self, tid, kind, root=root)
 
     def continue_trace(self, trace_id: str, kind: str = "serving",
@@ -161,6 +165,7 @@ class Tracer:
             return None
         self.traces_started += 1
         self.traces_continued += 1
+        self.last_trace_id = trace_id
         return TraceContext(self, trace_id, kind, root=root)
 
     # -------------------------------------------------------------- writing

@@ -297,7 +297,8 @@ class BaseRunner:
         # loop already fetches; the recorder snapshots dispatch inputs BEFORE
         # launch, the only point where donated buffers are still valid
         self.anomaly = (
-            AnomalyDetector(telemetry=self.telemetry)
+            AnomalyDetector(telemetry=self.telemetry,
+                            exemplar_fn=self._trace_exemplar)
             if run.anomaly_tripwires else None
         )
         self.profile_window = ProfilerWindow(
@@ -363,17 +364,60 @@ class BaseRunner:
         # scripts/obs_collector.py alongside the serving fleet
         # (-1 binds an ephemeral port — harness-friendly; the bound port is
         # announced on the OBS_PORT log line either way)
+        # bounded trend rollups (telemetry/timeseries.py): every metrics
+        # flush is diffed into tiered time windows and closed raw windows
+        # stream as typed ts_ records into <run_dir>/timeseries.jsonl —
+        # the trend view a 24h soak reads instead of the unbounded
+        # metrics.jsonl
+        self.rollup = None
+        self.ts_writer = None
+        if getattr(run, "timeseries", True):
+            from mat_dcml_tpu.telemetry.timeseries import RollupStore
+
+            self.rollup = RollupStore()
+            self.ts_writer = MetricsWriter(
+                self.run_dir, jsonl_name="timeseries.jsonl",
+                max_mb=getattr(run, "metrics_max_mb", 0.0) or 16.0)
         self.obs_sidecar = None
         if int(getattr(run, "obs_port", 0) or 0) != 0:
             from mat_dcml_tpu.telemetry.remote import TelemetrySidecar
 
             self.obs_sidecar = TelemetrySidecar(
                 self.telemetry, port=max(0, int(run.obs_port)),
-                label="trainer", log_fn=log_fn)
+                label="trainer", rollup=self.rollup, log_fn=log_fn)
             self.obs_sidecar.start()
             log_fn(f"OBS_PORT {self.obs_sidecar.port}")
         self._fused_fallback = 0.0
         self.start_episode = 0
+
+    def _trace_exemplar(self):
+        """Most recent sampled dispatch trace id (None when tracing is off)
+        — pinned on anomaly trips so incidents link to a concrete tree."""
+        tracer = getattr(self, "tracer", None)
+        return tracer.last_trace_id if tracer is not None else None
+
+    def _rollup_flush(self, record: Optional[dict] = None) -> None:
+        """Diff the registry into the rollup store and stream any closed
+        windows as ts_ records (called at metrics-flush cadence)."""
+        if self.rollup is None:
+            return
+        self.rollup.observe_telemetry(self.telemetry, source="trainer")
+        if record:
+            # derived per-interval fields (fps, step_time_* interval means)
+            # live only in the flushed record — observed series reset at
+            # flush, so they never reach the registry diff above.  Folded
+            # gauge-style under their own names: disjoint from every
+            # counter/gauge/hist family, so no double-representation.
+            derived = {k: v for k, v in record.items()
+                       if k == "fps" or k.startswith("step_time")}
+            if derived:
+                self.rollup.observe_record(derived)
+        for rec in self.rollup.drain_records():
+            self.ts_writer.write(rec)
+        # publish the store's own accounting so the ts_ gauge family rides
+        # the next metrics flush (and the scrape plane)
+        for name, v in self.rollup.gauges().items():
+            self.telemetry.gauge(name, v)
 
     # ------------------------------------------------------------------ setup
 
@@ -643,6 +687,11 @@ class BaseRunner:
             self.profile_window.close()
             if self.obs_sidecar is not None:
                 self.obs_sidecar.stop()
+            # final rollup flush: the still-open raw window never closed, but
+            # the diff state must land so the last interval is not lost
+            self._rollup_flush()
+            if self.ts_writer is not None:
+                self.ts_writer.close()
             if self.tracer is not None:
                 self.tracer.close()
             # saves are async (checkpoint.py): the loop's last scheduled save
@@ -1634,6 +1683,7 @@ class BaseRunner:
 
     def _log_record(self, record: dict):
         self.writer.write(record, step=record.get("total_steps"))
+        self._rollup_flush(record)
         self.log(
             f"ep {record['episode']} steps {record['total_steps']} fps {record['fps']:.0f} "
             f"avg_r {record['average_step_rewards']:.3f} vloss {record['value_loss']:.3f} "

@@ -199,7 +199,10 @@ def run_serving_leg(plan: FaultPlan, out: Path, duration_s: float) -> dict:
     leg["slices"] = len(slices)
     leg["fired"] = injector.fired_sequence()
     leg["ok"] = not leg["errors"]
-    return {"leg": leg, "records": slices + injector.records()}
+    # unsuppressed anomaly records ride along: the incident correlator must
+    # see every symptom the fleet surfaced, not just the injector's log
+    anomalies = list(getattr(fleet, "anomalies", []))
+    return {"leg": leg, "records": slices + injector.records() + anomalies}
 
 
 # ------------------------------------------------------------ trainer legs
@@ -377,7 +380,8 @@ def _read_run_records(run_dir: Path) -> list:
     from obs_report import read_jsonl, with_rotated
 
     records = []
-    for name in ("metrics.jsonl", "chaos_records.jsonl"):
+    for name in ("metrics.jsonl", "chaos_records.jsonl",
+                 "timeseries.jsonl", "incidents.jsonl"):
         for path in sorted(Path(run_dir).rglob(name)):
             records += read_jsonl(with_rotated(path))
     return records
@@ -389,7 +393,8 @@ def _validate_streams(out: Path, run_dirs: list) -> list:
     errs = []
     seen = set()
     for root in [out, *run_dirs]:
-        for name in ("metrics.jsonl", "chaos_records.jsonl"):
+        for name in ("metrics.jsonl", "chaos_records.jsonl",
+                     "timeseries.jsonl", "incidents.jsonl"):
             for path in sorted(Path(root).rglob(name)):
                 if path in seen:
                     continue
@@ -463,6 +468,47 @@ def main(argv=None) -> int:
         legs["serving"] = res["leg"]
         records += res["records"]
 
+    # --- incident correlation: the soak verdict layer --------------------
+    # Every incident must be attributed to an injected fault and zero
+    # unexplained incidents may remain open (the invariant below enforces
+    # it).  The SIGTERM this process delivers IS an injected fault — give
+    # the correlator its causal key so the worker's emergency checkpoint
+    # attributes instead of failing the soak.
+    from mat_dcml_tpu.telemetry.incidents import correlate
+    from mat_dcml_tpu.utils.metrics import MetricsWriter
+
+    synthetic = []
+    if facts["expect_kill"]:
+        synthetic.append({"event_id": "soak:trainer_kill:000",
+                          "kind": "trainer_kill", "t": 0.0, "cleared_t": 0.0})
+    fired_any = any(r.get("chaos") == "fired" for r in records)
+    facts["expect_incidents"] = bool(fired_any or synthetic)
+    # faults first: concatenated per-leg streams put symptom records ahead
+    # of the chaos log that explains them
+    stream = ([r for r in records if "chaos" in r]
+              + [r for r in records if "chaos" not in r])
+    corr = correlate(stream, synthetic_faults=synthetic)
+    facts["incident_summary"] = corr.summary()
+    inc_records = corr.records()
+    inc_writer = MetricsWriter(out, jsonl_name="incidents.jsonl")
+    for rec in inc_records:
+        inc_writer.write(rec)
+    inc_writer.write(corr.summary())
+    inc_writer.close()
+    records += inc_records
+    s = facts["incident_summary"]
+    log(f"[soak] incidents: total={s['incident_total']:g} "
+        f"attributed={s['incident_attributed']:g} "
+        f"unexplained={s['incident_unexplained']:g} "
+        f"open={s['incident_open']:g}")
+
+    # the disarmed golden twin must be incident-quiet: symptoms on a run
+    # with no faults armed mean the stack itself is sick
+    gdir = out / "train_sync_golden"
+    if gdir.exists():
+        facts["clean_incident_summary"] = \
+            correlate(_read_run_records(gdir)).summary()
+
     invariants = check_invariants(records, facts)
     for r in invariants:
         log(f"[soak] invariant {r.name:<24} "
@@ -482,6 +528,7 @@ def main(argv=None) -> int:
         "kinds": list(plan.kinds()),
         "events": [ev.to_dict() for ev in plan.events],
         "legs": legs,
+        "incidents": facts["incident_summary"],
         "invariants": [r.to_dict() for r in invariants],
         "all_green": all_green(invariants),
         "schema_errors": schema_errors,

@@ -21,10 +21,13 @@ Usage:
     python scripts/check_metrics_schema.py [--strict] <metrics.jsonl | run_dir>
 
 A directory argument validates every ``metrics.jsonl`` under it plus any
-rotated ``metrics.jsonl.1`` siblings (utils/metrics.py ``--metrics_max_mb``)
-and any ``trace.jsonl``/``trace.jsonl.1`` span streams (telemetry/tracing.py)
-— trace records are identified by their ``trace`` field and validated against
-the span schema, so the two streams may even share a file.
+rotated ``metrics.jsonl.1`` siblings (utils/metrics.py ``--metrics_max_mb``),
+any ``trace.jsonl``/``trace.jsonl.1`` span streams (telemetry/tracing.py),
+the rollup plane's ``timeseries.jsonl`` (telemetry/timeseries.py; typed
+``{"ts": ...}`` window/hist records) and the correlator's ``incidents.jsonl``
+(telemetry/incidents.py; typed ``{"incident": ...}`` lifecycle records) —
+typed records are identified by their marker field and validated against
+their own closed schema, so the streams may even share a file.
 
 ``--strict`` additionally enforces the per-family suffix vocabularies: by
 default a key under a known prefix (``serving_``, ``fleet_``, ...) passes with
@@ -163,6 +166,16 @@ KNOWN_PREFIXES = (
     # applied/overridden knob counts, the fingerprint-mismatch flag, search
     # accounting, per-knob measured ratios, and the verify-gate re-measure
     "tune_",
+    # rollup-store accounting gauges (telemetry/timeseries.py RollupStore.
+    # gauges): tracked series, overflow drops, open/closed/expired window
+    # counts, tier compactions — the typed {"ts": ...} window records are
+    # validated separately
+    "ts_",
+    # incident-correlator summary gauges (telemetry/incidents.py
+    # IncidentCorrelator.summary): totals by lifecycle state, attribution
+    # split, criticals, flap suppressions — the typed {"incident": ...}
+    # lifecycle records are validated separately
+    "incident_",
 )
 
 # registry suffixes a histogram sketch appends on flush (registry.py
@@ -229,7 +242,19 @@ STRICT_FAMILY_PATTERNS = {
         r"^chaos_(events_armed|events_fired|injected_faults"
         r"|suppressed_anomalies|active)$"),
     "scrape_": re.compile(
-        r"^scrape_(sources|stale|errors|restarts|polls)$"),
+        r"^scrape_(sources|stale|errors|restarts|polls"
+        # collector self-observability (scripts/obs_collector.py --obs_port):
+        # per-poll scrape-duration histogram, per-source staleness gauges
+        # (scrape_staleness_s_<label>), per-source restart counts
+        r"|duration_ms(_max|_sum|_p50|_p95|_p99|_count|_mean)?"
+        r"|staleness_s_max|staleness_s_[A-Za-z0-9_.-]+"
+        r"|restarts_[A-Za-z0-9_.-]+)$"),
+    "ts_": re.compile(
+        r"^ts_(series|series_dropped|windows_open|windows_closed"
+        r"|windows_expired|compactions)$"),
+    "incident_": re.compile(
+        r"^incident_(total|open|mitigated|resolved|attributed|unexplained"
+        r"|critical|flaps_suppressed)$"),
     "obs_": re.compile(
         r"^obs_(snapshot_requests|collector_polls"
         r"|collector_merged_records)$"),
@@ -334,17 +359,28 @@ def _strict_ok(name: str) -> bool:
 # anomaly records (telemetry/anomaly.py Anomaly.to_record) are the one
 # sanctioned exception to the numbers-only rule: kind/signal are strings,
 # nonfinite values encode as "nan"/"inf"/"-inf" strings (strict JSON has no
-# NaN literal), and baseline is null before warmup.
+# NaN literal), and baseline is null before warmup.  trace_exemplar pins the
+# live trace id at trip time (optional: only when a tracer was sampling).
 ANOMALY_FIELDS = ("anomaly", "signal", "value", "baseline", "episode",
-                  "total_steps")
+                  "total_steps", "trace_exemplar")
+_ANOMALY_REQUIRED = ("anomaly", "signal", "value", "baseline", "episode",
+                     "total_steps")
 _NONFINITE_STRINGS = ("nan", "inf", "-inf")
+# a trace id as minted by telemetry/tracing.py (16-hex) or carried over W3C
+# traceparent (32-hex)
+_TRACE_ID_RE = re.compile(r"^[0-9a-f]{8,32}$")
 
 
 def _validate_anomaly(record, where: str) -> List[str]:
     errs: List[str] = []
-    for k in ANOMALY_FIELDS:
+    for k in _ANOMALY_REQUIRED:
         if k not in record:
             errs.append(f"{where}: anomaly record missing {k!r}")
+    te = record.get("trace_exemplar")
+    if te is not None and (
+            not isinstance(te, str) or not _TRACE_ID_RE.match(te)):
+        errs.append(f"{where}: anomaly field 'trace_exemplar' must be a "
+                    f"trace id (8-32 hex chars), got {te!r}")
     for k in ("anomaly", "signal"):
         if k in record and not isinstance(record[k], str):
             errs.append(f"{where}: anomaly field {k!r} must be a string")
@@ -492,6 +528,141 @@ def _validate_chaos(record, where: str) -> List[str]:
     return errs
 
 
+# rollup window records (telemetry/timeseries.py RollupStore._close_raw):
+# the "ts" marker carries the record kind — "window" (scalar aggregate:
+# count/sum/min/max/last of the increments that landed inside the window) or
+# "hist" (the window's exact HistogramSketch delta as a dict).
+TS_FIELDS = ("ts", "tier", "width_s", "start_s", "metric",
+             "ts_count", "ts_sum", "ts_min", "ts_max", "ts_last", "ts_sketch")
+_TS_REQUIRED = ("ts", "tier", "width_s", "start_s", "metric")
+_TS_KINDS = ("window", "hist")
+_TS_WINDOW_NUMERIC = ("ts_count", "ts_sum", "ts_min", "ts_max", "ts_last")
+_SKETCH_FIELDS = ("buckets", "count", "total", "vmin", "vmax")
+
+
+def _validate_ts(record, where: str) -> List[str]:
+    errs: List[str] = []
+    for k in _TS_REQUIRED:
+        if k not in record:
+            errs.append(f"{where}: ts record missing {k!r}")
+    kind = record.get("ts")
+    if kind is not None and kind not in _TS_KINDS:
+        errs.append(f"{where}: ts field 'ts' must be one of {_TS_KINDS}, "
+                    f"got {kind!r}")
+    tier = record.get("tier")
+    if tier is not None and (
+            isinstance(tier, bool) or not isinstance(tier, int) or tier < 0):
+        errs.append(f"{where}: ts field 'tier' must be a non-negative integer")
+    for k in ("width_s", "start_s"):
+        v = record.get(k)
+        if v is not None and (
+                isinstance(v, bool) or not isinstance(v, (int, float))
+                or not math.isfinite(v) or v < 0):
+            errs.append(f"{where}: ts field {k!r} must be a non-negative "
+                        f"finite number")
+    metric = record.get("metric")
+    if metric is not None and not isinstance(metric, str):
+        errs.append(f"{where}: ts field 'metric' must be a string")
+    if kind == "window":
+        for k in _TS_WINDOW_NUMERIC:
+            v = record.get(k)
+            if v is None:
+                errs.append(f"{where}: ts window record missing {k!r}")
+            elif isinstance(v, bool) or not isinstance(v, (int, float)) \
+                    or not math.isfinite(v):
+                errs.append(f"{where}: ts field {k!r} must be a finite number")
+        c = record.get("ts_count")
+        if isinstance(c, (int, float)) and not isinstance(c, bool) and c < 0:
+            errs.append(f"{where}: ts field 'ts_count' is negative ({c})")
+        if "ts_sketch" in record:
+            errs.append(f"{where}: ts window record must not carry "
+                        f"'ts_sketch'")
+    elif kind == "hist":
+        sk = record.get("ts_sketch")
+        if not isinstance(sk, dict):
+            errs.append(f"{where}: ts hist record needs a 'ts_sketch' dict")
+        else:
+            for k in _SKETCH_FIELDS:
+                if k not in sk:
+                    errs.append(f"{where}: ts_sketch missing {k!r}")
+            b = sk.get("buckets")
+            if b is not None and (not isinstance(b, list) or any(
+                    isinstance(x, bool) or not isinstance(x, int) or x < 0
+                    for x in b)):
+                errs.append(f"{where}: ts_sketch 'buckets' must be a list of "
+                            f"non-negative integers")
+    for k in record:
+        if k not in TS_FIELDS:
+            errs.append(f"{where}: unexpected field {k!r} in ts record")
+    return errs
+
+
+# incident lifecycle records (telemetry/incidents.py Incident.record): the
+# "incident" marker carries the lifecycle stage; attribution is a chaos event
+# id causal key; trace_exemplar follows into trace.jsonl's span tree.
+INCIDENT_FIELDS = ("incident", "incident_id", "kind", "severity", "t_s",
+                   "events", "flaps", "attributed_to", "trace_exemplar",
+                   "duration_s")
+_INCIDENT_REQUIRED = ("incident", "incident_id", "kind", "severity", "t_s",
+                      "events", "flaps")
+_INCIDENT_STAGES = ("open", "mitigated", "resolved", "annotated")
+_INCIDENT_SEVERITIES = ("warning", "critical")
+_INCIDENT_ID_RE = re.compile(r"^inc:[0-9]{3,}$")
+# chaos event ids are kind:NNN (chaos/inject.py); soak-delivered synthetic
+# faults namespace theirs as soak:kind:NNN
+_EVENT_ID_RE = re.compile(r"^[a-z][a-z0-9_]*(:[a-z][a-z0-9_]*)*:[0-9]{3,}$")
+
+
+def _validate_incident(record, where: str) -> List[str]:
+    errs: List[str] = []
+    for k in _INCIDENT_REQUIRED:
+        if k not in record:
+            errs.append(f"{where}: incident record missing {k!r}")
+    stage = record.get("incident")
+    if stage is not None and stage not in _INCIDENT_STAGES:
+        errs.append(f"{where}: incident field 'incident' must be one of "
+                    f"{_INCIDENT_STAGES}, got {stage!r}")
+    iid = record.get("incident_id")
+    if iid is not None and (
+            not isinstance(iid, str) or not _INCIDENT_ID_RE.match(iid)):
+        errs.append(f"{where}: incident field 'incident_id' must match "
+                    f"inc:NNN, got {iid!r}")
+    kind = record.get("kind")
+    if kind is not None and not isinstance(kind, str):
+        errs.append(f"{where}: incident field 'kind' must be a string")
+    sev = record.get("severity")
+    if sev is not None and sev not in _INCIDENT_SEVERITIES:
+        errs.append(f"{where}: incident field 'severity' must be one of "
+                    f"{_INCIDENT_SEVERITIES}, got {sev!r}")
+    attr = record.get("attributed_to")
+    if attr is not None and (
+            not isinstance(attr, str) or not _EVENT_ID_RE.match(attr)):
+        errs.append(f"{where}: incident field 'attributed_to' must be a "
+                    f"chaos event id (kind:NNN), got {attr!r}")
+    te = record.get("trace_exemplar")
+    if te is not None and (
+            not isinstance(te, str) or not _TRACE_ID_RE.match(te)):
+        errs.append(f"{where}: incident field 'trace_exemplar' must be a "
+                    f"trace id (8-32 hex chars), got {te!r}")
+    for k in ("t_s", "duration_s"):
+        v = record.get(k)
+        if v is not None and (
+                isinstance(v, bool) or not isinstance(v, (int, float))
+                or not math.isfinite(v) or v < 0):
+            errs.append(f"{where}: incident field {k!r} must be a "
+                        f"non-negative finite number")
+    for k in ("events", "flaps"):
+        v = record.get(k)
+        if v is not None and (
+                isinstance(v, bool) or not isinstance(v, int) or v < 0):
+            errs.append(f"{where}: incident field {k!r} must be a "
+                        f"non-negative integer")
+    for k in record:
+        if k not in INCIDENT_FIELDS:
+            errs.append(f"{where}: unexpected field {k!r} in incident record")
+    return errs
+
+
 # supervisor lineage riders (utils/metrics.py stamps these onto EVERY record
 # written under scripts/train_supervisor.py — training, anomaly, emergency,
 # collector records alike): run_id is the stable hex id of the logical run,
@@ -539,6 +710,12 @@ def validate_record(record, index: int = 0, strict_names: bool = True,
     if "chaos" in record:
         # chaos fault-injection event record — ditto
         return errs + _validate_chaos(record, where)
+    if "ts" in record:
+        # rollup window / hist-delta record (timeseries.jsonl) — ditto
+        return errs + _validate_ts(record, where)
+    if "incident" in record:
+        # incident lifecycle record (incidents.jsonl) — ditto
+        return errs + _validate_incident(record, where)
     for k, v in record.items():
         if isinstance(v, bool):
             errs.append(f"{where}: field {k!r} is a boolean (flags must not "
@@ -555,7 +732,8 @@ def validate_record(record, index: int = 0, strict_names: bool = True,
                                  "resilience_", "slo_",
                                  "decode_cache_", "async_",
                                  "staleness_", "chaos_",
-                                 "scrape_", "obs_", "tune_"))) and v < 0:
+                                 "scrape_", "obs_", "tune_",
+                                 "ts_", "incident_"))) and v < 0:
             errs.append(f"{where}: field {k!r} is negative ({v})")
         if k in UNIT_INTERVAL and not (0.0 <= v <= 1.0):
             errs.append(f"{where}: field {k!r} must be in [0, 1], got {v}")
@@ -617,10 +795,12 @@ def validate_file(path, strict_names: bool = True,
 
 
 def discover(target: Path) -> List[Path]:
-    """Every validatable stream under a run directory: metrics.jsonl and
-    trace.jsonl plus their rotated ``.1`` predecessors."""
+    """Every validatable stream under a run directory: metrics.jsonl,
+    trace.jsonl, timeseries.jsonl, and incidents.jsonl plus their rotated
+    ``.1`` predecessors."""
     hits: List[Path] = []
-    for name in ("metrics.jsonl", "trace.jsonl"):
+    for name in ("metrics.jsonl", "trace.jsonl",
+                 "timeseries.jsonl", "incidents.jsonl"):
         for p in sorted(target.rglob(name)):
             rotated = p.with_name(p.name + ".1")
             if rotated.exists():

@@ -14,11 +14,23 @@ interval and appends, per poll:
 - ``<out>/snapshots.jsonl`` — the raw per-source snapshots behind that merge
   (one line per poll), so any merged record can be re-derived and audited
   offline.
+- ``<out>/timeseries_merged.json`` — the federated long-run rollup: every
+  source's ``GET /timeseries.json`` wire merged via
+  ``telemetry/timeseries.merge_wires`` (bit-identical to merging the live
+  ``RollupStore`` objects in process).  Disable with ``--no-timeseries``.
 
 Degradation contract (inherited from ``RemoteScraper``): a dead source keeps
 its last accepted snapshot and is marked stale — never zeroed; a source whose
 ``seq`` goes backwards restarted and REPLACES its entry — never summed — so
-counters are never double-counted across relaunches.
+counters are never double-counted across relaunches.  The same contract
+covers the rollup wires.
+
+The collector watches itself: ``--obs_port N`` serves the collector's OWN
+``/telemetry.json`` + ``/timeseries.json`` sidecar (0 picks a free port;
+``OBS_PORT <port>`` is printed) carrying per-poll scrape durations
+(``scrape_duration_ms`` histogram), per-source staleness
+(``scrape_staleness_s_<label>``) and restart counts — who watches the
+watcher is answerable with the same scrape plane.
 
 Usage:
     python scripts/obs_collector.py --out runs/obs \\
@@ -42,7 +54,12 @@ from pathlib import Path
 
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
-from mat_dcml_tpu.telemetry.remote import RemoteScraper  # noqa: E402
+from mat_dcml_tpu.telemetry.registry import Telemetry  # noqa: E402
+from mat_dcml_tpu.telemetry.remote import (  # noqa: E402
+    RemoteScraper,
+    TelemetrySidecar,
+)
+from mat_dcml_tpu.telemetry.timeseries import RollupStore  # noqa: E402
 from mat_dcml_tpu.utils.metrics import MetricsWriter  # noqa: E402
 
 
@@ -75,13 +92,53 @@ def main(argv=None) -> int:
                              "source is marked stale")
     parser.add_argument("--timeout", type=float, default=2.0,
                         help="per-request scrape timeout, seconds")
+    parser.add_argument("--no-timeseries", action="store_true",
+                        help="skip /timeseries.json federation")
+    parser.add_argument("--obs_port", type=int, default=None,
+                        help="serve the collector's OWN telemetry sidecar "
+                             "here (0 = pick a free port); prints OBS_PORT")
     args = parser.parse_args(argv)
 
     out = Path(args.out)
     out.mkdir(parents=True, exist_ok=True)
     scraper = RemoteScraper(args.endpoint, timeout_s=args.timeout,
-                            stale_after_s=args.stale_after)
+                            stale_after_s=args.stale_after,
+                            fetch_timeseries=not args.no_timeseries)
     writer = MetricsWriter(out)
+
+    # collector self-observability: its own registry, served over the same
+    # scrape plane it implements
+    tel = Telemetry()
+    sidecar = None
+    if args.obs_port is not None:
+        sidecar = TelemetrySidecar(tel, port=args.obs_port,
+                                   label="collector", rollup=RollupStore())
+        sidecar.start()
+        print(f"OBS_PORT {sidecar.port}", flush=True)
+
+    def self_observe() -> dict:
+        for d in scraper.durations_ms():
+            tel.hist("scrape_duration_ms", d)
+        staleness = scraper.staleness_s()
+        if staleness:
+            tel.gauge("scrape_staleness_s_max", max(staleness))
+        for label, src in scraper.sources.items():
+            if src.last_ok_s is not None:
+                tel.gauge(f"scrape_staleness_s_{label}",
+                          time.monotonic() - src.last_ok_s)
+            tel.gauge(f"scrape_restarts_{label}", float(src.restarts))
+        for k, v in scraper.scrape_record().items():
+            tel.gauge(k, v)
+        return tel.flush()
+
+    def write_merged_timeseries() -> None:
+        if args.no_timeseries or not scraper.timeseries_snapshots():
+            return
+        tmp = out / "timeseries_merged.json.tmp"
+        tmp.write_text(json.dumps(scraper.merged_timeseries(),
+                                  sort_keys=True) + "\n")
+        tmp.replace(out / "timeseries_merged.json")
+
     stopping = {"sig": None}
 
     def request_stop(signum, frame):
@@ -106,7 +163,9 @@ def main(argv=None) -> int:
                 merged_records += 1
                 rec["obs_collector_polls"] = float(scraper.polls)
                 rec["obs_collector_merged_records"] = float(merged_records)
+                rec.update(self_observe())
                 writer.write(rec)
+                write_merged_timeseries()
                 if args.iterations and scraper.polls >= args.iterations:
                     break
                 if args.duration and \
@@ -114,7 +173,12 @@ def main(argv=None) -> int:
                     break
                 time.sleep(args.interval)
     finally:
+        # graceful stop (SIGTERM/SIGINT or limits): flush every artifact
+        # before exiting so a soak teardown never truncates the stream
         writer.close()
+        write_merged_timeseries()
+        if sidecar is not None:
+            sidecar.stop()
     health = scraper.scrape_record()
     print("[collector] done: " + " ".join(
         f"{k}={v:.0f}" for k, v in sorted(health.items())), flush=True)

@@ -18,6 +18,16 @@ trees) — plus their rotated ``.1`` predecessors, and prints four panels:
    param-staleness histogram.
 4. **Training health**: fps and step-timer trajectory, compile/recompile and
    nonfinite-grad counters, dispatch mode, and emergency checkpoints.
+5. **Incident timeline**: the correlator's typed ``incident`` records
+   (telemetry/incidents.py) grouped per incident id — lifecycle chain,
+   severity, attribution causal key (UNEXPLAINED incidents are flagged),
+   trace exemplars, and the ``incident_`` summary gauges.
+6. **Long-run trends**: the rollup plane's ``ts`` window records
+   (telemetry/timeseries.py) — first-vs-last window means for step timers,
+   tail latencies, and burn rates, so multi-hour drift is visible without
+   replaying the raw stream.
+7. **Perf-flag tuning provenance**: the ``tune_`` gauge family the autotuner
+   stamps (tuning/probe.py) — which tuned config a run actually ran.
 
 **Multi-source (federation) mode** — repeated ``--source label=dir`` renders
 one coherent report across a whole service (serving fleet + trainer + loadgen
@@ -283,6 +293,104 @@ def async_panel(metrics: List[dict]) -> List[str]:
     return lines
 
 
+# -------------------------------------------------- incidents + long-run
+
+
+def incident_panel(metrics: List[dict]) -> List[str]:
+    """Timeline of the correlator's typed ``incident`` records: one block per
+    incident id with its lifecycle chain and attribution causal key.  An
+    incident without ``attributed_to`` is UNEXPLAINED — the condition that
+    fails an armed soak."""
+    lines = ["== incident timeline =="]
+    incs = [r for r in metrics if "incident" in r]
+    if not incs:
+        return lines + ["  (no incident records)"]
+    by_id: Dict[str, List[dict]] = defaultdict(list)
+    for r in incs:
+        by_id[str(r.get("incident_id", "?"))].append(r)
+    for iid in sorted(by_id):
+        recs = by_id[iid]
+        last = recs[-1]
+        chain = " -> ".join(str(r.get("incident", "?")) for r in recs)
+        attr = last.get("attributed_to")
+        flag = "" if attr else "  <-- UNEXPLAINED"
+        lines.append(f"  {iid} {str(last.get('kind', '?')):<26} "
+                     f"[{last.get('severity', '?')}] {chain}{flag}")
+        detail = [f"cause={attr}" if attr else "cause=?"]
+        detail.append(f"events={last.get('events', 1)}")
+        if last.get("flaps"):
+            detail.append(f"flaps={last['flaps']}")
+        if isinstance(last.get("duration_s"), (int, float)):
+            detail.append(f"duration={float(last['duration_s']):.2f}s")
+        if last.get("trace_exemplar"):
+            detail.append(f"exemplar={last['trace_exemplar']}")
+        lines.append("      " + "  ".join(detail))
+    summary = _last_with_prefix(metrics, ("incident_",))
+    # incident_id is a string field on every record, not a gauge
+    summary = {k: v for k, v in summary.items() if k != "incident_id"}
+    if summary:
+        lines.append("  summary:")
+        for k in sorted(summary):
+            flag = "  <-- FAILS SOAK" if (
+                k in ("incident_unexplained", "incident_open")
+                and summary[k] > 0) else ""
+            lines.append(f"    {k:<34} {summary[k]:>12.1f}{flag}")
+    return lines
+
+
+# window metrics worth trending across a long run
+_TREND_SUFFIXES = ("_p95", "_p99", "_burn")
+_TREND_PREFIXES = ("step_time", "fps")
+
+
+def timeseries_panel(metrics: List[dict]) -> List[str]:
+    """First-vs-last rollup window means for the drift-prone families: the
+    multi-hour trend view the bounded ``RollupStore`` retains after the raw
+    stream has rotated away."""
+    lines = ["== long-run trends (rollup windows) =="]
+    wins = [r for r in metrics if r.get("ts") == "window"]
+    if not wins:
+        return lines + ["  (no rollup window records)"]
+    by_metric: Dict[str, List[dict]] = defaultdict(list)
+    for r in wins:
+        name = str(r.get("metric", "?"))
+        if name.endswith(_TREND_SUFFIXES) or name.startswith(_TREND_PREFIXES):
+            by_metric[name].append(r)
+    tiers = sorted({int(r.get("tier", 0)) for r in wins})
+    lines.append(f"  window records {len(wins)}  tiers {tiers}  "
+                 f"metrics trended {len(by_metric)}")
+    if not by_metric:
+        return lines + ["  (no drift-prone metric families in the windows)"]
+    header = f"  {'metric':<34} {'windows':>7} {'first_mean':>11} " \
+             f"{'last_mean':>11} {'drift':>8}"
+    lines.append(header)
+    for name in sorted(by_metric):
+        recs = sorted(by_metric[name],
+                      key=lambda r: float(r.get("start_s", 0.0)))
+
+        def mean(r: dict) -> float:
+            c = float(r.get("ts_count", 0.0))
+            return float(r.get("ts_sum", 0.0)) / c if c else 0.0
+
+        first, last = mean(recs[0]), mean(recs[-1])
+        drift = ((last - first) / abs(first) * 100.0) if first else 0.0
+        lines.append(f"  {name:<34} {len(recs):>7} {first:>11.4f} "
+                     f"{last:>11.4f} {drift:>+7.1f}%")
+    return lines
+
+
+def tuning_panel(metrics: List[dict]) -> List[str]:
+    """Which tuned perf-flag config a run actually ran: the ``tune_`` gauge
+    family stamped from the tuned-config artifact (tuning/probe.py)."""
+    lines = ["== perf-flag tuning provenance =="]
+    latest = _last_with_prefix(metrics, ("tune_",))
+    if not latest:
+        return lines + ["  (no tune_ records — run used defaults)"]
+    for k in sorted(latest):
+        lines.append(f"  {k:<34} {latest[k]:>12.3f}")
+    return lines
+
+
 # ------------------------------------------------------- federation panels
 
 
@@ -436,8 +544,11 @@ def build_report(metrics: List[dict], traces: List[dict]) -> str:
     sections = [
         span_panel(traces),
         fleet_panel(metrics),
+        incident_panel(metrics),
+        timeseries_panel(metrics),
         async_panel(metrics),
         training_panel(metrics),
+        tuning_panel(metrics),
     ]
     return "\n".join("\n".join(s) for s in sections) + "\n"
 
@@ -446,6 +557,7 @@ def load_streams(root: Optional[Path], metrics_path: Optional[Path] = None,
                  trace_path: Optional[Path] = None):
     """(metrics, traces) for one run dir, rotated files included and
     trace-shaped records split out of mixed streams."""
+    extra: List[dict] = []
     if root is not None:
         if metrics_path is None:
             found = sorted(root.rglob("metrics.jsonl"))
@@ -453,7 +565,12 @@ def load_streams(root: Optional[Path], metrics_path: Optional[Path] = None,
         if trace_path is None:
             found = sorted(root.rglob("trace.jsonl"))
             trace_path = found[0] if found else None
-    metrics = read_jsonl(with_rotated(metrics_path))
+        # rollup + incident streams ride into the metrics view: their typed
+        # records feed the incident/trend panels
+        for name in ("timeseries.jsonl", "incidents.jsonl"):
+            for path in sorted(root.rglob(name)):
+                extra += read_jsonl(with_rotated(path))
+    metrics = read_jsonl(with_rotated(metrics_path)) + extra
     traces = read_jsonl(with_rotated(trace_path))
     # trace records may interleave into metrics.jsonl-shaped fixtures; split
     # them by shape rather than by file so mixed streams still report

@@ -115,7 +115,7 @@ def main(argv=None) -> int:
     # already minted one; each launch below bumps the incarnation
     run_id = os.environ.get(RUN_ID_ENV) or uuid.uuid4().hex[:16]
 
-    def write_metrics(last_rc: int) -> None:
+    def _append(record: dict) -> None:
         if args.metrics_file is None:
             return
         import json
@@ -123,16 +123,32 @@ def main(argv=None) -> int:
         path = Path(args.metrics_file)
         path.parent.mkdir(parents=True, exist_ok=True)
         with open(path, "a") as f:
-            f.write(json.dumps({
-                "resilience_supervisor_exit_76": watchdog_exits_total,
-                "resilience_supervisor_launches": launches,
-                # signal deaths (wait() returns -N) encode shell-style as 128+N
-                # so the resilience_ family stays non-negative
-                "resilience_supervisor_last_exit":
-                    last_rc if last_rc >= 0 else 128 - last_rc,
-                "run_id": run_id,
-                "incarnation": launches,
-            }) + "\n")
+            f.write(json.dumps(record) + "\n")
+
+    def write_relaunch(last_rc: int) -> None:
+        # one record per relaunch, BEFORE the next launch: the incident
+        # correlator (telemetry/incidents.py) matches it to the open kill
+        # incident by run_id and annotates the lineage instead of opening a
+        # duplicate — the relaunch is the mitigation, not a new failure
+        _append({
+            "resilience_supervisor_relaunch": launches,
+            "resilience_supervisor_last_exit":
+                last_rc if last_rc >= 0 else 128 - last_rc,
+            "run_id": run_id,
+            "incarnation": launches + 1,
+        })
+
+    def write_metrics(last_rc: int) -> None:
+        _append({
+            "resilience_supervisor_exit_76": watchdog_exits_total,
+            "resilience_supervisor_launches": launches,
+            # signal deaths (wait() returns -N) encode shell-style as 128+N
+            # so the resilience_ family stays non-negative
+            "resilience_supervisor_last_exit":
+                last_rc if last_rc >= 0 else 128 - last_rc,
+            "run_id": run_id,
+            "incarnation": launches,
+        })
 
     while True:
         launches += 1
@@ -159,6 +175,7 @@ def main(argv=None) -> int:
             watchdog_exits = 0
             print(f"[supervisor] child preempted (exit {rc}); relaunching in "
                   f"{args.preempt_delay:.1f}s", flush=True)
+            write_relaunch(rc)
             time.sleep(args.preempt_delay)
             continue
         if rc == EXIT_WATCHDOG:
@@ -179,6 +196,7 @@ def main(argv=None) -> int:
             print(f"[supervisor] child hit watchdog exhaustion (exit {rc}, "
                   f"{watchdog_exits}/{args.max_watchdog_relaunches}); "
                   f"relaunching in {delay:.1f}s", flush=True)
+            write_relaunch(rc)
             time.sleep(delay)
             continue
         crashes += 1
@@ -191,6 +209,7 @@ def main(argv=None) -> int:
                     args.backoff_base * (2 ** (crashes - 1))) * (0.5 + random.random())
         print(f"[supervisor] child crashed (exit {rc}, crash {crashes}/"
               f"{args.max_relaunches}); relaunching in {delay:.1f}s", flush=True)
+        write_relaunch(rc)
         time.sleep(delay)
 
 

@@ -253,11 +253,16 @@ def test_invariants_all_green():
     results = check_invariants(
         _green_records(),
         facts={"expect_async": True, "expect_kill": True,
-               "bit_exact_resume": True})
+               "bit_exact_resume": True, "expect_incidents": True,
+               "incident_summary": {
+                   "incident_total": 2.0, "incident_open": 0.0,
+                   "incident_unexplained": 0.0, "incident_attributed": 2.0,
+                   "incident_resolved": 2.0}})
     assert all_green(results)
     assert [r.name for r in results] == [
         "zero_dropped_requests", "zero_steady_recompiles",
-        "staleness_p95_le_1", "bit_exact_resume", "slo_burn_recovery"]
+        "staleness_p95_le_1", "bit_exact_resume", "incident_attribution",
+        "slo_burn_recovery"]
     assert not any(r.skipped for r in results)
 
 

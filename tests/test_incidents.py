@@ -1,0 +1,308 @@
+"""Incident correlation contracts: lifecycle, attribution, the soak verdict.
+
+What is pinned here is the load-bearing part of the tentpole: an armed soak
+must explain EVERY incident by an injected fault (unattributed incidents stay
+open and fail ``check_invariants``), a disarmed run must stay silent, flapping
+signals fold instead of storming, supervisor relaunches annotate the kill
+incident they mitigate, and every anomaly/SLO-burn trip carries a trace
+exemplar that resolves to a concrete span tree in ``trace.jsonl``.
+"""
+
+import importlib.util
+import json
+from pathlib import Path
+
+from mat_dcml_tpu.chaos.invariants import check_invariants
+from mat_dcml_tpu.telemetry.anomaly import AnomalyConfig, AnomalyDetector
+from mat_dcml_tpu.telemetry.incidents import (
+    IncidentConfig,
+    IncidentCorrelator,
+    correlate,
+)
+from mat_dcml_tpu.telemetry.tracing import Tracer
+
+
+def _load_script(name):
+    path = Path(__file__).resolve().parent.parent / "scripts" / f"{name}.py"
+    spec = importlib.util.spec_from_file_location(name, path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _fired(event_id, kind, t):
+    return {"chaos": "fired", "event_id": event_id, "kind": kind, "t_s": t}
+
+
+def _cleared(event_id, kind, t):
+    return {"chaos": "cleared", "event_id": event_id, "kind": kind, "t_s": t}
+
+
+def _suppressed(event_id, kind, suppressed_kind, t):
+    return {"chaos": "suppressed", "event_id": event_id, "kind": kind,
+            "suppressed_kind": suppressed_kind, "t_s": t}
+
+
+def _anomaly(kind, **extra):
+    rec = {"anomaly": kind, "signal": "slo_latency_burn", "value": 1.5,
+           "baseline": 1.0, "episode": 3, "total_steps": 24}
+    rec.update(extra)
+    return rec
+
+
+def _stages(corr, incident_id="inc:000"):
+    return [r["incident"] for r in corr.records()
+            if r["incident_id"] == incident_id]
+
+
+# ---------------------------------------------------------------- lifecycle
+
+
+def test_lifecycle_open_mitigated_resolved():
+    corr = correlate([
+        _fired("replica_crash:000", "replica_crash", 10.0),
+        _suppressed("replica_crash:000", "replica_crash",
+                    "slo_latency_budget", 12.0),
+        _cleared("replica_crash:000", "replica_crash", 20.0),
+    ])
+    (inc,) = corr.incidents()
+    assert inc.attributed_to == "replica_crash:000"
+    assert inc.state == "resolved"
+    assert _stages(corr) == ["open", "mitigated", "resolved"]
+    s = corr.summary()
+    assert s["incident_total"] == 1
+    assert s["incident_resolved"] == 1
+    assert s["incident_unexplained"] == 0
+    assert s["incident_open"] == 0
+
+
+def test_anomaly_attributes_via_suppression_prefix():
+    """The chaos suppression table IS the attribution table: an slo_ anomaly
+    inside a replica_crash window attributes without an explicit suppressed
+    record."""
+    corr = correlate([
+        _fired("replica_crash:001", "replica_crash", 10.0),
+        _anomaly("slo_latency_budget"),
+        _cleared("replica_crash:001", "replica_crash", 30.0),
+    ])
+    (inc,) = corr.incidents()
+    assert inc.attributed_to == "replica_crash:001"
+    assert inc.state == "resolved"
+
+
+def test_dedup_folds_repeat_symptoms_into_one_incident():
+    corr = correlate([
+        _fired("queue_stall:000", "queue_stall", 5.0),
+        _anomaly("slo_latency_budget"),
+        _anomaly("slo_latency_budget"),
+        _anomaly("slo_latency_budget"),
+        _cleared("queue_stall:000", "queue_stall", 15.0),
+    ])
+    (inc,) = corr.incidents()
+    assert inc.events == 3
+    assert corr.summary()["incident_total"] == 1
+
+
+def test_flap_suppression_caps_reopen_records():
+    """A bouncing signal reopens the incident (flap) only up to max_flaps
+    record emissions; beyond that the storm is counted, not streamed."""
+    cfg = IncidentConfig(max_flaps=2)
+    stream = [_fired("queue_stall:000", "queue_stall", 0.0)]
+    for i in range(4):
+        stream.append(_suppressed("queue_stall:000", "queue_stall",
+                                  "slo_latency_budget", 1.0 + 2 * i))
+        stream.append(_cleared("queue_stall:000", "queue_stall", 2.0 + 2 * i))
+    corr = correlate(stream, cfg=cfg)
+    (inc,) = corr.incidents()
+    assert inc.flaps == 3
+    assert corr.flaps_suppressed == 1
+    # 1 initial open + max_flaps reopened records, never more
+    assert _stages(corr).count("open") == 1 + cfg.max_flaps
+    assert corr.summary()["incident_flaps_suppressed"] == 1
+
+
+# -------------------------------------------------------------- attribution
+
+
+def test_unattributed_incident_stays_open_and_fails_armed_soak():
+    """The soak verdict: a symptom nobody injected (here an anomaly BEFORE
+    any fault window, with no causal kind match) must stay open through
+    finalize and fail the armed incident_attribution invariant."""
+    corr = correlate([
+        _anomaly("nonfinite_value", signal="loss", value="nan",
+                 baseline=None),
+        _fired("replica_crash:000", "replica_crash", 50.0),
+        _suppressed("replica_crash:000", "replica_crash",
+                    "slo_latency_budget", 52.0),
+        _cleared("replica_crash:000", "replica_crash", 60.0),
+    ])
+    s = corr.summary()
+    assert s["incident_total"] == 2
+    assert s["incident_unexplained"] == 1
+    assert s["incident_open"] == 1          # unattributed NEVER resolves
+    assert s["incident_critical"] >= 1      # nonfinite is critical
+
+    facts = {"expect_serving": False, "expect_async": False,
+             "expect_kill": False, "expect_incidents": True,
+             "incident_summary": s}
+    results = {r.name: r for r in check_invariants([], facts)}
+    assert not results["incident_attribution"].ok
+    assert "unexplained=1" in results["incident_attribution"].detail
+
+
+def test_fully_attributed_armed_soak_passes_invariant():
+    corr = correlate([
+        _fired("replica_crash:000", "replica_crash", 10.0),
+        _suppressed("replica_crash:000", "replica_crash",
+                    "slo_latency_budget", 12.0),
+        _cleared("replica_crash:000", "replica_crash", 20.0),
+    ])
+    facts = {"expect_serving": False, "expect_async": False,
+             "expect_kill": False, "expect_incidents": True,
+             "incident_summary": corr.summary()}
+    results = {r.name: r for r in check_invariants([], facts)}
+    assert results["incident_attribution"].ok
+
+
+def test_disarmed_stream_yields_zero_incidents():
+    """No faults, healthy fleet, steady scrape counters: the correlator must
+    stay silent, and both the disarmed invariant and the golden-twin
+    invariant hold."""
+    clean = [{"fps": 96.0, "loss": 0.5,
+              "fleet_healthy": 2.0, "fleet_replicas": 2.0,
+              "scrape_stale": 0.0, "scrape_errors": 0.0,
+              "scrape_restarts": 0.0}] * 5
+    corr = correlate(clean)
+    assert corr.summary()["incident_total"] == 0
+    assert corr.records() == []
+
+    facts = {"expect_serving": False, "expect_async": False,
+             "expect_kill": False, "expect_incidents": False,
+             "incident_summary": corr.summary(),
+             "clean_incident_summary": corr.summary()}
+    results = {r.name: r for r in check_invariants([], facts)}
+    assert results["incident_attribution"].ok
+    assert results["disarmed_twin_quiet"].ok
+
+
+def test_derived_health_symptoms_attribute_to_kind_matched_fault():
+    """Correlator-derived transitions (fleet health drop, scrape
+    degradation) attribute through SYMPTOM_FAULTS even when the concatenated
+    streams' clocks are incomparable — causal key outranks time window."""
+    corr = correlate([
+        _fired("replica_crash:000", "replica_crash", 100.0),
+        _cleared("replica_crash:000", "replica_crash", 110.0),
+        # rides the stream clock (t=110), which is OUTSIDE fired+grace of
+        # nothing — but kind-match still wins over proximity
+        {"fleet_replicas": 2.0, "fleet_healthy": 1.0},
+        {"scrape_errors": 1.0},
+    ])
+    for inc in corr.incidents():
+        assert inc.attributed_to == "replica_crash:000", inc.kind
+        assert inc.state == "resolved"
+    assert corr.summary()["incident_unexplained"] == 0
+
+
+# ---------------------------------------------------- supervisor integration
+
+
+def test_relaunch_annotates_kill_incident_and_mitigates():
+    """The supervisor's relaunch record folds into the open kill incident by
+    run lineage — the relaunch is the mitigation, not a second failure."""
+    corr = IncidentCorrelator()
+    corr.register_fault("soak:trainer_kill:000", "trainer_kill", 0.0,
+                        cleared_t=0.0)
+    corr.ingest({"emergency_checkpoint": 1.0, "run_id": "abc123",
+                 "incarnation": 1})
+    corr.ingest({"resilience_supervisor_relaunch": 1,
+                 "resilience_supervisor_last_exit": 75,
+                 "run_id": "abc123", "incarnation": 2})
+    corr.finalize()
+    (inc,) = corr.incidents()
+    assert inc.kind == "supervisor_kill"
+    assert inc.attributed_to == "soak:trainer_kill:000"
+    assert inc.events == 2
+    assert inc.incarnation == 2
+    assert inc.state == "resolved"
+    annotated = [r for r in corr.records() if r["incident"] == "annotated"]
+    assert annotated and annotated[0]["incarnation"] == 2
+    s = corr.summary()
+    assert s["incident_unexplained"] == 0 and s["incident_open"] == 0
+
+
+def test_relaunch_without_kill_incident_opens_critical_symptom():
+    corr = correlate([{"resilience_supervisor_relaunch": 1,
+                       "resilience_supervisor_last_exit": 1,
+                       "run_id": "abc123", "incarnation": 2}])
+    (inc,) = corr.incidents()
+    assert inc.kind == "supervisor_relaunch"
+    assert inc.severity == "critical"
+    assert inc.state == "open"              # nothing injected explains it
+
+
+# ------------------------------------------------------------ trace exemplar
+
+
+def test_exemplar_follows_anomaly_to_trace_tree(tmp_path):
+    """Satellite (b): the exemplar on an anomaly record is a real trace id —
+    following it into trace.jsonl lands on a root span plus its children,
+    and the incident minted from that anomaly carries the same id."""
+    tracer = Tracer(str(tmp_path), sample=1.0)
+    ctx = tracer.start_trace("serving", root="request")
+    assert ctx is not None
+    with ctx.span("batcher_dispatch"):
+        pass
+    ctx.finish()
+    tid = tracer.last_trace_id
+
+    det = AnomalyDetector(AnomalyConfig(),
+                          exemplar_fn=lambda: tracer.last_trace_id)
+    trips = det.observe({"slo_latency_burn": 2.0}, episode=4, total_steps=32)
+    assert [a.kind for a in trips] == ["slo_latency_budget"]
+    rec = trips[0].to_record()
+    assert rec["trace_exemplar"] == tid
+
+    spans = [json.loads(line) for line in
+             (tmp_path / "trace.jsonl").read_text().splitlines()]
+    tree = [s for s in spans if s["trace"] == rec["trace_exemplar"]]
+    roots = [s for s in tree if s["parent"] is None]
+    assert len(roots) == 1 and roots[0]["span"] == "request"
+    assert any(s["span"] == "batcher_dispatch" and s["parent"] == "request"
+               for s in tree)
+
+    corr = correlate([
+        _fired("load_spike:000", "load_spike", 0.0),
+        rec,
+        _cleared("load_spike:000", "load_spike", 5.0),
+    ])
+    (inc,) = corr.incidents()
+    assert inc.trace_exemplar == tid
+    opened = [r for r in corr.records() if r["incident"] == "open"]
+    assert opened[0]["trace_exemplar"] == tid
+    tracer.close()
+
+
+# ------------------------------------------------------------ typed records
+
+
+def test_incident_records_and_summary_pass_schema_both_modes():
+    check = _load_script("check_metrics_schema")
+    corr = IncidentCorrelator()
+    corr.register_fault("soak:trainer_kill:000", "trainer_kill", 0.0,
+                        cleared_t=0.0)
+    corr.ingest({"emergency_checkpoint": 1.0, "run_id": "abc123",
+                 "incarnation": 1})
+    corr.ingest({"resilience_supervisor_relaunch": 1,
+                 "resilience_supervisor_last_exit": 75,
+                 "run_id": "abc123", "incarnation": 2})
+    corr.ingest(_fired("replica_crash:000", "replica_crash", 10.0))
+    corr.ingest(_suppressed("replica_crash:000", "replica_crash",
+                            "slo_latency_budget", 12.0))
+    corr.ingest(_cleared("replica_crash:000", "replica_crash", 20.0))
+    corr.finalize()
+    records = corr.records()
+    assert records, "correlator emitted nothing"
+    for rec in records:
+        assert check.validate_record(rec) == [], rec
+        assert check.validate_record(rec, strict=True) == [], rec
+    assert check.validate_record(corr.summary(), strict=True) == []

@@ -1,0 +1,258 @@
+"""Rollup-plane contracts: exactness, bounded memory, canonical federation.
+
+The properties pinned here are the ones the unattended-soak story leans on:
+
+- window delta sketches merge back to the cumulative sketch BIT-FOR-BIT
+  (dyadic test values make float equality exact, not approximate);
+- memory stays under the analytic ``RollupConfig.cap_bytes()`` promise on a
+  fake-clock multi-hour stream, independent of run length;
+- tier compaction is deterministic — the same stream replayed twice yields a
+  byte-identical canonical wire;
+- the sidecar's ``GET /timeseries.json`` federated through ``RemoteScraper``
+  merges bit-identically to ``merge_wires`` over the live stores, and a dead
+  source keeps its last accepted wire (stale, never zero);
+- drained ``ts_`` records pass ``check_metrics_schema`` in both modes.
+"""
+
+import importlib.util
+import json
+import urllib.request
+from pathlib import Path
+
+from mat_dcml_tpu.telemetry.registry import Telemetry
+from mat_dcml_tpu.telemetry.remote import RemoteScraper, TelemetrySidecar
+from mat_dcml_tpu.telemetry.timeseries import (
+    RollupConfig,
+    RollupStore,
+    merge_wires,
+)
+
+
+def _load_script(name):
+    path = Path(__file__).resolve().parent.parent / "scripts" / f"{name}.py"
+    spec = importlib.util.spec_from_file_location(name, path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _canon(wire):
+    return json.dumps(wire, sort_keys=True)
+
+
+# dyadic rationals: exactly representable, so sums/mins/maxes compare with ==
+DYADIC = [0.125, 0.5, 2.0, 7.25, 0.25, 1.5, 3.0, 0.0625, 12.0, 0.75]
+
+SMALL_TIERS = ((10.0, 4), (60.0, 4), (600.0, 4))
+
+
+# ------------------------------------------------------------------ exactness
+
+
+def test_window_delta_sketches_merge_to_cumulative_bitwise():
+    """The tentpole exactness contract: per-window sketch DELTAS, merged over
+    every retained window (including tier-compacted ones), reproduce the
+    cumulative sketch bit-for-bit — every to_dict field, not just counts."""
+    tel = Telemetry()
+    store = RollupStore(RollupConfig(tiers=SMALL_TIERS))
+    for i, v in enumerate(DYADIC):
+        tel.hist("latency_ms", v)
+        tel.count("steps", 2)
+        # 7s stride crosses seven 10s raw windows; three compact into tier 1
+        store.observe_telemetry(tel, t=float(i * 7))
+    assert store.compactions > 0, "stream too short to exercise compaction"
+
+    merged = store.merged_window()
+    assert merged.hists["latency_ms"].to_dict() == \
+        tel.hists["latency_ms"].to_dict()
+
+    steps = merged.metrics["steps"]
+    assert steps.sum == tel.counters["steps"]       # deltas re-add exactly
+    assert steps.count == len(DYADIC)
+    assert steps.last == tel.counters["steps"]
+
+
+def test_windowed_merge_matches_whole_run_merge_across_sources():
+    """Merging two stores' wires (the federation path) equals feeding both
+    streams into the whole-run view: counts and totals add exactly."""
+    stores, tels = [], []
+    for offset in (0.0, 0.25):
+        tel = Telemetry()
+        store = RollupStore(RollupConfig(tiers=SMALL_TIERS))
+        for i, v in enumerate(DYADIC):
+            tel.hist("latency_ms", v + offset)
+            store.observe_telemetry(tel, t=float(i * 7))
+        stores.append(store)
+        tels.append(tel)
+
+    fed = RollupStore.from_wire(
+        merge_wires([s.to_wire() for s in stores])).merged_window()
+    ref = tels[0].hists["latency_ms"].to_dict()
+    other = tels[1].hists["latency_ms"].to_dict()
+    got = fed.hists["latency_ms"].to_dict()
+    assert got["count"] == ref["count"] + other["count"]
+    assert got["total"] == ref["total"] + other["total"]
+    assert got["vmin"] == min(ref["vmin"], other["vmin"])
+    assert got["vmax"] == max(ref["vmax"], other["vmax"])
+    assert got["buckets"] == [a + b for a, b in
+                              zip(ref["buckets"], other["buckets"])]
+
+
+# -------------------------------------------------------------- memory bound
+
+
+def test_memory_capped_on_fake_clock_multi_hour_stream():
+    """Eight fake-clock hours of steady observations: retained state stays
+    under the analytic cap and every ring respects its slot budget."""
+    cfg = RollupConfig()
+    store = RollupStore(cfg)
+    tel = Telemetry()
+    for i in range(5760):                     # 8 h at one observation per 5 s
+        t = i * 5.0
+        tel.count("steps", 4)
+        tel.hist("step_time_train", DYADIC[i % len(DYADIC)])
+        tel.gauge("loss", DYADIC[(i + 3) % len(DYADIC)])
+        store.observe_telemetry(tel, t=t)
+        store.observe_record({"fps": 96.0 + (i % 7), "reward": 0.5}, t=t)
+        store.drain_records()                 # a soak drains as it goes
+
+    assert store.estimate_bytes() <= cfg.cap_bytes()
+    for ring, (_, slots) in zip(store._tiers, cfg.tiers):
+        assert len(ring) <= slots
+    g = store.gauges()
+    assert g["ts_windows_closed"] > 0
+    assert g["ts_compactions"] > 0
+    assert g["ts_series"] <= cfg.max_series + cfg.max_hist_series
+
+
+def test_series_cap_drops_instead_of_growing():
+    cfg = RollupConfig(tiers=SMALL_TIERS, max_series=8, max_hist_series=2)
+    store = RollupStore(cfg)
+    store.observe_record({f"metric_{i}": float(i) for i in range(64)}, t=0.0)
+    assert len(store._series) == 8
+    assert store.series_dropped > 0
+    assert store.estimate_bytes() <= cfg.cap_bytes()
+
+
+# -------------------------------------------------------------- determinism
+
+
+def _drive(store, hours=3.0):
+    """Deterministic multi-hour stream: values are a pure function of the
+    step index, so two replays are identical by construction."""
+    tel = Telemetry()
+    steps = int(hours * 3600 / 30)
+    for i in range(steps):
+        t = i * 30.0
+        tel.count("steps", 1 + i % 3)
+        tel.hist("latency_ms", DYADIC[i % len(DYADIC)])
+        tel.gauge("loss", DYADIC[(i * 7) % len(DYADIC)])
+        store.observe_telemetry(tel, t=t)
+        store.observe_record({"fps": float(64 + i % 5)}, t=t)
+    return store
+
+
+def test_tier_compaction_is_deterministic():
+    """Same stream, two stores, multi-tier compaction on both: canonical
+    wires are byte-identical — compaction has no order- or identity-dependent
+    behaviour."""
+    a = _drive(RollupStore(RollupConfig(tiers=SMALL_TIERS)))
+    b = _drive(RollupStore(RollupConfig(tiers=SMALL_TIERS)))
+    # 3 h at 10s/60s/600s tiers forces eviction through BOTH boundaries
+    assert a.compactions > 0 and all(len(r) > 0 for r in a._tiers)
+    assert _canon(a.to_wire()) == _canon(b.to_wire())
+
+
+def test_wire_round_trip_bit_identical():
+    store = _drive(RollupStore(RollupConfig(tiers=SMALL_TIERS)), hours=1.0)
+    wire = store.to_wire()
+    back = RollupStore.from_wire(json.loads(json.dumps(wire))).to_wire()
+    assert _canon(back) == _canon(wire)
+
+
+def test_merge_wires_identity_and_empty():
+    store = _drive(RollupStore(RollupConfig(tiers=SMALL_TIERS)), hours=0.5)
+    wire = store.to_wire()
+    assert _canon(merge_wires([wire])) == _canon(wire)
+    assert merge_wires([]) == {"tiers": [], "series_dropped": 0}
+    assert _canon(merge_wires([{}, wire])) == _canon(wire)
+
+
+# -------------------------------------------------------------- typed records
+
+
+def test_drained_ts_records_pass_schema_both_modes():
+    check = _load_script("check_metrics_schema")
+    tel = Telemetry()
+    store = RollupStore(RollupConfig(tiers=SMALL_TIERS))
+    tel.hist("latency_ms", 1.5)
+    tel.count("steps", 2)
+    store.observe_telemetry(tel, t=5.0)
+    store.observe_telemetry(tel, t=15.0)      # closes the first raw window
+    records = store.drain_records()
+    assert any(r["ts"] == "window" for r in records)
+    assert any(r["ts"] == "hist" for r in records)
+    for rec in records:
+        assert check.validate_record(rec) == []
+        assert check.validate_record(rec, strict=True) == []
+    # the accounting gauges ride the metrics stream under the same vocab
+    assert check.validate_record(store.gauges(), strict=True) == []
+
+
+# --------------------------------------------------------------- federation
+
+
+def test_sidecar_scraper_federation_bit_identical_and_stale_never_zero():
+    """End-to-end over real HTTP: two sidecars serve /timeseries.json, the
+    scraper's merged wire equals merge_wires over the live stores byte-for-
+    byte; killing a source keeps its last accepted wire (stale, never
+    zeroed) in the merge."""
+    quiet = lambda *a, **k: None  # noqa: E731
+    tels, sidecars = [], []
+    for label, vals in (("trainer", DYADIC[:5]), ("fleet", DYADIC[5:])):
+        tel = Telemetry()
+        tel.count("steps", 8)
+        tel.gauge("loss", 0.75)
+        for v in vals:
+            tel.hist("latency_ms", v)
+        sc = TelemetrySidecar(tel, port=0, label=label,
+                              rollup=RollupStore(), log_fn=quiet)
+        sc.start()
+        tels.append(tel)
+        sidecars.append(sc)
+    try:
+        # raw payload shape straight off the wire
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{sidecars[0].port}/timeseries.json",
+                timeout=5.0) as resp:
+            snap = json.loads(resp.read())
+        assert snap["source"] == "trainer"
+        assert snap["seq"] >= 1
+        assert "rollup" in snap
+
+        scraper = RemoteScraper(
+            [("trainer", f"http://127.0.0.1:{sidecars[0].port}"),
+             ("fleet", f"http://127.0.0.1:{sidecars[1].port}")],
+            timeout_s=5.0, fetch_timeseries=True, log_fn=quiet)
+        scraper.poll()
+        merged = scraper.merged_timeseries()
+        # in-process reference over the SAME post-scrape store state
+        ref = merge_wires([sc.rollup.to_wire() for sc in sidecars])
+        assert _canon(merged) == _canon(ref)
+
+        # degradation: dead source keeps its last wire, never vanishes
+        sidecars[1].stop()
+        errors_before = scraper.sources["fleet"].errors
+        scraper.poll()
+        assert scraper.sources["fleet"].errors > errors_before
+        assert scraper.sources["fleet"].ts_snapshot is not None
+        assert len(scraper.timeseries_snapshots()) == 2
+        still = RollupStore.from_wire(
+            scraper.merged_timeseries()).merged_window()
+        assert "latency_ms" in still.hists
+    finally:
+        sidecars[0].stop()
+        try:
+            sidecars[1].stop()
+        except Exception:
+            pass
