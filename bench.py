@@ -197,6 +197,23 @@ Knobs (environment variables):
                         BENCH_ASYNC_T (8), BENCH_ASYNC_EPISODES (4),
                         BENCH_ASYNC_TRIALS (3), BENCH_ASYNC_DEVICES (8),
                         BENCH_ASYNC_PARITY_EPISODES (30; 0 disables)
+  BENCH_ASYNC_SCALE     "1" → N-worker trajectory-store scale-out sweep (CPU
+                        proxy): --async_actor_workers N in {1,2,4} x
+                        --staleness_budget B in {1,2,4} on a fixed 4-actor/
+                        4-learner split, actor-bound PPO (ppo_epoch=1), each
+                        cell through the real runner via ab_trials.  Scores
+                        ACTOR-side env-steps/s (sum of the per-worker
+                        async_actor_w<i>_env_steps_per_sec gauges); the
+                        record carries the full N x B cell table plus the
+                        zero-drops / zero-steady-recompiles / staleness-
+                        within-budget verdicts.  B < N serializes collection
+                        — read the scaling along B >= N.  Knobs:
+                        BENCH_ASYNC_SCALE_E (64), BENCH_ASYNC_SCALE_T (8),
+                        BENCH_ASYNC_SCALE_EPISODES (4),
+                        BENCH_ASYNC_SCALE_TRIALS (2),
+                        BENCH_ASYNC_SCALE_DEVICES (8),
+                        BENCH_ASYNC_SCALE_WORKERS (1,2,4),
+                        BENCH_ASYNC_SCALE_BUDGETS (1,2,4)
 
 On device OOM the bench walks a backoff ladder before shrinking the batch:
 remat on -> accumulation x2 (up to 8) -> halve E — big batches get memory
@@ -1465,6 +1482,170 @@ def _measure_async() -> None:
         "steady_state_recompiles": recompiles,
     }
     record.update(parity)
+    print(json.dumps(record), flush=True)
+
+
+def _measure_async_scale() -> None:
+    """BENCH_ASYNC_SCALE=1 leg: N-worker trajectory-store scale-out sweep
+    (CPU proxy).
+
+    Sweeps --async_actor_workers N in {1,2,4} x --staleness_budget B in
+    {1,2,4} on a fixed 4-actor/4-learner forced-virtual-device split, every
+    cell through the real runner (``base_runner.train_loop`` ->
+    ``_train_loop_async``), best-of-T alternating trials (``ab_trials``).
+    The workload is deliberately ACTOR-BOUND (ppo_epoch=1, num_mini_batch=1)
+    so actor-side throughput — the sum of the per-worker
+    ``async_actor_w<i>_env_steps_per_sec`` gauges — is the quantity the
+    scale-out can actually move.
+
+    Honest yardsticks baked into the record: B < N serializes collection
+    (the admission bound caps concurrent collects at B), so the scaling
+    diagonal to read is B >= N; and on a shared-CPU host all virtual actor
+    devices compete for the same cores, so this measures pipeline structure
+    (admission, zero drops, zero steady recompiles at every cell), not chip
+    speedup — chip re-measure is a ROADMAP follow-up."""
+    n_dev = int(os.environ.get("BENCH_ASYNC_SCALE_DEVICES", "8"))
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n_dev}"
+        ).strip()
+    jax, _ = _setup_jax()
+
+    import tempfile
+
+    import numpy as np
+
+    from mat_dcml_tpu.config import RunConfig
+    from mat_dcml_tpu.envs.dcml import DCMLEnv, DCMLEnvConfig
+    from mat_dcml_tpu.envs.dcml.env import DCMLConsts
+    from mat_dcml_tpu.training.ppo import PPOConfig
+    from mat_dcml_tpu.training.runner import DCMLRunner
+
+    E = int(os.environ.get("BENCH_ASYNC_SCALE_E", "64"))
+    T = int(os.environ.get("BENCH_ASYNC_SCALE_T", "8"))
+    episodes = int(os.environ.get("BENCH_ASYNC_SCALE_EPISODES", "4"))
+    trials = int(os.environ.get("BENCH_ASYNC_SCALE_TRIALS", "2"))
+    workers_list = [int(n) for n in os.environ.get(
+        "BENCH_ASYNC_SCALE_WORKERS", "1,2,4").split(",")]
+    budget_list = [int(b) for b in os.environ.get(
+        "BENCH_ASYNC_SCALE_BUDGETS", "1,2,4").split(",")]
+    n_act = n_dev // 2
+
+    W = 8
+    consts = DCMLConsts(worker_number_max=W, sob_dim=W + 2)
+    rng = np.random.default_rng(0)
+    workloads = rng.integers(
+        0, 5, size=(W, consts.local_workload_period)).astype(np.float32)
+
+    def make_env():
+        return DCMLEnv(DCMLEnvConfig(consts=consts), base_workloads=workloads)
+
+    schema_ok = []  # every trial's run dir, strict-validated
+
+    def leg(n_workers, budget):
+        tmp = tempfile.mkdtemp(prefix=f"bench_ascale_n{n_workers}b{budget}_")
+        runner = DCMLRunner(
+            RunConfig(
+                algorithm_name="mat",
+                experiment_name=f"bench_ascale_n{n_workers}b{budget}",
+                seed=1, n_rollout_threads=E, episode_length=T,
+                n_block=1, n_embd=32, n_head=2,
+                log_interval=1, telemetry_interval=1, save_interval=0,
+                run_dir=tmp, anomaly_tripwires=False, graceful_stop=False,
+                async_actors=True, actor_devices=n_act,
+                learner_devices=n_dev - n_act,
+                async_actor_workers=n_workers, staleness_budget=budget,
+            ),
+            # actor-bound on purpose: one cheap learner epoch so collection
+            # throughput is the bottleneck the worker fan-out can move
+            PPOConfig(ppo_epoch=1, num_mini_batch=1),
+            env=make_env(), log_fn=lambda *a: None)
+        ts, rs = runner.setup()
+        runner.train_loop(num_episodes=episodes, train_state=ts,
+                          rollout_state=rs)
+        with open(runner.metrics_path) as f:
+            recs = [json.loads(ln) for ln in f if ln.strip()]
+        schema_ok.append(_validate_run_dir(tmp))
+        recs = [r for r in recs if "fps" in r]
+        sps = actor_sps(recs)
+        log(f"N={n_workers} B={budget}: {sps:.1f} actor env-steps/s")
+        return recs
+
+    def actor_sps(recs):
+        last = recs[-1]
+        per_worker = [v for k, v in last.items()
+                      if k.startswith("async_actor_w")
+                      and k.endswith("_env_steps_per_sec")]
+        if per_worker:
+            return float(sum(per_worker))
+        return float(last.get("env_steps_per_sec", 0.0))
+
+    log(f"async scale-out sweep: E={E} T={T} episodes={episodes} "
+        f"trials={trials} devices={n_dev} (actor {n_act} / learner "
+        f"{n_dev - n_act}), N in {workers_list} x B in {budget_list}")
+    variants = {
+        f"n{n}_b{b}": (lambda n=n, b=b: leg(n, b))
+        for n in workers_list for b in budget_list
+    }
+    best, _ = ab_trials(variants, trials, score=actor_sps)
+
+    cells = {}
+    drops = recompiles = 0
+    budget_violations = []
+    for name, recs in best.items():
+        last = recs[-1]
+        sps = actor_sps(recs)
+        b = int(last.get("store_staleness_budget", 1))
+        p95 = float(last.get("staleness_learner_steps_p95", 0.0))
+        cells[name] = {
+            "actor_env_steps_per_sec": round(sps, 2),
+            "staleness_p95": p95,
+            "store_drops": int(last.get("store_drops",
+                                        last.get("async_queue_drops", 0))),
+            "steady_state_recompiles": int(
+                last.get("steady_state_recompiles", 0)
+                + last.get("async_actor_steady_state_recompiles", 0)),
+        }
+        drops += cells[name]["store_drops"]
+        recompiles += cells[name]["steady_state_recompiles"]
+        if p95 > b:
+            budget_violations.append(f"{name}: p95 {p95:g} > budget {b}")
+
+    n_max, b_max = max(workers_list), max(budget_list)
+    base_key, top_key = f"n{workers_list[0]}_b{budget_list[0]}", \
+        f"n{n_max}_b{b_max}"
+    base_sps = cells[base_key]["actor_env_steps_per_sec"]
+    top_sps = cells[top_key]["actor_env_steps_per_sec"]
+    scaling = top_sps / max(base_sps, 1e-9)
+    log(f"scale-out {base_key} {base_sps:.1f} -> {top_key} {top_sps:.1f} "
+        f"actor env-steps/s (x{scaling:.2f} of x{n_max} ideal); "
+        f"drops {drops}, steady recompiles {recompiles}, "
+        f"budget violations {budget_violations or 'none'}")
+
+    dev = jax.devices()[0]
+    record = {
+        "metric": "dcml_mat_async_scale_actor_env_steps_per_sec",
+        "value": round(top_sps, 2),
+        "unit": "env_steps/s",
+        "platform": dev.platform,
+        "device": dev.device_kind,
+        "provisional": dev.platform != "tpu",
+        "proxy": "cpu-virtual-devices",  # all actor submeshes share one
+        # socket: this proves pipeline structure, not parallel speedup
+        "E": E, "T": T, "episodes": episodes, "trials": trials,
+        "devices": n_dev, "actor_devices": n_act,
+        "learner_devices": n_dev - n_act,
+        "workers_swept": workers_list, "budgets_swept": budget_list,
+        "vs_baseline": round(scaling, 4),
+        "ideal_scaling": float(n_max),
+        "zero_drops": drops == 0,
+        "zero_steady_recompiles": recompiles == 0,
+        "staleness_within_budget": not budget_violations,
+        "schema_strict_ok": bool(schema_ok) and all(schema_ok),
+        "cells": cells,
+    }
     print(json.dumps(record), flush=True)
 
 
@@ -2747,6 +2928,11 @@ def main() -> None:
     # Async actor-learner overlap A/B: pins its own CPU topology pre-init
     if os.environ.get("BENCH_ASYNC", "0") == "1":
         _measure_async()
+        return
+
+    # N-worker trajectory-store scale-out sweep (N x staleness budget)
+    if os.environ.get("BENCH_ASYNC_SCALE", "0") == "1":
+        _measure_async_scale()
         return
 
     # Serving A/B leg: self-contained, no orchestration (the caller pins the

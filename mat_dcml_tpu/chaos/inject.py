@@ -74,6 +74,7 @@ _SUPPRESSES: Dict[str, tuple] = {
     "checkpoint_corrupt": ("step_time",),
     "nan_grad": ("nonfinite",),
     "actor_thread_death": ("step_time", "staleness"),
+    "actor_crash": ("step_time", "staleness"),
     "param_publish_delay": ("staleness", "step_time"),
     "trainer_kill": (),
 }
@@ -83,7 +84,7 @@ _SUPPRESSES: Dict[str, tuple] = {
 # counts are the deterministic clock there.
 _COUNT_GATED = frozenset({
     "decode_error", "checkpoint_io_error", "checkpoint_corrupt",
-    "nan_grad", "actor_thread_death",
+    "nan_grad", "actor_thread_death", "actor_crash",
 })
 
 
@@ -340,13 +341,23 @@ class FaultInjector:
         if hit is not None:
             time.sleep(float(hit[0].params.get("sleep_s", 0.1)))
 
-    def on_actor_iteration(self, iteration: int) -> None:
+    def on_actor_iteration(self, iteration: int,
+                           worker: Optional[str] = None) -> None:
         """Top of the actor thread loop; ``params.at_iteration`` is the
-        deterministic trigger."""
+        deterministic trigger.  ``worker`` is the calling worker's label
+        (``"w<idx>"``) — ``actor_crash`` events match it against their
+        ``target`` to kill one specific worker out of N, while the legacy
+        ``actor_thread_death`` ignores it (any worker can satisfy it)."""
         hit = self._claim("actor_thread_death", call_index=iteration)
         if hit is not None:
             raise ActorThreadDeath(
                 f"injected silent actor death ({hit[0].event_id})",
+                event_id=hit[0].event_id)
+        hit = self._claim("actor_crash", worker, call_index=iteration)
+        if hit is not None:
+            raise ActorThreadDeath(
+                f"injected actor worker crash ({hit[0].event_id}, "
+                f"worker={worker})",
                 event_id=hit[0].event_id)
 
     def on_anomaly_signals(self, signals: Dict[str, float],

@@ -12,8 +12,13 @@ returns one :class:`InvariantResult` per contract:
 * ``zero_steady_recompiles`` — every ``*steady_state_recompiles`` gauge in
   every record is 0: faults must not knock compiled programs off their
   signatures.
-* ``staleness_p95_le_1`` — the async overlap's double-buffering throttle
-  holds under injected delays (last ``staleness_learner_steps_p95`` ≤ 1).
+* ``staleness_p95_le_1`` — the async overlap's staleness budget holds under
+  injected delays: last ``staleness_learner_steps_p95`` ≤ the run's budget.
+  The budget is read from the records' own ``store_staleness_budget`` gauge
+  (the trajectory store self-describes it), falling back to
+  ``facts["staleness_budget"]`` and finally 1.0 — so pre-scale-out records
+  keep their original ≤ 1 contract.  The name keeps the historical ``le_1``
+  even at B > 1: it is the same contract with the bound generalized.
 * ``bit_exact_resume`` — the kill-and-relaunch trainer converges to the
   byte-identical final state of an uninterrupted twin (driver-computed).
 * ``incident_attribution`` — the correlator's verdict
@@ -112,9 +117,19 @@ def check_invariants(records: List[dict],
             out.append(_skip("staleness_p95_le_1", "no async records"))
     else:
         p95 = _num(stale[-1], "staleness_learner_steps_p95") or 0.0
+        # the store self-describes its budget; old (pre-scale-out) records
+        # carry no gauge and keep the original <= 1 bound
+        budget = next(
+            (_num(r, "store_staleness_budget") for r in reversed(stale)
+             if _num(r, "store_staleness_budget") is not None),
+            None)
+        if budget is None:
+            budget = float(facts.get("staleness_budget", 1.0) or 1.0)
         out.append(InvariantResult(
-            "staleness_p95_le_1", p95 <= 1.0,
-            f"staleness_learner_steps_p95={p95:g} (last async record)"))
+            "staleness_p95_le_1", p95 <= budget,
+            f"staleness_learner_steps_p95={p95:g} <= budget {budget:g} "
+            f"(last async record)" if p95 <= budget else
+            f"staleness_learner_steps_p95={p95:g} exceeds budget {budget:g}"))
 
     # --- bit-exact resume -------------------------------------------------
     verdict = facts.get("bit_exact_resume")

@@ -21,7 +21,8 @@ Plan JSON::
     }
 
 Count-gated kinds (checkpoint_io_error, decode_error, checkpoint_corrupt,
-actor_thread_death, nan_grad) fire on the Nth hook call inside their window
+actor_thread_death, actor_crash, nan_grad) fire on the Nth hook call inside
+their window
 via ``params`` (``fail_calls``, ``skip_calls``, ``at_iteration``) rather than
 wall-clock alone — training-plane timing is compile-dominated, so call counts
 are the deterministic clock there.
@@ -50,6 +51,10 @@ FAULT_KINDS: Dict[str, str] = {
     "trainer_kill": "train_sync",      # orchestrator-level SIGTERM
     "actor_thread_death": "train_async",   # actor thread dies silently
     "param_publish_delay": "train_async",  # publisher sleeps per publish
+    # a SPECIFIC actor worker (target "w<idx>") dies silently under load —
+    # the N-worker generalization of actor_thread_death, exercising the
+    # per-worker restart path + admission-ticket reclaim
+    "actor_crash": "train_async",
 }
 
 

@@ -188,14 +188,35 @@ class RunConfig:
     learner_devices: int = 0
     # bounded trajectory-queue capacity (device-buffer ring slots).  Deeper
     # queues buy transient actor/learner jitter tolerance at the cost of
-    # learner HBM; steady-state param staleness stays <= 1 learner step
-    # regardless (the actor throttles to one block per published version
-    # whenever a completed block is already queued — async_loop.ActorWorker).
+    # learner HBM; consumed param staleness stays <= --staleness_budget
+    # regardless (the store's admission control gates collects, not the
+    # ring depth — async_loop.TrajectoryStore).  The effective capacity is
+    # max(async_queue_depth, staleness_budget) so a raised budget is never
+    # throttled by the default ring.
     async_queue_depth: int = 2
     # learner-side liveness budget: how many times a silently-dead actor
     # thread (no recorded error, queue left open) is restarted from the last
-    # published params before the run raises ActorDeadError
+    # published params before the run raises ActorDeadError (per worker)
     async_actor_max_restarts: int = 2
+    # number of concurrent ActorWorker threads; the actor submesh is carved
+    # into this many equal contiguous (data, seq=1) slices
+    # (parallel.mesh.carve_actor_worker_meshes), each worker running its own
+    # compiled collect program.  Near-linear actor-side scaling needs
+    # --staleness_budget >= workers (admission serializes collects beyond
+    # the budget); 1 = PR 13 single-worker behavior
+    async_actor_workers: int = 1
+    # staleness budget B: max param-version lag any consumed trajectory
+    # block may carry (admission control: a collect starts only while
+    # in-flight + queued + consuming <= B).  1 reproduces the conservative
+    # double-buffered overlap; > 1 admits off-policy blocks and (with
+    # --off_policy_correction auto) turns on V-trace-style truncated-IS
+    # weighting in the PPO update
+    staleness_budget: int = 1
+    # off-policy correction for stale blocks (training/off_policy.py):
+    # "auto" = V-trace truncated IS iff staleness_budget > 1 (so B=1 runs
+    # stay bit-exact with PR 13), "vtrace" / "none" force it on / off.
+    # Clipping thresholds live in PPOConfig (vtrace_rho_bar / vtrace_c_bar)
+    off_policy_correction: str = "auto"
 
     @property
     def episodes(self) -> int:

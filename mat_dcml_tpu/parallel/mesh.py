@@ -223,6 +223,39 @@ def build_actor_learner_meshes(
     return actor_mesh, learner_mesh
 
 
+def carve_actor_worker_meshes(actor_mesh: Mesh, n_workers: int) -> list[Mesh]:
+    """Split the actor submesh into ``n_workers`` disjoint per-worker
+    ``(data, seq=1)`` slices for ``--async_actor_workers``: each
+    :class:`~mat_dcml_tpu.training.async_loop.ActorWorker` runs its own
+    collect program on its own contiguous device slice, so N collects
+    genuinely overlap instead of time-slicing one submesh.  ``n_workers=1``
+    hands back the actor mesh unchanged (PR 13 parity — same devices, same
+    compiled program).  The actor device count must divide evenly: a ragged
+    split would give workers different data-axis widths and therefore
+    different compiled collect programs for the same batch.
+    """
+    if n_workers < 1:
+        raise ValueError(
+            f"--async_actor_workers must be >= 1, got {n_workers}"
+        )
+    if n_workers == 1:
+        return [actor_mesh]
+    devices = list(actor_mesh.devices.flat)
+    n = len(devices)
+    if n % n_workers != 0:
+        raise ValueError(
+            f"--async_actor_workers {n_workers} must divide the actor "
+            f"submesh's {n} devices evenly (one equal contiguous slice per "
+            f"worker; pick --actor_devices as a multiple of the worker "
+            f"count)"
+        )
+    per = n // n_workers
+    return [
+        make_data_seq_mesh(1, devices[i * per:(i + 1) * per])
+        for i in range(n_workers)
+    ]
+
+
 def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 

@@ -45,6 +45,9 @@ class ACTrajectory(NamedTuple):
     # n_done, done_reward_sum, step_reward_mean always; done_delay_sum /
     # done_payment_sum only for envs whose TimeStep carries the info channels.
     chunk_stats: Optional[dict] = None
+    # Raw truncated-IS ratios (T, E, A, 1) from the async off-policy
+    # correction (training/off_policy.py); None outside stale async blocks.
+    is_weights: Optional[jax.Array] = None
 
 
 class ACRolloutState(NamedTuple):

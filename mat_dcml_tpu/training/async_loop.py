@@ -19,16 +19,27 @@ HBM.  Backpressure blocks the producer — a full queue means the learner is
 the bottleneck and more rollouts would only go stale; nothing is ever
 dropped (``drops`` is pinned at 0 by tests/test_async_loop.py).
 
-Staleness semantics: the learner accepts 1-step-lagged PPO (bit-exactness
+Staleness semantics: the learner accepts lag-tolerant PPO (bit-exactness
 with the synchronous loop is explicitly NOT a goal — convergence parity on
 the DCML preset is pinned in BENCHLOG instead).  ``ParamPublisher`` versions
 every publish; the lag ``publisher.version - block.param_version`` observed
-at consume time feeds the ``staleness_`` gauge family.  A double-buffering
-throttle in :class:`ActorWorker` (one new block per published version while
-one is already queued) pins steady-state lag at <= 1 even when the actor is
-the fast side; the importance-correction hook
-(:data:`IMPORTANCE_CORRECTION_DOC`) is the designated seam for off-policy
-corrections should transient lag > 1 ever need more than ratio clipping.
+at consume time feeds the ``staleness_`` gauge family.
+
+Scale-out (``--async_actor_workers N``): N :class:`ActorWorker` threads each
+own a carved slice of the actor submesh
+(``parallel.mesh.carve_actor_worker_meshes``) and a private telemetry
+registry, and feed one shared :class:`TrajectoryStore` — a multi-producer
+generalization of :class:`TrajectoryQueue` whose admission control enforces a
+**staleness budget** ``--staleness_budget B``: a worker may start collecting
+only while ``tickets + depth + consuming <= B`` (tickets = collects in
+flight, depth = queued blocks, consuming = the block the learner is training
+on right now).  Every block admitted when S others are outstanding is
+consumed after at most S subsequent publishes, so consumed lag <= B by
+construction — ``B = 1`` reproduces PR 13's double-buffering throttle
+(collect-during-train, steady-state lag <= 1) without the version-polling
+loop.  The importance-correction hook (:data:`IMPORTANCE_CORRECTION_DOC`) is
+the seam the V-trace-style truncated-IS implementation in
+``training/off_policy.py`` plugs into when ``B > 1``.
 """
 
 from __future__ import annotations
@@ -58,15 +69,21 @@ class TrajectoryBlock(NamedTuple):
     actor_iter: int           # 1-based actor iteration (FIFO assertable)
     t_start: float            # perf_counter at collect launch (actor thread)
     t_end: float              # perf_counter when the block was ready
+    worker_id: int = 0        # which ActorWorker produced this block
 
 
 # The importance-correction hook contract: ``hook(traj, lag) -> traj`` is
-# applied by the learner BEFORE the PPO update whenever the consumed block's
-# param-version lag is > 0.  The default (None) is the identity — PPO's ratio
-# clipping already absorbs the 1-step lag the bounded queue produces in
-# steady state (staleness_learner_steps_p95 <= 1, pinned in tests).  A real
-# correction (e.g. V-trace-style truncated importance weights over
-# ``traj.log_probs``) plugs in here without touching the loop.
+# applied by the learner BEFORE the PPO update on EVERY consumed block while
+# a correction is enabled (``lag`` may be 0 — the hook must be a numerical
+# identity there), and never while disabled, so the trajectory pytree
+# STRUCTURE seen by the jitted update is constant for the whole run and the
+# steady-state recompile guarantee holds.  The default (None) is the
+# identity — PPO's ratio clipping already absorbs the <=1-step lag the
+# ``staleness_budget=1`` store produces.  The real implementation
+# (V-trace-style truncated importance weights over ``traj.log_probs``) lives
+# in ``training/off_policy.make_vtrace_correction`` and attaches raw
+# behavior/target ratios as ``traj.is_weights``; the PPO/MAPPO loss clips
+# them at rho-bar / c-bar.
 ImportanceCorrection = Callable[[Any, int], Any]
 IMPORTANCE_CORRECTION_DOC = ImportanceCorrection
 
@@ -121,8 +138,20 @@ class TrajectoryQueue:
             self._slots.append(block)
             self.puts += 1
             self.max_depth = max(self.max_depth, len(self._slots))
+            self._on_put_locked()
             self._cv.notify_all()
             return True
+
+    def _on_put_locked(self) -> None:
+        """Subclass hook, called under ``_cv`` right after a successful
+        append (TrajectoryStore converts the producer's admission ticket
+        into queue depth here, atomically)."""
+
+    def _on_get_locked(self) -> None:
+        """Subclass hook, called under ``_cv`` right after a successful pop
+        (TrajectoryStore marks the block as being consumed here — the same
+        critical section, so admission never sees depth drop before
+        ``consuming`` rises)."""
 
     def get(self, timeout: Optional[float] = None):
         """Dequeue FIFO, blocking while empty.  ``None`` = closed-and-empty
@@ -141,6 +170,7 @@ class TrajectoryQueue:
                 return None          # closed and fully drained
             block = self._slots.popleft()
             self.gets += 1
+            self._on_get_locked()
             self._cv.notify_all()
             return block
 
@@ -161,6 +191,107 @@ class TrajectoryQueue:
             return left
 
 
+class TrajectoryStore(TrajectoryQueue):
+    """Multi-producer :class:`TrajectoryQueue` with staleness-budget
+    admission control.
+
+    N actor workers call :meth:`admit` before every collect; the call blocks
+    while ``tickets + depth + consuming > staleness_budget`` where
+
+    - ``tickets``   — admitted collects not yet enqueued (in flight on some
+      actor submesh slice),
+    - ``depth``     — completed blocks waiting in the ring,
+    - ``consuming`` — 1 between the learner's :meth:`get` and its
+      post-update :meth:`mark_consumed` (the train + publish window).
+
+    A block admitted when ``S`` others are outstanding is consumed after at
+    most ``S`` subsequent publishes, so the param-version lag of every
+    consumed block is ``<= staleness_budget`` by construction (asserted as
+    ``staleness_learner_steps_p95 <= B`` by the chaos invariants).  With
+    ``B = 1`` this reduces to PR 13's double-buffering throttle: one block
+    may be collected while the learner trains on another, steady-state lag
+    ``<= 1``.  Note ``B < N`` serializes collection — only B workers can
+    ever be admitted at once, so near-linear N-worker scaling needs
+    ``B >= N`` (measured honestly in ``BENCH_ASYNC_SCALE``).
+
+    Ticket hygiene: a successful :meth:`put` consumes the producer's ticket
+    atomically; a producer that aborts (stop request, closed store, crash
+    with a loud error) must :meth:`cancel_ticket`.  A SILENTLY dead actor
+    cannot — the learner's liveness/restart path reclaims its ticket via
+    ``ActorWorker.holding_ticket``, so an injected ``actor_crash`` never
+    leaks admission capacity.  ``close`` wakes admit-waiters (they return
+    ``False``), so graceful stop never deadlocks on admission.
+    """
+
+    def __init__(self, capacity: int, staleness_budget: int = 1):
+        super().__init__(capacity)
+        if staleness_budget < 1:
+            raise ValueError(
+                f"staleness budget must be >= 1, got {staleness_budget}"
+            )
+        self.staleness_budget = int(staleness_budget)
+        self._tickets = 0
+        self._consuming = 0
+        self.admits = 0
+
+    @property
+    def tickets(self) -> int:
+        return self._tickets
+
+    @property
+    def consuming(self) -> int:
+        return self._consuming
+
+    @property
+    def outstanding(self) -> int:
+        """tickets + depth + consuming — what admission gates on."""
+        with self._cv:
+            return self._tickets + len(self._slots) + self._consuming
+
+    def admit(self, timeout: Optional[float] = None) -> bool:
+        """Grant a collect ticket, blocking while the budget is spoken for.
+        ``False`` = closed or timed out (no ticket was taken)."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._cv:
+            while (not self._closed
+                   and (self._tickets + len(self._slots) + self._consuming
+                        > self.staleness_budget)):
+                remaining = (None if deadline is None
+                             else deadline - time.monotonic())
+                if remaining is not None and remaining <= 0:
+                    return False
+                self._cv.wait(remaining)
+            if self._closed:
+                return False
+            self._tickets += 1
+            self.admits += 1
+            return True
+
+    def cancel_ticket(self) -> None:
+        """Return an unused ticket (producer aborted between admit and put,
+        or the learner reclaims a silently-dead worker's ticket)."""
+        with self._cv:
+            if self._tickets > 0:
+                self._tickets -= 1
+                self._cv.notify_all()
+
+    def mark_consumed(self) -> None:
+        """Learner-side: the block taken by the last :meth:`get` has been
+        trained on AND the resulting params published — it no longer counts
+        against the staleness budget."""
+        with self._cv:
+            if self._consuming > 0:
+                self._consuming -= 1
+                self._cv.notify_all()
+
+    def _on_put_locked(self) -> None:
+        if self._tickets > 0:
+            self._tickets -= 1
+
+    def _on_get_locked(self) -> None:
+        self._consuming += 1
+
+
 class ParamPublisher:
     """Versioned device-to-device param broadcast, learner -> actor submesh.
 
@@ -172,13 +303,26 @@ class ParamPublisher:
     actor the latest (params, version) pair.  The publish blocks until the
     copy lands so the learner's next (donating) update can never invalidate
     buffers a copy still reads.
+
+    ``actor_mesh`` may be one mesh (PR 13 single-worker shape, or None for
+    mesh-free test use) or a LIST of per-worker meshes
+    (``carve_actor_worker_meshes``): publish then places one copy per slice
+    and ``snapshot(worker)`` hands each worker the copy on its own devices.
+    Every slice is placed under one version bump — workers never observe
+    torn versions.
     """
 
     def __init__(self, actor_mesh=None, param_specs=None):
-        self._mesh = actor_mesh      # None: single-device / test use
+        if actor_mesh is None:
+            meshes = [None]          # single-device / test use
+        elif isinstance(actor_mesh, (list, tuple)):
+            meshes = list(actor_mesh) if actor_mesh else [None]
+        else:
+            meshes = [actor_mesh]
+        self._meshes = meshes
         self._specs = param_specs
         self._lock = threading.Lock()
-        self._params = None
+        self._params: Optional[list] = None
         self._version = 0
 
     @property
@@ -191,23 +335,31 @@ class ParamPublisher:
 
         if _chaos.ACTIVE is not None:
             _chaos.ACTIVE.on_param_publish()
-        if self._mesh is not None:
-            from mat_dcml_tpu.parallel.sharding import place_params
+        placed = []
+        for mesh in self._meshes:
+            if mesh is not None:
+                from mat_dcml_tpu.parallel.sharding import place_params
 
-            placed = place_params(params, self._mesh, self._specs)
-            jax.block_until_ready(placed)
-        else:
-            placed = params
+                copy = place_params(params, mesh, self._specs)
+                jax.block_until_ready(copy)
+            else:
+                copy = params
+            placed.append(copy)
         with self._lock:
             self._version += 1
             self._params = placed
             return self._version
 
-    def snapshot(self):
-        """Latest ``(params, version)`` — what the next actor iteration
-        collects under."""
+    def snapshot(self, worker: int = 0):
+        """Latest ``(params, version)`` for ``worker``'s submesh slice —
+        what that worker's next iteration collects under."""
         with self._lock:
-            return self._params, self._version
+            if self._params is None:
+                return None, self._version
+            # a publisher built with fewer meshes than workers (single shared
+            # actor mesh) hands everyone the one copy
+            idx = worker if worker < len(self._params) else 0
+            return self._params[idx], self._version
 
 
 class ActorWorker(threading.Thread):
@@ -215,16 +367,24 @@ class ActorWorker(threading.Thread):
 
     Owns a PRIVATE :class:`Telemetry` registry (jit instrumentation is not
     thread-safe against the learner's flushes) guarded by ``tel_lock``; the
-    learner merges it into the metrics record under the ``async_actor_``
-    prefix.  ``latest_rollout_state`` always references the newest completed
-    carry — what a graceful stop packs after :meth:`request_stop` joins the
-    thread at an iteration boundary.
+    learner merges every worker's registry through ``TelemetryAggregator``
+    into the metrics record under the ``async_actor_`` prefix, plus
+    per-worker ``async_actor_w<id>_`` labelled keys.  ``latest_rollout_state``
+    always references the newest completed carry — what a graceful stop packs
+    after :meth:`request_stop` joins the thread at an iteration boundary.
+
+    When ``queue`` is a :class:`TrajectoryStore`, each iteration first takes
+    an admission ticket (the staleness-budget gate); against a plain
+    :class:`TrajectoryQueue` the PR 13 double-buffering throttle is kept for
+    back-compat.  ``holding_ticket`` is the learner-readable flag that lets
+    the restart path reclaim a silently-dead worker's ticket.
     """
 
     def __init__(self, collect_fn, publisher: ParamPublisher,
                  queue: TrajectoryQueue, rollout_state, learner_mesh,
-                 telemetry: Optional[Telemetry] = None, log=print):
-        super().__init__(name="async-actor", daemon=True)
+                 telemetry: Optional[Telemetry] = None, log=print,
+                 worker_id: int = 0):
+        super().__init__(name=f"async-actor-w{worker_id}", daemon=True)
         self.collect_fn = collect_fn
         self.publisher = publisher
         self.queue = queue
@@ -232,9 +392,11 @@ class ActorWorker(threading.Thread):
         self.telemetry = telemetry if telemetry is not None else Telemetry()
         self.tel_lock = threading.Lock()
         self.log = log
+        self.worker_id = int(worker_id)
         self.latest_rollout_state = rollout_state
         self.iterations = 0
         self.error: Optional[BaseException] = None
+        self.holding_ticket = False
         # NOT named _stop: threading.Thread has an internal _stop()
         # method that the interpreter calls on thread teardown
         self._stop_requested = threading.Event()
@@ -243,6 +405,12 @@ class ActorWorker(threading.Thread):
         """Ask the actor to exit at its next iteration boundary (the enqueue
         retry loop polls this, so a stop never deadlocks on a full queue)."""
         self._stop_requested.set()
+
+    @property
+    def stop_requested(self) -> bool:
+        """True once a stop was asked for — the learner's liveness check
+        uses this to tell an intentionally-quiesced worker from a dead one."""
+        return self._stop_requested.is_set()
 
     def run(self) -> None:
         import jax
@@ -254,24 +422,41 @@ class ActorWorker(threading.Thread):
 
         rs = self.latest_rollout_state
         last_version = -1
+        admit = getattr(self.queue, "admit", None)
         try:
             while not self._stop_requested.is_set():
                 if _chaos.ACTIVE is not None:
-                    _chaos.ACTIVE.on_actor_iteration(self.iterations + 1)
-                # double-buffering throttle: once a completed block is already
-                # waiting, collect at most ONE more per published version.  A
-                # fast actor otherwise laps the learner and its queued blocks
-                # go >1 version stale; with the throttle each block is
-                # consumed at its own version or the next one (steady-state
-                # staleness <= 1 learner step, pinned in tests), while a slow
-                # actor never hits the gate and overlap is unchanged.
-                while (not self._stop_requested.is_set()
-                       and self.queue.depth > 0
-                       and self.publisher.version <= last_version):
-                    time.sleep(0.001)
+                    _chaos.ACTIVE.on_actor_iteration(
+                        self.iterations + 1, worker=f"w{self.worker_id}")
+                if admit is not None:
+                    # staleness-budget admission: block until collecting one
+                    # more cannot push any consumed block past B versions
+                    # stale (see TrajectoryStore).  Short timeouts keep the
+                    # stop request responsive.
+                    t_admit = time.perf_counter()
+                    while (not self._stop_requested.is_set()
+                           and not self.holding_ticket):
+                        self.holding_ticket = admit(timeout=0.05)
+                        if self.queue.closed:
+                            break
+                    if not self.holding_ticket:
+                        break
+                    with self.tel_lock:
+                        self.telemetry.hist(
+                            "admit_wait_ms",
+                            (time.perf_counter() - t_admit) * 1e3)
+                else:
+                    # double-buffering throttle (plain TrajectoryQueue
+                    # back-compat): once a completed block is already
+                    # waiting, collect at most ONE more per published
+                    # version — steady-state staleness <= 1 learner step.
+                    while (not self._stop_requested.is_set()
+                           and self.queue.depth > 0
+                           and self.publisher.version <= last_version):
+                        time.sleep(0.001)
                 if self._stop_requested.is_set():
                     break
-                params, version = self.publisher.snapshot()
+                params, version = self.publisher.snapshot(self.worker_id)
                 last_version = version
                 t0 = time.perf_counter()
                 with self.tel_lock:
@@ -296,17 +481,32 @@ class ActorWorker(threading.Thread):
                     actor_iter=self.iterations,
                     t_start=t0,
                     t_end=t1,
+                    worker_id=self.worker_id,
                 )
                 placed = False
-                while not placed and not self._stop_requested.is_set():
+                while (not placed and not self._stop_requested.is_set()
+                       and not self.queue.closed):
                     placed = self.queue.put(block, timeout=0.05)
+                if placed:
+                    # a successful put consumed the admission ticket
+                    # atomically (TrajectoryStore._on_put_locked)
+                    self.holding_ticket = False
+            if self.holding_ticket and admit is not None:
+                # stopped between admit and put: hand the slot back so a
+                # graceful stop never strands budget capacity
+                self.queue.cancel_ticket()
+                self.holding_ticket = False
         except BaseException as e:      # surface to the learner, don't die
             if _chaos.is_silent_death(e):
                 # injected pathological mode: die WITHOUT recording the error
                 # or closing the queue — the learner's liveness check (not
-                # this handler) must notice and restart us
+                # this handler) must notice, restart us, and reclaim any
+                # ticket we died holding (holding_ticket stays set)
                 self.log(f"[async] actor thread dying silently ({e!r})")
                 return
+            if self.holding_ticket and admit is not None:
+                self.queue.cancel_ticket()
+                self.holding_ticket = False
             self.error = e
             self.log(f"[async] actor thread failed: {e!r}")
             self.queue.close()

@@ -1190,17 +1190,26 @@ class BaseRunner:
 
     def _train_loop_async(self, episodes, train_state, rollout_state, key):
         """--async_actors: overlap collect and train on disjoint submeshes
-        (training/async_loop.py; Podracer sebulba).  The actor THREAD runs the
-        jitted collector continuously on the actor submesh and enqueues
-        trajectory blocks; this method IS the learner program and stays on the
-        main thread (signal handlers, checkpoint writes).  One consumed block
-        = one episode, so episode accounting, cadences, and resume counters
-        match the synchronous loops.
+        (training/async_loop.py; Podracer sebulba).  N actor THREADS
+        (``--async_actor_workers``) each run a jitted collector continuously
+        on their carved slice of the actor submesh and feed one shared
+        :class:`TrajectoryStore`; this method IS the learner program and
+        stays on the main thread (signal handlers, checkpoint writes).  One
+        consumed block = one episode, so episode accounting, cadences, and
+        resume counters match the synchronous loops.
 
-        Not bit-exact with the synchronous loop (1-step-lagged PPO, separate
+        Staleness: the store's admission control bounds the param-version lag
+        of every consumed block at ``--staleness_budget`` (1 = PR 13's
+        double-buffered overlap); with a budget > 1 the V-trace-style
+        truncated-IS correction (``--off_policy_correction``,
+        training/off_policy.py) reweights each stale block's PPO update.
+
+        Not bit-exact with the synchronous loop (lagged PPO, separate
         actor/learner PRNG consumption); the graceful-stop carry is coherent —
-        learner state at a step boundary + the actor's last completed rollout
-        state — but a resumed run replays any unconsumed actor work.
+        learner state at a step boundary + worker 0's last completed rollout
+        state — but a resumed run replays any unconsumed actor work (workers
+        1..N-1 re-derive their decorrelated carries from worker 0's on
+        resume).
         """
         run = self.run_cfg
         tel = self.telemetry
@@ -1214,20 +1223,28 @@ class BaseRunner:
             put_replicated,
             put_sharded_state,
         )
-        from mat_dcml_tpu.parallel.mesh import build_actor_learner_meshes
+        from mat_dcml_tpu.parallel.mesh import (
+            build_actor_learner_meshes,
+            carve_actor_worker_meshes,
+        )
+        from mat_dcml_tpu.telemetry.aggregate import TelemetryAggregator
         from mat_dcml_tpu.training.async_loop import (
             ActorDeadError,
             ActorWorker,
             ParamPublisher,
-            TrajectoryQueue,
+            TrajectoryStore,
         )
+        from mat_dcml_tpu.training import off_policy as off_policy_mod
         from jax.sharding import NamedSharding, PartitionSpec as P
 
+        n_workers = int(getattr(run, "async_actor_workers", 1))
+        budget = int(getattr(run, "staleness_budget", 1))
         actor_mesh, learner_mesh = build_actor_learner_meshes(
             int(getattr(run, "actor_devices", 0)),
             int(getattr(run, "learner_devices", 0)),
         )
-        for side, m in (("actor", actor_mesh), ("learner", learner_mesh)):
+        worker_meshes = carve_actor_worker_meshes(actor_mesh, n_workers)
+        for side, m in (("actor", worker_meshes[0]), ("learner", learner_mesh)):
             n_data = dict(m.shape)["data"]
             if E % n_data:
                 raise ValueError(
@@ -1238,14 +1255,29 @@ class BaseRunner:
         # the learner owns train_state + PRNG chain; actors own the env state
         train_state = put_replicated(train_state, learner_mesh)
         key = jax.device_put(key, NamedSharding(learner_mesh, P()))
-        rollout_state = put_sharded_state(rollout_state, actor_mesh)
+        # worker 0 keeps the provided carry (PR 13 parity + what a graceful
+        # stop packs); workers 1..N-1 decorrelate by folding their index into
+        # the rollout PRNG, so N slices explore N distinct trajectories
+        rollout_states = []
+        for i, wm in enumerate(worker_meshes):
+            rs_i = rollout_state
+            if i > 0 and getattr(rs_i, "rng", None) is not None:
+                rs_i = rs_i._replace(rng=jax.random.fold_in(rs_i.rng, i))
+            rollout_states.append(put_sharded_state(rs_i, wm))
 
-        # the actor program gets a PRIVATE telemetry registry (the shared one
-        # is not thread-safe); merged into records as async_actor_* below
-        actor_tel = Telemetry()
-        collect_jit = instrumented_jit(
-            self.collector.collect, "collect", actor_tel, self.log
-        )
+        # every actor program gets a PRIVATE telemetry registry (jit
+        # instrumentation is not thread-safe across threads); the aggregator
+        # is the merged read-side view (async_actor_* in records), with each
+        # registry also flushed under its own async_actor_w<i>_ label
+        actor_agg = TelemetryAggregator()
+        actor_tels, collect_jits = [], []
+        for i in range(n_workers):
+            t_i = Telemetry()
+            actor_agg.add_source(f"w{i}", t_i)
+            actor_tels.append(t_i)
+            collect_jits.append(instrumented_jit(
+                self.collector.collect, "collect", t_i, self.log
+            ))
         # donation is safe against the publisher: publish() blocks until the
         # params copy lands on the actor submesh, so the next donating update
         # can never invalidate buffers a device-to-device copy still reads
@@ -1253,50 +1285,86 @@ class BaseRunner:
             self.trainer.train, "train", tel, self.log, donate_argnums=(0,),
             count_collectives=dict(learner_mesh.shape)["data"] > 1,
         )
-        publisher = ParamPublisher(actor_mesh)
+        publisher = ParamPublisher(worker_meshes)
         publisher.publish(train_state.params)
-        queue = TrajectoryQueue(max(1, int(getattr(run, "async_queue_depth", 2))))
-        worker = ActorWorker(collect_jit, publisher, queue, rollout_state,
-                             learner_mesh, telemetry=actor_tel, log=self.log)
-        # importance-correction hook stub (async_loop.ImportanceCorrection):
-        # runners/tests may set self.importance_correction = hook; identity
-        # (None) accepts the steady-state 1-step lag as-is
+        # ring capacity never throttles the staleness budget: admission is
+        # the real gate, the ring just holds what admission granted
+        store = TrajectoryStore(
+            max(1, int(getattr(run, "async_queue_depth", 2)), budget),
+            staleness_budget=budget,
+        )
+
+        def make_worker(i, rs):
+            return ActorWorker(collect_jits[i], publisher, store, rs,
+                               learner_mesh, telemetry=actor_tels[i],
+                               log=self.log, worker_id=i)
+
+        workers = [make_worker(i, rollout_states[i])
+                   for i in range(n_workers)]
+        # importance correction (async_loop.ImportanceCorrection): an
+        # explicitly set self.importance_correction wins; otherwise
+        # --off_policy_correction decides ("auto" = V-trace iff budget > 1).
+        # The params_fn closure reads this scope's train_state binding at
+        # call time, so the hook always scores under the newest params.
         correction = getattr(self, "importance_correction", None)
+        vtrace_on = off_policy_mod.resolve_correction_mode(
+            str(getattr(run, "off_policy_correction", "auto")), budget)
+        if correction is None and vtrace_on:
+            tr_cfg = getattr(self.trainer, "cfg", None)
+            factory = (off_policy_mod.make_vtrace_correction if self.is_mat
+                       else off_policy_mod.make_ac_vtrace_correction)
+            correction = factory(
+                self.policy, lambda: train_state.params,
+                rho_bar=float(getattr(tr_cfg, "vtrace_rho_bar", 1.0)),
+                c_bar=float(getattr(tr_cfg, "vtrace_c_bar", 1.0)),
+                telemetry=tel,
+            )
         tel.gauge("async_actor_devices", float(actor_mesh.size))
         tel.gauge("async_learner_devices", float(learner_mesh.size))
-        self.log(f"[async] actor submesh {actor_mesh.size}d / learner submesh "
-                 f"{learner_mesh.size}d, queue depth {queue.capacity}")
+        tel.gauge("async_actor_workers", float(n_workers))
+        tel.gauge("store_staleness_budget", float(budget))
+        self.log(f"[async] actor submesh {actor_mesh.size}d carved into "
+                 f"{n_workers} worker(s) / learner submesh "
+                 f"{learner_mesh.size}d, store capacity {store.capacity}, "
+                 f"staleness budget {budget}, correction "
+                 f"{'vtrace' if (vtrace_on or correction is not None) else 'none'}")
 
         def quiesce():
-            """Graceful-stop half of the async contract: stop the actor at an
-            iteration boundary, discard in-flight blocks (a resumed run
-            replays them), hand back the last COMPLETED rollout state."""
-            worker.request_stop()
-            queue.close()
-            worker.join(timeout=60.0)
-            discarded = len(queue.drain())
-            self.log(f"[async] stop: actor joined after {worker.iterations} "
-                     f"iteration(s); {discarded} queued block(s) discarded")
-            return worker.latest_rollout_state
+            """Graceful-stop half of the async contract: stop every worker at
+            an iteration boundary, discard in-flight blocks (a resumed run
+            replays them), hand back worker 0's last COMPLETED rollout
+            state."""
+            for w in workers:
+                w.request_stop()
+            store.close()
+            for w in workers:
+                w.join(timeout=60.0)
+            discarded = len(store.drain())
+            iters = ", ".join(f"w{w.worker_id}:{w.iterations}"
+                              for w in workers)
+            self.log(f"[async] stop: {len(workers)} worker(s) joined "
+                     f"({iters}); {discarded} queued block(s) discarded")
+            return workers[0].latest_rollout_state
 
         first = self.start_episode
         agg_done = agg_rew = agg_delay = agg_pay = 0.0
         has_info = False
-        actor_restarts = 0
+        restarts = [0] * n_workers
         max_restarts = max(0, int(getattr(run, "async_actor_max_restarts", 2)))
         tel.start_interval()
         start = time.time()
-        worker.start()
+        for w in workers:
+            w.start()
         try:
             for episode in range(first, episodes):
                 self._graceful_stop_check(episode, train_state,
-                                          worker.latest_rollout_state, key,
-                                          before_pack=quiesce)
+                                          workers[0].latest_rollout_state,
+                                          key, before_pack=quiesce)
                 # crash-path snapshot: learner-boundary train_state/key + the
                 # actor's newest completed carry (rebind-safe: the actor swaps
                 # the reference, never mutates a published tree)
                 self.watchdog.arm(episode, train_state,
-                                  worker.latest_rollout_state, key)
+                                  workers[0].latest_rollout_state, key)
                 self.profile_window.tick()
                 sampled = run.telemetry_interval > 0 and (
                     (episode - first) % run.telemetry_interval == 0
@@ -1304,49 +1372,66 @@ class BaseRunner:
                 trace = (self.tracer.start_trace("training", root="learner_step")
                          if self.tracer is not None else None)
                 t_wait = time.perf_counter()
-                block = queue.get(timeout=0.25)
-                while block is None:
-                    if worker.error is not None:
-                        raise DispatchFailedError(
-                            f"actor program failed: {worker.error!r}"
-                        ) from worker.error
-                    if not worker.is_alive():
-                        # liveness check: a thread that died WITHOUT recording
-                        # an error (crashed C extension, injected chaos) would
-                        # otherwise leave this loop polling an open, forever-
-                        # empty queue.  Restart from the last published params
-                        # + the dead worker's last completed rollout state, up
-                        # to the configured budget.
-                        actor_restarts += 1
-                        if actor_restarts > max_restarts:
+
+                def check_workers():
+                    # per-worker liveness: a thread that died WITHOUT
+                    # recording an error (crashed C extension, injected
+                    # actor_crash chaos) would otherwise go unnoticed — with
+                    # a live sibling still feeding the store the learner
+                    # never starves, so this runs every consume, not just
+                    # when the store runs dry.  Restart from the last
+                    # published params + the dead worker's last completed
+                    # rollout state, up to the per-worker budget; reclaim
+                    # any admission ticket it died holding so the staleness
+                    # budget never leaks.
+                    for w in workers:
+                        if w.error is not None:
+                            raise DispatchFailedError(
+                                f"actor program failed: {w.error!r}"
+                            ) from w.error
+                    for i, w in enumerate(workers):
+                        if w.is_alive() or w.stop_requested:
+                            continue
+                        restarts[i] += 1
+                        if restarts[i] > max_restarts:
                             raise ActorDeadError(
-                                f"actor thread died silently "
-                                f"{actor_restarts} time(s) — restart budget "
+                                f"actor worker w{i} died silently "
+                                f"{restarts[i]} time(s) — restart budget "
                                 f"({max_restarts}) spent; last completed "
-                                f"iteration {worker.iterations}")
-                        self.log(f"[async] actor thread dead with no recorded "
-                                 f"error after iteration {worker.iterations}; "
-                                 f"restarting from last published params "
-                                 f"({actor_restarts}/{max_restarts})")
+                                f"iteration {w.iterations}")
+                        self.log(f"[async] actor worker w{i} dead with no "
+                                 f"recorded error after iteration "
+                                 f"{w.iterations}; restarting from last "
+                                 f"published params "
+                                 f"({restarts[i]}/{max_restarts})")
                         tel.count("async_actor_restarts")
-                        worker = ActorWorker(
-                            collect_jit, publisher, queue,
-                            worker.latest_rollout_state, learner_mesh,
-                            telemetry=actor_tel, log=self.log)
-                        worker.start()
+                        if getattr(w, "holding_ticket", False):
+                            store.cancel_ticket()
+                        workers[i] = make_worker(i, w.latest_rollout_state)
+                        workers[i].start()
+
+                check_workers()
+                block = store.get(timeout=0.25)
+                while block is None:
+                    check_workers()
                     self._graceful_stop_check(episode, train_state,
-                                              worker.latest_rollout_state,
+                                              workers[0].latest_rollout_state,
                                               key, before_pack=quiesce)
-                    block = queue.get(timeout=0.25)
+                    block = store.get(timeout=0.25)
                 t_got = time.perf_counter()
                 # staleness: learner steps published since this block's params
                 lag = publisher.version - block.param_version
                 tel.hist("staleness_learner_steps", float(lag))
                 tel.gauge("staleness_param_version", float(publisher.version))
                 tel.hist("async_queue_wait_ms", (t_got - t_wait) * 1e3)
-                tel.gauge("async_queue_depth", float(queue.depth))
+                tel.gauge("async_queue_depth", float(store.depth))
+                tel.gauge("store_depth", float(store.depth))
+                tel.gauge("store_tickets", float(store.tickets))
                 traj = block.traj
-                if correction is not None and lag > 0:
+                if correction is not None:
+                    # applied at lag 0 too (numerical identity) so the jitted
+                    # update's pytree structure never flips mid-run — see
+                    # off_policy.py docstring
                     traj = correction(traj, lag)
                 key, k_train = jax.random.split(key)
                 t_train = time.perf_counter()
@@ -1360,6 +1445,10 @@ class BaseRunner:
                 jax.block_until_ready(train_state)
                 t_end = time.perf_counter()
                 publisher.publish(train_state.params)
+                # the consumed block stops counting against the staleness
+                # budget only now — AFTER its update was published — so a
+                # block admitted during the train window still lands within B
+                store.mark_consumed()
                 if trace is not None:
                     trace.add_span("actor_iter", block.t_start, block.t_end,
                                    actor_iter=block.actor_iter,
@@ -1456,14 +1545,42 @@ class BaseRunner:
                     for k, v in device_memory_gauges().items():
                         tel.gauge(k, v)
                     tel.gauge("host_rss_bytes", host_rss_bytes())
-                    tel.gauge("async_queue_drops", float(queue.drops))
-                    tel.gauge("async_queue_max_depth", float(queue.max_depth))
-                    tel.gauge("async_actor_iters", float(worker.iterations))
+                    tel.gauge("async_queue_drops", float(store.drops))
+                    tel.gauge("async_queue_max_depth", float(store.max_depth))
+                    tel.gauge("async_actor_iters",
+                              float(sum(w.iterations for w in workers)))
+                    tel.gauge("async_actor_workers", float(n_workers))
+                    tel.gauge("store_depth", float(store.depth))
+                    tel.gauge("store_max_depth", float(store.max_depth))
+                    tel.gauge("store_tickets", float(store.tickets))
+                    tel.gauge("store_puts", float(store.puts))
+                    tel.gauge("store_gets", float(store.gets))
+                    tel.gauge("store_drops", float(store.drops))
+                    tel.gauge("store_workers", float(n_workers))
+                    tel.gauge("store_staleness_budget", float(budget))
+                    # per-worker throughput, learner-side (also what the obs
+                    # sidecar's /metrics serves per actor)
+                    for w in workers:
+                        wid = w.worker_id
+                        tel.gauge(f"async_actor_w{wid}_iters",
+                                  float(w.iterations))
+                        tel.gauge(
+                            f"async_actor_w{wid}_env_steps_per_sec",
+                            w.iterations * T * E / max(elapsed, 1e-9))
                     record.update(tel.flush())
-                    with worker.tel_lock:
-                        actor_rec = worker.telemetry.flush()
+                    # merged actor view (counters/gauges summed, histograms
+                    # merged exactly across the N labelled registries) keeps
+                    # the PR 13 async_actor_* keys; each worker's registry is
+                    # ALSO flushed under its own async_actor_w<i>_ label so N
+                    # workers never silently overwrite each other
                     record.update({f"async_actor_{k}": v
-                                   for k, v in actor_rec.items()})
+                                   for k, v in actor_agg.snapshot().items()})
+                    for w in workers:
+                        with w.tel_lock:
+                            actor_rec = w.telemetry.flush()
+                        record.update(
+                            {f"async_actor_w{w.worker_id}_{k}": v
+                             for k, v in actor_rec.items()})
                     self._extra_metrics(record)
                     self._log_record(record)
 
@@ -1484,17 +1601,19 @@ class BaseRunner:
                     self.writer.write(eval_info, step=total_steps)
                     self.log(f"eval ep {episode}: {eval_info}")
         finally:
-            # every exit path — normal, preempted, crash — must stop the actor
-            # thread and release queue waiters before the interpreter tears
-            # down jit machinery under the daemon thread
-            worker.request_stop()
-            queue.close()
-            worker.join(timeout=60.0)
-            leftover = len(queue.drain())
+            # every exit path — normal, preempted, crash — must stop every
+            # actor thread and release store waiters before the interpreter
+            # tears down jit machinery under the daemon threads
+            for w in workers:
+                w.request_stop()
+            store.close()
+            for w in workers:
+                w.join(timeout=60.0)
+            leftover = len(store.drain())
             if leftover:
                 self.log(f"[async] run end: {leftover} unconsumed block(s) "
                          f"discarded")
-        return train_state, worker.latest_rollout_state
+        return train_state, workers[0].latest_rollout_state
 
     # ------------------------------------------------------------ resilience
 

@@ -102,6 +102,10 @@ class MAPPOConfig:
     # per epoch + dynamic_slice minibatches; byte-identical minibatch content
     # under the same permutation — tests/test_stream_equivalence.py).
     minibatch_layout: str = "gather"
+    # Truncated-IS clip thresholds for async off-policy blocks carrying
+    # ``is_weights`` (see ppo.PPOConfig.vtrace_rho_bar / vtrace_c_bar).
+    vtrace_rho_bar: float = 1.0
+    vtrace_c_bar: float = 1.0
 
 
 class Bootstrap(NamedTuple):
@@ -191,7 +195,7 @@ class MAPPOTrainer:
             return value_norm_denormalize(vn, x)
         return x
 
-    def _value_loss(self, values, old_values, ret_norm, active):
+    def _value_loss(self, values, old_values, ret_norm, active, is_w=None):
         cfg = self.cfg
         v_clipped = old_values + jnp.clip(values - old_values, -cfg.clip_param, cfg.clip_param)
         err_clipped = ret_norm - v_clipped
@@ -201,11 +205,14 @@ class MAPPOTrainer:
         else:
             vl_c, vl_o = 0.5 * err_clipped**2, 0.5 * err_orig**2
         vl = jnp.maximum(vl_o, vl_c) if cfg.use_clipped_value_loss else vl_o
+        if is_w is not None:
+            # async off-policy correction: c-bar-truncated IS weight
+            vl = vl * jnp.minimum(is_w, cfg.vtrace_c_bar)
         if cfg.use_value_active_masks:
             return (vl * active).sum() / active.sum()
         return vl.mean()
 
-    def _policy_loss(self, logp, old_logp, adv, active):
+    def _policy_loss(self, logp, old_logp, adv, active, is_w=None):
         cfg = self.cfg
         delta = logp - old_logp
         if cfg.importance_prod:
@@ -215,6 +222,9 @@ class MAPPOTrainer:
         surr1 = ratio * adv
         surr2 = jnp.clip(ratio, 1.0 - cfg.clip_param, 1.0 + cfg.clip_param) * adv
         surr = jnp.minimum(surr1, surr2).sum(-1, keepdims=True)
+        if is_w is not None:
+            # async off-policy correction: rho-bar-truncated IS weight
+            surr = surr * jnp.minimum(is_w, cfg.vtrace_rho_bar)
         if cfg.use_policy_active_masks:
             return -(surr * active).sum() / active.sum(), ratio
         return -surr.mean(), ratio
@@ -316,6 +326,8 @@ class MAPPOTrainer:
             "adv": adv.reshape(n_rows, -1),
             "returns": returns.reshape(n_rows, -1),
         }
+        if traj.is_weights is not None:
+            flat["is_w"] = traj.is_weights.reshape(n_rows, -1)
 
         def ppo_update(carry, b):
             params, actor_opt, critic_opt, value_norm = carry
@@ -326,8 +338,14 @@ class MAPPOTrainer:
                     p, b["cent_obs"], b["obs"], b["actor_h"], b["critic_h"],
                     b["actions"], b["masks"], b["avail"], b["active"],
                 )
-                policy_loss, ratio = self._policy_loss(logp, b["log_probs"], b["adv"], b["active"])
-                value_loss = self._value_loss(values, b["values"], ret_norm, b["active"])
+                policy_loss, ratio = self._policy_loss(
+                    logp, b["log_probs"], b["adv"], b["active"],
+                    is_w=b.get("is_w"),
+                )
+                value_loss = self._value_loss(
+                    values, b["values"], ret_norm, b["active"],
+                    is_w=b.get("is_w"),
+                )
                 total = policy_loss - ent * cfg.entropy_coef + value_loss * cfg.value_loss_coef
                 return total, (value_loss, policy_loss, ent, ratio)
 
@@ -389,6 +407,8 @@ class MAPPOTrainer:
             "actor_h0": chunk_starts(traj.actor_h),
             "critic_h0": chunk_starts(traj.critic_h),
         }
+        if traj.is_weights is not None:
+            data["is_w"] = to_chunks(traj.is_weights)
 
         def seq(x):
             # (mb, L, ...) -> (L, mb, ...)
@@ -403,10 +423,15 @@ class MAPPOTrainer:
                     p, seq(b["cent_obs"]), seq(b["obs"]), b["actor_h0"], b["critic_h0"],
                     seq(b["actions"]), seq(b["masks"]), seq(b["avail"]), seq(b["active"]),
                 )
+                is_w = b.get("is_w")
                 policy_loss, ratio = self._policy_loss(
-                    logp, seq(b["log_probs"]), seq(b["adv"]), seq(b["active"])
+                    logp, seq(b["log_probs"]), seq(b["adv"]), seq(b["active"]),
+                    is_w=None if is_w is None else seq(is_w),
                 )
-                value_loss = self._value_loss(values, seq(b["values"]), seq(ret_norm), seq(b["active"]))
+                value_loss = self._value_loss(
+                    values, seq(b["values"]), seq(ret_norm), seq(b["active"]),
+                    is_w=None if is_w is None else seq(is_w),
+                )
                 total = policy_loss - ent * cfg.entropy_coef + value_loss * cfg.value_loss_coef
                 return total, (value_loss, policy_loss, ent, ratio)
 

@@ -1,0 +1,160 @@
+"""V-trace-style truncated importance-sampling correction for stale async
+trajectory blocks (arXiv:1802.01561; the seam async_loop's
+``ImportanceCorrection`` hook contract reserves).
+
+With ``--staleness_budget B > 1`` the learner consumes blocks collected under
+params up to B publishes old: the stored ``traj.log_probs`` are the BEHAVIOR
+policy's, while the PPO update's ratio is taken against them as if they were
+current.  The correction re-evaluates the trajectory's actions under the
+CURRENT learner params (the target policy) and attaches the raw per-timestep
+importance ratio
+
+    rho_t = pi_target(a_t | s_t) / pi_behavior(a_t | s_t)
+          = exp(sum_dims(logp_target - logp_behavior))
+
+as ``traj.is_weights``; the PPO/MAPPO loss truncates it per V-trace —
+``min(rho, rho_bar)`` on the policy surrogate, ``min(rho, c_bar)`` on the
+value loss (``PPOConfig.vtrace_rho_bar`` / ``vtrace_c_bar``).  Keeping the
+RAW ratio on the trajectory and clipping inside the loss keeps the hook free
+of trainer hyperparameters and makes the attached weights reusable by both
+trainer families.
+
+Structure stability: the hook is applied by the learner to EVERY consumed
+block while a correction is enabled — at ``lag == 0`` the target and
+behavior params coincide and rho == 1 exactly (a numerical identity), but
+the ``is_weights`` leaf is always present, so the jitted update's input
+pytree structure never flips mid-run and the zero-steady-state-recompile
+guarantee survives.  When the correction is disabled the leaf is always
+None.  The hook runs on the learner thread BEFORE the (donating) train step
+reads the same params, which device-stream ordering serializes — no use-
+after-donate.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from mat_dcml_tpu.telemetry import Telemetry
+
+
+def truncated_is_weights(logp_target: jax.Array, logp_behavior: jax.Array,
+                         clip: Optional[float] = None) -> jax.Array:
+    """Raw (or ``clip``-truncated) per-timestep joint importance ratio.
+
+    ``logp_*`` are per-action-dim log-probs ``(..., act_prob)``; the joint
+    ratio is the product over dims = ``exp(sum(delta))``, shape ``(..., 1)``.
+    Pinned against a hand-computed example in tests/test_off_policy.py.
+    """
+    rho = jnp.exp((logp_target - logp_behavior).sum(-1, keepdims=True))
+    if clip is not None:
+        rho = jnp.minimum(rho, clip)
+    return rho
+
+
+def _rho_stats(rho: jax.Array, rho_bar: float, c_bar: float):
+    """Scalar summaries for the ``offpolicy_`` gauge family."""
+    return {
+        "rho_mean": rho.mean(),
+        "rho_max": rho.max(),
+        "rho_clip_fraction": (rho > rho_bar).mean(),
+        "c_clip_fraction": (rho > c_bar).mean(),
+    }
+
+
+def make_vtrace_correction(policy, params_fn: Callable[[], dict],
+                           rho_bar: float = 1.0, c_bar: float = 1.0,
+                           telemetry: Optional[Telemetry] = None):
+    """Build the ``hook(traj, lag) -> traj`` for the MAT family.
+
+    ``policy`` is the TransformerPolicy whose ``evaluate_actions`` scores the
+    stored actions; ``params_fn`` returns the CURRENT learner params at call
+    time (a closure over the training loop's ``train_state`` rebinds — the
+    hook always sees the newest published version).  ``rho_bar`` / ``c_bar``
+    only feed the clip-fraction gauges here; the loss applies the actual
+    truncation.  The scoring program is jitted once and reused — stable
+    shapes mean exactly one compile per run.
+    """
+
+    def _raw_rho(params, share_obs, obs, actions, available_actions,
+                 log_probs):
+        T, E = obs.shape[:2]
+
+        def rows(x):
+            return x.reshape(T * E, *x.shape[2:])
+
+        _, logp, _ = policy.evaluate_actions(
+            params, rows(share_obs), rows(obs), rows(actions),
+            rows(available_actions),
+        )
+        logp = logp.reshape(T, E, *logp.shape[1:])
+        rho = truncated_is_weights(logp, log_probs)
+        return rho, _rho_stats(rho, rho_bar, c_bar)
+
+    score_jit = jax.jit(_raw_rho)
+
+    def hook(traj, lag: int):
+        rho, stats = score_jit(
+            params_fn(), traj.share_obs, traj.obs, traj.actions,
+            traj.available_actions, traj.log_probs,
+        )
+        if telemetry is not None:
+            telemetry.count("offpolicy_applied")
+            telemetry.gauge("offpolicy_lag", float(lag))
+            for k, v in stats.items():
+                telemetry.gauge(f"offpolicy_{k}", float(v))
+        return traj._replace(is_weights=rho)
+
+    return hook
+
+
+def make_ac_vtrace_correction(policy, params_fn: Callable[[], dict],
+                              rho_bar: float = 1.0, c_bar: float = 1.0,
+                              telemetry: Optional[Telemetry] = None):
+    """:func:`make_vtrace_correction` for the actor-critic families
+    (MAPPO/IPPO/HAPPO): scores stored actions through the AC
+    ``evaluate_actions`` (per-row stored hiddens re-run each step, so the
+    per-step log-probs are exact for recurrent policies too)."""
+
+    def _raw_rho(params, traj):
+        T, E = traj.obs.shape[:2]
+
+        def rows(x):
+            return x.reshape(T * E, *x.shape[2:])
+
+        _, logp, _ = policy.evaluate_actions(
+            params, rows(traj.share_obs), rows(traj.obs), rows(traj.actor_h),
+            rows(traj.critic_h), rows(traj.actions), rows(traj.masks[:-1]),
+            rows(traj.available_actions), rows(traj.active_masks[:-1]),
+        )
+        logp = logp.reshape(T, E, *logp.shape[1:])
+        rho = truncated_is_weights(logp, traj.log_probs)
+        return rho, _rho_stats(rho, rho_bar, c_bar)
+
+    score_jit = jax.jit(_raw_rho)
+
+    def hook(traj, lag: int):
+        rho, stats = score_jit(params_fn(), traj)
+        if telemetry is not None:
+            telemetry.count("offpolicy_applied")
+            telemetry.gauge("offpolicy_lag", float(lag))
+            for k, v in stats.items():
+                telemetry.gauge(f"offpolicy_{k}", float(v))
+        return traj._replace(is_weights=rho)
+
+    return hook
+
+
+def resolve_correction_mode(mode: str, staleness_budget: int) -> bool:
+    """``--off_policy_correction`` -> is V-trace on?  "auto" enables it
+    exactly when stale blocks are admissible (B > 1), so B = 1 runs stay
+    bit-exact with the PR 13 uncorrected path."""
+    if mode not in ("auto", "vtrace", "none"):
+        raise ValueError(
+            f"--off_policy_correction must be auto|vtrace|none, got {mode!r}"
+        )
+    if mode == "auto":
+        return staleness_budget > 1
+    return mode == "vtrace"

@@ -124,6 +124,15 @@ class PPOConfig:
     # values (tests/test_stream_equivalence.py pins bit-exactness).  On CPU
     # (single memory space) it traces as a no-op; HBM relief is a chip claim.
     update_offload: bool = False
+    # ---- off-policy correction (training/off_policy.py) -------------------
+    # Truncation thresholds for the V-trace-style per-timestep importance
+    # weights a stale async trajectory carries in ``traj.is_weights`` (raw
+    # behavior->target ratios; --staleness_budget > 1).  rho-bar clips the
+    # policy-surrogate weight, c-bar the value-loss weight (arXiv:1802.01561
+    # notation; 1.0/1.0 is the paper's recommended setting).  Ignored when
+    # is_weights is absent — the on-policy loss is untouched.
+    vtrace_rho_bar: float = 1.0
+    vtrace_c_bar: float = 1.0
     # MO-MAT scalarization weights, comma-separated floats ("99,1" etc.);
     # empty = equal weights.  Reconstruction of the missing ``momat_trainer``
     # around the surviving ``mo_shared_buffer.py`` per-objective GAE.
@@ -265,7 +274,7 @@ class MATTrainer:
             buf, _ = jax.lax.scan(write, buf0, (jnp.arange(n_chunks), blocks))
             return buf.reshape(n_rows, *x.shape[2:])
 
-        flat = jax.tree.map(flatten_rows, {
+        flat_src = {
             "share_obs": traj.share_obs,
             "obs": traj.obs,
             "available_actions": traj.available_actions,
@@ -273,7 +282,14 @@ class MATTrainer:
             "log_probs": traj.log_probs,
             "values": traj.values,
             "active_masks": traj.active_masks[:-1],
-        })
+        }
+        if traj.is_weights is not None:
+            # raw truncated-IS ratios from the async off-policy correction
+            # (off_policy.make_vtrace_correction); clipped at rho-bar/c-bar
+            # inside loss_fn.  Present on EVERY block of a corrected run so
+            # the jitted update's pytree structure never flips mid-run.
+            flat_src["is_weights"] = traj.is_weights
+        flat = jax.tree.map(flatten_rows, flat_src)
 
         def compute_targets(params, value_norm):
             with named_scope("train/compute_targets"):
@@ -353,6 +369,14 @@ class MATTrainer:
                 surr1 = ratio * adv_b
                 surr2 = jnp.clip(ratio, 1.0 - cfg.clip_param, 1.0 + cfg.clip_param) * adv_b
                 surr = jnp.minimum(surr1, surr2).sum(axis=-1, keepdims=True)
+                if "is_weights" in batch:
+                    # V-trace-style truncated IS: the behavior policy that
+                    # collected this block lags the target by `lag` updates;
+                    # min(rho, rho_bar) reweights the policy gradient toward
+                    # the target policy's state distribution, min(rho, c_bar)
+                    # bounds the value-target correction (arXiv:1802.01561)
+                    surr = surr * jnp.minimum(batch["is_weights"],
+                                              cfg.vtrace_rho_bar)
                 if cfg.use_policy_active_masks:
                     policy_loss = -(surr * active).sum() / active_full_sum
                     entropy = (ent * active).sum() / active_full_sum
@@ -372,6 +396,8 @@ class MATTrainer:
                     vl_clipped = 0.5 * err_clipped**2
                     vl_orig = 0.5 * err_orig**2
                 vl = jnp.maximum(vl_orig, vl_clipped) if cfg.use_clipped_value_loss else vl_orig
+                if "is_weights" in batch:
+                    vl = vl * jnp.minimum(batch["is_weights"], cfg.vtrace_c_bar)
                 if cfg.use_value_active_masks:
                     value_loss = (vl * active).sum() / active_full_sum
                 else:

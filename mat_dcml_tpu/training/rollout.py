@@ -56,6 +56,12 @@ class Trajectory(NamedTuple):
     # step means.  None when the collector predates the carry (hand-built
     # states).
     chunk_stats: Optional[dict] = None
+    # Raw V-trace-style truncated-IS ratios (T, E, A, 1) attached by the
+    # async off-policy correction (training/off_policy.py) when the block
+    # was collected under stale params (--staleness_budget > 1); the PPO
+    # loss clips them at vtrace_rho_bar / vtrace_c_bar.  None everywhere
+    # else — collectors never fill this.
+    is_weights: Optional[jax.Array] = None
 
 
 class RolloutState(NamedTuple):

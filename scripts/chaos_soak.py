@@ -355,13 +355,28 @@ def run_sync_leg(plan: FaultPlan, events_path: Path, out: Path,
     return {"leg": leg, "run_dir": wdir}
 
 
+# The async leg's scale-out shape: 4 collector workers on a 4-device actor
+# submesh (1 device each) + 2 learner devices, staleness budget 2 — wide
+# enough that the targeted actor_crash event (target "w2") kills a worker
+# the learner must restart while its siblings keep the store fed, with
+# admission keeping consumed staleness p95 <= the budget throughout.
+ASYNC_WORKERS = 4
+ASYNC_STALENESS_BUDGET = 2
+
+
 def run_async_leg(events_path: Path, out: Path, episodes: int) -> dict:
     wdir = out / "train_async"
     cmd = _worker_cmd(wdir, episodes, events_path, "train_async",
-                      extra=("--async_actors", 1, "--devices", 2))
-    leg = {"errors": []}
+                      extra=("--async_actors", 1, "--devices", 6,
+                             "--actor_devices", 4, "--learner_devices", 2,
+                             "--async_actor_workers", ASYNC_WORKERS,
+                             "--staleness_budget", ASYNC_STALENESS_BUDGET))
+    leg = {"errors": [], "workers": ASYNC_WORKERS,
+           "staleness_budget": ASYNC_STALENESS_BUDGET}
     try:
-        log(f"[soak] async leg: {episodes} episodes, 2 devices, armed faults")
+        log(f"[soak] async leg: {episodes} episodes, 6 devices "
+            f"(4 actor / 2 learner), {ASYNC_WORKERS} workers, "
+            f"staleness budget {ASYNC_STALENESS_BUDGET}, armed faults")
         rc, outp = _run_to_completion(cmd)
         leg["rc"] = rc
         if rc != 0 or "DONE" not in outp:
@@ -461,6 +476,7 @@ def main(argv=None) -> int:
     if "train_async" in planes:
         res = run_async_leg(events_path, out, args.async_episodes)
         legs["train_async"] = res["leg"]
+        facts["staleness_budget"] = res["leg"].get("staleness_budget", 1)
         records += _read_run_records(res["run_dir"])
         run_dirs.append(res["run_dir"])
     if "serving" in planes:

@@ -151,6 +151,16 @@ KNOWN_PREFIXES = (
     # PPO): per-block lag histogram (staleness_learner_steps_*) and the
     # learner's current published version (staleness_param_version)
     "staleness_",
+    # multi-producer trajectory store (training/async_loop.TrajectoryStore,
+    # --async_actor_workers N): ring occupancy/high-water, outstanding
+    # admission tickets, put/get/drop counters, the worker count, and the
+    # admission bound itself (store_staleness_budget — the invariant checker
+    # reads it so staleness records self-describe their contract)
+    "store_",
+    # off-policy V-trace correction (training/off_policy.py): application
+    # counter, per-block param lag, and truncated-IS ratio summaries
+    # (offpolicy_rho_mean/_rho_max and the rho-bar/c-bar clip fractions)
+    "offpolicy_",
     # chaos fault injection (mat_dcml_tpu/chaos/): armed/fired/injected event
     # counters, the expected-anomaly suppression counter, and the armed flag
     # gauge — plus the typed {"chaos": ...} event records validated separately
@@ -238,6 +248,12 @@ STRICT_FAMILY_PATTERNS = {
     "staleness_": re.compile(
         r"^staleness_(param_version"
         r"|learner_steps(_p50|_p95|_p99|_count|_mean))$"),
+    "store_": re.compile(
+        r"^store_(depth|max_depth|tickets|puts|gets|drops|admits"
+        r"|workers|staleness_budget)$"),
+    "offpolicy_": re.compile(
+        r"^offpolicy_(applied|lag|rho_mean|rho_max"
+        r"|rho_clip_fraction|c_clip_fraction)$"),
     "chaos_": re.compile(
         r"^chaos_(events_armed|events_fired|injected_faults"
         r"|suppressed_anomalies|active)$"),
@@ -285,7 +301,8 @@ NON_NEGATIVE = (
 # rates that must stay within [0, 1] (acceptance is accepted/offered; the
 # cache hit fraction is cached/attended positions)
 UNIT_INTERVAL = ("decode_spec_accept_rate", "dispatch_fused_fallback",
-                 "decode_cache_hit_fraction")
+                 "decode_cache_hit_fraction",
+                 "offpolicy_rho_clip_fraction", "offpolicy_c_clip_fraction")
 
 # a serving record (identified by serving_qps) must carry the benchmark
 # contract BENCHLOG consumes: throughput, latency percentiles, shed rate
@@ -731,7 +748,8 @@ def validate_record(record, index: int = 0, strict_names: bool = True,
                 or k.startswith(("serving_", "fleet_", "rollout_", "shard_",
                                  "resilience_", "slo_",
                                  "decode_cache_", "async_",
-                                 "staleness_", "chaos_",
+                                 "staleness_", "store_", "offpolicy_",
+                                 "chaos_",
                                  "scrape_", "obs_", "tune_",
                                  "ts_", "incident_"))) and v < 0:
             errs.append(f"{where}: field {k!r} is negative ({v})")

@@ -58,6 +58,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import re
 import sys
 from collections import defaultdict
 from pathlib import Path
@@ -285,6 +286,37 @@ def async_panel(metrics: List[dict]) -> List[str]:
     if "staleness_param_version" in last:
         lines.append(f"  published param version "
                      f"{last['staleness_param_version']:.0f}")
+    if "store_staleness_budget" in last:
+        lines.append(f"  store: budget {last['store_staleness_budget']:.0f}  "
+                     f"depth {last.get('store_depth', 0):.0f}"
+                     f"/{last.get('store_max_depth', 0):.0f} max  "
+                     f"tickets {last.get('store_tickets', 0):.0f}  "
+                     f"puts {last.get('store_puts', 0):.0f}  "
+                     f"gets {last.get('store_gets', 0):.0f}  "
+                     f"drops {last.get('store_drops', 0):.0f}")
+    if "offpolicy_applied" in last:
+        lines.append(f"  off-policy correction: applied "
+                     f"{last['offpolicy_applied']:.0f}  "
+                     f"lag {last.get('offpolicy_lag', 0):.0f}  "
+                     f"rho mean {last.get('offpolicy_rho_mean', 0):.3f}  "
+                     f"max {last.get('offpolicy_rho_max', 0):.3f}  "
+                     f"clipped {last.get('offpolicy_rho_clip_fraction', 0):.1%}")
+    # one row per collector worker (--async_actor_workers N): its private
+    # iteration counter and actor-side throughput, so a straggling or
+    # restarted worker is visible at a glance
+    worker_ids = sorted(
+        int(m.group(1)) for k in last
+        for m in [re.match(r"^async_actor_w(\d+)_iters$", k)] if m)
+    for wid in worker_ids:
+        iters = last.get(f"async_actor_w{wid}_iters", 0)
+        rate = last.get(f"async_actor_w{wid}_env_steps_per_sec")
+        line = f"  worker w{wid}: iters {iters:.0f}"
+        if rate is not None:
+            line += f"  env steps/s {rate:.1f}"
+        lines.append(line)
+    if worker_ids and last.get("async_actor_restarts"):
+        lines.append(f"  worker restarts "
+                     f"{last['async_actor_restarts']:.0f}")
     for k in ("async_actor_steady_state_recompiles", "steady_state_recompiles"):
         if k in last:
             side = "actor" if k.startswith("async_actor_") else "learner"

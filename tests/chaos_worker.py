@@ -10,12 +10,18 @@ time its kill against; PreemptedExit propagates so a honored SIGTERM exits
 Usage:
     python tests/chaos_worker.py --run_dir DIR --episodes N
         [--seed 1] [--save_interval 2] [--data_shards 1] [--devices 1]
-        [--async_actors 0] [--chaos_plan PLAN.json] [--chaos_planes CSV]
+        [--async_actors 0] [--async_actor_workers 1] [--staleness_budget 1]
+        [--actor_devices 0] [--learner_devices 0]
+        [--chaos_plan PLAN.json] [--chaos_planes CSV]
         [--chaos_skip_kinds CSV] [--tripwires 0] [--obs_port 0|-1|N]
 
 ``--async_actors 1`` switches to the overlapped actor-learner loop
 (--iters_per_dispatch drops to 1 — the two overlap strategies are mutually
 exclusive); pass ``--devices 2`` or more so the submesh split has devices.
+``--async_actor_workers N`` (with ``--actor_devices`` a multiple of N)
+scales out to N collector threads sharing one trajectory store;
+``--staleness_budget B`` is the store's admission bound (see
+training/async_loop.py).
 
 ``--chaos_plan`` arms a mat_dcml_tpu.chaos FaultInjector for this process
 from the given plan JSON, filtered to ``--chaos_planes`` (csv; default both
@@ -85,6 +91,10 @@ def main() -> None:
     parser.add_argument("--data_shards", type=int, default=1)
     parser.add_argument("--devices", type=int, default=1)
     parser.add_argument("--async_actors", type=int, default=0)
+    parser.add_argument("--async_actor_workers", type=int, default=1)
+    parser.add_argument("--staleness_budget", type=int, default=1)
+    parser.add_argument("--actor_devices", type=int, default=0)
+    parser.add_argument("--learner_devices", type=int, default=0)
     parser.add_argument("--chaos_plan", default=None)
     parser.add_argument("--chaos_planes", default="train_sync,train_async")
     parser.add_argument("--chaos_skip_kinds", default="")
@@ -124,6 +134,10 @@ def main() -> None:
         n_block=1, n_embd=16, n_head=2,
         iters_per_dispatch=1 if args.async_actors else 2,
         async_actors=bool(args.async_actors),
+        async_actor_workers=args.async_actor_workers,
+        staleness_budget=args.staleness_budget,
+        actor_devices=args.actor_devices,
+        learner_devices=args.learner_devices,
         log_interval=1, telemetry_interval=1,
         save_interval=args.save_interval, run_dir=args.run_dir,
         anomaly_tripwires=bool(args.tripwires),

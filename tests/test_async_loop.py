@@ -23,10 +23,14 @@ import pytest
 from mat_dcml_tpu.config import RunConfig
 from mat_dcml_tpu.envs.dcml import DCMLConsts, DCMLEnv, DCMLEnvConfig
 from mat_dcml_tpu.parallel.distributed import put_time_major
-from mat_dcml_tpu.parallel.mesh import build_actor_learner_meshes
+from mat_dcml_tpu.parallel.mesh import (
+    build_actor_learner_meshes,
+    carve_actor_worker_meshes,
+)
 from mat_dcml_tpu.training.async_loop import (
     ParamPublisher,
     TrajectoryQueue,
+    TrajectoryStore,
 )
 from mat_dcml_tpu.training.ppo import PPOConfig
 from mat_dcml_tpu.training.runner import DCMLRunner
@@ -134,6 +138,136 @@ def test_queue_put_timeout_is_not_a_drop():
 
 
 # ===================================================================
+# trajectory store: staleness-budget admission control
+# ===================================================================
+
+def test_store_budget_validation():
+    with pytest.raises(ValueError, match="staleness budget"):
+        TrajectoryStore(capacity=2, staleness_budget=0)
+
+
+def test_store_b1_reproduces_double_buffering():
+    """B=1 is PR 13's throttle: at most one block collecting while one is
+    queued/consuming — the third admission must wait until the consumed
+    block is marked done."""
+    s = TrajectoryStore(capacity=2, staleness_budget=1)
+    assert s.admit(timeout=1.0)          # outstanding 0 <= 1: collect #1
+    assert s.admit(timeout=1.0)          # outstanding 1 <= 1: collect #2
+    assert s.admit(timeout=0.05) is False  # outstanding 2 > 1: throttled
+    assert s.put("a", timeout=1.0)       # ticket -> depth
+    assert s.tickets == 1 and s.depth == 1
+    assert s.admit(timeout=0.05) is False  # still 2 outstanding
+    assert s.get(timeout=1.0) == "a"     # depth -> consuming, atomically
+    assert s.consuming == 1
+    assert s.admit(timeout=0.05) is False  # consumed block still counts
+    s.mark_consumed()                    # learner published the new params
+    assert s.admit(timeout=1.0)          # now a new collect may start
+    assert s.outstanding == 2
+
+
+def test_store_admission_caps_consumed_lag_at_budget():
+    """Admission admits while outstanding <= B pre-increment, so at most
+    B + 1 blocks are ever in flight and any consumed block lags <= B."""
+    s = TrajectoryStore(capacity=4, staleness_budget=2)
+    assert s.admit(timeout=1.0)          # S=0
+    assert s.admit(timeout=1.0)          # S=1
+    assert s.admit(timeout=1.0)          # S=2 == B: last admissible
+    assert s.outstanding == 3
+    assert s.admit(timeout=0.05) is False
+    assert s.admits == 3
+
+
+def test_store_cancel_ticket_unblocks_waiter():
+    s = TrajectoryStore(capacity=2, staleness_budget=1)
+    assert s.admit(timeout=1.0) and s.admit(timeout=1.0)
+    got = {}
+
+    def waiter():
+        got["admit"] = s.admit(timeout=5.0)
+
+    t = threading.Thread(target=waiter, daemon=True)
+    t.start()
+    time.sleep(0.05)
+    s.cancel_ticket()                    # aborting producer returns its slot
+    t.join(timeout=5.0)
+    assert got["admit"] is True
+    assert s.tickets == 2
+
+
+def test_store_close_wakes_admit_waiter():
+    s = TrajectoryStore(capacity=2, staleness_budget=1)
+    assert s.admit(timeout=1.0) and s.admit(timeout=1.0)
+    got = {}
+
+    def waiter():
+        got["admit"] = s.admit()         # no timeout: real blocking wait
+
+    t = threading.Thread(target=waiter, daemon=True)
+    t.start()
+    time.sleep(0.05)
+    s.close()
+    t.join(timeout=5.0)
+    assert got["admit"] is False
+    assert s.admit(timeout=0.05) is False  # closed store never admits
+
+
+def test_store_multi_producer_fifo_zero_drops():
+    """Four producer threads through the admission gate: every block lands
+    exactly once (zero drops), and the consumer's lag never exceeds B."""
+    s = TrajectoryStore(capacity=4, staleness_budget=2)
+    n_per, n_workers = 5, 4
+    seen = []
+
+    def producer(wid):
+        for i in range(n_per):
+            assert s.admit(timeout=10.0)
+            assert s.put((wid, i), timeout=10.0)
+
+    threads = [threading.Thread(target=producer, args=(w,), daemon=True)
+               for w in range(n_workers)]
+    for t in threads:
+        t.start()
+    for _ in range(n_per * n_workers):
+        blk = s.get(timeout=10.0)
+        assert blk is not None
+        assert s.outstanding <= s.staleness_budget + 1
+        seen.append(blk)
+        s.mark_consumed()
+    for t in threads:
+        t.join(timeout=10.0)
+    assert s.drops == 0 and len(seen) == n_per * n_workers
+    assert sorted(seen) == sorted(
+        (w, i) for w in range(n_workers) for i in range(n_per))
+    # per-producer order is preserved even though workers interleave
+    for w in range(n_workers):
+        assert [i for (ww, i) in seen if ww == w] == list(range(n_per))
+
+
+# ===================================================================
+# actor-worker submesh carving
+# ===================================================================
+
+def test_carve_actor_worker_meshes(forced8_cpu):
+    actor, _ = build_actor_learner_meshes(4, 4, devices=forced8_cpu)
+    slices = carve_actor_worker_meshes(actor, 2)
+    assert len(slices) == 2
+    assert all(m.size == 2 for m in slices)
+    flat = [d for m in slices for d in m.devices.flat]
+    assert len(set(flat)) == 4          # disjoint, covering the submesh
+    assert set(flat) == set(actor.devices.flat)
+    # single worker keeps the actor submesh untouched
+    assert carve_actor_worker_meshes(actor, 1) == [actor]
+
+
+def test_carve_actor_worker_meshes_typed_errors(forced8_cpu):
+    actor, _ = build_actor_learner_meshes(4, 4, devices=forced8_cpu)
+    with pytest.raises(ValueError, match="must be >= 1"):
+        carve_actor_worker_meshes(actor, 0)
+    with pytest.raises(ValueError, match="divide the actor submesh"):
+        carve_actor_worker_meshes(actor, 3)
+
+
+# ===================================================================
 # staleness accounting (publisher versioning through the queue)
 # ===================================================================
 
@@ -169,6 +303,17 @@ def test_publisher_snapshot_hands_latest_params():
     pub.publish("p2")
     params, version = pub.snapshot()
     assert params == "p2" and version == 2
+
+
+def test_publisher_per_worker_snapshot_single_version():
+    """A multi-slice publisher places one copy per worker mesh under ONE
+    version bump; worker ids beyond the slice list clamp to slice 0 (the
+    shared-mesh publisher shape)."""
+    pub = ParamPublisher()               # mesh-free: one shared slice
+    assert pub.publish("p1") == 1
+    p0, v0 = pub.snapshot(0)
+    p9, v9 = pub.snapshot(9)             # clamps, never raises
+    assert (p0, v0) == (p9, v9) == ("p1", 1)
 
 
 # ===================================================================
@@ -295,6 +440,86 @@ def test_async_train_loop_smoke(tmp_path, forced8_cpu):
     for rec in records:
         errs = check_metrics_schema.validate_record(dict(rec), strict=True)
         assert errs == [], (errs, rec)
+
+
+@pytest.mark.slow
+def test_async_scale_out_workers_smoke(tmp_path, forced8_cpu):
+    """N=2 workers on a carved 4-device actor submesh at budget B=2: per-
+    worker telemetry lands under its own label, the store self-describes its
+    budget, staleness p95 stays <= B, the V-trace correction (auto at B>1)
+    fires on every consumed block, zero drops, and the strict schema holds."""
+    runner = _async_runner(tmp_path, actor_devices=4, learner_devices=2,
+                           async_actor_workers=2, staleness_budget=2)
+    ts, rs = runner.setup()
+    ts, rs = runner.train_loop(num_episodes=3, train_state=ts,
+                               rollout_state=rs)
+    assert ts is not None and rs is not None
+
+    metrics_path = next(Path(tmp_path).rglob("metrics.jsonl"))
+    records = [json.loads(ln) for ln in metrics_path.read_text().splitlines()]
+    train = [r for r in records if "fps" in r]
+    assert len(train) == 3
+    last = train[-1]
+    assert last["async_actor_workers"] == 2
+    assert last["store_workers"] == 2
+    assert last["store_staleness_budget"] == 2
+    assert last["store_drops"] == 0 and last["async_queue_drops"] == 0
+    # both workers made progress and report under their own labels
+    assert last["async_actor_w0_iters"] >= 1
+    assert last["async_actor_w1_iters"] >= 1
+    assert last["async_actor_w0_env_steps_per_sec"] > 0
+    assert last["async_actor_w1_env_steps_per_sec"] > 0
+    assert last["async_actor_iters"] == (
+        last["async_actor_w0_iters"] + last["async_actor_w1_iters"])
+    # consumed lag bounded by the budget; correction applied per consume
+    assert last["staleness_learner_steps_p95"] <= 2.0
+    assert last["offpolicy_applied"] == last["async_learner_steps"]
+    assert last["offpolicy_rho_mean"] > 0.0
+    # zero steady-state recompiles in the learner and every worker program
+    assert last.get("steady_state_recompiles", 0.0) == 0.0
+    for key in ("async_actor_steady_state_recompiles",
+                "async_actor_w0_steady_state_recompiles",
+                "async_actor_w1_steady_state_recompiles"):
+        assert last.get(key, 0.0) == 0.0, key
+    for rec in records:
+        errs = check_metrics_schema.validate_record(dict(rec), strict=True)
+        assert errs == [], (errs, rec)
+
+
+@pytest.mark.slow
+def test_async_actor_crash_restarts_worker(tmp_path, forced8_cpu):
+    """A targeted actor_crash kills worker w1 mid-run: the learner's
+    liveness check reclaims its admission ticket, restarts it, and the run
+    finishes with zero drops and the staleness budget still held."""
+    from mat_dcml_tpu.chaos import FaultInjector, FaultPlan, arm, disarm
+    from mat_dcml_tpu.chaos.plan import FaultEvent
+
+    plan = FaultPlan(events=[
+        FaultEvent(kind="actor_crash", target="w1",
+                   params={"fail_calls": 1, "at_iteration": 2})])
+    inj = FaultInjector(plan, log=lambda *a: None)
+    arm(inj)
+    inj.start()
+    try:
+        runner = _async_runner(tmp_path, actor_devices=4, learner_devices=2,
+                               async_actor_workers=2, staleness_budget=2)
+        ts, rs = runner.setup()
+        ts, rs = runner.train_loop(num_episodes=4, train_state=ts,
+                                   rollout_state=rs)
+        assert ts is not None
+        assert inj.fired_sequence() == ["actor_crash:000"]
+    finally:
+        disarm()
+
+    metrics_path = next(Path(tmp_path).rglob("metrics.jsonl"))
+    records = [json.loads(ln) for ln in metrics_path.read_text().splitlines()]
+    train = [r for r in records if "fps" in r]
+    last = train[-1]
+    assert last["async_actor_restarts"] >= 1
+    assert last["store_drops"] == 0
+    assert last["staleness_learner_steps_p95"] <= 2.0
+    # the crashed-and-restarted worker resumed contributing
+    assert last["async_actor_w1_iters"] >= 1
 
 
 @pytest.mark.slow

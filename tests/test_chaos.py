@@ -174,6 +174,27 @@ def test_actor_thread_death_is_silent_and_iteration_gated():
     assert is_silent_death(err.value)
 
 
+def test_actor_crash_targets_one_worker():
+    """actor_crash kills exactly the targeted worker label: siblings passing
+    through the same hook at the same iteration stay alive, and the fault is
+    count-gated so the restarted worker survives its own iteration 3."""
+    inj, clock = _injector([
+        FaultEvent(kind="actor_crash", target="w2",
+                   params={"fail_calls": 1, "at_iteration": 3})])
+    inj.start()
+    clock["t"] = 0.1
+    for it in range(1, 5):
+        inj.on_actor_iteration(it, worker="w0")   # wrong worker: no-op
+    inj.on_actor_iteration(2, worker="w2")        # right worker, too early
+    with pytest.raises(ActorThreadDeath) as err:
+        inj.on_actor_iteration(3, worker="w2")
+    assert is_silent_death(err.value)
+    inj.on_actor_iteration(3, worker="w2")        # budget burned: healthy
+    # legacy call shape (no worker kwarg) still works on a plan without
+    # actor_crash targets
+    inj.on_actor_iteration(4)
+
+
 def test_nan_grad_mutates_signals_copy_only():
     inj, clock = _injector([
         FaultEvent(kind="nan_grad", params={"fail_calls": 1})])
@@ -339,8 +360,9 @@ def test_chaos_soak_smoke_plan_passes(tmp_path):
 
 @pytest.mark.slow
 def test_chaos_soak_full_plan_passes(tmp_path):
-    """All 11 fault kinds across serving + train_sync + train_async,
-    including the SIGTERM/resume bit-exact leg — the PR's acceptance soak."""
+    """All 12 fault kinds across serving + train_sync + train_async,
+    including the SIGTERM/resume bit-exact leg and the N=4 worker
+    actor_crash restart — the PR's acceptance soak."""
     report = _run_soak(_PLANS / "full.json", tmp_path / "soak",
                        duration=10.0, timeout=900.0)
     assert report["pass"] is True, report
