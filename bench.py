@@ -149,6 +149,22 @@ Knobs (environment variables):
                         (1,4,16), BENCH_OBS_FED_SAMPLE (0.01),
                         BENCH_OBS_FED_TRIALS (5), BENCH_OBS_FED_RUN_DIR
                         (append records + trace.jsonl, then strict-validate)
+  BENCH_FED_SERVE       "1" → serving-federation router tax A/B + kill cell:
+                        the identical single-replica host served through the
+                        full service tier (ServiceRouter + HTTP frontend) vs
+                        direct HTTP to the host.  Record value = routed QPS,
+                        vs_baseline = median per-round (matched-pair)
+                        routed/direct QPS ratio (contract: >= 0.95 — the
+                        router tier costs one local hop).  Rides along: a
+                        3-host host-kill-under-load cell (one host dies cold
+                        mid-load) whose verdict fields pin zero
+                        client-visible errors, zero exhausted retries, and
+                        no generation split.  Knobs:
+                        BENCH_FED_SERVE_REQUESTS (512),
+                        BENCH_FED_SERVE_CONCURRENCY (16),
+                        BENCH_FED_SERVE_BUCKETS (1,4,16),
+                        BENCH_FED_SERVE_TRIALS (5), BENCH_FED_SERVE_RUN_DIR
+                        (append records, then strict-validate)
   BENCH_OBS_ROLLUP      "1" → long-run rollup-plane overhead A/B: the armed
                         leg runs the identical single-replica fleet while a
                         background loop every 100 ms folds the merged
@@ -2346,6 +2362,180 @@ def _measure_obs_fed(jax) -> None:
     print(json.dumps(record), flush=True)
 
 
+def _measure_fed_serve(jax) -> None:
+    """BENCH_FED_SERVE=1 leg: serving-federation router tax + kill cell.
+
+    **Router-tax A/B**: both legs drive the identical single-replica host
+    fleet through a real ``PolicyServer`` + ``HttpPolicyClient`` loopback
+    pair; the ``routed`` leg inserts the full service tier in between
+    (``ServiceRouter`` + its HTTP frontend), so the ratio isolates the cost
+    of the extra hop — one more JSON parse + socket round-trip plus the
+    router's host-pick and health bookkeeping.  ``vs_baseline`` is the
+    MEDIAN of per-round routed/direct QPS ratios (matched pairs, same
+    rationale as the BENCH_OBS_FED leg; contract: >= 0.95 — the router tier
+    costs one local hop, not a second serving stack).
+
+    **Host-kill cell**: 3 single-replica hosts behind the router under one
+    closed-loop load; once a third of the requests have landed, one host is
+    stopped cold (its HTTP server and engine die mid-load).  The cell's
+    verdict is the federation acceptance criterion under load: zero
+    client-visible errors, zero exhausted retries, no generation split."""
+    import threading as _threading
+
+    from mat_dcml_tpu.config import RunConfig
+    from mat_dcml_tpu.envs.dcml import DCMLEnv, DCMLEnvConfig
+    from mat_dcml_tpu.serving.batcher import BatcherConfig
+    from mat_dcml_tpu.serving.engine import EngineConfig
+    from mat_dcml_tpu.serving.fleet import EngineFleet, FleetConfig
+    from mat_dcml_tpu.serving.loadgen import run_load, write_serving_record
+    from mat_dcml_tpu.serving.router import (
+        RouterConfig,
+        RouterServer,
+        ServiceRouter,
+    )
+    from mat_dcml_tpu.serving.server import HttpPolicyClient, PolicyServer
+    from mat_dcml_tpu.training.runner import build_mat_policy
+
+    data_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)), "data")
+    env = DCMLEnv(DCMLEnvConfig(), data_dir=data_dir)
+    policy = build_mat_policy(RunConfig(), env)
+    params = policy.init_params(jax.random.key(0))
+
+    n_req = int(os.environ.get("BENCH_FED_SERVE_REQUESTS", "512"))
+    conc = int(os.environ.get("BENCH_FED_SERVE_CONCURRENCY", "16"))
+    buckets = tuple(
+        int(b)
+        for b in os.environ.get("BENCH_FED_SERVE_BUCKETS", "1,4,16").split(",")
+    )
+    trials = int(os.environ.get("BENCH_FED_SERVE_TRIALS", "5"))
+    run_dir = os.environ.get("BENCH_FED_SERVE_RUN_DIR", "")
+    quiet = lambda *a: None  # noqa: E731
+
+    def _mk_host():
+        fleet = EngineFleet(
+            params, policy.cfg,
+            fleet_cfg=FleetConfig(n_replicas=1),
+            engine_cfg=EngineConfig(buckets=buckets),
+            batcher_cfg=BatcherConfig(max_batch_wait_ms=2.0),
+            log_fn=quiet,
+        )
+        fleet.warmup()
+        server = PolicyServer(fleet=fleet, port=0, log_fn=quiet)
+        server.warm = True
+        server.start()
+        return fleet, server
+
+    def _run_leg(name: str) -> dict:
+        routed = name == "routed"
+        fleet, host = _mk_host()
+        router = front = None
+        url = f"http://127.0.0.1:{host.port}"
+        if routed:
+            router = ServiceRouter(
+                [url], RouterConfig(probe_interval_s=600.0), log_fn=quiet)
+            front = RouterServer(router, port=0, log_fn=quiet)
+            front.start()
+            url = f"http://127.0.0.1:{front.port}"
+        client = HttpPolicyClient(url, cfg=policy.cfg)
+        rec = run_load(client, n_requests=n_req, concurrency=conc)
+        rec["steady_state_recompiles"] = fleet.steady_state_recompiles()
+        if routed:
+            rec.update(router.service_record())
+            front.stop()
+        host.stop()
+        fleet.close()
+        log(f"fed_serve[{name}]: {rec['serving_qps']:.1f} req/s, "
+            f"p50 {rec['serving_p50_ms']:.1f} ms, "
+            f"p99 {rec['serving_p99_ms']:.1f} ms")
+        return rec
+
+    best, legs = ab_trials(
+        {"routed": lambda: _run_leg("routed"),
+         "direct": lambda: _run_leg("direct")},
+        trials, score=lambda r: r["serving_qps"])
+    ratios = paired_ratios(legs, "routed", "direct",
+                           value=lambda r: r["serving_qps"])
+    median_ratio = median_of_ratios(legs, "routed", "direct",
+                                    value=lambda r: r["serving_qps"])
+
+    # ---- host-kill-under-load cell: 3 hosts, one dies cold mid-load ------
+    hosts = [_mk_host() for _ in range(3)]
+    router = ServiceRouter(
+        [f"http://127.0.0.1:{h.port}" for _, h in hosts],
+        RouterConfig(probe_interval_s=600.0, backoff_base_ms=2.0),
+        log_fn=quiet)
+    front = RouterServer(router, port=0, log_fn=quiet)
+    front.start()
+    client = HttpPolicyClient(f"http://127.0.0.1:{front.port}",
+                              cfg=policy.cfg)
+    kill_rec: dict = {}
+
+    def _drive():
+        kill_rec.update(run_load(client, n_requests=n_req, concurrency=conc))
+
+    driver = _threading.Thread(target=_drive)
+    driver.start()
+    deadline = time.time() + 120.0
+    while (sum(h.requests for h in router.hosts) < n_req / 3
+           and driver.is_alive() and time.time() < deadline):
+        time.sleep(0.01)
+    victim_fleet, victim_server = hosts[1]
+    victim_server.stop()       # the host dies cold, connections refused
+    victim_fleet.close()
+    driver.join(timeout=300.0)
+    kill_rec.update(router.service_record())
+    front.stop()
+    for i, (fleet, server) in enumerate(hosts):
+        if i != 1:
+            server.stop()
+            fleet.close()
+    kill_zero_drops = (
+        kill_rec.get("serving_error_rate", 1.0) == 0.0
+        and kill_rec.get("router_retries_exhausted", 1.0) == 0.0
+        and kill_rec.get("router_generation_split", 1.0) == 0.0)
+    log(f"fed_serve[kill]: {kill_rec.get('serving_qps', 0.0):.1f} req/s, "
+        f"failovers {kill_rec.get('router_failovers', 0.0):g}, "
+        f"zero_drops={kill_zero_drops}")
+
+    if run_dir:
+        for rec in best.values():
+            write_serving_record(run_dir, rec)
+        write_serving_record(run_dir, kill_rec)
+
+    dev = jax.devices()[0]
+    record = {
+        "metric": "dcml_mat_fed_serve_router_tax_qps",
+        "value": round(best["routed"]["serving_qps"], 2),
+        "unit": "req/s",
+        # the router-tier tax over the direct-HTTP baseline (contract >= 0.95)
+        "vs_baseline": round(median_ratio, 4),
+        "paired_ratios": [round(r, 3) for r in ratios],
+        "platform": dev.platform,
+        "device": dev.device_kind,
+        "provisional": False,
+        "buckets": ",".join(str(b) for b in buckets),
+        "requests": n_req,
+        "concurrency": conc,
+        "trials": max(trials, 1),
+        "direct_qps": round(best["direct"]["serving_qps"], 2),
+        "routed_qps_all": [round(r["serving_qps"], 1)
+                           for r in legs["routed"]],
+        "direct_qps_all": [round(r["serving_qps"], 1)
+                           for r in legs["direct"]],
+        "routed_p50_ms": round(best["routed"]["serving_p50_ms"], 2),
+        "direct_p50_ms": round(best["direct"]["serving_p50_ms"], 2),
+        "routed_p99_ms": round(best["routed"]["serving_p99_ms"], 2),
+        "direct_p99_ms": round(best["direct"]["serving_p99_ms"], 2),
+        "kill_zero_drops": kill_zero_drops,
+        "kill_qps": round(kill_rec.get("serving_qps", 0.0), 2),
+        "kill_failovers": kill_rec.get("router_failovers", 0.0),
+        "kill_error_rate": kill_rec.get("serving_error_rate", 0.0),
+        "kill_healthy_hosts": kill_rec.get("router_healthy", 0.0),
+        "schema_strict_ok": _validate_run_dir(run_dir),
+    }
+    print(json.dumps(record), flush=True)
+
+
 def _measure_chaos(jax) -> None:
     """BENCH_CHAOS=1 leg: chaos-seam overhead A/B.
 
@@ -2960,6 +3150,12 @@ def main() -> None:
     if os.environ.get("BENCH_OBS_FED", "0") == "1":
         jax, _ = _setup_jax()
         _measure_obs_fed(jax)
+        return
+
+    # Serving-federation router tax A/B + host-kill-under-load zero-drop cell
+    if os.environ.get("BENCH_FED_SERVE", "0") == "1":
+        jax, _ = _setup_jax()
+        _measure_fed_serve(jax)
         return
 
     # Rollup-plane overhead A/B: tiered rollups + incident correlator armed

@@ -77,6 +77,9 @@ _SUPPRESSES: Dict[str, tuple] = {
     "actor_crash": ("step_time", "staleness"),
     "param_publish_delay": ("staleness", "step_time"),
     "trainer_kill": (),
+    # a killed host tanks service latency until its siblings absorb the
+    # load and the prober readmits nothing (the host stays dead)
+    "host_loss": ("slo_",),
 }
 
 # Kinds gated by call count (fire on the Nth matching hook call) rather than
@@ -84,7 +87,7 @@ _SUPPRESSES: Dict[str, tuple] = {
 # counts are the deterministic clock there.
 _COUNT_GATED = frozenset({
     "decode_error", "checkpoint_io_error", "checkpoint_corrupt",
-    "nan_grad", "actor_thread_death", "actor_crash",
+    "nan_grad", "actor_thread_death", "actor_crash", "host_loss",
 })
 
 
@@ -372,6 +375,15 @@ class FaultInjector:
             signals["nonfinite_grads"] = max(
                 1.0, float(signals.get("nonfinite_grads", 0.0)))
         return signals
+
+    def claim_host_loss(self, host: Optional[str] = None):
+        """Driver-delivered fault (like ``trainer_kill``'s SIGTERM): the
+        federation soak driver polls this per host (``target`` ``"h<idx>"``)
+        and SIGKILLs the matching host subprocess when an armed ``host_loss``
+        event's window opens.  Count-gated with a default budget of 1, so
+        the kill fires exactly once.  Returns ``(event, plan_time)`` or
+        ``None``."""
+        return self._claim("host_loss", host)
 
     def load_multiplier(self) -> float:
         """Offered-load multiplier for the load generator (product of active

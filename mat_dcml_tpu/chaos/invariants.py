@@ -7,8 +7,9 @@ returns one :class:`InvariantResult` per contract:
 
 * ``zero_dropped_requests`` — graceful degradation means clients see 429
   sheds and retries, never errors: every serving slice has
-  ``serving_error_rate == 0`` (and zero deadline misses), the fleet never
-  exhausted retries, and the async trajectory queue dropped nothing.
+  ``serving_error_rate == 0`` (and zero deadline misses), neither the fleet
+  nor the service router exhausted retries, and the async trajectory queue
+  dropped nothing.
 * ``zero_steady_recompiles`` — every ``*steady_state_recompiles`` gauge in
   every record is 0: faults must not knock compiled programs off their
   signatures.
@@ -81,6 +82,12 @@ def check_invariants(records: List[dict],
                     for r in metrics) if metrics else 0.0
     if exhausted:
         bad.append(f"fleet_retries_exhausted={exhausted:g}")
+    # the federation tier: a request that exhausted its sibling-host
+    # failovers surfaced to the client as an error — that IS a drop
+    r_exhausted = max((_num(r, "router_retries_exhausted") or 0.0)
+                      for r in metrics) if metrics else 0.0
+    if r_exhausted:
+        bad.append(f"router_retries_exhausted={r_exhausted:g}")
     drops = max((_num(r, "async_queue_drops") or 0.0)
                 for r in metrics) if metrics else 0.0
     if drops:

@@ -21,8 +21,8 @@ Plan JSON::
     }
 
 Count-gated kinds (checkpoint_io_error, decode_error, checkpoint_corrupt,
-actor_thread_death, actor_crash, nan_grad) fire on the Nth hook call inside
-their window
+actor_thread_death, actor_crash, nan_grad, host_loss) fire on the Nth hook
+call inside their window
 via ``params`` (``fail_calls``, ``skip_calls``, ``at_iteration``) rather than
 wall-clock alone — training-plane timing is compile-dominated, so call counts
 are the deterministic clock there.
@@ -55,6 +55,10 @@ FAULT_KINDS: Dict[str, str] = {
     # the N-worker generalization of actor_thread_death, exercising the
     # per-worker restart path + admission-ticket reclaim
     "actor_crash": "train_async",
+    # a whole HOST fleet (target "h<idx>") dies under load: the soak driver
+    # claims this and SIGKILLs the host subprocess; the service router must
+    # fail the in-flight requests over to sibling hosts with zero drops
+    "host_loss": "service",
 }
 
 

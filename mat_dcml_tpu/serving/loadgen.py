@@ -9,18 +9,29 @@ p50/p95/p99 latency, shed rate, deadline-miss rate, and bucket-occupancy
 through the telemetry registry into ``metrics.jsonl`` — the same stream the
 trainer writes, so BENCH tooling consumes serving records unchanged
 (``scripts/check_metrics_schema.py`` knows the ``serving_*`` family).
+
+HTTP mode additionally accepts MULTIPLE endpoints
+(:class:`MultiTargetClient`; repeatable ``--target`` on the CLI): the same
+loadgen then drives N host fleets directly or the federation router
+(:mod:`mat_dcml_tpu.serving.router`) with one URL per host, round-robining
+the offered load and attributing client overhead per endpoint
+(``serving_target_<i>_client_overhead_ms`` next to the merged
+``serving_client_overhead_ms``) — which is how the bench compares
+router-fronted vs direct serving under a matched arrival process.
 """
 
 from __future__ import annotations
 
+import itertools
 import threading
 import time
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
 from mat_dcml_tpu.serving.batcher import ServingError
 from mat_dcml_tpu.serving.server import PolicyClient
+from mat_dcml_tpu.telemetry.registry import HistogramSketch, Telemetry
 
 
 def synth_requests(cfg, n: int, seed: int = 0):
@@ -47,6 +58,82 @@ def percentiles(latencies_ms: List[float]) -> Dict[str, float]:
         "serving_p95_ms": float(np.percentile(arr, 95)),
         "serving_p99_ms": float(np.percentile(arr, 99)),
     }
+
+
+def _target_name(name: str, i: int) -> str:
+    """``serving_client_overhead_ms`` -> ``serving_target_<i>_client_overhead_ms``
+    (family prefix preserved so the schema checker keeps one vocabulary)."""
+    bare = name[len("serving_"):] if name.startswith("serving_") else name
+    return f"serving_target_{i}_{bare}"
+
+
+class _MultiTargetTelemetry(Telemetry):
+    """Facade registry over the per-target client registries.
+
+    Each flush re-derives state from the targets: bare ``serving_client_*``
+    names carry the merged view (so single-target consumers read the record
+    unchanged — sketches merge exactly, per :class:`HistogramSketch`), and
+    every name is re-emitted per endpoint under ``serving_target_<i>_*`` so
+    one record shows which endpoint imposed what overhead."""
+
+    def __init__(self, clients: Sequence) -> None:
+        super().__init__()
+        self._clients = list(clients)
+
+    def _sync(self) -> None:
+        self.counters = {}
+        self.hists = {}
+        for i, c in enumerate(self._clients):
+            tel = c.telemetry
+            for name, v in dict(tel.counters).items():
+                self.counters[name] = self.counters.get(name, 0.0) + v
+                self.counters[_target_name(name, i)] = v
+            for name, sk in dict(tel.hists).items():
+                merged = self.hists.get(name)
+                if merged is None:
+                    merged = self.hists[name] = HistogramSketch()
+                merged.merge(sk)
+                mine = self.hists[_target_name(name, i)] = HistogramSketch()
+                mine.merge(sk)
+
+    def flush(self) -> Dict[str, float]:
+        self._sync()
+        return super().flush()
+
+
+class MultiTargetClient:
+    """Round-robin fan-out over N ``/v1/act`` endpoints.
+
+    Duck-types the slice of :class:`PolicyClient` that :func:`run_load`
+    consumes (``act`` / ``cfg`` / ``telemetry``).  Every target gets its own
+    :class:`~mat_dcml_tpu.serving.server.HttpPolicyClient` with a private
+    registry, so per-endpoint client overhead stays attributable; the facade
+    registry merges them on flush.  With one target this degenerates to a
+    plain HTTP client (plus the ``serving_target_0_*`` echo), so the same
+    loadgen invocation shape drives a single fleet, N fleets directly, or
+    the federation router.
+    """
+
+    def __init__(self, targets: Sequence[str], cfg=None, tracer=None,
+                 timeout_s: float = 60.0) -> None:
+        from mat_dcml_tpu.serving.server import HttpPolicyClient
+
+        urls = [str(t).rstrip("/") for t in targets if str(t).strip()]
+        if not urls:
+            raise ValueError("MultiTargetClient needs at least one target")
+        self.targets = urls
+        self.cfg = cfg
+        self.clients = [HttpPolicyClient(url, cfg=cfg, tracer=tracer,
+                                         timeout_s=timeout_s)
+                        for url in urls]
+        self.telemetry = _MultiTargetTelemetry(self.clients)
+        self._next = itertools.count()   # next() is atomic under the GIL
+
+    def act(self, state, obs, available_actions=None,
+            timeout_s: Optional[float] = None):
+        i = next(self._next) % len(self.clients)
+        return self.clients[i].act(state, obs, available_actions,
+                                   timeout_s=timeout_s)
 
 
 def run_load(
@@ -216,6 +303,13 @@ def main(argv=None) -> None:
     HTTP mode (no local engine; ``--policy_dir`` not needed):
            --server_url http://host:port --shape N_AGENT,OBS,STATE,ACT
            [--obs_port 9100]   # join the scrape plane (telemetry/remote.py)
+
+    Federated HTTP mode — repeat ``--target`` for each endpoint (host fleets
+    driven directly, or the one router URL; ``--server_url`` is the
+    single-target alias).  Load round-robins across targets and the record
+    carries per-target ``serving_target_<i>_client_overhead_ms`` histograms
+    next to the merged client-overhead sketch:
+           --target http://h0:8420 --target http://h1:8420 --shape ...
     """
     import argparse
 
@@ -226,7 +320,14 @@ def main(argv=None) -> None:
     p.add_argument("--policy_dir", default=None)
     p.add_argument("--server_url", default=None,
                    help="drive a remote PolicyServer over HTTP instead of an "
-                        "in-process engine (traceparent propagation on)")
+                        "in-process engine (traceparent propagation on); "
+                        "alias for a single --target")
+    p.add_argument("--target", action="append", default=None, dest="targets",
+                   metavar="URL",
+                   help="repeatable: a /v1/act base URL (a host fleet, or "
+                        "the federation router).  Two or more targets "
+                        "round-robin the offered load and emit per-target "
+                        "client-overhead histograms")
     p.add_argument("--shape", default=None,
                    help="HTTP mode request shape: n_agent,obs_dim,state_dim,"
                         "action_dim")
@@ -263,18 +364,25 @@ def main(argv=None) -> None:
         tracer = Tracer(args.run_dir, sample=args.trace_sample,
                         max_mb=args.trace_max_mb)
     engine = batcher = None
-    if args.server_url:
-        # HTTP mode: the engine lives in the server process; this process is
-        # a pure client minting root spans + injecting traceparent headers
+    urls = ([args.server_url] if args.server_url else []) \
+        + list(args.targets or [])
+    if urls:
+        # HTTP mode: the engine lives in the server process(es); this process
+        # is a pure client minting root spans + injecting traceparent headers
         from mat_dcml_tpu.serving.server import HttpPolicyClient
 
         if not args.shape:
-            p.error("--server_url needs --shape n_agent,obs,state,action")
+            p.error("--server_url/--target needs "
+                    "--shape n_agent,obs,state,action")
         dims = [int(x) for x in args.shape.split(",")]
         if len(dims) != 4:
             p.error("--shape takes exactly four comma-separated ints")
-        client = HttpPolicyClient(args.server_url, cfg=_ShapeCfg(*dims),
-                                  tracer=tracer)
+        if len(urls) == 1:
+            client = HttpPolicyClient(urls[0], cfg=_ShapeCfg(*dims),
+                                      tracer=tracer)
+        else:
+            client = MultiTargetClient(urls, cfg=_ShapeCfg(*dims),
+                                       tracer=tracer)
     else:
         if not args.policy_dir:
             p.error("--policy_dir is required without --server_url")
@@ -292,7 +400,15 @@ def main(argv=None) -> None:
     if args.obs_port:
         from mat_dcml_tpu.telemetry.remote import TelemetrySidecar
 
-        tel = batcher.telemetry if batcher is not None else client.telemetry
+        if batcher is not None:
+            tel = batcher.telemetry
+        elif isinstance(client, MultiTargetClient):
+            # one labelled registry per endpoint joins the scrape plane (the
+            # merged facade only materializes its state on flush)
+            tel = {f"target{i}": c.telemetry
+                   for i, c in enumerate(client.clients)}
+        else:
+            tel = client.telemetry
         sidecar = TelemetrySidecar(tel, port=max(0, args.obs_port),
                                    label="loadgen")
         sidecar.start()

@@ -1,0 +1,875 @@
+"""Cross-host serving federation: a router tier that turns N fleets into one
+service.
+
+One :class:`~mat_dcml_tpu.serving.fleet.EngineFleet` is a single process — N
+replicas on one host's devices.  This module adds the tier above it (the
+Gemma-on-TPU topology from PAPERS.md: replica-per-chip, router-per-host,
+federation above): a stdlib-HTTP router that fronts N *host* endpoints, each
+a ``PolicyServer`` running a fleet (``scripts/serve_fleet.py``), and speaks
+the same JSON ``/v1/act`` protocol on both sides — so every existing client
+(``HttpPolicyClient``, the loadgen, the soak harness) drives a federation
+exactly like a single host.
+
+**Routing** — least-outstanding-requests over the healthy host pool with a
+health-penalty score (a host that has been failing requests ranks behind a
+clean sibling at equal depth) and a rotating tie-break, mirroring the fleet's
+replica router one level up.
+
+**Fault tolerance** — a host that refuses a connection, times out, or
+returns a 5xx is marked UNHEALTHY and the in-flight request is retried on a
+sibling host with bounded jittered exponential backoff (safe because decode
+is pure: a duplicate attempt returns identical bits).  A background prober
+re-polls ``GET /healthz`` on every host; ``probe_successes`` consecutive
+passes readmit an unhealthy host — the fleet's UNHEALTHY→probe→readmit state
+machine at host granularity.  An upstream 429 is *saturation*, not sickness:
+the host stays healthy, the router tries a sibling, and only when every host
+has shed does the client see an honest 429 whose ``Retry-After`` is the
+largest upstream hint (the earliest instant at which the WHOLE service could
+plausibly have capacity again — any smaller hint would bounce the client off
+the still-saturated slowest host).  Zero healthy hosts is a brownout 429
+derived from one probe-readmission cycle, exactly like the fleet's.
+
+**Tracing** — the router continues an inbound ``traceparent`` (or mints its
+own sampled root) and injects the SAME id upstream, so one trace id spans
+client → router → host fleet → replica; each upstream try is a ``route``
+span with the host id attached, and ``obs_report.py --source`` stitches the
+three tiers.
+
+**Generation-consistent push** — :meth:`ServiceRouter.push` rolls a new
+weight generation across hosts one at a time.  Each host runs its own
+canary gate (``RolloutController``); before the roll starts, the router
+scrapes every host's ``/telemetry.json`` and vetoes on any burning
+``slo_*_burn`` gauge (never widen a rollout into a burning service).  Any
+host failing mid-roll — gate verdict, HTTP error, or death — aborts to a
+full-service rollback of every already-promoted host, so no two hosts ever
+serve different generations steady-state.  ``router_generation_split`` is
+the flagged invariant.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import http.client
+import json
+import math
+import random
+import threading
+import time
+import urllib.error
+import urllib.request
+from typing import Dict, List, Optional, Tuple
+
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from mat_dcml_tpu.serving.batcher import (
+    DeadlineExceededError,
+    EngineFailureError,
+    QueueFullError,
+    ServingError,
+)
+from mat_dcml_tpu.telemetry.aggregate import TelemetryAggregator
+from mat_dcml_tpu.telemetry.anomaly import AnomalyConfig, AnomalyDetector
+from mat_dcml_tpu.telemetry.propagate import TRACEPARENT_HEADER
+from mat_dcml_tpu.telemetry.propagate import extract as extract_traceparent
+from mat_dcml_tpu.telemetry.propagate import inject as inject_traceparent
+from mat_dcml_tpu.telemetry.registry import Telemetry
+from mat_dcml_tpu.telemetry.remote import (
+    SNAPSHOT_PATH,
+    build_snapshot,
+    run_identity,
+)
+from mat_dcml_tpu.telemetry.slo import SLOMonitor
+from mat_dcml_tpu.telemetry.timeseries import TIMESERIES_PATH, RollupStore
+from mat_dcml_tpu.telemetry.tracing import Tracer
+
+# host health states: the fleet's replica-level vocabulary, one level up
+# (no canary state — canarying happens inside each host's fleet)
+HEALTHY = "healthy"
+UNHEALTHY = "unhealthy"
+
+_STATE_CODE = {UNHEALTHY: 0.0, HEALTHY: 1.0}
+
+# network-level failures that mean "this HOST is gone", not "this request is
+# bad" — connection refused/reset, DNS, socket timeout, torn HTTP framing
+_HOST_ERRORS = (urllib.error.URLError, http.client.HTTPException,
+                ConnectionError, OSError, TimeoutError)
+
+
+@dataclasses.dataclass(frozen=True)
+class RouterConfig:
+    max_retries: int = 2              # sibling-host retries per request
+    backoff_base_ms: float = 5.0      # jittered exponential backoff base
+    attempt_timeout_s: float = 60.0   # per-attempt HTTP budget (no deadline)
+    probe_interval_s: float = 0.25    # host /healthz probe cadence
+    probe_successes: int = 2          # consecutive passes before readmission
+    probe_timeout_s: float = 2.0      # per-probe HTTP budget
+    scrape_timeout_s: float = 2.0     # /telemetry.json fetch budget (push gate)
+    push_timeout_s: float = 600.0     # per-host /v1/push budget (canary waits)
+    push_burn_threshold: float = 1.0  # federated slo_*_burn veto level
+
+    def __post_init__(self):
+        if self.max_retries < 0:
+            raise ValueError("RouterConfig.max_retries must be >= 0")
+
+
+class Host:
+    """One upstream fleet endpoint + health record.  Mutable health fields
+    are guarded by the router lock."""
+
+    def __init__(self, hid: int, base_url: str):
+        self.hid = hid
+        self.base_url = base_url.rstrip("/")
+        self.state = HEALTHY
+        self.outstanding = 0
+        self.generation = 0
+        self.probe_ok = 0
+        self.requests = 0.0
+        self.failures = 0.0          # unhealthy marks + failed probes
+        self.sheds = 0.0             # upstream 429s (saturation, not sickness)
+        self.unhealthy_since: Optional[float] = None
+
+    def health_penalty(self) -> float:
+        """Degraded-path history as a routing tie-break: a host that has been
+        failing requests (or shedding) is a worse bet than a clean sibling at
+        equal outstanding depth."""
+        return self.failures * 1.0 + self.sheds * 0.25
+
+
+class ServiceRouter:
+    """N host fleets behind a load-aware router; the service-level twin of
+    :class:`~mat_dcml_tpu.serving.fleet.EngineFleet`'s replica router."""
+
+    def __init__(
+        self,
+        endpoints: List[str],
+        cfg: RouterConfig = RouterConfig(),
+        telemetry: Optional[Telemetry] = None,
+        tracer: Optional[Tracer] = None,
+        slo_monitor: Optional[SLOMonitor] = None,
+        log_fn=print,
+    ):
+        if not endpoints:
+            raise ValueError("ServiceRouter needs at least one host endpoint")
+        self.cfg = cfg
+        self.telemetry = telemetry if telemetry is not None else Telemetry()
+        self.tracer = tracer
+        self.slo = slo_monitor
+        self.log = log_fn
+        self.hosts = [Host(i, url) for i, url in enumerate(endpoints)]
+        self.current_generation = 0
+        self._lock = threading.Lock()
+        self._push_lock = threading.Lock()
+        self._rr = 0
+        self._closed = False
+        self.telemetry.gauge("router_hosts", float(len(self.hosts)))
+        self._prober = threading.Thread(
+            target=self._probe_loop, name="service-prober", daemon=True)
+        self._prober.start()
+
+    def close(self) -> None:
+        self._closed = True
+
+    # --------------------------------------------------------------- routing
+
+    def _pick(self, tried: set) -> Optional[Host]:
+        """Least-outstanding healthy host, health-penalty then rotating
+        tie-break — the fleet's ``_pick`` at host granularity."""
+        with self._lock:
+            self._rr += 1
+            pool = [h for h in self.hosts
+                    if h.state == HEALTHY and h.hid not in tried]
+            if not pool:
+                return None
+            n = len(self.hosts)
+            pool.sort(key=lambda h: (
+                h.outstanding,
+                h.health_penalty(),
+                (h.hid - self._rr) % n,
+            ))
+            choice = pool[0]
+            choice.outstanding += 1
+            choice.requests += 1
+            return choice
+
+    def route(self, body: bytes, timeout_s: Optional[float] = None,
+              trace=None, traceparent: Optional[str] = None) -> dict:
+        """Forward one ``/v1/act`` request body to the best host; retries on
+        sibling hosts when a host dies mid-request.  Returns the winning
+        host's reply payload (with ``router_host`` stamped on) or raises the
+        batcher's typed :class:`ServingError` family — so the router's HTTP
+        frontend and every existing client keep their error mapping."""
+        if self._closed:
+            raise ServingError("service router is closed")
+        self.telemetry.count("router_requests")
+        tried: set = set()
+        attempts = 0
+        sheds: List[float] = []
+        wait = (self.cfg.attempt_timeout_s if timeout_s is None
+                else float(timeout_s) + 5.0)
+        while True:
+            host = self._pick(tried)
+            if host is None:
+                if sheds:
+                    # every live host refused admission — service-level shed
+                    # with the LARGEST upstream hint: the whole service has
+                    # capacity only once its slowest host does
+                    self.telemetry.count("router_shed")
+                    raise QueueFullError(
+                        "all hosts at capacity",
+                        retry_after_s=max(sheds))
+                # total outage: honest brownout, hint = one probe-readmission
+                # cycle (same derivation as the fleet's)
+                self.telemetry.count("router_no_healthy")
+                self.telemetry.count("router_brownout")
+                retry_after = max(1, math.ceil(
+                    self.cfg.probe_interval_s
+                    * max(1, self.cfg.probe_successes)))
+                raise QueueFullError(
+                    "service brownout: no healthy hosts (probes will "
+                    "readmit)", retry_after_s=retry_after)
+            t0 = time.perf_counter()
+            try:
+                payload = self._post_act(host, body, wait, trace, traceparent)
+            except urllib.error.HTTPError as e:
+                with self._lock:
+                    host.outstanding -= 1
+                try:
+                    err = json.loads(e.read() or b"{}")
+                except (ValueError, json.JSONDecodeError):
+                    err = {}
+                if trace is not None:
+                    trace.add_span("route", t0, time.perf_counter(),
+                                   host=host.hid, retry=attempts, ok=False,
+                                   status=f"http_{e.code}")
+                if e.code == 429:
+                    # saturation, not sickness: the host is alive and honest
+                    # about its queue — try a sibling, remember the hint
+                    with self._lock:
+                        host.sheds += 1
+                    tried.add(host.hid)
+                    sheds.append(float(err.get("retry_after_s", 1)))
+                    continue
+                if e.code == 400:
+                    # caller bug, not host health — propagate verbatim
+                    raise ValueError(
+                        err.get("error", "bad request")) from None
+                if e.code == 504:
+                    # the request's own budget elapsed — retrying can't help
+                    raise DeadlineExceededError(
+                        err.get("error", "deadline exceeded")) from None
+                # 5xx: the host's engine is failing — fail over
+                self._mark_unhealthy(host, f"HTTP {e.code}: "
+                                     f"{err.get('error', '')!r}")
+                attempts = self._retry_or_raise(attempts, tried, host)
+                continue
+            except _HOST_ERRORS as e:
+                with self._lock:
+                    host.outstanding -= 1
+                if trace is not None:
+                    trace.add_span("route", t0, time.perf_counter(),
+                                   host=host.hid, retry=attempts, ok=False,
+                                   status=e.__class__.__name__)
+                self._mark_unhealthy(host, repr(e))
+                attempts = self._retry_or_raise(attempts, tried, host)
+                continue
+            with self._lock:
+                host.outstanding -= 1
+            self.telemetry.hist("router_upstream_ms",
+                                (time.perf_counter() - t0) * 1e3)
+            if trace is not None:
+                trace.add_span("route", t0, time.perf_counter(),
+                               host=host.hid, retry=attempts, ok=True)
+            if attempts:
+                self.telemetry.count("router_failovers")
+            payload["router_host"] = host.hid
+            return payload
+
+    def _post_act(self, host: Host, body: bytes, wait: float, trace,
+                  traceparent: Optional[str]) -> dict:
+        headers = {"Content-Type": "application/json"}
+        if trace is not None:
+            # the SAME trace id rides upstream: client → router → host fleet
+            inject_traceparent(headers, trace)
+        elif traceparent:
+            # not sampled at this tier, but the client's header still flows
+            # through so the host can continue the client's id
+            headers[TRACEPARENT_HEADER] = traceparent
+        req = urllib.request.Request(host.base_url + "/v1/act", data=body,
+                                     headers=headers, method="POST")
+        with urllib.request.urlopen(req, timeout=wait) as resp:
+            return json.loads(resp.read())
+
+    def _retry_or_raise(self, attempts: int, tried: set, failed: Host) -> int:
+        """Bounded jittered-backoff failover bookkeeping; returns the new
+        attempt count or raises once the retry budget is spent."""
+        tried.add(failed.hid)
+        if attempts >= self.cfg.max_retries:
+            self.telemetry.count("router_retries_exhausted")
+            raise EngineFailureError(
+                f"request failed on {attempts + 1} hosts")
+        attempts += 1
+        self.telemetry.count("router_retries")
+        base = self.cfg.backoff_base_ms / 1e3
+        time.sleep(base * (2 ** (attempts - 1)) * (0.5 + random.random()))
+        return attempts
+
+    # ---------------------------------------------------------------- health
+
+    def _mark_unhealthy(self, host: Host, why: str) -> None:
+        with self._lock:
+            host.failures += 1
+            if host.state == UNHEALTHY:
+                return
+            host.state = UNHEALTHY
+            host.probe_ok = 0
+            host.unhealthy_since = time.monotonic()
+        self.telemetry.count("router_unhealthy_marks")
+        self.log(f"[service] host {host.hid} ({host.base_url}) marked "
+                 f"UNHEALTHY: {why}")
+
+    def _probe_host(self, host: Host) -> Optional[dict]:
+        """One ``GET /healthz`` against the host; payload dict or None."""
+        try:
+            with urllib.request.urlopen(
+                    host.base_url + "/healthz",
+                    timeout=self.cfg.probe_timeout_s) as resp:
+                return json.loads(resp.read())
+        except (*_HOST_ERRORS, ValueError, json.JSONDecodeError):
+            return None
+
+    def _probe_loop(self) -> None:
+        """Probe every host each cycle: a live ``/healthz`` refreshes the
+        host's advertised weight generation; ``probe_successes`` consecutive
+        passes readmit an UNHEALTHY host; a failed probe of a healthy host
+        marks it (so an idle router still notices a dead host)."""
+        while not self._closed:
+            time.sleep(self.cfg.probe_interval_s)
+            if self._closed:
+                return
+            for host in self.hosts:
+                self.telemetry.count("router_probes")
+                payload = self._probe_host(host)
+                if payload is None:
+                    self.telemetry.count("router_probe_failures")
+                    if host.state == UNHEALTHY:
+                        host.probe_ok = 0
+                    else:
+                        self._mark_unhealthy(host, "healthz probe failed")
+                    continue
+                gen = (payload.get("fleet") or {}).get("generation")
+                if gen is not None:
+                    with self._lock:
+                        host.generation = int(gen)
+                if host.state != UNHEALTHY:
+                    continue
+                host.probe_ok += 1
+                if host.probe_ok >= self.cfg.probe_successes:
+                    with self._lock:
+                        host.state = HEALTHY
+                        host.unhealthy_since = None
+                    self.telemetry.count("router_readmissions")
+                    self.log(f"[service] host {host.hid} readmitted after "
+                             f"{host.probe_ok} clean probes")
+
+    # ------------------------------------------------------------ weight push
+
+    def _host_burns(self, host: Host) -> Dict[str, float]:
+        """The host's live ``slo_*_burn`` gauges from its federated
+        ``/telemetry.json`` snapshot (``extra_gauges`` rider)."""
+        try:
+            with urllib.request.urlopen(
+                    host.base_url + SNAPSHOT_PATH,
+                    timeout=self.cfg.scrape_timeout_s) as resp:
+                snap = json.loads(resp.read())
+        except (*_HOST_ERRORS, ValueError, json.JSONDecodeError):
+            return {}
+        return {k: float(v)
+                for k, v in (snap.get("extra_gauges") or {}).items()
+                if k.endswith("_burn")}
+
+    def _post_json(self, host: Host, path: str, payload: dict,
+                   timeout_s: float) -> Tuple[int, dict]:
+        req = urllib.request.Request(
+            host.base_url + path, data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"}, method="POST")
+        try:
+            with urllib.request.urlopen(req, timeout=timeout_s) as resp:
+                return resp.status, json.loads(resp.read())
+        except urllib.error.HTTPError as e:
+            try:
+                return e.code, json.loads(e.read() or b"{}")
+            except (ValueError, json.JSONDecodeError):
+                return e.code, {}
+
+    def push(self, policy_dir: str) -> dict:
+        """Generation-consistent weight push across every host.
+
+        Gate order: (1) federated SLO burn — every host's scraped
+        ``slo_*_burn`` must be under ``push_burn_threshold``; (2) each host's
+        own canary gate (``POST /v1/push`` blocks on its
+        ``RolloutController``), rolled one host at a time.  ANY host failing
+        — gate verdict, HTTP error, or mid-roll death — aborts to a
+        full-service rollback of every already-promoted host.  Steady state
+        therefore never has two hosts on different generations."""
+        if not self._push_lock.acquire(blocking=False):
+            raise RuntimeError("a service push is already in progress")
+        try:
+            return self._push_locked(policy_dir)
+        finally:
+            self._push_lock.release()
+
+    def _push_locked(self, policy_dir: str) -> dict:
+        t_start = time.perf_counter()
+        report: dict = {"status": "", "policy_dir": str(policy_dir),
+                        "prior_generation": self.current_generation,
+                        "hosts": {}, "events": []}
+
+        # (1) never widen a rollout into a burning service: any host's live
+        # burn at/past threshold vetoes before the first host swaps
+        for host in self.hosts:
+            hot = {k: v for k, v in self._host_burns(host).items()
+                   if v >= self.cfg.push_burn_threshold}
+            if hot:
+                self.telemetry.count("router_slo_gated")
+                report["status"] = "rejected"
+                report["events"].append(
+                    {"host": host.hid, "slo_gated": hot})
+                self.log(f"[service] push REJECTED: host {host.hid} SLO "
+                         f"budget burning ({hot})")
+                return report
+
+        promoted: List[Host] = []
+        generation = None
+        for host in self.hosts:
+            try:
+                code, host_report = self._post_json(
+                    host, "/v1/push", {"policy_dir": str(policy_dir)},
+                    self.cfg.push_timeout_s)
+            except _HOST_ERRORS as e:
+                code, host_report = 0, {"status": "unreachable",
+                                        "error": repr(e)}
+            report["hosts"][host.hid] = host_report
+            status = host_report.get("status", "")
+            if code == 200 and status == "promoted":
+                promoted.append(host)
+                generation = int(host_report.get(
+                    "generation", self.current_generation + 1))
+                with self._lock:
+                    host.generation = generation
+                continue
+            # host gate tripped / host died mid-roll: full-service rollback
+            self.telemetry.count("router_push_failures")
+            self._mark_unhealthy(host, f"push failed ({status or code})")
+            self._rollback_hosts(promoted, report)
+            report["status"] = "rolled_back"
+            report["failed_host"] = host.hid
+            report["wall_s"] = time.perf_counter() - t_start
+            self.telemetry.count("router_rollbacks")
+            self.log(f"[service] push ROLLED BACK: host {host.hid} "
+                     f"{status or f'HTTP {code}'} — {len(promoted)} host(s) "
+                     f"reverted")
+            return report
+
+        self.current_generation = (generation if generation is not None
+                                   else self.current_generation)
+        self.telemetry.count("router_pushes")
+        report["status"] = "promoted"
+        report["generation"] = self.current_generation
+        report["wall_s"] = time.perf_counter() - t_start
+        self.log(f"[service] push PROMOTED to generation "
+                 f"{self.current_generation} across {len(self.hosts)} hosts")
+        return report
+
+    def _rollback_hosts(self, hosts: List[Host], report: dict) -> None:
+        for host in hosts:
+            try:
+                code, rb = self._post_json(host, "/v1/rollback", {},
+                                           self.cfg.push_timeout_s)
+            except _HOST_ERRORS as e:
+                code, rb = 0, {"error": repr(e)}
+            report["events"].append(
+                {"host": host.hid, "rollback": rb, "code": code})
+            if code == 200:
+                with self._lock:
+                    host.generation = int(
+                        rb.get("generation", host.generation))
+
+    def rollback(self) -> dict:
+        """Manual full-service rollback: every host reverts to its prior
+        promoted manifest."""
+        report: dict = {"status": "rolled_back", "hosts": {}}
+        failed = 0
+        for host in self.hosts:
+            try:
+                code, rb = self._post_json(host, "/v1/rollback", {},
+                                           self.cfg.push_timeout_s)
+            except _HOST_ERRORS as e:
+                code, rb = 0, {"error": repr(e)}
+            report["hosts"][host.hid] = rb
+            if code == 200:
+                with self._lock:
+                    host.generation = int(
+                        rb.get("generation", host.generation))
+            else:
+                failed += 1
+        self.telemetry.count("router_rollbacks")
+        if failed == len(self.hosts):
+            raise RuntimeError("rollback failed on every host")
+        gens = {h.generation for h in self.hosts}
+        if len(gens) == 1:
+            self.current_generation = gens.pop()
+        report["generation"] = self.current_generation
+        return report
+
+    # ------------------------------------------------------------ accounting
+
+    def status(self) -> dict:
+        """Human/HTTP-facing service state (the ``/service`` endpoint)."""
+        with self._lock:
+            hosts = [{
+                "hid": h.hid,
+                "url": h.base_url,
+                "state": h.state,
+                "outstanding": h.outstanding,
+                "generation": h.generation,
+                "requests": h.requests,
+                "failures": h.failures,
+            } for h in self.hosts]
+        gens = {h["generation"] for h in hosts}
+        return {
+            "hosts": hosts,
+            "healthy": sum(1 for h in hosts if h["state"] == HEALTHY),
+            "generation": self.current_generation,
+            "generation_split": len(gens) > 1,
+            "push_in_progress": self._push_lock.locked(),
+        }
+
+    def sync_gauges(self) -> None:
+        """Refresh the point-in-time service gauges on the registry.
+        Counters and the upstream latency sketch accrue live, but
+        health/generation are derived state — materialized scrape-driven
+        (each ``/metrics`` / ``/telemetry.json`` hit), the same cadence
+        trick the telemetry sidecar uses for rollup sampling."""
+        with self._lock:
+            hosts = list(self.hosts)
+            healthy = sum(1 for h in hosts if h.state == HEALTHY)
+            gens = {h.generation for h in hosts}
+        self.telemetry.gauge("router_hosts", float(len(hosts)))
+        self.telemetry.gauge("router_healthy", float(healthy))
+        self.telemetry.gauge("router_generation",
+                             float(self.current_generation))
+        self.telemetry.gauge("router_generation_split",
+                             1.0 if len(gens) > 1 else 0.0)
+        for h in hosts:
+            prefix = f"host_{h.hid}"
+            self.telemetry.gauge(f"{prefix}_state", _STATE_CODE[h.state])
+            self.telemetry.gauge(f"{prefix}_outstanding",
+                                 float(h.outstanding))
+            self.telemetry.gauge(f"{prefix}_generation",
+                                 float(h.generation))
+            self.telemetry.gauge(f"{prefix}_requests", h.requests)
+            self.telemetry.gauge(f"{prefix}_failures", h.failures)
+
+    def service_record(self) -> Dict[str, float]:
+        """Flat metrics.jsonl fragment: the ``router_``/``host_`` families
+        (`scripts/check_metrics_schema.py` REQUIRED_ROUTER contract) plus the
+        upstream latency sketch and live SLO gauges."""
+        c = self.telemetry.counters
+        with self._lock:
+            hosts = list(self.hosts)
+            healthy = sum(1 for h in hosts if h.state == HEALTHY)
+            gens = {h.generation for h in hosts}
+        record: Dict[str, float] = {
+            "router_hosts": float(len(hosts)),
+            "router_healthy": float(healthy),
+            "router_requests": c.get("router_requests", 0.0),
+            "router_retries": c.get("router_retries", 0.0),
+            "router_retries_exhausted": c.get("router_retries_exhausted", 0.0),
+            "router_failovers": c.get("router_failovers", 0.0),
+            "router_shed": c.get("router_shed", 0.0),
+            "router_no_healthy": c.get("router_no_healthy", 0.0),
+            "router_brownout": c.get("router_brownout", 0.0),
+            "router_unhealthy_marks": c.get("router_unhealthy_marks", 0.0),
+            "router_readmissions": c.get("router_readmissions", 0.0),
+            "router_probes": c.get("router_probes", 0.0),
+            "router_probe_failures": c.get("router_probe_failures", 0.0),
+            "router_pushes": c.get("router_pushes", 0.0),
+            "router_rollbacks": c.get("router_rollbacks", 0.0),
+            "router_push_failures": c.get("router_push_failures", 0.0),
+            "router_slo_gated": c.get("router_slo_gated", 0.0),
+            "router_generation": float(self.current_generation),
+            "router_generation_split": 1.0 if len(gens) > 1 else 0.0,
+        }
+        # per-host labels: one flat field per (host, signal)
+        for h in hosts:
+            prefix = f"host_{h.hid}"
+            record[f"{prefix}_state"] = _STATE_CODE[h.state]
+            record[f"{prefix}_outstanding"] = float(h.outstanding)
+            record[f"{prefix}_generation"] = float(h.generation)
+            record[f"{prefix}_requests"] = h.requests
+            record[f"{prefix}_failures"] = h.failures
+        sk = self.telemetry.hists.get("router_upstream_ms")
+        if sk is not None and sk.count:
+            record.update(sk.snapshot("router_upstream_ms"))
+        if self.slo is not None:
+            record.update(self.slo.gauges())
+        return record
+
+
+class _RouterHandler(BaseHTTPRequestHandler):
+    server_version = "mat-dcml-service/1"
+
+    def log_message(self, fmt, *args):   # route through the server's logger
+        self.server.log_fn("[service] " + fmt % args)
+
+    def _reply(self, code: int, payload: dict, headers=None) -> None:
+        body = json.dumps(payload).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        for name, value in (headers or {}).items():
+            self.send_header(name, value)
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _reply_text(self, code: int, text: str, content_type: str) -> None:
+        body = text.encode()
+        self.send_response(code)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):
+        srv: "RouterServer" = self.server.router_server
+        if self.path == "/metrics":
+            self._reply_text(200, srv.metrics_text(),
+                             "text/plain; version=0.0.4; charset=utf-8")
+        elif self.path == SNAPSHOT_PATH:
+            self._reply(200, srv.telemetry_snapshot())
+        elif self.path == TIMESERIES_PATH:
+            self._reply(200, srv.timeseries_snapshot())
+        elif self.path == "/healthz":
+            status = srv.router.status()
+            self._reply(200, {
+                "ok": True,
+                "service": {"hosts": len(status["hosts"]),
+                            "healthy": status["healthy"],
+                            "generation": status["generation"]}})
+        elif self.path == "/service":
+            self._reply(200, srv.router.status())
+        else:
+            self._reply(404, {"error": f"no route {self.path}"})
+
+    def do_POST(self):
+        srv: "RouterServer" = self.server.router_server
+        if self.path == "/v1/push":
+            self._do_push(srv)
+            return
+        if self.path == "/v1/rollback":
+            self._do_rollback(srv)
+            return
+        if self.path != "/v1/act":
+            self._reply(404, {"error": f"no route {self.path}"})
+            return
+        length = int(self.headers.get("Content-Length", "0"))
+        body = self.rfile.read(length)
+        try:
+            # the body is forwarded verbatim; only timeout_s is peeked (the
+            # host enforces the deadline — the router just sizes its wait)
+            timeout_s = json.loads(body).get("timeout_s")
+        except (ValueError, json.JSONDecodeError) as e:
+            self._reply(400, {"error": f"malformed request: {e!r}"})
+            return
+        # ingress: continue the client-minted trace id (the client made the
+        # sampling decision) or mint a sampled root — either way the SAME id
+        # is injected upstream, so one trace spans all three tiers
+        traceparent = self.headers.get(TRACEPARENT_HEADER)
+        trace = None
+        if srv.tracer is not None:
+            remote_id = extract_traceparent(self.headers)
+            trace = (srv.tracer.continue_trace(remote_id, "router")
+                     if remote_id else srv.tracer.start_trace("router"))
+        t0 = time.monotonic()
+        try:
+            payload = srv.router.route(body, timeout_s, trace=trace,
+                                       traceparent=traceparent)
+        except QueueFullError as e:
+            srv.observe_request(t0, ok=False, trace=trace, status="shed")
+            self._reply(429, {"error": str(e), "kind": "queue_full",
+                              "retry_after_s": getattr(e, "retry_after_s", 1)},
+                        headers={"Retry-After":
+                                 str(getattr(e, "retry_after_s", 1))})
+        except DeadlineExceededError as e:
+            srv.observe_request(t0, ok=False, trace=trace, status="deadline")
+            self._reply(504, {"error": str(e), "kind": "deadline_exceeded"})
+        except ValueError as e:
+            # caller bug, not service health: finish the trace, spare the SLO
+            if trace is not None:
+                trace.finish(status="bad_shape")
+            self._reply(400, {"error": str(e), "kind": "bad_shape"})
+        except Exception as e:  # retries exhausted / unexpected
+            srv.observe_request(t0, ok=False, trace=trace, status="error")
+            self._reply(500, {"error": repr(e), "kind": "engine_failure"})
+        else:
+            payload["router_ms"] = (time.monotonic() - t0) * 1e3
+            srv.observe_request(t0, ok=True, trace=trace, status="ok")
+            self._reply(200, payload)
+
+    def _do_push(self, srv: "RouterServer") -> None:
+        try:
+            length = int(self.headers.get("Content-Length", "0"))
+            req = json.loads(self.rfile.read(length))
+            policy_dir = req["policy_dir"]
+        except (KeyError, ValueError, json.JSONDecodeError) as e:
+            self._reply(400, {"error": f"malformed request: {e!r}"})
+            return
+        try:
+            report = srv.router.push(policy_dir)
+        except RuntimeError as e:       # push already in progress
+            self._reply(409, {"error": str(e), "kind": "push_in_progress"})
+        except Exception as e:
+            self._reply(500, {"error": repr(e), "kind": "push_failure"})
+        else:
+            self._reply(200, report)
+
+    def _do_rollback(self, srv: "RouterServer") -> None:
+        try:
+            report = srv.router.rollback()
+        except RuntimeError as e:       # nothing to roll back to anywhere
+            self._reply(409, {"error": str(e), "kind": "no_prior"})
+        except Exception as e:
+            self._reply(500, {"error": repr(e), "kind": "rollback_failure"})
+        else:
+            self._reply(200, report)
+
+
+class RouterServer:
+    """HTTP frontend over a :class:`ServiceRouter` — the service twin of
+    :class:`~mat_dcml_tpu.serving.server.PolicyServer`.  Same routes, same
+    typed-rejection mapping, so ``HttpPolicyClient`` and the loadgen drive
+    the federation URL exactly like a single host.  ``start()`` binds and
+    serves on a background thread; ``port=0`` picks a free port (tests)."""
+
+    def __init__(
+        self,
+        router: ServiceRouter,
+        host: str = "127.0.0.1",
+        port: int = 8520,
+        log_fn=print,
+        tracer: Optional[Tracer] = None,
+        slo_monitor: Optional[SLOMonitor] = None,
+        anomaly_cfg: AnomalyConfig = AnomalyConfig(),
+    ):
+        self.router = router
+        self.tracer = tracer if tracer is not None else router.tracer
+        self.slo = slo_monitor if slo_monitor is not None else router.slo
+        self._slo_detector = (
+            AnomalyDetector(
+                anomaly_cfg,
+                exemplar_fn=lambda: (self.tracer.last_trace_id
+                                     if self.tracer is not None else None))
+            if self.slo is not None else None)
+        self.anomalies: list = []
+        self._slo_seen = 0
+        self._snapshot_seq = 0
+        self._ts_seq = 0
+        self._snapshot_lock = threading.Lock()
+        self.rollup = RollupStore()
+        self.log_fn = log_fn
+        self._httpd = ThreadingHTTPServer((host, port), _RouterHandler)
+        self._httpd.router_server = self
+        self._httpd.log_fn = log_fn
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    # --------------------------------------------------------- observability
+
+    def metrics_text(self) -> str:
+        self.router.sync_gauges()
+        agg = TelemetryAggregator([("router", self.router.telemetry)])
+        extra = self.slo.gauges() if self.slo is not None else None
+        return agg.prometheus_text(extra_gauges=extra)
+
+    def telemetry_snapshot(self) -> dict:
+        """``GET /telemetry.json`` payload (telemetry/remote.py wire format)
+        for the router's OWN registry — host fleets expose their own
+        endpoints; a collector scrapes all N+1 and merges."""
+        with self._snapshot_lock:
+            self._snapshot_seq += 1
+            seq = self._snapshot_seq
+        self.router.sync_gauges()
+        self.router.telemetry.count("obs_snapshot_requests")
+        extra = self.slo.gauges() if self.slo is not None else None
+        return build_snapshot(f"router:{self.port}",
+                              [("router", self.router.telemetry)], seq,
+                              extra_gauges=extra)
+
+    def timeseries_snapshot(self) -> dict:
+        """``GET /timeseries.json`` payload: scrape-driven sampling into the
+        rollup store (PolicyServer's contract, router families)."""
+        self.router.sync_gauges()
+        with self._snapshot_lock:
+            self._ts_seq += 1
+            seq = self._ts_seq
+            t = time.time()
+            self.rollup.observe_telemetry(self.router.telemetry, t=t,
+                                          source="router")
+            if self.slo is not None:
+                self.rollup.observe_record(self.slo.gauges(), t=t)
+            wire = self.rollup.to_wire()
+        snap = {
+            "source": f"router:{self.port}",
+            "seq": seq,
+            "time_s": t,
+            "rollup": wire,
+        }
+        snap.update(run_identity())
+        return snap
+
+    def observe_request(self, t0: float, ok: bool, trace=None,
+                        status: str = "ok") -> None:
+        """Terminal accounting for one routed request: finish the ingress
+        trace and feed the service-level SLO monitor (amortized burn-rate
+        tripwire checks, same cadence as the fleet's)."""
+        if trace is not None:
+            trace.finish(status=status)
+        if self.slo is None:
+            return
+        self.slo.observe_request((time.monotonic() - t0) * 1e3, ok=ok)
+        self._slo_seen += 1
+        if self._slo_detector is not None and self._slo_seen % 16 == 0:
+            from mat_dcml_tpu.chaos import inject as _chaos
+            trips = self._slo_detector.observe(
+                self.slo.burn_signals(), episode=0,
+                total_steps=int(self.slo.total_requests))
+            for a in trips:
+                if _chaos.ACTIVE is not None:
+                    event_id = _chaos.ACTIVE.suppression_for(a.kind)
+                    if event_id is not None:
+                        self.log_fn(f"[service] SLO anomaly {a.kind} "
+                                    f"suppressed — expected under chaos "
+                                    f"event {event_id}")
+                        continue
+                self.anomalies.append(a.to_record())
+                self.log_fn(f"[service] SLO budget anomaly: {a.kind}")
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="service-http",
+            daemon=True)
+        self._thread.start()
+        self.log_fn(
+            f"[service] router listening on "
+            f"http://{self._httpd.server_address[0]}:{self.port} "
+            f"({len(self.router.hosts)} hosts)")
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+        self.router.close()

@@ -3,7 +3,8 @@
 The soak verdict layer.  :class:`IncidentCorrelator` consumes the repo's
 typed observability records — anomaly trips (incl. ``slo_*`` burn budgets),
 ``chaos`` fired/suppressed/cleared, emergency checkpoints, supervisor relaunch
-lineage, scrape-health transitions, fleet replica health — and groups them
+lineage, scrape-health transitions, fleet replica and service host health —
+and groups them
 into incidents via time proximity plus causal keys: chaos event ids (PR 15's
 suppression keys), trace exemplars, ``run_id``/``incarnation``.
 
@@ -39,7 +40,7 @@ def suppression_map() -> Dict[str, tuple]:
 # symptom kinds the correlator derives itself (not anomaly-detector kinds)
 KILL_KINDS = ("trainer_kill",)
 CRITICAL_KINDS = ("nonfinite", "supervisor_kill", "supervisor_relaunch",
-                  "fleet_no_healthy")
+                  "fleet_no_healthy", "service_no_healthy")
 
 # causal keys for correlator-derived symptoms: which injected fault kinds
 # explain them (the anomaly-kind prefixes come from the chaos suppression
@@ -50,6 +51,10 @@ SYMPTOM_FAULTS: Dict[str, tuple] = {
     "scrape_degraded": ("trainer_kill", "replica_crash", "replica_hang"),
     "supervisor_kill": KILL_KINDS,
     "supervisor_relaunch": KILL_KINDS,
+    # service tier (router over N host fleets): a killed host shows up as a
+    # router_healthy drop in the federation leg's records
+    "service_host_down": ("host_loss",),
+    "service_no_healthy": ("host_loss",),
 }
 
 LIFECYCLE = ("open", "mitigated", "resolved", "annotated")
@@ -133,9 +138,10 @@ class IncidentCorrelator:
         self._records: List[Dict] = []
         self._t = 0.0
         self.flaps_suppressed = 0
-        # scrape / fleet transition state
+        # scrape / fleet / service transition state
         self._last_scrape: Dict[str, float] = {}
         self._last_fleet_healthy: Optional[float] = None
+        self._last_router_healthy: Optional[float] = None
 
     # ------------------------------------------------------------ fault plane
 
@@ -287,6 +293,17 @@ class IncidentCorrelator:
                         else "fleet_unhealthy")
                 self._symptom(kind, t)
             self._last_fleet_healthy = float(healthy)
+        # service host health drops (router tier, one level above the fleet)
+        healthy = record.get("router_healthy")
+        hosts = record.get("router_hosts")
+        if isinstance(healthy, (int, float)) and \
+                isinstance(hosts, (int, float)):
+            prev = self._last_router_healthy
+            if healthy < hosts and (prev is None or healthy < prev):
+                kind = ("service_no_healthy" if healthy == 0
+                        else "service_host_down")
+                self._symptom(kind, t)
+            self._last_router_healthy = float(healthy)
 
     # ---------------------------------------------------------- incident core
 

@@ -2,7 +2,7 @@
 """One-command chaos soak: run a FaultPlan against the whole stack, check
 invariants, emit a pass/fail ``chaos_report.json``.
 
-Boots up to three legs, partitioned by the plan's fault planes:
+Boots up to four legs, partitioned by the plan's fault planes:
 
 * **serving** — an in-process 2-replica ``EngineFleet`` (tiny MAT config, the
   test-suite buckets so the persistent compile cache hits) under paced
@@ -10,6 +10,13 @@ Boots up to three legs, partitioned by the plan's fault planes:
   ``load_spike`` events multiply the offered load; after the last fault
   clears the leg keeps serving until every ``slo_*_burn`` gauge is back
   under 1.0.
+* **service** — the cross-host federation: three real host fleets
+  (``tests/service_worker.py`` subprocesses) behind an in-process
+  ``ServiceRouter`` + HTTP frontend, under paced loadgen slices driven
+  through the router.  ``host_loss`` events are delivered by THIS process as
+  genuine SIGKILLs of the matching host subprocess; the leg demands zero
+  client-visible drops, one trace id stitching client → router → host, and
+  one uniform service generation throughout.
 * **train_sync** — a real trainer subprocess (``tests/chaos_worker.py``) with
   the sync-plane events armed inside it.  ``trainer_kill`` events are
   delivered by THIS process as genuine SIGTERMs after the scheduled number
@@ -203,6 +210,213 @@ def run_serving_leg(plan: FaultPlan, out: Path, duration_s: float) -> dict:
     # see every symptom the fleet surfaced, not just the injector's log
     anomalies = list(getattr(fleet, "anomalies", []))
     return {"leg": leg, "records": slices + injector.records() + anomalies}
+
+
+# ---------------------------------------------------------- federation leg
+
+
+FEDERATION_HOSTS = 3
+
+
+def _read_traces(run_dir: Path) -> list:
+    """(tier, record) pairs from every trace.jsonl under ``run_dir`` — a
+    SIGKILLed host may leave a torn tail line, which is skipped."""
+    out = []
+    for path in sorted(Path(run_dir).rglob("trace.jsonl")):
+        tier = path.parent.name
+        tier = "host" if tier.startswith("host") else tier
+        for line in path.read_text().splitlines():
+            try:
+                out.append((tier, json.loads(line)))
+            except json.JSONDecodeError:
+                continue
+    return out
+
+
+def run_federation_leg(plan: FaultPlan, out: Path, duration_s: float) -> dict:
+    """Three real host fleets behind the service router, with ``host_loss``
+    kills delivered as SIGKILLs to the matching subprocess.  Pins the
+    acceptance criterion in soak form: zero dropped requests, one trace id
+    across all three tiers, one service generation."""
+    from mat_dcml_tpu.models.mat import MATConfig
+    from mat_dcml_tpu.serving.loadgen import run_load
+    from mat_dcml_tpu.serving.router import (
+        RouterConfig,
+        RouterServer,
+        ServiceRouter,
+    )
+    from mat_dcml_tpu.serving.server import HttpPolicyClient
+    from mat_dcml_tpu.telemetry.slo import SLOConfig, SLOMonitor
+    from mat_dcml_tpu.telemetry.tracing import Tracer
+    from mat_dcml_tpu.utils.metrics import MetricsWriter
+
+    fed_out = out / "federation"
+    fed_out.mkdir(parents=True, exist_ok=True)
+    cfg = MATConfig(n_agent=3, obs_dim=4, state_dim=5, action_dim=3,
+                    n_block=1, n_embd=16, n_head=2)
+    sub = plan.filter(planes=("service",))
+    leg = {"hosts": FEDERATION_HOSTS, "killed": [], "errors": []}
+    slices: list = []
+    procs: list = []
+    line_bufs: list = []
+
+    def spawn(i: int):
+        proc = subprocess.Popen(
+            [sys.executable, str(REPO / "tests" / "service_worker.py"),
+             "--run_dir", str(fed_out / f"host{i}"), "--linger_s", "600"],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            cwd=str(REPO), env=_worker_env())
+        lines: list = []
+
+        def pump():
+            for line in proc.stdout:
+                lines.append(line.rstrip("\n"))
+
+        threading.Thread(target=pump, daemon=True).start()
+        procs.append(proc)
+        line_bufs.append(lines)
+        return proc, lines
+
+    def wait_port(proc, lines, timeout=300.0) -> int:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            for ln in list(lines):
+                if ln.startswith("PORT"):
+                    return int(ln.split()[1])
+            if proc.poll() is not None:
+                raise RuntimeError(
+                    f"host exited rc={proc.returncode}:\n"
+                    + "\n".join(lines[-30:]))
+            time.sleep(0.05)
+        raise RuntimeError("timed out waiting for host PORT")
+
+    log(f"[soak] federation leg: warming {FEDERATION_HOSTS} host fleets ...")
+    router = server = injector = writer = None
+    router_tracer = client_tracer = None
+    try:
+        for i in range(FEDERATION_HOSTS):
+            spawn(i)
+        ports = [wait_port(p, ln) for p, ln in zip(procs, line_bufs)]
+        router_tracer = Tracer(str(fed_out / "router"), sample=1.0)
+        client_tracer = Tracer(str(fed_out / "client"), sample=1.0)
+        router = ServiceRouter(
+            [f"http://127.0.0.1:{p}" for p in ports],
+            RouterConfig(backoff_base_ms=2.0),
+            tracer=router_tracer,
+            slo_monitor=SLOMonitor(SLOConfig(latency_p99_ms=250.0)),
+            log_fn=log)
+        server = RouterServer(router, port=0, log_fn=log)
+        server.start()
+        client = HttpPolicyClient(f"http://127.0.0.1:{server.port}",
+                                  cfg=cfg, tracer=client_tracer)
+        injector = FaultInjector(sub, telemetry=router.telemetry,
+                                 record_sink=jsonl_sink(
+                                     fed_out / "metrics.jsonl"),
+                                 log=log)
+        writer = MetricsWriter(fed_out)
+
+        def deliver_kills():
+            for hid in range(FEDERATION_HOSTS):
+                hit = injector.claim_host_loss(f"h{hid}")
+                if hit is None:
+                    continue
+                if procs[hid].poll() is None:
+                    procs[hid].kill()
+                    procs[hid].wait(timeout=30)
+                leg["killed"].append(hid)
+                log(f"[soak] federation: SIGKILLed host {hid} "
+                    f"({hit[0].event_id})")
+
+        def slice_record(i: int, n: int) -> dict:
+            rec = run_load(client, n_requests=n, concurrency=4,
+                           seed=200 + i, slo_ms=250.0)
+            rec.update(router.service_record())
+            rec.update({k: v for k, v in router.telemetry.counters.items()
+                        if k.startswith("chaos_")})
+            writer.write(rec)
+            slices.append(rec)
+            return rec
+
+        arm(injector)
+        injector.start()
+        horizon = max(float(duration_s), sub.horizon_s() + 1.0)
+        log(f"[soak] federation leg: {len(sub.events)} event(s) over "
+            f"{horizon:.0f}s, {FEDERATION_HOSTS} hosts")
+        t_end = time.monotonic() + horizon
+        i = 0
+        while time.monotonic() < t_end:
+            injector.poll()
+            deliver_kills()
+            n = max(8, int(round(16 * injector.load_multiplier())))
+            slice_record(i, n)
+            i += 1
+        injector.poll()
+        deliver_kills()
+        # recovery tail: serve until the router's burn gauges are cold
+        deadline = time.monotonic() + 60.0
+        while time.monotonic() < deadline:
+            rec = slice_record(i, 16)
+            i += 1
+            burns = {k: v for k, v in rec.items() if k.endswith("_burn")}
+            if burns and all(v < 1.0 for v in burns.values()):
+                break
+        else:
+            leg["errors"].append("slo burn never recovered below 1.0")
+        injector.finish()
+
+        # --- the acceptance criterion, pinned in soak form ---------------
+        final = slices[-1]
+        if final["router_retries_exhausted"] != 0:
+            leg["errors"].append(
+                f"dropped requests: router_retries_exhausted="
+                f"{final['router_retries_exhausted']:g}")
+        if final["router_generation_split"] != 0:
+            leg["errors"].append("service served two generations")
+        expect_healthy = FEDERATION_HOSTS - len(set(leg["killed"]))
+        if final["router_healthy"] != expect_healthy:
+            leg["errors"].append(
+                f"healthy={final['router_healthy']:g}, expected "
+                f"{expect_healthy} after {len(set(leg['killed']))} kill(s)")
+    except Exception as e:  # noqa: BLE001 — leg failure goes in the report
+        leg["errors"].append(f"federation leg crashed: {e!r}")
+    finally:
+        disarm()
+        if writer is not None:
+            writer.close()
+        if server is not None:
+            server.stop()
+        elif router is not None:
+            router.close()
+        for proc in procs:
+            if proc.poll() is None:
+                proc.terminate()
+                try:
+                    proc.wait(timeout=30)
+                except subprocess.TimeoutExpired:
+                    proc.kill()
+        for tr in (router_tracer, client_tracer):
+            if tr is not None:
+                tr.close()
+
+    # one trace id must stitch all three tiers: client -> router -> host
+    tiered = _read_traces(fed_out)
+    by_tier: dict = {}
+    for tier, rec in tiered:
+        by_tier.setdefault(tier, set()).add(rec["trace"])
+    three_tier = (by_tier.get("client", set())
+                  & by_tier.get("router", set())
+                  & by_tier.get("host", set()))
+    leg["three_tier_traces"] = len(three_tier)
+    if not three_tier:
+        leg["errors"].append(
+            "no trace id stitches client -> router -> host")
+
+    leg["slices"] = len(slices)
+    leg["fired"] = injector.fired_sequence() if injector is not None else []
+    leg["ok"] = not leg["errors"]
+    inj_records = injector.records() if injector is not None else []
+    return {"leg": leg,
+            "records": slices + inj_records + [r for _, r in tiered]}
 
 
 # ------------------------------------------------------------ trainer legs
@@ -461,7 +675,9 @@ def main(argv=None) -> int:
     records: list = []
     run_dirs: list = []
     facts = {
-        "expect_serving": "serving" in planes,
+        # the federation leg serves through the router, so either plane
+        # produces serving-slice records and burn gauges
+        "expect_serving": bool({"serving", "service"} & planes),
         "expect_async": "train_async" in planes,
         "expect_kill": ("train_sync" in planes
                         and "trainer_kill" in plan.kinds()),
@@ -482,6 +698,10 @@ def main(argv=None) -> int:
     if "serving" in planes:
         res = run_serving_leg(plan, out, args.duration)
         legs["serving"] = res["leg"]
+        records += res["records"]
+    if "service" in planes:
+        res = run_federation_leg(plan, out, args.duration)
+        legs["service"] = res["leg"]
         records += res["records"]
 
     # --- incident correlation: the soak verdict layer --------------------

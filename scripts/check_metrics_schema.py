@@ -186,6 +186,15 @@ KNOWN_PREFIXES = (
     # split, criticals, flap suppressions — the typed {"incident": ...}
     # lifecycle records are validated separately
     "incident_",
+    # cross-host serving federation (serving/router.py ServiceRouter): the
+    # router tier's request/failover/brownout outcome counters, probe + host
+    # health accounting, generation-consistent push/rollback totals, the
+    # generation-split flag gauge, and the upstream-latency sketch
+    "router_",
+    # per-host gauges of the same federation record (host_<hid>_state/...)
+    # — host_rss_bytes predates the family and is carved out in the strict
+    # vocabulary below
+    "host_",
 )
 
 # registry suffixes a histogram sketch appends on flush (registry.py
@@ -206,6 +215,10 @@ STRICT_FAMILY_PATTERNS = {
         # HTTP client-side (serving/server.py HttpPolicyClient): client wall
         # minus the server-reported server_ms, and transport/HTTP failures
         r"|client_overhead_ms|client_errors"
+        # multi-target loadgen (serving/loadgen.py MultiTargetClient): the
+        # same client-side pair re-emitted per endpoint next to the merged
+        # sketch, so federated runs attribute overhead per host/router URL
+        r"|target_\d+_client_(overhead_ms|errors)"
         r")(_max|_sum|_p50|_p95|_p99|_count|_mean)?$"),
     "decode_cache_": re.compile(
         r"^decode_cache_(bytes_b\d+|steps|hit_fraction)$"),
@@ -274,6 +287,17 @@ STRICT_FAMILY_PATTERNS = {
     "obs_": re.compile(
         r"^obs_(snapshot_requests|collector_polls"
         r"|collector_merged_records)$"),
+    "router_": re.compile(
+        r"^router_(hosts|healthy|requests|retries|retries_exhausted"
+        r"|failovers|shed|no_healthy|brownout|unhealthy_marks|readmissions"
+        r"|probes|probe_failures|pushes|rollbacks|push_failures|slo_gated"
+        r"|generation|generation_split"
+        r"|upstream_ms(_p50|_p95|_p99|_count|_mean))$"),
+    # host_rss_bytes is the long-standing process gauge; everything else
+    # under host_ is the federation record's per-host state
+    "host_": re.compile(
+        r"^host_(rss_bytes"
+        r"|\d+_(state|outstanding|generation|requests|failures))$"),
     "tune_": re.compile(
         r"^tune_(applied|overridden|mismatch|search_wall_s|probes"
         r"|probes_pruned|verify_ratio|ratio_[a-z0-9_]+)$"),
@@ -310,6 +334,15 @@ REQUIRED_SERVING = (
     "serving_qps", "serving_ok", "serving_wall_s",
     "serving_p50_ms", "serving_p95_ms", "serving_p99_ms",
     "serving_shed_rate", "serving_deadline_miss_rate", "serving_error_rate",
+)
+
+# a router record (identified by router_hosts) must carry the federation
+# contract: service size/health, request + failover outcomes, honest
+# brownout accounting, and the generation gauges that expose a split-brain
+# service (two hosts steady-state serving different weight generations)
+REQUIRED_ROUTER = (
+    "router_hosts", "router_healthy", "router_requests", "router_failovers",
+    "router_brownout", "router_generation", "router_generation_split",
 )
 
 # a fleet record (identified by fleet_replicas) must carry the replication
@@ -751,7 +784,8 @@ def validate_record(record, index: int = 0, strict_names: bool = True,
                                  "staleness_", "store_", "offpolicy_",
                                  "chaos_",
                                  "scrape_", "obs_", "tune_",
-                                 "ts_", "incident_"))) and v < 0:
+                                 "ts_", "incident_",
+                                 "router_", "host_"))) and v < 0:
             errs.append(f"{where}: field {k!r} is negative ({v})")
         if k in UNIT_INTERVAL and not (0.0 <= v <= 1.0):
             errs.append(f"{where}: field {k!r} must be in [0, 1], got {v}")
@@ -778,6 +812,10 @@ def validate_record(record, index: int = 0, strict_names: bool = True,
         for k in REQUIRED_FLEET:
             if k not in record:
                 errs.append(f"{where}: fleet record missing {k!r}")
+    if "router_hosts" in record:  # federation router record
+        for k in REQUIRED_ROUTER:
+            if k not in record:
+                errs.append(f"{where}: router record missing {k!r}")
     if "fps" in record:  # training record: enforce the full contract
         fused = record.get("iters_per_dispatch", 1) > 1
         for k in REQUIRED_CORE:

@@ -12,7 +12,11 @@ trees) — plus their rotated ``.1`` predecessors, and prints four panels:
    go" is answerable without loading anything into a UI.
 2. **Fleet / SLO summary**: the last observed serving percentiles (merged
    sketch snapshots), fleet routing and rollout counters, live SLO burn-rate
-   gauges, and every typed anomaly record grouped by kind.
+   gauges, and every typed anomaly record grouped by kind.  Runs fronted by
+   the federation router (serving/router.py) additionally get a **service
+   topology** panel: per-host health / request share / weight generation
+   (split-brain generations are flagged), the router's failover / brownout /
+   push accounting, and the upstream-latency sketch.
 3. **Actor/learner overlap** (``--async_actors`` runs): submesh split, queue
    depth / queue-wait p95 / drop counter, actor-vs-learner progress, and the
    param-staleness histogram.
@@ -38,7 +42,10 @@ one coherent report across a whole service (serving fleet + trainer + loadgen
 - a **cross-process trace stitching** panel: span records grouped by trace id
   across sources, counting traces that crossed a process boundary and showing
   the client-root minus server-root overhead plus the slowest stitched
-  request (client wall, server wall, failover ``attempt`` hops),
+  request (client wall, server wall, failover ``attempt`` hops).  A federated
+  service stitches THREE tiers under one id — client root, router root
+  (kind ``router``, with its ``route`` host hops), host-fleet root — and the
+  panel renders the full chain for the slowest such trace,
 - a **chaos-vs-SLO timeline**: every chaos record correlated, in stream
   order, with the nearest SLO burn / latency-tail observation before and
   after it,
@@ -211,6 +218,67 @@ def fleet_panel(metrics: List[dict]) -> List[str]:
         lines.append("  anomalies:")
         for kind, n in sorted(by_kind.items()):
             lines.append(f"    {kind:<34} {n:>12}")
+    return lines
+
+
+_HOST_STATES = {0.0: "UNHEALTHY", 1.0: "healthy"}
+
+
+def service_panel(metrics: List[dict]) -> List[str]:
+    """Federation topology from the router's ``router_``/``host_`` record
+    (serving/router.py ``service_record``): one row per host with health
+    state, request share, and weight generation; a split-brain service (two
+    hosts steady-state on different generations) is flagged loudly, as is a
+    generation-split gauge left high by a partial roll."""
+    lines = ["== service topology (federation router) =="]
+    latest = _last_with_prefix(metrics, ("router_", "host_"))
+    latest.pop("host_rss_bytes", None)   # the process gauge, not a host row
+    if not any(k.startswith("router_") for k in latest):
+        return lines + ["  (no service router records)"]
+    n_hosts = latest.get("router_hosts", 0.0)
+    lines.append(f"  hosts {n_hosts:.0f}  healthy {latest.get('router_healthy', 0):.0f}"
+                 f"  service generation {latest.get('router_generation', 0):.0f}")
+    host_ids = sorted(
+        int(m.group(1)) for k in latest
+        for m in [re.match(r"^host_(\d+)_state$", k)] if m)
+    total_req = sum(latest.get(f"host_{h}_requests", 0.0) for h in host_ids)
+    gens = {latest.get(f"host_{h}_generation", 0.0) for h in host_ids}
+    if host_ids:
+        lines.append(f"  {'host':<6} {'state':<11} {'gen':>4} {'requests':>9} "
+                     f"{'share':>7} {'outstanding':>12} {'failures':>9}")
+    for h in host_ids:
+        state = _HOST_STATES.get(
+            latest.get(f"host_{h}_state", -1.0), "?")
+        req = latest.get(f"host_{h}_requests", 0.0)
+        gen = latest.get(f"host_{h}_generation", 0.0)
+        flag = "  <-- GENERATION SPLIT" if len(gens) > 1 else ""
+        lines.append(
+            f"  h{h:<5} {state:<11} {gen:>4.0f} {req:>9.0f} "
+            f"{(req / total_req if total_req else 0.0):>6.1%} "
+            f"{latest.get(f'host_{h}_outstanding', 0.0):>12.0f} "
+            f"{latest.get(f'host_{h}_failures', 0.0):>9.0f}{flag}")
+    if latest.get("router_generation_split", 0.0):
+        lines.append("  router_generation_split=1  <-- SPLIT-BRAIN SERVICE")
+    ups = {k: v for k, v in latest.items()
+           if k.startswith("router_upstream_ms")}
+    if ups:
+        lines.append(
+            f"  upstream latency p50 {ups.get('router_upstream_ms_p50', 0):.2f} ms"
+            f"  p95 {ups.get('router_upstream_ms_p95', 0):.2f} ms"
+            f"  p99 {ups.get('router_upstream_ms_p99', 0):.2f} ms"
+            f"  (n={ups.get('router_upstream_ms_count', 0):.0f})")
+    lines.append("  router counters (last observed):")
+    for k in sorted(k for k in latest
+                    if k.startswith("router_")
+                    and not k.startswith("router_upstream_ms")
+                    and k not in ("router_hosts", "router_healthy",
+                                  "router_generation")):
+        flag = ""
+        if k == "router_retries_exhausted" and latest[k] > 0:
+            flag = "  <-- DROPPED REQUESTS"
+        elif k == "router_generation_split" and latest[k] > 0:
+            flag = "  <-- SPLIT-BRAIN SERVICE"
+        lines.append(f"    {k:<34} {latest[k]:>12.1f}{flag}")
     return lines
 
 
@@ -445,12 +513,20 @@ def federation_panel(metrics: List[dict]) -> List[str]:
     return lines
 
 
+# stitched-trace tiers, outermost first; a federated request carries all
+# three kinds under one trace id (client -> router -> host fleet), a direct
+# fleet request only client + serving
+_TIER_ORDER = ("client", "router", "serving")
+
+
 def stitch_panel(source_traces: Dict[str, List[dict]]) -> List[str]:
     """Group span records by trace id ACROSS sources.  A trace id seen in
     more than one source crossed a process boundary (W3C traceparent over
-    ``POST /v1/act``); for those, the client root minus the server root is
-    the network + client-stack overhead, and ``attempt`` spans under the same
-    id show failover hops."""
+    ``POST /v1/act``); for those, the client root minus the innermost server
+    root is the network + client-stack overhead.  A federated service chains
+    THREE roots under one id — client, router (kind ``router``), host fleet —
+    and the slowest such trace is rendered tier by tier with the router's
+    ``route`` host hops and the fleet's ``attempt`` replica hops."""
     lines = ["== cross-process trace stitching =="]
     by_trace: Dict[str, List[tuple]] = defaultdict(list)
     for src, traces in source_traces.items():
@@ -460,28 +536,40 @@ def stitch_panel(source_traces: Dict[str, List[dict]]) -> List[str]:
                 by_trace[str(tid)].append((src, rec))
     multi = {tid: recs for tid, recs in by_trace.items()
              if len({src for src, _ in recs}) > 1}
+    three_tier = 0
+    for recs in multi.values():
+        kinds = {str(r.get("kind", "?")) for _, r in recs
+                 if r.get("parent") is None}
+        if len(kinds & set(_TIER_ORDER)) >= 3:
+            three_tier += 1
     lines.append(f"  trace ids {len(by_trace)}  "
                  f"stitched across processes {len(multi)}")
+    lines.append(f"  three-tier (client->router->host) {three_tier}")
     if not multi:
         return lines + ["  (no trace id observed in more than one process)"]
     overheads: List[float] = []
     worst = None
     for tid, recs in multi.items():
-        client = server = None
+        # slowest root per kind: a router retry can land the same trace id
+        # on more than one host, and the slow hop is the informative one
+        roots: Dict[str, tuple] = {}
         for src, r in recs:
             if r.get("parent") is not None:
                 continue
-            if r.get("kind") == "client":
-                client = (src, r)
-            else:
-                server = (src, r)
+            kind = str(r.get("kind", "?"))
+            if kind not in roots or float(r.get("dur_ms", 0.0)) > \
+                    float(roots[kind][1].get("dur_ms", 0.0)):
+                roots[kind] = (src, r)
+        client = roots.get("client")
+        server = roots.get("serving") or next(
+            ((s, r) for k, (s, r) in roots.items() if k != "client"), None)
         if client is None or server is None:
             continue
         overheads.append(max(0.0, float(client[1].get("dur_ms", 0.0))
                              - float(server[1].get("dur_ms", 0.0))))
         if worst is None or float(client[1].get("dur_ms", 0.0)) > \
                 float(worst[1][1].get("dur_ms", 0.0)):
-            worst = (tid, client, server, recs)
+            worst = (tid, client, roots, recs)
     if overheads:
         lines.append(
             f"  client-minus-server overhead: n={len(overheads)}  "
@@ -489,20 +577,29 @@ def stitch_panel(source_traces: Dict[str, List[dict]]) -> List[str]:
             f"p95 {percentile(overheads, 0.95):.2f} ms  "
             f"max {max(overheads):.2f} ms")
     if worst is not None:
-        tid, (csrc, croot), (ssrc, sroot), recs = worst
+        tid, _, roots, recs = worst
         lines.append(f"  -- slowest stitched trace {tid} --")
-        lines.append(f"    {csrc + '/' + str(croot.get('span', '?')):<36} "
-                     f"{float(croot.get('dur_ms', 0.0)):>9.2f} ms  "
-                     f"status={croot.get('status', '?')}")
-        lines.append(f"    {ssrc + '/' + str(sroot.get('span', '?')):<36} "
-                     f"{float(sroot.get('dur_ms', 0.0)):>9.2f} ms  "
-                     f"status={sroot.get('status', '?')}")
-        hops = sorted((r for _, r in recs if r.get("span") == "attempt"),
+        ordered = [k for k in _TIER_ORDER if k in roots] \
+            + sorted(k for k in roots if k not in _TIER_ORDER)
+        for depth, kind in enumerate(ordered):
+            src, root = roots[kind]
+            label = "  " * depth + f"{src}/{root.get('span', '?')}"
+            lines.append(f"    {label:<36} "
+                         f"{float(root.get('dur_ms', 0.0)):>9.2f} ms  "
+                         f"status={root.get('status', '?')}")
+        hops = sorted((r for _, r in recs
+                       if r.get("span") in ("attempt", "route")),
                       key=lambda r: float(r.get("t_ms", 0.0)))
         for hop in hops:
-            lines.append(f"      attempt replica={hop.get('replica', '?')} "
-                         f"ok={hop.get('ok', '?')} "
-                         f"{float(hop.get('dur_ms', 0.0)):.2f} ms")
+            if hop.get("span") == "route":
+                lines.append(f"      route host={hop.get('host', '?')} "
+                             f"retry={hop.get('retry', '?')} "
+                             f"ok={hop.get('ok', '?')} "
+                             f"{float(hop.get('dur_ms', 0.0)):.2f} ms")
+            else:
+                lines.append(f"      attempt replica={hop.get('replica', '?')} "
+                             f"ok={hop.get('ok', '?')} "
+                             f"{float(hop.get('dur_ms', 0.0)):.2f} ms")
     return lines
 
 
@@ -576,6 +673,7 @@ def build_report(metrics: List[dict], traces: List[dict]) -> str:
     sections = [
         span_panel(traces),
         fleet_panel(metrics),
+        service_panel(metrics),
         incident_panel(metrics),
         timeseries_panel(metrics),
         async_panel(metrics),
@@ -590,20 +688,24 @@ def load_streams(root: Optional[Path], metrics_path: Optional[Path] = None,
     """(metrics, traces) for one run dir, rotated files included and
     trace-shaped records split out of mixed streams."""
     extra: List[dict] = []
+    trace_files: List[Optional[Path]] = [trace_path]
     if root is not None:
         if metrics_path is None:
             found = sorted(root.rglob("metrics.jsonl"))
             metrics_path = found[0] if found else None
         if trace_path is None:
-            found = sorted(root.rglob("trace.jsonl"))
-            trace_path = found[0] if found else None
+            # a service run dir nests one trace stream per tier (router/,
+            # host0/, host1/, ...) — the stitching panel needs all of them
+            trace_files = sorted(root.rglob("trace.jsonl")) or [None]
         # rollup + incident streams ride into the metrics view: their typed
         # records feed the incident/trend panels
         for name in ("timeseries.jsonl", "incidents.jsonl"):
             for path in sorted(root.rglob(name)):
                 extra += read_jsonl(with_rotated(path))
     metrics = read_jsonl(with_rotated(metrics_path)) + extra
-    traces = read_jsonl(with_rotated(trace_path))
+    traces: List[dict] = []
+    for path in trace_files:
+        traces += read_jsonl(with_rotated(path))
     # trace records may interleave into metrics.jsonl-shaped fixtures; split
     # them by shape rather than by file so mixed streams still report
     traces += [r for r in metrics if "trace" in r]

@@ -1,14 +1,21 @@
 #!/usr/bin/env python
-"""Push a policy export into a running fleet (canary-gated hot weight swap).
+"""Push a policy export into a running fleet or federated service.
 
-Stdlib HTTP client against ``scripts/serve_fleet.py``'s control endpoints.
-The push blocks until the fleet's canary gate resolves and prints the full
-report (status promoted | rolled_back | rejected, comparison/mismatch counts,
-warm-pass recompiles, requests dropped during the push — expected 0).
+Stdlib HTTP client against the control endpoints of ``serve_fleet.py``
+(default) or ``serve_service.py`` (``--service``).  The push blocks until
+the canary gate(s) resolve and prints the full report (status promoted |
+rolled_back | rejected, comparison/mismatch counts, warm-pass recompiles,
+requests dropped during the push — expected 0).
+
+With ``--service`` the target is the router tier and the push is
+generation-consistent across hosts: every host's canary gate must pass and
+the federated SLO burn must be clean, or every already-promoted host rolls
+back — the report carries the per-host sub-reports.
 
 Usage:
   python scripts/push_policy.py --policy_dir exports/gen2 [--host 127.0.0.1]
       [--port 8420] [--rollback]   # --rollback ignores --policy_dir
+  python scripts/push_policy.py --service --port 8520 --policy_dir exports/gen2
 """
 
 import argparse
@@ -23,12 +30,23 @@ def main(argv=None) -> int:
     p.add_argument("--policy_dir", default=None,
                    help="export dir to push (required unless --rollback)")
     p.add_argument("--host", default="127.0.0.1")
-    p.add_argument("--port", type=int, default=8420)
-    p.add_argument("--timeout_s", type=float, default=300.0,
-                   help="HTTP timeout; covers warm passes + the canary gate")
+    p.add_argument("--port", type=int, default=None,
+                   help="default 8420 (fleet) / 8520 (--service)")
+    p.add_argument("--service", action="store_true",
+                   help="target a serve_service.py router instead of a "
+                        "single fleet: the push rolls every host through "
+                        "its canary gate, generation-consistently")
+    p.add_argument("--timeout_s", type=float, default=None,
+                   help="HTTP timeout; covers warm passes + the canary "
+                        "gate(s); default 300 (fleet) / 900 (--service)")
     p.add_argument("--rollback", action="store_true",
                    help="roll the fleet back to its prior manifest instead")
     args = p.parse_args(argv)
+    if args.port is None:
+        args.port = 8520 if args.service else 8420
+    if args.timeout_s is None:
+        # a service push serializes N host canary gates
+        args.timeout_s = 900.0 if args.service else 300.0
 
     if args.rollback:
         url = f"http://{args.host}:{args.port}/v1/rollback"

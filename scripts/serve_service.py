@@ -1,0 +1,180 @@
+#!/usr/bin/env python
+"""Serve a policy export as a cross-host federated service.
+
+Boots N host-local fleets (each a ``scripts/serve_fleet.py`` subprocess —
+the process-simulated stand-in for one physical host) and fronts them with
+the :class:`~mat_dcml_tpu.serving.router.ServiceRouter` HTTP tier, so the
+whole federation answers on ONE ``/v1/act`` URL.  Alternatively,
+``--host_urls`` fronts fleets that are already running (real multi-host).
+
+Usage:
+  python scripts/serve_service.py --policy_dir exports/gen1 \
+      [--n_hosts 3] [--replicas 2] [--port 8520] [--buckets 1,8,32,128] \
+      [--run_dir results/service --trace_sample 0.01] [--slo_p99_ms 250]
+
+  # front fleets that are already up (skips spawning):
+  python scripts/serve_service.py --host_urls http://h0:8420,http://h1:8420
+
+Control plane against the running router:
+  curl -X POST localhost:8520/v1/push -d '{"policy_dir": "exports/gen2"}'
+  curl -X POST localhost:8520/v1/rollback
+  curl localhost:8520/service        # per-host health/generation/outstanding
+  curl localhost:8520/metrics        # Prometheus text, router families
+
+A push through the router is generation-consistent: every host's canary
+gate must pass and the federated SLO burn must be clean, or every
+already-promoted host is rolled back — no two hosts serve different
+generations steady-state (``push_policy.py --service`` wraps the curl).
+"""
+
+import argparse
+import json
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+from mat_dcml_tpu.serving.router import (  # noqa: E402
+    RouterConfig,
+    RouterServer,
+    ServiceRouter,
+)
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _wait_healthy(url: str, timeout_s: float) -> bool:
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        try:
+            with urllib.request.urlopen(url + "/healthz", timeout=2.0) as r:
+                if json.loads(r.read()).get("ok"):
+                    return True
+        except OSError:
+            pass
+        time.sleep(0.5)
+    return False
+
+
+def spawn_hosts(args, ports) -> list:
+    """One ``serve_fleet.py`` subprocess per simulated host."""
+    procs = []
+    for hid, port in enumerate(ports):
+        cmd = [sys.executable, str(REPO / "scripts" / "serve_fleet.py"),
+               "--policy_dir", args.policy_dir,
+               "--replicas", str(args.replicas),
+               "--port", str(port),
+               "--buckets", args.buckets,
+               "--max_queue", str(args.max_queue)]
+        if args.slo_p99_ms > 0:
+            cmd += ["--slo_p99_ms", str(args.slo_p99_ms)]
+        if args.run_dir:
+            host_dir = Path(args.run_dir) / f"host{hid}"
+            cmd += ["--run_dir", str(host_dir),
+                    "--trace_sample", str(args.trace_sample)]
+        procs.append(subprocess.Popen(cmd))
+    return procs
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description="MAT federated policy service")
+    p.add_argument("--policy_dir", default=None,
+                   help="export dir from scripts/export_policy.py "
+                        "(required unless --host_urls)")
+    p.add_argument("--host_urls", default=None,
+                   help="comma list of already-running fleet base URLs; "
+                        "skips spawning host subprocesses")
+    p.add_argument("--n_hosts", type=int, default=3)
+    p.add_argument("--replicas", type=int, default=2,
+                   help="decode replicas per host fleet")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=8520)
+    p.add_argument("--buckets", default="1,8,32,128")
+    p.add_argument("--max_queue", type=int, default=256)
+    p.add_argument("--max_retries", type=int, default=2,
+                   help="sibling-host failover retries per request")
+    p.add_argument("--probe_interval_s", type=float, default=0.25)
+    p.add_argument("--boot_timeout_s", type=float, default=300.0,
+                   help="per-host warmup budget before giving up")
+    p.add_argument("--run_dir", default=None,
+                   help="observability output dir (enables trace.jsonl on "
+                        "the router and every spawned host)")
+    p.add_argument("--trace_sample", type=float, default=0.01,
+                   help="fraction of requests traced (0 disables)")
+    p.add_argument("--slo_p99_ms", type=float, default=0.0,
+                   help="service-level p99 SLO in ms; 0 disables burn "
+                        "tracking (also forwarded to spawned hosts)")
+    args = p.parse_args(argv)
+
+    procs = []
+    if args.host_urls:
+        urls = [u.strip().rstrip("/")
+                for u in args.host_urls.split(",") if u.strip()]
+    else:
+        if not args.policy_dir:
+            p.error("--policy_dir is required unless --host_urls is given")
+        ports = [_free_port() for _ in range(args.n_hosts)]
+        procs = spawn_hosts(args, ports)
+        urls = [f"http://127.0.0.1:{port}" for port in ports]
+    if not urls:
+        p.error("no host endpoints")
+
+    for url in urls:
+        if not _wait_healthy(url, args.boot_timeout_s):
+            for proc in procs:
+                proc.terminate()
+            print(f"[service] host {url} never became healthy", file=sys.stderr)
+            return 1
+        print(f"[service] host {url} healthy")
+
+    tracer = None
+    if args.run_dir and args.trace_sample > 0:
+        from mat_dcml_tpu.telemetry.tracing import Tracer
+
+        tracer = Tracer(str(Path(args.run_dir) / "router"),
+                        sample=args.trace_sample)
+    slo = None
+    if args.slo_p99_ms > 0:
+        from mat_dcml_tpu.telemetry.slo import SLOConfig, SLOMonitor
+
+        slo = SLOMonitor(SLOConfig(latency_p99_ms=args.slo_p99_ms))
+
+    router = ServiceRouter(
+        urls,
+        RouterConfig(max_retries=args.max_retries,
+                     probe_interval_s=args.probe_interval_s),
+        tracer=tracer, slo_monitor=slo)
+    server = RouterServer(router, host=args.host, port=args.port)
+    server.start()
+
+    def _shutdown(*_):
+        raise KeyboardInterrupt
+
+    signal.signal(signal.SIGTERM, _shutdown)
+    try:
+        threading.Event().wait()
+    except KeyboardInterrupt:
+        server.stop()
+        for proc in procs:
+            proc.terminate()
+        for proc in procs:
+            try:
+                proc.wait(timeout=10.0)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv[1:]))

@@ -1,0 +1,107 @@
+"""Host-fleet worker for the serving-federation tests (run via subprocess).
+
+One process-simulated HOST: a real ``EngineFleet`` behind a ``PolicyServer``
+— the upstream a :class:`~mat_dcml_tpu.serving.router.ServiceRouter` fronts.
+The federation tests spawn N of these, route load through an in-process
+router, SIGKILL one mid-load, and assert sibling-host failover with zero
+client-visible drops, one trace id across all three tiers, and bit-exact
+replies from surviving hosts (every host initializes the same params from
+seed 0, and decode is pure).
+
+Prints ``PORT <n>`` once serving, then lingers until ``--linger_s`` expires
+or SIGTERM.  CFG/BUCKETS match tests/test_fleet.py so warmup hits the
+persistent compile cache (tests/conftest.py).
+
+Usage:
+    python tests/service_worker.py --run_dir DIR [--replicas 2]
+        [--linger_s 60] [--trace_sample 1.0] [--slo_p99_ms 0]
+"""
+
+import argparse
+import os
+import sys
+import time
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, repo_root)
+
+_cache_dir = os.environ.get(
+    "MAT_DCML_TPU_TEST_CACHE",
+    os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache"),
+)
+jax.config.update("jax_compilation_cache_dir", _cache_dir)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.1)
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+
+from mat_dcml_tpu.models.mat import MATConfig  # noqa: E402
+from mat_dcml_tpu.models.policy import TransformerPolicy  # noqa: E402
+from mat_dcml_tpu.serving.batcher import BatcherConfig  # noqa: E402
+from mat_dcml_tpu.serving.engine import EngineConfig  # noqa: E402
+from mat_dcml_tpu.serving.fleet import EngineFleet, FleetConfig  # noqa: E402
+from mat_dcml_tpu.serving.server import PolicyServer  # noqa: E402
+from mat_dcml_tpu.telemetry.tracing import Tracer  # noqa: E402
+
+BUCKETS = (2, 4)
+
+CFG = MATConfig(
+    n_agent=3, obs_dim=4, state_dim=5, action_dim=3,
+    n_block=1, n_embd=16, n_head=2,
+)
+
+
+def log(*a):
+    print(*a, flush=True)
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--run_dir", required=True)
+    parser.add_argument("--replicas", type=int, default=2)
+    parser.add_argument("--linger_s", type=float, default=60.0)
+    parser.add_argument("--trace_sample", type=float, default=1.0)
+    parser.add_argument("--slo_p99_ms", type=float, default=0.0)
+    args = parser.parse_args()
+
+    params = TransformerPolicy(CFG).init_params(jax.random.key(0))
+    tracer = Tracer(args.run_dir, sample=args.trace_sample)
+    fleet = EngineFleet(
+        params, CFG,
+        # replica probing is the fleet's concern; the federation tests
+        # exercise HOST-level health, so keep replica probes out of the way
+        fleet_cfg=FleetConfig(n_replicas=args.replicas,
+                              probe_interval_s=600.0),
+        engine_cfg=EngineConfig(buckets=BUCKETS),
+        batcher_cfg=BatcherConfig(max_batch_wait_ms=2.0),
+        tracer=tracer, log_fn=log,
+    )
+    fleet.warmup()
+
+    slo = None
+    if args.slo_p99_ms > 0:
+        from mat_dcml_tpu.telemetry.slo import SLOConfig, SLOMonitor
+
+        slo = SLOMonitor(SLOConfig(latency_p99_ms=args.slo_p99_ms))
+
+    server = PolicyServer(fleet=fleet, port=0, log_fn=log, slo_monitor=slo)
+    server.warm = True        # fleet already warm; don't re-warm on start
+    server.start()
+    log(f"PORT {server.port}")
+    try:
+        time.sleep(args.linger_s)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        server.stop()
+        fleet.close()
+        tracer.close()
+    log("DONE")
+
+
+if __name__ == "__main__":
+    main()

@@ -91,7 +91,7 @@ def test_plan_filter_preserves_ids():
     assert all(e.event_id in full_ids for e in sub.events)
     assert plan.filter(kinds=("load_spike",)).kinds() == ("load_spike",)
     assert set(FAULT_KINDS.values()) == {"serving", "train_sync",
-                                         "train_async"}
+                                         "train_async", "service"}
 
 
 # ===================================================================

@@ -1,0 +1,633 @@
+"""Cross-host serving federation: router failure matrix + subprocess legs.
+
+What the PR's acceptance hinges on:
+
+- **host kill mid-request → sibling retry, one trace id**: a host that dies
+  on an in-flight request is marked UNHEALTHY and the request retries on a
+  sibling; the SAME traceparent reaches every host tried, so the stitched
+  trace shows the failover.
+- **all-saturated → honest 429**: when every host sheds, the client sees a
+  429 whose Retry-After is the LARGEST upstream hint (the whole service has
+  capacity only once its slowest host does).
+- **readmission**: an unhealthy host returns to rotation after
+  ``probe_successes`` consecutive clean ``/healthz`` probes.
+- **generation-consistent push**: a mid-roll host failure rolls the WHOLE
+  service back — every already-promoted host reverts, steady state never
+  serves two generations (``router_generation_split`` stays 0).
+- **three real tiers** (subprocess leg): loadgen client → router → host
+  fleet share one trace id; a SIGKILLed host under load drops zero requests;
+  surviving hosts answer the same request bit-exactly.
+
+The fast tier uses scripted stdlib fake hosts (no jax, no engine) so the
+matrix runs in milliseconds; the subprocess leg boots real fleets
+(tests/service_worker.py) with the shared compile cache.
+"""
+
+import json
+import signal
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from mat_dcml_tpu.serving.batcher import (
+    EngineFailureError,
+    QueueFullError,
+)
+from mat_dcml_tpu.serving.loadgen import MultiTargetClient, _ShapeCfg
+from mat_dcml_tpu.serving.router import (
+    HEALTHY,
+    UNHEALTHY,
+    RouterConfig,
+    RouterServer,
+    ServiceRouter,
+)
+from mat_dcml_tpu.serving.server import HttpPolicyClient
+from mat_dcml_tpu.telemetry.propagate import TRACEPARENT_HEADER
+from mat_dcml_tpu.telemetry.tracing import Tracer
+
+_REPO = Path(__file__).resolve().parent.parent
+
+QUIET = lambda *a: None  # noqa: E731
+
+# no prober interference unless a test asks for it
+SLOW_PROBES = RouterConfig(probe_interval_s=600.0, backoff_base_ms=0.1)
+
+
+# --------------------------------------------------------------- fake hosts
+
+
+class FakeHost:
+    """Scripted upstream: canned ``/v1/act`` / ``/healthz`` / push behavior,
+    mutable per test.  ``act_mode``: ok | shed | error.  ``push_mode``:
+    promote | fail.  Records every traceparent it sees."""
+
+    def __init__(self, generation: int = 1):
+        self.generation = generation
+        self.prior_generation = generation
+        self.act_mode = "ok"
+        self.retry_after = 2.0
+        self.healthz_ok = True
+        self.push_mode = "promote"
+        self.burns = {}                   # /telemetry.json extra_gauges
+        self.seen_traceparents = []
+        self.acts = 0
+        fake = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def _reply(self, code, payload):
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path == "/healthz" and fake.healthz_ok:
+                    self._reply(200, {"ok": True, "fleet": {
+                        "replicas": 2, "healthy": 2,
+                        "generation": fake.generation}})
+                elif self.path == "/telemetry.json":
+                    self._reply(200, {"source": "fake", "seq": 1,
+                                      "sources": {},
+                                      "extra_gauges": dict(fake.burns)})
+                else:
+                    self._reply(503, {"error": "unhealthy"})
+
+            def do_POST(self):
+                length = int(self.headers.get("Content-Length", "0"))
+                body = self.rfile.read(length)
+                if self.path == "/v1/act":
+                    fake.acts += 1
+                    fake.seen_traceparents.append(
+                        self.headers.get(TRACEPARENT_HEADER))
+                    if fake.act_mode == "shed":
+                        self._reply(429, {
+                            "error": "queue full", "kind": "queue_full",
+                            "retry_after_s": fake.retry_after})
+                    elif fake.act_mode == "error":
+                        self._reply(500, {"error": "engine dead",
+                                          "kind": "engine_failure"})
+                    else:
+                        n = len(json.loads(body)["obs"])
+                        self._reply(200, {
+                            "action": [[0]] * n, "log_prob": [[0.0]] * n,
+                            "server_ms": 0.1,
+                            "generation": fake.generation})
+                elif self.path == "/v1/push":
+                    if fake.push_mode == "promote":
+                        fake.prior_generation = fake.generation
+                        fake.generation += 1
+                        self._reply(200, {"status": "promoted",
+                                          "generation": fake.generation})
+                    else:
+                        self._reply(500, {"status": "rolled_back",
+                                          "error": "canary gate tripped"})
+                elif self.path == "/v1/rollback":
+                    fake.generation = fake.prior_generation
+                    self._reply(200, {"status": "rolled_back",
+                                      "generation": fake.generation})
+                else:
+                    self._reply(404, {"error": "no route"})
+
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        threading.Thread(target=self._httpd.serve_forever,
+                         daemon=True).start()
+
+    @property
+    def url(self) -> str:
+        return f"http://127.0.0.1:{self._httpd.server_address[1]}"
+
+    def stop(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+
+@pytest.fixture
+def hosts():
+    made = []
+
+    def make(n, **kw):
+        made.extend(FakeHost(**kw) for _ in range(n))
+        return made
+
+    yield make
+    for h in made:
+        h.stop()
+
+
+def _body(n_agent=3):
+    return json.dumps({
+        "state": [[0.0] * 5] * n_agent,
+        "obs": [[0.0] * 4] * n_agent,
+    }).encode()
+
+
+# ----------------------------------------------------------- failure matrix
+
+
+def test_host_death_fails_over_to_sibling_one_traceparent(hosts, tmp_path):
+    """A host 500-ing an in-flight request is marked UNHEALTHY and the
+    request retries on the sibling — success, one failover counted, and the
+    SAME traceparent delivered to both hosts."""
+    h0, h1 = hosts(2)
+    h0.act_mode = "error"
+    tracer = Tracer(str(tmp_path), sample=1.0)
+    router = ServiceRouter([h0.url, h1.url], SLOW_PROBES,
+                           tracer=tracer, log_fn=QUIET)
+    try:
+        # bias the first pick onto the dying host (tie-breaks rotate)
+        router.hosts[1].outstanding = 1
+        trace = tracer.start_trace("router")
+        payload = router.route(_body(), trace=trace)
+        trace.finish(status="ok")
+        assert payload["router_host"] == 1
+        assert payload["generation"] == 1
+        assert router.hosts[0].state == UNHEALTHY
+        assert router.hosts[1].state == HEALTHY
+        rec = router.service_record()
+        assert rec["router_failovers"] == 1
+        assert rec["router_retries"] == 1
+        assert rec["router_retries_exhausted"] == 0
+        # one trace id reached every host tried
+        seen = h0.seen_traceparents + h1.seen_traceparents
+        assert len(seen) == 2 and None not in seen
+        ids = {tp.split("-")[1] for tp in seen}
+        assert len(ids) == 1
+    finally:
+        router.close()
+        tracer.close()
+
+
+def test_retries_exhausted_surfaces_typed_error(hosts):
+    """Every host dead: the retry budget spends out into the typed
+    EngineFailureError (a client-visible drop, counted as such)."""
+    h0, h1 = hosts(2)
+    h0.act_mode = h1.act_mode = "error"
+    router = ServiceRouter(
+        [h0.url, h1.url],
+        RouterConfig(max_retries=1, probe_interval_s=600.0,
+                     backoff_base_ms=0.1),
+        log_fn=QUIET)
+    try:
+        with pytest.raises(EngineFailureError):
+            router.route(_body())
+        assert router.service_record()["router_retries_exhausted"] == 1
+    finally:
+        router.close()
+
+
+def test_all_hosts_saturated_429_with_max_retry_after(hosts):
+    """Both hosts shed with different hints -> service-level QueueFullError
+    carrying the LARGEST hint; hosts stay HEALTHY (saturation != sickness)."""
+    h0, h1 = hosts(2)
+    h0.act_mode = h1.act_mode = "shed"
+    h0.retry_after, h1.retry_after = 2.0, 5.0
+    router = ServiceRouter([h0.url, h1.url], SLOW_PROBES, log_fn=QUIET)
+    try:
+        with pytest.raises(QueueFullError) as exc:
+            router.route(_body())
+        assert exc.value.retry_after_s == 5.0
+        assert all(h.state == HEALTHY for h in router.hosts)
+        rec = router.service_record()
+        assert rec["router_shed"] == 1
+        assert rec["router_unhealthy_marks"] == 0
+    finally:
+        router.close()
+
+
+def test_brownout_when_no_healthy_hosts(hosts):
+    """Zero healthy hosts -> honest brownout 429 whose hint covers one
+    probe-readmission cycle, not an engine error."""
+    h0, h1 = hosts(2)
+    router = ServiceRouter(
+        [h0.url, h1.url],
+        RouterConfig(probe_interval_s=2.0, probe_successes=2),
+        log_fn=QUIET)
+    try:
+        for h in router.hosts:
+            router._mark_unhealthy(h, "test")
+        with pytest.raises(QueueFullError) as exc:
+            router.route(_body())
+        assert exc.value.retry_after_s == 4    # ceil(2.0 * 2)
+        rec = router.service_record()
+        assert rec["router_brownout"] == 1
+        assert rec["router_no_healthy"] == 1
+    finally:
+        router.close()
+
+
+def test_unhealthy_host_readmitted_after_clean_probes(hosts):
+    """The fleet's UNHEALTHY -> probe -> readmit machine at host granularity:
+    after the host recovers, ``probe_successes`` consecutive clean probes
+    put it back in rotation (and refresh its advertised generation)."""
+    h0, h1 = hosts(2)
+    h0.act_mode = "error"
+    router = ServiceRouter(
+        [h0.url, h1.url],
+        RouterConfig(probe_interval_s=0.05, probe_successes=2,
+                     backoff_base_ms=0.1),
+        log_fn=QUIET)
+    try:
+        router.hosts[1].outstanding = 1   # deterministic first pick
+        router.route(_body())
+        assert router.hosts[0].state == UNHEALTHY
+        h0.act_mode = "ok"           # host recovers; healthz was always ok
+        h0.generation = 7
+        deadline = time.monotonic() + 10.0
+        while router.hosts[0].state != HEALTHY:
+            assert time.monotonic() < deadline, "host never readmitted"
+            time.sleep(0.02)
+        rec = router.service_record()
+        assert rec["router_readmissions"] == 1
+        assert router.hosts[0].generation == 7    # probe refreshed it
+    finally:
+        router.close()
+
+
+def test_routing_prefers_least_outstanding_then_health_penalty(hosts):
+    """The fleet's _pick one level up: equal depth routes away from the host
+    with failover history."""
+    h0, h1 = hosts(2)
+    router = ServiceRouter([h0.url, h1.url], SLOW_PROBES, log_fn=QUIET)
+    try:
+        router.hosts[0].failures = 3.0     # dirty history, still HEALTHY
+        for _ in range(4):
+            assert router.route(_body())["router_host"] == 1
+        router.hosts[1].outstanding = 5    # sibling now deep in flight
+        assert router.route(_body())["router_host"] == 0
+    finally:
+        router.close()
+
+
+# ----------------------------------------------------- generation consistency
+
+
+def test_push_promotes_every_host_or_none(hosts):
+    """Clean roll: every host promotes, service generation advances, no
+    split.  Mid-roll failure: the failed host aborts the roll, every
+    already-promoted host is rolled back, and steady state is one uniform
+    generation again."""
+    h0, h1, h2 = hosts(3)
+    router = ServiceRouter([h.url for h in (h0, h1, h2)], SLOW_PROBES,
+                           log_fn=QUIET)
+    try:
+        report = router.push("exports/gen2")
+        assert report["status"] == "promoted"
+        assert report["generation"] == 2
+        assert {h.generation for h in router.hosts} == {2}
+        assert router.status()["generation_split"] is False
+
+        # next roll dies on the LAST host: hosts 0+1 already promoted to 3,
+        # host 2 trips its canary gate -> full-service rollback to 2
+        h2.push_mode = "fail"
+        report = router.push("exports/gen3")
+        assert report["status"] == "rolled_back"
+        assert report["failed_host"] == 2
+        assert {h.generation for h in (h0, h1, h2)} == {2}, \
+            "a rolled-back service must serve ONE generation everywhere"
+        assert router.status()["generation_split"] is False
+        rec = router.service_record()
+        assert rec["router_pushes"] == 1
+        assert rec["router_rollbacks"] == 1
+        assert rec["router_push_failures"] == 1
+        assert rec["router_generation_split"] == 0.0
+        assert rec["router_generation"] == 2.0
+    finally:
+        router.close()
+
+
+def test_push_vetoed_by_federated_slo_burn(hosts):
+    """A burning host vetoes the roll before ANY host swaps — never widen a
+    rollout into a burning service."""
+    h0, h1 = hosts(2)
+    h1.burns = {"slo_latency_burn": 2.5}
+    router = ServiceRouter([h0.url, h1.url], SLOW_PROBES, log_fn=QUIET)
+    try:
+        report = router.push("exports/gen2")
+        assert report["status"] == "rejected"
+        assert report["events"][0]["host"] == 1
+        assert {h.generation for h in (h0, h1)} == {1}   # nobody swapped
+        assert router.service_record()["router_slo_gated"] == 1
+    finally:
+        router.close()
+
+
+def test_concurrent_push_rejected(hosts):
+    h0, = hosts(1)
+    router = ServiceRouter([h0.url], SLOW_PROBES, log_fn=QUIET)
+    try:
+        assert router._push_lock.acquire(blocking=False)
+        try:
+            with pytest.raises(RuntimeError, match="already in progress"):
+                router.push("exports/gen2")
+        finally:
+            router._push_lock.release()
+    finally:
+        router.close()
+
+
+# ------------------------------------------------------------- HTTP frontend
+
+
+def test_router_server_speaks_the_fleet_protocol(hosts, tmp_path):
+    """The RouterServer is a drop-in PolicyServer: HttpPolicyClient acts
+    against it unchanged, /healthz + /service + /telemetry.json respond, and
+    the 429 mapping carries the service-level Retry-After."""
+    h0, h1 = hosts(2)
+    router = ServiceRouter([h0.url, h1.url], SLOW_PROBES, log_fn=QUIET)
+    server = RouterServer(router, port=0, log_fn=QUIET)
+    server.start()
+    try:
+        base = f"http://127.0.0.1:{server.port}"
+        cfg = _ShapeCfg(3, 4, 5, 3)
+        client = HttpPolicyClient(base, cfg=cfg)
+        action, log_prob = client.act(
+            np.zeros((3, 5), np.float32), np.zeros((3, 4), np.float32))
+        assert action.shape == (3, 1)
+
+        with urllib.request.urlopen(base + "/healthz", timeout=5) as r:
+            health = json.loads(r.read())
+        assert health["ok"] and health["service"]["hosts"] == 2
+        with urllib.request.urlopen(base + "/service", timeout=5) as r:
+            status = json.loads(r.read())
+        assert [h["state"] for h in status["hosts"]] == [HEALTHY, HEALTHY]
+        with urllib.request.urlopen(base + "/telemetry.json", timeout=5) as r:
+            snap = json.loads(r.read())
+        assert snap["source"].startswith("router:")
+
+        h0.act_mode = h1.act_mode = "shed"
+        h0.retry_after, h1.retry_after = 3.0, 9.0
+        with pytest.raises(QueueFullError) as exc:
+            client.act(np.zeros((3, 5), np.float32),
+                       np.zeros((3, 4), np.float32))
+        assert exc.value.retry_after_s == 9.0
+        # the raw header carries the same max hint
+        req = urllib.request.Request(base + "/v1/act", data=_body(),
+                                     method="POST")
+        with pytest.raises(urllib.error.HTTPError) as http_exc:
+            urllib.request.urlopen(req, timeout=5)
+        assert http_exc.value.code == 429
+        assert float(http_exc.value.headers["Retry-After"]) == 9.0
+    finally:
+        server.stop()
+
+
+def test_service_record_validates_against_schema(hosts):
+    """The router's flat record is schema-clean under --strict, including
+    the REQUIRED_ROUTER contract."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "check_metrics_schema",
+        _REPO / "scripts" / "check_metrics_schema.py")
+    cms = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(cms)
+
+    h0, h1 = hosts(2)
+    h0.act_mode = "error"
+    router = ServiceRouter([h0.url, h1.url], SLOW_PROBES, log_fn=QUIET)
+    try:
+        router.route(_body())
+        rec = router.service_record()
+        assert cms.validate_record(rec, 0) == []
+        assert cms.validate_record(rec, 0, strict=True) == []
+        for k in cms.REQUIRED_ROUTER:
+            assert k in rec, k
+    finally:
+        router.close()
+
+
+def test_multi_target_loadgen_attributes_per_endpoint(hosts):
+    """The loadgen's MultiTargetClient round-robins across targets and its
+    flushed record carries BOTH the merged client-overhead sketch and the
+    per-target families."""
+    h0, h1 = hosts(2)
+    client = MultiTargetClient([h0.url, h1.url], cfg=_ShapeCfg(3, 4, 5, 3))
+    for _ in range(4):
+        client.act(np.zeros((3, 5), np.float32),
+                   np.zeros((3, 4), np.float32))
+    assert h0.acts == 2 and h1.acts == 2        # round-robin split
+    rec = client.telemetry.flush()
+    assert rec["serving_client_overhead_ms_count"] == 4
+    assert rec["serving_target_0_client_overhead_ms_count"] == 2
+    assert rec["serving_target_1_client_overhead_ms_count"] == 2
+
+
+# ------------------------------------------------------- real-fleet leg
+
+
+def _env():
+    import os
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.setdefault("MAT_DCML_TPU_TEST_CACHE",
+                   str(_REPO / "tests" / ".jax_cache"))
+    return env
+
+
+def _spawn_host(run_dir):
+    proc = subprocess.Popen(
+        [sys.executable, str(_REPO / "tests" / "service_worker.py"),
+         "--run_dir", str(run_dir), "--linger_s", "300"],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        cwd=str(_REPO), env=_env())
+    lines = []
+
+    def pump():
+        for line in proc.stdout:
+            lines.append(line.rstrip("\n"))
+
+    threading.Thread(target=pump, daemon=True).start()
+    return proc, lines
+
+
+def _wait_port(proc, lines, timeout=300.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        for ln in list(lines):
+            if ln.startswith("PORT"):
+                return int(ln.split()[1])
+        if proc.poll() is not None:
+            raise AssertionError(
+                f"host exited rc={proc.returncode}:\n" + "\n".join(lines[-50:]))
+        time.sleep(0.05)
+    raise AssertionError("timeout waiting for PORT:\n" + "\n".join(lines[-50:]))
+
+
+def _stop(proc):
+    if proc.poll() is None:
+        proc.terminate()
+        try:
+            proc.wait(timeout=30)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait(timeout=10)
+
+
+def test_host_kill_under_load_three_tiers_bit_exact(tmp_path):
+    """The acceptance leg on REAL fleets: two service_worker hosts behind an
+    in-process router+HTTP frontend; one host is SIGKILLed mid-load.  Every
+    request succeeds (zero drops), at least one trace id stitches all three
+    tiers (client -> router -> host), and the same request answered before
+    and after the kill — necessarily by different hosts — returns identical
+    bits (decode is pure, hosts share seed-0 params)."""
+    from mat_dcml_tpu.models.mat import MATConfig
+    from mat_dcml_tpu.serving.loadgen import synth_requests
+
+    cfg = MATConfig(n_agent=3, obs_dim=4, state_dim=5, action_dim=3,
+                    n_block=1, n_embd=16, n_head=2)
+    procs = []
+    try:
+        (p0, l0), (p1, l1) = (_spawn_host(tmp_path / "h0"),
+                              _spawn_host(tmp_path / "h1"))
+        procs += [p0, p1]
+        ports = [_wait_port(p0, l0), _wait_port(p1, l1)]
+        router_tracer = Tracer(str(tmp_path / "router"), sample=1.0)
+        router = ServiceRouter(
+            [f"http://127.0.0.1:{p}" for p in ports],
+            RouterConfig(probe_interval_s=600.0, backoff_base_ms=1.0),
+            tracer=router_tracer, log_fn=QUIET)
+        server = RouterServer(router, port=0, log_fn=QUIET)
+        server.start()
+        try:
+            cli_tracer = Tracer(str(tmp_path / "cli"), sample=1.0)
+            client = HttpPolicyClient(f"http://127.0.0.1:{server.port}",
+                                      cfg=cfg, tracer=cli_tracer)
+            states, obs, avail = synth_requests(cfg, 12, seed=7)
+
+            before_a, before_lp = client.act(states[0], obs[0], avail[0])
+            for i in range(1, 6):
+                client.act(states[i], obs[i], avail[i])
+
+            # SIGKILL whichever host served the last request: the next
+            # request that routes there fails over to the sibling
+            victim = 0 if router.hosts[0].requests >= \
+                router.hosts[1].requests else 1
+            procs[victim].kill()
+            procs[victim].wait(timeout=30)
+
+            for i in range(6, 12):
+                action, _ = client.act(states[i], obs[i], avail[i])
+                assert action.shape == (cfg.n_agent, 1)
+            after_a, after_lp = client.act(states[0], obs[0], avail[0])
+
+            # bit-exact across hosts: same request, same bits, regardless of
+            # which host answered before/after the kill
+            np.testing.assert_array_equal(before_a, after_a)
+            np.testing.assert_array_equal(before_lp, after_lp)
+
+            rec = router.service_record()
+            assert rec["router_retries_exhausted"] == 0, "a request dropped"
+            assert rec["router_failovers"] >= 1
+            assert rec["router_healthy"] == 1.0
+            cli_tracer.close()
+        finally:
+            server.stop()
+            router_tracer.close()
+
+        # one trace id across all three tiers of at least one request
+        def trace_ids(d):
+            path = Path(d) / "trace.jsonl"
+            if not path.exists():
+                return {}
+            out = {}
+            for line in path.read_text().splitlines():
+                rec = json.loads(line)
+                out.setdefault(rec["trace"], []).append(rec)
+            return out
+
+        cli = trace_ids(tmp_path / "cli")
+        rtr = trace_ids(tmp_path / "router")
+        surviving = trace_ids(tmp_path / f"h{1 - victim}")
+        three_tier = set(cli) & set(rtr) & set(surviving)
+        assert three_tier, (sorted(cli), sorted(rtr), sorted(surviving))
+        tid = sorted(three_tier)[0]
+        assert any(r["span"] == "route" for r in rtr[tid])
+        assert any(r["span"] == "request" for r in surviving[tid])
+    finally:
+        for p in procs:
+            _stop(p)
+
+
+# ------------------------------------------------------- chaos-soak leg
+
+
+@pytest.mark.slow
+def test_chaos_soak_federation_plan_passes(tmp_path):
+    """The committed service-plane plan end to end through the soak driver:
+    three real host fleets behind the router, host 1 SIGKILLed mid-soak by
+    an armed ``host_loss`` event — zero drops, one stitched trace id, one
+    generation, an attributed ``service_host_down`` incident, and every
+    invariant green."""
+    out = tmp_path / "soak"
+    proc = subprocess.run(
+        [sys.executable, str(_REPO / "scripts" / "chaos_soak.py"),
+         "--plan", str(_REPO / "tests" / "data" / "plans" / "federation.json"),
+         "--out", str(out), "--duration", "8"],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        cwd=str(_REPO), env=_env(), timeout=600)
+    assert proc.returncode == 0, proc.stdout[-4000:]
+    report = json.loads((out / "chaos_report.json").read_text())
+    assert report["pass"] is True
+    assert report["planes"] == ["service"]
+    leg = report["legs"]["service"]
+    assert leg["ok"] is True
+    assert leg["killed"] == [1]
+    assert leg["fired"] == ["host_loss:000"]
+    assert leg["three_tier_traces"] >= 1
+    assert report["incidents"]["incident_total"] >= 1
+    assert report["incidents"]["incident_unexplained"] == 0
+    assert report["schema_errors"] == []
